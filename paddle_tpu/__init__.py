@@ -1,0 +1,89 @@
+"""paddle_tpu: a TPU-native deep learning framework.
+
+Brand-new design with the capabilities of the PaddlePaddle reference
+(define-by-run autograd, static capture, hybrid-parallel distributed
+training), built on JAX/XLA/Pallas idioms: ops are jax lowerings fused by
+XLA, the autograd tape records jax VJP closures, program capture jits whole
+train steps, and parallelism is expressed over a jax.sharding.Mesh with
+XLA collectives on ICI/DCN.
+"""
+from __future__ import annotations
+
+import importlib
+
+__version__ = "0.1.0"
+
+from .core import dtype as _dtype_mod
+from .core.dtype import (bfloat16, bool_ as bool8, complex64, complex128, float16,
+                         float32, float64, int8, int16, int32, int64, uint8)
+from .core.tensor import Tensor, as_tensor, is_tensor
+from .core.dispatch import no_grad, enable_grad, set_grad_enabled_ctx as set_grad_enabled
+from .core.generator import seed, get_rng_state, set_rng_state, Generator
+from .core.flags import get_flags, set_flags, define_flag
+from .core.place import (CPUPlace, CustomPlace, Place, TPUPlace, device_count,
+                         get_device, is_compiled_with_tpu, set_device)
+from .core import enforce
+
+# Op surface (also attaches Tensor methods).
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation
+from .ops.creation import to_tensor
+from .autograd import backward, grad, is_grad_enabled, PyLayer
+
+CUDAPlace = TPUPlace  # source-compat alias: accelerator place
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_distribute():
+    return True
+
+
+def in_dynamic_mode():
+    from .jit.api import in_capture_mode
+    return not in_capture_mode()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    return None
+
+
+def save(obj, path, protocol=4, **kwargs):
+    from .framework.io import save as _save
+    return _save(obj, path, protocol=protocol, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework.io import load as _load
+    return _load(path, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes=dtypes, input=input)
+
+
+_LAZY_MODULES = {
+    "nn", "optimizer", "amp", "io", "jit", "distributed", "vision", "metric",
+    "profiler", "autograd", "incubate", "framework", "device", "static", "hapi",
+    "distribution", "linalg", "fft", "sparse", "text", "onnx", "quantization",
+    "models", "utils",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY_MODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

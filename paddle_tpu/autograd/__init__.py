@@ -1,0 +1,46 @@
+"""User-facing autograd API (reference: python/paddle/autograd/)."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..core.dispatch import (enable_grad, no_grad, set_grad_enabled_ctx as
+                             set_grad_enabled, grad_enabled)
+from ..core.tensor import Tensor
+from .engine import AccumulationNode, GradNode, run_backward
+from .pylayer import PyLayer, PyLayerContext
+
+
+def is_grad_enabled() -> bool:
+    return grad_enabled()
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward (reference python/paddle/autograd/backward_mode.py)."""
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is not None and isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    run_backward(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """paddle.grad — functional gradients without touching .grad fields
+    (reference python/paddle/base/dygraph/base.py grad)."""
+    outs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    ins = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    gouts = grad_outputs
+    if gouts is not None and isinstance(gouts, Tensor):
+        gouts = [gouts]
+    if retain_graph is None:
+        retain_graph = create_graph
+    grads = run_backward(outs, gouts, retain_graph=retain_graph,
+                         create_graph=create_graph, inputs=ins,
+                         accumulate_into_leaves=False)
+    if not allow_unused:
+        for t, g in zip(ins, grads):
+            if g is None:
+                raise RuntimeError(
+                    f"One of the differentiated tensors ({t.name}) appears unused; "
+                    "pass allow_unused=True to get None for it")
+    return grads

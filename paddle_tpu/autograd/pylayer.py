@@ -1,0 +1,110 @@
+"""PyLayer: user-defined autograd functions.
+
+Capability parity with reference paddle/fluid/eager/pylayer/ +
+python/paddle/autograd/py_layer.py. The custom backward runs through the
+dispatcher, so its ops are themselves jax lowerings (traceable, fusable).
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .engine import AccumulationNode, GradNode
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.non_differentiable = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable = tensors
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = value
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Subclass with static ``forward(ctx, *args)`` / ``backward(ctx, *grads)``."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..core import dispatch
+
+        ctx = PyLayerContext()
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        with dispatch.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        out_list = [outs] if single else list(outs)
+
+        requires = [not t.stop_gradient for t in tensor_inputs]
+        record = dispatch.grad_enabled() and any(requires)
+        if record:
+            node = _PyLayerGradNode(cls, ctx, tensor_inputs, out_list, requires)
+            for i, o in enumerate(out_list):
+                if isinstance(o, Tensor) and o not in ctx.non_differentiable:
+                    o.stop_gradient = False
+                    o.grad_node = node
+                    o.output_index = i
+        return outs
+
+
+class _PyLayerGradNode(GradNode):
+    """GradNode whose vjp is the user's backward()."""
+
+    __slots__ = ("cls", "ctx")
+
+    def __init__(self, cls, ctx, tensor_inputs, out_list, requires):
+        edges = []
+        for t, req in zip(tensor_inputs, requires):
+            if not req:
+                edges.append((None, 0))
+            elif t.grad_node is not None:
+                edges.append((t.grad_node, t.output_index))
+            else:
+                if getattr(t, "_accum_node", None) is None:
+                    t._accum_node = AccumulationNode(t)
+                edges.append((t._accum_node, 0))
+        out_avals = [(tuple(o.shape), np.dtype(o.dtype)) if isinstance(o, Tensor)
+                     else ((), np.dtype(np.float32)) for o in out_list]
+        super().__init__(f"pylayer_{cls.__name__}", self._run_backward, edges,
+                         out_avals, requires, out_tuple=len(out_list) > 1)
+        self.cls = cls
+        self.ctx = ctx
+
+    def _run_backward(self, cts):
+        if not isinstance(cts, tuple):
+            cts = (cts,)
+        grad_ts = [Tensor(c) if not isinstance(c, Tensor) else c for c in cts]
+        outs = self.cls.backward(self.ctx, *grad_ts)
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        return tuple(o._data if isinstance(o, Tensor) else o for o in outs)

@@ -1,0 +1,2 @@
+from . import dispatch, dtype, enforce, flags, generator, place
+from .tensor import Tensor, as_tensor, is_tensor

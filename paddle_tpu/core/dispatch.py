@@ -1,0 +1,273 @@
+"""Eager op dispatcher.
+
+TPU-native replacement for the reference's generated per-op ``*_ad_func``
+layer (reference: paddle/fluid/eager/auto_code_generator/generator/
+eager_gen.py:301 — AMP cast -> type promotion -> autograd-meta -> GradNode ->
+PHI kernel call -> NaN check). Here one generic ``call`` does that pipeline
+for every op: the "kernel" is a jax-level lowering (XLA fuses + schedules, so
+there is no KernelKey/backend selection), and the GradNode is the jax.vjp
+closure of the lowering. Payloads may be tracers, so the same dispatcher body
+is what program capture traces through.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from . import flags
+from .tensor import Tensor
+
+# Filled in lazily to break the core<->autograd import cycle (the autograd
+# package re-exports dispatch's grad-mode contexts).
+GradNode = None
+AccumulationNode = None
+
+
+def _bind_engine():
+    global GradNode, AccumulationNode
+    if GradNode is None:
+        from ..autograd.engine import AccumulationNode as _A, GradNode as _G
+        GradNode, AccumulationNode = _G, _A
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "grad_enabled"):
+        _state.grad_enabled = True
+        _state.amp_level = "O0"
+        _state.amp_dtype = dtypes.bfloat16
+        _state.amp_custom_white = set()
+        _state.amp_custom_black = set()
+    return _state
+
+
+def grad_enabled() -> bool:
+    return _tls().grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    s = _tls()
+    prev = s.grad_enabled
+    s.grad_enabled = mode
+    return prev
+
+
+class no_grad:
+    """Context manager + decorator (paddle.no_grad)."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class set_grad_enabled_ctx:
+    def __init__(self, mode: bool):
+        self._mode = mode
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(self._mode)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# AMP op lists — capability parity with reference python/paddle/amp/amp_lists.py
+# (bf16-first: on TPU the MXU natively consumes bf16).
+# ---------------------------------------------------------------------------
+AMP_WHITE_OPS = {
+    "matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
+    "einsum", "linear", "addmm", "flash_attention", "scaled_dot_product_attention",
+}
+AMP_BLACK_OPS = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "softmax_with_cross_entropy",
+    "layer_norm", "rms_norm", "batch_norm", "group_norm", "instance_norm",
+    "mean", "sum", "cumsum", "sigmoid_cross_entropy", "reduce_sum",
+    "norm", "cos_sim", "erfinv", "acos", "asin", "atan2",
+}
+
+
+def amp_state():
+    s = _tls()
+    return s.amp_level, s.amp_dtype
+
+
+def set_amp_state(level: str, dtype=None, custom_white=None, custom_black=None):
+    s = _tls()
+    prev = (s.amp_level, s.amp_dtype, s.amp_custom_white, s.amp_custom_black)
+    s.amp_level = level
+    if dtype is not None:
+        s.amp_dtype = dtypes.convert_dtype(dtype)
+    s.amp_custom_white = set(custom_white or ())
+    s.amp_custom_black = set(custom_black or ())
+    return prev
+
+
+def restore_amp_state(prev):
+    s = _tls()
+    s.amp_level, s.amp_dtype, s.amp_custom_white, s.amp_custom_black = prev
+
+
+def _amp_cast_inputs(op_name: str, arrays: List):
+    """O1: cast white-list op inputs to amp dtype, black-list to fp32.
+    O2 casting happens at the parameter level (amp.decorate)."""
+    s = _tls()
+    if s.amp_level not in ("O1", "O2"):
+        return arrays
+    name = op_name.lower()
+    white = (name in AMP_WHITE_OPS or name in s.amp_custom_white)
+    black = (name in AMP_BLACK_OPS or name in s.amp_custom_black)
+    if white and not black:
+        target = s.amp_dtype
+    elif black:
+        target = dtypes.float32
+    else:
+        return arrays
+    out = []
+    for a in arrays:
+        d = np.dtype(a.dtype)
+        if d in (dtypes.float16, dtypes.bfloat16, dtypes.float32) and d != target:
+            a = a.astype(target)
+        out.append(a)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+_op_hooks: List[Callable] = []  # profiler / debugging taps
+
+
+def register_op_hook(fn):
+    _op_hooks.append(fn)
+    return fn
+
+
+def _check_nan_inf(op_name, outs):
+    for o in outs:
+        d = np.dtype(o.dtype)
+        if np.issubdtype(d, np.floating) or d == dtypes.bfloat16:
+            bad = bool(jnp.any(~jnp.isfinite(o)))
+            if bad:
+                level = flags.get_flag("check_nan_inf_level")
+                msg = f"NaN or Inf found in output of op '{op_name}'"
+                if level == 0:
+                    raise FloatingPointError(msg)
+                print(f"[paddle_tpu][nan_inf] {msg}")
+
+
+def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
+         attrs: Optional[dict] = None, multi_output: bool = False,
+         differentiable_mask: Optional[Sequence[bool]] = None):
+    """Run one op: ``fn(*arrays, **attrs)`` over the payloads of
+    ``tensor_inputs``, recording a GradNode when grad is enabled and any
+    input requires grad. Returns Tensor or list of Tensors."""
+    attrs = attrs or {}
+    s = _tls()
+    if GradNode is None:
+        _bind_engine()
+
+    arrays = [t._data for t in tensor_inputs]
+    arrays = _amp_cast_inputs(op_name, arrays)
+
+    requires = [
+        (not t.stop_gradient) and (differentiable_mask[i] if differentiable_mask else True)
+        for i, t in enumerate(tensor_inputs)
+    ]
+    record = s.grad_enabled and any(requires)
+
+    if attrs:
+        f = lambda *xs: fn(*xs, **attrs)
+    else:
+        f = fn
+
+    node = None
+    if record:
+        outs, vjp_fn = jax.vjp(f, *arrays)
+    else:
+        outs = f(*arrays)
+
+    out_tuple = isinstance(outs, (tuple, list))
+    single = not out_tuple
+    out_list = [outs] if single else list(outs)
+
+    if record:
+        edges = []
+        for t, req in zip(tensor_inputs, requires):
+            if not req:
+                edges.append((None, 0))
+            elif t.grad_node is not None:
+                edges.append((t.grad_node, t.output_index))
+            else:
+                if getattr(t, "_accum_node", None) is None:
+                    t._accum_node = AccumulationNode(t)
+                edges.append((t._accum_node, 0))
+        node = GradNode(
+            op_name, vjp_fn, edges,
+            [(o.shape, np.dtype(o.dtype)) for o in out_list],
+            requires, out_tuple=out_tuple,
+            primal_fn=f, saved_inputs=list(tensor_inputs),
+        )
+
+    out_tensors = []
+    for i, o in enumerate(out_list):
+        t = Tensor(o, stop_gradient=not record)
+        if node is not None:
+            t.grad_node = node
+            t.output_index = i
+        out_tensors.append(t)
+
+    if flags.get_flag("check_nan_inf"):
+        _check_nan_inf(op_name, out_list)
+    if flags.get_flag("benchmark"):
+        for o in out_list:
+            jax.block_until_ready(o)
+    for hook in _op_hooks:
+        hook(op_name, tensor_inputs, out_tensors, attrs)
+
+    if single:
+        return out_tensors[0]
+    return out_tensors
+
+
+def wrap_hooks_into_tensor(t: Tensor, hook):
+    """Attach a grad hook to a non-leaf tensor: store it on its producer node."""
+    node = t.grad_node
+    node.output_hooks.setdefault(t.output_index, []).append(hook)
+
+
+def retain_grad_for(t: Tensor):
+    if t.grad_node is not None:
+        t.grad_node.retain_outputs[t.output_index] = t

@@ -1,0 +1,88 @@
+"""Dtype system.
+
+TPU-native re-design of the reference's dtype enum (reference:
+paddle/phi/common/data_type.h). Instead of an enum dispatched through KernelKey
+bit-packing, dtypes are thin aliases over numpy/jax dtypes; XLA handles layout
+and the MXU prefers bfloat16, which is the promoted "half" type here.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects (numpy dtype instances; jax accepts them directly).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype") else np.dtype(jnp.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    # paddle-style aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+FLOATING = {float16, bfloat16, float32, float64}
+INTEGER = {uint8, int8, int16, int32, int64}
+COMPLEX = {complex64, complex128}
+
+
+def convert_dtype(dtype) -> np.dtype:
+    """Normalize any dtype spec (str, numpy dtype, jax dtype, python type)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        name = dtype.lower().replace("paddle.", "")
+        if name in _NAME_TO_DTYPE:
+            return _NAME_TO_DTYPE[name]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    if dtype is float:
+        return float32
+    if dtype is int:
+        return int64
+    if dtype is bool:
+        return bool_
+    try:
+        return np.dtype(dtype)
+    except TypeError as e:
+        raise ValueError(f"Cannot convert {dtype!r} to a dtype") from e
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return "bfloat16" if d == bfloat16 else d.name
+
+
+def is_floating_point(dtype) -> bool:
+    return convert_dtype(dtype) in FLOATING
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in INTEGER or d == bool_
+
+
+def is_complex(dtype) -> bool:
+    return convert_dtype(dtype) in COMPLEX

@@ -1,0 +1,62 @@
+"""Error/enforce machinery (capability parity with reference paddle/common/enforce.h).
+
+The reference raises typed errors (InvalidArgument, NotFound, ...) with
+source-annotated messages; here the same taxonomy maps onto Python exception
+classes so user code can catch framework errors by category.
+"""
+from __future__ import annotations
+
+
+class EnforceNotMet(RuntimeError):
+    """Base class for all framework errors."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PermissionDeniedError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+class UnavailableError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class ExecutionTimeoutError(EnforceNotMet, TimeoutError):
+    pass
+
+
+def enforce(cond, msg="", err_cls=InvalidArgumentError):
+    if not cond:
+        raise err_cls(msg)
+
+
+def enforce_eq(a, b, msg="", err_cls=InvalidArgumentError):
+    if a != b:
+        raise err_cls(f"{msg} (expected {a!r} == {b!r})")
+
+
+def enforce_shape_match(shape_a, shape_b, msg=""):
+    if tuple(shape_a) != tuple(shape_b):
+        raise InvalidArgumentError(f"{msg}: shape mismatch {tuple(shape_a)} vs {tuple(shape_b)}")

@@ -1,0 +1,104 @@
+"""Global flag registry.
+
+Capability parity with the reference's gflags-style system (reference:
+paddle/common/flags.cc — PHI_DEFINE_EXPORTED_* definitions; Python surface
+paddle.get_flags / paddle.set_flags). Flags are defined in Python, can be
+seeded from FLAGS_* environment variables, and are queried by subsystems
+(allocator stats, nan/inf checks, collective timeouts, ...).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    type: type
+    value: Any = None
+
+
+_registry: Dict[str, _Flag] = {}
+_lock = threading.Lock()
+_observers: Dict[str, Callable[[Any], None]] = {}
+
+
+def _coerce(ty: type, raw):
+    if ty is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return ty(raw)
+
+
+def define_flag(name: str, default, help: str = "", type: Optional[type] = None):
+    """Register a flag. Env var FLAGS_<name> overrides the default."""
+    ty = type if type is not None else (default.__class__ if default is not None else str)
+    with _lock:
+        if name in _registry:
+            return _registry[name].value
+        env = os.environ.get("FLAGS_" + name)
+        value = _coerce(ty, env) if env is not None else default
+        _registry[name] = _Flag(name, default, help, ty, value)
+        return value
+
+
+def get_flags(names) -> Dict[str, Any]:
+    if isinstance(names, str):
+        names = [names]
+    out = {}
+    with _lock:
+        for n in names:
+            key = n[6:] if n.startswith("FLAGS_") else n
+            if key not in _registry:
+                raise KeyError(f"Flag {n!r} is not defined")
+            out[n] = _registry[key].value
+    return out
+
+
+def get_flag(name: str):
+    return next(iter(get_flags([name]).values()))
+
+
+def set_flags(flags: Dict[str, Any]):
+    with _lock:
+        for n, v in flags.items():
+            key = n[6:] if n.startswith("FLAGS_") else n
+            if key not in _registry:
+                raise KeyError(f"Flag {n!r} is not defined")
+            f = _registry[key]
+            f.value = _coerce(f.type, v)
+            obs = _observers.get(key)
+            if obs is not None:
+                obs(f.value)
+
+
+def on_change(name: str, fn: Callable[[Any], None]):
+    _observers[name] = fn
+
+
+def all_flags() -> Iterable[str]:
+    return list(_registry)
+
+
+# ---------------------------------------------------------------------------
+# Core flag definitions (subset mirroring reference paddle/common/flags.cc).
+# ---------------------------------------------------------------------------
+define_flag("check_nan_inf", False, "Scan op outputs for NaN/Inf after every op.")
+define_flag("check_nan_inf_level", 0, "0: error on NaN/Inf; >0: log only.")
+define_flag("benchmark", False, "Synchronize after each op for benchmarking.")
+define_flag("paddle_num_threads", 1, "Host threads for compute.")
+define_flag("allocator_strategy", "auto_growth", "Allocator strategy facade (XLA owns HBM).")
+define_flag("eager_delete_tensor_gb", 0.0, "GC threshold facade.")
+define_flag("distributed_timeout_ms", 30 * 60 * 1000, "Collective watchdog timeout.")
+define_flag("stop_check_timeout", -1, "Seconds before a hung collective aborts the job.")
+define_flag("tpu_matmul_precision", "default", "default|high|highest matmul precision.")
+define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for hot ops when available.")
+define_flag("log_level", 0, "VLOG-style verbosity for framework logging.")
+define_flag("cudnn_deterministic", False, "Determinism facade (XLA is deterministic by default).")
+define_flag("max_inplace_grad_add", 0, "Grad accumulation chunking facade.")

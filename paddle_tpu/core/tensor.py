@@ -1,0 +1,242 @@
+"""The eager Tensor.
+
+Capability parity with the reference's eager Tensor (reference:
+paddle/fluid/pybind/eager.cc:1392 Tensor PyType; autograd fields in
+paddle/fluid/eager/autograd_meta.h:61). TPU-native design: the payload is a
+jax.Array (device buffer, possibly sharded across a Mesh — a sharded payload
+IS the DistTensor of reference phi/core/distributed/auto_parallel/dist_tensor.h),
+and autograd metadata (grad_node, persisted .grad, hooks) lives on this Python
+wrapper. Under program capture the payload is a jax tracer and every method
+stays traceable.
+
+Mutation semantics (in-place ops, ``tensor.grad`` accumulation, optimizer
+updates) are implemented by swapping the wrapped functional array — the
+wrapper is the identity, the buffer is a value.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtypes
+from .place import current_place
+
+_name_counter = itertools.count()
+
+
+class Tensor:
+    __array_priority__ = 100  # beat numpy in mixed arithmetic
+
+    def __init__(self, data, *, stop_gradient: bool = True, name: Optional[str] = None,
+                 persistable: bool = False):
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.persistable = persistable
+        self.name = name or f"generated_tensor_{next(_name_counter)}"
+        self._grad: Optional["Tensor"] = None
+        self.grad_node = None          # producer GradNode (None for leaves)
+        self.output_index = 0          # which output of grad_node this is
+        self._backward_hooks: List[Any] = []
+        self._retain_grads = False
+
+    # ------------------------------------------------------------------ meta
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        return current_place()
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.grad_node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return self.dtype.itemsize
+
+    # ---------------------------------------------------------------- grads
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        self._grad = value
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._grad._data))
+        else:
+            self._grad = None
+
+    def retain_grads(self):
+        self._retain_grads = True
+        if self.grad_node is not None:
+            self.grad_node.retain_outputs[self.output_index] = self
+
+    def register_hook(self, hook):
+        """Fire ``hook(grad_tensor)`` when this tensor's gradient is computed.
+
+        The hook may return a new Tensor to replace the gradient (reference:
+        paddle/fluid/eager/hooks.h TensorHook).
+        """
+        if self.stop_gradient:
+            raise RuntimeError("Cannot register hook on a tensor with stop_gradient=True")
+        if self.grad_node is not None:
+            hooks = self.grad_node.output_hooks.setdefault(self.output_index, [])
+            hooks.append(hook)
+            return _HookHandle(hooks, hook)
+        self._backward_hooks.append(hook)
+        return _HookHandle(self._backward_hooks, hook)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        from ..autograd.engine import run_backward
+        run_backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self.grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def stop_gradient_(self, val: bool = True):
+        self.stop_gradient = val
+        return self
+
+    # ------------------------------------------------------------- host sync
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        arr = np.asarray(self._data)
+        return arr.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        try:
+            data_str = np.array2string(np.asarray(self._data), precision=6, separator=", ")
+        except Exception:
+            data_str = f"<{type(self._data).__name__}>"  # tracer under capture
+        return (f"Tensor(shape={self.shape}, dtype={dtypes.dtype_name(self.dtype)}"
+                f"{grad_info},\n       {data_str})")
+
+    # -------------------------------------------------------------- mutation
+    def set_value(self, value):
+        """In-place overwrite (reference Tensor.set_value)."""
+        if isinstance(value, Tensor):
+            value = value._data
+        arr = jnp.asarray(value, dtype=self._data.dtype)
+        if tuple(arr.shape) != tuple(self._data.shape):
+            arr = jnp.broadcast_to(arr, self._data.shape)
+        self._data = arr
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def _swap_payload(self, new_data):
+        self._data = new_data
+        return self
+
+    # ------------------------------------------------------------ traversal
+    def block_until_ready(self):
+        jax.block_until_ready(self._data)
+        return self
+
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
+
+    def to_dist(self, sharding):
+        """Place/reshard onto a NamedSharding — the DistTensor entry point."""
+        return Tensor(jax.device_put(self._data, sharding),
+                      stop_gradient=self.stop_gradient, name=self.name)
+
+    @property
+    def sharding(self):
+        return getattr(self._data, "sharding", None)
+
+    def is_dist(self) -> bool:
+        sh = self.sharding
+        return sh is not None and not sh.is_fully_replicated
+
+    # Arithmetic/method surface is attached by paddle_tpu.ops at import time
+    # (mirrors the reference's monkey-patch of tensor methods,
+    # python/paddle/tensor/__init__.py).
+
+
+class _HookHandle:
+    def __init__(self, hook_list, hook):
+        self._list = hook_list
+        self._hook = hook
+
+    def remove(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def as_tensor(data, dtype=None, stop_gradient: bool = True) -> Tensor:
+    """to_tensor: ingest python/numpy/jax data onto the current device."""
+    if isinstance(data, Tensor):
+        if dtype is not None and dtypes.convert_dtype(dtype) != data.dtype:
+            return Tensor(data._data.astype(dtypes.convert_dtype(dtype)),
+                          stop_gradient=stop_gradient)
+        return data
+    d = dtypes.convert_dtype(dtype) if dtype is not None else None
+    if isinstance(data, np.ndarray) and d is None and data.dtype == np.float64:
+        d = dtypes.float32  # paddle default: float data lands as fp32
+    if isinstance(data, (bool, int, float, list, tuple)) and d is None:
+        probe = np.asarray(data)
+        if probe.dtype == np.float64:
+            d = dtypes.float32
+    arr = jnp.asarray(data, dtype=d)
+    return Tensor(arr, stop_gradient=stop_gradient)

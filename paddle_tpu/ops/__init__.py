@@ -1,0 +1,193 @@
+"""Op layer: user-facing tensor functions + Tensor method attachment.
+
+The reference monkey-patches ~400 methods onto its eager Tensor
+(python/paddle/tensor/__init__.py); this module does the same for ours.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, as_tensor
+from . import creation, linalg, manipulation, math, reduction, search
+from .registry import OPS, op_names, ops_by_category
+
+from .math import *        # noqa: F401,F403
+from .creation import *    # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .reduction import *   # noqa: F401,F403
+from .linalg import *      # noqa: F401,F403
+from .search import *      # noqa: F401,F403
+
+
+# ---------------------------------------------------------------------------
+# Tensor indexing
+# ---------------------------------------------------------------------------
+def _norm_index(idx):
+    """Convert Tensors in an index expression into raw arrays."""
+    def conv(i):
+        if isinstance(i, Tensor):
+            return i._data
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+    if isinstance(idx, tuple):
+        return tuple(conv(i) for i in idx)
+    return conv(idx)
+
+
+def _getitem(self, idx):
+    if isinstance(idx, Tensor) and idx.dtype == np.dtype(bool):
+        # boolean mask -> dynamic shape -> host path (parity with reference
+        # masked_select semantics)
+        return search.masked_select(self, idx) if False else Tensor(
+            jnp.asarray(np.asarray(self._data)[np.asarray(idx._data).astype(bool)]))
+    nidx = _norm_index(idx)
+    return dispatch.call("getitem", lambda a: a[nidx], [self])
+
+
+def _setitem(self, idx, value):
+    nidx = _norm_index(idx)
+    vt = value if isinstance(value, Tensor) else as_tensor(value)
+    def f(a, v):
+        return a.at[nidx].set(v.astype(a.dtype))
+    out = dispatch.call("setitem", f, [self, vt])
+    self._swap_payload(out._data)
+    self.grad_node, self.output_index = out.grad_node, out.output_index
+    self.stop_gradient = out.stop_gradient if not self.stop_gradient else self.stop_gradient
+    return self
+
+
+def _astype(self, dtype):
+    return math.cast(self, dtype)
+
+
+def _clone(self):
+    return creation.clone(self)
+
+
+def _item(self, *args):
+    return Tensor.item(self, *args)
+
+
+_BINARY_OPERATORS = {
+    "__add__": math.add, "__radd__": lambda a, b: math.add(b, a),
+    "__sub__": math.subtract, "__rsub__": lambda a, b: math.subtract(b, a),
+    "__mul__": math.multiply, "__rmul__": lambda a, b: math.multiply(b, a),
+    "__truediv__": math.divide, "__rtruediv__": lambda a, b: math.divide(b, a),
+    "__floordiv__": math.floor_divide,
+    "__rfloordiv__": lambda a, b: math.floor_divide(b, a),
+    "__mod__": math.mod, "__rmod__": lambda a, b: math.mod(b, a),
+    "__pow__": math.pow, "__rpow__": lambda a, b: math.pow(b, a),
+    "__matmul__": linalg.matmul, "__rmatmul__": lambda a, b: linalg.matmul(b, a),
+    "__eq__": math.equal, "__ne__": math.not_equal,
+    "__lt__": math.less_than, "__le__": math.less_equal,
+    "__gt__": math.greater_than, "__ge__": math.greater_equal,
+    "__and__": math.bitwise_and, "__or__": math.bitwise_or,
+    "__xor__": math.bitwise_xor,
+}
+
+
+def _attach_methods():
+    for name, fn in _BINARY_OPERATORS.items():
+        setattr(Tensor, name, (lambda f: lambda self, other: f(self, other))(fn))
+    Tensor.__neg__ = lambda self: math.neg(self)
+    Tensor.__abs__ = lambda self: math.abs(self)
+    Tensor.__invert__ = lambda self: math.logical_not(self)
+    Tensor.__getitem__ = _getitem
+    Tensor.__setitem__ = _setitem
+    Tensor.__hash__ = object.__hash__  # __eq__ override would kill hashing
+
+    methods = {
+        # math
+        "add": math.add, "subtract": math.subtract, "multiply": math.multiply,
+        "divide": math.divide, "floor_divide": math.floor_divide, "mod": math.mod,
+        "remainder": math.mod, "pow": math.pow, "maximum": math.maximum,
+        "minimum": math.minimum, "exp": math.exp, "log": math.log, "log2": math.log2,
+        "log10": math.log10, "log1p": math.log1p, "sqrt": math.sqrt, "rsqrt": math.rsqrt,
+        "square": math.square, "abs": math.abs, "neg": math.neg, "sign": math.sign,
+        "floor": math.floor, "ceil": math.ceil, "round": math.round, "trunc": math.trunc,
+        "reciprocal": math.reciprocal, "sin": math.sin, "cos": math.cos, "tan": math.tan,
+        "asin": math.asin, "acos": math.acos, "atan": math.atan, "sinh": math.sinh,
+        "cosh": math.cosh, "tanh": math.tanh, "erf": math.erf, "sigmoid": math.sigmoid,
+        "scale": math.scale, "clip": math.clip, "lerp": math.lerp, "cast": math.cast,
+        "astype": _astype, "isnan": math.isnan, "isinf": math.isinf,
+        "isfinite": math.isfinite, "equal": math.equal, "not_equal": math.not_equal,
+        "less_than": math.less_than, "less_equal": math.less_equal,
+        "greater_than": math.greater_than, "greater_equal": math.greater_equal,
+        "logical_and": math.logical_and, "logical_or": math.logical_or,
+        "logical_not": math.logical_not, "logical_xor": math.logical_xor,
+        "isclose": math.isclose, "allclose": math.allclose, "equal_all": math.equal_all,
+        "nan_to_num": math.nan_to_num,
+        # reduction
+        "sum": reduction.sum, "mean": reduction.mean, "max": reduction.max,
+        "min": reduction.min, "prod": reduction.prod, "any": reduction.any,
+        "all": reduction.all, "std": reduction.std, "var": reduction.var,
+        "logsumexp": reduction.logsumexp, "median": reduction.median,
+        "cumsum": reduction.cumsum, "cumprod": reduction.cumprod,
+        "amax": reduction.amax, "amin": reduction.amin,
+        "count_nonzero": reduction.count_nonzero,
+        # manipulation
+        "reshape": manipulation.reshape, "reshape_": manipulation.reshape_,
+        "flatten": manipulation.flatten, "squeeze": manipulation.squeeze,
+        "squeeze_": manipulation.squeeze_, "unsqueeze": manipulation.unsqueeze,
+        "unsqueeze_": manipulation.unsqueeze_, "transpose": manipulation.transpose,
+        "tile": manipulation.tile, "expand": manipulation.expand,
+        "expand_as": manipulation.expand_as, "broadcast_to": manipulation.broadcast_to,
+        "flip": manipulation.flip, "roll": manipulation.roll,
+        "gather": manipulation.gather, "gather_nd": manipulation.gather_nd,
+        "scatter": manipulation.scatter, "scatter_nd_add": manipulation.scatter_nd_add,
+        "index_select": manipulation.index_select, "masked_select": search.masked_select
+        if hasattr(search, "masked_select") else manipulation.masked_select,
+        "masked_fill": manipulation.masked_fill, "split": manipulation.split,
+        "chunk": manipulation.chunk, "unbind": manipulation.unbind,
+        "pad": manipulation.pad, "take_along_axis": manipulation.take_along_axis,
+        "put_along_axis": manipulation.put_along_axis, "repeat_interleave":
+        manipulation.repeat_interleave, "diagonal": manipulation.diagonal,
+        "numel_t": manipulation.numel, "moveaxis": manipulation.moveaxis,
+        "unfold": manipulation.unfold, "view": manipulation.view,
+        "view_as": manipulation.view_as,
+        # linalg
+        "matmul": linalg.matmul, "mm": linalg.mm, "bmm": linalg.bmm, "dot": linalg.dot,
+        "norm": linalg.norm, "dist": linalg.dist, "t": linalg.t, "trace": linalg.trace,
+        "inner": linalg.inner, "outer": linalg.outer, "cross": linalg.cross,
+        "cholesky": linalg.cholesky, "inverse": linalg.inverse,
+        "matrix_power": linalg.matrix_power,
+        # search
+        "argmax": search.argmax, "argmin": search.argmin, "argsort": search.argsort,
+        "sort": search.sort, "topk": search.topk, "where": search.where,
+        "nonzero": search.nonzero, "unique": search.unique, "kthvalue": search.kthvalue,
+        "bucketize": search.bucketize,
+        # creation-ish
+        "clone": _clone, "fill_": lambda self, v: self.set_value(
+            jnp.full(tuple(self.shape), v, dtype=self._data.dtype)),
+        "zero_": lambda self: self.set_value(jnp.zeros(tuple(self.shape),
+                                                       dtype=self._data.dtype)),
+    }
+    for name, fn in methods.items():
+        setattr(Tensor, name, fn)
+
+    # in-place arithmetic sugar (paddle add_/subtract_/scale_)
+    def _make_inplace(f):
+        def inplace(self, *a, **k):
+            out = f(self, *a, **k)
+            self._swap_payload(out._data)
+            self.grad_node, self.output_index = out.grad_node, out.output_index
+            if not out.stop_gradient:
+                self.stop_gradient = False
+            return self
+        return inplace
+
+    for nm, f in [("add_", math.add), ("subtract_", math.subtract),
+                  ("multiply_", math.multiply), ("divide_", math.divide),
+                  ("scale_", math.scale), ("clip_", math.clip),
+                  ("exp_", math.exp), ("sqrt_", math.sqrt), ("rsqrt_", math.rsqrt),
+                  ("floor_", math.floor), ("ceil_", math.ceil),
+                  ("reciprocal_", math.reciprocal), ("round_", math.round),
+                  ("tanh_", math.tanh)]:
+        setattr(Tensor, nm, _make_inplace(f))
+
+
+_attach_methods()

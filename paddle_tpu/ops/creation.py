@@ -1,0 +1,277 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py,
+random.py; phi full/arange/gaussian kernels). Random ops draw keys from the
+stateful Generator facade (paddle_tpu.core.generator) so paddle.seed gives
+reproducible streams on top of TPU counter-based PRNG.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype, float32, int64
+from ..core.generator import next_key
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like", "full_like",
+    "empty", "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "rand", "randn", "randint", "randint_like", "uniform",
+    "normal", "standard_normal", "randperm", "multinomial", "bernoulli", "poisson",
+    "exponential_", "tril_indices", "triu_indices", "one_hot", "clone", "assign",
+    "complex", "polar",
+]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return as_tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+
+
+@register("zeros", category="creation", differentiable=False)
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=convert_dtype(dtype) or float32))
+
+
+@register("ones", category="creation", differentiable=False)
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=convert_dtype(dtype) or float32))
+
+
+@register("full", category="creation", differentiable=False)
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = convert_dtype(dtype)
+    if d is None:
+        d = float32 if isinstance(fill_value, float) else None
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=d))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
+                                 dtype=convert_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None):
+    return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
+                                dtype=convert_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x, fill_value,
+                                dtype=convert_dtype(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@register("arange", category="creation", differentiable=False)
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    d = convert_dtype(dtype)
+    if d is None:
+        d = int64 if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else float32
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=convert_dtype(dtype) or float32))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=convert_dtype(dtype) or float32))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype) or float32))
+
+
+@register("diag", category="creation")
+def diag(x, offset=0, padding_value=0, name=None):
+    xt = as_tensor(x)
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, dtype=out.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return dispatch.call("diag", f, [xt])
+
+
+def diagflat(x, offset=0, name=None):
+    xt = as_tensor(x)
+    return dispatch.call("diagflat", lambda a: jnp.diagflat(a, k=offset), [xt])
+
+
+@register("tril", category="creation")
+def tril(x, diagonal=0, name=None):
+    return dispatch.call("tril", lambda a: jnp.tril(a, k=diagonal), [as_tensor(x)])
+
+
+@register("triu", category="creation")
+def triu(x, diagonal=0, name=None):
+    return dispatch.call("triu", lambda a: jnp.triu(a, k=diagonal), [as_tensor(x)])
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = args[0]
+    ts = [as_tensor(a) for a in args]
+    outs = dispatch.call("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), ts)
+    return list(outs)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
+
+
+# ------------------------------------------------------------------- random
+@register("uniform", category="random", differentiable=False)
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = next_key() if seed == 0 else jax.random.key(seed)
+    d = convert_dtype(dtype)
+    return Tensor(jax.random.uniform(key, _shape(shape), dtype=d, minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype or "float32", 0.0, 1.0)
+
+
+@register("gaussian", category="random", differentiable=False)
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean) if not isinstance(mean, Tensor) else mean
+        s = as_tensor(std) if not isinstance(std, Tensor) else std
+        shp = _shape(shape) if shape is not None else tuple(
+            np.broadcast_shapes(tuple(m.shape), tuple(s.shape)))
+        key = next_key()
+        return dispatch.call(
+            "gaussian", lambda mm, ss: mm + ss * jax.random.normal(key, shp, dtype=jnp.float32),
+            [m, s])
+    key = next_key()
+    return Tensor(mean + std * jax.random.normal(key, _shape(shape or [1]), dtype=jnp.float32))
+
+
+def randn(shape, dtype=None, name=None):
+    key = next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), dtype=convert_dtype(dtype) or float32))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@register("randint", category="random", differentiable=False)
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
+                                     dtype=convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    xt = as_tensor(x)
+    return randint(low, high, tuple(xt.shape), dtype or xt.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(next_key(), n).astype(convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    xt = as_tensor(x)
+    key = next_key()
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(key, logits, axis=-1,
+                                          shape=(*p.shape[:-1], num_samples))
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(key, p.shape)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx
+    return dispatch.call("multinomial", f, [xt])
+
+
+def bernoulli(x, name=None):
+    xt = as_tensor(x)
+    key = next_key()
+    return dispatch.call("bernoulli",
+                         lambda p: jax.random.bernoulli(key, p).astype(p.dtype), [xt])
+
+
+def poisson(x, name=None):
+    xt = as_tensor(x)
+    key = next_key()
+    return dispatch.call("poisson",
+                         lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), [xt])
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = next_key()
+    new = jax.random.exponential(key, tuple(x.shape), dtype=x._data.dtype) / lam
+    x._swap_payload(new)
+    return x
+
+
+@register("one_hot", category="creation", differentiable=False)
+def one_hot(x, num_classes, name=None):
+    return dispatch.call("one_hot",
+                         lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
+                         [as_tensor(x)])
+
+
+def clone(x, name=None):
+    return dispatch.call("clone", lambda a: a + 0, [as_tensor(x)])
+
+
+def assign(x, output=None):
+    xt = as_tensor(x)
+    out = dispatch.call("assign", lambda a: a + 0, [xt])
+    if output is not None:
+        output._swap_payload(out._data)
+        return output
+    return out
+
+
+def complex(real, imag, name=None):
+    return dispatch.call("complex", jax.lax.complex, [as_tensor(real), as_tensor(imag)])
+
+
+def polar(abs, angle, name=None):
+    return dispatch.call("polar",
+                         lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
+                         [as_tensor(abs), as_tensor(angle)])

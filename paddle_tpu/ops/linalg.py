@@ -1,0 +1,324 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py ->
+phi matmul/blas kernels; here jnp/lax lowerings — matmuls land on the MXU in
+bf16/fp32 per FLAGS_tpu_matmul_precision).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch, flags
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "inner", "outer", "mv", "addmm", "einsum",
+    "t", "norm", "dist", "cross", "histogram", "bincount", "matrix_power",
+    "cholesky", "cholesky_solve", "inverse", "det", "slogdet", "svd", "qr", "lu", "eig", "eigh",
+    "eigvals", "eigvalsh", "solve", "triangular_solve", "lstsq", "pinv",
+    "matrix_rank", "cov", "corrcoef", "multi_dot", "cdist", "vander", "householder_product",
+    "matrix_transpose", "trace", "rank", "pca_lowrank",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _precision():
+    p = flags.get_flag("tpu_matmul_precision")
+    return {"default": None, "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}.get(p, None)
+
+
+@register("matmul", category="linalg")
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    prec = _precision()
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=prec)
+    return dispatch.call("matmul", f, [_t(x), _t(y)])
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return dispatch.call("bmm", lambda a, b: jnp.matmul(a, b, precision=_precision()),
+                         [_t(x), _t(y)])
+
+
+@register("dot", category="linalg")
+def dot(x, y, name=None):
+    return dispatch.call("dot", lambda a, b: jnp.sum(a * b, axis=-1), [_t(x), _t(y)])
+
+
+def inner(x, y, name=None):
+    return dispatch.call("inner", jnp.inner, [_t(x), _t(y)])
+
+
+def outer(x, y, name=None):
+    return dispatch.call("outer", lambda a, b: jnp.outer(a, b), [_t(x), _t(y)])
+
+
+def mv(x, vec, name=None):
+    return dispatch.call("mv", lambda a, v: jnp.matmul(a, v), [_t(x), _t(vec)])
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return dispatch.call("addmm",
+                         lambda i, a, b: beta * i + alpha * jnp.matmul(a, b, precision=_precision()),
+                         [_t(input), _t(x), _t(y)])
+
+
+@register("einsum", category="linalg")
+def einsum(equation, *operands):
+    ts = [_t(o) for o in operands]
+    return dispatch.call("einsum",
+                         lambda *xs: jnp.einsum(equation, *xs, precision=_precision()), ts)
+
+
+def t(x, name=None):
+    xt = _t(x)
+    if xt.ndim < 2:
+        return xt
+    return dispatch.call("t", lambda a: a.T, [xt])
+
+
+def matrix_transpose(x, name=None):
+    return dispatch.call("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2), [_t(x)])
+
+
+@register("p_norm", category="linalg")
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    xt = _t(x)
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(a * a, keepdims=keepdim))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            if axis is None:
+                return jnp.max(jnp.abs(a))
+            return jnp.max(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if p == float("-inf") or p == "-inf":
+            if axis is None:
+                return jnp.min(jnp.abs(a))
+            return jnp.min(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            flat = a.reshape(-1)
+            return jnp.sum(jnp.abs(flat) ** p) ** (1.0 / p)
+        return jnp.sum(jnp.abs(a) ** p, axis=_ax(axis), keepdims=keepdim) ** (1.0 / p)
+    return dispatch.call("p_norm", f, [xt])
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(dispatch.call("sub", jnp.subtract, [_t(x), _t(y)]), p=p)
+
+
+def cross(x, y, axis=9, name=None):
+    xt = _t(x)
+    ax = axis if axis != 9 else next(i for i, s in enumerate(xt.shape) if s == 3)
+    return dispatch.call("cross", lambda a, b: jnp.cross(a, b, axis=ax), [xt, _t(y)])
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    xt = _t(input)
+    arr = np.asarray(xt._data)
+    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
+    w = np.asarray(weight._data) if weight is not None else None
+    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w, density=density)
+    return Tensor(jnp.asarray(hist if density else hist.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    xt = _t(x)
+    n = builtins_max(int(np.asarray(xt._data).max(initial=-1)) + 1, minlength)
+    if weights is not None:
+        return dispatch.call("bincount",
+                             lambda a, w: jnp.bincount(a.astype(jnp.int32), weights=w, length=n),
+                             [xt, _t(weights)], differentiable_mask=[False, True])
+    return dispatch.call("bincount",
+                         lambda a: jnp.bincount(a.astype(jnp.int32), length=n), [xt])
+
+
+import builtins
+builtins_max = builtins.max
+
+
+def matrix_power(x, n, name=None):
+    return dispatch.call("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), [_t(x)])
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return dispatch.call("cholesky", f, [_t(x)])
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        lo = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(jnp.swapaxes(lo, -1, -2), z, lower=False)
+    return dispatch.call("cholesky_solve", f, [_t(x), _t(y)])
+
+
+def inverse(x, name=None):
+    return dispatch.call("inverse", jnp.linalg.inv, [_t(x)])
+
+
+def det(x, name=None):
+    return dispatch.call("det", jnp.linalg.det, [_t(x)])
+
+
+def slogdet(x, name=None):
+    outs = dispatch.call("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), [_t(x)])
+    return outs
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = dispatch.call("svd",
+                         lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                         [_t(x)])
+    return outs
+
+
+def qr(x, mode="reduced", name=None):
+    outs = dispatch.call("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [_t(x)])
+    return outs
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    xt = _t(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(xt._data)
+    outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return outs + (Tensor(jnp.zeros((), dtype=jnp.int32)),)
+    return outs
+
+
+def eig(x, name=None):
+    arr = np.asarray(_t(x)._data)  # CPU fallback: general eig not on TPU
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = dispatch.call("eigh",
+                         lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), [_t(x)])
+    return outs
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(_t(x)._data)
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return dispatch.call("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [_t(x)])
+
+
+def solve(x, y, name=None):
+    return dispatch.call("solve", jnp.linalg.solve, [_t(x), _t(y)])
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        a2 = jnp.swapaxes(a, -1, -2) if transpose else a
+        return jax.scipy.linalg.solve_triangular(
+            a2, b, lower=not upper, unit_diagonal=unitriangular)
+    return dispatch.call("triangular_solve", f, [_t(x), _t(y)])
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    outs = jnp.linalg.lstsq(_t(x)._data, _t(y)._data, rcond=rcond)
+    return tuple(Tensor(o) for o in outs)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return dispatch.call("pinv",
+                         lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), [_t(x)])
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return dispatch.call("matrix_rank",
+                         lambda a: jnp.linalg.matrix_rank(a, rtol=tol), [_t(x)])
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return dispatch.call("cov",
+                         lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), [_t(x)])
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return dispatch.call("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [_t(x)])
+
+
+def multi_dot(tensors, name=None):
+    ts = [_t(v) for v in tensors]
+    return dispatch.call("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), ts)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    return dispatch.call("cdist", f, [_t(x), _t(y)])
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return dispatch.call("vander",
+                         lambda a: jnp.vander(a, N=n, increasing=increasing), [_t(x)])
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(q, a.shape[:-2] + (m, m)).copy() if a.ndim > 2 else q
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, dtype=a.dtype), jnp.ones(1, dtype=a.dtype),
+                                 a[..., i + 1:, i]], axis=-1)
+            h = jnp.eye(m, dtype=a.dtype) - t_[..., i, None, None] * (v[..., :, None] * v[..., None, :])
+            q = q @ h
+        return q[..., :, :n]
+    return dispatch.call("householder_product", f, [_t(x), _t(tau)])
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch.call("trace",
+                         lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+                         [_t(x)])
+
+
+def rank(x):
+    return Tensor(jnp.asarray(_t(x).ndim, dtype=jnp.int32))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    xt = _t(x)
+    qq = q or builtins_max(1, min(6, *xt.shape[-2:]))
+    def f(a):
+        if center:
+            a = a - jnp.mean(a, axis=-2, keepdims=True)
+        u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+        return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
+    outs = dispatch.call("pca_lowrank", f, [xt])
+    return outs

@@ -1,0 +1,524 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/
+manipulation.py; phi reshape/concat/split/... kernels + stride/ view kernels).
+Views are value-semantics here: XLA aliases buffers where it can, so "view"
+ops are metadata-only after compilation.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze", "unsqueeze_",
+    "transpose", "moveaxis", "swapaxes", "concat", "stack", "unstack", "split",
+    "tensor_split", "chunk", "tile", "expand", "expand_as", "broadcast_to",
+    "broadcast_tensors", "flip", "rot90", "roll", "repeat_interleave", "gather",
+    "gather_nd", "scatter", "scatter_nd_add", "put_along_axis", "take_along_axis",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "slice", "strided_slice", "crop", "pad", "unbind", "numel",
+    "shard_index", "as_real", "as_complex", "view", "view_as", "unfold",
+    "tensordot", "atleast_1d", "atleast_2d", "atleast_3d", "diagonal",
+    "diag_embed", "kron", "take", "select_scatter", "slice_scatter",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _norm_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(v) for v in np.asarray(shape._data))
+    return tuple(int(v.item()) if isinstance(v, Tensor) else int(v) for v in shape)
+
+
+@register("reshape", category="manipulation")
+def reshape(x, shape, name=None):
+    shape = _norm_shape(shape)
+    return dispatch.call("reshape", lambda a: jnp.reshape(a, shape), [_t(x)])
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._swap_payload(out._data)
+    x.grad_node, x.output_index, x.stop_gradient = out.grad_node, out.output_index, out.stop_gradient
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    d = convert_dtype(shape_or_dtype)
+    return dispatch.call("view_dtype", lambda a: a.view(d), [_t(x)])
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+@register("flatten", category="manipulation")
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    xt = _t(x)
+    nd = xt.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    def f(a):
+        if a.ndim == 0:
+            return a.reshape(1)
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return a.reshape(new_shape)
+    return dispatch.call("flatten", f, [xt])
+
+
+@register("squeeze", category="manipulation")
+def squeeze(x, axis=None, name=None):
+    xt = _t(x)
+    if axis is None:
+        ax = None
+    else:
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        ax = tuple(a % max(xt.ndim, 1) for a in axes if xt.shape[a] == 1)
+    return dispatch.call("squeeze", lambda a: jnp.squeeze(a, axis=ax), [xt])
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._swap_payload(out._data)
+    x.grad_node, x.output_index = out.grad_node, out.output_index
+    return x
+
+
+@register("unsqueeze", category="manipulation")
+def unsqueeze(x, axis, name=None):
+    axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    axes = tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes)
+    return dispatch.call("unsqueeze", lambda a: jnp.expand_dims(a, axes), [_t(x)])
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._swap_payload(out._data)
+    x.grad_node, x.output_index = out.grad_node, out.output_index
+    return x
+
+
+@register("transpose", category="manipulation")
+def transpose(x, perm=None, name=None):
+    xt = _t(x)
+    if perm is None:
+        perm = tuple(reversed(range(xt.ndim)))
+    perm = tuple(int(p) for p in perm)
+    return dispatch.call("transpose", lambda a: jnp.transpose(a, perm), [xt])
+
+
+def moveaxis(x, source, destination, name=None):
+    return dispatch.call("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [_t(x)])
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return dispatch.call("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), [_t(x)])
+
+
+@register("concat", category="manipulation")
+def concat(x: Sequence, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return dispatch.call("concat", lambda *xs: jnp.concatenate(xs, axis=axis), ts)
+
+
+@register("stack", category="manipulation")
+def stack(x: Sequence, axis=0, name=None):
+    ts = [_t(v) for v in x]
+    return dispatch.call("stack", lambda *xs: jnp.stack(xs, axis=axis), ts)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    xt = _t(x)
+    n = num or xt.shape[axis]
+    outs = dispatch.call(
+        "unstack",
+        lambda a: tuple(jnp.squeeze(s, axis=axis)
+                        for s in jnp.split(a, n, axis=axis)), [xt])
+    return list(outs)
+
+
+@register("split", category="manipulation")
+def split(x, num_or_sections, axis=0, name=None):
+    xt = _t(x)
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    ax = axis % xt.ndim
+    if isinstance(num_or_sections, int):
+        outs = dispatch.call("split",
+                             lambda a: tuple(jnp.split(a, num_or_sections, axis=ax)), [xt])
+    else:
+        secs = [int(s.item()) if isinstance(s, Tensor) else int(s) for s in num_or_sections]
+        total = xt.shape[ax]
+        if any(s == -1 for s in secs):
+            rem = total - sum(s for s in secs if s != -1)
+            secs = [rem if s == -1 else s for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        outs = dispatch.call("split", lambda a: tuple(jnp.split(a, idx, axis=ax)), [xt])
+    return list(outs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    xt = _t(x)
+    outs = dispatch.call("tensor_split",
+                         lambda a: tuple(jnp.array_split(a, num_or_indices, axis=axis)), [xt])
+    return list(outs)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    return unstack(x, axis)
+
+
+@register("tile", category="manipulation")
+def tile(x, repeat_times, name=None):
+    reps = _norm_shape(repeat_times)
+    return dispatch.call("tile", lambda a: jnp.tile(a, reps), [_t(x)])
+
+
+@register("expand", category="manipulation")
+def expand(x, shape, name=None):
+    xt = _t(x)
+    shape = list(_norm_shape(shape))
+    cur = [1] * (len(shape) - xt.ndim) + list(xt.shape)
+    tgt = [c if s == -1 else s for s, c in zip(shape, cur)]
+    return dispatch.call("expand", lambda a: jnp.broadcast_to(a, tuple(tgt)), [xt])
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    ts = [_t(v) for v in inputs]
+    outs = dispatch.call("broadcast_tensors",
+                         lambda *xs: tuple(jnp.broadcast_arrays(*xs)), ts)
+    return list(outs)
+
+
+@register("flip", category="manipulation")
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return dispatch.call("flip", lambda a: jnp.flip(a, axis=ax), [_t(x)])
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return dispatch.call("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [_t(x)])
+
+
+@register("roll", category="manipulation")
+def roll(x, shifts, axis=None, name=None):
+    return dispatch.call("roll", lambda a: jnp.roll(a, shifts, axis=axis), [_t(x)])
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        return dispatch.call("repeat_interleave",
+                             lambda a: jnp.repeat(a, reps, axis=axis), [_t(x)])
+    return dispatch.call("repeat_interleave",
+                         lambda a: jnp.repeat(a, repeats, axis=axis), [_t(x)])
+
+
+# ----------------------------------------------------------- gather/scatter
+@register("gather", category="indexing")
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return dispatch.call("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis),
+                         [_t(x), _t(index)], differentiable_mask=[True, False])
+
+
+@register("gather_nd", category="indexing")
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = a[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+    return dispatch.call("gather_nd", f, [_t(x), _t(index)],
+                         differentiable_mask=[True, False])
+
+
+@register("scatter", category="indexing")
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        if overwrite:
+            return a.at[idx].set(upd)
+        zeroed = a.at[idx].set(jnp.zeros_like(upd))
+        return zeroed.at[idx].add(upd)
+    return dispatch.call("scatter", f, [_t(x), _t(index), _t(updates)],
+                         differentiable_mask=[True, False, True])
+
+
+@register("scatter_nd_add", category="indexing")
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32)
+        return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return dispatch.call("scatter_nd_add", f, [_t(x), _t(index), _t(updates)],
+                         differentiable_mask=[True, False, True])
+
+
+def scatter_nd(index, updates, shape, name=None):
+    z = Tensor(jnp.zeros(_norm_shape(shape), dtype=_t(updates)._data.dtype))
+    return scatter_nd_add(z, index, updates)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return dispatch.call("take_along_axis",
+                         lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
+                         [_t(arr), _t(indices)], differentiable_mask=[True, False])
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        dims = tuple(range(a.ndim))
+        if reduce == "assign":
+            # emulate via scatter on flattened index grid
+            idx = jnp.indices(i.shape)
+            full_idx = tuple(idx[d] if d != axis % a.ndim else i for d in dims)
+            return a.at[full_idx].set(v)
+        idx = jnp.indices(i.shape)
+        full_idx = tuple(idx[d] if d != axis % a.ndim else i for d in dims)
+        if reduce in ("add", "sum"):
+            return a.at[full_idx].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[full_idx].multiply(v)
+        raise ValueError(f"unsupported reduce {reduce}")
+    return dispatch.call("put_along_axis", f, [_t(arr), _t(indices), _t(values)],
+                         differentiable_mask=[True, False, True])
+
+
+@register("index_select", category="indexing")
+def index_select(x, index, axis=0, name=None):
+    return gather(x, index, axis)
+
+
+def index_sample(x, index, name=None):
+    return dispatch.call(
+        "index_sample",
+        lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
+        [_t(x), _t(index)], differentiable_mask=[True, False])
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        i = i.astype(jnp.int32)
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return dispatch.call("index_add", f, [_t(x), _t(index), _t(value)],
+                         differentiable_mask=[True, False, True])
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_ts = [_t(i) for i in indices]
+    def f(a, v, *idx):
+        idx = tuple(i.astype(jnp.int32) if np.issubdtype(np.dtype(i.dtype), np.integer)
+                    else i for i in idx)
+        if accumulate:
+            return a.at[idx].add(v)
+        return a.at[idx].set(v)
+    return dispatch.call("index_put", f, [_t(x), _t(value)] + idx_ts,
+                         differentiable_mask=[True, True] + [False] * len(idx_ts))
+
+
+def take(x, index, mode="raise", name=None):
+    return dispatch.call("take",
+                         lambda a, i: jnp.take(a.reshape(-1), i.astype(jnp.int32),
+                                               mode="clip" if mode == "clip" else "wrap"),
+                         [_t(x), _t(index)], differentiable_mask=[True, False])
+
+
+@register("masked_select", category="indexing", differentiable=False)
+def masked_select(x, mask, name=None):
+    # Dynamic output size — host-side (not jit-capturable; reference kernel is
+    # likewise dynamic). Returns a 1-D tensor of the selected elements.
+    xt, mt = _t(x), _t(mask)
+    data = np.asarray(xt._data)[np.asarray(mt._data).astype(bool)]
+    return Tensor(jnp.asarray(data))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) else value
+    return dispatch.call("masked_fill",
+                         lambda a, m: jnp.where(m.astype(bool), jnp.asarray(v, dtype=a.dtype), a),
+                         [_t(x), _t(mask)], differentiable_mask=[True, False])
+
+
+# ------------------------------------------------------------------- slicing
+import builtins
+builtins_slice = builtins.slice
+
+
+@register("slice", category="manipulation")
+def slice(x, axes, starts, ends, name=None):
+    xt = _t(x)
+    sl = [builtins_slice(None)] * xt.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st.item()) if isinstance(st, Tensor) else int(st)
+        en = int(en.item()) if isinstance(en, Tensor) else int(en)
+        sl[ax] = builtins_slice(st, en)
+    sl = tuple(sl)
+    return dispatch.call("slice", lambda a: a[sl], [xt])
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    xt = _t(x)
+    sl = [builtins_slice(None)] * xt.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins_slice(int(st), int(en), int(sd))
+    sl = tuple(sl)
+    return dispatch.call("strided_slice", lambda a: a[sl], [xt])
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    xt = _t(x)
+    shape = _norm_shape(shape)
+    offsets = _norm_shape(offsets) if offsets is not None else (0,) * xt.ndim
+    sl = tuple(builtins_slice(o, o + s if s != -1 else None)
+               for o, s in zip(offsets, shape))
+    return dispatch.call("crop", lambda a: a[sl], [xt])
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = builtins_slice(int(st), int(en), int(sd))
+        return a.at[tuple(sl)].set(v)
+    return dispatch.call("slice_scatter", f, [_t(x), _t(value)])
+
+
+def select_scatter(x, value, axis, index, name=None):
+    def f(a, v):
+        sl = [builtins_slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v)
+    return dispatch.call("select_scatter", f, [_t(x), _t(value)])
+
+
+@register("pad", category="manipulation")
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    xt = _t(x)
+    pad = _norm_shape(pad)
+    nd = xt.ndim
+    if len(pad) == 2 * nd:
+        widths = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # paddle semantics: pad applies to last len(pad)//2 spatial dims,
+        # ordered innermost-first (like torch.nn.functional.pad)
+        k = len(pad) // 2
+        widths = [(0, 0)] * (nd - k)
+        trailing = [(pad[2 * i], pad[2 * i + 1]) for i in range(k)]
+        widths += list(reversed(trailing))
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return dispatch.call("pad", lambda a: jnp.pad(a, widths, mode="constant",
+                                                      constant_values=value), [xt])
+    return dispatch.call("pad", lambda a: jnp.pad(a, widths, mode=jmode), [xt])
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_t(x).size, dtype=jnp.int64))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(a):
+        size = index_num // nshards
+        shard = a // size
+        local = a % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return dispatch.call("shard_index", f, [_t(input)])
+
+
+def as_real(x, name=None):
+    def f(a):
+        return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
+    return dispatch.call("as_real", f, [_t(x)])
+
+
+def as_complex(x, name=None):
+    return dispatch.call("as_complex",
+                         lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [_t(x)])
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (reference phi unfold kernel)."""
+    from .nn_ops import _pair
+    ks, st, pd, dl = _pair(kernel_sizes), _pair(strides), _pair(paddings), _pair(dilations)
+    def f(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=ks, window_strides=st,
+            padding=[(pd[0], pd[0]), (pd[1], pd[1])],
+            rhs_dilation=dl, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow] -> [N, C*kh*kw, oh*ow]
+        return patches.reshape(n, patches.shape[1], -1)
+    return dispatch.call("unfold", f, [_t(x)])
+
+
+def tensordot(x, y, axes=2, name=None):
+    return dispatch.call("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
+                         [_t(x), _t(y)])
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [dispatch.call("atleast_1d", jnp.atleast_1d, [_t(v)]) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [dispatch.call("atleast_2d", jnp.atleast_2d, [_t(v)]) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [dispatch.call("atleast_3d", jnp.atleast_3d, [_t(v)]) for v in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return dispatch.call("diagonal",
+                         lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
+                         [_t(x)])
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        out = jnp.zeros(a.shape + (a.shape[-1],), dtype=a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        out = out.at[..., idx, idx].set(a)
+        # move diag axes into requested positions
+        return out
+    return dispatch.call("diag_embed", f, [_t(x)])
+
+
+def kron(x, y, name=None):
+    return dispatch.call("kron", jnp.kron, [_t(x), _t(y)])

@@ -1,0 +1,279 @@
+"""Elementwise math, comparison, and logic ops.
+
+Reference parity: paddle/phi/kernels elementwise + activation kernels and the
+python surface python/paddle/tensor/math.py. TPU-native: each op is a jnp
+lowering dispatched through paddle_tpu.core.dispatch (XLA fuses chains of
+these into single HBM-friendly kernels; no per-op CUDA file needed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _binary(name, jfn, x, y):
+    xt, yt = isinstance(x, Tensor), isinstance(y, Tensor)
+    if xt and yt:
+        return dispatch.call(name, jfn, [x, y])
+    if xt:
+        return dispatch.call(name, lambda a: jfn(a, y), [x])
+    if yt:
+        return dispatch.call(name, lambda b: jfn(x, b), [y])
+    return dispatch.call(name, jfn, [_t(x), _t(y)])
+
+
+def _unary(name, jfn, x, **attrs):
+    return dispatch.call(name, jfn, [_t(x)], attrs or None)
+
+
+def _make_binary(name, jfn, aliases=()):
+    @register(name, category="math")
+    def op(x, y, name_=None):
+        return _binary(name, jfn, x, y)
+    op.__name__ = name
+    op.__qualname__ = name
+    _export(op)
+    g = globals()
+    g[name] = op
+    for a in aliases:
+        g[a] = op
+        __all__.append(a)
+    return op
+
+
+def _make_unary(name, jfn, aliases=(), differentiable=True):
+    @register(name, category="math", differentiable=differentiable)
+    def op(x, name_=None):
+        return _unary(name, jfn, x)
+    op.__name__ = name
+    op.__qualname__ = name
+    _export(op)
+    g = globals()
+    g[name] = op
+    for a in aliases:
+        g[a] = op
+        __all__.append(a)
+    return op
+
+
+# -------------------------------------------------------------------- binary
+_make_binary("add", jnp.add)
+_make_binary("subtract", jnp.subtract)
+_make_binary("multiply", jnp.multiply)
+_make_binary("divide", jnp.true_divide)
+_make_binary("floor_divide", jnp.floor_divide)
+_make_binary("mod", jnp.mod, aliases=("remainder", "floor_mod"))
+_make_binary("pow", jnp.power)
+_make_binary("maximum", jnp.maximum)
+_make_binary("minimum", jnp.minimum)
+_make_binary("fmax", jnp.fmax)
+_make_binary("fmin", jnp.fmin)
+_make_binary("atan2", jnp.arctan2)
+_make_binary("hypot", jnp.hypot)
+_make_binary("logaddexp", jnp.logaddexp)
+_make_binary("nextafter", jnp.nextafter)
+_make_binary("copysign", jnp.copysign)
+_make_binary("heaviside", jnp.heaviside)
+_make_binary("gcd", jnp.gcd)
+_make_binary("lcm", jnp.lcm)
+_make_binary("ldexp", jnp.ldexp)
+
+_make_binary("equal", jnp.equal)
+_make_binary("not_equal", jnp.not_equal)
+_make_binary("less_than", jnp.less, aliases=("less",))
+_make_binary("less_equal", jnp.less_equal)
+_make_binary("greater_than", jnp.greater, aliases=("greater",))
+_make_binary("greater_equal", jnp.greater_equal)
+
+_make_binary("logical_and", jnp.logical_and)
+_make_binary("logical_or", jnp.logical_or)
+_make_binary("logical_xor", jnp.logical_xor)
+_make_binary("bitwise_and", jnp.bitwise_and)
+_make_binary("bitwise_or", jnp.bitwise_or)
+_make_binary("bitwise_xor", jnp.bitwise_xor)
+_make_binary("bitwise_left_shift", jnp.left_shift)
+_make_binary("bitwise_right_shift", jnp.right_shift)
+
+# --------------------------------------------------------------------- unary
+_make_unary("exp", jnp.exp)
+_make_unary("expm1", jnp.expm1)
+_make_unary("log", jnp.log)
+_make_unary("log2", jnp.log2)
+_make_unary("log10", jnp.log10)
+_make_unary("log1p", jnp.log1p)
+_make_unary("sqrt", jnp.sqrt)
+_make_unary("rsqrt", lambda x: jax.lax.rsqrt(x))
+_make_unary("square", jnp.square)
+_make_unary("abs", jnp.abs)
+_make_unary("neg", jnp.negative)
+_make_unary("sign", jnp.sign)
+_make_unary("floor", jnp.floor)
+_make_unary("ceil", jnp.ceil)
+_make_unary("round", jnp.round)
+_make_unary("trunc", jnp.trunc)
+_make_unary("frac", lambda x: x - jnp.trunc(x))
+_make_unary("reciprocal", jnp.reciprocal)
+_make_unary("sin", jnp.sin)
+_make_unary("cos", jnp.cos)
+_make_unary("tan", jnp.tan)
+_make_unary("asin", jnp.arcsin)
+_make_unary("acos", jnp.arccos)
+_make_unary("atan", jnp.arctan)
+_make_unary("sinh", jnp.sinh)
+_make_unary("cosh", jnp.cosh)
+_make_unary("tanh", jnp.tanh)
+_make_unary("asinh", jnp.arcsinh)
+_make_unary("acosh", jnp.arccosh)
+_make_unary("atanh", jnp.arctanh)
+_make_unary("erf", jax.scipy.special.erf)
+_make_unary("erfinv", jax.scipy.special.erfinv)
+_make_unary("sigmoid", jax.nn.sigmoid)
+_make_unary("logit", jax.scipy.special.logit)
+_make_unary("digamma", jax.scipy.special.digamma)
+_make_unary("lgamma", jax.scipy.special.gammaln)
+_make_unary("i0", lambda x: jax.scipy.special.i0(x))
+_make_unary("i1", lambda x: jax.scipy.special.i1(x))
+_make_unary("logical_not", jnp.logical_not, differentiable=False)
+_make_unary("bitwise_not", jnp.bitwise_not, differentiable=False)
+_make_unary("isnan", jnp.isnan, differentiable=False)
+_make_unary("isinf", jnp.isinf, differentiable=False)
+_make_unary("isfinite", jnp.isfinite, differentiable=False)
+_make_unary("conj", jnp.conj)
+_make_unary("angle", jnp.angle)
+_make_unary("real", jnp.real)
+_make_unary("imag", jnp.imag)
+
+
+@register("scale", category="math")
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    """paddle.scale (reference phi ScaleKernel)."""
+    def f(a, scale, bias, bias_after_scale):
+        out = a * scale + bias if bias_after_scale else (a + bias) * scale
+        return out.astype(a.dtype) if np.issubdtype(np.dtype(a.dtype), np.integer) else out
+    if isinstance(scale, Tensor):
+        return dispatch.call("scale", lambda a, s: a * s + bias if bias_after_scale
+                             else (a + bias) * s, [_t(x), scale])
+    return dispatch.call("scale", f, [_t(x)],
+                         dict(scale=scale, bias=bias, bias_after_scale=bias_after_scale))
+
+
+@register("clip", category="math")
+@_export
+def clip(x, min=None, max=None, name=None):
+    if isinstance(min, Tensor) or isinstance(max, Tensor):
+        mins = min if isinstance(min, Tensor) else _t(min if min is not None else -np.inf)
+        maxs = max if isinstance(max, Tensor) else _t(max if max is not None else np.inf)
+        return dispatch.call("clip", lambda a, lo, hi: jnp.clip(a, lo, hi), [_t(x), mins, maxs])
+    return dispatch.call("clip", lambda a: jnp.clip(a, min, max), [_t(x)])
+
+
+@register("lerp", category="math")
+@_export
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return dispatch.call("lerp", lambda a, b, w: a + w * (b - a), [_t(x), _t(y), weight])
+    return dispatch.call("lerp", lambda a, b: a + weight * (b - a), [_t(x), _t(y)])
+
+
+@register("stanh", category="math")
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return dispatch.call("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), [_t(x)])
+
+
+@register("multiplex", category="math")
+@_export
+def multiplex(inputs, index, name=None):
+    ts = [_t(i) for i in inputs] + [_t(index)]
+    def f(*args):
+        *xs, idx = args
+        stacked = jnp.stack(xs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32),
+            axis=0)[0]
+    return dispatch.call("multiplex", f, ts)
+
+
+@register("isclose", category="math", differentiable=False)
+@_export
+def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return dispatch.call("isclose",
+                         lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                         [_t(x), _t(y)])
+
+
+@register("allclose", category="math", differentiable=False)
+@_export
+def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    return dispatch.call("allclose",
+                         lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+                         [_t(x), _t(y)])
+
+
+@register("equal_all", category="math", differentiable=False)
+@_export
+def equal_all(x, y, name=None):
+    return dispatch.call("equal_all", lambda a, b: jnp.array_equal(a, b), [_t(x), _t(y)])
+
+
+@register("nan_to_num", category="math")
+@_export
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return dispatch.call("nan_to_num",
+                         lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
+                         [_t(x)])
+
+
+@register("trapezoid", category="math")
+@_export
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return dispatch.call("trapezoid",
+                             lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
+                             [_t(y), _t(x)])
+    return dispatch.call("trapezoid",
+                         lambda yy: jax.scipy.integrate.trapezoid(yy, dx=dx or 1.0, axis=axis),
+                         [_t(y)])
+
+
+@register("diff", category="math")
+@_export
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    ins = [_t(x)]
+    def f(a, *rest):
+        pre = rest[0] if prepend is not None else None
+        app = rest[-1] if append is not None else None
+        return jnp.diff(a, n=n, axis=axis, prepend=pre, append=app)
+    if prepend is not None:
+        ins.append(_t(prepend))
+    if append is not None:
+        ins.append(_t(append))
+    return dispatch.call("diff", f, ins)
+
+
+@register("cast", category="math")
+@_export
+def cast(x, dtype):
+    from ..core.dtype import convert_dtype
+    d = convert_dtype(dtype)
+    xt = _t(x)
+    if xt.dtype == d:
+        return xt
+    return dispatch.call("cast", lambda a: a.astype(d), [xt])

@@ -1,0 +1,210 @@
+"""Reduction ops (reference: phi reduce kernels; python/paddle/tensor/math.py
+sum/mean/... surface). XLA lowers these to MXU/VPU-friendly tree reductions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = [
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "any", "all",
+    "logsumexp", "median", "nanmedian", "quantile", "nanquantile", "std", "var",
+    "nansum", "nanmean", "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    "count_nonzero", "mode",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        arr = np.asarray(axis._data)
+        return tuple(int(v) for v in np.atleast_1d(arr))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, jfn, differentiable=True):
+    @register(name, category="reduction", differentiable=differentiable)
+    def op(x, axis=None, keepdim=False, name_=None, dtype=None):
+        ax = _axis(axis)
+        d = convert_dtype(dtype)
+        def f(a):
+            out = jfn(a, axis=ax, keepdims=keepdim)
+            return out.astype(d) if d is not None else out
+        return dispatch.call(name, f, [_t(x)])
+    op.__name__ = name
+    op.__qualname__ = name
+    globals()[name] = op
+    return op
+
+
+_make_reduce("sum", jnp.sum)
+_make_reduce("mean", jnp.mean)
+_make_reduce("max", jnp.max)
+_make_reduce("min", jnp.min)
+_make_reduce("amax", jnp.amax)
+_make_reduce("amin", jnp.amin)
+_make_reduce("prod", jnp.prod)
+_make_reduce("any", jnp.any, differentiable=False)
+_make_reduce("all", jnp.all, differentiable=False)
+_make_reduce("nansum", jnp.nansum)
+_make_reduce("nanmean", jnp.nanmean)
+
+
+@register("logsumexp", category="reduction")
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return dispatch.call("logsumexp",
+                         lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
+                         [_t(x)])
+
+
+@register("median", category="reduction")
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    ax = _axis(axis)
+    return dispatch.call("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), [_t(x)])
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return dispatch.call("nanmedian",
+                         lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), [_t(x)])
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    ax = _axis(axis)
+    return dispatch.call(
+        "quantile",
+        lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim,
+                               method=interpolation), [_t(x)])
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return dispatch.call(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=ax, keepdims=keepdim), [_t(x)])
+
+
+@register("std", category="reduction")
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return dispatch.call("std",
+                         lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                           keepdims=keepdim), [_t(x)])
+
+
+@register("var", category="reduction")
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return dispatch.call("var",
+                         lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                           keepdims=keepdim), [_t(x)])
+
+
+@register("cumsum", category="reduction")
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=d)
+        return jnp.cumsum(a, axis=_axis(axis), dtype=d)
+    return dispatch.call("cumsum", f, [_t(x)])
+
+
+@register("cumprod", category="reduction")
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = convert_dtype(dtype)
+    return dispatch.call("cumprod",
+                         lambda a: jnp.cumprod(a, axis=_axis(dim), dtype=d), [_t(x)])
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = _axis(axis)
+    def f(a):
+        if ax is None:
+            a = a.reshape(-1)
+            axis_ = 0
+        else:
+            axis_ = ax
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=axis_)
+        n = a.shape[axis_]
+        iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, axis_)
+        eq = a == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, iota, -1), axis=axis_)
+        return vals, idx.astype(convert_dtype(dtype))
+    outs = dispatch.call("cummax", f, [_t(x)])
+    return outs[0], outs[1]
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = _axis(axis)
+    def f(a):
+        axis_ = 0 if ax is None else ax
+        if ax is None:
+            a = a.reshape(-1)
+        vals = jax.lax.associative_scan(jnp.minimum, a, axis=axis_)
+        iota = jax.lax.broadcasted_iota(jnp.int32, a.shape, axis_)
+        eq = a == vals
+        idx = jax.lax.associative_scan(jnp.maximum, jnp.where(eq, iota, -1), axis=axis_)
+        return vals, idx.astype(convert_dtype(dtype))
+    outs = dispatch.call("cummin", f, [_t(x)])
+    return outs[0], outs[1]
+
+
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    ax = _axis(axis)
+    def f(a):
+        if ax is None:
+            a2 = a.reshape(-1)
+            axis_ = 0
+        else:
+            a2, axis_ = a, ax
+        return jax.lax.associative_scan(jnp.logaddexp, a2, axis=axis_)
+    return dispatch.call("logcumsumexp", f, [_t(x)])
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return dispatch.call("count_nonzero",
+                         lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64),
+                         [_t(x)])
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    ax = _axis(axis)
+    def f(a):
+        sorted_ = jnp.sort(a, axis=ax)
+        n = a.shape[ax]
+        # run-length trick: count occurrences of each sorted value
+        def along(last_axis_arr):
+            eq = last_axis_arr[..., :, None] == last_axis_arr[..., None, :]
+            counts = eq.sum(-1)
+            best = jnp.argmax(counts, axis=-1)
+            vals = jnp.take_along_axis(last_axis_arr, best[..., None], axis=-1)[..., 0]
+            return vals
+        moved = jnp.moveaxis(sorted_, ax, -1)
+        vals = along(moved)
+        if keepdim:
+            vals = jnp.expand_dims(vals, ax)
+        orig = jnp.moveaxis(a, ax, -1)
+        idx = jnp.argmax(orig == (vals[..., None] if not keepdim else
+                                  jnp.moveaxis(vals, ax, -1)), axis=-1)
+        if keepdim:
+            idx = jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+    outs = dispatch.call("mode", f, [_t(x)])
+    return outs[0], outs[1]

@@ -1,0 +1,53 @@
+"""Declarative op registry.
+
+Capability parity with the reference's YAML op registry (reference:
+paddle/phi/ops/yaml/ops.yaml — args/output/infer_meta/kernel per op). Here an
+OpDef records the op's name, category and lowering; the "kernel" is a jax
+callable (XLA compiles/fuses it), infer_meta is subsumed by jax shape
+inference, and the VJP comes from jax.vjp at dispatch time. The registry
+drives introspection/tooling (op listing, docs, parity audits against the
+reference yaml).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional
+
+
+@dataclass
+class OpDef:
+    name: str
+    category: str = "misc"
+    lowering: Optional[Callable] = None
+    differentiable: bool = True
+    inplace_variant: Optional[str] = None
+    doc: str = ""
+    tags: tuple = field(default_factory=tuple)
+
+
+OPS: Dict[str, OpDef] = {}
+
+
+def register(name: str, category: str = "misc", differentiable: bool = True,
+             inplace_variant: Optional[str] = None, tags=()):
+    """Decorator registering a user-facing op function."""
+
+    def deco(fn):
+        OPS[name] = OpDef(name=name, category=category, lowering=fn,
+                          differentiable=differentiable,
+                          inplace_variant=inplace_variant,
+                          doc=(fn.__doc__ or ""), tags=tuple(tags))
+        return fn
+
+    return deco
+
+
+def op_names():
+    return sorted(OPS)
+
+
+def ops_by_category():
+    out: Dict[str, list] = {}
+    for d in OPS.values():
+        out.setdefault(d.category, []).append(d.name)
+    return {k: sorted(v) for k, v in out.items()}

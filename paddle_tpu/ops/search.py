@@ -1,0 +1,187 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py -> phi argmax/
+topk/sort kernels). top_k lowers to lax.top_k (TPU-native sort network).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "searchsorted", "index_of_max", "kthvalue", "unique", "unique_consecutive",
+    "masked_scatter", "bucketize", "isin",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+@register("argmax", category="search", differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        out = jnp.argmax(a, axis=axis, keepdims=keepdim)
+        return out.astype(d)
+    return dispatch.call("argmax", f, [_t(x)])
+
+
+@register("argmin", category="search", differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return out.reshape((1,) * a.ndim).astype(d) if keepdim else out.astype(d)
+        return jnp.argmin(a, axis=axis, keepdims=keepdim).astype(d)
+    return dispatch.call("argmin", f, [_t(x)])
+
+
+@register("argsort", category="search", differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=True, descending=descending)
+        return idx.astype(jnp.int64)
+    return dispatch.call("argsort", f, [_t(x)])
+
+
+@register("sort", category="search")
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    return dispatch.call("sort",
+                         lambda a: jnp.sort(a, axis=axis, stable=True, descending=descending),
+                         [_t(x)])
+
+
+@register("top_k", category="search")
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    def f(a):
+        ax = (axis if axis is not None else a.ndim - 1) % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(moved, k)
+        else:
+            v, i = jax.lax.top_k(-moved, k)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64), -1, ax)
+    outs = dispatch.call("top_k", f, [_t(x)])
+    return outs[0], outs[1]
+
+
+@register("where", category="search")
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return dispatch.call("where", lambda c, a, b: jnp.where(c.astype(bool), a, b),
+                         [_t(condition), _t(x), _t(y)],
+                         differentiable_mask=[False, True, True])
+
+
+def where_(condition, x, y, name=None):
+    out = where(condition, x, y)
+    x._swap_payload(out._data)
+    return x
+
+
+@register("nonzero", category="search", differentiable=False)
+def nonzero(x, as_tuple=False, name=None):
+    arr = np.asarray(_t(x)._data)  # dynamic output shape -> host
+    nz = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(v.astype(np.int64))) for v in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=-1).astype(np.int64)))
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    d = jnp.int32 if out_int32 else jnp.int64
+    return dispatch.call(
+        "searchsorted",
+        lambda s, v: jnp.searchsorted(s, v, side="right" if right else "left").astype(d),
+        [_t(sorted_sequence), _t(values)])
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        v = jnp.sort(moved, axis=-1)[..., k - 1]
+        i = jnp.argsort(moved, axis=-1, stable=True)[..., k - 1]
+        if keepdim:
+            v, i = jnp.expand_dims(v, ax), jnp.expand_dims(i, ax)
+        return v, i.astype(jnp.int64)
+    outs = dispatch.call("kthvalue", f, [_t(x)])
+    return outs[0], outs[1]
+
+
+@register("unique", category="search", differentiable=False)
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    arr = np.asarray(_t(x)._data)  # dynamic output shape -> host
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    outs = [Tensor(jnp.asarray(r if i == 0 else r.astype(np.int64)))
+            for i, r in enumerate(res)]
+    return tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(_t(x)._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        ax = 0
+    else:
+        ax = axis
+    sel = np.ones(arr.shape[ax], dtype=bool)
+    moved = np.moveaxis(arr, ax, 0)
+    if moved.shape[0] > 1:
+        neq = np.any((moved[1:] != moved[:-1]).reshape(moved.shape[0] - 1, -1), axis=1)
+        sel[1:] = neq
+    out = np.moveaxis(moved[sel], 0, ax)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(sel) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(sel)
+        counts = np.diff(np.append(idx, arr.shape[ax]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def masked_scatter(x, mask, value, name=None):
+    xt, mt, vt = _t(x), _t(mask), _t(value)
+    m = np.asarray(mt._data).astype(bool)
+    def f(a, v):
+        flat_v = v.reshape(-1)[: int(m.sum())]
+        out = np.asarray(a).copy()
+        out[np.broadcast_to(m, out.shape)] = np.asarray(flat_v)
+        return jnp.asarray(out)
+    out_arr = f(xt._data, vt._data)
+    return Tensor(out_arr)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return dispatch.call("isin",
+                         lambda a, b: jnp.isin(a, b, invert=invert),
+                         [_t(x), _t(test_x)])
+
+
+def index_of_max(x):
+    return argmax(x)
