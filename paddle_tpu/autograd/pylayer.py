@@ -24,12 +24,12 @@ class PyLayerContext:
     def save_for_backward(self, *tensors):
         self._saved = list(tensors)
 
-    @property
     def saved_tensor(self):
         return self._saved
 
+    @property
     def saved_tensors(self):
-        return self._saved
+        return tuple(self._saved)
 
     def mark_non_differentiable(self, *tensors):
         self.non_differentiable = tensors

@@ -1,0 +1,33 @@
+"""Test harness config.
+
+All tests run on a virtual 8-device CPU platform so sharding/collective
+tests work without TPU hardware (reference test strategy: SURVEY.md §4 —
+TestDistBase simulates the cluster on localhost; here the virtual mesh
+plays that role).
+
+The agent image's sitecustomize imports jax and points it at the real-TPU
+platform before pytest starts, so a plain env var is too late — switch the
+platform through jax.config before any backend is initialized.
+"""
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(2024)
+    import paddle_tpu as paddle
+    paddle.seed(2024)
+    yield

@@ -1,0 +1,67 @@
+"""OpTest-style numeric harness.
+
+Capability parity with the reference's OpTest (test/legacy_test/op_test.py:418):
+run an op through the framework, compare outputs against a NumPy reference,
+and check analytic gradients against numeric finite differences
+(op_test.py:3026 check_grad). Default tolerances mirror the reference
+(fp32 1e-5, op_test.py:1084).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def check_output(fn, np_fn, inputs, attrs=None, rtol=1e-5, atol=1e-6):
+    """fn: framework op over Tensors; np_fn: numpy reference over ndarrays."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(x) for x in inputs]
+    out = fn(*tensors, **attrs)
+    ref = np_fn(*inputs, **attrs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    refs = ref if isinstance(ref, (list, tuple)) else [ref]
+    assert len(outs) == len(refs)
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), np.asarray(r), rtol=rtol, atol=atol)
+
+
+def check_grad(fn, inputs, attrs=None, grad_input_idx=None,
+               max_relative_error=5e-3, delta=1e-3):
+    """Compare analytic grads (backward through the tape) vs central finite
+    differences on a scalar sum-of-outputs loss."""
+    attrs = attrs or {}
+    inputs = [np.asarray(x, dtype=np.float64).astype(np.float32) for x in inputs]
+    idxs = grad_input_idx if grad_input_idx is not None else range(len(inputs))
+
+    def loss_np(arrs):
+        tensors = [paddle.to_tensor(a) for a in arrs]
+        out = fn(*tensors, **attrs)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return float(sum(o.sum().item() for o in outs))
+
+    tensors = [paddle.to_tensor(x, stop_gradient=False) for x in inputs]
+    out = fn(*tensors, **attrs)
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    loss = outs[0].sum()
+    for o in outs[1:]:
+        loss = loss + o.sum()
+    loss.backward()
+
+    for i in idxs:
+        analytic = tensors[i].grad.numpy().astype(np.float64)
+        numeric = np.zeros_like(analytic)
+        flat = inputs[i].reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + delta
+            hi = loss_np(inputs)
+            flat[j] = orig - delta
+            lo = loss_np(inputs)
+            flat[j] = orig
+            numeric.reshape(-1)[j] = (hi - lo) / (2 * delta)
+        denom = np.maximum(np.abs(numeric), 1.0)
+        err = np.abs(analytic - numeric) / denom
+        assert err.max() <= max_relative_error, (
+            f"grad mismatch on input {i}: max rel err {err.max():.3e}\n"
+            f"analytic:\n{analytic}\nnumeric:\n{numeric}")

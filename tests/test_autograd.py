@@ -1,0 +1,138 @@
+"""Autograd engine tests (reference: eager/backward.cc semantics,
+test/legacy_test/test_imperative_* family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_simple_backward():
+    x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_branching_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    a = x * 2
+    b = x * 3
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+
+def test_chain_and_shared_subgraph():
+    x = paddle.to_tensor([0.5], stop_gradient=False)
+    h = paddle.tanh(x)
+    y = h * h
+    y.backward()
+    th = np.tanh(0.5)
+    np.testing.assert_allclose(x.grad.numpy(), [2 * th * (1 - th**2)], rtol=1e-5)
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    w = paddle.to_tensor([2.0])  # stop_gradient=True
+    y = (x * w).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert w.grad is None
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+
+
+def test_backward_twice_raises():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_retain_graph():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad([y], [x])
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # grad() must not pollute .grad
+
+
+def test_double_backward():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad([y], [x], create_graph=True)
+    (ggx,) = paddle.grad([gx], [x])
+    np.testing.assert_allclose(ggx.numpy(), [12.0])  # d2/dx2 x^3 = 6x
+
+
+def test_tensor_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+    h = x * 2
+    h.register_hook(lambda g: seen.append(g.numpy().copy()))
+    h.sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [1.0])
+
+
+def test_hook_replaces_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.register_hook(lambda g: g * 10)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [20.0])
+
+
+def test_retain_grads_non_leaf():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x * 2
+    y.retain_grads()
+    (y * 3).sum().backward()
+    np.testing.assert_allclose(y.grad.numpy(), [3.0])
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_pylayer_custom():
+    class Cube(paddle.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * x * x
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 3 * x * x
+
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = Cube.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])

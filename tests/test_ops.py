@@ -1,0 +1,170 @@
+"""Op numeric tests via the OpTest-style harness (reference:
+test/legacy_test/ per-op tests; harness op_test.py:418)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def rand(*shape):
+    return np.random.uniform(-1, 1, shape).astype(np.float32)
+
+
+# ------------------------------------------------------------------ math
+@pytest.mark.parametrize("name,np_fn", [
+    ("exp", np.exp), ("tanh", np.tanh), ("sqrt", None), ("abs", np.abs),
+    ("sigmoid", lambda x: 1 / (1 + np.exp(-x))), ("log", None),
+    ("sin", np.sin), ("cos", np.cos), ("floor", np.floor), ("ceil", np.ceil),
+])
+def test_unary(name, np_fn):
+    x = rand(3, 4)
+    if name in ("sqrt", "log"):
+        x = np.abs(x) + 0.5
+        np_fn = {"sqrt": np.sqrt, "log": np.log}[name]
+    check_output(getattr(paddle, name), lambda a: np_fn(a), [x])
+    if name not in ("floor", "ceil", "abs"):
+        check_grad(getattr(paddle, name), [x])
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("atan2", np.arctan2),
+])
+def test_binary(name, np_fn):
+    x, y = rand(3, 4), rand(3, 4) + 2.0
+    check_output(getattr(paddle, name), lambda a, b: np_fn(a, b), [x, y])
+
+
+def test_broadcasting():
+    x, y = rand(3, 1, 4), rand(2, 1)
+    check_output(paddle.add, np.add, [x, y])
+    check_grad(paddle.add, [x, y])
+
+
+def test_matmul():
+    x, y = rand(3, 4), rand(4, 5)
+    check_output(paddle.matmul, np.matmul, [x, y])
+    check_grad(paddle.matmul, [x, y])
+
+
+def test_matmul_batched_transpose():
+    x, y = rand(2, 3, 4), rand(2, 5, 4)
+    out = paddle.matmul(paddle.to_tensor(x), paddle.to_tensor(y), transpose_y=True)
+    np.testing.assert_allclose(out.numpy(), x @ y.transpose(0, 2, 1), rtol=1e-5)
+
+
+def test_reductions():
+    x = rand(3, 4, 5)
+    check_output(paddle.sum, lambda a: np.sum(a), [x])
+    check_output(lambda t: paddle.sum(t, axis=1),
+                 lambda a: np.sum(a, axis=1), [x])
+    check_output(lambda t: paddle.mean(t, axis=[0, 2], keepdim=True),
+                 lambda a: np.mean(a, axis=(0, 2), keepdims=True), [x])
+    check_output(lambda t: paddle.max(t, axis=1), lambda a: np.max(a, 1), [x])
+    check_grad(lambda t: paddle.mean(t, axis=1), [x])
+
+
+def test_cumsum_logsumexp():
+    x = rand(3, 4)
+    check_output(lambda t: paddle.cumsum(t, axis=1),
+                 lambda a: np.cumsum(a, axis=1), [x])
+    from scipy.special import logsumexp as np_lse  # scipy ships with the image
+    check_output(lambda t: paddle.logsumexp(t, axis=1),
+                 lambda a: np_lse(a, axis=1), [x], rtol=1e-5)
+
+
+def test_manipulation():
+    x = rand(2, 3, 4)
+    check_output(lambda t: paddle.reshape(t, [6, 4]),
+                 lambda a: a.reshape(6, 4), [x])
+    check_output(lambda t: paddle.transpose(t, [2, 0, 1]),
+                 lambda a: a.transpose(2, 0, 1), [x])
+    check_output(lambda t: paddle.flatten(t, start_axis=1),
+                 lambda a: a.reshape(2, 12), [x])
+    check_output(lambda t: paddle.squeeze(paddle.unsqueeze(t, 0), 0),
+                 lambda a: a, [x])
+    check_output(lambda t: paddle.flip(t, axis=1),
+                 lambda a: np.flip(a, 1), [x])
+
+
+def test_concat_stack_split():
+    x, y = rand(2, 3), rand(2, 3)
+    check_output(lambda a, b: paddle.concat([a, b], axis=0),
+                 lambda a, b: np.concatenate([a, b], 0), [x, y])
+    check_output(lambda a, b: paddle.stack([a, b], axis=1),
+                 lambda a, b: np.stack([a, b], 1), [x, y])
+    parts = paddle.split(paddle.to_tensor(rand(6, 3)), 3, axis=0)
+    assert len(parts) == 3 and parts[0].shape == [2, 3]
+
+
+def test_gather_scatter_index():
+    x = rand(5, 3)
+    idx = np.array([0, 2, 4])
+    check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                 lambda a: a[idx], [x])
+    check_output(lambda t: paddle.index_select(t, paddle.to_tensor(idx), axis=0),
+                 lambda a: a[idx], [x])
+
+
+def test_where_topk_argmax():
+    x = rand(4, 5)
+    check_output(lambda t: paddle.argmax(t, axis=1),
+                 lambda a: np.argmax(a, 1), [x])
+    v, i = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+    ref = np.sort(x, axis=1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(v.numpy(), ref, rtol=1e-6)
+    cond = x > 0
+    check_output(lambda t: paddle.where(paddle.to_tensor(cond), t, t * 2),
+                 lambda a: np.where(cond, a, a * 2), [x])
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int32").dtype == np.int32
+    np.testing.assert_array_equal(paddle.arange(0, 10, 2).numpy(), [0, 2, 4, 6, 8])
+    e = paddle.eye(3)
+    np.testing.assert_allclose(e.numpy(), np.eye(3, dtype=np.float32))
+    fl = paddle.full([2, 2], 7.0)
+    np.testing.assert_allclose(fl.numpy(), 7.0)
+    z = paddle.zeros_like(paddle.ones([4]))
+    np.testing.assert_allclose(z.numpy(), 0.0)
+    ls = paddle.linspace(0, 1, 5)
+    np.testing.assert_allclose(ls.numpy(), np.linspace(0, 1, 5, dtype=np.float32))
+
+
+def test_random_ops_reproducible():
+    paddle.seed(123)
+    a = paddle.randn([3, 3])
+    paddle.seed(123)
+    b = paddle.randn([3, 3])
+    np.testing.assert_allclose(a.numpy(), b.numpy())
+    u = paddle.uniform([1000], min=0.0, max=1.0)
+    assert 0 <= u.numpy().min() and u.numpy().max() <= 1
+    r = paddle.randint(0, 10, [100])
+    assert r.numpy().min() >= 0 and r.numpy().max() < 10
+
+
+def test_linalg_ops():
+    x = rand(3, 3)
+    spd = x @ x.T + 3 * np.eye(3, dtype=np.float32)
+    check_output(paddle.inverse, np.linalg.inv, [spd], rtol=1e-4)
+    check_output(lambda t: paddle.cholesky(t),
+                 lambda a: np.linalg.cholesky(a), [spd], rtol=1e-4)
+    check_output(paddle.trace, np.trace, [x])
+    check_output(lambda t: paddle.norm(t),
+                 lambda a: np.linalg.norm(a), [x], rtol=1e-5)
+
+
+def test_einsum():
+    x, y = rand(2, 3, 4), rand(2, 4, 5)
+    check_output(lambda a, b: paddle.einsum("bij,bjk->bik", a, b),
+                 lambda a, b: np.einsum("bij,bjk->bik", a, b), [x, y])
+
+
+def test_cast_dtype_promotion():
+    a = paddle.to_tensor([1, 2], dtype="int32")
+    b = paddle.to_tensor([0.5, 0.5])
+    out = a + b
+    assert out.dtype == np.float32

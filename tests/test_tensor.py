@@ -1,0 +1,101 @@
+"""Tensor surface tests (reference: test/legacy_test/test_eager_tensor.py area)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_dtypes():
+    t = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert t.dtype == np.float32  # python floats land as fp32
+    # TPU-first design decision: integer data lands as int32 (the MXU/VPU
+    # native index width; jax x64 mode stays off). The reference defaults
+    # to int64 on CUDA.
+    ti = paddle.to_tensor(np.arange(4))
+    assert ti.dtype == np.int32
+    tb = paddle.to_tensor([True, False])
+    assert tb.dtype == np.bool_
+
+
+def test_shape_meta():
+    t = paddle.zeros([2, 3, 4])
+    assert t.shape == [2, 3, 4]
+    assert t.ndim == 3
+    assert t.size == 24
+    assert t.numel() == 24
+    assert len(t) == 2
+
+
+def test_item_and_numpy():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == pytest.approx(3.5)
+    assert float(t) == pytest.approx(3.5)
+    a = paddle.to_tensor([[1, 2], [3, 4]])
+    np.testing.assert_array_equal(a.numpy(), [[1, 2], [3, 4]])
+
+
+def test_arithmetic_operators():
+    a = paddle.to_tensor([1.0, 2.0])
+    b = paddle.to_tensor([3.0, 4.0])
+    np.testing.assert_allclose((a + b).numpy(), [4, 6])
+    np.testing.assert_allclose((a - b).numpy(), [-2, -2])
+    np.testing.assert_allclose((a * b).numpy(), [3, 8])
+    np.testing.assert_allclose((b / a).numpy(), [3, 2])
+    np.testing.assert_allclose((a ** 2).numpy(), [1, 4])
+    np.testing.assert_allclose((2 + a).numpy(), [3, 4])
+    np.testing.assert_allclose((-a).numpy(), [-1, -2])
+
+
+def test_comparison_and_indexing():
+    a = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    m = a > 2.0
+    assert m.dtype == np.bool_
+    np.testing.assert_array_equal(a[0].numpy(), [1, 2])
+    np.testing.assert_array_equal(a[:, 1].numpy(), [2, 4])
+    np.testing.assert_array_equal(a[m].numpy(), [3, 4])
+
+
+def test_setitem():
+    a = paddle.zeros([3, 3])
+    a[1] = 5.0
+    np.testing.assert_allclose(a.numpy()[1], [5, 5, 5])
+    a[0, 0] = 7.0
+    assert a.numpy()[0, 0] == 7
+
+
+def test_set_value_and_inplace():
+    a = paddle.ones([2, 2])
+    a.set_value(np.full((2, 2), 3.0, np.float32))
+    np.testing.assert_allclose(a.numpy(), 3.0)
+    a.add_(paddle.ones([2, 2]))
+    np.testing.assert_allclose(a.numpy(), 4.0)
+    a.zero_()
+    np.testing.assert_allclose(a.numpy(), 0.0)
+
+
+def test_astype_cast():
+    a = paddle.to_tensor([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.astype(paddle.bfloat16)
+    assert c.dtype == paddle.bfloat16
+
+
+def test_detach_and_clone():
+    a = paddle.to_tensor([1.0], stop_gradient=False)
+    b = (a * 2).detach()
+    assert b.stop_gradient
+    c = a.clone()
+    assert not c.stop_gradient  # clone is differentiable
+
+
+def test_dist_placement_api():
+    # Tensor.to_dist is the DistTensor entry (SURVEY §2.3 dygraph auto-parallel)
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    devs = np.array(jax.devices("cpu")[:8]).reshape(4, 2)
+    mesh = Mesh(devs, ("dp", "mp"))
+    t = paddle.ones([8, 4])
+    d = t.to_dist(NamedSharding(mesh, P("dp", None)))
+    assert d.is_dist()
+    np.testing.assert_allclose(d.numpy(), 1.0)
