@@ -1,0 +1,14 @@
+"""Automatic mixed precision.
+
+Reference: python/paddle/amp/ (auto_cast.py amp_guard:383, grad_scaler.py,
+amp_lists.py). TPU-native: bf16 is the native MXU input type, so the default
+amp dtype is bfloat16 and loss scaling is a no-op for bf16 (its exponent
+range equals fp32); the full dynamic-scaling machinery still exists for
+fp16 parity.
+"""
+from .auto_cast import amp_guard, auto_cast, decorate, amp_decorate
+from .grad_scaler import AmpScaler, GradScaler
+from . import debugging
+
+white_list = None
+black_list = None

@@ -1,0 +1,72 @@
+"""auto_cast / decorate (reference: python/paddle/amp/auto_cast.py)."""
+from __future__ import annotations
+
+from ..core import dispatch
+from ..core import dtype as dtypes
+
+
+class auto_cast:
+    """Context manager: O1 casts white-list op inputs to the amp dtype at
+    dispatch time; O2 additionally assumes params were cast by decorate()."""
+
+    def __init__(self, enable=True, custom_white_list=None,
+                 custom_black_list=None, level="O1", dtype="bfloat16",
+                 use_promote=True):
+        if level not in ("O0", "O1", "O2", "OD"):
+            raise ValueError(f"unsupported amp level {level}")
+        self.enable = enable
+        self.level = level if enable else "O0"
+        self.dtype = dtype
+        self.custom_white_list = custom_white_list
+        self.custom_black_list = custom_black_list
+
+    def __enter__(self):
+        self._prev = dispatch.set_amp_state(
+            self.level, self.dtype, self.custom_white_list,
+            self.custom_black_list)
+        return self
+
+    def __exit__(self, *exc):
+        dispatch.restore_amp_state(self._prev)
+        return False
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2: cast model params to the amp dtype, keeping master fp32 copies in
+    the optimizer (reference amp.decorate)."""
+    if level == "O1" or level == "O0":
+        return (models, optimizers) if optimizers is not None else models
+    target = dtypes.convert_dtype(dtype)
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    excluded = tuple(excluded_layers) if excluded_layers else ()
+    from ..nn.layer.norm import _BatchNormBase, LayerNorm
+
+    for model in model_list:
+        for layer in model.sublayers(include_self=True):
+            if isinstance(layer, (_BatchNormBase, LayerNorm)) or \
+                    (excluded and isinstance(layer, excluded)):
+                continue  # norm layers stay fp32 for numeric stability
+            for pname, p in layer._parameters.items():
+                if p is None:
+                    continue
+                cur = p.dtype
+                import numpy as np
+                if np.issubdtype(cur, np.floating) or cur == dtypes.bfloat16:
+                    p._swap_payload(p._data.astype(target))
+            layer._casted_by_pure_fp16 = True
+    if optimizers is not None:
+        opt_list = (optimizers if isinstance(optimizers, (list, tuple))
+                    else [optimizers])
+        for opt in opt_list:
+            opt._multi_precision = True
+        return (models if isinstance(models, (list, tuple)) else model_list[0],
+                optimizers)
+    return models if isinstance(models, (list, tuple)) else model_list[0]
+
+
+amp_decorate = decorate

@@ -1,0 +1,84 @@
+"""Numeric debugging (reference: python/paddle/amp/debugging.py —
+TensorCheckerConfig:156, enable_operator_stats_collection)."""
+from __future__ import annotations
+
+import contextlib
+from collections import Counter
+
+from ..core import dispatch, flags
+from ..core.tensor import Tensor
+
+
+class DebugMode:
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=False, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None, skipped_op_list=None,
+                 debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+        self.debug_step = debug_step
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    if config.enable:
+        level = 0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT else 1
+        flags.set_flags({"check_nan_inf": True,
+                         "check_nan_inf_level": level})
+
+
+def disable_tensor_checker():
+    flags.set_flags({"check_nan_inf": False})
+
+
+_op_stats = Counter()
+_collecting = False
+
+
+def _stats_hook(op_name, inputs, outputs, attrs):
+    if _collecting:
+        dt = outputs[0].dtype if outputs else None
+        _op_stats[f"{op_name}:{dt}"] += 1
+
+
+_hook_registered = False
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    global _collecting, _hook_registered
+    if not _hook_registered:
+        dispatch.register_op_hook(_stats_hook)
+        _hook_registered = True
+    _op_stats.clear()
+    _collecting = True
+    try:
+        yield
+    finally:
+        _collecting = False
+        print("<------------------------------ op list ------------------------------->")
+        for key, cnt in sorted(_op_stats.items()):
+            print(f"  {key}  calls={cnt}")
+
+
+def enable_operator_stats_collection():
+    global _collecting, _hook_registered
+    if not _hook_registered:
+        dispatch.register_op_hook(_stats_hook)
+        _hook_registered = True
+    _op_stats.clear()
+    _collecting = True
+
+
+def disable_operator_stats_collection():
+    global _collecting
+    _collecting = False
+    for key, cnt in sorted(_op_stats.items()):
+        print(f"  {key}  calls={cnt}")

@@ -1,0 +1,19 @@
+"""paddle_tpu.framework — misc framework-level API
+(reference: python/paddle/framework/__init__.py)."""
+from ..core.dispatch import grad_enabled
+from ..core.generator import get_rng_state, seed, set_rng_state
+from .io import load, save
+from .random import get_cuda_rng_state, set_cuda_rng_state
+
+
+def in_dynamic_mode():
+    from ..jit.api import in_capture_mode
+    return not in_capture_mode()
+
+
+def in_pir_mode():
+    return False
+
+
+def use_pir_api():
+    return False

@@ -1,0 +1,60 @@
+"""Single-process save/load.
+
+Reference: python/paddle/framework/io.py (save:743 / load:985 — pickled
+nested state_dict, protocol 4). Tensors are serialized as numpy arrays and
+rehydrated onto the current device on load; bfloat16 round-trips through a
+uint16 view since numpy lacks the dtype.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+_BF16_TAG = "__bf16__"
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        arr = obj._data
+        if np.dtype(arr.dtype) == dtypes.bfloat16:
+            return {_BF16_TAG: True,
+                    "data": np.asarray(arr.astype(jnp.float32))}
+        return np.asarray(arr)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            return Tensor(jnp.asarray(obj["data"]).astype(dtypes.bfloat16))
+        return {k: _unpack(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f))

@@ -1,0 +1,11 @@
+"""Device RNG state helpers (reference: python/paddle/framework/random.py).
+On TPU there is one counter-based stream; the "cuda" names are aliases."""
+from ..core.generator import get_rng_state, set_rng_state
+
+
+def get_cuda_rng_state():
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    return set_rng_state(state)

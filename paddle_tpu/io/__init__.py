@@ -1,0 +1,15 @@
+"""paddle_tpu.io — Dataset/DataLoader.
+
+Reference: python/paddle/io/ (dataloader with multiprocess prefetch,
+dataloader_iter.py:365). TPU-native notes: the loader yields host numpy
+batches; device transfer happens at first op use (or explicitly via
+to_tensor), so input pipelines overlap with device compute naturally under
+JAX's async dispatch. Multiprocess workers use the same
+``multiprocessing.Process`` + queue design as the reference.
+"""
+from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
+                      IterableDataset, Subset, TensorDataset, random_split)
+from .dataloader import DataLoader, get_worker_info
+from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
+                      Sampler, SequenceSampler, SubsetRandomSampler,
+                      WeightedRandomSampler)

@@ -1,0 +1,256 @@
+"""DataLoader with multiprocess prefetch.
+
+Reference: python/paddle/io/dataloader/dataloader_iter.py:365
+(_DataLoaderIterMultiProcess — worker Process pool, index queues, data
+queue). This implementation keeps the same architecture: a round-robin
+index-queue per worker, a shared result queue, and an in-order reorder
+buffer; numpy arrays cross process boundaries (device transfer happens in
+the consumer, keeping workers device-free, which is mandatory on TPU where
+only one process may own the chip).
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing as mp
+import queue as queue_mod
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler, RandomSampler, SequenceSampler
+
+_worker_info = None
+
+
+@dataclass
+class WorkerInfo:
+    id: int
+    num_workers: int
+    dataset: object
+    seed: int = 0
+
+
+def get_worker_info():
+    return _worker_info
+
+
+def default_collate_fn(batch):
+    """Stack samples into batched numpy/Tensor structures (reference:
+    python/paddle/io/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([b._data for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return batch
+
+
+def _worker_loop(dataset, index_queue, data_queue, collate_fn, worker_id,
+                 num_workers, init_fn, use_shared_memory):
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        item = index_queue.get()
+        if item is None:
+            break
+        batch_idx, indices = item
+        try:
+            if isinstance(dataset, IterableDataset):
+                data = indices  # pre-fetched by iterator path
+            else:
+                samples = [dataset[i] for i in indices]
+                data = collate_fn(samples)
+            data_queue.put((batch_idx, data, None))
+        except Exception as e:  # propagate worker errors to the consumer
+            import traceback
+            data_queue.put((batch_idx, None, f"{e}\n{traceback.format_exc()}"))
+
+
+class _SingleProcessIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._sampler_iter = iter(loader.batch_sampler)
+        self._dataset = loader.dataset
+        self._collate = loader.collate_fn
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        indices = next(self._sampler_iter)
+        samples = [self._dataset[i] for i in indices]
+        out = self._collate(samples)
+        return self._loader._to_output(out)
+
+
+class _IterableDatasetIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._it = iter(loader.dataset)
+        self._batch_size = loader.batch_size
+        self._drop_last = loader.drop_last
+        self._collate = loader.collate_fn
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = list(itertools.islice(self._it, self._batch_size))
+        if not batch or (self._drop_last and len(batch) < self._batch_size):
+            raise StopIteration
+        return self._loader._to_output(self._collate(batch))
+
+
+class _MultiProcessIter:
+    def __init__(self, loader):
+        self._loader = loader
+        self._num_workers = loader.num_workers
+        self._sampler_iter = iter(loader.batch_sampler)
+        ctx = mp.get_context("fork")
+        self._index_queues = [ctx.Queue() for _ in range(self._num_workers)]
+        self._data_queue = ctx.Queue()
+        self._workers = []
+        for wid in range(self._num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self._index_queues[wid],
+                      self._data_queue, loader.collate_fn, wid,
+                      self._num_workers, loader.worker_init_fn,
+                      loader.use_shared_memory),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+        self._send_idx = 0
+        self._rcvd_idx = 0
+        self._reorder = {}
+        self._outstanding = 0
+        self._exhausted = False
+        self._shutdown = False
+        # prime the pipeline: 2 batches in flight per worker
+        for _ in range(2 * self._num_workers):
+            self._dispatch()
+
+    def _dispatch(self):
+        if self._exhausted:
+            return
+        try:
+            indices = next(self._sampler_iter)
+        except StopIteration:
+            self._exhausted = True
+            return
+        self._index_queues[self._send_idx % self._num_workers].put(
+            (self._send_idx, indices))
+        self._send_idx += 1
+        self._outstanding += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._outstanding == 0:
+            self._teardown()
+            raise StopIteration
+        while self._rcvd_idx not in self._reorder:
+            # Bounded get + liveness check: a died worker (e.g. fork of the
+            # multithreaded JAX parent wedging) must not hang the consumer.
+            try:
+                batch_idx, data, err = self._data_queue.get(timeout=5.0)
+            except queue_mod.Empty:
+                dead = [w.pid for w in self._workers if not w.is_alive()]
+                if dead:
+                    self._teardown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly")
+                continue
+            if err is not None:
+                self._teardown()
+                raise RuntimeError(f"DataLoader worker failed:\n{err}")
+            self._reorder[batch_idx] = data
+        data = self._reorder.pop(self._rcvd_idx)
+        self._rcvd_idx += 1
+        self._outstanding -= 1
+        self._dispatch()
+        return self._loader._to_output(data)
+
+    def _teardown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        for q in self._index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self._workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+
+    def __del__(self):
+        self._teardown()
+
+
+class DataLoader:
+    def __init__(self, dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=True, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
+        self.return_list = return_list
+        if batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = getattr(batch_sampler, "batch_size", batch_size)
+        elif not isinstance(dataset, IterableDataset):
+            self.batch_sampler = BatchSampler(
+                dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+        else:
+            self.batch_sampler = None
+
+    def _to_output(self, data):
+        """numpy → Tensor conversion at the consumer edge."""
+        if isinstance(data, np.ndarray):
+            import jax.numpy as jnp
+            return Tensor(jnp.asarray(data))
+        if isinstance(data, (list, tuple)):
+            return type(data)(self._to_output(d) for d in data)
+        if isinstance(data, dict):
+            return {k: self._to_output(v) for k, v in data.items()}
+        return data
+
+    def __iter__(self):
+        if isinstance(self.dataset, IterableDataset):
+            return _IterableDatasetIter(self)
+        if self.num_workers == 0:
+            return _SingleProcessIter(self)
+        return _MultiProcessIter(self)
+
+    def __len__(self):
+        if self.batch_sampler is None:
+            raise TypeError("IterableDataset has no len()")
+        return len(self.batch_sampler)

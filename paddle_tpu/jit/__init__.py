@@ -1,0 +1,8 @@
+"""paddle_tpu.jit — program capture & compiled execution.
+
+Reference: python/paddle/jit/ (to_static, save/load, SOT). The trace-based
+capture engine lands in api.py; SOT-style bytecode capture is tracked in
+sot/ (reference python/paddle/jit/sot/).
+"""
+from .api import to_static, not_to_static, in_capture_mode, ignore_module
+from .api import save, load

@@ -1,0 +1,160 @@
+"""Program capture (to_static) — trace-based v0.
+
+Reference: python/paddle/jit/api.py to_static:173 + dy2static/sot capture
+frontends. TPU-native design: instead of transpiling Python to a Program IR,
+`to_static` jits the wrapped callable with jax — the dispatcher runs under
+tracing (payloads become tracers), the autograd tape records as usual, and
+XLA compiles the whole step. Guards = jax's shape/dtype dispatch cache.
+
+This v0 supports function capture with static control flow. Graph-break
+fallback and bytecode-level capture (SOT) land on top of this API.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ..core.tensor import Tensor
+
+_capture = threading.local()
+
+
+def in_capture_mode() -> bool:
+    return getattr(_capture, "active", 0) > 0
+
+
+class _CaptureScope:
+    def __enter__(self):
+        _capture.active = getattr(_capture, "active", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _capture.active -= 1
+        return False
+
+
+def _unwrap(obj):
+    if isinstance(obj, Tensor):
+        return obj._data
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unwrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _unwrap(v) for k, v in obj.items()}
+    return obj
+
+
+def _wrap(obj):
+    if isinstance(obj, jax.Array):
+        return Tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_wrap(o) for o in obj)
+    if isinstance(obj, dict):
+        return {k: _wrap(v) for k, v in obj.items()}
+    return obj
+
+
+class StaticFunction:
+    """Callable wrapper holding the jit cache (reference:
+    dy2static/program_translator.py:329 StaticFunction)."""
+
+    def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
+                 backend=None, full_graph=True):
+        self._dygraph_fn = fn
+        self._input_spec = input_spec
+        functools.update_wrapper(self, fn)
+
+        def traced(params_data, args_data, kwargs_data):
+            with _CaptureScope():
+                # rebind parameter payloads to tracers for the trace
+                originals = []
+                for p, d in params_data:
+                    originals.append((p, p._data))
+                    p._data = d
+                try:
+                    args_t = _wrap(args_data)
+                    kwargs_t = _wrap(kwargs_data)
+                    out = fn(*args_t, **kwargs_t)
+                    return _unwrap(out)
+                finally:
+                    for p, d in originals:
+                        p._data = d
+
+        self._jitted = None
+        self._traced = traced
+
+    def _collect_params(self, args):
+        """Find Layer instances bound to the function (self for methods)."""
+        params = []
+        owner = getattr(self._dygraph_fn, "__self__", None)
+        if owner is not None and hasattr(owner, "parameters"):
+            params.extend(owner.parameters())
+            params.extend(b for _, b in owner.named_buffers())
+        for a in args:
+            if hasattr(a, "parameters") and hasattr(a, "named_buffers"):
+                params.extend(a.parameters())
+        return params
+
+    def __call__(self, *args, **kwargs):
+        if in_capture_mode():
+            return self._dygraph_fn(*args, **kwargs)
+        params = self._collect_params(args)
+        pairs = [(p, p._data) for p in params]
+        if self._jitted is None:
+            def jit_target(param_arrays, args_data, kwargs_data):
+                return self._traced(
+                    list(zip(params, param_arrays)), args_data, kwargs_data)
+            self._jitted = jax.jit(jit_target)
+        out = self._jitted([d for _, d in pairs], _unwrap(args),
+                           _unwrap(kwargs))
+        return _wrap(out)
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._dygraph_fn)
+
+    def concrete_program(self):
+        return None
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True):
+    def decorate(fn):
+        if hasattr(fn, "forward") and callable(getattr(fn, "forward")):
+            # Layer instance: wrap its forward
+            layer = fn
+            layer.forward = StaticFunction(layer.forward, input_spec,
+                                           build_strategy, backend, full_graph)
+            return layer
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              full_graph)
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn):
+    fn._not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    return None
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Save params + (optionally) the traced program (reference:
+    python/paddle/jit/api.py save). v0 persists the state_dict; exported
+    StableHLO lands with the inference-export milestone."""
+    from ..framework.io import save as _save
+    state = layer.state_dict() if hasattr(layer, "state_dict") else layer
+    _save(state, path + ".pdparams")
+
+
+def load(path, **configs):
+    from ..framework.io import load as _load
+    return _load(path + ".pdparams")

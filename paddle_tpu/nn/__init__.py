@@ -1,0 +1,11 @@
+"""paddle_tpu.nn — neural network layers.
+
+Reference surface: python/paddle/nn/__init__.py.
+"""
+from . import functional
+from . import initializer
+from .parameter import Parameter, ParamAttr, create_parameter
+from .layer import *  # noqa: F401,F403
+from .layer.layers import Layer
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters

@@ -1,0 +1,97 @@
+"""Gradient clipping strategies.
+
+Reference: python/paddle/nn/clip.py — ClipGradByValue, ClipGradByNorm,
+ClipGradByGlobalNorm. These objects are handed to optimizers
+(``grad_clip=``) and applied to (param, grad) lists before the update. The
+hybrid-parallel variant that allreduces the global norm across mesh axes
+lives in paddle_tpu.distributed.fleet (reference:
+hybrid_parallel_optimizer.py HybridParallelClipGrad).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            ng = dispatch.call("clip", lambda a: jnp.clip(a, self.min, self.max), [g])
+            out.append((p, ng))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+
+            def f(a):
+                norm = jnp.sqrt(jnp.sum(a.astype(jnp.float32) ** 2))
+                scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                    1.0)
+                return (a.astype(jnp.float32) * scale).astype(a.dtype)
+            out.append((p, dispatch.call("clip_by_norm", f, [g])))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def _global_norm(self, grads):
+        sq = None
+        for g in grads:
+            s = dispatch.call(
+                "sq_l2", lambda a: jnp.sum(a.astype(jnp.float32) ** 2), [g])
+            sq = s if sq is None else sq + s
+        return dispatch.call("sqrt_", lambda a: jnp.sqrt(a), [sq])
+
+    def _dygraph_clip(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        global_norm = self._global_norm(grads)
+
+        def scale_fn(a, n):
+            s = self.clip_norm / jnp.maximum(n, self.clip_norm)
+            return (a.astype(jnp.float32) * s).astype(a.dtype)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, dispatch.call("global_norm_scale", scale_fn,
+                                         [g, global_norm])))
+        return out
+
+
+GradientClipBase = ClipGradBase
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm

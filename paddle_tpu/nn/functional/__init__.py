@@ -1,0 +1,13 @@
+"""paddle_tpu.nn.functional — functional neural net ops.
+
+Reference surface: python/paddle/nn/functional/__init__.py.
+"""
+from .activation import *  # noqa: F401,F403
+from .common import *      # noqa: F401,F403
+from .conv import *        # noqa: F401,F403
+from .pooling import *     # noqa: F401,F403
+from .norm import *        # noqa: F401,F403
+from .loss import *        # noqa: F401,F403
+from .flash_attention import *  # noqa: F401,F403
+
+from . import activation, common, conv, flash_attention, loss, norm, pooling

@@ -1,0 +1,201 @@
+"""Activation functionals.
+
+Reference: python/paddle/nn/functional/activation.py. All are single jax
+lowerings dispatched through the autograd dispatcher; XLA fuses them into
+neighbouring matmuls so there is no need for hand-fused kernels here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _unary(name, f):
+    def op(x, name=None):
+        return dispatch.call(name_, f, [_t(x)])
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", lambda a: jnp.maximum(a, 0))
+relu6 = _unary("relu6", lambda a: jnp.clip(a, 0, 6))
+sigmoid = _unary("sigmoid", jax.nn.sigmoid)
+tanh = _unary("tanh", jnp.tanh)
+silu = _unary("silu", jax.nn.silu)
+swish = silu
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
+softsign = _unary("softsign", jax.nn.soft_sign)
+tanhshrink = _unary("tanhshrink", lambda a: a - jnp.tanh(a))
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return dispatch.call("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+                         [_t(x)])
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return dispatch.call(
+        "leaky_relu", lambda a: jnp.where(a >= 0, a, negative_slope * a), [_t(x)])
+
+
+def elu(x, alpha=1.0, name=None):
+    return dispatch.call("elu", lambda a: jax.nn.elu(a, alpha=alpha), [_t(x)])
+
+
+def celu(x, alpha=1.0, name=None):
+    return dispatch.call("celu", lambda a: jax.nn.celu(a, alpha=alpha), [_t(x)])
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return dispatch.call(
+        "selu",
+        lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), [_t(x)])
+
+
+def hardswish(x, name=None):
+    return dispatch.call("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6, [_t(x)])
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return dispatch.call(
+        "hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0, 1), [_t(x)])
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return dispatch.call("hardtanh", lambda a: jnp.clip(a, min, max), [_t(x)])
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return dispatch.call(
+        "hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), [_t(x)])
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return dispatch.call(
+        "softshrink",
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)), [_t(x)])
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    def f(a):
+        scaled = beta * a
+        return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
+    return dispatch.call("softplus", f, [_t(x)])
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, w = _t(x), _t(weight)
+
+    def f(a, wa):
+        if wa.size == 1:
+            wb = wa.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch_axis = 1 if data_format in ("NCHW", "NCL", "NCDHW") else a.ndim - 1
+            shape[ch_axis] = wa.size
+            wb = wa.reshape(shape)
+        return jnp.where(a >= 0, a, wb * a)
+    return dispatch.call("prelu", f, [x, w])
+
+
+def rrelu(x, lower=0.125, upper=1.0 / 3, training=False, name=None):
+    from ...core.generator import next_key
+    x = _t(x)
+    if training:
+        key = next_key()
+
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, dtype=a.dtype,
+                                       minval=lower, maxval=upper)
+            return jnp.where(a >= 0, a, slope * a)
+    else:
+        mid = (lower + upper) / 2
+
+        def f(a):
+            return jnp.where(a >= 0, a, mid * a)
+    return dispatch.call("rrelu", f, [x])
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+
+    def f(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return dispatch.call("softmax", f, [x])
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+
+    def f(a):
+        if dtype is not None:
+            from ...core.dtype import convert_dtype
+            a = a.astype(convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return dispatch.call("log_softmax", f, [x])
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...core.generator import next_key
+    x = _t(x)
+    key = next_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis,
+                                        inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return dispatch.call("gumbel_softmax", f, [x])
+
+
+def maxout(x, groups, axis=1, name=None):
+    x = _t(x)
+
+    def f(a):
+        shape = list(a.shape)
+        ax = axis if axis >= 0 else a.ndim + axis
+        c = shape[ax]
+        new_shape = shape[:ax] + [c // groups, groups] + shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return dispatch.call("maxout", f, [x])
+
+
+def glu(x, axis=-1, name=None):
+    x = _t(x)
+
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return dispatch.call("glu", f, [x])
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return dispatch.call(
+        "thresholded_relu", lambda a: jnp.where(a > threshold, a, value), [_t(x)])
+
+
+__all__ = [
+    "relu", "relu6", "sigmoid", "tanh", "silu", "swish", "mish", "softsign",
+    "tanhshrink", "log_sigmoid", "gelu", "leaky_relu", "elu", "celu", "selu",
+    "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "softshrink",
+    "softplus", "prelu", "rrelu", "softmax", "log_softmax", "gumbel_softmax",
+    "maxout", "glu", "thresholded_relu",
+]

@@ -1,0 +1,194 @@
+"""Convolution functionals.
+
+Reference: python/paddle/nn/functional/conv.py (conv2d -> phi conv kernels,
+paddle/phi/kernels/gpu/conv_kernel.cu via cuDNN). TPU-native: one
+``lax.conv_general_dilated`` lowering — XLA maps it onto the MXU and picks
+the layout; there is no algo-autotune cache to port because XLA owns it.
+Weight layouts follow the reference: conv NCHW/OIHW, conv_transpose IOHW.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _ntuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(x) for x in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        raise ValueError(f"expected {n} values, got {v}")
+    return tuple(int(v) for _ in range(n))
+
+
+def _resolve_padding(padding, nd, dilation, ksize):
+    """Paddle padding forms: int, list, 'SAME', 'VALID', per-dim pairs."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (list, tuple)):
+        flat = list(padding)
+        if len(flat) == nd and all(isinstance(p, (list, tuple)) for p in flat):
+            return [tuple(p) for p in flat]
+        if len(flat) == 2 * nd:
+            return [(flat[2 * i], flat[2 * i + 1]) for i in range(nd)]
+        p = _ntuple(flat, nd)
+        return [(x, x) for x in p]
+    p = _ntuple(padding, nd)
+    return [(x, x) for x in p]
+
+
+def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, channel_last,
+             op_name):
+    stride = _ntuple(stride, nd)
+    dilation = _ntuple(dilation, nd)
+    spatial = "DHW"[3 - nd:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(w.shape), (lhs_spec, "OI" + spatial, lhs_spec))
+    pad = _resolve_padding(padding, nd, dilation, w.shape[2:])
+
+    inputs = [x, w] + ([bias] if bias is not None else [])
+
+    def f(a, wt, *rest):
+        y = jax.lax.conv_general_dilated(
+            a, wt.astype(a.dtype), window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if rest:
+            b = rest[0].astype(y.dtype)
+            shape = [1] * y.ndim
+            shape[-1 if channel_last else 1] = b.size
+            y = y + b.reshape(shape)
+        return y
+    return dispatch.call(op_name, f, inputs)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv_nd(_t(x), _t(weight), _t(bias) if bias is not None else None,
+                    stride, padding, dilation, groups, 1,
+                    data_format == "NLC", "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv_nd(_t(x), _t(weight), _t(bias) if bias is not None else None,
+                    stride, padding, dilation, groups, 2,
+                    data_format == "NHWC", "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv_nd(_t(x), _t(weight), _t(bias) if bias is not None else None,
+                    stride, padding, dilation, groups, 3,
+                    data_format == "NDHWC", "conv3d")
+
+
+def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
+                       groups, nd, channel_last, output_size, op_name):
+    stride = _ntuple(stride, nd)
+    dilation = _ntuple(dilation, nd)
+    output_padding = _ntuple(output_padding, nd)
+    ksize = [int(k) for k in w.shape[2:]]
+    pad = _resolve_padding(padding, nd, dilation, ksize)
+    if isinstance(pad, str):
+        if pad == "VALID":
+            pad = [(0, 0)] * nd
+        else:  # SAME: out = in * stride
+            pad = []
+            for i in range(nd):
+                total = dilation[i] * (ksize[i] - 1) + 1 - stride[i]
+                total = max(total, 0)
+                pad.append((total // 2, total - total // 2))
+    if output_size is not None:
+        output_size = _ntuple(output_size, nd)
+        in_spatial = x.shape[2:] if not channel_last else x.shape[1:-1]
+        output_padding = tuple(
+            output_size[i] - ((in_spatial[i] - 1) * stride[i]
+                              - pad[i][0] - pad[i][1]
+                              + dilation[i] * (ksize[i] - 1) + 1)
+            for i in range(nd))
+
+    spatial = "DHW"[3 - nd:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+
+    c_in = w.shape[0]
+    c_out_per_g = w.shape[1]
+
+    # Gradient-of-conv formulation: flip spatial dims, swap I/O per group,
+    # dilate the input by stride (reference semantics:
+    # python/paddle/nn/functional/conv.py conv2d_transpose).
+    conv_pad = [
+        (dilation[i] * (ksize[i] - 1) - pad[i][0],
+         dilation[i] * (ksize[i] - 1) - pad[i][1] + output_padding[i])
+        for i in range(nd)
+    ]
+
+    inputs = [x, w] + ([bias] if bias is not None else [])
+
+    def f(a, wt, *rest):
+        g = groups
+        kt = wt.reshape((g, c_in // g, c_out_per_g) + wt.shape[2:])
+        kt = jnp.swapaxes(kt, 1, 2)
+        kt = kt.reshape((g * c_out_per_g, c_in // g) + wt.shape[2:])
+        kt = jnp.flip(kt, axis=tuple(range(2, 2 + nd)))
+        dn = jax.lax.conv_dimension_numbers(
+            tuple(a.shape), tuple(kt.shape), (lhs_spec, "OI" + spatial, lhs_spec))
+        y = jax.lax.conv_general_dilated(
+            a, kt.astype(a.dtype), window_strides=(1,) * nd, padding=conv_pad,
+            lhs_dilation=stride, rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=g)
+        if rest:
+            b = rest[0].astype(y.dtype)
+            shape = [1] * y.ndim
+            shape[-1 if channel_last else 1] = b.size
+            y = y + b.reshape(shape)
+        return y
+    return dispatch.call(op_name, f, inputs)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose_nd(_t(x), _t(weight),
+                              _t(bias) if bias is not None else None,
+                              stride, padding, output_padding, dilation, groups,
+                              1, data_format == "NLC", output_size,
+                              "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose_nd(_t(x), _t(weight),
+                              _t(bias) if bias is not None else None,
+                              stride, padding, output_padding, dilation, groups,
+                              2, data_format == "NHWC", output_size,
+                              "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose_nd(_t(x), _t(weight),
+                              _t(bias) if bias is not None else None,
+                              stride, padding, output_padding, dilation, groups,
+                              3, data_format == "NDHWC", output_size,
+                              "conv3d_transpose")
+
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]

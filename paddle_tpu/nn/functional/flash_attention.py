@@ -1,0 +1,181 @@
+"""Attention functionals.
+
+Reference: python/paddle/nn/functional/flash_attention.py (flash_attention
+:147, flash_attn_unpadded :455, scaled_dot_product_attention :722) backed by
+the third_party/flashattn CUDA library. TPU-native: a Pallas flash-attention
+kernel (paddle_tpu/ops/pallas/flash_attention.py) on TPU backends, with an
+XLA-fused reference path everywhere else (CPU tests, capture tracing).
+
+Layout follows the reference: q/k/v are (batch, seq, num_heads, head_dim).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _use_pallas():
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _sdpa_xla(q, k, v, bias=None, causal=False, dropout_p=0.0, key=None,
+              scale=None):
+    """Reference-path attention in BSHD layout; fp32 softmax accumulator."""
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.einsum("bshd,bthd->bhst", q, k) * sc
+    logits = qt.astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), k=t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1 - dropout_p), 0.0)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    q, k, v = _t(query), _t(key), _t(value)
+    drop_key = None
+    if dropout > 0.0 and training:
+        from ...core.generator import next_key
+        drop_key = next_key()
+
+    if _use_pallas() and dropout == 0.0:
+        from ...ops.pallas.flash_attention import flash_attention_fwd
+
+        def f(qa, ka, va):
+            return flash_attention_fwd(qa, ka, va, causal=causal)
+        out = dispatch.call("flash_attention", f, [q, k, v])
+    else:
+        def f(qa, ka, va):
+            return _sdpa_xla(qa, ka, va, causal=causal,
+                             dropout_p=dropout if training else 0.0,
+                             key=drop_key)
+        out = dispatch.call("flash_attention", f, [q, k, v])
+    return out, None
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    q, k, v = _t(query), _t(key), _t(value)
+    inputs = [q, k, v]
+    has_mask = attn_mask is not None
+    if has_mask:
+        inputs.append(_t(attn_mask))
+    drop_key = None
+    if dropout_p > 0.0 and training:
+        from ...core.generator import next_key
+        drop_key = next_key()
+
+    if _use_pallas() and not has_mask and dropout_p == 0.0:
+        from ...ops.pallas.flash_attention import flash_attention_fwd
+
+        def f(qa, ka, va):
+            return flash_attention_fwd(qa, ka, va, causal=is_causal)
+        return dispatch.call("scaled_dot_product_attention", f, [q, k, v])
+
+    def f(qa, ka, va, *mask):
+        bias = mask[0] if mask else None
+        if bias is not None and jnp.issubdtype(bias.dtype, jnp.bool_):
+            bias = jnp.where(bias, 0.0, -1e30)
+        return _sdpa_xla(qa, ka, va, bias=bias, causal=is_causal,
+                         dropout_p=dropout_p if training else 0.0,
+                         key=drop_key)
+    return dispatch.call("scaled_dot_product_attention", f, inputs,
+                         differentiable_mask=[True, True, True]
+                         + [False] * has_mask)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    """Varlen attention over packed (total_tokens, heads, dim) tensors.
+    Implemented by segment-masked attention: positions attend only within
+    their own sequence (reference flash_attn_unpadded :455)."""
+    q, k, v = _t(query), _t(key), _t(value)
+    cq, ck = _t(cu_seqlens_q), _t(cu_seqlens_k)
+
+    def f(qa, ka, va, cqa, cka):
+        tq = qa.shape[0]
+        tk = ka.shape[0]
+        # segment id per token from cumulative seqlens
+        pos_q = jnp.arange(tq)
+        pos_k = jnp.arange(tk)
+        seg_q = jnp.searchsorted(cqa[1:], pos_q, side="right")
+        seg_k = jnp.searchsorted(cka[1:], pos_k, side="right")
+        logits = jnp.einsum("qhd,khd->hqk", qa, ka) * scale
+        logits = logits.astype(jnp.float32)
+        same = seg_q[:, None] == seg_k[None, :]
+        if causal:
+            off_q = pos_q - jnp.take(cqa, seg_q)
+            off_k = pos_k - jnp.take(cka, seg_k)
+            same = same & (off_q[:, None] >= off_k[None, :])
+        logits = jnp.where(same[None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
+        return jnp.einsum("hqk,khd->qhd", probs, va)
+    out = dispatch.call("flash_attn_unpadded", f, [q, k, v, cq, ck],
+                        differentiable_mask=[True, True, True, False, False])
+    return out, None
+
+
+def flash_attention_with_sparse_mask(query, key, value,
+                                     attn_mask_start_row_indices,
+                                     attn_mask_start_row=0, dropout_p=0.0,
+                                     is_causal=True, training=True, name=None):
+    """Sparse-mask attention (reference :844): rows below a per-column start
+    index are masked out in addition to the causal structure."""
+    q, k, v = _t(query), _t(key), _t(value)
+    idx = _t(attn_mask_start_row_indices)
+
+    def f(qa, ka, va, ia):
+        sc = 1.0 / math.sqrt(qa.shape[-1])
+        logits = jnp.einsum("bshd,bthd->bhst", qa, ka) * sc
+        logits = logits.astype(jnp.float32)
+        s, t = logits.shape[-2], logits.shape[-1]
+        rows = jnp.arange(s)[:, None]
+        cols = jnp.arange(t)[None, :]
+        mask = rows >= cols if is_causal else jnp.ones((s, t), bool)
+        # ia: (batch, num_heads, seq) start row per column
+        start = ia[:, :, None, :]
+        mask = mask[None, None] & (rows[None, None] < start)
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
+        return jnp.einsum("bhst,bthd->bshd", probs, va)
+    return dispatch.call("flash_attention_with_sparse_mask", f, [q, k, v, idx],
+                         differentiable_mask=[True, True, True, False])
+
+
+def sdp_kernel(*args, **kwargs):
+    class _Null:
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *a):
+            return False
+    return _Null()
+
+
+__all__ = ["flash_attention", "scaled_dot_product_attention",
+           "flash_attn_unpadded", "flash_attention_with_sparse_mask",
+           "sdp_kernel"]

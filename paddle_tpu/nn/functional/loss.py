@@ -1,0 +1,335 @@
+"""Loss functionals.
+
+Reference: python/paddle/nn/functional/loss.py (cross_entropy at its heart is
+phi softmax_with_cross_entropy). Labels are non-differentiable inputs; the
+dispatcher routes float0 cotangents around them automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _reduce(val, reduction, weight_sum=None):
+    if reduction == "none":
+        return val
+    if reduction == "sum":
+        return jnp.sum(val)
+    if weight_sum is not None:
+        return jnp.sum(val) / weight_sum
+    return jnp.mean(val)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    """softmax+CE in one fused lowering (reference: loss.py cross_entropy →
+    _C_ops.cross_entropy_with_softmax)."""
+    input, label = _t(input), _t(label)
+    inputs = [input, label]
+    has_w = weight is not None
+    if has_w:
+        inputs.append(_t(weight))
+
+    def f(logits, lab, *w):
+        ax = axis if axis >= 0 else logits.ndim + axis
+        c = logits.shape[ax]
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
+        else:
+            logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-15, 1.0))
+        if soft_label or (lab.ndim == logits.ndim and lab.shape[ax] == c
+                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / c
+            loss = -jnp.sum(soft * logp, axis=ax)
+            if has_w:
+                wvec = w[0].astype(jnp.float32)
+                loss = loss * jnp.sum(soft * wvec.reshape(
+                    [1] * ax + [c] + [1] * (logits.ndim - ax - 1)), axis=ax)
+            return _reduce(loss, reduction)
+        lab_i = lab.astype(jnp.int32)
+        if lab_i.ndim == logits.ndim and lab_i.shape[ax] == 1:
+            lab_i = jnp.squeeze(lab_i, axis=ax)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, ax),
+                                     axis=ax)
+        nll = -jnp.squeeze(picked, axis=ax)
+        if label_smoothing > 0:
+            smooth = -jnp.mean(logp, axis=ax)
+            nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+        if has_w:
+            wv = jnp.take(w[0].astype(jnp.float32), safe)
+            nll = nll * wv
+            nll = jnp.where(valid, nll, 0.0)
+            if reduction == "mean":
+                return jnp.sum(nll) / jnp.maximum(
+                    jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
+            return _reduce(nll, reduction)
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+        return _reduce(nll, reduction)
+    return dispatch.call("cross_entropy", f, inputs,
+                         differentiable_mask=[True, soft_label] + [False] * has_w)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+    loss = dispatch.call("unsqueeze", lambda a: jnp.expand_dims(a, axis), [loss])
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = _t(input), _t(label)
+    inputs = [input, label]
+    has_w = weight is not None
+    if has_w:
+        inputs.append(_t(weight))
+
+    def f(logp, lab, *w):
+        lab_i = lab.astype(jnp.int32)
+        valid = lab_i != ignore_index
+        safe = jnp.where(valid, lab_i, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        nll = -jnp.squeeze(picked, axis=1)
+        wv = (jnp.take(w[0], safe) if has_w
+              else jnp.ones_like(nll))
+        nll = jnp.where(valid, nll * wv, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(
+                jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
+        return _reduce(nll, reduction)
+    return dispatch.call("nll_loss", f, inputs,
+                         differentiable_mask=[True, False] + [False] * has_w)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return dispatch.call(
+        "mse_loss",
+        lambda a, b: _reduce((a - b.astype(a.dtype)) ** 2, reduction),
+        [_t(input), _t(label)])
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return dispatch.call(
+        "l1_loss",
+        lambda a, b: _reduce(jnp.abs(a - b.astype(a.dtype)), reduction),
+        [_t(input), _t(label)])
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = a - b.astype(a.dtype)
+        ad = jnp.abs(d)
+        val = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(val, reduction)
+    return dispatch.call("smooth_l1_loss", f, [_t(input), _t(label)])
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    inputs = [_t(input), _t(label)]
+    has_w = weight is not None
+    if has_w:
+        inputs.append(_t(weight))
+
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-7)
+        val = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if has_w:
+            val = val * w[0]
+        return _reduce(val, reduction)
+    return dispatch.call("binary_cross_entropy", f, inputs,
+                         differentiable_mask=[True, True] + [False] * has_w)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    inputs = [_t(logit), _t(label)]
+    has_w = weight is not None
+    has_pw = pos_weight is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_pw:
+        inputs.append(_t(pos_weight))
+
+    def f(x, y, *rest):
+        y = y.astype(x.dtype)
+        max_val = jnp.maximum(-x, 0)
+        if has_pw:
+            pw = rest[-1]
+            log_weight = (pw - 1) * y + 1
+            loss = (1 - y) * x + log_weight * (
+                jnp.log(jnp.exp(-max_val) + jnp.exp(-x - max_val)) + max_val)
+        else:
+            loss = (1 - y) * x + max_val + jnp.log(
+                jnp.exp(-max_val) + jnp.exp(-x - max_val))
+        if has_w:
+            loss = loss * rest[0]
+        return _reduce(loss, reduction)
+    return dispatch.call("bce_with_logits", f, inputs,
+                         differentiable_mask=[True, True]
+                         + [False] * (has_w + has_pw))
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            val = jnp.exp(t) * (t - lp)
+        else:
+            tt = jnp.clip(t, 1e-12, None)
+            val = t * (jnp.log(tt) - lp)
+            val = jnp.where(t > 0, val, 0.0)
+        if reduction == "batchmean":
+            return jnp.sum(val) / lp.shape[0]
+        return _reduce(val, reduction)
+    return dispatch.call("kl_div", f, [_t(input), _t(label)])
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+    return dispatch.call("margin_ranking_loss", f,
+                         [_t(input), _t(other), _t(label)],
+                         differentiable_mask=[True, True, False])
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        val = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(val, reduction)
+    return dispatch.call("hinge_embedding_loss", f, [_t(input), _t(label)],
+                         differentiable_mask=[True, False])
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = (jnp.sum(a * b, axis=-1)
+               / jnp.maximum(jnp.linalg.norm(a, axis=-1)
+                             * jnp.linalg.norm(b, axis=-1), 1e-12))
+        val = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(val, reduction)
+    return dispatch.call("cosine_embedding_loss", f,
+                         [_t(input1), _t(input2), _t(label)],
+                         differentiable_mask=[True, True, False])
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_an = jnp.minimum(d_an, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_ap - d_an + margin), reduction)
+    return dispatch.call("triplet_margin_loss", f,
+                         [_t(input), _t(positive), _t(negative)])
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    inputs = [_t(logit), _t(label)]
+    if normalizer is not None:
+        inputs.append(_t(normalizer))
+
+    def f(x, y, *n):
+        p = jax.nn.sigmoid(x)
+        ce = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    return dispatch.call("sigmoid_focal_loss", f, inputs,
+                         differentiable_mask=[True, False]
+                         + [False] * (normalizer is not None))
+
+
+def square_error_cost(input, label):
+    return dispatch.call("square_error_cost",
+                         lambda a, b: (a - b) ** 2, [_t(input), _t(label)])
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard forward algorithm in log space (reference:
+    warpctc binding, python/paddle/nn/functional/loss.py ctc_loss).
+    log_probs: (T, N, C) logits."""
+    lp, lab = _t(log_probs), _t(labels)
+    il, ll = _t(input_lengths), _t(label_lengths)
+
+    def f(logits, labels_, in_len, lab_len):
+        T, N, C = logits.shape
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        S = labels_.shape[1]
+        ext_len = 2 * S + 1
+        # Extended label sequence: blank, l1, blank, l2, ... blank
+        ext = jnp.full((N, ext_len), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(labels_.astype(jnp.int32))
+        neg_inf = -1e30
+        alpha0 = jnp.full((N, ext_len), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1)[:, 0])
+
+        allow_skip = jnp.concatenate([
+            jnp.zeros((N, 2), bool),
+            ext[:, 2:] != ext[:, :-2]], axis=1) & (jnp.arange(ext_len)[None, :] % 2 == 1)
+
+        def step(alpha, t):
+            shifted1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            shifted2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            shifted2 = jnp.where(allow_skip, shifted2, neg_inf)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, shifted1), shifted2)
+            emit = jnp.take_along_axis(logp[t], ext, axis=1)
+            new_alpha = merged + emit
+            new_alpha = jnp.where(t < in_len[:, None], new_alpha, alpha)
+            return new_alpha, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        last = 2 * lab_len.astype(jnp.int32)
+        a_last = jnp.take_along_axis(alpha, last[:, None], axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(
+            alpha, jnp.maximum(last - 1, 0)[:, None], axis=1)[:, 0]
+        ll_total = jnp.logaddexp(a_last, a_prev)
+        loss = -ll_total
+        if norm_by_times:
+            loss = loss / in_len.astype(loss.dtype)
+        return _reduce(loss, reduction)
+    return dispatch.call("ctc_loss", f, [lp, lab, il, ll],
+                         differentiable_mask=[True, False, False, False])
+
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss", "mse_loss",
+    "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+    "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
+    "sigmoid_focal_loss", "square_error_cost", "ctc_loss",
+]

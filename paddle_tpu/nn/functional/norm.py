@@ -1,0 +1,258 @@
+"""Normalization functionals.
+
+Reference: python/paddle/nn/functional/norm.py and the fused kernels
+(paddle/phi/kernels/fusion/gpu/fused_layernorm_kernel.cu, rms_norm). On TPU
+these are plain jnp expressions XLA fuses into single VPU passes; a Pallas
+fused variant exists in paddle_tpu.ops.pallas for the hot path.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    x = _t(x)
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    ndim = len(normalized_shape)
+    axes = tuple(range(x.ndim - ndim, x.ndim))
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_b:
+        inputs.append(_t(bias))
+
+    def f(a, *wb):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        y = (a32 - mean) / jnp.sqrt(var + epsilon)
+        i = 0
+        if has_w:
+            y = y * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            y = y + wb[i].astype(jnp.float32)
+        return y.astype(dt)
+    return dispatch.call("layer_norm", f, inputs)
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
+             name=None):
+    x = _t(x)
+    axis = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    axes = tuple(range(axis, x.ndim))
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_b:
+        inputs.append(_t(bias))
+
+    def f(a, *wb):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        ms = jnp.mean(a32 * a32, axis=axes, keepdims=True)
+        y = a32 * jax_rsqrt(ms + epsilon)
+        i = 0
+        if has_w:
+            y = y * wb[i].astype(jnp.float32)
+            i += 1
+        if has_b:
+            y = y + wb[i].astype(jnp.float32)
+        return y.astype(dt)
+    return dispatch.call("rms_norm", f, inputs)
+
+
+def jax_rsqrt(v):
+    return 1.0 / jnp.sqrt(v)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Reference semantics (python/paddle/nn/functional/norm.py batch_norm):
+    running = momentum*running + (1-momentum)*batch; stats updated in-place."""
+    x = _t(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    ch_axis = x.ndim - 1 if channel_last else (1 if x.ndim > 1 else 0)
+    red_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch = training and not use_global_stats
+
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_b:
+        inputs.append(_t(bias))
+
+    if use_batch:
+        n = int(np.prod([x.shape[i] for i in red_axes]))
+
+        # Single fused pass: normalize AND emit batch stats as extra outputs
+        # (the stats get zero cotangents; the normalization keeps its full
+        # mean/var dependence for correct gradients). Mirrors the reference
+        # kernel's mean_out/variance_out side outputs.
+        def f(a, *wb):
+            dt = a.dtype
+            a32 = a.astype(jnp.float32)
+            mean = jnp.mean(a32, axis=red_axes, keepdims=True)
+            var = jnp.var(a32, axis=red_axes, keepdims=True)
+            y = (a32 - mean) / jnp.sqrt(var + epsilon)
+            y = _affine(y, wb, has_w, has_b, ch_axis, a.ndim)
+            return (y.astype(dt), jnp.squeeze(mean, red_axes),
+                    jnp.squeeze(var, red_axes))
+        out, bm, bv = dispatch.call("batch_norm", f, inputs,
+                                    multi_output=True)
+        unbiased = bv._data * (n / max(n - 1, 1))
+        running_mean.set_value(momentum * running_mean._data.astype(jnp.float32)
+                               + (1 - momentum) * bm._data)
+        running_var.set_value(momentum * running_var._data.astype(jnp.float32)
+                              + (1 - momentum) * unbiased)
+        return out
+
+    rm, rv = running_mean._data, running_var._data
+
+    def f(a, *wb):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        shape = [1] * a.ndim
+        shape[ch_axis] = rm.size
+        y = ((a32 - rm.astype(jnp.float32).reshape(shape))
+             / jnp.sqrt(rv.astype(jnp.float32).reshape(shape) + epsilon))
+        y = _affine(y, wb, has_w, has_b, ch_axis, a.ndim)
+        return y.astype(dt)
+    return dispatch.call("batch_norm", f, inputs)
+
+
+def _affine(y, wb, has_w, has_b, ch_axis, ndim):
+    shape = [1] * ndim
+    i = 0
+    if has_w:
+        shape[ch_axis] = wb[i].size
+        y = y * wb[i].astype(jnp.float32).reshape(shape)
+        i += 1
+    if has_b:
+        shape[ch_axis] = wb[i].size
+        y = y + wb[i].astype(jnp.float32).reshape(shape)
+    return y
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    x = _t(x)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_b:
+        inputs.append(_t(bias))
+
+    def f(a, *wb):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        if channel_last:
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            a32 = jnp.transpose(a32, perm)
+        n, c = a32.shape[:2]
+        spatial = a32.shape[2:]
+        g = a32.reshape((n, num_groups, c // num_groups) + spatial)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        y = ((g - mean) / jnp.sqrt(var + epsilon)).reshape((n, c) + spatial)
+        shape = [1, c] + [1] * len(spatial)
+        i = 0
+        if has_w:
+            y = y * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if has_b:
+            y = y + wb[i].astype(jnp.float32).reshape(shape)
+        if channel_last:
+            inv = (0,) + tuple(range(2, a.ndim)) + (1,)
+            y = jnp.transpose(y, inv)
+        return y.astype(dt)
+    return dispatch.call("group_norm", f, inputs)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    x = _t(x)
+    inputs = [x]
+    has_w = weight is not None
+    has_b = bias is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_b:
+        inputs.append(_t(bias))
+
+    def f(a, *wb):
+        dt = a.dtype
+        a32 = a.astype(jnp.float32)
+        axes = tuple(range(2, a.ndim))
+        mean = jnp.mean(a32, axis=axes, keepdims=True)
+        var = jnp.var(a32, axis=axes, keepdims=True)
+        y = (a32 - mean) / jnp.sqrt(var + eps)
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        i = 0
+        if has_w:
+            y = y * wb[i].astype(jnp.float32).reshape(shape)
+            i += 1
+        if has_b:
+            y = y + wb[i].astype(jnp.float32).reshape(shape)
+        return y.astype(dt)
+    return dispatch.call("instance_norm", f, inputs)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = _t(x)
+
+    def f(a):
+        if p == np.inf:
+            n = jnp.max(jnp.abs(a), axis=axis, keepdims=True)
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return dispatch.call("normalize", f, [x])
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(a):
+        sq = a * a
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        c = a.shape[ch_axis]
+        half = size // 2
+        pad_cfg = [(0, 0)] * a.ndim
+        pad_cfg[ch_axis] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_cfg)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            sl = [slice(None)] * a.ndim
+            sl[ch_axis] = slice(i, i + c)
+            acc = acc + padded[tuple(sl)]
+        return a / ((k + alpha * acc) ** beta)
+    return dispatch.call("local_response_norm", f, [x])
+
+
+__all__ = ["layer_norm", "rms_norm", "batch_norm", "group_norm",
+           "instance_norm", "normalize", "local_response_norm"]

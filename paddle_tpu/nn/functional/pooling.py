@@ -1,0 +1,276 @@
+"""Pooling functionals.
+
+Reference: python/paddle/nn/functional/pooling.py (phi pool kernels,
+paddle/phi/kernels/funcs/pooling.h). TPU-native: ``lax.reduce_window`` — XLA
+lowers it onto the VPU with fused padding; exclusive avg-pool divides by a
+reduce_window over ones. Adaptive pools use the integral-image (cumsum +
+gather) formulation so output shapes stay static for the compiler.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+from .conv import _ntuple, _resolve_padding
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _pool_nd(x, ksize, stride, padding, nd, channel_last, mode,
+             exclusive=True, ceil_mode=False, op_name="pool"):
+    ksize = _ntuple(ksize, nd)
+    stride = _ntuple(stride if stride is not None else ksize, nd)
+    pad = _resolve_padding(padding, nd, (1,) * nd, ksize)
+    if isinstance(pad, str):
+        pad_mode = pad
+        pad = None
+    else:
+        pad_mode = None
+
+    if channel_last:
+        window = (1,) + ksize + (1,)
+        strides = (1,) + stride + (1,)
+        spatial_axes = tuple(range(1, 1 + nd))
+    else:
+        window = (1, 1) + ksize
+        strides = (1, 1) + stride
+        spatial_axes = tuple(range(2, 2 + nd))
+
+    def full_padding(a):
+        if pad_mode == "VALID":
+            return [(0, 0)] * a.ndim
+        if pad_mode == "SAME":
+            cfg = []
+            j = 0
+            for i in range(a.ndim):
+                if i in spatial_axes:
+                    out = -(-a.shape[i] // stride[j])
+                    total = max((out - 1) * stride[j] + ksize[j] - a.shape[i], 0)
+                    cfg.append((total // 2, total - total // 2))
+                    j += 1
+                else:
+                    cfg.append((0, 0))
+            return cfg
+        cfg = [(0, 0)] * a.ndim
+        for j, ax in enumerate(spatial_axes):
+            lo, hi = pad[j]
+            if ceil_mode:
+                size = a.shape[ax] + lo + hi
+                rem = (size - ksize[j]) % stride[j]
+                if rem:
+                    hi += stride[j] - rem
+            cfg[ax] = (lo, hi)
+        return cfg
+
+    def f(a):
+        cfg = full_padding(a)
+        if mode == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window, strides, cfg)
+        s = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, cfg)
+        if exclusive:
+            ones = jnp.ones(a.shape, dtype=a.dtype)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, cfg)
+            return s / cnt
+        return s / float(np.prod(ksize))
+    return dispatch.call(op_name, f, [x])
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool_nd(_t(x), kernel_size, stride, padding, 1, False, "avg",
+                    exclusive, ceil_mode, "avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    if divisor_override is not None:
+        t = _pool_nd(_t(x), kernel_size, stride, padding, 2,
+                     data_format == "NHWC", "avg", False, ceil_mode,
+                     "avg_pool2d")
+        k = float(np.prod(_ntuple(kernel_size, 2)))
+        return dispatch.call("scale", lambda a: a * (k / divisor_override), [t])
+    return _pool_nd(_t(x), kernel_size, stride, padding, 2,
+                    data_format == "NHWC", "avg", exclusive, ceil_mode,
+                    "avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool_nd(_t(x), kernel_size, stride, padding, 3,
+                    data_format == "NDHWC", "avg", exclusive, ceil_mode,
+                    "avg_pool3d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    out = _pool_nd(_t(x), kernel_size, stride, padding, 1, False, "max",
+                   ceil_mode=ceil_mode, op_name="max_pool1d")
+    if return_mask:
+        return out, _max_pool_indices(_t(x), kernel_size, stride, padding, 1, False, ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool_nd(_t(x), kernel_size, stride, padding, 2,
+                   data_format == "NHWC", "max", ceil_mode=ceil_mode,
+                   op_name="max_pool2d")
+    if return_mask:
+        return out, _max_pool_indices(_t(x), kernel_size, stride, padding, 2,
+                                      data_format == "NHWC", ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    out = _pool_nd(_t(x), kernel_size, stride, padding, 3,
+                   data_format == "NDHWC", "max", ceil_mode=ceil_mode,
+                   op_name="max_pool3d")
+    if return_mask:
+        return out, _max_pool_indices(_t(x), kernel_size, stride, padding, 3,
+                                      data_format == "NDHWC", ceil_mode)
+    return out
+
+
+def _max_pool_indices(x, ksize, stride, padding, nd, channel_last,
+                      ceil_mode=False):
+    """Flat spatial argmax per window (reference max_pool return_mask)."""
+    ksize_t = _ntuple(ksize, nd)
+    stride_t = _ntuple(stride if stride is not None else ksize, nd)
+    pad = _resolve_padding(padding, nd, (1,) * nd, ksize_t)
+    if isinstance(pad, str):
+        pad = [(0, 0)] * nd
+
+    def f(a):
+        if channel_last:
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            a = jnp.transpose(a, perm)
+        spatial = a.shape[2:]
+        flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+        flat_idx = jnp.broadcast_to(flat_idx, a.shape).astype(jnp.int32)
+        window = (1, 1) + ksize_t
+        strides = (1, 1) + stride_t
+        eff_pad = []
+        for j in range(nd):
+            lo, hi = pad[j]
+            if ceil_mode:
+                size = spatial[j] + lo + hi
+                rem = (size - ksize_t[j]) % stride_t[j]
+                if rem:
+                    hi += stride_t[j] - rem
+            eff_pad.append((lo, hi))
+        cfg = [(0, 0), (0, 0)] + eff_pad
+
+        def reducer(acc, cur):
+            av, ai = acc
+            cv, ci = cur
+            take = cv > av
+            return jnp.where(take, cv, av), jnp.where(take, ci, ai)
+        init_v = jnp.asarray(-jnp.inf, a.dtype)
+        init_i = jnp.asarray(-1, jnp.int32)
+        _, idx = jax.lax.reduce_window((a, flat_idx), (init_v, init_i), reducer,
+                                       window, strides, cfg)
+        return idx
+    return dispatch.call("max_pool_mask", f, [x],
+                         differentiable_mask=[False])
+
+
+def _adaptive_pool_nd(x, output_size, nd, channel_last, mode, op_name):
+    out = _ntuple(output_size, nd) if output_size is not None else None
+
+    def f(a):
+        if channel_last:
+            perm = (0, a.ndim - 1) + tuple(range(1, a.ndim - 1))
+            a = jnp.transpose(a, perm)
+        spatial = a.shape[2:]
+        osize = tuple(o if o is not None else spatial[i]
+                      for i, o in enumerate(out))
+        y = a
+        if all(spatial[i] % osize[i] == 0 for i in range(nd)):
+            # Fast path: reshape + reduce (static, MXU/VPU friendly).
+            shape = list(a.shape[:2])
+            red_axes = []
+            for i in range(nd):
+                k = spatial[i] // osize[i]
+                shape += [osize[i], k]
+                red_axes.append(len(shape) - 1)
+            y = a.reshape(shape)
+            y = (jnp.max(y, axis=tuple(red_axes)) if mode == "max"
+                 else jnp.mean(y, axis=tuple(red_axes)))
+        else:
+            # General path: per-axis gather of uneven windows.
+            for i in range(nd):
+                ax = 2 + i
+                in_sz, out_sz = spatial[i], osize[i]
+                starts = (np.arange(out_sz) * in_sz) // out_sz
+                ends = -(-((np.arange(out_sz) + 1) * in_sz) // out_sz)
+                max_k = int((ends - starts).max())
+                gather_idx = np.minimum(
+                    starts[:, None] + np.arange(max_k)[None, :], in_sz - 1)
+                valid = (starts[:, None] + np.arange(max_k)[None, :]) < ends[:, None]
+                g = jnp.take(y, jnp.asarray(gather_idx.reshape(-1)), axis=ax)
+                new_shape = g.shape[:ax] + (out_sz, max_k) + g.shape[ax + 1:]
+                g = g.reshape(new_shape)
+                vshape = [1] * g.ndim
+                vshape[ax], vshape[ax + 1] = out_sz, max_k
+                v = jnp.asarray(valid).reshape(vshape)
+                if mode == "max":
+                    g = jnp.where(v, g, -jnp.inf)
+                    y = jnp.max(g, axis=ax + 1)
+                else:
+                    g = jnp.where(v, g, 0.0)
+                    y = jnp.sum(g, axis=ax + 1) / jnp.sum(v, axis=ax + 1)
+        if channel_last:
+            inv = (0,) + tuple(range(2, 2 + nd)) + (1,)
+            y = jnp.transpose(y, inv)
+        return y
+    return dispatch.call(op_name, f, [x])
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd(_t(x), output_size, 1, False, "avg",
+                             "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool_nd(_t(x), output_size, 2, data_format == "NHWC",
+                             "avg", "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(_t(x), output_size, 3, data_format == "NDHWC",
+                             "avg", "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(_t(x), output_size, 1, False, "max",
+                            "adaptive_max_pool1d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(_t(x), output_size, 2, False, "max",
+                            "adaptive_max_pool2d")
+    return (out, None) if return_mask else out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(_t(x), output_size, 3, False, "max",
+                            "adaptive_max_pool3d")
+    return (out, None) if return_mask else out
+
+
+__all__ = [
+    "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
+    "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]

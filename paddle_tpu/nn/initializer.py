@@ -1,0 +1,207 @@
+"""Weight initializers.
+
+Capability parity with the reference's initializer suite (reference:
+python/paddle/nn/initializer/*.py — Constant, Normal, TruncatedNormal,
+Uniform, Xavier*, Kaiming*, Assign, Orthogonal). TPU-native: each initializer
+is a pure function of (shape, dtype, key) using the counter-based global
+generator, so initialization is reproducible from ``paddle.seed`` and usable
+under capture.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.generator import next_key
+from ..core.tensor import Tensor
+
+
+class Initializer:
+    def __call__(self, shape, dtype=dtypes.float32):
+        raise NotImplementedError
+
+    def apply(self, tensor: Tensor):
+        tensor.set_value(self(tensor.shape, tensor.dtype))
+        return tensor
+
+
+def _fan_in_out(shape):
+    shape = list(shape)
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # Linear weight is (in_features, out_features) in the reference.
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        return jnp.full(tuple(shape), self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        return self.mean + self.std * jax.random.normal(
+            next_key(), tuple(shape), dtype=dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated to [mean - a*std, mean + b*std] (default 2 std)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        r = jax.random.truncated_normal(next_key(), self.a, self.b,
+                                        tuple(shape), dtype=dtype)
+        return self.mean + self.std * r
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        return jax.random.uniform(next_key(), tuple(shape), dtype=dtype,
+                                  minval=self.low, maxval=self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(next_key(), tuple(shape), dtype=dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), tuple(shape), dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+def _kaiming_gain(negative_slope, nonlinearity):
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        return math.sqrt(2.0 / (1 + negative_slope ** 2))
+    if nonlinearity in ("tanh",):
+        return 5.0 / 3
+    return 1.0
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        std = _kaiming_gain(self.negative_slope, self.nonlinearity) / math.sqrt(fi)
+        return std * jax.random.normal(next_key(), tuple(shape), dtype=dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        limit = (_kaiming_gain(self.negative_slope, self.nonlinearity)
+                 * math.sqrt(3.0 / fi))
+        return jax.random.uniform(next_key(), tuple(shape), dtype=dtype,
+                                  minval=-limit, maxval=limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._data
+        arr = jnp.asarray(v, dtype=dtype)
+        if tuple(arr.shape) != tuple(shape):
+            arr = arr.reshape(tuple(shape))
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        shape = tuple(shape)
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(next_key(), (max(rows, cols), min(rows, cols)),
+                                 dtype=jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv init (reference nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=dtypes.float32):
+        shape = tuple(shape)
+        arr = np.zeros(shape, dtype=np.float32)
+        out_per_group = shape[0] // self.groups
+        mid = tuple(s // 2 for s in shape[2:])
+        for g in range(self.groups):
+            for i in range(min(out_per_group, shape[1])):
+                arr[(g * out_per_group + i, i) + mid] = 1.0
+        return jnp.asarray(arr, dtype=dtype)
+
+
+# functional aliases matching paddle.nn.initializer module surface
+constant = Constant
+normal = Normal
+uniform = Uniform
+xavier_normal = XavierNormal
+xavier_uniform = XavierUniform
+kaiming_normal = KaimingNormal
+kaiming_uniform = KaimingUniform
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac",
+]

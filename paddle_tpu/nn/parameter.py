@@ -1,0 +1,77 @@
+"""Parameter: a trainable Tensor, plus ParamAttr.
+
+Reference: python/paddle/base/framework.py EagerParamBase (Parameter is a
+Tensor with trainable/optimize attrs); paddle.ParamAttr
+(python/paddle/base/param_attr.py).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+_param_counter = itertools.count()
+
+
+class ParamAttr:
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, regularizer=None,
+                 trainable: bool = True, need_clip: bool = True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if attr is False:
+            return None
+        # an initializer instance used directly as attr
+        return ParamAttr(initializer=attr)
+
+
+class Parameter(Tensor):
+    def __init__(self, data, *, trainable: bool = True, name: Optional[str] = None,
+                 optimize_attr=None, regularizer=None, need_clip: bool = True):
+        super().__init__(data, stop_gradient=not trainable,
+                         name=name or f"param_{next(_param_counter)}",
+                         persistable=True)
+        self.trainable = trainable
+        self.optimize_attr = optimize_attr or {"learning_rate": 1.0}
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        self.is_distributed = False
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def create_parameter(shape, dtype=dtypes.float32, attr=None, is_bias=False,
+                     default_initializer=None) -> Optional[Parameter]:
+    """Materialize a Parameter per attr/initializer precedence
+    (reference: python/paddle/nn/layer/layers.py create_parameter)."""
+    from . import initializer as I
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is None:
+        return None
+    init = attr.initializer or default_initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    dtype = dtypes.convert_dtype(dtype)
+    data = init(shape, dtype)
+    p = Parameter(data, trainable=attr.trainable, name=attr.name)
+    p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p

@@ -1,0 +1,68 @@
+"""nn.utils (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = None
+        for g in grads:
+            m = dispatch.call("absmax", lambda a: jnp.max(jnp.abs(a)), [g])
+            total = m if total is None else dispatch.call(
+                "maximum", lambda a, b: jnp.maximum(a, b), [total, m])
+    else:
+        acc = None
+        for g in grads:
+            s = dispatch.call(
+                "norm_pow", lambda a: jnp.sum(jnp.abs(a.astype(jnp.float32))
+                                              ** norm_type), [g])
+            acc = s if acc is None else acc + s
+        total = dispatch.call("norm_root",
+                              lambda a: a ** (1.0 / norm_type), [acc])
+    clip_coef = max_norm / (float(total.numpy()) + 1e-6)
+    if clip_coef < 1:
+        for p in parameters:
+            if p.grad is not None:
+                p.grad._swap_payload(p.grad._data * clip_coef)
+    return total
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    for p in parameters:
+        if p.grad is not None:
+            p.grad._swap_payload(jnp.clip(p.grad._data, -clip_value,
+                                          clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...ops import manipulation
+    return manipulation.concat(
+        [manipulation.reshape(p, [-1]) for p in parameters], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(vec._data[offset:offset + n].reshape(p._data.shape))
+        offset += n
+
+
+def weight_norm(layer, name="weight", dim=0):
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    return layer

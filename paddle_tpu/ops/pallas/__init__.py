@@ -1,0 +1,7 @@
+"""Pallas TPU kernels for the hot ops.
+
+TPU-native replacement for the reference's hand-written fused CUDA kernels
+(reference: paddle/phi/kernels/fusion/gpu/ and third_party/flashattn). Only
+the truly bandwidth/latency-critical ops get kernels here — everything else
+is left to XLA fusion.
+"""

@@ -1,0 +1,166 @@
+"""Flash attention (forward) as a Pallas TPU kernel.
+
+Replaces the reference's FlashAttention-2 CUDA library integration
+(reference: third_party/flashattn; op `flash_attn` at
+paddle/phi/ops/yaml/ops.yaml:1635). Design: online-softmax over KV tiles —
+grid (batch*heads, q_tiles, kv_tiles) with the kv axis innermost so the
+fp32 accumulators in VMEM scratch persist across kv steps; the MXU consumes
+(Bq, d) x (d, Bk) tiles; causal tiles above the diagonal are skipped with
+@pl.when so no FLOPs are spent on masked blocks.
+
+Backward uses recompute-based VJP (standard flash strategy): the saved
+memory is O(B*S*H*d) instead of O(B*H*S^2), and XLA fuses the recomputed
+attention with the gradient matmuls.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                scale, causal, block_q, block_k, seq_q, seq_k):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+    num_kv = pl.num_programs(2)
+    # Bottom-right-aligned causal diagonal (matches tril(..., k=t-s) in the
+    # XLA reference path): query i attends keys <= i + (seq_k - seq_q).
+    causal_offset = seq_k - seq_q
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Skip fully-masked tiles (strictly above the causal diagonal).
+    run = True
+    if causal:
+        run = (kv_idx * block_k
+               <= q_idx * block_q + (block_q - 1) + causal_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]          # (block_q, d)
+        k = k_ref[0]          # (block_k, d)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_k
+        if causal:
+            mask = mask & (q_pos + causal_offset >= k_pos)
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[:]                      # (block_q, 1)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                 # (block_q, block_k)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q=512, block_k=512):
+    """q/k/v: (BH, S, d) -> out (BH, S, d)."""
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, max(s_q, 8))
+    block_k = min(block_k, max(s_k, 8))
+
+    # Pad seq dims to tile multiples and head_dim to the 128-lane width.
+    pad_q = (-s_q) % block_q
+    pad_k = (-s_k) % block_k
+    pad_d = (-d) % 128
+    if pad_q or pad_d:
+        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, pad_d)))
+    if pad_k or pad_d:
+        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, pad_d)))
+        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, pad_d)))
+    sp_q, sp_k, dp = s_q + pad_q, s_k + pad_k, d + pad_d
+
+    grid = (bh, sp_q // block_q, sp_k // block_k)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, seq_q=s_q, seq_k=s_k)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, sp_q, dp), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, dp), jnp.float32),
+        ],
+    )(q, k, v)
+    return out[:, :s_q, :d]
+
+
+def _sdpa_reference(q, k, v, causal, scale):
+    """XLA attention used for the recompute VJP (BSHD layout)."""
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), k=t - s)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, causal, scale):
+    b, s, h, d = q.shape
+    t = k.shape[1]
+    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    out = _flash_fwd_bhsd(qf, kf, vf, causal=causal, scale=scale)
+    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    return _flash_attention(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, causal,
+                                                        scale), q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """Public entry: q/k/v (batch, seq, heads, head_dim)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_attention(q, k, v, causal, scale)

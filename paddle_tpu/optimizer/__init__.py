@@ -1,0 +1,4 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/__init__.py)."""
+from . import lr
+from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
+                        NAdam, Optimizer, RAdam, RMSProp, SGD)

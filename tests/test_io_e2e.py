@@ -1,0 +1,153 @@
+"""DataLoader tests + the LeNet/MNIST end-to-end slice (BASELINE config 1)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer as optim
+from paddle_tpu.io import DataLoader, Dataset, TensorDataset
+
+
+class _SyntheticMNIST(Dataset):
+    """Deterministic separable synthetic 'MNIST' (class-dependent blobs)."""
+
+    def __init__(self, n=256):
+        rng = np.random.RandomState(0)
+        self.labels = rng.randint(0, 10, n)
+        base = rng.randn(10, 1, 28, 28).astype("float32") * 2
+        self.images = (base[self.labels]
+                       + rng.randn(n, 1, 28, 28).astype("float32") * 0.3)
+
+    def __getitem__(self, i):
+        return self.images[i], np.int64(self.labels[i])
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.features = nn.Sequential(
+            nn.Conv2D(1, 6, 3, stride=1, padding=1), nn.ReLU(),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(6, 16, 5, stride=1, padding=0), nn.ReLU(),
+            nn.MaxPool2D(2, 2))
+        self.fc = nn.Sequential(
+            nn.Linear(400, 120), nn.ReLU(),
+            nn.Linear(120, 84), nn.ReLU(),
+            nn.Linear(84, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        from paddle_tpu.ops import manipulation
+        x = manipulation.flatten(x, 1)
+        return self.fc(x)
+
+
+class TestDataLoader:
+    def test_batching_and_order(self):
+        ds = TensorDataset([paddle.to_tensor(np.arange(10, dtype="float32")
+                                             .reshape(10, 1))])
+        dl = DataLoader(ds, batch_size=4, shuffle=False)
+        batches = [b[0].numpy() for b in dl]
+        assert len(batches) == 3
+        np.testing.assert_array_equal(batches[0].ravel(), [0, 1, 2, 3])
+        assert batches[2].shape[0] == 2
+
+    def test_drop_last_and_shuffle(self):
+        ds = _SyntheticMNIST(50)
+        dl = DataLoader(ds, batch_size=8, shuffle=True, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 6
+        assert batches[0][0].shape == [8, 1, 28, 28]
+
+    def test_multiprocess_workers(self):
+        ds = _SyntheticMNIST(64)
+        dl = DataLoader(ds, batch_size=16, num_workers=2)
+        seen = 0
+        for img, lab in dl:
+            assert img.shape[0] == 16
+            seen += img.shape[0]
+        assert seen == 64
+
+    def test_dict_collate(self):
+        class DictDs(Dataset):
+            def __getitem__(self, i):
+                return {"x": np.ones(3, "float32") * i, "y": np.int64(i)}
+
+            def __len__(self):
+                return 8
+
+        dl = DataLoader(DictDs(), batch_size=4)
+        b = next(iter(dl))
+        assert b["x"].shape == [4, 3]
+        assert b["y"].shape == [4]
+
+
+class TestSaveLoad:
+    def test_model_roundtrip(self, tmp_path):
+        net = LeNet()
+        path = str(tmp_path / "lenet.pdparams")
+        paddle.save(net.state_dict(), path)
+        loaded = paddle.load(path)
+        net2 = LeNet()
+        missing, unexpected = net2.set_state_dict(loaded)
+        assert missing == [] and unexpected == []
+        np.testing.assert_array_equal(
+            net.fc[0].weight.numpy(), net2.fc[0].weight.numpy())
+
+    def test_optimizer_state_roundtrip(self, tmp_path):
+        net = nn.Linear(4, 2)
+        opt = optim.Adam(learning_rate=0.01, parameters=net.parameters())
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        loss = F.mse_loss(net(x), paddle.to_tensor(np.zeros((8, 2), "float32")))
+        loss.backward()
+        opt.step()
+        path = str(tmp_path / "opt.pdopt")
+        paddle.save(opt.state_dict(), path)
+        sd = paddle.load(path)
+        assert sd["@step_count"] == 1
+
+    def test_bf16_roundtrip(self, tmp_path):
+        import jax.numpy as jnp
+        t = paddle.Tensor(jnp.ones((3,), dtype=jnp.bfloat16))
+        path = str(tmp_path / "t.pd")
+        paddle.save({"x": t}, path)
+        out = paddle.load(path)["x"]
+        assert out.dtype == paddle.bfloat16
+
+
+class TestLeNetEndToEnd:
+    def test_trains_to_high_accuracy(self):
+        """The minimum end-to-end slice (SURVEY.md §7 stage 4)."""
+        paddle.seed(42)
+        net = LeNet()
+        opt = optim.Adam(learning_rate=1e-3, parameters=net.parameters())
+        ds = _SyntheticMNIST(256)
+        dl = DataLoader(ds, batch_size=32, shuffle=True, drop_last=True)
+        net.train()
+        first_loss = last_loss = None
+        for epoch in range(3):
+            for img, label in dl:
+                logits = net(img)
+                loss = F.cross_entropy(logits, label)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                if first_loss is None:
+                    first_loss = float(loss.numpy())
+                last_loss = float(loss.numpy())
+        assert last_loss < first_loss * 0.5, (first_loss, last_loss)
+
+        # eval accuracy on the training set (separable -> should be high)
+        net.eval()
+        correct = total = 0
+        for img, label in DataLoader(ds, batch_size=64):
+            pred = net(img).numpy().argmax(-1)
+            correct += (pred == label.numpy()).sum()
+            total += len(pred)
+        assert correct / total > 0.9, f"accuracy {correct / total}"

@@ -1,0 +1,267 @@
+"""nn package tests (layer mechanics, functionals vs numpy/torch-free refs).
+
+Mirrors the reference's OpTest-style numeric comparison (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(arr, sg=True):
+    return paddle.to_tensor(np.asarray(arr), stop_gradient=sg)
+
+
+class TestLayerMechanics:
+    def test_parameter_registration(self):
+        l = nn.Linear(4, 3)
+        names = [n for n, _ in l.named_parameters()]
+        assert names == ["weight", "bias"]
+        assert l.weight.shape == [4, 3]
+
+    def test_sublayer_nesting(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = {n for n, _ in net.named_parameters()}
+        assert names == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+        assert len(net.sublayers()) == 2
+
+    def test_state_dict_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sd = net.state_dict()
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        missing, unexpected = net2.set_state_dict(sd)
+        assert missing == [] and unexpected == []
+        np.testing.assert_array_equal(net[0].weight.numpy(),
+                                      net2[0].weight.numpy())
+
+    def test_buffers_in_state_dict(self):
+        bn = nn.BatchNorm2D(4)
+        sd = bn.state_dict()
+        assert "_mean" in sd and "_variance" in sd
+
+    def test_train_eval_mode(self):
+        net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+        net.eval()
+        assert not net[1].training
+        net.train()
+        assert net[1].training
+
+    def test_forward_hooks(self):
+        l = nn.Linear(2, 2)
+        calls = []
+        h1 = l.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+        h2 = l.register_forward_post_hook(
+            lambda layer, inp, out: calls.append("post"))
+        l(t(np.ones((1, 2), "float32")))
+        assert calls == ["pre", "post"]
+        h1.remove()
+        h2.remove()
+        calls.clear()
+        l(t(np.ones((1, 2), "float32")))
+        assert calls == []
+
+    def test_to_dtype(self):
+        l = nn.Linear(2, 2)
+        l.to(dtype="bfloat16")
+        assert l.weight.dtype == paddle.bfloat16
+
+
+class TestFunctionals:
+    def test_linear_matches_numpy(self):
+        x = np.random.randn(3, 4).astype("float32")
+        l = nn.Linear(4, 5)
+        out = l(t(x)).numpy()
+        ref = x @ l.weight.numpy() + l.bias.numpy()
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+    def test_conv2d_matches_naive(self):
+        x = np.random.randn(1, 2, 5, 5).astype("float32")
+        w = np.random.randn(3, 2, 3, 3).astype("float32")
+        out = F.conv2d(t(x), t(w), padding=1).numpy()
+        assert out.shape == (1, 3, 5, 5)
+        # center pixel check vs direct correlation
+        patch = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))[0, :, 1:4, 1:4]
+        np.testing.assert_allclose(out[0, 0, 1, 1],
+                                   np.sum(patch * w[0]), rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_shape_inverts_conv(self):
+        x = np.random.randn(2, 4, 8, 8).astype("float32")
+        w = np.random.randn(4, 6, 3, 3).astype("float32")
+        y = F.conv2d_transpose(t(x), t(w), stride=2, padding=1,
+                               output_padding=1)
+        assert y.shape == [2, 6, 16, 16]
+
+    def test_softmax_cross_entropy_consistency(self):
+        logits = np.random.randn(6, 10).astype("float32")
+        labels = np.random.randint(0, 10, (6,))
+        loss = F.cross_entropy(t(logits), t(labels)).numpy()
+        # manual
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[np.arange(6), labels]).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype("float32")
+        labels = np.array([1, -100, 3, -100])
+        loss = F.cross_entropy(t(logits), t(labels)).numpy()
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = -np.log(p[[0, 2], [1, 3]]).mean()
+        np.testing.assert_allclose(loss, ref, rtol=1e-5, atol=1e-5)
+
+    def test_layer_norm(self):
+        x = np.random.randn(2, 3, 8).astype("float32")
+        ln = nn.LayerNorm(8)
+        out = ln(t(x)).numpy()
+        mu = x.mean(-1, keepdims=True)
+        sd = x.std(-1, keepdims=True)
+        np.testing.assert_allclose(out, (x - mu) / np.sqrt(sd ** 2 + 1e-5),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_rms_norm(self):
+        x = np.random.randn(2, 8).astype("float32")
+        out = F.rms_norm(t(x)).numpy()
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+    def test_batch_norm_train_updates_stats(self):
+        bn = nn.BatchNorm2D(3, momentum=0.9)
+        x = np.random.randn(4, 3, 5, 5).astype("float32") * 2 + 1
+        bn.train()
+        bn(t(x))
+        batch_mean = x.mean(axis=(0, 2, 3))
+        np.testing.assert_allclose(bn._mean.numpy(), 0.1 * batch_mean,
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_batch_norm_eval_uses_running(self):
+        bn = nn.BatchNorm2D(3)
+        bn.eval()
+        x = np.random.randn(2, 3, 4, 4).astype("float32")
+        out = bn(t(x)).numpy()
+        np.testing.assert_allclose(out, x / np.sqrt(1 + 1e-5), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_max_avg_pool(self):
+        x = np.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+        mp = F.max_pool2d(t(x), 2).numpy()
+        np.testing.assert_array_equal(mp[0, 0], [[5, 7], [13, 15]])
+        ap = F.avg_pool2d(t(x), 2).numpy()
+        np.testing.assert_allclose(ap[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+    def test_avg_pool_exclusive_padding(self):
+        x = np.ones((1, 1, 3, 3), "float32")
+        out = F.avg_pool2d(t(x), 2, stride=2, padding=1, exclusive=True).numpy()
+        np.testing.assert_allclose(out, np.ones_like(out))
+
+    def test_adaptive_pool(self):
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        out = F.adaptive_avg_pool2d(t(x), 2).numpy()
+        ref = x.reshape(2, 3, 2, 4, 2, 4).mean(axis=(3, 5))
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+        # non-divisible path
+        out = F.adaptive_avg_pool2d(t(x), 3)
+        assert out.shape == [2, 3, 3, 3]
+
+    def test_dropout_train_eval(self):
+        x = np.ones((100, 100), "float32")
+        train_out = F.dropout(t(x), 0.5, training=True).numpy()
+        assert abs((train_out == 0).mean() - 0.5) < 0.05
+        np.testing.assert_allclose(train_out[train_out != 0], 2.0)
+        eval_out = F.dropout(t(x), 0.5, training=False).numpy()
+        np.testing.assert_array_equal(eval_out, x)
+
+    def test_embedding_grad_and_padding(self):
+        w = t(np.random.randn(10, 4).astype("float32"), sg=False)
+        ids = t(np.array([1, 2, 0, 1]))
+        out = F.embedding(ids, w, padding_idx=0)
+        assert np.allclose(out.numpy()[2], 0)
+        out.backward(t(np.ones((4, 4), "float32")))
+        g = w.grad.numpy()
+        assert np.allclose(g[1], 2.0) and np.allclose(g[2], 1.0)
+        assert np.allclose(g[5], 0.0)
+
+    def test_interpolate_nearest_bilinear(self):
+        x = np.arange(4, dtype="float32").reshape(1, 1, 2, 2)
+        out = F.interpolate(t(x), size=[4, 4], mode="nearest").numpy()
+        assert out.shape == (1, 1, 4, 4)
+        out2 = F.interpolate(t(x), scale_factor=2, mode="bilinear").numpy()
+        assert out2.shape == (1, 1, 4, 4)
+
+    def test_sdpa_matches_naive(self):
+        q = np.random.randn(2, 5, 2, 8).astype("float32")
+        out = F.scaled_dot_product_attention(t(q), t(q), t(q),
+                                             is_causal=False).numpy()
+        # naive
+        qq = q.transpose(0, 2, 1, 3)
+        logits = qq @ qq.transpose(0, 1, 3, 2) / np.sqrt(8)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        ref = (p @ qq).transpose(0, 2, 1, 3)
+        np.testing.assert_allclose(out, ref, rtol=1e-3, atol=1e-3)
+
+    def test_pad_modes(self):
+        x = np.random.randn(1, 1, 3, 3).astype("float32")
+        out = F.pad(t(x), [1, 1, 1, 1]).numpy()
+        assert out.shape == (1, 1, 5, 5)
+        assert out[0, 0, 0, 0] == 0
+
+    def test_pixel_shuffle_roundtrip(self):
+        x = np.random.randn(1, 8, 4, 4).astype("float32")
+        y = F.pixel_shuffle(t(x), 2)
+        z = F.pixel_unshuffle(y, 2).numpy()
+        np.testing.assert_array_equal(z, x)
+
+    def test_activations_finite(self):
+        x = t(np.linspace(-5, 5, 64, dtype="float32").reshape(8, 8))
+        for fn in [F.relu, F.gelu, F.sigmoid, F.tanh, F.silu, F.mish,
+                   F.hardswish, F.softplus, F.elu, F.selu, F.leaky_relu]:
+            out = fn(x).numpy()
+            assert np.all(np.isfinite(out)), fn
+
+
+class TestGradients:
+    def test_linear_grad_numeric(self):
+        np.random.seed(0)
+        x = np.random.randn(3, 4).astype("float32")
+        l = nn.Linear(4, 2)
+        xt = t(x, sg=False)
+        loss = F.mse_loss(l(xt), t(np.zeros((3, 2), "float32")))
+        loss.backward()
+        # numeric grad on one weight element
+        eps = 1e-3
+        w = l.weight.numpy().copy()
+        for (i, j) in [(0, 0), (3, 1)]:
+            wp = w.copy()
+            wp[i, j] += eps
+            lp = float(F.mse_loss(
+                F.linear(t(x), t(wp), l.bias),
+                t(np.zeros((3, 2), "float32"))).numpy())
+            wm = w.copy()
+            wm[i, j] -= eps
+            lm = float(F.mse_loss(
+                F.linear(t(x), t(wm), l.bias),
+                t(np.zeros((3, 2), "float32"))).numpy())
+            num = (lp - lm) / (2 * eps)
+            np.testing.assert_allclose(l.weight.grad.numpy()[i, j], num,
+                                       rtol=1e-2, atol=1e-3)
+
+    def test_conv_grad_flows(self):
+        conv = nn.Conv2D(2, 3, 3, padding=1)
+        x = t(np.random.randn(1, 2, 4, 4).astype("float32"), sg=False)
+        out = conv(x)
+        from paddle_tpu.ops import reduction
+        reduction.sum(out).backward()
+        assert conv.weight.grad is not None
+        assert x.grad is not None and x.grad.shape == [1, 2, 4, 4]

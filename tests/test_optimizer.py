@@ -1,0 +1,229 @@
+"""Optimizer + lr scheduler + amp tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import optimizer as optim
+
+
+def _quadratic_losses(opt_cls, steps=60, **kw):
+    """Minimize ||w - c||^2; return final distance."""
+    target = np.array([1.0, -2.0, 3.0], dtype="float32")
+    w = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+    w.persistable = True
+    from paddle_tpu.nn.parameter import Parameter
+    p = Parameter(w._data)
+    p.stop_gradient = False
+    steps = kw.pop("steps", steps)
+    opt = opt_cls(learning_rate=kw.pop("lr", 0.1), parameters=[p], **kw)
+    for _ in range(steps):
+        diff = p - paddle.to_tensor(target)
+        loss = (diff * diff).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(p.numpy() - target).max()
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("cls,kw", [
+        (optim.SGD, {}),
+        (optim.Momentum, {}),
+        (optim.Adam, {}),
+        (optim.AdamW, {}),
+        (optim.Adagrad, {"lr": 0.5}),
+        (optim.RMSProp, {}),
+        (optim.Adamax, {}),
+        (optim.Lamb, {"lr": 0.05, "steps": 200}),
+        (optim.NAdam, {}),
+        (optim.RAdam, {}),
+    ])
+    def test_converges_on_quadratic(self, cls, kw):
+        err = _quadratic_losses(cls, **kw)
+        assert err < 0.5, f"{cls.__name__} final err {err}"
+
+    def test_adam_matches_reference_formula(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.asarray(np.array([1.0, 2.0], "float32")))
+        p.stop_gradient = False
+        opt = optim.Adam(learning_rate=0.1, parameters=[p])
+        g = np.array([0.5, -0.5], "float32")
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        # step 1: m=0.1g v=0.001g^2, mhat=g, vhat=g^2 -> w -= lr*g/(|g|+eps)
+        expect = np.array([1.0, 2.0]) - 0.1 * g / (np.abs(g) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), expect, rtol=1e-5, atol=1e-6)
+
+    def test_weight_decay_l2(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.asarray(np.array([2.0], "float32")))
+        p.stop_gradient = False
+        opt = optim.SGD(learning_rate=0.1, parameters=[p], weight_decay=0.5)
+        p.grad = paddle.to_tensor(np.array([0.0], "float32"))
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), [2.0 - 0.1 * 0.5 * 2.0])
+
+    def test_grad_clip_global_norm(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.asarray(np.zeros(4, "float32")))
+        p.stop_gradient = False
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = optim.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        p.grad = paddle.to_tensor(np.full(4, 10.0, "float32"))  # norm 20
+        opt.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.asarray(np.ones(2, "float32")), name="w0")
+        p.stop_gradient = False
+        opt = optim.Adam(learning_rate=0.1, parameters=[p])
+        p.grad = paddle.to_tensor(np.ones(2, "float32"))
+        opt.step()
+        sd = opt.state_dict()
+        assert "w0_moment1" in sd
+        p2 = Parameter(jnp.asarray(np.ones(2, "float32")), name="w0")
+        p2.stop_gradient = False
+        opt2 = optim.Adam(learning_rate=0.1, parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(
+            opt2._accumulators[id(p2)]["moment1"],
+            opt._accumulators[id(p)]["moment1"])
+
+    def test_multi_precision_master_weights(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.ones(4, dtype=jnp.bfloat16))
+        p.stop_gradient = False
+        opt = optim.AdamW(learning_rate=0.01, parameters=[p],
+                          multi_precision=True)
+        p.grad = paddle.Tensor(jnp.full((4,), 0.001, dtype=jnp.bfloat16))
+        opt.step()
+        assert id(p) in opt._master_weights
+        assert opt._master_weights[id(p)].dtype == np.float32
+        assert p.dtype == paddle.bfloat16
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optim.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        lrs = []
+        for _ in range(5):
+            lrs.append(sched())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_cosine(self):
+        sched = optim.lr.CosineAnnealingDecay(1.0, T_max=10)
+        assert abs(sched() - 1.0) < 1e-6
+        for _ in range(10):
+            sched.step()
+        assert sched() < 1e-6
+
+    def test_warmup(self):
+        sched = optim.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0,
+                                      end_lr=0.1)
+        assert sched() < 0.02
+        for _ in range(12):
+            sched.step()
+        assert abs(sched() - 0.1) < 1e-6
+
+    def test_optimizer_uses_scheduler(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.ones(1))
+        p.stop_gradient = False
+        sched = optim.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+        opt = optim.SGD(learning_rate=sched, parameters=[p])
+        assert opt.get_lr() == 1.0
+        sched.step()
+        assert abs(opt.get_lr() - 0.1) < 1e-9
+
+
+class TestAmp:
+    def test_auto_cast_matmul_bf16(self):
+        from paddle_tpu.ops import linalg
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(level="O1"):
+            y = linalg.matmul(x, x)
+        assert y.dtype == paddle.bfloat16
+        y2 = linalg.matmul(x, x)
+        assert y2.dtype == np.dtype("float32")
+
+    def test_auto_cast_blacklist_stays_fp32(self):
+        x = paddle.to_tensor(np.random.randn(4, 4).astype("float32"))
+        with paddle.amp.auto_cast(level="O1"):
+            y = F.softmax(x)
+        assert y.dtype == np.dtype("float32")
+
+    def test_grad_scaler_scales_and_skips_inf(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        p = Parameter(jnp.ones(2, dtype=jnp.float32))
+        p.stop_gradient = False
+        opt = optim.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0,
+                                       incr_every_n_steps=1000)
+        loss = (p * p).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        # grads are 4x; unscale_ restores and step applies
+        scaler.step(opt)
+        opt.clear_grad()
+        np.testing.assert_allclose(p.numpy(), 1.0 - 0.1 * 2.0, rtol=1e-5)
+        # inf grad skips the step and shrinks the scale
+        before = p.numpy().copy()
+        p.grad = paddle.to_tensor(np.array([np.inf, 1.0], "float32"))
+        scaler.step(opt)
+        np.testing.assert_array_equal(p.numpy(), before)
+        assert scaler._scale == 2.0
+
+    def test_decorate_o2(self):
+        model = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+        from paddle_tpu.nn.parameter import Parameter
+        opt = optim.AdamW(learning_rate=0.01, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2")
+        assert model[0].weight.dtype == paddle.bfloat16
+        assert model[1].weight.dtype == np.dtype("float32")  # LN excluded
+        assert opt._multi_precision
+
+
+class TestDecayExclusion:
+    def test_adamw_apply_decay_param_fun(self):
+        from paddle_tpu.nn.parameter import Parameter
+        import jax.numpy as jnp
+        w = Parameter(jnp.ones(2), name="weight_w")
+        b = Parameter(jnp.ones(2), name="bias_b")
+        for p in (w, b):
+            p.stop_gradient = False
+        opt = optim.AdamW(learning_rate=0.1, parameters=[w, b],
+                          weight_decay=0.5,
+                          apply_decay_param_fun=lambda n: "bias" not in n)
+        z = np.zeros(2, "float32")
+        w.grad = paddle.to_tensor(z)
+        b.grad = paddle.to_tensor(z)
+        opt.step()
+        # zero grads: only decay moves params; bias must be untouched
+        np.testing.assert_allclose(w.numpy(), 1.0 - 0.1 * 0.5, rtol=1e-6)
+        np.testing.assert_allclose(b.numpy(), 1.0, rtol=1e-6)
+
+    def test_linear_warmup_state_roundtrip(self):
+        inner = optim.lr.CosineAnnealingDecay(0.1, T_max=10)
+        sched = optim.lr.LinearWarmup(inner, warmup_steps=3, start_lr=0.0,
+                                      end_lr=0.1)
+        for _ in range(7):
+            sched.step()
+        sd = sched.state_dict()
+        inner2 = optim.lr.CosineAnnealingDecay(0.1, T_max=10)
+        sched2 = optim.lr.LinearWarmup(inner2, warmup_steps=3, start_lr=0.0,
+                                       end_lr=0.1)
+        sched2.set_state_dict(sd)
+        assert abs(sched2() - sched()) < 1e-9
+        assert sched2.lr_sched.last_epoch == sched.lr_sched.last_epoch
