@@ -1,0 +1,28 @@
+"""paddle_tpu.distributed — mesh topology, collectives, auto-parallel.
+
+Reference surface: python/paddle/distributed/__init__.py.
+"""
+from . import mesh
+from .mesh import build_mesh, get_mesh, set_mesh
+from .communication.group import (Group, destroy_process_group,
+                                  get_default_group, is_initialized,
+                                  new_group)
+from .communication.collective import (P2POp, ReduceOp, all_gather,
+                                       all_gather_object, all_reduce,
+                                       all_to_all, alltoall, alltoall_single,
+                                       barrier, batch_isend_irecv, broadcast,
+                                       broadcast_object_list, irecv, isend,
+                                       recv, reduce, reduce_scatter, scatter,
+                                       send, shift_along_axis)
+from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,
+                       init_parallel_env)
+from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                            dtensor_from_fn, reshard, shard_dataloader,
+                            shard_layer, shard_optimizer, shard_tensor)
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """Single-controller SPMD needs no process spawning on one host; run the
+    function directly (multi-host uses the launcher, reference
+    distributed/spawn.py)."""
+    func(*args)

@@ -1,0 +1,290 @@
+"""Auto-parallel dygraph API — DistTensor over jax.Array shardings.
+
+Reference: python/paddle/distributed/auto_parallel/api.py (shard_tensor:131,
+reshard:579, shard_layer:678, shard_optimizer:1353) and C++ DistTensor
+(phi/core/distributed/auto_parallel/dist_tensor.h:39). TPU-native: a
+DistTensor IS a Tensor whose payload carries a NamedSharding; placements map
+to PartitionSpec entries; `reshard` is a sharding-constraint transfer the
+XLA SPMD partitioner lowers to the right collective (the reference needs 14
+hand-written reshard functions — r_to_s, s_to_r, p_to_r, ... — because it
+must pick the collective itself; GSPMD subsumes them).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor, as_tensor
+from .. import mesh as mesh_mod
+
+
+# ----------------------------------------------------------- placements
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicate(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD tracks partial sums internally;
+    at the API level a Partial tensor is materialized by reducing on
+    reshard (reference placement_types.h Partial)."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and \
+            other.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+# ----------------------------------------------------------- ProcessMesh
+class ProcessMesh:
+    """N-D logical process topology (reference:
+    python/paddle/distributed/auto_parallel/process_mesh.py)."""
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape: Optional[Sequence[int]] = None,
+                 process_ids: Optional[Sequence[int]] = None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self._shape = list(arr.shape)
+            self._process_ids = arr.reshape(-1).tolist()
+        else:
+            self._shape = list(shape)
+            self._process_ids = (list(process_ids) if process_ids is not None
+                                 else list(range(int(np.prod(shape)))))
+        self._dim_names = (list(dim_names) if dim_names is not None
+                           else [f"d{i}" for i in range(len(self._shape))])
+        self._jax_mesh: Optional[Mesh] = None
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def get_mesh_with_dim(self, dim_name):
+        idx = self._dim_names.index(dim_name)
+        order = [idx] + [i for i in range(self.ndim) if i != idx]
+        arr = np.asarray(self._process_ids).reshape(self._shape)
+        arr = arr.transpose(order)
+        return ProcessMesh(arr, [self._dim_names[i] for i in order])
+
+    def jax_mesh(self) -> Mesh:
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            dev = np.asarray([devices[i] for i in self._process_ids]) \
+                .reshape(self._shape)
+            self._jax_mesh = Mesh(dev, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def _placements_to_spec(placements: Sequence[Placement], ndim: int,
+                        pmesh: ProcessMesh) -> P:
+    entries: List = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis_name = pmesh.dim_names[mesh_dim]
+            if entries[pl.dim] is None:
+                entries[pl.dim] = axis_name
+            elif isinstance(entries[pl.dim], tuple):
+                entries[pl.dim] = entries[pl.dim] + (axis_name,)
+            else:
+                entries[pl.dim] = (entries[pl.dim], axis_name)
+    return P(*entries)
+
+
+def _spec_to_placements(spec: P, pmesh: ProcessMesh) -> List[Placement]:
+    placements: List[Placement] = [Replicate() for _ in pmesh.dim_names]
+    for tensor_dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[pmesh.dim_names.index(ax)] = Shard(tensor_dim)
+    return placements
+
+
+# ---------------------------------------------------------------- the API
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Distribute a tensor over the process mesh (reference api.py:131)."""
+    t = data if isinstance(data, Tensor) else as_tensor(data, dtype=dtype)
+    spec = _placements_to_spec(placements, t.ndim, mesh)
+    sharding = NamedSharding(mesh.jax_mesh(), spec)
+    arr = jax.device_put(t._data, sharding)
+    out = Tensor(arr, stop_gradient=t.stop_gradient
+                 if stop_gradient is None else stop_gradient,
+                 name=t.name)
+    out.process_mesh = mesh
+    out.placements = list(placements)
+    # keep autograd lineage when resharding a tracked tensor
+    out.grad_node = t.grad_node
+    out.output_index = t.output_index
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements, *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Transfer to a new placement; XLA chooses the collective
+    (reference api.py:579 + the 14 C++ reshard functions)."""
+    has_partial = any(isinstance(p, Partial) for p in placements)
+    if has_partial:
+        raise ValueError("reshard target cannot be Partial")
+    return shard_tensor(dist_tensor, mesh, placements)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Shard a Layer's parameters in-place (reference api.py:678)."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):
+            for pname, p in sublayer._parameters.items():
+                if p is None:
+                    continue
+                st = shard_tensor(p, mesh,
+                                  [Replicate() for _ in mesh.dim_names])
+                p._swap_payload(st._data)
+    for name, sublayer in layer.named_sublayers(include_self=True):
+        shard_fn(name, sublayer, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda l, inp: input_fn(inp, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda l, inp, out: output_fn(out, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    """ZeRO-style optimizer-state sharding hook (reference api.py:1353).
+    States are created lazily; wrap _init_state so new accumulators are
+    placed sharded."""
+    orig_init = optimizer._init_state
+
+    def sharded_init(p):
+        state = orig_init(p)
+        if shard_fn is not None:
+            state = {k: shard_fn(k, p, Tensor(v))._data
+                     for k, v in state.items()}
+        else:
+            pm = getattr(p, "process_mesh", None)
+            placements = getattr(p, "placements", None)
+            if pm is not None and placements is not None:
+                state = {k: shard_tensor(Tensor(v), pm, placements)._data
+                         for k, v in state.items()}
+        return state
+
+    optimizer._init_state = sharded_init
+    return optimizer
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, input_keys=None):
+    """Wrap a DataLoader so yielded batches land sharded on the mesh
+    (reference api.py:2846)."""
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    dim = shard_dims if isinstance(shard_dims, str) else (
+        mesh.dim_names[0] if shard_dims is None else shard_dims)
+
+    class _ShardedLoader:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def __iter__(self):
+            ndim_cache = {}
+            for batch in self._inner:
+                yield self._shard(batch)
+
+        def _shard(self, item):
+            if isinstance(item, Tensor):
+                placements = [Shard(0) if d == dim else Replicate()
+                              for d in mesh.dim_names]
+                return shard_tensor(item, mesh, placements)
+            if isinstance(item, (list, tuple)):
+                return type(item)(self._shard(i) for i in item)
+            if isinstance(item, dict):
+                return {k: self._shard(v) for k, v in item.items()}
+            return item
+
+        def __len__(self):
+            return len(self._inner)
+
+    return _ShardedLoader(dataloader)

@@ -1,0 +1,407 @@
+"""Collective operations.
+
+Reference surface: python/paddle/distributed/communication/ (all_reduce.py,
+all_gather.py, reduce_scatter.py, all_to_all.py, broadcast.py, scatter.py,
+reduce.py) over ProcessGroupNCCL. TPU-native: every collective is a cached
+one-op compiled program — ``shard_map`` over the group's mesh axes with the
+matching ``jax.lax`` collective (psum/all_gather/psum_scatter/all_to_all/
+ppermute) — so eager collectives and in-graph collectives are the same code
+riding ICI (SURVEY.md §5 'Distributed communication backend').
+
+Rank semantics under single-controller SPMD: "rank i's tensor" is shard i of
+a distributed array. A replicated input behaves as every rank holding the
+same value.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+try:
+    from jax import shard_map as _shard_map_raw
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map_raw
+
+
+def shard_map(f, mesh, in_specs, out_specs):
+    """shard_map with the static replication checker off — collective
+    outputs (all_gather/broadcast) are replicated in ways the checker can't
+    infer."""
+    try:
+        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_vma=False)
+    except TypeError:
+        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+from .. import mesh as mesh_mod
+from .group import Group, get_default_group
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _group(group) -> Group:
+    return group if group is not None else get_default_group()
+
+
+def _ensure_on_mesh(arr, mesh):
+    """Give the payload a NamedSharding on `mesh` (replicated if it has
+    none), so shard_map specs line up."""
+    sh = getattr(arr, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh.shape == mesh.shape:
+        return arr, sh.spec
+    arr = jax.device_put(arr, NamedSharding(mesh, P()))
+    return arr, P()
+
+
+def _reduce_fn(op, axes):
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        f = lambda x: jax.lax.psum(x, axes)
+    elif op == ReduceOp.MAX:
+        f = lambda x: jax.lax.pmax(x, axes)
+    elif op == ReduceOp.MIN:
+        f = lambda x: jax.lax.pmin(x, axes)
+    elif op == ReduceOp.PROD:
+        f = lambda x: jnp.exp(jax.lax.psum(jnp.log(x), axes))
+    else:
+        raise ValueError(f"unsupported reduce op {op}")
+    return f
+
+
+@functools.lru_cache(maxsize=512)
+def _build_all_reduce(mesh_key, axes, spec, op):
+    mesh = _MESHES[mesh_key]
+    red = _reduce_fn(op, axes)
+
+    def body(x):
+        y = red(x)
+        if op == ReduceOp.AVG:
+            n = int(np.prod([mesh.shape[a] for a in axes]))
+            y = y / n
+        return y
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+_MESHES = {}
+
+
+def _mesh_key(mesh):
+    key = (id(mesh),)
+    _MESHES[key] = mesh
+    return key
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """In-place sum (or max/min/prod/avg) across the group's axes."""
+    g = _group(group)
+    t = _t(tensor)
+    arr, spec = _ensure_on_mesh(t._data, g.mesh)
+    fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec, op)
+    out = fn(arr)
+    t._swap_payload(out)
+    return t
+
+
+def _strip_axes(spec: P, axes) -> list:
+    """Remove group axes from a PartitionSpec (they become replicated)."""
+    out = []
+    for e in spec:
+        if e is None:
+            out.append(None)
+        elif isinstance(e, tuple):
+            kept = tuple(a for a in e if a not in axes)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        else:
+            out.append(None if e in axes else e)
+    return out
+
+
+@functools.lru_cache(maxsize=512)
+def _build_all_gather(mesh_key, axes, spec):
+    mesh = _MESHES[mesh_key]
+    axis = axes[0] if len(axes) == 1 else axes
+
+    def body(x):
+        return jax.lax.all_gather(x, axis, tiled=False)
+    # gathered result is replicated along the group axes
+    out_spec = P(None, *_strip_axes(spec, axes))
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=out_spec))
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Gather each rank's tensor; fills ``tensor_list`` (reference
+    all_gather.py)."""
+    g = _group(group)
+    t = _t(tensor)
+    arr, spec = _ensure_on_mesh(t._data, g.mesh)
+    fn = _build_all_gather(_mesh_key(g.mesh), g.axes, spec)
+    stacked = fn(arr)                      # (nranks, *global_shape_local)
+    n = stacked.shape[0]
+    if tensor_list is None:
+        tensor_list = []
+    del tensor_list[:]
+    for i in range(n):
+        tensor_list.append(Tensor(stacked[i]))
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Host-side object gather. Single-controller: every 'rank' holds the
+    same object, so this replicates (reference all_gather_object is a
+    pickle-over-NCCL convenience)."""
+    g = _group(group)
+    del object_list[:]
+    object_list.extend([obj] * g.nranks)
+    return object_list
+
+
+@functools.lru_cache(maxsize=512)
+def _build_reduce_scatter(mesh_key, axes, spec, op):
+    mesh = _MESHES[mesh_key]
+    axis = axes[0] if len(axes) == 1 else axes
+
+    def body(x):
+        return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    """Each rank gets its reduced chunk of the concatenated input
+    (reference reduce_scatter.py)."""
+    g = _group(group)
+    src = tensor_or_tensor_list
+    if isinstance(src, (list, tuple)):
+        from ...ops import manipulation
+        src = manipulation.concat([_t(s) for s in src], axis=0)
+    src = _t(src)
+    arr, spec = _ensure_on_mesh(src._data, g.mesh)
+    fn = _build_reduce_scatter(_mesh_key(g.mesh), g.axes, spec, op)
+    out = fn(arr)
+    if tensor is not None:
+        _t(tensor)._swap_payload(out)
+        return tensor
+    return Tensor(out)
+
+
+@functools.lru_cache(maxsize=512)
+def _build_broadcast(mesh_key, axes, spec, src):
+    mesh = _MESHES[mesh_key]
+    axis = axes[0] if len(axes) == 1 else axes
+
+    def body(x):
+        g = jax.lax.all_gather(x, axis, tiled=False)
+        return g[src]
+    # every rank's local shard := src's shard, so the layout is unchanged
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    g = _group(group)
+    t = _t(tensor)
+    src_local = g.get_group_rank(src)
+    if src_local < 0:
+        src_local = src
+    arr, spec = _ensure_on_mesh(t._data, g.mesh)
+    fn = _build_broadcast(_mesh_key(g.mesh), g.axes, spec, src_local)
+    t._swap_payload(fn(arr))
+    return t
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+@functools.lru_cache(maxsize=512)
+def _build_reduce(mesh_key, axes, spec, op):
+    return _build_all_reduce(mesh_key, axes, spec, op)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reduce to dst. SPMD computes the reduction everywhere (a strict
+    superset of the reference semantics where only dst sees the result)."""
+    return all_reduce(tensor, op=op, group=group)
+
+
+@functools.lru_cache(maxsize=512)
+def _build_scatter(mesh_key, axes, spec, src):
+    mesh = _MESHES[mesh_key]
+    axis = axes[0] if len(axes) == 1 else axes
+    n = int(np.prod([mesh.shape[a] for a in axes]))
+
+    def body(x):
+        g = jax.lax.all_gather(x, axis, tiled=False)
+        mine = g[src]                       # src's full tensor
+        chunk = mine.shape[0] // n
+        idx = jax.lax.axis_index(axis)
+        return jax.lax.dynamic_slice_in_dim(mine, idx * chunk, chunk, 0)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
+            sync_op=True):
+    g = _group(group)
+    source = tensor_or_tensor_list
+    if isinstance(source, (list, tuple)):
+        from ...ops import manipulation
+        source = manipulation.concat([_t(s) for s in source], axis=0)
+    source = _t(source) if source is not None else _t(tensor)
+    arr, spec = _ensure_on_mesh(source._data, g.mesh)
+    src_local = g.get_group_rank(src)
+    if src_local < 0:
+        src_local = src
+    fn = _build_scatter(_mesh_key(g.mesh), g.axes, spec, src_local)
+    out = fn(arr)
+    _t(tensor)._swap_payload(out)
+    return tensor
+
+
+@functools.lru_cache(maxsize=512)
+def _build_all_to_all(mesh_key, axes, spec):
+    mesh = _MESHES[mesh_key]
+    axis = axes[0] if len(axes) == 1 else axes
+
+    def body(x):
+        # x local: (n, chunk, ...) — slab j goes to rank j.
+        return jax.lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=False)
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """rank i's j-th input tensor lands as rank j's i-th output
+    (reference all_to_all.py)."""
+    g = _group(group)
+    from ...ops import manipulation
+    stacked = manipulation.stack([_t(x) for x in in_tensor_list], axis=0)
+    arr, spec = _ensure_on_mesh(stacked._data, g.mesh)
+    fn = _build_all_to_all(_mesh_key(g.mesh), g.axes, spec)
+    out = fn(arr)
+    if out_tensor_list is None:
+        out_tensor_list = []
+    del out_tensor_list[:]
+    for i in range(out.shape[0]):
+        out_tensor_list.append(Tensor(out[i]))
+    return out_tensor_list
+
+
+all_to_all = alltoall
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    g = _group(group)
+    t = _t(in_tensor)
+    arr, spec = _ensure_on_mesh(t._data, g.mesh)
+    n = g.nranks
+    reshaped = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+    fn = _build_all_to_all(_mesh_key(g.mesh), g.axes,
+                           P(*([None] + list(spec))))
+    out = fn(reshaped)
+    out = out.reshape((-1,) + out.shape[2:])
+    if out_tensor is not None:
+        _t(out_tensor)._swap_payload(out)
+        return out_tensor
+    return Tensor(out)
+
+
+def barrier(group=None):
+    g = _group(group)
+    tok = Tensor(jnp.zeros(()))
+    all_reduce(tok, group=g)
+    tok.block_until_ready()
+
+
+# --------------------------------------------------------------------- p2p
+class P2POp:
+    """One half of a point-to-point exchange (reference
+    communication/batch_isend_irecv.py P2POp)."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op                # the send/recv function object
+        self.tensor = _t(tensor)
+        self.peer = peer
+        self.group = group
+
+
+def isend(tensor, dst, group=None, sync_op=True):
+    raise RuntimeError(
+        "Single-controller SPMD has no unpaired send: batch the exchange "
+        "with paddle_tpu.distributed.batch_isend_irecv (ppermute), as the "
+        "pipeline runtime does.")
+
+
+def irecv(tensor, src, group=None, sync_op=True):
+    raise RuntimeError(
+        "Single-controller SPMD has no unpaired recv: batch the exchange "
+        "with paddle_tpu.distributed.batch_isend_irecv (ppermute).")
+
+
+send = isend
+recv = irecv
+
+
+@functools.lru_cache(maxsize=512)
+def _build_ppermute(mesh_key, axes, spec, perm):
+    mesh = _MESHES[mesh_key]
+    axis = axes[0] if len(axes) == 1 else axes
+
+    def body(x):
+        return jax.lax.ppermute(x, axis, list(perm))
+    return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
+                             out_specs=spec))
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Pair up sends/recvs into one ppermute over the group axis
+    (reference batch_isend_irecv; PP p2p at
+    fleet/meta_parallel/pp_utils/p2p_communication.py:637)."""
+    sends = [op for op in p2p_op_list if op.op in (isend, "send", "isend")]
+    recvs = [op for op in p2p_op_list if op.op in (irecv, "recv", "irecv")]
+    if not sends:
+        return []
+    g = _group(sends[0].group)
+    # In SPMD every rank executes the same exchange, so the send ops must
+    # describe the whole permutation: op i = "group-rank src_rank (default i)
+    # sends to group-rank peer".
+    perm = tuple((int(getattr(op, "src_rank", i)), int(op.peer))
+                 for i, op in enumerate(sends))
+    t = sends[0].tensor
+    arr, spec = _ensure_on_mesh(t._data, g.mesh)
+    fn = _build_ppermute(_mesh_key(g.mesh), g.axes, spec, perm)
+    out = fn(arr)
+    for op in recvs:
+        op.tensor._swap_payload(out)
+    return []
+
+
+# ------------------------------------------------------- in-graph wrappers
+def shift_along_axis(arr, axis_name, shift, mesh=None):
+    """ppermute helper used by the pipeline runtime inside compiled steps:
+    shard i's value moves to shard (i+shift) mod n."""
+    mesh = mesh or mesh_mod.get_mesh()
+    n = int(mesh.shape[axis_name])
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return jax.lax.ppermute(arr, axis_name, perm)

@@ -1,0 +1,104 @@
+"""Communication groups.
+
+The reference Group is a set of global ranks bound to a communicator
+(reference: python/paddle/distributed/communication/group.py). TPU-native: a
+Group names one or more mesh axes; its "ranks" are coordinates along those
+axes, and every collective compiles to an XLA op reducing over the named
+axes. new_group over explicit rank lists is supported when the ranks form a
+slice of a mesh axis (the only case the hybrid topology produces).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import mesh as mesh_mod
+
+
+class Group:
+    def __init__(self, axes: Sequence[str], mesh=None, ranks=None, gid=0):
+        self.axes = tuple(axes)
+        self._mesh = mesh
+        self._ranks = list(ranks) if ranks is not None else None
+        self.id = gid
+
+    @property
+    def mesh(self):
+        return self._mesh or mesh_mod.get_mesh()
+
+    @property
+    def nranks(self) -> int:
+        return int(np.prod([mesh_mod.axis_size(a) for a in self.axes])) \
+            if self.axes else 1
+
+    world_size = nranks
+
+    @property
+    def ranks(self) -> List[int]:
+        if self._ranks is not None:
+            return self._ranks
+        return list(range(self.nranks))
+
+    def get_group_rank(self, rank: int) -> int:
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_ids(self):
+        return self.ranks
+
+    def __repr__(self):
+        return f"Group(axes={self.axes}, nranks={self.nranks})"
+
+
+_group_counter = itertools.count(1)
+_default_group: Optional[Group] = None
+
+
+def get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        mesh = mesh_mod.get_mesh()
+        _default_group = Group(tuple(mesh.axis_names), mesh=mesh, gid=0)
+    return _default_group
+
+
+def set_default_group(g: Group):
+    global _default_group
+    _default_group = g
+
+
+def new_group(ranks=None, backend=None, timeout=None, axes=None) -> Group:
+    """Create a group. Preferred TPU form: ``new_group(axes=('dp',))``.
+    Rank-list form maps onto the default mesh's flat device order."""
+    if axes is not None:
+        return Group(tuple(axes) if not isinstance(axes, str) else (axes,),
+                     gid=next(_group_counter))
+    mesh = mesh_mod.get_mesh()
+    n = mesh.devices.size
+    if ranks is None or sorted(ranks) == list(range(n)):
+        return Group(tuple(mesh.axis_names), mesh=mesh,
+                     gid=next(_group_counter))
+    # Sub-axis group: find the mesh axis whose slices match the rank list.
+    flat = mesh.devices.reshape(-1)
+    for ax_idx, ax in enumerate(mesh.axis_names):
+        arr = np.arange(n).reshape(mesh.devices.shape)
+        moved = np.moveaxis(arr, ax_idx, -1).reshape(-1, mesh.shape[ax])
+        for row in moved:
+            if sorted(ranks) == sorted(row.tolist()):
+                return Group((ax,), mesh=mesh, ranks=sorted(ranks),
+                             gid=next(_group_counter))
+    # Fallback: treat as a group over all axes with explicit ranks (host
+    # mediated paths may use the rank list).
+    return Group(tuple(mesh.axis_names), mesh=mesh, ranks=list(ranks),
+                 gid=next(_group_counter))
+
+
+def is_initialized() -> bool:
+    return mesh_mod.has_mesh()
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None

@@ -1,0 +1,187 @@
+"""Distributed core tests on the 8-device virtual CPU mesh.
+
+Mirrors the reference's collective API tests (test/collective/
+collective_allreduce_api.py etc. — SURVEY.md §4 mechanism 2), with the
+virtual mesh playing the 8-GPU host.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import (Partial, ProcessMesh, Replicate, Shard,
+                                    ReduceOp)
+
+
+@pytest.fixture(autouse=True)
+def _mesh():
+    dist.set_mesh(dist.build_mesh({"dp": 8}))
+    yield
+
+
+def _ranked(shape_per_rank, n=8):
+    """Build a dim0-sharded tensor whose shard i holds value i."""
+    vals = np.stack([np.full(shape_per_rank, i, "float32") for i in range(n)])
+    mesh = ProcessMesh(list(range(n)), dim_names=["dp"])
+    return dist.shard_tensor(paddle.to_tensor(vals.reshape(
+        (n * shape_per_rank[0],) + shape_per_rank[1:])), mesh, [Shard(0)]), mesh
+
+
+class TestCollectives:
+    def test_all_reduce_sum(self):
+        t, _ = _ranked((1, 4))
+        dist.all_reduce(t)
+        np.testing.assert_allclose(t.numpy(), np.tile(
+            np.full((1, 4), sum(range(8)), "float32"), (8, 1)))
+
+    def test_all_reduce_max(self):
+        t, _ = _ranked((1, 4))
+        dist.all_reduce(t, op=ReduceOp.MAX)
+        np.testing.assert_allclose(t.numpy(), np.full((8, 4), 7.0))
+
+    def test_all_reduce_replicated_semantics(self):
+        x = paddle.to_tensor(np.ones((2, 2), "float32"))
+        dist.all_reduce(x)
+        np.testing.assert_allclose(x.numpy(), np.full((2, 2), 8.0))
+
+    def test_all_gather(self):
+        t, _ = _ranked((2, 3))
+        out = []
+        dist.all_gather(out, t)
+        assert len(out) == 8
+        np.testing.assert_allclose(out[3].numpy(), np.full((2, 3), 3.0))
+
+    def test_reduce_scatter(self):
+        # every rank contributes [0..7]; rank i receives sum of chunk i
+        vals = np.tile(np.arange(8, dtype="float32")[None], (8, 1)).reshape(-1)
+        mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        t = dist.shard_tensor(paddle.to_tensor(vals), mesh, [Shard(0)])
+        out = dist.reduce_scatter(None, t)
+        np.testing.assert_allclose(
+            out.numpy(), np.repeat(np.arange(8) * 8.0, 1))
+
+    def test_broadcast(self):
+        t, _ = _ranked((1, 4))
+        dist.broadcast(t, src=5)
+        np.testing.assert_allclose(t.numpy(), np.full((8, 4), 5.0))
+
+    def test_alltoall(self):
+        # rank i sends tensor full(j) to rank j => rank j receives [full(j)]*8
+        n = 8
+        mesh = ProcessMesh(list(range(n)), dim_names=["dp"])
+        vals = np.stack([np.arange(n, dtype="float32")] * n)  # row i = 0..7
+        # stacked per-rank inputs: shard i (row i) has slabs for each dst
+        stacked = vals.reshape(n * n, 1)
+        t = dist.shard_tensor(paddle.to_tensor(stacked), mesh, [Shard(0)])
+        ins = []
+        from paddle_tpu.ops import manipulation
+        # emulate list-of-tensors API: split the local stacked view
+        out = dist.alltoall_single(None, t)
+        res = out.numpy().reshape(n, n)
+        # rank j's received block = column j of vals = all j's
+        for j in range(n):
+            np.testing.assert_allclose(res[j], np.full(n, j, "float32"))
+
+    def test_barrier_and_groups(self):
+        dist.barrier()
+        g = dist.new_group(axes=("dp",))
+        assert g.nranks == 8
+
+    def test_shift_along_axis_in_graph(self):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+        mesh = dist.get_mesh()
+        x = jax.device_put(jnp.arange(8.0), NamedSharding(mesh, P("dp")))
+        f = jax.jit(shard_map(
+            lambda a: dist.shift_along_axis(a, "dp", 1, mesh),
+            mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp")))
+        out = np.asarray(f(x))
+        np.testing.assert_allclose(out, np.roll(np.arange(8.0), 1))
+
+
+class TestAutoParallel:
+    def test_shard_tensor_placements(self):
+        mesh = ProcessMesh([[0, 1, 2, 3], [4, 5, 6, 7]],
+                           dim_names=["x", "y"])
+        dist.set_mesh(dist.build_mesh({"x": 2, "y": 4}))
+        x = paddle.to_tensor(np.arange(64, dtype="float32").reshape(8, 8))
+        st = dist.shard_tensor(x, mesh, [Shard(0), Shard(1)])
+        assert st.placements == [Shard(0), Shard(1)]
+        sh = st.sharding
+        assert sh is not None
+        np.testing.assert_array_equal(st.numpy(), x.numpy())
+
+    def test_reshard_s_to_r(self):
+        mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(8, 2))
+        st = dist.shard_tensor(x, mesh, [Shard(0)])
+        rt = dist.reshard(st, mesh, [Replicate()])
+        assert rt.placements == [Replicate()]
+        np.testing.assert_array_equal(rt.numpy(), x.numpy())
+
+    def test_shard_layer_replicates_params(self):
+        import paddle_tpu.nn as nn
+        mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        layer = nn.Linear(4, 4)
+        dist.shard_layer(layer, mesh)
+        assert layer.weight.sharding is not None
+
+    def test_sharded_compute_produces_correct_values(self):
+        """Ops on sharded tensors match single-device math (GSPMD)."""
+        mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        x = np.random.randn(16, 4).astype("float32")
+        w = np.random.randn(4, 4).astype("float32")
+        xs = dist.shard_tensor(paddle.to_tensor(x), mesh, [Shard(0)])
+        wt = paddle.to_tensor(w)
+        from paddle_tpu.ops import linalg
+        out = linalg.matmul(xs, wt)
+        np.testing.assert_allclose(out.numpy(), x @ w, rtol=1e-5, atol=1e-5)
+
+    def test_data_parallel_end_to_end(self):
+        """DP training step: sharded batch, replicated params, grads match
+        the single-device run (the reference's EagerReducer correctness
+        contract)."""
+        import paddle_tpu.nn as nn
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        w0 = net.weight.numpy().copy()
+        x = np.random.randn(16, 4).astype("float32")
+        y = np.random.randn(16, 2).astype("float32")
+
+        # single-device reference grads
+        loss_ref = F.mse_loss(net(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss_ref.backward()
+        gref = net.weight.grad.numpy().copy()
+        net.clear_gradients()
+
+        dp = dist.DataParallel(net)
+        loss = F.mse_loss(dp(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        np.testing.assert_allclose(float(loss.numpy()),
+                                   float(loss_ref.numpy()), rtol=1e-5)
+        np.testing.assert_allclose(net.weight.grad.numpy(), gref,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_shard_optimizer_states(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu import optimizer as optim
+        mesh = ProcessMesh(list(range(8)), dim_names=["dp"])
+        layer = nn.Linear(8, 8)
+        # shard weight rows over dp
+        st = dist.shard_tensor(layer.weight, mesh, [Shard(0)])
+        layer.weight._swap_payload(st._data)
+        layer.weight.process_mesh = mesh
+        layer.weight.placements = [Shard(0)]
+        opt = dist.shard_optimizer(
+            optim.Adam(learning_rate=0.1, parameters=layer.parameters()))
+        import paddle_tpu.nn.functional as F
+        x = paddle.to_tensor(np.random.randn(4, 8).astype("float32"))
+        loss = F.mse_loss(layer(x), paddle.to_tensor(
+            np.zeros((4, 8), "float32")))
+        loss.backward()
+        opt.step()
+        m1 = opt._accumulators[id(layer.weight)]["moment1"]
+        assert m1.sharding is not None
