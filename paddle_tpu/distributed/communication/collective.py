@@ -78,7 +78,10 @@ def _reduce_fn(op, axes):
     elif op == ReduceOp.MIN:
         f = lambda x: jax.lax.pmin(x, axes)
     elif op == ReduceOp.PROD:
-        f = lambda x: jnp.exp(jax.lax.psum(jnp.log(x), axes))
+        # True product: gather then multiply (log/exp would NaN on
+        # negatives and zeros).
+        ax = axes[0] if len(axes) == 1 else axes
+        f = lambda x: jnp.prod(jax.lax.all_gather(x, ax, tiled=False), axis=0)
     else:
         raise ValueError(f"unsupported reduce op {op}")
     return f
@@ -177,9 +180,24 @@ def all_gather_object(object_list, obj, group=None):
 def _build_reduce_scatter(mesh_key, axes, spec, op):
     mesh = _MESHES[mesh_key]
     axis = axes[0] if len(axes) == 1 else axes
+    n = int(np.prod([mesh.shape[a] for a in axes]))
 
-    def body(x):
-        return jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        def body(x):
+            y = jax.lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+            if op == ReduceOp.AVG:
+                y = y / n
+            return y
+    else:
+        # MAX/MIN/PROD have no fused scatter primitive: reduce the gathered
+        # copies elementwise, then keep this rank's chunk.
+        red = _reduce_fn(op, axes)
+
+        def body(x):
+            full = red(x)
+            chunk = full.shape[0] // n
+            idx = jax.lax.axis_index(axis)
+            return jax.lax.dynamic_slice_in_dim(full, idx * chunk, chunk, 0)
     return jax.jit(shard_map(body, mesh=mesh, in_specs=(spec,),
                              out_specs=spec))
 
@@ -314,8 +332,14 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
     g = _group(group)
     t = _t(in_tensor)
-    arr, spec = _ensure_on_mesh(t._data, g.mesh)
     n = g.nranks
+    for sizes, label in ((in_split_sizes, "in_split_sizes"),
+                         (out_split_sizes, "out_split_sizes")):
+        if sizes is not None and len(set(int(s) for s in sizes)) > 1:
+            raise NotImplementedError(
+                f"alltoall_single with uneven {label}={list(sizes)} is not "
+                "supported; pad to equal chunks")
+    arr, spec = _ensure_on_mesh(t._data, g.mesh)
     reshaped = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
     fn = _build_all_to_all(_mesh_key(g.mesh), g.axes,
                            P(*([None] + list(spec))))

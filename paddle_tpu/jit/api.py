@@ -16,6 +16,7 @@ import threading
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
@@ -57,37 +58,30 @@ def _wrap(obj):
     return obj
 
 
+def _is_traced_leaf(x):
+    return isinstance(x, (Tensor, jax.Array, np.ndarray))
+
+
 class StaticFunction:
     """Callable wrapper holding the jit cache (reference:
-    dy2static/program_translator.py:329 StaticFunction)."""
+    dy2static/program_translator.py:329 StaticFunction).
+
+    Arguments are partitioned per call: Tensor/array leaves are traced, any
+    other leaf (a Layer, a python scalar, a string attr) is static and keys
+    the jit cache — the guard role of the reference's SOT guards."""
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  backend=None, full_graph=True):
         self._dygraph_fn = fn
         self._input_spec = input_spec
         functools.update_wrapper(self, fn)
-
-        def traced(params_data, args_data, kwargs_data):
-            with _CaptureScope():
-                # rebind parameter payloads to tracers for the trace
-                originals = []
-                for p, d in params_data:
-                    originals.append((p, p._data))
-                    p._data = d
-                try:
-                    args_t = _wrap(args_data)
-                    kwargs_t = _wrap(kwargs_data)
-                    out = fn(*args_t, **kwargs_t)
-                    return _unwrap(out)
-                finally:
-                    for p, d in originals:
-                        p._data = d
-
         self._jitted = None
-        self._traced = traced
+        self._params = None
 
     def _collect_params(self, args):
-        """Find Layer instances bound to the function (self for methods)."""
+        """Find Layer instances bound to the function (self for methods),
+        including buffers (BN running stats) so trace-time set_value on them
+        is threaded back out instead of leaking a tracer."""
         params = []
         owner = getattr(self._dygraph_fn, "__self__", None)
         if owner is not None and hasattr(owner, "parameters"):
@@ -96,20 +90,58 @@ class StaticFunction:
         for a in args:
             if hasattr(a, "parameters") and hasattr(a, "named_buffers"):
                 params.extend(a.parameters())
+                params.extend(b for _, b in a.named_buffers())
         return params
 
     def __call__(self, *args, **kwargs):
         if in_capture_mode():
             return self._dygraph_fn(*args, **kwargs)
         params = self._collect_params(args)
-        pairs = [(p, p._data) for p in params]
+        fn = self._dygraph_fn
+
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        arrays = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
+                  for l in leaves if _is_traced_leaf(l)]
+        statics = tuple((i, l) for i, l in enumerate(leaves)
+                        if not _is_traced_leaf(l))
+
         if self._jitted is None:
-            def jit_target(param_arrays, args_data, kwargs_data):
-                return self._traced(
-                    list(zip(params, param_arrays)), args_data, kwargs_data)
-            self._jitted = jax.jit(jit_target)
-        out = self._jitted([d for _, d in pairs], _unwrap(args),
-                           _unwrap(kwargs))
+            self._params = params
+
+            def jit_target(param_arrays, array_leaves, treedef, statics):
+                static_map = dict(statics)
+                it = iter(array_leaves)
+                full = [static_map[i] if i in static_map else next(it)
+                        for i in range(treedef.num_leaves)]
+                a, k = jax.tree_util.tree_unflatten(treedef, full)
+                with _CaptureScope():
+                    originals = []
+                    for p, d in zip(params, param_arrays):
+                        originals.append((p, p._data))
+                        p._data = d
+                    try:
+                        args_t = _wrap(a)
+                        kwargs_t = _wrap(k)
+                        out = fn(*args_t, **kwargs_t)
+                        # Thread in-place updates (BatchNorm running stats
+                        # via set_value) out of the trace so the caller can
+                        # write them back.
+                        mutated = {i: p._data
+                                   for i, (p, d) in enumerate(
+                                       zip(params, param_arrays))
+                                   if p._data is not d}
+                        return _unwrap(out), mutated
+                    finally:
+                        for p, d in originals:
+                            p._data = d
+
+            self._jitted = jax.jit(jit_target,
+                                   static_argnums=(2, 3))
+        out, mutated = self._jitted([p._data for p in params], arrays,
+                                    treedef, statics)
+        for i, arr in mutated.items():
+            params[i]._swap_payload(arr)
         return _wrap(out)
 
     @property

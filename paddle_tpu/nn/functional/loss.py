@@ -321,6 +321,9 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
         loss = -ll_total
         if norm_by_times:
             loss = loss / in_len.astype(loss.dtype)
+        if reduction == "mean":
+            # Reference semantics (loss.py:1977): mean(loss / label_lengths).
+            return jnp.mean(loss / jnp.maximum(lab_len, 1).astype(loss.dtype))
         return _reduce(loss, reduction)
     return dispatch.call("ctc_loss", f, [lp, lab, il, ll],
                          differentiable_mask=[True, False, False, False])

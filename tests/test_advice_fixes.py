@@ -1,0 +1,82 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.communication.collective import ReduceOp
+
+
+@pytest.fixture
+def mesh8():
+    dist.init_parallel_env({"dp": 8})
+    yield dist.mesh.get_mesh()
+
+
+def test_all_reduce_prod_negative_and_zero(mesh8):
+    # exp(psum(log)) would NaN here; a true product must not.
+    x = paddle.to_tensor(np.array([-2.0, 0.0, 3.0], np.float32))
+    dist.all_reduce(x, op=ReduceOp.PROD)
+    # replicated input: product over 8 identical copies
+    expect = np.array([-2.0, 0.0, 3.0]) ** 8
+    np.testing.assert_allclose(x.numpy(), expect, rtol=1e-5)
+
+
+def test_reduce_scatter_max(mesh8):
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32).reshape(16, 1))
+    out = dist.reduce_scatter(None, x, op=ReduceOp.MAX)
+    # replicated input: max == input; each rank keeps chunk of size 2
+    assert out.shape == [2, 1]
+    np.testing.assert_allclose(out.numpy(), x.numpy()[:2])
+
+
+def test_reduce_scatter_avg(mesh8):
+    x = paddle.to_tensor(np.ones((16, 2), np.float32))
+    out = dist.reduce_scatter(None, x, op=ReduceOp.AVG)
+    np.testing.assert_allclose(out.numpy(), np.ones((2, 2)), rtol=1e-6)
+
+
+def test_alltoall_single_uneven_splits_raises(mesh8):
+    x = paddle.to_tensor(np.zeros((16, 2), np.float32))
+    with pytest.raises(NotImplementedError):
+        dist.alltoall_single(None, x, in_split_sizes=[3, 1, 2, 2, 2, 2, 2, 2])
+
+
+def test_ctc_loss_mean_divides_by_label_length():
+    T, N, C = 12, 2, 5
+    rng = np.random.RandomState(0)
+    logits = paddle.to_tensor(rng.randn(T, N, C).astype(np.float32))
+    labels = paddle.to_tensor(np.array([[1, 2, 3], [2, 4, 0]], np.int32))
+    in_len = paddle.to_tensor(np.array([12, 12], np.int64))
+    lab_len = paddle.to_tensor(np.array([3, 2], np.int64))
+    import paddle_tpu.nn.functional as F
+    none_loss = F.ctc_loss(logits, labels, in_len, lab_len,
+                           reduction="none").numpy()
+    mean_loss = F.ctc_loss(logits, labels, in_len, lab_len,
+                           reduction="mean").numpy()
+    expect = np.mean(none_loss / np.array([3.0, 2.0]))
+    np.testing.assert_allclose(mean_loss, expect, rtol=1e-5)
+
+
+def test_to_static_batchnorm_training_updates_stats():
+    import paddle_tpu.nn as nn
+
+    bn = nn.BatchNorm2D(3)
+    bn.train()
+
+    @paddle.jit.to_static
+    def step(layer, x):
+        return layer(x)
+
+    x = paddle.to_tensor(np.random.RandomState(1).randn(4, 3, 5, 5)
+                         .astype(np.float32))
+    before = bn._mean.numpy().copy()
+    out = step(bn, x)
+    assert out.shape == [4, 3, 5, 5]
+    after = bn._mean.numpy()
+    # running stats moved and did not become tracers
+    assert np.isfinite(after).all()
+    assert not np.allclose(before, after)
+    # a second eager call must not crash on a leaked tracer
+    bn.eval()
+    bn(x)
