@@ -1,0 +1,152 @@
+"""Benchmark driver hook.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures the flagship GPT-2 small (124M) full training step — forward +
+backward + AdamW update compiled as ONE XLA program (the steady-state path)
+— on the available accelerator, and reports tokens/sec plus MFU versus the
+chip's peak bf16 FLOPs. ``vs_baseline`` is our MFU divided by 0.40, the
+published A100 GPT-class MFU reference (BASELINE.md: the reference repo
+publishes no absolute numbers, so external A100 MFU is the bar).
+"""
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# Peak dense bf16 FLOPs/s per chip (public spec sheets).
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
+
+
+def chip_peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "")
+    for name, peak in PEAK_FLOPS.items():
+        if kind.lower().startswith(name.lower()):
+            return peak
+    if device.platform == "tpu":
+        return 275e12
+    return 1e12  # CPU fallback so the math stays finite
+
+
+def main():
+    if os.environ.get("BENCH_SMALL") == "1":
+        # local testing: force the host platform before any backend init
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    small = (not on_tpu) or os.environ.get("BENCH_SMALL") == "1"
+
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if small:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        use_flash_attention=False)
+        batch, seq, iters = 2, 128, 2
+    else:
+        cfg = GPTConfig(max_seq_len=1024)
+        batch, seq, iters = 8, 1024, 5
+
+    model = GPTForCausalLM(cfg)
+    params = [p for p in model.parameters() if not p.stop_gradient]
+
+    # AdamW state as raw arrays: the whole update lives inside the step.
+    b1, b2, eps, wd, lr = 0.9, 0.95, 1e-8, 0.1, 2.5e-4
+    m_state = [jnp.zeros_like(p._data) for p in params]
+    v_state = [jnp.zeros_like(p._data) for p in params]
+
+    ids_np = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
+
+    def train_step(param_arrays, m_st, v_st, step_t, ids):
+        def loss_fn(pa):
+            originals = [p._data for p in params]
+            for p, a in zip(params, pa):
+                p._data = a
+            try:
+                with amp.auto_cast(level="O1", dtype="bfloat16"):
+                    _, loss = model(paddle.Tensor(ids),
+                                    labels=paddle.Tensor(ids))
+                return loss._data.astype(jnp.float32)
+            finally:
+                for p, o in zip(params, originals):
+                    p._data = o
+
+        loss, grads = jax.value_and_grad(loss_fn)(param_arrays)
+        t = step_t.astype(jnp.float32)
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(param_arrays, grads, m_st, v_st):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * g * g
+            m_hat = m / (1 - b1 ** t)
+            v_hat = v / (1 - b2 ** t)
+            p = p * (1 - lr * wd)
+            p = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+            new_p.append(p)
+            new_m.append(m)
+            new_v.append(v)
+        return loss, new_p, new_m, new_v
+
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
+
+    pa = [p._data for p in params]
+    ids = jnp.asarray(ids_np)
+    step_t = jnp.asarray(1, jnp.int32)
+
+    # compile + warmup
+    loss0, pa, m_state, v_state = jitted(pa, m_state, v_state, step_t, ids)
+    jax.block_until_ready(loss0)
+    loss0 = float(loss0)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        loss, pa, m_state, v_state = jitted(
+            pa, m_state, v_state, jnp.asarray(2 + i, jnp.int32), ids)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+    loss_end = float(loss)
+
+    tokens_per_sec = batch * seq / dt
+    n_params = sum(int(np.prod(p.shape)) for p in pa)
+    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
+    achieved = flops_per_token * tokens_per_sec
+    peak = chip_peak_flops(jax.devices()[0])
+    mfu = achieved / peak
+
+    result = {
+        "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
+                  if not small else "gpt_tiny_train_tokens_per_sec",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "step_time_s": round(dt, 4),
+            "mfu": round(mfu, 4),
+            "params": n_params,
+            "device": str(getattr(jax.devices()[0], "device_kind",
+                                  jax.default_backend())),
+            "loss_first": round(loss0, 3),
+            "loss_last": round(loss_end, 3),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -212,6 +212,10 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         from ...ops import manipulation
         src = manipulation.concat([_t(s) for s in src], axis=0)
     src = _t(src)
+    if src._data.shape[0] % g.nranks != 0:
+        raise ValueError(
+            f"reduce_scatter dim 0 ({src._data.shape[0]}) must divide the "
+            f"group size ({g.nranks})")
     arr, spec = _ensure_on_mesh(src._data, g.mesh)
     fn = _build_reduce_scatter(_mesh_key(g.mesh), g.axes, spec, op)
     out = fn(arr)
@@ -335,10 +339,16 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
     n = g.nranks
     for sizes, label in ((in_split_sizes, "in_split_sizes"),
                          (out_split_sizes, "out_split_sizes")):
-        if sizes is not None and len(set(int(s) for s in sizes)) > 1:
+        if sizes is None:
+            continue
+        if len(set(int(s) for s in sizes)) > 1:
             raise NotImplementedError(
                 f"alltoall_single with uneven {label}={list(sizes)} is not "
                 "supported; pad to equal chunks")
+        if len(sizes) != n or sum(int(s) for s in sizes) != t._data.shape[0]:
+            raise ValueError(
+                f"{label}={list(sizes)} must have one entry per rank ({n}) "
+                f"and sum to dim 0 ({t._data.shape[0]})")
     arr, spec = _ensure_on_mesh(t._data, g.mesh)
     reshaped = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
     fn = _build_all_to_all(_mesh_key(g.mesh), g.axes,

@@ -106,10 +106,15 @@ class StaticFunction:
         statics = tuple((i, l) for i, l in enumerate(leaves)
                         if not _is_traced_leaf(l))
 
+        # The live param binding: jit_target reads this at trace time, so a
+        # call with a different layer (new static leaf -> retrace) rebinds
+        # tracers onto THAT call's params rather than the first call's.
+        self._params = params
         if self._jitted is None:
-            self._params = params
+            outer = self
 
             def jit_target(param_arrays, array_leaves, treedef, statics):
+                params = outer._params
                 static_map = dict(statics)
                 it = iter(array_leaves)
                 full = [static_map[i] if i in static_map else next(it)

@@ -1,0 +1,11 @@
+"""Model zoo (reference: python/paddle/vision/models + the GPT fixtures the
+reference uses for auto-parallel tests, test/auto_parallel/get_gpt_model.py).
+These are the BASELINE.md ladder configs: LeNet, ResNet, BERT, GPT, LLaMA.
+"""
+from .lenet import LeNet
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_medium
+
+__all__ = [
+    "LeNet", "GPTConfig", "GPTModel", "GPTForCausalLM",
+    "gpt2_small", "gpt2_medium",
+]
