@@ -21,6 +21,7 @@ from typing import Any, List, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding
 
 from . import dtype as dtypes
 from .place import current_place
@@ -165,12 +166,17 @@ class Tensor:
 
     # -------------------------------------------------------------- mutation
     def set_value(self, value):
-        """In-place overwrite (reference Tensor.set_value)."""
+        """In-place overwrite (reference Tensor.set_value). A sharded
+        payload keeps its NamedSharding — overwriting a TP/ZeRO-sharded
+        parameter re-commits the new value to the same placement."""
         if isinstance(value, Tensor):
             value = value._data
         arr = jnp.asarray(value, dtype=self._data.dtype)
         if tuple(arr.shape) != tuple(self._data.shape):
             arr = jnp.broadcast_to(arr, self._data.shape)
+        sh = getattr(self._data, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            arr = jax.device_put(arr, sh)
         self._data = arr
         return self
 

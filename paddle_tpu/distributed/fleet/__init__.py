@@ -1,0 +1,23 @@
+"""Fleet: the hybrid-parallel trainer package.
+
+Capability parity with the reference Fleet (reference:
+python/paddle/distributed/fleet/ — facade fleet.py:100, TP layers
+layers/mpu/, SP utils, sharding meta-optimizers, pipeline meta-parallel).
+TPU-native: every parallelism axis is a mesh axis; layers shard weights via
+NamedSharding and XLA inserts the collectives.
+"""
+from .mpu import (ColumnParallelLinear, ParallelCrossEntropy,
+                  RowParallelLinear, VocabParallelEmbedding,
+                  get_rng_state_tracker, model_parallel_random_seed, mp_ops,
+                  raw_ops)
+from .sequence_parallel import (ColumnSequenceParallelLinear,
+                                RowSequenceParallelLinear,
+                                mark_as_sequence_parallel_parameter)
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "ColumnSequenceParallelLinear",
+    "RowSequenceParallelLinear", "mark_as_sequence_parallel_parameter",
+    "get_rng_state_tracker", "model_parallel_random_seed",
+    "mp_ops", "raw_ops",
+]

@@ -1,0 +1,13 @@
+"""Model-parallel unit: TP comm ops, layers and RNG trees
+(reference: python/paddle/distributed/fleet/layers/mpu/)."""
+from . import mp_ops, raw_ops
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import (RNGStatesTracker, get_rng_state_tracker,
+                     model_parallel_random_seed)
+
+__all__ = [
+    "mp_ops", "raw_ops", "ColumnParallelLinear", "RowParallelLinear",
+    "VocabParallelEmbedding", "ParallelCrossEntropy", "RNGStatesTracker",
+    "get_rng_state_tracker", "model_parallel_random_seed",
+]

@@ -1,0 +1,93 @@
+"""TP seed trees: per-region RNG state tracking.
+
+Capability parity with the reference's model-parallel RNG tracker
+(reference: python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+random.py — RNGStatesTracker, get_rng_state_tracker, model_parallel_rng
+region). TPU-native: the global Generator is a counter-based threefry
+facade (paddle_tpu.core.generator), so a "state" is (seed, counter); the
+tracker keeps one such state per named region and swaps it in around the
+``rng_state(name)`` context — dropout inside TP blocks draws from the
+model-parallel stream while everything else stays on the global stream.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ....core import generator as gen_mod
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self._states = {}
+        self._seeds = set()
+        self._lock = threading.Lock()
+
+    def reset(self):
+        with self._lock:
+            self._states.clear()
+            self._seeds.clear()
+
+    def get_states_tracker(self):
+        with self._lock:
+            return dict(self._states)
+
+    def set_states_tracker(self, states):
+        with self._lock:
+            self._states = dict(states)
+
+    def add(self, name: str, seed: int):
+        with self._lock:
+            if seed in self._seeds:
+                raise ValueError(f"seed {seed} already exists")
+            if name in self._states:
+                raise ValueError(f"state {name} already exists")
+            self._seeds.add(seed)
+            # state = (seed, counter) of a fresh stream
+            self._states[name] = (int(seed), 0)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        """Swap the global generator to the named stream for the duration."""
+        with self._lock:
+            if name not in self._states:
+                # lazily derive a deterministic per-region seed from the
+                # current global seed (reference requires explicit add();
+                # lazy derivation keeps single-process tests seed-stable)
+                base = gen_mod.default_generator().seed()
+                self._states[name] = ((base ^ hash(name)) & 0x7FFFFFFF, 0)
+            state = self._states[name]
+        g = gen_mod.default_generator()
+        orig = g.get_state()
+        g.set_state(state)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._states[name] = g.get_state()
+            g.set_state(orig)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int, hcg=None):
+    """Seed the global + model-parallel streams per TP rank (reference
+    random.py model_parallel_random_seed). Under single-controller SPMD all
+    shards trace one program, so one derived stream per region suffices —
+    per-shard decorrelation happens inside kernels via fold_in of axis index.
+    """
+    _tracker.reset()
+    gen_mod.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1024)
+
+
+def determinate_seed(name: str = MODEL_PARALLEL_RNG) -> int:
+    with _tracker.rng_state(name):
+        return gen_mod.default_generator().seed()

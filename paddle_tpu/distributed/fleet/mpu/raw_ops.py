@@ -1,0 +1,97 @@
+"""Rank-local differentiable collective pairs for manual-SPMD code.
+
+These are the honest Megatron pairs (reference:
+python/paddle/distributed/fleet/layers/mpu/mp_ops.py — c_identity fwd-id/
+bwd-allreduce etc.), expressed for use **inside shard_map bodies** where a
+mesh axis name is bound and arrays are rank-local shards. Each is a
+``jax.custom_vjp`` so the backward collective is exactly the transpose:
+
+=====================  =====================  =====================
+fn                     forward                backward
+=====================  =====================  =====================
+identity               x                      psum over axis
+all_reduce             psum over axis         identity
+all_gather             all_gather (tiled)     psum_scatter (tiled)
+reduce_scatter         psum_scatter (tiled)   all_gather (tiled)
+=====================  =====================  =====================
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity(x, axis_name):
+    """Forward identity, backward all-reduce (the op before a Megatron
+    column-parallel matmul)."""
+    return x
+
+
+def _identity_fwd(x, axis_name):
+    return x, None
+
+
+def _identity_bwd(axis_name, _, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+identity.defvjp(_identity_fwd, _identity_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def all_reduce(x, axis_name):
+    """Forward all-reduce(sum), backward identity (the op after a Megatron
+    row-parallel matmul)."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _all_reduce_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _all_reduce_bwd(axis_name, _, ct):
+    return (ct,)
+
+
+all_reduce.defvjp(_all_reduce_fwd, _all_reduce_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def all_gather(x, axis_name, dim=0):
+    """Forward tiled all-gather along `dim`, backward reduce-scatter
+    (sequence-parallel gather, reference sequence_parallel_utils.py:85)."""
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _all_gather_fwd(x, axis_name, dim):
+    return jax.lax.all_gather(x, axis_name, axis=dim, tiled=True), None
+
+
+def _all_gather_bwd(axis_name, dim, _, ct):
+    return (jax.lax.psum_scatter(ct, axis_name, scatter_dimension=dim,
+                                 tiled=True),)
+
+
+all_gather.defvjp(_all_gather_fwd, _all_gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def reduce_scatter(x, axis_name, dim=0):
+    """Forward tiled reduce-scatter along `dim`, backward all-gather
+    (sequence-parallel scatter, reference sequence_parallel_utils.py:85)."""
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+                                tiled=True)
+
+
+def _reduce_scatter_fwd(x, axis_name, dim):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=dim,
+                                tiled=True), None
+
+
+def _reduce_scatter_bwd(axis_name, dim, _, ct):
+    return (jax.lax.all_gather(ct, axis_name, axis=dim, tiled=True),)
+
+
+reduce_scatter.defvjp(_reduce_scatter_fwd, _reduce_scatter_bwd)

@@ -1,0 +1,136 @@
+"""Megatron sequence parallelism (SP).
+
+Capability parity with the reference SP utilities (reference:
+python/paddle/distributed/fleet/utils/sequence_parallel_utils.py —
+ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers :85-146,
+ColumnSequenceParallelLinear :427, RowSequenceParallelLinear :562).
+TPU-native: activations between TP regions keep the **sequence dim sharded
+over mp** (a NamedSharding), so LayerNorm/dropout/residual work touches only
+``s/mp`` rows per chip; entering a TP matmul the partitioner all-gathers the
+sequence dim (backward: reduce-scatter), and leaving it reduce-scatters the
+partial sums (backward: all-gather) — the exact Megatron-SP comm pattern,
+scheduled by XLA over ICI with comm/compute overlap.
+
+Global-shape semantics: the sequence axis of our GPT tensors is dim 1
+(batch-first, (b, s, h)); ``seq_axis`` overrides it for (s, b, h) models.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from .. import mesh as mesh_mod
+from .mpu import mp_ops
+from .mpu.mp_layers import _mp_axis, _mp_degree, _shard_param
+from .mpu.random import get_rng_state_tracker
+
+from jax.sharding import PartitionSpec as P
+
+SEQ_AXIS = 1  # (b, s, h) batch-first default
+
+
+def scatter(x, group=None, axis: int = SEQ_AXIS):
+    """Split the sequence dim across mp (reference ScatterOp: fwd scatter,
+    bwd all-gather)."""
+    return mp_ops._c_split(x, group=group, axis=axis)
+
+
+def gather(x, group=None, axis: int = SEQ_AXIS):
+    """Re-gather the sequence dim (reference GatherOp: fwd all-gather, bwd
+    scatter)."""
+    return mp_ops._c_concat(x, group=group, axis=axis)
+
+
+def all_gather(x, group=None, axis: int = SEQ_AXIS):
+    """Sequence all-gather whose backward is a reduce-scatter (reference
+    AllGatherOp)."""
+    return mp_ops._c_allgather_sequence(x, group=group, axis=axis)
+
+
+def reduce_scatter(x, group=None, axis: int = SEQ_AXIS):
+    """Sequence reduce-scatter whose backward is an all-gather (reference
+    ReduceScatterOp)."""
+    return mp_ops._c_reducescatter_sequence(x, group=group, axis=axis)
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Tag a parameter whose grad must be summed over mp (LayerNorm weights
+    inside the SP region — reference sequence_parallel_utils.py:192). Under
+    global-array autodiff the summation is automatic; the tag is kept for
+    checkpoint metadata."""
+    param.sequence_parallel = True
+    return param
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear fed by a sequence-sharded activation
+    (reference sequence_parallel_utils.py:427)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, mp_group=None,
+                 seq_axis: int = SEQ_AXIS, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.seq_axis = seq_axis
+        self.gather_output = gather_output
+        world = _mp_degree(self._axis)
+        if out_features % world != 0:
+            raise ValueError(
+                f"out_features {out_features} must divide mp degree {world}")
+        with get_rng_state_tracker().rng_state("model_parallel_rng"):
+            self.weight = self.create_parameter(
+                [in_features, out_features], attr=weight_attr)
+        _shard_param(self.weight, P(None, self._axis))
+        self.bias = None
+        if has_bias is None or has_bias:
+            self.bias = self.create_parameter([out_features], attr=None,
+                                              is_bias=True)
+            _shard_param(self.bias, P(self._axis))
+
+    def forward(self, x):
+        # seq-sharded -> replicated (all-gather; bwd reduce-scatter)
+        x = all_gather(x, axis=self.seq_axis)
+        y = F.linear(x, self.weight, self.bias)
+        if self.gather_output:
+            return mp_ops._c_concat(y, axis=-1)
+        return mp_ops._c_split(y, axis=-1)
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear emitting a sequence-sharded activation
+    (reference sequence_parallel_utils.py:562)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 seq_axis: int = SEQ_AXIS, name=None):
+        super().__init__()
+        self._axis = _mp_axis(mp_group)
+        self.seq_axis = seq_axis
+        self.input_is_parallel = input_is_parallel
+        world = _mp_degree(self._axis)
+        if in_features % world != 0:
+            raise ValueError(
+                f"in_features {in_features} must divide mp degree {world}")
+        with get_rng_state_tracker().rng_state("model_parallel_rng"):
+            self.weight = self.create_parameter(
+                [in_features, out_features], attr=weight_attr)
+        _shard_param(self.weight, P(self._axis, None))
+        self.bias = None
+        if has_bias:
+            self.bias = self.create_parameter([out_features], attr=None,
+                                              is_bias=True)
+
+    def forward(self, x):
+        if not self.input_is_parallel:
+            x = mp_ops._c_split(x, axis=-1)
+        y = F.linear(x, self.weight)
+        # partial sums -> sequence-sharded (reduce-scatter; bwd all-gather)
+        y = reduce_scatter(y, axis=self.seq_axis)
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+def create_fused_allreduce_gradient_hooks(*a, **k):
+    raise NotImplementedError(
+        "grad-sync hooks are unnecessary under global-array autodiff: "
+        "sequence-parallel parameter grads are reduced by the partitioner")
