@@ -278,7 +278,18 @@ def _leaf_accumulate(t: Tensor, g, input_grads, watched_leaves, accumulate_into_
         if not accumulate_into_leaves:
             return
     acc = _accumulate(t._grad, g)
-    t._grad = acc if isinstance(acc, Tensor) else Tensor(acc)
+    acc = acc if isinstance(acc, Tensor) else Tensor(acc)
+    # ZeRO stage-2/3: a param tagged with a grad sharding stores its grad
+    # reduce-scattered over the sharding axis instead of replicated
+    # (reference group_sharded_stage2.py:46 grad storage; here the shard
+    # placement IS the storage policy and XLA emits the reduce-scatter).
+    gs = getattr(t, "_grad_sharding", None)
+    if gs is not None:
+        if isinstance(acc._data, jax.core.Tracer):
+            acc._data = jax.lax.with_sharding_constraint(acc._data, gs)
+        else:
+            acc._data = jax.device_put(acc._data, gs)
+    t._grad = acc
 
 
 def _vjp_on_tape(node: GradNode, cts):

@@ -19,6 +19,9 @@ from .parallel import (DataParallel, ParallelEnv, get_rank, get_world_size,
 from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
                             dtensor_from_fn, reshard, shard_dataloader,
                             shard_layer, shard_optimizer, shard_tensor)
+from . import fleet
+from . import sharding
+from .fleet.mpu.mp_ops import split
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

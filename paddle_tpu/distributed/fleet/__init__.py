@@ -6,6 +6,13 @@ layers/mpu/, SP utils, sharding meta-optimizers, pipeline meta-parallel).
 TPU-native: every parallelism axis is a mesh axis; layers shard weights via
 NamedSharding and XLA inserts the collectives.
 """
+from .base.distributed_strategy import DistributedStrategy
+from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
+                            ParallelMode, get_hybrid_communicate_group)
+from .fleet_base import Fleet, fleet
+from .meta_optimizers import (DygraphShardingOptimizer,
+                              HybridParallelClipGrad,
+                              HybridParallelOptimizer)
 from .mpu import (ColumnParallelLinear, ParallelCrossEntropy,
                   RowParallelLinear, VocabParallelEmbedding,
                   get_rng_state_tracker, model_parallel_random_seed, mp_ops,
@@ -14,7 +21,20 @@ from .sequence_parallel import (ColumnSequenceParallelLinear,
                                 RowSequenceParallelLinear,
                                 mark_as_sequence_parallel_parameter)
 
+# facade functions bound to the singleton (reference fleet.py module tail)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+collective_perf = fleet.collective_perf
+worker_num = fleet.worker_num
+worker_index = fleet.worker_index
+
 __all__ = [
+    "Fleet", "fleet", "init", "distributed_model", "distributed_optimizer",
+    "DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
+    "ParallelMode", "get_hybrid_communicate_group",
+    "DygraphShardingOptimizer", "HybridParallelOptimizer",
+    "HybridParallelClipGrad", "collective_perf",
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "ColumnSequenceParallelLinear",
     "RowSequenceParallelLinear", "mark_as_sequence_parallel_parameter",

@@ -1,0 +1,5 @@
+from .topology import CommunicateTopology, HybridCommunicateGroup, ParallelMode
+from .distributed_strategy import DistributedStrategy
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode",
+           "DistributedStrategy"]

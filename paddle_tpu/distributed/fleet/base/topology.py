@@ -1,0 +1,277 @@
+"""Hybrid-parallel process topology.
+
+Capability parity with the reference 5-axis topology (reference:
+python/paddle/distributed/fleet/base/topology.py:178 — CommunicateTopology +
+HybridCommunicateGroup building one NCCL subgroup per axis of the
+data/pipe/sharding/sep/model cartesian product). TPU-native: the topology IS
+the global ``jax.sharding.Mesh`` — each axis is a mesh axis, an axis "group"
+is just the axis name, and XLA compiles collectives over those axes onto
+ICI. No communicators are created or warmed up; what remains of the
+reference class is the coordinate bookkeeping (rank <-> coord mapping) and
+the axis-query API the rest of Fleet programs against.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ... import mesh as mesh_mod
+from ...communication.group import Group
+
+# Reference axis order (topology.py hybrid_group_names): data, pipe,
+# sharding, sep, model — mapped onto mesh axis names.
+AXIS_ORDER = ("dp", "pp", "sharding", "sep", "mp")
+_REF_NAMES = {"dp": "data", "pp": "pipe", "sharding": "sharding",
+              "sep": "sep", "mp": "model"}
+
+
+class ParallelMode:
+    """reference base/topology.py ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class CommunicateTopology:
+    """Cartesian rank topology (reference topology.py:60 region).
+
+    Pure coordinate math over the axis dims; world rank is the row-major
+    index in ``AXIS_ORDER``-ordered axes, matching the mesh device layout.
+    """
+
+    def __init__(self, hybrid_group_names: Sequence[str] = AXIS_ORDER,
+                 dims: Sequence[int] = None):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims) if dims is not None else [1] * len(
+            self._parallel_names)
+        self._coord_map = {}
+        self._rank_map = {}
+        for rank, coord in enumerate(np.ndindex(*self._dims)):
+            self._coord_map[tuple(coord)] = rank
+            self._rank_map[rank] = tuple(coord)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord_map[coord]
+
+    def get_coord(self, rank: int) -> Tuple[int, ...]:
+        return self._rank_map[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All world ranks whose coordinate on ``axis_name`` equals index."""
+        axis = self._parallel_names.index(axis_name)
+        return sorted(r for r, c in self._rank_map.items()
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Rank groups that communicate along ``axis_name`` (one group per
+        coordinate of the other axes) — reference get_comm_list."""
+        axis = self._parallel_names.index(axis_name)
+        other_dims = [d for i, d in enumerate(self._dims) if i != axis]
+        groups = []
+        for other in np.ndindex(*other_dims):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, k)
+                ranks.append(self._coord_map[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """Axis-query facade over the global mesh (reference topology.py:178).
+
+    In the reference this creates one NCCL group per axis slice; here each
+    "group" is a :class:`Group` naming a mesh axis, and the rank of this
+    process along an axis is the coordinate of its first addressable device
+    (SPMD: all local devices act in lockstep inside compiled programs).
+    """
+
+    def __init__(self, topology: Optional[CommunicateTopology] = None):
+        mesh = mesh_mod.get_mesh()
+        self._mesh = mesh
+        if topology is None:
+            names = [a for a in AXIS_ORDER if a in mesh.axis_names]
+            names += [a for a in mesh.axis_names if a not in names]
+            dims = [int(mesh.shape[a]) for a in names]
+            topology = CommunicateTopology(names, dims)
+        self._topo = topology
+
+        self._dp_degree = self._axis_size("dp")
+        self._mp_degree = self._axis_size("mp")
+        self._pp_degree = self._axis_size("pp")
+        self._sharding_degree = self._axis_size("sharding")
+        self._sep_degree = self._axis_size("sep")
+
+        self.nranks = int(np.prod([int(mesh.shape[a])
+                                   for a in mesh.axis_names]))
+        self.global_rank = self._global_rank()
+
+    # ------------------------------------------------------------- helpers
+    def _axis_size(self, axis: str) -> int:
+        return int(self._mesh.shape[axis]) if axis in self._mesh.axis_names \
+            else 1
+
+    def _global_rank(self) -> int:
+        import jax
+        try:
+            first = self._mesh.devices.reshape(-1)[0]
+            return int(jax.devices().index(first)) if first in jax.devices() \
+                else 0
+        except Exception:
+            return 0
+
+    def _axis_rank(self, axis: str) -> int:
+        """Coordinate of this process's first device along ``axis``."""
+        if axis not in self._mesh.axis_names:
+            return 0
+        import jax
+        pid = jax.process_index()
+        devs = self._mesh.devices
+        idx = np.argwhere(np.vectorize(
+            lambda d: d.process_index == pid)(devs))
+        if len(idx) == 0:
+            return 0
+        return int(idx[0][list(self._mesh.axis_names).index(axis)])
+
+    def _group(self, axis: str) -> Group:
+        return Group((axis,) if axis in self._mesh.axis_names else (),
+                     mesh=self._mesh)
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    def get_parallel_mode(self) -> int:
+        # reference topology.py:233 region — precedence pp > sharding > mp
+        # > sep > dp
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._sep_degree > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    # ----------------------------------------------------- per-axis queries
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_rank(self) -> int:
+        return self._axis_rank("dp")
+
+    def get_data_parallel_group(self) -> Group:
+        return self._group("dp")
+
+    def get_data_parallel_group_src_rank(self) -> int:
+        return 0
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_rank(self) -> int:
+        return self._axis_rank("mp")
+
+    def get_model_parallel_group(self) -> Group:
+        return self._group("mp")
+
+    def get_model_parallel_group_src_rank(self) -> int:
+        return 0
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_stage_id(self) -> int:
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self._axis_rank("pp")
+
+    def get_pipe_parallel_group(self) -> Group:
+        return self._group("pp")
+
+    def get_p2p_groups(self):
+        return None  # p2p rides ppermute over the pipe axis
+
+    def is_first_stage(self) -> bool:
+        return self.get_stage_id() == 0
+
+    def is_last_stage(self) -> bool:
+        return self.get_stage_id() == self._pp_degree - 1
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._axis_rank("sharding")
+
+    def get_sharding_parallel_group(self) -> Group:
+        return self._group("sharding")
+
+    def get_sharding_parallel_group_src_rank(self) -> int:
+        return 0
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._axis_rank("sep")
+
+    def get_sep_parallel_group(self) -> Group:
+        return self._group("sep")
+
+    # fused-axis groups (reference create_fuse_group / get_dp_sep_... )
+    def get_dp_sep_parallel_group(self) -> Group:
+        axes = [a for a in ("dp", "sep") if a in self._mesh.axis_names]
+        return Group(tuple(axes), mesh=self._mesh)
+
+    def get_pp_mp_parallel_group(self) -> Group:
+        axes = [a for a in ("pp", "mp") if a in self._mesh.axis_names]
+        return Group(tuple(axes), mesh=self._mesh)
+
+    def get_check_parallel_group(self, sharding=False) -> Group:
+        axes = [a for a in (("sharding", "pp", "mp") if sharding
+                            else ("pp", "mp")) if a in self._mesh.axis_names]
+        return Group(tuple(axes), mesh=self._mesh)
+
+    def get_rank_from_stage(self, stage_id: int, **kwargs) -> int:
+        coord = dict(kwargs)
+        names = self._topo.get_hybrid_group_names()
+        full = {}
+        for n in names:
+            if n == "pp":
+                full[n] = stage_id
+            else:
+                full[n] = coord.get(n, 0)
+        return self._topo.get_rank(**full)
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+
+
+def set_hybrid_communicate_group(hcg: HybridCommunicateGroup):
+    global _hcg
+    _hcg = hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg

@@ -1,0 +1,10 @@
+from .parallel_wrappers import (SegmentParallel, ShardingParallel,
+                                TensorParallel)
+from .sharding.group_sharded_stage2 import GroupShardedStage2
+from .sharding.group_sharded_stage3 import GroupShardedStage3
+from .sharding.group_sharded_optimizer_stage2 import \
+    GroupShardedOptimizerStage2
+
+__all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
+           "GroupShardedStage2", "GroupShardedStage3",
+           "GroupShardedOptimizerStage2"]

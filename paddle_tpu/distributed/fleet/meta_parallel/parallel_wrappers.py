@@ -1,0 +1,99 @@
+"""Meta-parallel model wrappers.
+
+Capability parity with the reference wrapper family picked by
+``fleet.distributed_model`` (reference:
+python/paddle/distributed/fleet/model.py:132-151 choosing TensorParallel /
+ShardingParallel / SegmentParallel / PipelineParallel from
+fleet/meta_parallel/). TPU-native: a wrapper's job collapses to (a) placing
+batch inputs on the right global-mesh axes and (b) keeping the paddle
+``state_dict`` surface; grad synchronization is compiled into the programs
+by the SPMD partitioner, and the reference's broadcast-initial-params step
+(hybrid_parallel_util.py:213-275) is unnecessary because params are global
+arrays — every axis sees one consistent value by construction.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from ... import mesh as mesh_mod
+
+# Axes that consume independent batches (dp and — under ZeRO — sharding;
+# reference topology.py fused data-sharding groups).
+_DATA_AXES = ("dp", "sharding")
+
+
+class _MeshInputWrapper(Layer):
+    """Place batch inputs on the global mesh; pass everything through."""
+
+    #: input dim -> mesh axes it is split over
+    _dim_axes = {0: _DATA_AXES}
+
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._mesh = mesh_mod.get_mesh()
+
+    def _input_sharding(self, ndim: int) -> NamedSharding:
+        entries = [None] * ndim
+        for dim, axes in self._dim_axes.items():
+            if dim >= ndim:
+                continue
+            present = tuple(a for a in axes
+                            if a in self._mesh.axis_names
+                            and int(self._mesh.shape[a]) > 1)
+            if present:
+                entries[dim] = present if len(present) > 1 else present[0]
+        return NamedSharding(self._mesh, P(*entries))
+
+    def _shard_input(self, x):
+        if isinstance(x, Tensor):
+            if x.ndim == 0:
+                return x
+            sh = self._input_sharding(x.ndim)
+            if sh.spec == P(*([None] * x.ndim)):
+                return x
+            out = Tensor(jax.device_put(x._data, sh),
+                         stop_gradient=x.stop_gradient, name=x.name)
+            out.grad_node = x.grad_node
+            out.output_index = x.output_index
+            return out
+        if isinstance(x, (list, tuple)):
+            return type(x)(self._shard_input(i) for i in x)
+        if isinstance(x, dict):
+            return {k: self._shard_input(v) for k, v in x.items()}
+        return x
+
+    def forward(self, *inputs, **kwargs):
+        inputs = self._shard_input(inputs)
+        kwargs = self._shard_input(kwargs)
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+
+class TensorParallel(_MeshInputWrapper):
+    """reference meta_parallel/tensor_parallel.py — batch rides the data
+    axes; the mp sharding lives in the mpu layers' weight placements."""
+
+
+class ShardingParallel(_MeshInputWrapper):
+    """reference meta_parallel/sharding_parallel.py — sharding ranks see
+    different batches (the sharding axis is data-like for inputs)."""
+
+
+class SegmentParallel(_MeshInputWrapper):
+    """reference meta_parallel/segment_parallel.py:26 — additionally split
+    the sequence dim (dim 1 of [batch, seq, ...]) across the sep axis."""
+    _dim_axes = {0: _DATA_AXES, 1: ("sep",)}

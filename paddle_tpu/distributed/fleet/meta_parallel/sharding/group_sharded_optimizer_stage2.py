@@ -1,0 +1,34 @@
+"""ZeRO-2 optimizer: sharded states + sharded grad consumption.
+
+Capability parity with the reference GroupShardedOptimizerStage2
+(reference: python/paddle/distributed/fleet/meta_parallel/sharding/
+group_sharded_optimizer_stage2.py:53 — per-rank param segmentation
+``_segment_params``, grad storage management, update of owned shards).
+TPU-native: extends the stage-1 wrapper; gradients arrive already sharded
+over the sharding axis (placed by the param's ``_grad_sharding`` tag at
+accumulation time — the reduce-scatter), so the jitted update consumes
+shard-local grads and never materializes a replicated grad buffer.
+"""
+from __future__ import annotations
+
+from ....fleet.meta_optimizers.dygraph_sharding_optimizer import \
+    DygraphShardingOptimizer
+
+
+class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
+    def __init__(self, params, optim=None, group=None, offload=False,
+                 device="tpu", **kwargs):
+        optimizer = optim if optim is not None else params
+        super().__init__(optimizer,
+                         axis=kwargs.get("axis", "sharding"))
+        self._offload = offload
+        # tag every trainable param so backward stores grads sharded
+        for p in self._parameter_list:
+            sh = self._state_sharding(p)
+            if sh is not None and not p.stop_gradient:
+                p._grad_sharding = sh
+
+    def untag_grads(self):
+        for p in self._parameter_list:
+            if hasattr(p, "_grad_sharding"):
+                del p._grad_sharding

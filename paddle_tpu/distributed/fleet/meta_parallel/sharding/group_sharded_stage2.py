@@ -1,0 +1,30 @@
+"""ZeRO-2 model wrapper.
+
+Capability parity with the reference GroupShardedStage2 (reference:
+python/paddle/distributed/fleet/meta_parallel/sharding/
+group_sharded_stage2.py:46 — grad-reduce hooks into per-rank grad storages,
+overlap management). TPU-native: the wrapper shards batch inputs over the
+data-like axes and relies on the params' ``_grad_sharding`` tags (set by
+GroupShardedOptimizerStage2) to make backward store reduce-scattered
+grads; XLA fuses the scatter into the backward programs.
+"""
+from __future__ import annotations
+
+from ..parallel_wrappers import _MeshInputWrapper
+
+
+class GroupShardedStage2(_MeshInputWrapper):
+    def __init__(self, layer, sharding_optimizer, group=None,
+                 sync_buffers=False, buffer_max_size=2 ** 23,
+                 auto_refresh_trainable=True, device="tpu", **kwargs):
+        super().__init__(layer)
+        self._sharding_optimizers = (
+            sharding_optimizer if isinstance(sharding_optimizer, list)
+            else [sharding_optimizer])
+
+    def to(self, *args, **kwargs):
+        return self
+
+    def clear_gradients(self):
+        for p in self._layers.parameters():
+            p.clear_gradient()

@@ -143,21 +143,36 @@ class Optimizer:
         self._step_count += 1
         lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
         step = jnp.asarray(self._step_count, dtype=jnp.int32)
-        masters = [self._master_weights.get(id(p)) for p in params]
-        states = [self._accumulators[id(p)] for p in params]
-        lr_mults = [float(getattr(p, "optimize_attr", {})
-                          .get("learning_rate", 1.0)) for p in params]
-        wd_flags = [self._wd_flag(p) for p in params]
 
-        new_params, new_masters, new_states = self._jit_step(
-            lr, step, [p._data for p in params], [g._data for g in grads],
-            masters, states, tuple(lr_mults), tuple(wd_flags))
+        # Pipeline parallel places each stage's params on a disjoint
+        # sub-mesh; one XLA program cannot span them, so group params by
+        # device set and run the jitted tree-step per group (one group ==
+        # one program in the common non-PP case).
+        groups: Dict[object, List[int]] = {}
+        for i, p in enumerate(params):
+            sh = getattr(p._data, "sharding", None)
+            key = frozenset(getattr(sh, "device_set", ()) or ())
+            groups.setdefault(key, []).append(i)
 
-        for p, np_, nm, ns in zip(params, new_params, new_masters, new_states):
-            p._swap_payload(np_)
-            if nm is not None:
-                self._master_weights[id(p)] = nm
-            self._accumulators[id(p)] = ns
+        for idxs in groups.values():
+            gp = [params[i] for i in idxs]
+            gg = [grads[i] for i in idxs]
+            masters = [self._master_weights.get(id(p)) for p in gp]
+            states = [self._accumulators[id(p)] for p in gp]
+            lr_mults = [float(getattr(p, "optimize_attr", {})
+                              .get("learning_rate", 1.0)) for p in gp]
+            wd_flags = [self._wd_flag(p) for p in gp]
+
+            new_params, new_masters, new_states = self._jit_step(
+                lr, step, [p._data for p in gp], [g._data for g in gg],
+                masters, states, tuple(lr_mults), tuple(wd_flags))
+
+            for p, np_, nm, ns in zip(gp, new_params, new_masters,
+                                      new_states):
+                p._swap_payload(np_)
+                if nm is not None:
+                    self._master_weights[id(p)] = nm
+                self._accumulators[id(p)] = ns
         self._post_step()
 
     def _post_step(self):
