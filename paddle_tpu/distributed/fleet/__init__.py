@@ -13,6 +13,8 @@ from .fleet_base import Fleet, fleet
 from .meta_optimizers import (DygraphShardingOptimizer,
                               HybridParallelClipGrad,
                               HybridParallelOptimizer)
+from .meta_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
+                            SharedLayerDesc)
 from .mpu import (ColumnParallelLinear, ParallelCrossEntropy,
                   RowParallelLinear, VocabParallelEmbedding,
                   get_rng_state_tracker, model_parallel_random_seed, mp_ops,
@@ -40,4 +42,5 @@ __all__ = [
     "RowSequenceParallelLinear", "mark_as_sequence_parallel_parameter",
     "get_rng_state_tracker", "model_parallel_random_seed",
     "mp_ops", "raw_ops",
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
 ]

@@ -30,8 +30,14 @@ _PP_DEFAULTS = {
 
 
 class DistributedStrategy:
+    @staticmethod
+    def _hybrid_defaults() -> Dict[str, Any]:
+        cfg = dict(_HYBRID_DEFAULTS)
+        cfg["order"] = list(_HYBRID_DEFAULTS["order"])
+        return cfg
+
     def __init__(self):
-        self._hybrid_configs: Dict[str, Any] = dict(_HYBRID_DEFAULTS)
+        self._hybrid_configs: Dict[str, Any] = self._hybrid_defaults()
         self.pipeline_configs: Dict[str, Any] = dict(_PP_DEFAULTS)
         self.amp = False
         self.amp_configs: Dict[str, Any] = {"init_loss_scaling": 32768.0,
@@ -59,7 +65,7 @@ class DistributedStrategy:
 
     @hybrid_configs.setter
     def hybrid_configs(self, configs: Dict[str, Any]):
-        merged = dict(_HYBRID_DEFAULTS)
+        merged = self._hybrid_defaults()
         merged.update(configs or {})
         self._hybrid_configs = merged
 

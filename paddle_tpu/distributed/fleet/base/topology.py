@@ -106,8 +106,9 @@ class HybridCommunicateGroup:
         mesh = mesh_mod.get_mesh()
         self._mesh = mesh
         if topology is None:
-            names = [a for a in AXIS_ORDER if a in mesh.axis_names]
-            names += [a for a in mesh.axis_names if a not in names]
+            # keep the mesh's own axis order so the row-major rank map
+            # matches the device layout exactly (custom orders included)
+            names = list(mesh.axis_names)
             dims = [int(mesh.shape[a]) for a in names]
             topology = CommunicateTopology(names, dims)
         self._topo = topology
@@ -128,11 +129,22 @@ class HybridCommunicateGroup:
             else 1
 
     def _global_rank(self) -> int:
+        """World rank of THIS process: the topology rank at the coordinate
+        of its first addressable device (per-process, unlike the mesh's
+        first device which is the same object on every host)."""
         import jax
         try:
-            first = self._mesh.devices.reshape(-1)[0]
-            return int(jax.devices().index(first)) if first in jax.devices() \
-                else 0
+            pid = jax.process_index()
+            devs = self._mesh.devices
+            idx = np.argwhere(np.vectorize(
+                lambda d: d.process_index == pid)(devs))
+            if len(idx) == 0:
+                return 0
+            coord = dict(zip(self._mesh.axis_names,
+                             (int(c) for c in idx[0])))
+            names = self._topo.get_hybrid_group_names()
+            return self._topo.get_rank(
+                **{n: coord.get(n, 0) for n in names})
         except Exception:
             return 0
 

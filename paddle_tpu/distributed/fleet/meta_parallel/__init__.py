@@ -1,10 +1,15 @@
 from .parallel_wrappers import (SegmentParallel, ShardingParallel,
                                 TensorParallel)
+from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,
+                        SharedLayerDesc)
+from .pipeline_parallel import PipelineParallel, spmd_pipeline
 from .sharding.group_sharded_stage2 import GroupShardedStage2
 from .sharding.group_sharded_stage3 import GroupShardedStage3
 from .sharding.group_sharded_optimizer_stage2 import \
     GroupShardedOptimizerStage2
 
 __all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
+           "LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
+           "PipelineParallel", "spmd_pipeline",
            "GroupShardedStage2", "GroupShardedStage3",
            "GroupShardedOptimizerStage2"]

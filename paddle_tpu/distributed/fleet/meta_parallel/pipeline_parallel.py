@@ -1,0 +1,348 @@
+"""Pipeline-parallel runtime: SPMD micro-batch pipelining over the ``pp``
+mesh axis.
+
+Capability parity with the reference runtime (reference:
+python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py —
+``PipelineParallel``:149, ``train_batch``:392, ``forward_backward_pipeline``
+:459 implementing FThenB/1F1B micro-batch schedules over NCCL p2p;
+interleaved VPP :1010). TPU-native redesign: instead of per-rank Python
+schedulers exchanging tensors with send/recv, the whole pipeline is ONE
+compiled SPMD program —
+
+* stage weights are stacked along a leading axis sharded over ``pp``;
+* a ``lax.scan`` over ``m + S - 1`` ticks rotates micro-batch activations
+  stage→stage+1 with ``lax.ppermute`` (ICI neighbor exchange);
+* stage compute is the same traced block applied to each device's weight
+  slice, so all stages run concurrently on different micro-batches — the
+  classic pipeline diagram, produced by the SPMD partitioner instead of a
+  host scheduler;
+* backward is ``jax.grad`` of the scan: XLA replays the ticks in reverse
+  (the B-phase), and ``schedule_mode='1F1B'`` adds per-tick rematerialization
+  (``jax.checkpoint``) so resident activation memory matches the 1F1B
+  steady-state instead of FThenB's full-batch retention.
+
+The non-repeated prologue (e.g. embeddings) and epilogue (final norm / LM
+head / loss) run replicated on every pp rank — redundant compute that is
+trivially cheap next to the blocks and removes the reference's
+embedding/head special stages and tied-weight allreduce
+(pp_layers.py SharedLayerDesc machinery).
+
+Exact-numerics contract: ``forward_backward_pipeline`` reproduces the
+sequential model bit-for-bit up to float reassociation (tested against
+``PipelineLayer.forward``).
+"""
+from __future__ import annotations
+
+import warnings
+from functools import partial
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ....nn.layer.layers import Layer
+from ... import mesh as mesh_mod
+from .pp_layers import PipelineLayer
+
+
+def _trainable(layer: Layer) -> List[Tensor]:
+    return [p for p in layer.parameters() if not p.stop_gradient]
+
+
+def _layer_signature(fn) -> Optional[tuple]:
+    """Structural signature used to detect a homogeneous (stackable) run of
+    layers: class plus trainable param shapes/dtypes."""
+    if not isinstance(fn, Layer):
+        return None
+    return (type(fn).__name__,
+            tuple((tuple(p.shape), str(p.dtype)) for p in _trainable(fn)))
+
+
+def _find_homogeneous_run(funcs: Sequence, num_stages: int
+                          ) -> Optional[Tuple[int, int]]:
+    """Longest contiguous run of identical-signature Layers whose length is
+    a positive multiple of num_stages. Returns (start, length) or None."""
+    sigs = [_layer_signature(f) for f in funcs]
+    best = None
+    i = 0
+    n = len(sigs)
+    while i < n:
+        if sigs[i] is None or not sigs[i][1]:
+            i += 1
+            continue
+        j = i
+        while j < n and sigs[j] == sigs[i]:
+            j += 1
+        length = ((j - i) // num_stages) * num_stages
+        if length >= num_stages and (best is None or length > best[1]):
+            best = (i, length)
+        i = j
+    return best
+
+
+def _swap_call(layer: Layer, params: Sequence[Tensor], arrays, x_arr):
+    """Run `layer` with `arrays` substituted for its param payloads."""
+    originals = [p._data for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+    try:
+        out = layer(Tensor(x_arr, stop_gradient=False))
+        return out._data
+    finally:
+        for p, o in zip(params, originals):
+            p._data = o
+
+
+def spmd_pipeline(block_fn: Callable, stacked: Sequence, xs, *, mesh,
+                  num_stages: int, schedule: str = "1F1B"):
+    """Run ``m`` micro-batches through ``S * K`` blocks pipelined over the
+    ``pp`` mesh axis.
+
+    block_fn(per_block_arrays: list, x) -> y — one block's compute.
+    stacked — list of arrays, each ``[S*K, ...]`` (block-major), stacked
+    weights for one param position; dim 0 will be sharded over ``pp``.
+    xs — ``[m, micro_batch..., ...]`` micro-batch activations (batch dims
+    may carry dp/sharding shardings; they stay GSPMD-managed because the
+    pipeline is only *manual* over ``pp``).
+    Returns ``[m, ...]`` outputs (replicated over pp).
+    """
+    S = num_stages
+    m = xs.shape[0]
+    L = stacked[0].shape[0]
+    K = L // S
+    assert K * S == L, (L, S)
+    if schedule.upper() in ("1F1B", "VPP", "ZBH1"):
+        block_fn = jax.checkpoint(block_fn)
+
+    # [L, ...] -> [S, K, ...], stage-major
+    staged = [a.reshape((S, K) + a.shape[1:]) for a in stacked]
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def body(staged_local, xs):
+        # staged_local: list of [1, K, ...]; xs: [m, ...] (pp-replicated)
+        local = [a[0] for a in staged_local]
+        idx = jax.lax.axis_index("pp")
+        T = m + S - 1
+
+        def stage_fn(x):
+            def blk(h, per_block):
+                return block_fn(per_block, h), None
+            h, _ = jax.lax.scan(blk, x, local)
+            return h
+
+        state = jnp.zeros(xs.shape[1:], xs.dtype)
+        out = jnp.zeros_like(xs)
+
+        def tick(carry, t):
+            state, out = carry
+            inject = jax.lax.dynamic_index_in_dim(
+                xs, jnp.minimum(t, m - 1), 0, keepdims=False)
+            x_in = jnp.where(idx == 0, inject, state)
+            y = stage_fn(x_in)
+            wpos = jnp.clip(t - (S - 1), 0, m - 1)
+            old = jax.lax.dynamic_index_in_dim(out, wpos, 0, keepdims=False)
+            newval = jnp.where(
+                jnp.logical_and(idx == S - 1, t >= S - 1), y, old)
+            out = jax.lax.dynamic_update_index_in_dim(out, newval, wpos, 0)
+            state = jax.lax.ppermute(y, "pp", perm)
+            return (state, out), None
+
+        (_, out), _ = jax.lax.scan(tick, (state, out), jnp.arange(T))
+        # deliver the last stage's buffer to every pp rank (one allreduce;
+        # its transpose routes dL/dout straight back to the last stage)
+        return jax.lax.psum(
+            jnp.where(idx == S - 1, out, jnp.zeros_like(out)), "pp")
+
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=([P("pp")] * len(staged), P()),
+        out_specs=P(),
+        axis_names=frozenset({"pp"}), check_vma=False)(staged, xs)
+
+
+class PipelineParallel(Layer):
+    """User-facing pipeline runtime (reference pipeline_parallel.py:149).
+
+    Wraps a :class:`PipelineLayer`; ``train_batch((x, y), optimizer)``
+    splits the batch into ``accumulate_steps`` micro-batches, runs the
+    compiled SPMD pipelined forward+backward, writes mean-over-microbatch
+    grads into ``param.grad``, and steps the optimizer.
+    """
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel needs a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        self._mesh = mesh_mod.get_mesh()
+        if hcg is not None:
+            self.num_stages = hcg.get_pipe_parallel_world_size()
+        else:
+            self.num_stages = mesh_mod.axis_size("pp")
+        cfg = getattr(strategy, "pipeline_configs", None) or {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1) or 1)
+        self.schedule_mode = str(cfg.get("schedule_mode", "1F1B"))
+
+        funcs = layers.run_function
+        run = (_find_homogeneous_run(funcs, self.num_stages)
+               if self.num_stages > 1 else None)
+        if run is None and self.num_stages > 1:
+            warnings.warn(
+                "PipelineParallel: no homogeneous block run divisible by "
+                f"{self.num_stages} stages found; falling back to "
+                "non-overlapped micro-batch accumulation")
+        self._run = run
+        if run is not None:
+            start, length = run
+            self._prologue = funcs[:start]
+            self._blocks = funcs[start:start + length]
+            self._epilogue = funcs[start + length:]
+            self._template = self._blocks[0]
+            self._template_params = _trainable(self._template)
+        else:
+            self._prologue = list(funcs)
+            self._blocks = []
+            self._epilogue = []
+
+        # de-duplicated trainable params, block params in stacking order
+        seen = {}
+        for p in _trainable(layers):
+            seen.setdefault(id(p), p)
+        loss_fn = layers.loss_fn
+        if isinstance(loss_fn, Layer):
+            for p in _trainable(loss_fn):
+                seen.setdefault(id(p), p)
+        self._params: List[Tensor] = list(seen.values())
+        self._block_param_ids = []
+        if run is not None:
+            order = {id(p): i for i, p in enumerate(self._params)}
+            for blk in self._blocks:
+                self._block_param_ids.append(
+                    [order[id(p)] for p in _trainable(blk)])
+        self._jit_cache = {}
+        # reference surface
+        self.total_loss = None
+        self.micro_batch_size = int(cfg.get("micro_batch_size", 1) or 1)
+
+    # ------------------------------------------------------------ execution
+    def _run_funcs(self, funcs, x: Tensor) -> Tensor:
+        for fn in funcs:
+            x = fn(x)
+        return x
+
+    def _loss(self, out: Tensor, labels) -> Tensor:
+        loss_fn = self._layers.loss_fn
+        if loss_fn is None:
+            raise ValueError("train_batch requires PipelineLayer(loss_fn=…)")
+        return loss_fn(out, labels)
+
+    def _step_fn(self, param_arrays, xs, ys):
+        """loss(param_arrays) on micro-batched input — traced under jit."""
+        params = self._params
+        originals = [p._data for p in params]
+        for p, a in zip(params, param_arrays):
+            p._data = a
+        try:
+            m = xs.shape[0]
+            flat = xs.reshape((-1,) + xs.shape[2:])
+            h = self._run_funcs(self._prologue, Tensor(flat,
+                                                       stop_gradient=False))
+            if self._run is not None:
+                harr = h._data.reshape((m, -1) + h._data.shape[1:])
+                stacked = []
+                n_p = len(self._block_param_ids[0])
+                for j in range(n_p):
+                    stacked.append(jnp.stack(
+                        [param_arrays[ids[j]]
+                         for ids in self._block_param_ids]))
+
+                def block_fn(per_block, x_arr):
+                    return _swap_call(self._template, self._template_params,
+                                      per_block, x_arr)
+
+                out = spmd_pipeline(block_fn, stacked, harr,
+                                    mesh=self._mesh,
+                                    num_stages=self.num_stages,
+                                    schedule=self.schedule_mode)
+                h = Tensor(out.reshape((-1,) + out.shape[2:]),
+                           stop_gradient=False)
+            out = self._run_funcs(self._epilogue, h)
+            loss = self._loss(out, Tensor(ys))
+            return loss._data
+        finally:
+            for p, o in zip(params, originals):
+                p._data = o
+
+    def forward_backward_pipeline(self, data, scaler=None) -> Tensor:
+        x, y = data
+        xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+        m = self.accumulate_steps
+        if xa.shape[0] % m:
+            raise ValueError(
+                f"batch size {xa.shape[0]} not divisible by "
+                f"accumulate_steps {m}")
+        xs = xa.reshape((m, xa.shape[0] // m) + xa.shape[1:])
+        key = (xs.shape, str(xs.dtype), ya.shape, str(ya.dtype),
+               scaler is not None)
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            def value_and_grads(param_arrays, xs, ys, scale):
+                def f(pa):
+                    loss = self._step_fn(pa, xs, ys)
+                    return loss * scale, loss
+                grads, loss = jax.grad(f, has_aux=True)(param_arrays)
+                return loss, grads
+            fn = jax.jit(value_and_grads)
+            self._jit_cache[key] = fn
+        scale = (scaler._scale._data if scaler is not None
+                 else jnp.float32(1.0))
+        loss_arr, grads = fn([p._data for p in self._params], xs, ya, scale)
+        for p, g in zip(self._params, grads):
+            if p.grad is None:
+                p.grad = Tensor(g)
+            else:
+                p.grad = Tensor(p.grad._data + g)
+        self.total_loss = Tensor(loss_arr)
+        return self.total_loss
+
+    # ------------------------------------------------------- training API
+    def train_batch(self, data, optimizer, lr_scheduler=None,
+                    scaler=None) -> Tensor:
+        self._layers.train()
+        loss = self.forward_backward_pipeline(data, scaler)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+    def eval_batch(self, data, compute_loss: bool = True) -> Tensor:
+        self._layers.eval()
+        x, y = data if isinstance(data, (tuple, list)) and len(data) == 2 \
+            else (data, None)
+        out = self._layers(x if isinstance(x, Tensor) else Tensor(x))
+        if compute_loss and y is not None:
+            return self._loss(out, y if isinstance(y, Tensor) else Tensor(y))
+        return out
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # --------------------------------------------------------- passthrough
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, *args, **kwargs):
+        return self._layers.set_state_dict(*args, **kwargs)
