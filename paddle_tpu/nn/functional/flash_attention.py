@@ -25,7 +25,7 @@ def _t(x):
 
 def _use_pallas():
     try:
-        return jax.default_backend() == "tpu"
+        return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
 

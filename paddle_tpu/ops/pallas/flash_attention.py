@@ -1,16 +1,26 @@
-"""Flash attention (forward) as a Pallas TPU kernel.
+"""Flash attention (forward + backward) as Pallas TPU kernels.
 
 Replaces the reference's FlashAttention-2 CUDA library integration
 (reference: third_party/flashattn; op `flash_attn` at
-paddle/phi/ops/yaml/ops.yaml:1635). Design: online-softmax over KV tiles —
-grid (batch*heads, q_tiles, kv_tiles) with the kv axis innermost so the
-fp32 accumulators in VMEM scratch persist across kv steps; the MXU consumes
-(Bq, d) x (d, Bk) tiles; causal tiles above the diagonal are skipped with
-@pl.when so no FLOPs are spent on masked blocks.
+paddle/phi/ops/yaml/ops.yaml:1635). Design:
 
-Backward uses recompute-based VJP (standard flash strategy): the saved
-memory is O(B*S*H*d) instead of O(B*H*S^2), and XLA fuses the recomputed
-attention with the gradient matmuls.
+* forward — online-softmax over KV tiles: grid (batch*heads, q_tiles,
+  kv_tiles) with the kv axis innermost so the fp32 accumulators in VMEM
+  scratch persist across kv steps; the MXU consumes (Bq, d) x (d, Bk)
+  tiles; causal tiles above the diagonal are skipped with @pl.when so no
+  FLOPs are spent on masked blocks. Also emits the per-row logsumexp
+  (the FA2 "L" residual) for backward.
+* backward — the FA2 recompute strategy, O(S·d) memory: residuals are only
+  (q, k, v, out, lse); each backward tile recomputes p = exp(qk·scale−lse)
+  on the fly. Two kernels: dQ iterates kv innermost accumulating
+  dq += ds·K; dK/dV iterates q innermost accumulating dv += pᵀ·dO and
+  dk += dsᵀ·Q, where ds = p·(dp − Δ)·scale, dp = dO·Vᵀ and
+  Δ = rowsum(dO∘O) is precomputed by one fused XLA reduction. The full
+  (S, S) probability matrix is never materialized in either pass.
+
+``block_q`` / ``block_k`` are exposed for tuning (reference
+flash_attn's num_splits analog); ``INTERPRET=True`` runs the same kernels
+through the Pallas interpreter so CPU tests cover the real kernel code.
 """
 from __future__ import annotations
 
@@ -24,9 +34,35 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 
+# Tuning knobs (VMEM-footprint vs pipeline depth); override per call via
+# flash_attention_fwd(..., block_q=..., block_k=...).
+DEFAULT_BLOCK_Q = 1024      # tuned on v5e @ S=8k: 23 TF/s vs 19 at 512
+DEFAULT_BLOCK_K = 1024
+DEFAULT_BWD_BLOCK_Q = 512
+DEFAULT_BWD_BLOCK_K = 512
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                scale, causal, block_q, block_k, seq_q, seq_k):
+#: run kernels in the Pallas interpreter (CPU testing of kernel code)
+INTERPRET = False
+
+
+def _causal_run(q_idx, kv_idx, block_q, block_k, offset):
+    """Tile intersects the bottom-right-aligned causal region."""
+    return kv_idx * block_k <= q_idx * block_q + (block_q - 1) + offset
+
+
+def _tile_mask(q_idx, kv_idx, block_q, block_k, seq_k, causal, offset):
+    q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    mask = k_pos < seq_k
+    if causal:
+        mask = mask & (q_pos + offset >= k_pos)
+    return mask
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, seq_q, seq_k):
     kv_idx = pl.program_id(2)
     q_idx = pl.program_id(1)
     num_kv = pl.num_programs(2)
@@ -40,11 +76,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Skip fully-masked tiles (strictly above the causal diagonal).
     run = True
     if causal:
-        run = (kv_idx * block_k
-               <= q_idx * block_q + (block_q - 1) + causal_offset)
+        run = _causal_run(q_idx, kv_idx, block_q, block_k, causal_offset)
 
     @pl.when(run)
     def _step():
@@ -54,20 +88,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-
-        q_pos = q_idx * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
-        k_pos = kv_idx * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        mask = k_pos < seq_k
-        if causal:
-            mask = mask & (q_pos + causal_offset >= k_pos)
+        mask = _tile_mask(q_idx, kv_idx, block_q, block_k, seq_k, causal,
+                          causal_offset)
         s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[:]                      # (block_q, 1)
         m_cur = jnp.max(s, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                 # (block_q, block_k)
+        # fully-masked rows (causal, seq_q > seq_k): m_new == NEG_INF and
+        # exp(s - m_new) == 1; zero them so l stays 0 and out stays 0
+        p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_scr[:] + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
@@ -80,87 +111,263 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finish():
         l = jnp.maximum(l_scr[:], 1e-30)
         o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[:] + jnp.log(l)
 
 
-def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q=512, block_k=512):
-    """q/k/v: (BH, S, d) -> out (BH, S, d)."""
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, scale, causal, block_q, block_k, seq_q, seq_k):
+    kv_idx = pl.program_id(2)
+    q_idx = pl.program_id(1)
+    num_kv = pl.num_programs(2)
+    causal_offset = seq_k - seq_q
+
+    @pl.when(kv_idx == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = _causal_run(q_idx, kv_idx, block_q, block_k, causal_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]                       # (block_q, 1)
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(q_idx, kv_idx, block_q, block_k, seq_k, causal,
+                          causal_offset)
+        s = jnp.where(mask, s, NEG_INF)
+        # mask-guard (not just exp underflow): for fully-masked rows lse is
+        # garbage (~NEG_INF) and exp(NEG_INF - lse) would be 1, not 0
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale          # (block_q, block_k) fp32
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kv_idx == num_kv - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+                dv_ref, dk_scr, dv_scr, *, scale, causal, block_q, block_k,
+                seq_q, seq_k):
+    q_idx = pl.program_id(2)       # q innermost in this kernel
+    kv_idx = pl.program_id(1)
+    num_q = pl.num_programs(2)
+    causal_offset = seq_k - seq_q
+
+    @pl.when(q_idx == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = _causal_run(q_idx, kv_idx, block_q, block_k, causal_offset)
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        mask = _tile_mask(q_idx, kv_idx, block_q, block_k, seq_k, causal,
+                          causal_offset)
+        s = jnp.where(mask, s, NEG_INF)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        # dv += P^T dO
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dk += dS^T Q
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(q_idx == num_q - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _pad_bhsd(x, block_s, pad_d):
+    pad_s = (-x.shape[1]) % block_s
+    if pad_s or pad_d:
+        x = jnp.pad(x, ((0, 0), (0, pad_s), (0, pad_d)))
+    return x
+
+
+def _flash_fwd_bhsd(q, k, v, *, causal, scale, block_q, block_k):
+    """q/k/v: (BH, S, d) -> (out (BH, S, d), lse fp32 (BH, Sq_padded))."""
     bh, s_q, d = q.shape
     s_k = k.shape[1]
     block_q = min(block_q, max(s_q, 8))
     block_k = min(block_k, max(s_k, 8))
-
-    # Pad seq dims to tile multiples and head_dim to the 128-lane width.
-    pad_q = (-s_q) % block_q
-    pad_k = (-s_k) % block_k
     pad_d = (-d) % 128
-    if pad_q or pad_d:
-        q = jnp.pad(q, ((0, 0), (0, pad_q), (0, pad_d)))
-    if pad_k or pad_d:
-        k = jnp.pad(k, ((0, 0), (0, pad_k), (0, pad_d)))
-        v = jnp.pad(v, ((0, 0), (0, pad_k), (0, pad_d)))
-    sp_q, sp_k, dp = s_q + pad_q, s_k + pad_k, d + pad_d
+    q = _pad_bhsd(q, block_q, pad_d)
+    k = _pad_bhsd(k, block_k, pad_d)
+    v = _pad_bhsd(v, block_k, pad_d)
+    sp_q, sp_k, dp = q.shape[1], k.shape[1], d + pad_d
 
     grid = (bh, sp_q // block_q, sp_k // block_k)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
         block_k=block_k, seq_q=s_q, seq_k=s_k)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, sp_q, dp), q.dtype),
+        out_shape=[jax.ShapeDtypeStruct((bh, sp_q, dp), q.dtype),
+                   jax.ShapeDtypeStruct((bh, sp_q, 1), jnp.float32)],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+        out_specs=[
+            pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, dp), jnp.float32),
         ],
+        interpret=INTERPRET,
     )(q, k, v)
-    return out[:, :s_q, :d]
+    return out[:, :s_q, :d], lse
 
 
-def _sdpa_reference(q, k, v, causal, scale):
-    """XLA attention used for the recompute VJP (BSHD layout)."""
-    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
-    if causal:
-        s, t = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((s, t), bool), k=t - s)
-        logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,bthd->bshd", probs, v)
+def _flash_bwd_bhsd(q, k, v, out, lse, do, *, causal, scale, block_q,
+                    block_k):
+    """FA2 backward. All of q/k/v/out/do: (BH, S, d); lse: (BH, Sq_pad_fwd).
+    Returns (dq, dk, dv) unpadded."""
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    block_q = min(block_q, max(s_q, 8))
+    block_k = min(block_k, max(s_k, 8))
+    pad_d = (-d) % 128
+
+    # Δ = rowsum(dO ∘ O): one fused XLA reduction, fp32.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                # (BH, s_q, 1)
+
+    q = _pad_bhsd(q, block_q, pad_d)
+    do = _pad_bhsd(do, block_q, pad_d)
+    k = _pad_bhsd(k, block_k, pad_d)
+    v = _pad_bhsd(v, block_k, pad_d)
+    sp_q, sp_k, dp = q.shape[1], k.shape[1], d + pad_d
+    if lse.shape[1] < sp_q:     # fwd may have tiled with a different block
+        lse = jnp.pad(lse, ((0, 0), (0, sp_q - lse.shape[1]), (0, 0)))
+    elif lse.shape[1] > sp_q:
+        lse = lse[:, :sp_q]
+    delta = jnp.pad(delta, ((0, 0), (0, sp_q - s_q), (0, 0)))
+
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+              seq_q=s_q, seq_k=s_k)
+    q_spec = pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, **kw),
+        out_shape=jax.ShapeDtypeStruct((bh, sp_q, dp), q.dtype),
+        grid=(bh, sp_q // block_q, sp_k // block_k),
+        in_specs=[
+            q_spec,
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, j, 0)),
+            q_spec, row_spec, row_spec,
+        ],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        interpret=INTERPRET,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv: kv outer, q inner
+    qi_spec = pl.BlockSpec((1, block_q, dp), lambda b, i, j: (b, j, 0))
+    rowi_spec = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, dp), lambda b, i, j: (b, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, **kw),
+        out_shape=[jax.ShapeDtypeStruct((bh, sp_k, dp), k.dtype),
+                   jax.ShapeDtypeStruct((bh, sp_k, dp), v.dtype)],
+        grid=(bh, sp_k // block_k, sp_q // block_q),
+        in_specs=[qi_spec, kv_spec, kv_spec, qi_spec, rowi_spec, rowi_spec],
+        out_specs=[kv_spec, kv_spec],
+        scratch_shapes=[pltpu.VMEM((block_k, dp), jnp.float32),
+                        pltpu.VMEM((block_k, dp), jnp.float32)],
+        interpret=INTERPRET,
+    )(q, k, v, do, lse, delta)
+    return (dq[:, :s_q, :d], dk[:, :s_k, :d], dv[:, :s_k, :d])
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, scale):
+def _bshd_to_bhsd(x):
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _bhsd_to_bshd(x, b, h):
+    bh, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, block_q, block_k):
+    out, _ = _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
     b, s, h, d = q.shape
-    t = k.shape[1]
-    qf = q.transpose(0, 2, 1, 3).reshape(b * h, s, d)
-    kf = k.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    vf = v.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-    out = _flash_fwd_bhsd(qf, kf, vf, causal=causal, scale=scale)
-    return out.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+    out, lse = _flash_fwd_bhsd(
+        _bshd_to_bhsd(q), _bshd_to_bhsd(k), _bshd_to_bhsd(v),
+        causal=causal, scale=scale,
+        block_q=DEFAULT_BLOCK_Q if block_q is None else block_q,
+        block_k=DEFAULT_BLOCK_K if block_k is None else block_k)
+    out_bshd = _bhsd_to_bshd(out, b, h)
+    return out_bshd, (q, k, v, out_bshd, lse)
 
 
-def _flash_fwd_rule(q, k, v, causal, scale):
-    return _flash_attention(q, k, v, causal, scale), (q, k, v)
-
-
-def _flash_bwd_rule(causal, scale, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q_, k_, v_: _sdpa_reference(q_, k_, v_, causal,
-                                                        scale), q, k, v)
-    return vjp(g)
+def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    b, s, h, d = q.shape
+    dq, dk, dv = _flash_bwd_bhsd(
+        _bshd_to_bhsd(q), _bshd_to_bhsd(k), _bshd_to_bhsd(v),
+        _bshd_to_bhsd(out), lse, _bshd_to_bhsd(g),
+        causal=causal, scale=scale,
+        block_q=DEFAULT_BWD_BLOCK_Q if block_q is None else block_q,
+        block_k=DEFAULT_BWD_BLOCK_K if block_k is None else block_k)
+    return (_bhsd_to_bshd(dq, b, h), _bhsd_to_bshd(dk, b, h),
+            _bhsd_to_bshd(dv, b, h))
 
 
 _flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention_fwd(q, k, v, causal=False, scale=None):
-    """Public entry: q/k/v (batch, seq, heads, head_dim)."""
+def flash_attention_fwd(q, k, v, causal=False, scale=None, block_q=None,
+                        block_k=None):
+    """Public entry: q/k/v (batch, seq, heads, head_dim). ``block_q`` /
+    ``block_k`` tune the tile sizes (defaults: DEFAULT_BLOCK_Q/K forward,
+    DEFAULT_BWD_BLOCK_Q/K backward)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_attention(q, k, v, causal, scale)
+    return _flash_attention(q, k, v, causal, scale, block_q, block_k)

@@ -1,0 +1,169 @@
+"""Pallas flash-attention kernel tests (interpret mode on CPU).
+
+The kernels themselves run through the Pallas interpreter so the exact
+kernel code that executes on TPU is what is tested here (reference test
+analog: test/legacy_test/test_flash_attention.py comparing against a plain
+attention implementation).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu.ops.pallas.flash_attention as fa
+
+
+def _ref_attn(q, k, v, causal, scale):
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool), k=t - s)
+        logits = jnp.where(mask, logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+@pytest.fixture(autouse=True)
+def _interpret():
+    old = fa.INTERPRET
+    fa.INTERPRET = True
+    yield
+    fa.INTERPRET = old
+
+
+def _rand_qkv(b=1, s=128, h=2, d=32, t=None, seed=0):
+    rng = np.random.RandomState(seed)
+    t = t or s
+    q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+    k = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    v = jnp.asarray(rng.randn(b, t, h, d).astype(np.float32))
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_matches_reference(causal):
+    q, k, v = _rand_qkv()
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    out = fa.flash_attention_fwd(q, k, v, causal=causal,
+                                 block_q=64, block_k=64)
+    ref = _ref_attn(q, k, v, causal, scale)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_forward_unaligned_seq():
+    # seq not a multiple of the block: exercises padding/masking
+    q, k, v = _rand_qkv(s=100, t=100)
+    out = fa.flash_attention_fwd(q, k, v, causal=True,
+                                 block_q=64, block_k=64)
+    ref = _ref_attn(q, k, v, True, 1.0 / math.sqrt(q.shape[-1]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_cross_attention_lengths():
+    q, k, v = _rand_qkv(s=64, t=128)
+    out = fa.flash_attention_fwd(q, k, v, causal=True,
+                                 block_q=64, block_k=64)
+    ref = _ref_attn(q, k, v, True, 1.0 / math.sqrt(q.shape[-1]))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_backward_matches_reference(causal):
+    q, k, v = _rand_qkv(s=128)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def loss_flash(q, k, v):
+        out = fa.flash_attention_fwd(q, k, v, causal=causal,
+                                     block_q=64, block_k=64)
+        return jnp.sum(out * jnp.cos(out))   # non-trivial cotangent
+
+    def loss_ref(q, k, v):
+        out = _ref_attn(q, k, v, causal, scale)
+        return jnp.sum(out * jnp.cos(out))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(g1, g2, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_backward_unaligned_and_different_blocks():
+    q, k, v = _rand_qkv(s=100, t=100)
+
+    def loss(q, k, v):
+        out = fa.flash_attention_fwd(q, k, v, causal=True,
+                                     block_q=64, block_k=32)
+        return jnp.sum(out ** 2)
+
+    def loss_ref(q, k, v):
+        out = _ref_attn(q, k, v, True, 1.0 / math.sqrt(q.shape[-1]))
+        return jnp.sum(out ** 2)
+
+    g1 = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-4)
+
+
+def test_bf16_forward_backward():
+    q, k, v = _rand_qkv(s=64)
+    q, k, v = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention_fwd(
+            q, k, v, causal=True, block_q=32, block_k=32)
+            .astype(jnp.float32))
+
+    g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    scale = 1.0 / math.sqrt(q.shape[-1])
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_ref_attn(q, k, v, True, scale).astype(jnp.float32))
+
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(
+            np.asarray(a, dtype=np.float32), np.asarray(b, np.float32),
+            atol=0.15, rtol=0.1)
+
+
+def test_fully_masked_rows_causal_sq_gt_sk():
+    # causal with seq_q > seq_k: the first (sq - sk) query rows attend zero
+    # keys. FA convention: output 0 for those rows, independent of block
+    # size; gradients must not leak probability mass from them.
+    q, k, v = _rand_qkv(s=128, t=64)
+    n_masked = 128 - 64
+    outs = []
+    for bq, bk in [(32, 32), (64, 64), (128, 64)]:
+        out = np.asarray(fa.flash_attention_fwd(q, k, v, causal=True,
+                                                block_q=bq, block_k=bk))
+        np.testing.assert_allclose(out[:, :n_masked], 0.0, atol=1e-6)
+        outs.append(out)
+    for o in outs[1:]:
+        np.testing.assert_allclose(o, outs[0], atol=1e-5)
+
+    def loss(q, k, v):
+        return jnp.sum(fa.flash_attention_fwd(
+            q, k, v, causal=True, block_q=32, block_k=32) ** 2)
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    # masked q rows contribute nothing anywhere
+    np.testing.assert_allclose(np.asarray(gq)[:, :n_masked], 0.0, atol=1e-6)
+    assert np.all(np.isfinite(np.asarray(gk)))
+    assert np.all(np.isfinite(np.asarray(gv)))
+
+
+def test_grad_under_jit():
+    q, k, v = _rand_qkv(s=64)
+    f = jax.jit(jax.grad(lambda q: jnp.sum(fa.flash_attention_fwd(
+        q, k, v, causal=True, block_q=32, block_k=32) ** 2)))
+    g = f(q)
+    assert np.all(np.isfinite(np.asarray(g)))
