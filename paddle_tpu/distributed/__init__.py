@@ -21,6 +21,8 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
                             shard_layer, shard_optimizer, shard_tensor)
 from . import fleet
 from . import sharding
+from . import checkpoint
+from .checkpoint import load_state_dict, save_state_dict
 from .fleet.mpu.mp_ops import split
 
 

@@ -1,0 +1,4 @@
+from .save_state_dict import save_state_dict
+from .load_state_dict import load_state_dict
+
+__all__ = ["save_state_dict", "load_state_dict"]

@@ -15,6 +15,7 @@ from .meta_optimizers import (DygraphShardingOptimizer,
                               HybridParallelOptimizer)
 from .meta_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
                             SharedLayerDesc)
+from .recompute import recompute, recompute_sequential
 from .mpu import (ColumnParallelLinear, ParallelCrossEntropy,
                   RowParallelLinear, VocabParallelEmbedding,
                   get_rng_state_tracker, model_parallel_random_seed, mp_ops,
@@ -43,4 +44,5 @@ __all__ = [
     "get_rng_state_tracker", "model_parallel_random_seed",
     "mp_ops", "raw_ops",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "recompute", "recompute_sequential",
 ]

@@ -228,7 +228,28 @@ class PipelineLayer(Layer):
     # -------------------------------------------------------------- forward
     def forward(self, x):
         """Sequential (non-pipelined) execution — the ground-truth numerics
-        and the pp_degree==1 path."""
+        and the pp_degree==1 path. ``recompute_interval=k`` checkpoints
+        every k layers (reference pp_layers.py forward with
+        _recompute_interval)."""
+        k = self._recompute_interval
+        if k and k > 0 and self.training:
+            from ..recompute import recompute
+            ctx = self._recompute_ctx or {}
+            preserve = bool(ctx.get("preserve_rng_state", True))
+            fns = self.run_function
+            for start in range(0, len(fns), k):
+                chunk = fns[start:start + k]
+                chunk_params = [
+                    p for fn in chunk if isinstance(fn, Layer)
+                    for p in fn.parameters() if not p.stop_gradient]
+
+                def run(x, chunk=chunk):
+                    for fn in chunk:
+                        x = fn(x)
+                    return x
+                x = recompute(run, x, preserve_rng_state=preserve,
+                              params=chunk_params)
+            return x
         for fn in self.run_function:
             x = fn(x)
         return x

@@ -1,0 +1,176 @@
+"""Recompute (activation checkpointing).
+
+Capability parity with the reference recompute
+(reference: python/paddle/distributed/fleet/recompute/recompute.py —
+``RecomputeFunction`` PyLayer saving inputs + RNG state and replaying the
+forward inside backward; ``recompute_sequential`` chunked wrapper). TPU-native:
+the forward segment runs under ``no_grad`` so NO per-op residuals are
+retained (the eager tape records nothing — only the segment's boundary
+inputs are saved); backward replays the forward with the tape enabled and
+runs the engine over the replayed subgraph, so parameter grads accumulate
+into ``.grad`` exactly as in the reference. Both the default generator AND
+the fleet RNGStatesTracker streams are snapshotted before the forward and
+replayed during the recompute so dropout masks match (reference
+``_swith_rng_state_tracker``). For fully-jitted training steps the same
+effect comes from ``jax.checkpoint`` (used by the pipeline runtime's 1F1B
+schedule).
+"""
+from __future__ import annotations
+
+import warnings
+from typing import Any
+
+import jax.numpy as jnp
+
+from ....autograd.pylayer import PyLayer
+from ....core import dispatch
+from ....core.generator import get_rng_state, set_rng_state
+from ....core.tensor import Tensor
+from ..mpu.random import get_rng_state_tracker
+
+
+def _snapshot_rng():
+    return (get_rng_state(), get_rng_state_tracker().get_states_tracker())
+
+
+def _restore_rng(snap):
+    state, tracker = snap
+    set_rng_state(state)
+    get_rng_state_tracker().set_states_tracker(tracker)
+
+
+def _discover_params(function):
+    if hasattr(function, "parameters"):
+        return [p for p in function.parameters() if not p.stop_gradient]
+    owner = getattr(function, "__self__", None)       # bound layer.forward
+    if owner is not None and hasattr(owner, "parameters"):
+        return [p for p in owner.parameters() if not p.stop_gradient]
+    return []
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args, **kwargs)`` without storing intermediate
+    activations; re-run it during backward.
+
+    ``function`` must return a Tensor / tuple whose Tensor entries are the
+    differentiable outputs. Options (popped, rest forwarded):
+    ``preserve_rng_state`` (default True) replays the RNG streams in the
+    recompute pass; ``params`` explicitly lists the trainable parameters
+    used inside ``function`` when it is not a Layer (they anchor the tape
+    node when no tensor input requires grad); ``use_reentrant`` is accepted
+    for API parity.
+    """
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+    params = kwargs.pop("params", None)
+    if params is None:
+        params = _discover_params(function)
+    params = [p for p in params if isinstance(p, Tensor)
+              and not p.stop_gradient]
+
+    tensor_idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    n_in = len(tensor_idx)
+    if not params and not any(not args[i].stop_gradient
+                              for i in tensor_idx):
+        warnings.warn("recompute: no input requires grad and no parameters "
+                      "were found; gradients will not flow through this "
+                      "segment")
+
+    class _Recompute(PyLayer):
+        @staticmethod
+        def forward(ctx, *ts_and_params):
+            ts = ts_and_params[:n_in]
+            ctx.rng_before = _snapshot_rng() if preserve_rng_state else None
+            # snapshot input payloads NOW: in-place mutation between
+            # forward and backward must not change the replay
+            ctx.saved_arrays = [t._data for t in ts]
+            full = list(args)
+            for i, t in zip(tensor_idx, ts):
+                full[i] = t
+            outs = function(*full, **kwargs)
+            out_list = [outs] if not isinstance(outs, (tuple, list)) \
+                else list(outs)
+            ctx.tensor_out_idx = [i for i, o in enumerate(out_list)
+                                  if isinstance(o, Tensor)]
+            return outs
+
+        @staticmethod
+        def backward(ctx, *grads):
+            from ....autograd.engine import run_backward
+
+            rng_after = _snapshot_rng()
+            if ctx.rng_before is not None:
+                _restore_rng(ctx.rng_before)
+            # Replay the forward WITH the tape so parameter grads accumulate
+            # into .grad through the normal engine (reference
+            # RecomputeFunction.backward: tracing re-run + backward()).
+            ins = [Tensor(arr, stop_gradient=False)
+                   for arr in ctx.saved_arrays]
+            full = list(args)
+            for i, c in zip(tensor_idx, ins):
+                full[i] = c
+            try:
+                with dispatch.enable_grad():
+                    outs = function(*full, **kwargs)
+            finally:
+                if ctx.rng_before is not None:
+                    _restore_rng(rng_after)
+            out_list = [outs] if not isinstance(outs, (tuple, list)) \
+                else list(outs)
+            # pair cotangents with outputs BY POSITION, then keep Tensors
+            out_ts, cts = [], []
+            for i in ctx.tensor_out_idx:
+                out_ts.append(out_list[i])
+                g = grads[i] if i < len(grads) else None
+                cts.append(g if isinstance(g, Tensor) or g is None
+                           else Tensor(g))
+            run_backward(out_ts, cts)
+            in_grads = tuple(
+                c.grad if c.grad is not None
+                else Tensor(jnp.zeros_like(c._data)) for c in ins)
+            # params anchor the node; their real grads were accumulated by
+            # run_backward above, so their positional slots get zeros
+            return in_grads + tuple(
+                Tensor(jnp.zeros_like(p._data)) for p in params)
+
+    tensors = [args[i] for i in tensor_idx]
+    return _Recompute.apply(*tensors, *params)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Chunked recompute over a sequence of layers (reference
+    recompute_sequential: split ``functions`` into ``segments`` chunks,
+    checkpoint each chunk's boundary activation only).
+    """
+    ctx = dict(ctx or {})
+    segments = int(ctx.get("segments", 1))
+    preserve = bool(ctx.get("preserve_rng_state", True))
+    if hasattr(functions, "children"):        # nn.Sequential / Layer
+        functions = list(functions.children())
+    functions = list(functions)
+    if not functions:
+        raise ValueError("recompute_sequential needs at least one function")
+
+    n = len(functions)
+    per = max(n // max(segments, 1), 1)
+
+    def run_chunk(chunk):
+        def f(*xs):
+            out = xs if len(xs) > 1 else xs[0]
+            for fn in chunk:
+                out = fn(*out) if isinstance(out, tuple) else fn(out)
+            return out
+        return f
+
+    out: Any = args
+    start = 0
+    while start < n:
+        chunk = functions[start:start + per]
+        chunk_params = [p for fn in chunk
+                        for p in _discover_params(fn)]
+        inputs = out if isinstance(out, tuple) else (out,)
+        out = recompute(run_chunk(chunk), *inputs,
+                        preserve_rng_state=preserve, params=chunk_params,
+                        **kwargs)
+        start += per
+    return out

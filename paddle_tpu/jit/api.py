@@ -183,15 +183,149 @@ def ignore_module(modules):
     return None
 
 
+def _example_arrays(input_spec):
+    """InputSpec / Tensor / ndarray entries -> jax abstract values. A -1
+    dim becomes a symbolic dimension so the saved program serves any size
+    on that axis."""
+    from jax import export as jax_export
+
+    avals = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            avals.append(jax.ShapeDtypeStruct(tuple(spec.shape),
+                                              spec._data.dtype))
+            continue
+        if isinstance(spec, (np.ndarray, jax.Array)):
+            avals.append(jax.ShapeDtypeStruct(spec.shape, spec.dtype))
+            continue
+        shape = tuple(spec.shape)
+        if any(s == -1 for s in shape):
+            names = ",".join(f"d{i}" if s == -1 else str(s)
+                             for i, s in enumerate(shape))
+            shape = jax_export.symbolic_shape(f"({names})")
+        dtype = jnp.bfloat16 if str(spec.dtype) == "bfloat16" \
+            else np.dtype(spec.dtype)
+        avals.append(jax.ShapeDtypeStruct(shape, dtype))
+    return avals
+
+
 def save(layer, path, input_spec=None, **configs):
-    """Save params + (optionally) the traced program (reference:
-    python/paddle/jit/api.py save). v0 persists the state_dict; exported
-    StableHLO lands with the inference-export milestone."""
+    """Serialize the traced program (StableHLO via jax.export) + params
+    (reference: python/paddle/jit/api.py save → .pdmodel/.pdiparams;
+    jit.load returns a TranslatedLayer that executes WITHOUT the Python
+    model class). Artifacts: ``path.pdmodel`` (program + calling
+    convention) and ``path.pdparams`` (weights)."""
+    import pickle
+
+    from jax import export as jax_export
+
     from ..framework.io import save as _save
-    state = layer.state_dict() if hasattr(layer, "state_dict") else layer
+
+    fn = layer.forward if hasattr(layer, "forward") else layer
+    if isinstance(fn, StaticFunction):
+        if input_spec is None:
+            input_spec = fn._input_spec
+        fn = fn._dygraph_fn
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec (list of InputSpec / example "
+            "tensors) to trace the program")
+
+    state = layer.state_dict() if hasattr(layer, "state_dict") else {}
+    param_arrays = {k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                    for k, v in state.items()}
+    name_to_param = {}
+    if hasattr(layer, "named_parameters"):
+        name_to_param.update(dict(layer.named_parameters()))
+    if hasattr(layer, "named_buffers"):
+        name_to_param.update(dict(layer.named_buffers()))
+
+    was_training = getattr(layer, "training", False)
+    if hasattr(layer, "eval"):
+        layer.eval()
+    try:
+        def pure(params, *xs):
+            originals = []
+            for k, t in name_to_param.items():
+                originals.append((t, t._data))
+                if k in params:
+                    t._data = params[k]
+            try:
+                out = fn(*_wrap(list(xs)))
+                return _unwrap(out)
+            finally:
+                for t, d in originals:
+                    t._data = d
+
+        avals = _example_arrays(list(input_spec))
+        exported = jax_export.export(jax.jit(pure))(param_arrays, *avals)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()
+
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({"format": "paddle_tpu.jit/1",
+                     "stablehlo": exported.serialize()}, f)
     _save(state, path + ".pdparams")
 
 
+class TranslatedLayer:
+    """A loaded program: callable without the original model class
+    (reference: python/paddle/jit/translated_layer.py TranslatedLayer)."""
+
+    def __init__(self, exported, state):
+        self._exported = exported
+        self._state = state
+        self._param_arrays = {
+            k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+            for k, v in state.items()}
+        self.training = False
+
+    def __call__(self, *inputs):
+        arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
+                  for i in inputs]
+        out = self._exported.call(self._param_arrays, *arrays)
+        return _wrap(out)
+
+    forward = __call__
+
+    def state_dict(self):
+        return dict(self._state)
+
+    def set_state_dict(self, state):
+        for k, v in state.items():
+            if k in self._state:
+                self._state[k] = v if isinstance(v, Tensor) else Tensor(
+                    jnp.asarray(v))
+        self._param_arrays = {
+            k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+            for k, v in self._state.items()}
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        raise RuntimeError(
+            "TranslatedLayer holds an inference program; retraining "
+            "requires the original model class (reference parity)")
+
+
 def load(path, **configs):
+    """Load a saved program as a TranslatedLayer; falls back to a raw
+    state-dict when only params were saved."""
+    import os
+    import pickle
+
+    from jax import export as jax_export
+
     from ..framework.io import load as _load
-    return _load(path + ".pdparams")
+
+    state = _load(path + ".pdparams")
+    model_file = path + ".pdmodel"
+    if not os.path.exists(model_file):
+        return state
+    with open(model_file, "rb") as f:
+        blob = pickle.load(f)
+    exported = jax_export.deserialize(blob["stablehlo"])
+    return TranslatedLayer(exported, state)

@@ -1,0 +1,37 @@
+"""Static-graph surface: InputSpec.
+
+Reference: python/paddle/static/input.py InputSpec — declarative
+shape/dtype/name of a program input, used by to_static and jit.save.
+TPU-native: it maps directly to a jax.ShapeDtypeStruct; a -1/None dim is
+exported as a symbolic dimension so one saved program serves any batch.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+class InputSpec:
+    def __init__(self, shape: Sequence[Optional[int]], dtype="float32",
+                 name: Optional[str] = None, stop_gradient: bool = True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = str(np.dtype(dtype)) if dtype != "bfloat16" \
+            else "bfloat16"
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, str(tensor.dtype), name)
+
+    @classmethod
+    def from_numpy(cls, ndarray, name=None):
+        return cls(ndarray.shape, str(ndarray.dtype), name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+
+__all__ = ["InputSpec"]

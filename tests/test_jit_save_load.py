@@ -1,0 +1,122 @@
+"""jit.save / jit.load program-artifact round-trip tests.
+
+Reference analog: test/dygraph_to_static/test_save_load.py — save a traced
+program + params, load as TranslatedLayer, run WITHOUT the model class, and
+match the original outputs.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.static import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_save_load_round_trip(tmp_path):
+    paddle.seed(0)
+    net = SmallNet()
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    ref = net(x).numpy()
+
+    path = str(tmp_path / "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([4, 8], "float32")])
+
+    loaded = paddle.jit.load(path)
+    assert isinstance(loaded, paddle.jit.TranslatedLayer)
+    out = loaded(x)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-6)
+
+
+def test_load_runs_without_model_class(tmp_path):
+    """The loaded program must execute from the artifact alone — state dict
+    + serialized StableHLO, no SmallNet involved."""
+    paddle.seed(1)
+    net = SmallNet()
+    path = str(tmp_path / "model2")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    x = np.random.randn(2, 8).astype(np.float32)
+    ref = net(paddle.to_tensor(x)).numpy()
+
+    import pickle
+
+    from jax import export as jax_export
+
+    from paddle_tpu.framework.io import load as fio_load
+    from paddle_tpu.jit.api import TranslatedLayer
+
+    with open(path + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    fresh = TranslatedLayer(jax_export.deserialize(blob["stablehlo"]),
+                            fio_load(path + ".pdparams"))
+    out = fresh(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-6)
+
+
+def test_symbolic_batch_dim(tmp_path):
+    paddle.seed(2)
+    net = SmallNet()
+    path = str(tmp_path / "model3")
+    paddle.jit.save(net, path, input_spec=[InputSpec([-1, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for b in (1, 3, 16):
+        x = np.random.randn(b, 8).astype(np.float32)
+        ref = net(paddle.to_tensor(x)).numpy()
+        out = loaded(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-6)
+
+
+def test_to_static_layer_save(tmp_path):
+    paddle.seed(3)
+    net = paddle.jit.to_static(
+        SmallNet(), input_spec=[InputSpec([4, 8], "float32")])
+    x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+    ref = net(x).numpy()
+    path = str(tmp_path / "model4")
+    paddle.jit.save(net, path)
+    out = paddle.jit.load(path)(x)
+    np.testing.assert_allclose(np.asarray(out._data), ref, atol=1e-6)
+
+
+def test_set_state_dict_on_translated_layer(tmp_path):
+    paddle.seed(4)
+    net = SmallNet()
+    path = str(tmp_path / "model5")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    new_state = {k: paddle.to_tensor(np.zeros(v.shape, np.float32))
+                 for k, v in loaded.state_dict().items()}
+    loaded.set_state_dict(new_state)
+    x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    out = loaded(x)
+    np.testing.assert_allclose(np.asarray(out._data), 0.0, atol=1e-6)
+
+
+def test_train_raises(tmp_path):
+    paddle.seed(5)
+    net = SmallNet()
+    path = str(tmp_path / "model6")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_params_only_fallback(tmp_path):
+    """paddle.save'd raw state (no .pdmodel) still loads as a dict."""
+    paddle.seed(6)
+    net = SmallNet()
+    from paddle_tpu.framework.io import save as fio_save
+    path = str(tmp_path / "weights")
+    fio_save(net.state_dict(), path + ".pdparams")
+    out = paddle.jit.load(path)
+    assert isinstance(out, dict) and "fc1.weight" in out

@@ -1,0 +1,266 @@
+"""Recompute (activation checkpointing) tests.
+
+Reference analog: test/collective/fleet/test_dygraph_recompute*.py — grads
+with recompute must equal grads without; dropout must replay identically.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed.fleet import recompute, recompute_sequential
+
+
+class Block(nn.Layer):
+    def __init__(self, d=16, dropout=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(d, 4 * d)
+        self.fc2 = nn.Linear(4 * d, d)
+        self.p = dropout
+
+    def forward(self, x):
+        y = paddle.nn.functional.gelu(self.fc1(x))
+        if self.p > 0:
+            y = paddle.nn.functional.dropout(y, p=self.p,
+                                             training=self.training)
+        return x + self.fc2(y)
+
+
+def _grads(model, x, use_recompute, segments=0):
+    for p in model.parameters():
+        p.clear_grad()
+    h = x
+    if use_recompute:
+        if segments:
+            h = recompute_sequential({"segments": segments},
+                                     list(model), h)
+        else:
+            for blk in model:
+                h = recompute(blk, h)
+    else:
+        for blk in model:
+            h = blk(h)
+    loss = paddle.ops.mean(h ** 2)
+    loss.backward()
+    return (float(loss.numpy()),
+            {n: np.asarray(p.grad._data)
+             for n, p in model.named_parameters() if p.grad is not None})
+
+
+def test_grads_match_no_recompute():
+    paddle.seed(0)
+    model = nn.LayerList([Block() for _ in range(4)])
+    x = paddle.to_tensor(np.random.randn(8, 16).astype(np.float32),
+                         stop_gradient=False)
+    l1, g1 = _grads(model, x, use_recompute=False)
+    l2, g2 = _grads(model, x, use_recompute=True)
+    assert abs(l1 - l2) < 1e-6
+    assert set(g1) == set(g2) and len(g1) > 0
+    for n in g1:
+        np.testing.assert_allclose(g1[n], g2[n], atol=1e-6,
+                                   err_msg=f"grad mismatch {n}")
+
+
+def test_input_grad_flows():
+    paddle.seed(1)
+    blk = Block()
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = recompute(blk, x)
+    loss = paddle.ops.sum(out ** 2)
+    loss.backward()
+    assert x.grad is not None
+    # reference
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    loss2 = paddle.ops.sum(blk(x2) ** 2)
+    loss2.backward()
+    np.testing.assert_allclose(np.asarray(x.grad._data),
+                               np.asarray(x2.grad._data), atol=1e-6)
+
+
+def test_rng_replay_with_dropout():
+    paddle.seed(3)
+    model = nn.LayerList([Block(dropout=0.5) for _ in range(2)])
+    model.train()
+    x = paddle.to_tensor(np.random.randn(32, 16).astype(np.float32),
+                         stop_gradient=False)
+    # same seed, recompute on/off: forwards see identical dropout masks
+    paddle.seed(123)
+    l1, g1 = _grads(model, x, use_recompute=False)
+    paddle.seed(123)
+    l2, g2 = _grads(model, x, use_recompute=True)
+    assert abs(l1 - l2) < 1e-6, "dropout mask not replayed identically"
+    for n in g1:
+        np.testing.assert_allclose(g1[n], g2[n], atol=1e-6)
+
+
+def test_recompute_sequential_segments():
+    paddle.seed(4)
+    model = nn.LayerList([Block() for _ in range(4)])
+    x = paddle.to_tensor(np.random.randn(8, 16).astype(np.float32),
+                         stop_gradient=False)
+    l1, g1 = _grads(model, x, use_recompute=False)
+    l2, g2 = _grads(model, x, use_recompute=True, segments=2)
+    assert abs(l1 - l2) < 1e-6
+    for n in g1:
+        np.testing.assert_allclose(g1[n], g2[n], atol=1e-6)
+
+
+def test_no_activation_residuals_held():
+    """Forward under recompute must not record tape nodes (that is where
+    activation residuals live in the eager engine)."""
+    paddle.seed(5)
+    blk = Block()
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = recompute(blk, x)
+    # output's grad node is the single PyLayer node, not the op-level chain
+    assert out.grad_node is not None
+    assert type(out.grad_node).__name__ == "_PyLayerGradNode"
+
+
+def test_stop_gradient_input_still_trains():
+    """Standard training loop: data input has stop_gradient=True; param
+    grads must still flow through the recomputed segment."""
+    paddle.seed(10)
+    blk = Block()
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+    assert x.stop_gradient
+    out = recompute(blk, x)
+    loss = paddle.ops.mean(out ** 2)
+    loss.backward()
+    grads = [p.grad for p in blk.parameters() if not p.stop_gradient]
+    assert all(g is not None for g in grads)
+
+    x2 = paddle.to_tensor(x.numpy())
+    for p in blk.parameters():
+        p.clear_grad()
+    loss2 = paddle.ops.mean(blk(x2) ** 2)
+    loss2.backward()
+    for p, g in zip([p for p in blk.parameters() if not p.stop_gradient],
+                    grads):
+        np.testing.assert_allclose(np.asarray(g._data),
+                                   np.asarray(p.grad._data), atol=1e-6)
+
+
+def test_mutation_between_forward_and_backward():
+    """In-place set_value on an input after the recompute forward must not
+    change the replay (inputs are snapshotted at forward time)."""
+    paddle.seed(11)
+    blk = Block()
+    xv = np.random.randn(4, 16).astype(np.float32)
+    x = paddle.to_tensor(xv, stop_gradient=False)
+    out = recompute(blk, x)
+    loss = paddle.ops.mean(out ** 2)
+    x.set_value(paddle.to_tensor(np.zeros_like(xv)))  # mutate AFTER forward
+    loss.backward()
+    got = {n: np.asarray(p.grad._data)
+           for n, p in blk.named_parameters() if p.grad is not None}
+
+    x2 = paddle.to_tensor(xv, stop_gradient=False)
+    for p in blk.parameters():
+        p.clear_grad()
+    loss2 = paddle.ops.mean(blk(x2) ** 2)
+    loss2.backward()
+    for n, p in blk.named_parameters():
+        if p.grad is not None:
+            np.testing.assert_allclose(got[n], np.asarray(p.grad._data),
+                                       atol=1e-6, err_msg=n)
+
+
+def test_tracker_stream_dropout_replay():
+    """Dropout drawing from the fleet RNGStatesTracker stream must replay
+    the same mask in the recompute pass."""
+    from paddle_tpu.distributed.fleet import get_rng_state_tracker
+
+    class TrackerDropBlock(nn.Layer):
+        def __init__(self, d=16):
+            super().__init__()
+            self.fc = nn.Linear(d, d)
+
+        def forward(self, x):
+            y = self.fc(x)
+            with get_rng_state_tracker().rng_state():
+                y = paddle.nn.functional.dropout(y, p=0.5,
+                                                 training=self.training)
+            return x + y
+
+    paddle.seed(12)
+    blk = TrackerDropBlock()
+    blk.train()
+    x = paddle.to_tensor(np.random.randn(64, 16).astype(np.float32),
+                         stop_gradient=False)
+    paddle.seed(77)
+    get_rng_state_tracker().reset()
+    l1 = paddle.ops.mean(blk(x) ** 2)
+    l1.backward()
+    g1 = {n: np.asarray(p.grad._data) for n, p in blk.named_parameters()}
+    for p in blk.parameters():
+        p.clear_grad()
+
+    paddle.seed(77)
+    get_rng_state_tracker().reset()
+    l2 = paddle.ops.mean(recompute(blk, x) ** 2)
+    l2.backward()
+    assert abs(float(l1.numpy()) - float(l2.numpy())) < 1e-6
+    for n, p in blk.named_parameters():
+        np.testing.assert_allclose(g1[n], np.asarray(p.grad._data),
+                                   atol=1e-6, err_msg=n)
+
+
+def test_mixed_outputs_cotangent_alignment():
+    """function returning (non_tensor, tensor): cotangents must pair with
+    outputs by position."""
+    paddle.seed(13)
+    blk = Block()
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32),
+                         stop_gradient=False)
+
+    def f(x):
+        return "aux", blk(x)
+
+    aux, out = recompute(f, x)
+    assert aux == "aux"
+    loss = paddle.ops.mean(out ** 2)
+    loss.backward()
+    got = {n: np.asarray(p.grad._data)
+           for n, p in blk.named_parameters() if p.grad is not None}
+    assert got
+
+    for p in blk.parameters():
+        p.clear_grad()
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    loss2 = paddle.ops.mean(blk(x2) ** 2)
+    loss2.backward()
+    for n, p in blk.named_parameters():
+        if p.grad is not None:
+            np.testing.assert_allclose(got[n], np.asarray(p.grad._data),
+                                       atol=1e-6, err_msg=n)
+
+
+def test_pipeline_layer_recompute_interval():
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    paddle.seed(6)
+    pl = PipelineLayer(layers=[LayerDesc(Block) for _ in range(4)],
+                       num_stages=1, recompute_interval=2)
+    pl.train()
+    x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32),
+                         stop_gradient=False)
+    out = pl(x)
+    loss = paddle.ops.mean(out ** 2)
+    loss.backward()
+    grads = [np.asarray(p.grad._data) for p in pl.parameters()
+             if p.grad is not None]
+    assert grads
+
+    pl2 = PipelineLayer(layers=list(pl.run_function), num_stages=1)
+    for p in pl2.parameters():
+        p.clear_grad()
+    x2 = paddle.to_tensor(x.numpy(), stop_gradient=False)
+    loss2 = paddle.ops.mean(pl2(x2) ** 2)
+    loss2.backward()
+    grads2 = [np.asarray(p.grad._data) for p in pl2.parameters()
+              if p.grad is not None]
+    for a, b in zip(grads, grads2):
+        np.testing.assert_allclose(a, b, atol=1e-6)
