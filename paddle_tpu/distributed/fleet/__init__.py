@@ -14,7 +14,9 @@ from .meta_optimizers import (DygraphShardingOptimizer,
                               HybridParallelClipGrad,
                               HybridParallelOptimizer)
 from .meta_parallel import (LayerDesc, PipelineLayer, PipelineParallel,
-                            SharedLayerDesc)
+                            SharedLayerDesc, ring_flash_attention,
+                            scatter_gather_attention)
+from .moe import MoELayer, TopKGate
 from .recompute import recompute, recompute_sequential
 from .mpu import (ColumnParallelLinear, ParallelCrossEntropy,
                   RowParallelLinear, VocabParallelEmbedding,
@@ -45,4 +47,6 @@ __all__ = [
     "mp_ops", "raw_ops",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     "recompute", "recompute_sequential",
+    "MoELayer", "TopKGate", "ring_flash_attention",
+    "scatter_gather_attention",
 ]

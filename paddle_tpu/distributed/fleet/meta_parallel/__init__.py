@@ -3,6 +3,7 @@ from .parallel_wrappers import (SegmentParallel, ShardingParallel,
 from .pp_layers import (LayerDesc, PipelineLayer, SegmentLayers,
                         SharedLayerDesc)
 from .pipeline_parallel import PipelineParallel, spmd_pipeline
+from .sep_utils import ring_flash_attention, scatter_gather_attention
 from .sharding.group_sharded_stage2 import GroupShardedStage2
 from .sharding.group_sharded_stage3 import GroupShardedStage3
 from .sharding.group_sharded_optimizer_stage2 import \
@@ -11,5 +12,6 @@ from .sharding.group_sharded_optimizer_stage2 import \
 __all__ = ["TensorParallel", "ShardingParallel", "SegmentParallel",
            "LayerDesc", "SharedLayerDesc", "SegmentLayers", "PipelineLayer",
            "PipelineParallel", "spmd_pipeline",
+           "ring_flash_attention", "scatter_gather_attention",
            "GroupShardedStage2", "GroupShardedStage3",
            "GroupShardedOptimizerStage2"]

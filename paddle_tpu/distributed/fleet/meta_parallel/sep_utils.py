@@ -1,0 +1,188 @@
+"""Sequence/context parallelism for long sequences: Ulysses all-to-all
+attention and ring flash attention over the ``sep`` mesh axis.
+
+Capability parity with the reference segment-parallel stack (reference:
+python/paddle/distributed/fleet/meta_parallel/segment_parallel.py:26 +
+fleet/utils/sequence_parallel_utils.py scatter/gather ops used for
+sep-axis attention). TPU-native designs:
+
+* ``scatter_gather_attention`` (DeepSpeed-Ulysses analog): activations are
+  global arrays sharded [B, S(sep), H, D]; a sharding transition to
+  [B, S, H(sep), D] makes XLA emit the all-to-all on ICI, local full-sequence
+  attention runs per head group, and the inverse transition restores
+  sequence sharding. Differentiable because resharding is.
+
+* ``ring_flash_attention`` (Ring Attention, Liu et al.): q stays put; k/v
+  blocks rotate around the sep ring with ``lax.ppermute`` while an online
+  log-sum-exp accumulator merges per-block partial attention — peak memory
+  O(S/P · d) per device and S² compute spread over the ring, which is how
+  sequences beyond one chip's HBM train. Causal masking uses global block
+  offsets; merging follows the flash-attention (m, l, acc) recurrence.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core import dispatch
+from ....core.tensor import Tensor
+from ... import mesh as mesh_mod
+
+NEG_INF = -1e30
+
+
+def _sep_size(mesh, axis):
+    return int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+
+
+# ---------------------------------------------------------------------------
+# Ulysses-style: all-to-all via sharding transition
+# ---------------------------------------------------------------------------
+
+def scatter_gather_attention(q, k, v, causal=False, scale=None,
+                             axis: str = "sep", attn_fn=None,
+                             dropout_p: float = 0.0):
+    """q/k/v: [B, S, H, D] Tensors, S sharded over ``axis``. Reshard heads
+    over the axis (XLA all-to-all), run full-sequence attention locally,
+    reshard back. Shardings on OTHER axes (dp on batch, mp on heads…) are
+    preserved — only the ``axis`` entry moves between the seq and head
+    dims."""
+    from ....nn.functional.flash_attention import _sdpa_xla
+    from ..mpu.mp_ops import _spec_of, _with_dim, _without_axes
+
+    mesh = mesh_mod.get_mesh()
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    drop_key = None
+    if dropout_p > 0.0:
+        from ....core.generator import next_key
+        drop_key = next_key()
+    inner = attn_fn or (lambda qa, ka, va: _sdpa_xla(
+        qa, ka, va, causal=causal, scale=sc, dropout_p=dropout_p,
+        key=drop_key))
+
+    # specs come from the CONCRETE inputs (tracers don't carry shardings):
+    # keep every non-`axis` entry, move `axis` seq<->head dim
+    in_specs = [_spec_of(t._data) for t in (q, k, v)]
+
+    def _move(spec, ndim, dim):
+        return _with_dim(_without_axes(spec, ndim, (axis,)), ndim, dim,
+                         (axis,))
+
+    def f(qa, ka, va):
+        if _sep_size(mesh, axis) == 1:
+            return inner(qa, ka, va)
+        qh, kh, vh = (
+            jax.lax.with_sharding_constraint(
+                t, NamedSharding(mesh, _move(spec, t.ndim, 2)))
+            for t, spec in zip((qa, ka, va), in_specs))
+        out = inner(qh, kh, vh)
+        return jax.lax.with_sharding_constraint(
+            out, NamedSharding(mesh, _move(in_specs[0], out.ndim, 1)))
+
+    return dispatch.call("scatter_gather_attention", f, [q, k, v])
+
+
+# ---------------------------------------------------------------------------
+# Ring attention
+# ---------------------------------------------------------------------------
+
+def _block_attn(q, k, v, q_off, k_off, scale, causal):
+    """Partial attention of q block vs k/v block with global positions.
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]. Returns (acc [B,Sq,H,D] fp32
+    un-normalized, m [B,Sq,H,1], l [B,Sq,H,1])."""
+    s = jnp.einsum("bshd,bthd->bsth", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = (q_pos >= k_pos)[None, :, :, None]
+        s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=2, keepdims=True)                 # [B,Sq,1,H]
+    m = jnp.maximum(m, NEG_INF / 2)  # keep fully-masked rows finite
+    p = jnp.exp(s - m)
+    if causal:
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=2, keepdims=True)                 # [B,Sq,1,H]
+    acc = jnp.einsum("bsth,bthd->bshd", p.astype(v.dtype),
+                     v).astype(jnp.float32)
+    # reshape m/l to [B,Sq,H,1]
+    return acc, m.transpose(0, 1, 3, 2), l.transpose(0, 1, 3, 2)
+
+
+def _ring_body(qa, ka, va, *, sep, scale, causal, local_seq,
+               axis_name="sep"):
+    """shard_map body over the sep axis: local q [B, S/P, H, D]."""
+    idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % sep) for i in range(sep)]
+
+    q_off = idx * local_seq
+
+    def step(carry, t):
+        k_cur, v_cur, m, l, acc = carry
+        src = (idx - t) % sep          # whose kv block we hold at step t
+        k_off = src * local_seq
+        a, m_b, l_b = _block_attn(qa, k_cur, v_cur, q_off, k_off, scale,
+                                  causal)
+        m_new = jnp.maximum(m, m_b)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_b - m_new)
+        acc = acc * alpha + a * beta
+        l = l * alpha + l_b * beta
+        # skip the rotation on the final step (its result is discarded) —
+        # one ICI hop of k+v saved per ring pass
+        k_nxt, v_nxt = jax.lax.cond(
+            t < sep - 1,
+            lambda kv: tuple(jax.lax.ppermute(x, axis_name, perm)
+                             for x in kv),
+            lambda kv: kv, (k_cur, v_cur))
+        return (k_nxt, v_nxt, m_new, l, acc), None
+
+    b, sq, h, d = qa.shape
+    # mark the accumulators device-varying over the ring axis so the scan
+    # carry type is stable under vma checking
+    m0 = jax.lax.pvary(jnp.full((b, sq, h, 1), NEG_INF, jnp.float32),
+                       axis_name)
+    l0 = jax.lax.pvary(jnp.zeros((b, sq, h, 1), jnp.float32), axis_name)
+    acc0 = jax.lax.pvary(jnp.zeros((b, sq, h, d), jnp.float32), axis_name)
+    (k_f, v_f, m, l, acc), _ = jax.lax.scan(
+        step, (ka, va, m0, l0, acc0), jnp.arange(sep))
+    out = acc / jnp.maximum(l, 1e-30)
+    return out.astype(qa.dtype)
+
+
+def ring_flash_attention(q, k, v, causal=False, scale=None,
+                         axis: str = "sep"):
+    """Ring attention: q/k/v [B, S, H, D] Tensors with S sharded over
+    ``axis``. KV blocks rotate around the ring; online-softmax merge.
+    Matches full attention exactly (up to fp reassociation)."""
+    mesh = mesh_mod.get_mesh()
+    sep = _sep_size(mesh, axis)
+    sc = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    seq = q.shape[1]
+    if sep == 1:
+        from ....nn.functional.flash_attention import _sdpa_xla
+        return dispatch.call(
+            "ring_flash_attention",
+            lambda qa, ka, va: _sdpa_xla(qa, ka, va, causal=causal,
+                                         scale=sc), [q, k, v])
+    if seq % sep:
+        raise ValueError(f"seq {seq} not divisible by {axis} size {sep}")
+    local_seq = seq // sep
+
+    body = functools.partial(_ring_body, sep=sep, scale=sc, causal=causal,
+                             local_seq=local_seq, axis_name=axis)
+    seq_spec = P(None, axis, None, None)
+
+    def f(qa, ka, va):
+        sm = jax.shard_map(body, mesh=mesh,
+                           in_specs=(seq_spec, seq_spec, seq_spec),
+                           out_specs=seq_spec,
+                           axis_names=frozenset({axis}), check_vma=True)
+        return sm(qa, ka, va)
+
+    return dispatch.call("ring_flash_attention", f, [q, k, v])

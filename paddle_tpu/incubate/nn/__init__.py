@@ -1,0 +1,5 @@
+"""incubate.nn: MoE layers at the reference import path (reference:
+python/paddle/incubate/distributed/models/moe/moe_layer.py MoELayer)."""
+from ...distributed.fleet.moe import MoELayer, TopKGate
+
+__all__ = ["MoELayer", "TopKGate"]

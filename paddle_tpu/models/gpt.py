@@ -37,10 +37,23 @@ class GPTConfig:
     use_flash_attention: bool = True
     mp_degree: int = 1              # tensor-parallel ways ('mp' mesh axis)
     sequence_parallel: bool = False
+    #: long-context attention backend over the 'sep' axis:
+    #: "" (dense/flash local), "ring" (ring attention), "ulysses"
+    #: (all-to-all head-scatter) — see fleet.meta_parallel.sep_utils
+    context_parallel: str = ""
 
     def __post_init__(self):
         if self.intermediate_size == 0:
             self.intermediate_size = 4 * self.hidden_size
+        if self.context_parallel not in ("", "ring", "ulysses"):
+            raise ValueError(
+                f"context_parallel must be '', 'ring' or 'ulysses', got "
+                f"{self.context_parallel!r}")
+        if self.context_parallel == "ring" and self.dropout > 0:
+            raise ValueError(
+                "attention dropout is not supported with ring attention "
+                "(the probability mask is never materialized globally); "
+                "set dropout=0 or use context_parallel='ulysses'")
 
 
 def gpt2_small(**kw) -> "GPTConfig":
@@ -75,6 +88,7 @@ class GPTAttention(nn.Layer):
         self.head_dim = cfg.hidden_size // cfg.num_heads
         self.use_flash = cfg.use_flash_attention
         self.dropout = cfg.dropout
+        self.context_parallel = cfg.context_parallel
         col, row, _ = _linears(cfg)
         h = cfg.hidden_size
         if col is not None:
@@ -94,7 +108,15 @@ class GPTAttention(nn.Layer):
         q = ops.reshape(q, [b, s, self.num_heads, self.head_dim])
         k = ops.reshape(k, [b, s, self.num_heads, self.head_dim])
         v = ops.reshape(v, [b, s, self.num_heads, self.head_dim])
-        if self.use_flash:
+        if self.context_parallel == "ring":
+            from ..distributed.fleet import ring_flash_attention
+            out = ring_flash_attention(q, k, v, causal=True)
+        elif self.context_parallel == "ulysses":
+            from ..distributed.fleet import scatter_gather_attention
+            out = scatter_gather_attention(
+                q, k, v, causal=True,
+                dropout_p=self.dropout if self.training else 0.0)
+        elif self.use_flash:
             out, _ = F.flash_attention(q, k, v, dropout=self.dropout,
                                        causal=True, training=self.training)
         else:

@@ -1,0 +1,198 @@
+"""MoE + sequence/context parallelism tests on the 8-dev virtual mesh.
+
+Reference analogs: test/collective/collective_global_gather.py MoE routing
+tests; the ring attention must equal full attention (the segment-parallel
+correctness contract).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.distributed.fleet import (MoELayer, TopKGate,
+                                          ring_flash_attention,
+                                          scatter_gather_attention)
+
+
+@pytest.fixture
+def sep_mesh():
+    old = mesh_mod._global_mesh
+    mesh = mesh_mod.set_mesh(mesh_mod.build_mesh({"dp": 2, "sep": 4}))
+    yield mesh
+    mesh_mod._global_mesh = old
+
+
+@pytest.fixture
+def mp_mesh():
+    old = mesh_mod._global_mesh
+    mesh = mesh_mod.set_mesh(mesh_mod.build_mesh({"dp": 2, "mp": 4}))
+    yield mesh
+    mesh_mod._global_mesh = old
+
+
+def _ref_attn(q, k, v, causal, scale):
+    logits = jnp.einsum("bshd,bthd->bhst", q, k).astype(jnp.float32) * scale
+    if causal:
+        s, t = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((s, t), bool))
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", p, v)
+
+
+class TestGate:
+    def test_top1_routing_shapes_and_capacity(self):
+        paddle.seed(0)
+        gate = TopKGate(16, 4, top_k=1, capacity_factor=1.0)
+        x = paddle.to_tensor(np.random.randn(32, 16).astype(np.float32))
+        combine, dispatch_m, aux = gate(x)
+        n, e, c = combine.shape
+        assert (n, e) == (32, 4) and c == max(int(1.0 * 32 * 1 / 4), 1)
+        d = np.asarray(dispatch_m._data)
+        # each capacity slot of each expert holds at most one token
+        assert d.sum(axis=0).max() <= 1.0 + 1e-6
+        # each token dispatched at most once (top-1)
+        assert d.sum(axis=(1, 2)).max() <= 1.0 + 1e-6
+        assert float(aux.numpy()) > 0
+
+    def test_top2_dispatches_two_experts(self):
+        paddle.seed(1)
+        gate = TopKGate(16, 4, top_k=2, capacity_factor=4.0)
+        x = paddle.to_tensor(np.random.randn(16, 16).astype(np.float32))
+        combine, dispatch_m, aux = gate(x)
+        d = np.asarray(dispatch_m._data)
+        # ample capacity: every token goes to exactly 2 experts
+        np.testing.assert_allclose(d.sum(axis=(1, 2)), 2.0)
+
+    def test_gate_weight_receives_grad(self):
+        paddle.seed(2)
+        gate = TopKGate(8, 2, top_k=1, capacity_factor=2.0)
+        x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+        combine, _, aux = gate(x)
+        loss = paddle.ops.sum(combine) + aux
+        loss.backward()
+        assert gate.weight.grad is not None
+
+
+class TestMoELayer:
+    def test_single_expert_equals_dense(self, mp_mesh):
+        paddle.seed(3)
+        moe = MoELayer(16, num_experts=1, d_hidden=32, top_k=1,
+                       capacity_factor=8.0)
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype(np.float32))
+        out = moe(x)
+        # with one expert and ample capacity every token routes to it with
+        # weight softmax([logit])=1
+        expert = moe.experts[0]
+        ref = expert(paddle.ops.reshape(x, [-1, 16]))
+        np.testing.assert_allclose(
+            np.asarray(out._data).reshape(-1, 16),
+            np.asarray(ref._data), atol=1e-5)
+
+    def test_expert_parallel_runs_and_backprops(self, mp_mesh):
+        paddle.seed(4)
+        moe = MoELayer(16, num_experts=4, d_hidden=32, top_k=2,
+                       capacity_factor=2.0, ep_axis="mp")
+        x = paddle.to_tensor(np.random.randn(4, 8, 16).astype(np.float32))
+        out = moe(x)
+        assert out.shape == [4, 8, 16]
+        loss = paddle.ops.mean(out ** 2) + 0.01 * moe.l_aux
+        loss.backward()
+        n_grads = sum(1 for p in moe.parameters() if p.grad is not None)
+        assert n_grads == len(list(moe.parameters()))
+
+    def test_heterogeneous_experts_rejected(self, mp_mesh):
+        class OtherExpert(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        from paddle_tpu.distributed.fleet.moe import _ExpertMLP
+        with pytest.raises(ValueError, match="identical in structure"):
+            MoELayer(16, num_experts=2,
+                     experts=[_ExpertMLP(16, 32), OtherExpert()])
+
+    def test_incubate_import_path(self):
+        from paddle_tpu.incubate.nn import MoELayer as M2
+        assert M2 is MoELayer
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, sep_mesh, causal):
+        rng = np.random.RandomState(0)
+        b, s, h, d = 2, 32, 4, 8
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        sh = NamedSharding(sep_mesh, P(None, "sep", None, None))
+        qt = paddle.Tensor(jax.device_put(q, sh), stop_gradient=False)
+        kt = paddle.Tensor(jax.device_put(k, sh), stop_gradient=False)
+        vt = paddle.Tensor(jax.device_put(v, sh), stop_gradient=False)
+        out = ring_flash_attention(qt, kt, vt, causal=causal)
+        ref = _ref_attn(q, k, v, causal, 1.0 / math.sqrt(d))
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   atol=2e-5)
+
+    def test_gradients_match(self, sep_mesh):
+        rng = np.random.RandomState(1)
+        b, s, h, d = 1, 16, 2, 8
+        qv = rng.randn(b, s, h, d).astype(np.float32)
+        kv = rng.randn(b, s, h, d).astype(np.float32)
+        vv = rng.randn(b, s, h, d).astype(np.float32)
+        sh = NamedSharding(sep_mesh, P(None, "sep", None, None))
+
+        qt = paddle.Tensor(jax.device_put(jnp.asarray(qv), sh),
+                           stop_gradient=False)
+        kt = paddle.Tensor(jax.device_put(jnp.asarray(kv), sh),
+                           stop_gradient=False)
+        vt = paddle.Tensor(jax.device_put(jnp.asarray(vv), sh),
+                           stop_gradient=False)
+        out = ring_flash_attention(qt, kt, vt, causal=True)
+        paddle.ops.sum(out ** 2).backward()
+
+        sc = 1.0 / math.sqrt(d)
+        g_ref = jax.grad(
+            lambda q, k, v: jnp.sum(_ref_attn(q, k, v, True, sc) ** 2),
+            argnums=(0, 1, 2))(jnp.asarray(qv), jnp.asarray(kv),
+                               jnp.asarray(vv))
+        for t, g in zip((qt, kt, vt), g_ref):
+            np.testing.assert_allclose(np.asarray(t.grad._data),
+                                       np.asarray(g), atol=5e-5)
+
+    def test_ring_sharding_preserved(self, sep_mesh):
+        b, s, h, d = 2, 32, 4, 8
+        sh = NamedSharding(sep_mesh, P(None, "sep", None, None))
+        mk = lambda: paddle.Tensor(jax.device_put(
+            jnp.ones((b, s, h, d), jnp.float32), sh))
+        out = ring_flash_attention(mk(), mk(), mk(), causal=False)
+        spec = out._data.sharding.spec
+        entries = tuple(spec) + (None,) * (4 - len(tuple(spec)))
+        assert entries == (None, "sep", None, None)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, sep_mesh, causal):
+        rng = np.random.RandomState(2)
+        b, s, h, d = 2, 32, 4, 8   # h divisible by sep=4
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        sh = NamedSharding(sep_mesh, P(None, "sep", None, None))
+        qt = paddle.Tensor(jax.device_put(q, sh))
+        kt = paddle.Tensor(jax.device_put(k, sh))
+        vt = paddle.Tensor(jax.device_put(v, sh))
+        out = scatter_gather_attention(qt, kt, vt, causal=causal)
+        ref = _ref_attn(q, k, v, causal, 1.0 / math.sqrt(d))
+        np.testing.assert_allclose(np.asarray(out._data), np.asarray(ref),
+                                   atol=2e-5)
