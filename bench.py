@@ -2,12 +2,16 @@
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Measures the flagship GPT-2 small (124M) full training step — forward +
-backward + AdamW update compiled as ONE XLA program (the steady-state path)
-— on the available accelerator, and reports tokens/sec plus MFU versus the
-chip's peak bf16 FLOPs. ``vs_baseline`` is our MFU divided by 0.40, the
-published A100 GPT-class MFU reference (BASELINE.md: the reference repo
-publishes no absolute numbers, so external A100 MFU is the bar).
+Default (the driver's call): flagship GPT-2 small (124M) full training
+step — forward + backward + AdamW update compiled as ONE XLA program (the
+steady-state path) — reporting tokens/sec plus MFU versus the chip's peak
+bf16 FLOPs. ``vs_baseline`` is our MFU divided by 0.40, the published A100
+GPT-class MFU reference (BASELINE.md: the reference repo publishes no
+absolute numbers, so external A100 MFU is the bar).
+
+Ladder rungs (BASELINE.md configs 2-3): ``BENCH_MODEL=resnet50`` and
+``BENCH_MODEL=bert`` run those models' train steps through the same
+harness and report images/s / tokens/s.
 """
 import json
 import os
@@ -44,45 +48,25 @@ def chip_peak_flops(device) -> float:
     return 1e12  # CPU fallback so the math stays finite
 
 
-def main():
-    if os.environ.get("BENCH_SMALL") == "1":
-        # local testing: force the host platform before any backend init
-        jax.config.update("jax_platforms", "cpu")
-    on_tpu = jax.default_backend() in ("tpu", "axon")
-    small = (not on_tpu) or os.environ.get("BENCH_SMALL") == "1"
-
-    import paddle_tpu as paddle
+def _run_train_bench(model, params, make_inputs, loss_of, iters):
+    """Shared harness: jit fwd+bwd+AdamW as one program; each timed iter
+    uses a DIFFERENT input batch (the axon tunnel replays identical
+    executions from cache, which would fake the timing otherwise)."""
+    import paddle_tpu as paddle  # noqa: F401
     from paddle_tpu import amp
-    from paddle_tpu.models import GPTConfig, GPTForCausalLM
 
-    if small:
-        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
-                        num_heads=4, max_seq_len=128,
-                        use_flash_attention=False)
-        batch, seq, iters = 2, 128, 2
-    else:
-        cfg = GPTConfig(max_seq_len=1024)
-        batch, seq, iters = 8, 1024, 5
-
-    model = GPTForCausalLM(cfg)
-    params = [p for p in model.parameters() if not p.stop_gradient]
-
-    # AdamW state as raw arrays: the whole update lives inside the step.
     b1, b2, eps, wd, lr = 0.9, 0.95, 1e-8, 0.1, 2.5e-4
     m_state = [jnp.zeros_like(p._data) for p in params]
     v_state = [jnp.zeros_like(p._data) for p in params]
 
-    ids_np = np.random.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int64)
-
-    def train_step(param_arrays, m_st, v_st, step_t, ids):
+    def train_step(param_arrays, m_st, v_st, step_t, *inputs):
         def loss_fn(pa):
             originals = [p._data for p in params]
             for p, a in zip(params, pa):
                 p._data = a
             try:
                 with amp.auto_cast(level="O1", dtype="bfloat16"):
-                    _, loss = model(paddle.Tensor(ids),
-                                    labels=paddle.Tensor(ids))
+                    loss = loss_of(model, *inputs)
                 return loss._data.astype(jnp.float32)
             finally:
                 for p, o in zip(params, originals):
@@ -104,48 +88,166 @@ def main():
         return loss, new_p, new_m, new_v
 
     jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
-
     pa = [p._data for p in params]
-    ids = jnp.asarray(ids_np)
-    step_t = jnp.asarray(1, jnp.int32)
+    batches = [make_inputs(i) for i in range(iters + 1)]
 
-    # compile + warmup
-    loss0, pa, m_state, v_state = jitted(pa, m_state, v_state, step_t, ids)
+    loss0, pa, m_state, v_state = jitted(
+        pa, m_state, v_state, jnp.asarray(1, jnp.int32), *batches[0])
     jax.block_until_ready(loss0)
     loss0 = float(loss0)
 
     t0 = time.perf_counter()
     for i in range(iters):
         loss, pa, m_state, v_state = jitted(
-            pa, m_state, v_state, jnp.asarray(2 + i, jnp.int32), ids)
+            pa, m_state, v_state, jnp.asarray(2 + i, jnp.int32),
+            *batches[1 + i])
     jax.block_until_ready(loss)
     dt = (time.perf_counter() - t0) / iters
-    loss_end = float(loss)
-
-    tokens_per_sec = batch * seq / dt
     n_params = sum(int(np.prod(p.shape)) for p in pa)
-    flops_per_token = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
-    achieved = flops_per_token * tokens_per_sec
-    peak = chip_peak_flops(jax.devices()[0])
-    mfu = achieved / peak
+    return dt, loss0, float(loss), n_params
 
-    result = {
+
+def _bench_gpt(small):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if small:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        use_flash_attention=False)
+        batch, seq, iters = 2, 128, 2
+    else:
+        cfg = GPTConfig(max_seq_len=1024)
+        batch, seq, iters = 8, 1024, 5
+    model = GPTForCausalLM(cfg)
+    params = [p for p in model.parameters() if not p.stop_gradient]
+
+    def make_inputs(i):
+        rng = np.random.RandomState(i)
+        return (jnp.asarray(rng.randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int64)),)
+
+    def loss_of(model, ids):
+        import paddle_tpu as paddle
+        _, loss = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        return loss
+
+    dt, loss0, loss_end, n_params = _run_train_bench(
+        model, params, make_inputs, loss_of, iters)
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_layers * cfg.hidden_size * seq
+    mfu = flops_per_token * tokens_per_sec / chip_peak_flops(
+        jax.devices()[0])
+    return {
         "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
                   if not small else "gpt_tiny_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
-        "extra": {
-            "step_time_s": round(dt, 4),
-            "mfu": round(mfu, 4),
-            "params": n_params,
-            "device": str(getattr(jax.devices()[0], "device_kind",
-                                  jax.default_backend())),
-            "loss_first": round(loss0, 3),
-            "loss_last": round(loss_end, 3),
-        },
+        "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
+                  "params": n_params,
+                  "device": str(getattr(jax.devices()[0], "device_kind",
+                                        jax.default_backend())),
+                  "loss_first": round(loss0, 3),
+                  "loss_last": round(loss_end, 3)},
     }
-    print(json.dumps(result))
+
+
+def _bench_resnet50(small):
+    import paddle_tpu as paddle
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.vision.models import resnet50
+
+    batch, hw, iters = (4, 64, 2) if small else (64, 224, 5)
+    model = resnet50()
+    model.train()
+    params = [p for p in model.parameters() if not p.stop_gradient]
+
+    def make_inputs(i):
+        rng = np.random.RandomState(i)
+        return (jnp.asarray(rng.randn(batch, 3, hw, hw)
+                            .astype(np.float32)),
+                jnp.asarray(rng.randint(0, 1000, (batch,))
+                            .astype(np.int64)))
+
+    def loss_of(model, x, y):
+        logits = model(paddle.Tensor(x))
+        return F.cross_entropy(logits, paddle.Tensor(y))
+
+    dt, loss0, loss_end, n_params = _run_train_bench(
+        model, params, make_inputs, loss_of, iters)
+    imgs_per_sec = batch / dt
+    # ~2080 A100 img/s for fp16 ResNet50 training (MLPerf-class number)
+    return {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(imgs_per_sec, 1),
+        "unit": "images/s",
+        "vs_baseline": round(imgs_per_sec / 2080.0, 4),
+        "extra": {"step_time_s": round(dt, 4), "params": n_params,
+                  "batch": batch, "loss_first": round(loss0, 3),
+                  "loss_last": round(loss_end, 3)},
+    }
+
+
+def _bench_bert(small):
+    import paddle_tpu as paddle
+    from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+    if small:
+        cfg = BertConfig(vocab_size=512, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         intermediate_size=256,
+                         max_position_embeddings=128,
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        batch, seq, iters = 2, 128, 2
+    else:
+        cfg = BertConfig(hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0)
+        batch, seq, iters = 16, 512, 5
+    model = BertForPretraining(cfg)
+    params = [p for p in model.parameters() if not p.stop_gradient]
+
+    def make_inputs(i):
+        rng = np.random.RandomState(i)
+        return (jnp.asarray(rng.randint(0, cfg.vocab_size, (batch, seq))
+                            .astype(np.int64)),)
+
+    def loss_of(model, ids):
+        _, _, loss = model(paddle.Tensor(ids),
+                           masked_lm_labels=paddle.Tensor(ids))
+        return loss
+
+    dt, loss0, loss_end, n_params = _run_train_bench(
+        model, params, make_inputs, loss_of, iters)
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_hidden_layers * cfg.hidden_size * seq
+    mfu = flops_per_token * tokens_per_sec / chip_peak_flops(
+        jax.devices()[0])
+    return {
+        "metric": "bert_base_mlm_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
+                  "params": n_params, "loss_first": round(loss0, 3),
+                  "loss_last": round(loss_end, 3)},
+    }
+
+
+def main():
+    if os.environ.get("BENCH_SMALL") == "1":
+        # local testing: force the host platform before any backend init
+        jax.config.update("jax_platforms", "cpu")
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    small = (not on_tpu) or os.environ.get("BENCH_SMALL") == "1"
+
+    which = os.environ.get("BENCH_MODEL", "gpt2")
+    bench = {"gpt2": _bench_gpt, "resnet50": _bench_resnet50,
+             "bert": _bench_bert}[which]
+    print(json.dumps(bench(small)))
 
 
 if __name__ == "__main__":

@@ -188,6 +188,19 @@ def _check_nan_inf(op_name, outs):
                 print(f"[paddle_tpu][nan_inf] {msg}")
 
 
+def _lazy_vjp(f, arrays):
+    """Deferred vjp for ops recorded under an outer trace: linearize only
+    when the tape backward actually runs (while the tracers are live)."""
+    state = {}
+
+    def vjp_fn(cts):
+        if "vjp" not in state:
+            _, state["vjp"] = jax.vjp(f, *arrays)
+        return state["vjp"](cts)
+
+    return vjp_fn
+
+
 def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
          attrs: Optional[dict] = None, multi_output: bool = False,
          differentiable_mask: Optional[Sequence[bool]] = None):
@@ -214,10 +227,17 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
         f = fn
 
     node = None
-    if record:
+    traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
+    if record and not traced:
         outs, vjp_fn = jax.vjp(f, *arrays)
     else:
+        # Under an outer jax transform the eager linearization is wasted
+        # work (the transform differentiates the primal directly) and
+        # breaks custom_vjp kernels (second-order AD). Compute the primal
+        # only; if the tape IS walked while the trace is live (recompute
+        # replay), derive the vjp lazily then.
         outs = f(*arrays)
+        vjp_fn = _lazy_vjp(f, arrays) if record else None
 
     out_tuple = isinstance(outs, (tuple, list))
     single = not out_tuple

@@ -1,0 +1,4 @@
+from .model import Model
+from .summary import summary
+
+__all__ = ["Model", "summary"]

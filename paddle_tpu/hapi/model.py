@@ -1,0 +1,193 @@
+"""hapi Model — the high-level train/eval/predict facade.
+
+Capability parity with the reference high-level API (reference:
+python/paddle/hapi/model.py Model:1000 region — prepare/fit/evaluate/
+predict/save/load over a Layer + optimizer + loss + metrics). TPU-native:
+train_batch is plain eager dispatch (each op an XLA call); the whole-step
+jit path comes from wrapping the network with paddle.jit.to_static before
+constructing the Model, exactly like the reference's prepare(amp_configs)
+composition.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List = []
+        self.stop_training = False
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        return self
+
+    # ------------------------------------------------------- batch methods
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        labs = _to_list(labels)
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) first")
+        return self._loss(*outs, *labs)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        outputs = self.network(*_to_list(inputs))
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        outputs = self.network(*_to_list(inputs))
+        loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return ([float(loss.numpy())], metrics) if metrics else \
+            [float(loss.numpy())]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outputs = self.network(*_to_list(inputs))
+        return [o.numpy() if isinstance(o, Tensor) else o
+                for o in _to_list(outputs)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        for m in self._metrics:
+            correct = m.compute(*_to_list(outputs), *_to_list(labels))
+            m.update(*[np.asarray(c.numpy() if isinstance(c, Tensor) else c)
+                       for c in _to_list(correct)])
+            res.append(m.accumulate())
+        return res
+
+    # ------------------------------------------------------------ fit loop
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        from ..io import DataLoader
+        loader = train_data
+        if not isinstance(train_data, DataLoader):
+            loader = DataLoader(train_data, batch_size=batch_size,
+                                shuffle=shuffle, drop_last=drop_last,
+                                num_workers=num_workers)
+        history = []
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            losses = []
+            for step, batch in enumerate(loader):
+                batch = _to_list(batch)
+                xs, ys = batch[:-1], batch[-1:]
+                out = self.train_batch(xs, ys)
+                loss = out[0][0] if isinstance(out, tuple) else out[0]
+                losses.append(loss)
+                if verbose and log_freq and step % log_freq == 0:
+                    msg = f"epoch {epoch} step {step} loss {loss:.4f}"
+                    for m, v in zip(self._metrics,
+                                    out[1] if isinstance(out, tuple)
+                                    else []):
+                        msg += f" {m.name()}={v}"
+                    print(msg)
+            history.append(float(np.mean(losses)))
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+            if save_dir and (epoch + 1) % max(save_freq, 1) == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        from ..io import DataLoader
+        loader = eval_data
+        if not isinstance(eval_data, DataLoader):
+            loader = DataLoader(eval_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            batch = _to_list(batch)
+            xs, ys = batch[:-1], batch[-1:]
+            out = self.eval_batch(xs, ys)
+            losses.append(out[0][0] if isinstance(out, tuple) else out[0])
+        result = {"loss": [float(np.mean(losses))]}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        if verbose:
+            print("eval:", result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None):
+        from ..io import DataLoader
+        loader = test_data
+        if not isinstance(test_data, DataLoader):
+            loader = DataLoader(test_data, batch_size=batch_size,
+                                num_workers=num_workers)
+        outs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            # split inputs from trailing labels: the Model(inputs=...)
+            # spec decides when given (reference contract); otherwise fall
+            # back to dropping one trailing label when a loss was prepared
+            if self._inputs is not None:
+                batch = batch[:len(_to_list(self._inputs))]
+            elif self._loss is not None and len(batch) > 1:
+                batch = batch[:-1]
+            outs.append(self.predict_batch(batch))
+        if stack_outputs and outs:
+            n = len(outs[0])
+            return [np.concatenate([o[i] for o in outs]) for i in range(n)]
+        return outs
+
+    # ------------------------------------------------------------ save/load
+    def save(self, path, training=True):
+        from ..framework.io import save as _save
+        _save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            _save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        import os
+
+        from ..framework.io import load as _load
+        self.network.set_state_dict(_load(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path) and \
+                hasattr(self._optimizer, "set_state_dict"):
+            self._optimizer.set_state_dict(_load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,4 @@
+"""paddle.vision surface (reference: python/paddle/vision/)."""
+from . import models  # noqa: F401
+
+__all__ = ["models"]

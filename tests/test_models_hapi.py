@@ -1,0 +1,190 @@
+"""ResNet / BERT / hapi Model / metric tests.
+
+Reference analogs: test/legacy_test/test_resnet*.py (loss decreases),
+test_bert fixtures under to_static, python/paddle/hapi tests (fit/
+evaluate/predict round trip), metric unit tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+class TestResNet:
+    def test_resnet18_trains(self):
+        from paddle_tpu.vision.models import resnet18
+        paddle.seed(0)
+        m = resnet18(num_classes=4)
+        m.train()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 3, 32, 32)
+                             .astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3]))
+        losses = []
+        for _ in range(4):
+            loss = paddle.nn.functional.cross_entropy(m(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_resnet50_structure(self):
+        from paddle_tpu.vision.models import resnet50
+        m = resnet50()
+        n = sum(p.size for p in m.parameters())
+        # reference resnet50 (1000 classes): 25.6M params
+        assert abs(n - 25_557_032) < 10_000, n
+
+    def test_bn_running_stats_update(self):
+        from paddle_tpu.vision.models import resnet18
+        m = resnet18(num_classes=2)
+        m.train()
+        before = np.asarray(m.bn1._mean._data).copy()
+        x = paddle.to_tensor(
+            np.random.randn(2, 3, 32, 32).astype(np.float32) + 3.0)
+        m(x)
+        after = np.asarray(m.bn1._mean._data)
+        assert not np.allclose(before, after)
+
+
+class TestBert:
+    def _cfg(self):
+        from paddle_tpu.models.bert import BertConfig
+        return BertConfig(vocab_size=128, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=2,
+                          intermediate_size=64,
+                          max_position_embeddings=32,
+                          hidden_dropout_prob=0.0,
+                          attention_probs_dropout_prob=0.0)
+
+    def test_classification_trains(self):
+        from paddle_tpu.models.bert import BertForSequenceClassification
+        paddle.seed(0)
+        m = BertForSequenceClassification(self._cfg(), num_classes=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=m.parameters())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (4, 16)).astype(np.int64))
+        y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        losses = []
+        for _ in range(4):
+            _, loss = m(ids, labels=y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_attention_mask_effect(self):
+        from paddle_tpu.models.bert import BertModel
+        paddle.seed(1)
+        m = BertModel(self._cfg())
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (1, 8)).astype(np.int64))
+        full = np.ones((1, 8), np.float32)
+        half = full.copy()
+        half[0, 4:] = 0
+        s1, _ = m(ids, attention_mask=paddle.to_tensor(full))
+        s2, _ = m(ids, attention_mask=paddle.to_tensor(half))
+        assert not np.allclose(s1.numpy(), s2.numpy())
+
+    def test_under_to_static(self):
+        from paddle_tpu.models.bert import BertForSequenceClassification
+        paddle.seed(2)
+        m = BertForSequenceClassification(self._cfg(), num_classes=2)
+        m.eval()
+        ids = paddle.to_tensor(
+            np.random.randint(0, 128, (2, 16)).astype(np.int64))
+        ref = m(ids).numpy()
+        st = paddle.jit.to_static(m)
+        out = st(ids).numpy()
+        np.testing.assert_allclose(ref, out, atol=1e-5)
+
+
+class TestHapiModel:
+    def _dataset(self, n=32):
+        from paddle_tpu.io import Dataset
+
+        class XorDs(Dataset):
+            def __init__(self):
+                rng = np.random.RandomState(0)
+                self.x = rng.randn(n, 8).astype(np.float32)
+                self.y = (self.x[:, :1] > 0).astype(np.int64).reshape(-1)
+
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return self.x[i], self.y[i]
+
+        return XorDs()
+
+    def test_fit_evaluate_predict(self, tmp_path):
+        from paddle_tpu.hapi import Model
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 2))
+        model = Model(net)
+        model.prepare(
+            optimizer=paddle.optimizer.AdamW(learning_rate=1e-2,
+                                             parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(),
+            metrics=Accuracy())
+        ds = self._dataset()
+        hist = model.fit(ds, batch_size=8, epochs=3, verbose=0)
+        assert hist[-1] < hist[0]
+        res = model.evaluate(ds, batch_size=8, verbose=0)
+        assert res["acc"] > 0.6
+        preds = model.predict(ds, batch_size=8, stack_outputs=True)
+        assert preds[0].shape == (32, 2)
+        model.save(str(tmp_path / "ckpt"))
+        model.load(str(tmp_path / "ckpt"))
+
+    def test_summary(self):
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+        info = paddle.summary(net, (1, 8))
+        assert info["total_params"] == 8 * 16 + 16 + 16 * 2 + 2
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.7, 0.2], [0.8, 0.1, 0.1]])
+        label = np.array([1, 2])
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert abs(top1 - 0.5) < 1e-6
+        assert abs(top2 - 0.5) < 1e-6
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.7])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert abs(p.accumulate() - 2 / 3) < 1e-6
+        assert abs(r.accumulate() - 2 / 3) < 1e-6
+
+    def test_auc_perfect_separation(self):
+        auc = Auc()
+        auc.update(np.array([0.9, 0.8, 0.1, 0.2]),
+                   np.array([1, 1, 0, 0]))
+        assert auc.accumulate() > 0.99
+
+    def test_accuracy_column_labels(self):
+        # conventional [B, 1] integer label column is indices, not one-hot
+        m = Accuracy()
+        pred = np.array([[0.1, 0.9], [0.2, 0.8]])
+        label = np.array([[1], [1]])
+        m.update(m.compute(pred, label))
+        assert abs(m.accumulate() - 1.0) < 1e-6
+
+    def test_auc_saturated_bins(self):
+        # all scores land in one histogram bin: AUC is 0.5, not 0
+        auc = Auc()
+        auc.update(np.array([1.0, 1.0]), np.array([1, 0]))
+        assert abs(auc.accumulate() - 0.5) < 1e-3
