@@ -76,7 +76,7 @@ def summary(net, input_size=None, dtypes=None, input=None):
 _LAZY_MODULES = {
     "nn", "optimizer", "amp", "io", "jit", "distributed", "vision", "metric",
     "profiler", "autograd", "incubate", "framework", "device", "static", "hapi",
-    "distribution", "linalg", "fft", "sparse", "text", "onnx", "quantization",
+    "distribution", "linalg", "fft", "signal", "sparse", "text", "onnx", "quantization",
     "models", "utils",
 }
 

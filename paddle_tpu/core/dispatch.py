@@ -213,7 +213,17 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
         _bind_engine()
 
     arrays = [t._data for t in tensor_inputs]
-    arrays = _amp_cast_inputs(op_name, arrays)
+    amp_cast = _amp_cast_inputs(op_name, arrays)
+    if amp_cast is not arrays:
+        # fold the AMP cast INTO the differentiated function so vjp
+        # cotangents keep the ORIGINAL input/output dtypes — an out-of-band
+        # cast would hand consumers mismatched-dtype cotangents
+        inner, targets = fn, [a.dtype for a in amp_cast]
+
+        def fn(*xs, __inner=inner, __targets=targets, **kw):
+            cast = [x.astype(d) if hasattr(x, "astype") and x.dtype != d
+                    else x for x, d in zip(xs, __targets)]
+            return __inner(*cast, **kw)
 
     requires = [
         (not t.stop_gradient) and (differentiable_mask[i] if differentiable_mask else True)

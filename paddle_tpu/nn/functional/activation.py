@@ -197,5 +197,18 @@ __all__ = [
     "tanhshrink", "log_sigmoid", "gelu", "leaky_relu", "elu", "celu", "selu",
     "hardswish", "hardsigmoid", "hardtanh", "hardshrink", "softshrink",
     "softplus", "prelu", "rrelu", "softmax", "log_softmax", "gumbel_softmax",
-    "maxout", "glu", "thresholded_relu",
+    "maxout", "glu", "thresholded_relu", "swiglu",
 ]
+
+
+def swiglu(x, y=None, name=None):
+    """SwiGLU: silu(x) * y; single-arg form splits x in half on the last
+    dim (reference ops.yaml swiglu, used by LLaMA MLPs)."""
+    from ...core import dispatch as _dispatch
+    if y is None:
+        def f(a):
+            u, v = jnp.split(a, 2, axis=-1)
+            return jax.nn.silu(u) * v
+        return _dispatch.call("swiglu", f, [_t(x)])
+    return _dispatch.call("swiglu",
+                          lambda a, b: jax.nn.silu(a) * b, [_t(x), _t(y)])

@@ -339,3 +339,26 @@ __all__ = [
     "unfold", "fold", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
     "cosine_similarity", "bilinear", "label_smooth",
 ]
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    """[B] lengths -> [B, maxlen] 0/1 mask (reference ops.yaml
+    sequence_mask). maxlen=None derives it from the (concrete) lengths
+    BEFORE tracing — under capture, pass an explicit maxlen."""
+    import jax as _jax
+
+    from ...core import dispatch as _dispatch
+    t = lengths if isinstance(lengths, Tensor) else as_tensor(lengths)
+    if maxlen is None:
+        if isinstance(t._data, _jax.core.Tracer):
+            raise ValueError(
+                "sequence_mask(maxlen=None) needs concrete lengths; pass "
+                "an explicit maxlen under jit/to_static (shapes must be "
+                "static)")
+        maxlen = int(jnp.max(t._data))
+
+    def f(l):
+        return (jnp.arange(maxlen)[None, :] < l[..., None]).astype(dtype)
+    return _dispatch.call("sequence_mask", f, [t])
+
+__all__ += ['sequence_mask']

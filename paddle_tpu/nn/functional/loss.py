@@ -336,3 +336,34 @@ __all__ = [
     "hinge_embedding_loss", "cosine_embedding_loss", "triplet_margin_loss",
     "sigmoid_focal_loss", "square_error_cost", "ctc_loss",
 ]
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """Negative log likelihood for probabilities (reference ops.yaml
+    log_loss)."""
+    i, l = _t(input), _t(label)
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(
+            1 - p + epsilon)
+    return dispatch.call("log_loss", f, [i, l])
+
+
+def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
+    """True Huber loss (reference ops.yaml huber_loss):
+    0.5*d^2 for |d|<delta else delta*(|d| - 0.5*delta). Note this is NOT
+    smooth_l1 (which divides by delta)."""
+    i, l = _t(input), _t(label)
+
+    def f(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        out = jnp.where(ad < delta, 0.5 * d * d,
+                        delta * (ad - 0.5 * delta))
+        if reduction == "mean":
+            return jnp.mean(out)
+        if reduction == "sum":
+            return jnp.sum(out)
+        return out
+    return dispatch.call("huber_loss", f, [i, l])
+
+__all__ += ['log_loss', 'huber_loss']

@@ -1,0 +1,421 @@
+"""Recurrent layers: cells, RNN/BiRNN drivers, SimpleRNN/LSTM/GRU stacks.
+
+Capability parity with the reference recurrent stack (reference:
+python/paddle/nn/layer/rnn.py — RNNCellBase:551, SimpleRNNCell:697,
+LSTMCell:874, GRUCell:1100, RNN:1293, BiRNN:1366, RNNBase cudnn-flattened
+multi-layer driver:1694, SimpleRNN:1758, LSTM:1881, GRU:2018). TPU-native:
+the time loop is ONE ``lax.scan`` per direction (compiled once, no Python
+step loop), gate matmuls are batched [B, 4H]-style MXU ops, and the whole
+multi-layer stack stays inside a single dispatch op so XLA fuses gates +
+activations per step.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core import dispatch
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from ..parameter import ParamAttr
+from .layers import Layer
+
+
+def _uniform_attr(hidden_size):
+    k = 1.0 / math.sqrt(hidden_size)
+    return ParamAttr(initializer=Uniform(-k, k))
+
+
+class RNNCellBase(Layer):
+    """reference rnn.py:551 — get_initial_states helper."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape or (self.hidden_size,)
+        return Tensor(jnp.full((batch,) + tuple(shape), init_value,
+                               jnp.float32))
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError
+
+    def gate_params(self):
+        """(weight_ih, weight_hh, bias_ih, bias_hh) tensors."""
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference rnn.py:697)."""
+
+    n_gates = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+        attr = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [hidden_size, input_size], attr=weight_ih_attr or attr)
+        self.weight_hh = self.create_parameter(
+            [hidden_size, hidden_size], attr=weight_hh_attr or attr)
+        self.bias_ih = self.create_parameter(
+            [hidden_size], attr=bias_ih_attr or attr, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [hidden_size], attr=bias_hh_attr or attr, is_bias=True)
+
+    @staticmethod
+    def step(params, x, h, activation="tanh"):
+        w_ih, w_hh, b_ih, b_hh = params
+        z = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        h_new = jnp.tanh(z) if activation == "tanh" else jax.nn.relu(z)
+        return h_new, h_new
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else \
+            self.get_initial_states(inputs)
+        def f(x, hh, w_ih, w_hh, b_ih, b_hh):
+            return self.step((w_ih, w_hh, b_ih, b_hh), x, hh,
+                             self.activation)
+        out = dispatch.call(
+            "simple_rnn_cell", f,
+            [inputs if isinstance(inputs, Tensor) else Tensor(inputs),
+             h, *self.gate_params()])
+        return out[0], out[1]
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    """i,f,g,o gates (reference rnn.py:874)."""
+
+    n_gates = 4
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        attr = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [4 * hidden_size, input_size], attr=weight_ih_attr or attr)
+        self.weight_hh = self.create_parameter(
+            [4 * hidden_size, hidden_size], attr=weight_hh_attr or attr)
+        self.bias_ih = self.create_parameter(
+            [4 * hidden_size], attr=bias_ih_attr or attr, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [4 * hidden_size], attr=bias_hh_attr or attr, is_bias=True)
+
+    @staticmethod
+    def step(params, x, state, activation=None):
+        w_ih, w_hh, b_ih, b_hh = params
+        h, c = state
+        gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = (jax.nn.sigmoid(v) for v in (i, f, o))
+        g = jnp.tanh(g)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def f(x, hh, cc, w_ih, w_hh, b_ih, b_hh):
+            h_new, (_, c_new) = self.step((w_ih, w_hh, b_ih, b_hh), x,
+                                          (hh, cc))
+            return h_new, c_new
+        out = dispatch.call(
+            "lstm_cell", f,
+            [inputs if isinstance(inputs, Tensor) else Tensor(inputs),
+             h, c, *self.gate_params()])
+        return out[0], (out[0], out[1])
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    """r,z,c gates (reference rnn.py:1100; paddle gate order r,z,c)."""
+
+    n_gates = 3
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        attr = _uniform_attr(hidden_size)
+        self.weight_ih = self.create_parameter(
+            [3 * hidden_size, input_size], attr=weight_ih_attr or attr)
+        self.weight_hh = self.create_parameter(
+            [3 * hidden_size, hidden_size], attr=weight_hh_attr or attr)
+        self.bias_ih = self.create_parameter(
+            [3 * hidden_size], attr=bias_ih_attr or attr, is_bias=True)
+        self.bias_hh = self.create_parameter(
+            [3 * hidden_size], attr=bias_hh_attr or attr, is_bias=True)
+
+    @staticmethod
+    def step(params, x, h, activation=None):
+        w_ih, w_hh, b_ih, b_hh = params
+        gx = x @ w_ih.T + b_ih
+        gh = h @ w_hh.T + b_hh
+        rx, zx, cx = jnp.split(gx, 3, axis=-1)
+        rh, zh, ch = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(rx + rh)
+        z = jax.nn.sigmoid(zx + zh)
+        c = jnp.tanh(cx + r * ch)
+        h_new = (1.0 - z) * c + z * h
+        return h_new, h_new
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else \
+            self.get_initial_states(inputs)
+
+        def f(x, hh, w_ih, w_hh, b_ih, b_hh):
+            return self.step((w_ih, w_hh, b_ih, b_hh), x, hh)
+        out = dispatch.call(
+            "gru_cell", f,
+            [inputs if isinstance(inputs, Tensor) else Tensor(inputs),
+             h, *self.gate_params()])
+        return out[0], out[1]
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+def _scan_direction(cell_cls, params, xs, init_state, activation,
+                    reverse=False):
+    """lax.scan over time. xs: [T, B, I]; returns (outs [T,B,H], final)."""
+    def body(state, x):
+        out, new_state = cell_cls.step(params, x, state, activation)
+        return new_state, out
+
+    if reverse:
+        xs = xs[::-1]
+    final, outs = jax.lax.scan(body, init_state, xs)
+    if reverse:
+        outs = outs[::-1]
+    return outs, final
+
+
+class RNN(Layer):
+    """Single-cell driver (reference rnn.py:1293): scans the cell over the
+    time dim."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "variable-length sequences: pad + mask externally")
+        cell = self.cell
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        is_lstm = isinstance(cell, LSTMCell)
+
+        if initial_states is None:
+            batch = x.shape[0] if not self.time_major else x.shape[1]
+            h0 = jnp.zeros((batch, cell.hidden_size), jnp.float32)
+            init = (h0, h0) if is_lstm else h0
+            init_tensors = [Tensor(h0), Tensor(h0)] if is_lstm \
+                else [Tensor(h0)]
+        else:
+            init_tensors = list(initial_states) if is_lstm \
+                else [initial_states]
+
+        params = cell.gate_params()
+        act = getattr(cell, "activation", None)
+        time_major = self.time_major
+        reverse = self.is_reverse
+
+        def f(xa, *rest):
+            n_state = 2 if is_lstm else 1
+            state = rest[:n_state]
+            w = rest[n_state:]
+            xs = xa if time_major else jnp.swapaxes(xa, 0, 1)
+            init = tuple(state) if is_lstm else state[0]
+            outs, final = _scan_direction(type(cell), w, xs, init, act,
+                                          reverse)
+            if not time_major:
+                outs = jnp.swapaxes(outs, 0, 1)
+            return (outs,) + (tuple(final) if is_lstm else (final,))
+
+        res = dispatch.call("rnn_scan", f, [x, *init_tensors, *params])
+        if is_lstm:
+            return res[0], (res[1], res[2])
+        return res[0], res[1]
+
+
+class BiRNN(Layer):
+    """Two cells, opposite directions, concatenated outputs (reference
+    rnn.py:1366)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        states = initial_states or (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, states[0], sequence_length)
+        out_bw, st_bw = self.rnn_bw(inputs, states[1], sequence_length)
+        from ... import ops
+        return ops.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+_CELLS = {"SimpleRNN": SimpleRNNCell, "LSTM": LSTMCell, "GRU": GRUCell}
+
+
+class RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack (reference
+    rnn.py:1694). States are [num_layers*num_directions, B, H]."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        if direction in ("forward",):
+            self.num_directions = 1
+        elif direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        else:
+            raise ValueError(f"unknown direction {direction!r}")
+        cell_cls = _CELLS[mode]
+        kw = dict(weight_ih_attr=weight_ih_attr,
+                  weight_hh_attr=weight_hh_attr,
+                  bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr)
+        if mode == "SimpleRNN":
+            kw["activation"] = activation
+        from .container import LayerList
+        self.cells = LayerList()
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else \
+                hidden_size * self.num_directions
+            for _ in range(self.num_directions):
+                self.cells.append(cell_cls(in_sz, hidden_size, **kw))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        if sequence_length is not None:
+            raise NotImplementedError(
+                "variable-length sequences: pad + mask externally")
+        x = inputs if isinstance(inputs, Tensor) else Tensor(inputs)
+        is_lstm = self.mode == "LSTM"
+        nl, nd = self.num_layers, self.num_directions
+        batch = x.shape[1] if self.time_major else x.shape[0]
+
+        if initial_states is None:
+            z = jnp.zeros((nl * nd, batch, self.hidden_size), jnp.float32)
+            init_tensors = [Tensor(z), Tensor(z)] if is_lstm else [Tensor(z)]
+        else:
+            init_tensors = list(initial_states) if is_lstm \
+                else [initial_states]
+
+        all_params = []
+        for cell in self.cells:
+            all_params.extend(cell.gate_params())
+        cell0 = self.cells[0]
+        act = getattr(cell0, "activation", None)
+        cell_cls = type(cell0)
+        time_major = self.time_major
+        n_per = 4
+        # inter-layer dropout (reference RNNBase: applied to every
+        # non-final layer's output while training)
+        dropout_p = float(self.dropout or 0.0)
+        drop_keys = None
+        if dropout_p > 0.0 and self.training and nl > 1:
+            from ...core.generator import next_key
+            drop_keys = jax.random.split(next_key(), nl - 1)
+
+        def f(xa, *rest):
+            n_state = 2 if is_lstm else 1
+            states = rest[:n_state]
+            flat = rest[n_state:]
+            xs = xa if time_major else jnp.swapaxes(xa, 0, 1)
+            final_h, final_c = [], []
+            for layer in range(nl):
+                outs_dir = []
+                for d in range(nd):
+                    idx = layer * nd + d
+                    w = flat[idx * n_per:(idx + 1) * n_per]
+                    if is_lstm:
+                        init = (states[0][idx], states[1][idx])
+                    else:
+                        init = states[0][idx]
+                    outs, final = _scan_direction(cell_cls, w, xs, init,
+                                                  act, reverse=(d == 1))
+                    outs_dir.append(outs)
+                    if is_lstm:
+                        final_h.append(final[0])
+                        final_c.append(final[1])
+                    else:
+                        final_h.append(final)
+                xs = outs_dir[0] if nd == 1 else jnp.concatenate(
+                    outs_dir, axis=-1)
+                if drop_keys is not None and layer < nl - 1:
+                    keep = jax.random.bernoulli(
+                        drop_keys[layer], 1.0 - dropout_p, xs.shape)
+                    xs = jnp.where(keep, xs / (1.0 - dropout_p), 0.0)
+            out = xs if time_major else jnp.swapaxes(xs, 0, 1)
+            if is_lstm:
+                return out, jnp.stack(final_h), jnp.stack(final_c)
+            return out, jnp.stack(final_h)
+
+        res = dispatch.call(f"{self.mode.lower()}_stack", f,
+                            [x, *init_tensors, *all_params])
+        if is_lstm:
+            return res[0], (res[1], res[2])
+        return res[0], res[1]
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("SimpleRNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

@@ -29,11 +29,21 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
             acc = s if acc is None else acc + s
         total = dispatch.call("norm_root",
                               lambda a: a ** (1.0 / norm_type), [acc])
-    clip_coef = max_norm / (float(total.numpy()) + 1e-6)
-    if clip_coef < 1:
-        for p in parameters:
-            if p.grad is not None:
-                p.grad._swap_payload(p.grad._data * clip_coef)
+    # stays ON DEVICE: no host sync mid-step (the reference's CUDA path
+    # also keeps the coef on device); min(1, max/total) folds the branch
+    if error_if_nonfinite:
+        import numpy as np
+        if not np.isfinite(float(total.numpy())):
+            raise RuntimeError(
+                f"the total norm of gradients is non-finite; disable with "
+                f"error_if_nonfinite=False")
+    coef = dispatch.call(
+        "clip_coef",
+        lambda t: jnp.minimum(1.0, max_norm / (t + 1e-6)), [total])
+    for p in parameters:
+        if p.grad is not None:
+            g = p.grad._data
+            p.grad._swap_payload(g * coef._data.astype(g.dtype))
     return total
 
 
@@ -61,8 +71,60 @@ def vector_to_parameters(vec, parameters, name=None):
 
 
 def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize ``layer.<name>`` as direction*magnitude (reference:
+    python/paddle/nn/utils/weight_norm_hook.py — weight = g * v/||v||,
+    recomputed by a forward-pre-hook each call)."""
+    import numpy as np
+
+    from ...ops import linalg  # noqa: F401  (norm availability)
+
+    w = getattr(layer, name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    axes = tuple(i for i in range(w._data.ndim) if i != dim)
+
+    def _norm(arr):
+        return jnp.sqrt(jnp.sum(arr.astype(jnp.float32) ** 2, axis=axes,
+                                keepdims=True)).astype(arr.dtype)
+
+    g0 = _norm(w._data)
+    from ..parameter import Parameter
+    weight_g = Parameter(g0)
+    weight_v = Parameter(w._data)
+    # replace the original parameter; keep `name` as a plain attribute
+    # recomputed before every forward
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", weight_g)
+    layer.add_parameter(name + "_v", weight_v)
+
+    def compute(layer_, inputs=None):
+        v = getattr(layer_, name + "_v")
+        g = getattr(layer_, name + "_g")
+        normed = dispatch.call(
+            "weight_norm", lambda va, ga: ga * va / (_norm(va) + 1e-12),
+            [v, g])
+        object.__setattr__(layer_, name, normed)
+
+    compute(layer)
+    hook = layer.register_forward_pre_hook(
+        lambda layer_, inputs: compute(layer_))
+    layer._weight_norm_hooks = getattr(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = hook
     return layer
 
 
 def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a single parameter and remove the hook."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    hook = hooks.pop(name, None)
+    if hook is None:
+        raise ValueError(f"no weight_norm applied to parameter {name!r}")
+    hook.remove()
+    from ..parameter import Parameter
+    w = getattr(layer, name)  # last computed normalized weight
+    if name in layer.__dict__:
+        del layer.__dict__[name]
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    layer.add_parameter(name, Parameter(w._data))
     return layer

@@ -191,3 +191,30 @@ def _attach_methods():
 
 
 _attach_methods()
+
+
+# ---------------------------------------------------------------------------
+# Registry: every public op function is registered (ops/registry.py is the
+# source of truth the parity audit runs against — tools/op_parity_audit.py)
+# ---------------------------------------------------------------------------
+def _register_all():
+    from .registry import register_module
+    register_module(math, "math")
+    register_module(creation, "creation")
+    register_module(manipulation, "manipulation")
+    register_module(reduction, "reduction")
+    register_module(linalg, "linalg")
+    register_module(search, "search")
+    from ..nn import functional as _F
+    from ..nn.functional import (activation as _act, common as _common,
+                                 conv as _conv, loss as _loss, norm as _norm,
+                                 pooling as _pool)
+    for mod, cat in ((_act, "activation"), (_common, "nn_common"),
+                     (_conv, "conv"), (_loss, "loss"), (_norm, "norm"),
+                     (_pool, "pooling")):
+        register_module(mod, cat)
+    from ..nn.functional import flash_attention as _fa
+    register_module(_fa, "attention")
+
+
+_register_all()

@@ -277,3 +277,90 @@ def cast(x, dtype):
     if xt.dtype == d:
         return xt
     return dispatch.call("cast", lambda a: a.astype(d), [xt])
+
+
+def gammaln(x, name=None):
+    """lgamma alias (reference ops.yaml gammaln)."""
+    import jax.scipy.special as jsp
+    return dispatch.call("gammaln", jsp.gammaln, [_t(x)])
+
+
+def polygamma(x, n, name=None):
+    """n-th derivative of digamma (reference ops.yaml polygamma)."""
+    import jax.scipy.special as jsp
+    return dispatch.call("polygamma",
+                         lambda a: jsp.polygamma(n, a), [_t(x)])
+
+
+def i0(x, name=None):
+    import jax.scipy.special as jsp
+    return dispatch.call("i0", jsp.i0, [_t(x)])
+
+
+def i0e(x, name=None):
+    import jax.scipy.special as jsp
+    return dispatch.call("i0e", jsp.i0e, [_t(x)])
+
+
+def i1(x, name=None):
+    import jax.scipy.special as jsp
+    return dispatch.call("i1", jsp.i1, [_t(x)])
+
+
+def i1e(x, name=None):
+    import jax.scipy.special as jsp
+    return dispatch.call("i1e", jsp.i1e, [_t(x)])
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add of a scalar (reference ops.yaml increment)."""
+    out = dispatch.call("increment", lambda a: a + value, [_t(x)])
+    if isinstance(x, Tensor):
+        x._swap_payload(out._data)
+        return x
+    return out
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Renormalize slices along `axis` to at most max_norm in p-norm
+    (reference ops.yaml renorm)."""
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return dispatch.call("renorm", f, [_t(x)])
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    """Fill the (offset) diagonal (reference ops.yaml fill_diagonal):
+    offset>0 above the main diagonal, offset<0 below; wrap=True restarts
+    the diagonal after every (ncols+1) rows on tall matrices (numpy
+    fill_diagonal semantics)."""
+    def f(a):
+        rows, cols = a.shape[-2], a.shape[-1]
+        if wrap and offset == 0 and rows > cols:
+            # wrapped main diagonal: rows i where i % (cols+1) < cols...
+            # numpy semantics: flat stride cols+1 over the flattened matrix
+            r = jnp.arange(rows)
+            c = r % (cols + 1)
+            ok = c < cols
+            return a.at[..., r[ok], c[ok]].set(value)
+        if offset >= 0:
+            n = max(min(rows, cols - offset), 0)
+            i = jnp.arange(n)
+            return a.at[..., i, i + offset].set(value)
+        n = max(min(rows + offset, cols), 0)
+        i = jnp.arange(n)
+        return a.at[..., i - offset, i].set(value)
+    return dispatch.call("fill_diagonal", f, [_t(x)])
+
+
+def logaddexp(x, y, name=None):
+    return dispatch.call("logaddexp", jnp.logaddexp, [_t(x), _t(y)])
+
+__all__ += ["gammaln", "polygamma", "i0", "i0e", "i1", "i1e",
+            "increment", "renorm", "fill_diagonal", "logaddexp"]

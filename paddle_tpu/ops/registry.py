@@ -42,6 +42,26 @@ def register(name: str, category: str = "misc", differentiable: bool = True,
     return deco
 
 
+def register_module(module, category: str, *, skip=()):
+    """Register every public callable of an op module (the registry is the
+    source of truth for the op surface; modules that define ops in bulk use
+    this instead of per-function decorators)."""
+    import inspect
+    names = getattr(module, "__all__", None)
+    if names is None:
+        names = [n for n in vars(module) if not n.startswith("_")]
+    for n in names:
+        if n in skip or n in OPS:
+            continue
+        fn = getattr(module, n, None)
+        if fn is None or not callable(fn) or inspect.isclass(fn):
+            continue
+        if getattr(fn, "__module__", "").startswith(("jax", "numpy")):
+            continue
+        OPS[n] = OpDef(name=n, category=category, lowering=fn,
+                       doc=(fn.__doc__ or ""))
+
+
 def op_names():
     return sorted(OPS)
 

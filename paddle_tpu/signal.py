@@ -1,0 +1,165 @@
+"""paddle.signal — frame / overlap_add / stft / istft.
+
+Capability parity with the reference signal module (reference:
+python/paddle/signal.py — frame:33, overlap_add:141, stft:231, istft:381).
+TPU-native: framing is a gather (XLA fuses), the DFT rides paddle.fft's
+XLA FFT lowerings, all differentiable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import dispatch
+from .core.tensor import Tensor, as_tensor
+from . import fft as _fft
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames along ``axis`` (reference signal.py:33).
+    [..., seq] -> [..., frame_length, num_frames] for axis=-1."""
+    def f(a):
+        seq = a.shape[axis]
+        if frame_length > seq:
+            raise ValueError("frame_length must be <= sequence length")
+        n = 1 + (seq - frame_length) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]  # [n, fl]
+        moved = jnp.moveaxis(a, axis, -1)
+        frames = moved[..., idx]                # [..., n, frame_length]
+        # branch on the axis ARGUMENT (for 1-D input axis=0 and axis=-1
+        # coincide positionally but select different reference layouts)
+        if axis == 0:
+            # reference layout for axis=0: [num_frames, frame_length, ...]
+            return jnp.moveaxis(frames, (-2, -1), (0, 1))
+        if axis in (-1, a.ndim - 1):
+            # reference layout for axis=-1: [..., frame_length, num_frames]
+            return jnp.swapaxes(frames, -1, -2)
+        raise NotImplementedError("frame supports axis 0 or -1")
+    return dispatch.call("frame", f, [_t(x)])
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    """Inverse of frame (reference signal.py:141):
+    [..., frame_length, num_frames] -> [..., seq]."""
+    def f(a):
+        if axis == 0:
+            # [num_frames, frame_length, ...]
+            n, fl = a.shape[0], a.shape[1]
+            frames = jnp.moveaxis(a, (0, 1), (-2, -1))  # [..., n, fl]
+        elif axis in (-1, a.ndim - 1):
+            # [..., frame_length, num_frames]
+            fl, n = a.shape[-2], a.shape[-1]
+            frames = jnp.swapaxes(a, -1, -2)    # [..., n, fl]
+        else:
+            raise NotImplementedError("overlap_add supports axis 0 or -1")
+        seq = (n - 1) * hop_length + fl
+        out = jnp.zeros(frames.shape[:-2] + (seq,), a.dtype)
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(fl)[None, :]    # [n, fl]
+        flat_idx = idx.reshape(-1)
+        flat = frames.reshape(frames.shape[:-2] + (n * fl,))
+        out = out.at[..., flat_idx].add(flat)
+        if axis in (-1, a.ndim - 1):
+            return out
+        return jnp.moveaxis(out, -1, axis)
+    return dispatch.call("overlap_add", f, [_t(x)])
+
+
+def stft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+         center: bool = True, pad_mode: str = "reflect",
+         normalized: bool = False, onesided: bool = True, name=None):
+    """Short-time Fourier transform (reference signal.py:231).
+    x: [B, seq] or [seq] real -> [B, n_fft//2+1, num_frames] complex."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def f(a, w):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, ((0, 0), (pad, pad)), mode=pad_mode)
+        seq = a.shape[-1]
+        n = 1 + (seq - n_fft) // hop_length
+        starts = jnp.arange(n) * hop_length
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[:, idx]                       # [B, n, n_fft]
+        frames = frames * w[None, None, :]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))    # [B, n, bins]
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        spec = jnp.swapaxes(spec, -1, -2)        # [B, bins, n]
+        return spec[0] if squeeze else spec
+
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._data if isinstance(window, Tensor) \
+            else jnp.asarray(window)
+    if win_length < n_fft:   # center-pad window to n_fft (reference)
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    return dispatch.call("stft", f, [_t(x), Tensor(win)])
+
+
+def istft(x, n_fft: int, hop_length=None, win_length=None, window=None,
+          center: bool = True, normalized: bool = False,
+          onesided: bool = True, length=None, return_complex: bool = False,
+          name=None):
+    """Inverse STFT (reference signal.py:381)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    if return_complex and onesided:
+        raise ValueError(
+            "return_complex=True requires onesided=False (reference istft "
+            "contract: a complex output implies a two-sided spectrum)")
+
+    def f(a, w):
+        squeeze = a.ndim == 2
+        if squeeze:
+            a = a[None]
+        spec = jnp.swapaxes(a, -1, -2)           # [B, n, bins]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(spec, axis=-1)     # complex [B, n, n_fft]
+            if not return_complex:
+                frames = frames.real
+        frames = frames * w[None, None, :]
+        n = frames.shape[1]
+        seq = (n - 1) * hop_length + n_fft
+        out = jnp.zeros(frames.shape[:-2] + (seq,), frames.dtype)
+        norm = jnp.zeros((seq,), w.dtype)   # real even for complex output
+        starts = jnp.arange(n) * hop_length
+        idx = (starts[:, None] + jnp.arange(n_fft)[None, :]).reshape(-1)
+        out = out.at[..., idx].add(frames.reshape(frames.shape[0], -1))
+        norm = norm.at[idx].add(jnp.tile(w * w, (n,)))
+        out = out / jnp.maximum(norm, 1e-10)[None, :]
+        if center:
+            pad = n_fft // 2
+            out = out[..., pad:seq - pad]
+        if length is not None:
+            out = out[..., :length]
+        return out[0] if squeeze else out
+
+    if window is None:
+        win = jnp.ones((win_length,), jnp.float32)
+    else:
+        win = window._data if isinstance(window, Tensor) \
+            else jnp.asarray(window)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (lpad, n_fft - win_length - lpad))
+    return dispatch.call("istft", f, [_t(x), Tensor(win)])
+
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]

