@@ -175,6 +175,13 @@ def register_op_hook(fn):
     return fn
 
 
+def unregister_op_hook(fn):
+    try:
+        _op_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
 def _check_nan_inf(op_name, outs):
     for o in outs:
         d = np.dtype(o.dtype)

@@ -1,0 +1,79 @@
+"""paddle.device — device management + memory stats facade.
+
+Capability parity with the reference device module (reference:
+python/paddle/device/__init__.py set_device/get_device;
+python/paddle/device/cuda/__init__.py memory_allocated / max_memory_* over
+paddle/fluid/memory/stats.cc). TPU-native: device stats come from the XLA
+client's per-device memory_stats(); host stats from the native tracked
+allocator (paddle_tpu/native)."""
+from __future__ import annotations
+
+import jax
+
+from ..core.place import (get_device, set_device)  # noqa: F401
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def _stats(device_id: int = 0) -> dict:
+    try:
+        return jax.local_devices()[device_id].memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the accelerator (reference
+    device/cuda memory_allocated)."""
+    return int(_stats(_id(device)).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None) -> int:
+    return int(_stats(_id(device)).get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None) -> int:
+    s = _stats(_id(device))
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None) -> int:
+    return max_memory_allocated(device)
+
+
+def host_memory_stats() -> dict:
+    from .. import native
+    return native.host_memory_stats()
+
+
+def _id(device) -> int:
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    s = str(device)
+    return int(s.split(":")[-1]) if ":" in s else 0
+
+
+class cuda:
+    """Source-compat shim: paddle.device.cuda.* (accelerator = TPU)."""
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+
+    @staticmethod
+    def device_count():
+        return jax.device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+__all__ = ["device_count", "get_device", "set_device", "memory_allocated",
+           "max_memory_allocated", "memory_reserved",
+           "max_memory_reserved", "host_memory_stats", "cuda"]

@@ -1,0 +1,3 @@
+from .main import launch
+
+__all__ = ["launch"]

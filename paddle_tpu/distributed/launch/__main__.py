@@ -1,0 +1,5 @@
+import sys
+
+from .main import launch
+
+sys.exit(launch())

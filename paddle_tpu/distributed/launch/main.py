@@ -1,0 +1,144 @@
+"""Distributed job launcher.
+
+Capability parity with the reference launcher (reference:
+python/paddle/distributed/launch/main.py:21 — `python -m
+paddle.distributed.launch --nnodes ... train.py`, builds per-rank envs,
+spawns/monitors workers, restarts under elastic policy
+fleet/elastic/manager.py:124). TPU-native: one process per HOST (single
+controller drives all local chips), so --nproc_per_node defaults to 1; the
+env contract sets both the reference names (PADDLE_TRAINER_ID …) and the
+jax.distributed coordinates the framework's parallel.init reads.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+
+def _parse(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch a multi-process / multi-host job")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int,
+                   default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (TPU single-controller: 1)")
+    p.add_argument("--master", default=os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:8765"),
+        help="coordinator host:port (jax.distributed)")
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic restarts per worker on failure")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+def _worker_env(args, local_rank: int) -> dict:
+    world = args.nnodes * args.nproc_per_node
+    rank = args.node_rank * args.nproc_per_node + local_rank
+    env = dict(os.environ)
+    env.update({
+        # reference names (compat for user scripts)
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_LOCAL_RANK": str(local_rank),
+        "PADDLE_MASTER": args.master,
+        # jax.distributed coordinates (paddle_tpu.distributed.init reads)
+        "JAX_COORDINATOR_ADDRESS": args.master,
+        "JAX_NUM_PROCESSES": str(world),
+        "JAX_PROCESS_ID": str(rank),
+    })
+    return env
+
+
+class _Worker:
+    def __init__(self, args, local_rank: int):
+        self.args = args
+        self.local_rank = local_rank
+        self.restarts = 0
+        self.proc: subprocess.Popen | None = None
+        self.log = None
+
+    def start(self):
+        args = self.args
+        cmd = [sys.executable, args.training_script,
+               *args.training_script_args]
+        stdout = None
+        if args.log_dir:
+            os.makedirs(args.log_dir, exist_ok=True)
+            self.log = open(os.path.join(
+                args.log_dir, f"worker.{self.local_rank}.log"), "ab")
+            stdout = self.log
+        self.proc = subprocess.Popen(
+            cmd, env=_worker_env(args, self.local_rank),
+            stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+
+    def poll(self):
+        return self.proc.poll() if self.proc else None
+
+    def terminate(self):
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+
+    def close(self):
+        if self.log:
+            self.log.close()
+
+
+def launch(argv=None) -> int:
+    """Spawn + monitor the workers; elastic restart up to --max_restarts
+    (reference elastic/manager.py watchdog loop)."""
+    args = _parse(argv)
+    workers: List[_Worker] = [
+        _Worker(args, i) for i in range(args.nproc_per_node)]
+    for w in workers:
+        w.start()
+
+    def _sig(_s, _f):
+        for w in workers:
+            w.terminate()
+        sys.exit(1)
+
+    signal.signal(signal.SIGINT, _sig)
+    signal.signal(signal.SIGTERM, _sig)
+
+    exit_code = 0
+    try:
+        while True:
+            alive = False
+            for w in workers:
+                code = w.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    if w.restarts < args.max_restarts:
+                        w.restarts += 1
+                        print(f"[launch] worker {w.local_rank} exited "
+                              f"{code}; restart "
+                              f"{w.restarts}/{args.max_restarts}")
+                        w.start()
+                        alive = True
+                    else:
+                        print(f"[launch] worker {w.local_rank} failed "
+                              f"with {code}; stopping job")
+                        for other in workers:
+                            other.terminate()
+                        return code
+            if not alive:
+                break
+            time.sleep(0.2)
+    finally:
+        for w in workers:
+            w.close()
+    return exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,3 @@
+from .log_utils import get_logger, logger
+
+__all__ = ["get_logger", "logger"]

@@ -1,0 +1,48 @@
+"""Rank-aware logging (reference: python/paddle/distributed/fleet/utils/
+log_util.py — logger with `[rank x]` prefix, root-rank-only helpers)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+class _RankFilter(logging.Filter):
+    def filter(self, record):
+        try:
+            import jax
+            record.rank = jax.process_index()
+        except Exception:
+            record.rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        return True
+
+
+def get_logger(level=logging.INFO, name: str = "paddle_tpu",
+               fmt: str = None) -> logging.Logger:
+    log = logging.getLogger(name)
+    if not log.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(
+            fmt or "%(asctime)s [rank %(rank)s] %(levelname)s: "
+                   "%(message)s"))
+        handler.addFilter(_RankFilter())
+        log.addHandler(handler)
+        log.propagate = False
+    log.setLevel(level)
+    return log
+
+
+logger = get_logger()
+
+
+def is_rank_0() -> bool:
+    try:
+        import jax
+        return jax.process_index() == 0
+    except Exception:
+        return True
+
+
+def rank_0_print(*args, **kwargs):
+    if is_rank_0():
+        print(*args, **kwargs)

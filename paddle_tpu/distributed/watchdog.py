@@ -1,0 +1,131 @@
+"""Comm/compute watchdog — hang detection + failure diagnostics.
+
+Capability parity with the reference comm task manager (reference:
+paddle/phi/core/distributed/comm_task_manager.cc + async watchdog in
+process_group_nccl.cc — detect a collective stuck past a timeout, dump
+diagnostics, optionally abort). TPU-native: there are no per-collective
+handles to watch (XLA fuses comms into programs), so the watchdog watches
+*progress*: every dispatched op and every ``heartbeat()`` bumps a
+timestamp; a daemon thread fires when no progress happens for ``timeout``
+seconds while work is marked in flight, dumping all Python thread stacks
+(the reference's stuck-collective report) and invoking ``on_hang``.
+"""
+from __future__ import annotations
+
+import faulthandler
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+
+class Watchdog:
+    def __init__(self, timeout: float = 600.0,
+                 on_hang: Optional[Callable] = None,
+                 abort_on_hang: bool = False, poll_interval: float = 5.0):
+        self.timeout = timeout
+        self.on_hang = on_hang
+        self.abort_on_hang = abort_on_hang
+        self.poll_interval = poll_interval
+        self._last_progress = time.monotonic()
+        self._in_flight = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._hook = None
+        self.hang_count = 0
+
+    # ------------------------------------------------------------- progress
+    def heartbeat(self):
+        with self._lock:
+            self._last_progress = time.monotonic()
+
+    def begin_work(self):
+        with self._lock:
+            self._in_flight += 1
+            self._last_progress = time.monotonic()
+
+    def end_work(self):
+        with self._lock:
+            self._in_flight = max(self._in_flight - 1, 0)
+            self._last_progress = time.monotonic()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        from ..core import dispatch
+
+        def hook(op_name, inputs, outputs, attrs):
+            self.heartbeat()
+        self._hook = hook
+        dispatch.register_op_hook(hook)
+
+        def run():
+            while not self._stop.wait(self.poll_interval):
+                with self._lock:
+                    stalled = (self._in_flight > 0 and
+                               time.monotonic() - self._last_progress
+                               > self.timeout)
+                if stalled:
+                    self.hang_count += 1
+                    sys.stderr.write(
+                        f"[watchdog] no progress for >{self.timeout}s with "
+                        f"work in flight — dumping thread stacks\n")
+                    faulthandler.dump_traceback(file=sys.stderr)
+                    if self.on_hang is not None:
+                        try:
+                            self.on_hang(self)
+                        except Exception:
+                            pass
+                    if self.abort_on_hang:
+                        import os
+                        os.abort()
+                    self.heartbeat()   # one report per stall window
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="paddle_tpu_watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.poll_interval)
+            self._thread = None
+        if self._hook is not None:
+            from ..core import dispatch
+            dispatch.unregister_op_hook(self._hook)
+            self._hook = None
+
+    def __enter__(self):
+        self.begin_work()
+        return self
+
+    def __exit__(self, *exc):
+        self.end_work()
+        return False
+
+
+_global: Optional[Watchdog] = None
+
+
+def start_watchdog(timeout: float = 600.0, **kw) -> Watchdog:
+    global _global
+    if _global is None:
+        _global = Watchdog(timeout=timeout, **kw).start()
+    elif _global.timeout != timeout or kw:
+        import warnings
+        warnings.warn(
+            f"watchdog already running with timeout={_global.timeout}; "
+            f"requested config (timeout={timeout}, {kw}) ignored — call "
+            "stop_watchdog() first to reconfigure")
+    return _global
+
+
+def stop_watchdog():
+    global _global
+    if _global is not None:
+        _global.stop()
+        _global = None
+
+
+__all__ = ["Watchdog", "start_watchdog", "stop_watchdog"]

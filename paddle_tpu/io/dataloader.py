@@ -46,6 +46,14 @@ def default_collate_fn(batch):
         import jax.numpy as jnp
         return Tensor(jnp.stack([b._data for b in batch]))
     if isinstance(sample, np.ndarray):
+        # native parallel memcpy when available (paddle_tpu/native —
+        # reference data_feed.cc batch assembly role)
+        try:
+            from .. import native
+            if native.AVAILABLE and sample.nbytes * len(batch) > 1 << 20:
+                return native.collate_stack(batch)
+        except Exception:
+            pass
         return np.stack(batch)
     if isinstance(sample, (int, np.integer)):
         return np.asarray(batch, dtype=np.int64)

@@ -1,0 +1,111 @@
+// paddle_tpu native runtime: parallel batch collation + tracked host
+// allocator.
+//
+// Capability parity with the reference's native runtime pieces the Python
+// layer leans on (reference: paddle/fluid/framework/data_feed.cc native
+// batch assembly in the C++ DataLoader workers; paddle/fluid/memory/
+// stats.cc host/device stat registry). TPU-native: device memory belongs
+// to XLA, so the native layer owns the HOST side of the pipeline — the
+// memcpy-bound sample->batch collation that feeds jax.device_put, and a
+// host allocation tracker behind paddle_tpu.device.memory_stats.
+//
+// Built at import by paddle_tpu/native/__init__.py (g++ -O3 -shared);
+// exposed over the C ABI via ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Parallel collation: stack n same-size sample buffers into dst.
+// Threads split WORK (bytes), not samples, so a few large samples still
+// parallelize: each thread owns a contiguous byte range of the OUTPUT and
+// copies the (sample, offset) pieces that fall in it.
+// ---------------------------------------------------------------------
+void pt_collate(const void** srcs, int64_t n, int64_t sample_bytes,
+                void* dst, int n_threads) {
+  if (n <= 0 || sample_bytes <= 0) return;
+  char* out = static_cast<char*>(dst);
+  int64_t total = n * sample_bytes;
+  if (n_threads <= 1 || total < (int64_t)1 << 20) {
+    for (int64_t i = 0; i < n; ++i)
+      std::memcpy(out + i * sample_bytes, srcs[i], sample_bytes);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(n_threads);
+  int64_t per = (total + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    int64_t lo = t * per, hi = std::min(total, lo + per);
+    if (lo >= hi) break;
+    threads.emplace_back([=] {
+      int64_t pos = lo;
+      while (pos < hi) {
+        int64_t sample = pos / sample_bytes;
+        int64_t off = pos - sample * sample_bytes;
+        int64_t chunk = std::min(sample_bytes - off, hi - pos);
+        std::memcpy(out + pos,
+                    static_cast<const char*>(srcs[sample]) + off, chunk);
+        pos += chunk;
+      }
+    });
+  }
+  for (auto& th : threads) th.join();
+}
+
+// ---------------------------------------------------------------------
+// Tracked host allocator (stats facade).
+// ---------------------------------------------------------------------
+static std::atomic<int64_t> g_allocated{0};
+static std::atomic<int64_t> g_peak{0};
+static std::atomic<int64_t> g_alloc_count{0};
+
+struct Header {
+  int64_t bytes;
+  int64_t magic;
+};
+static constexpr int64_t kMagic = 0x70746e61746976;  // "ptnativ"
+static constexpr size_t kAlign = 64;
+
+void* pt_host_alloc(int64_t bytes) {
+  size_t total = sizeof(Header) + kAlign + (size_t)bytes;
+  char* raw = static_cast<char*>(std::malloc(total));
+  if (!raw) return nullptr;
+  char* user = raw + sizeof(Header);
+  user += kAlign - (reinterpret_cast<uintptr_t>(user) % kAlign);
+  Header* h = reinterpret_cast<Header*>(user) - 1;
+  h->bytes = bytes;
+  h->magic = kMagic ^ reinterpret_cast<int64_t>(raw);
+  // stash raw pointer just before the header
+  std::memcpy(reinterpret_cast<char*>(h) - sizeof(void*), &raw,
+              sizeof(void*));
+  int64_t cur = g_allocated.fetch_add(bytes) + bytes;
+  int64_t peak = g_peak.load();
+  while (cur > peak && !g_peak.compare_exchange_weak(peak, cur)) {
+  }
+  g_alloc_count.fetch_add(1);
+  return user;
+}
+
+void pt_host_free(void* p) {
+  if (!p) return;
+  Header* h = reinterpret_cast<Header*>(p) - 1;
+  void* raw;
+  std::memcpy(&raw, reinterpret_cast<char*>(h) - sizeof(void*),
+              sizeof(void*));
+  if ((h->magic ^ reinterpret_cast<int64_t>(raw)) != kMagic) return;
+  g_allocated.fetch_sub(h->bytes);
+  std::free(raw);
+}
+
+int64_t pt_host_allocated() { return g_allocated.load(); }
+int64_t pt_host_peak() { return g_peak.load(); }
+int64_t pt_host_alloc_count() { return g_alloc_count.load(); }
+void pt_reset_peak() { g_peak.store(g_allocated.load()); }
+
+}  // extern "C"

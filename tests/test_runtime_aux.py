@@ -1,0 +1,186 @@
+"""Aux runtime subsystem tests: profiler, watchdog, launcher, rank logger,
+native collation/allocator, device stats.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+class TestProfiler:
+    def test_profile_counts_ops(self):
+        from paddle_tpu import profiler
+        net = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with profiler.Profiler(timer_only=True) as p:
+            for _ in range(3):
+                net(x)
+                p.step()
+        stats = p.summary()
+        assert stats.get("linear", stats.get("matmul", 0)) >= 3
+
+    def test_scheduler_state_machine(self):
+        from paddle_tpu.profiler import ProfilerState, make_scheduler
+        sch = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sch(i) for i in range(5)]
+        assert states[0] == ProfilerState.CLOSED
+        assert states[1] == ProfilerState.READY
+        assert states[2] == ProfilerState.RECORD
+        assert states[3] == ProfilerState.RECORD_AND_RETURN
+        assert states[4] == ProfilerState.CLOSED
+
+    def test_record_event_and_chrome_export(self, tmp_path):
+        from paddle_tpu import profiler
+        with profiler.Profiler(
+                timer_only=True,
+                on_trace_ready=profiler.export_chrome_tracing(
+                    str(tmp_path))) as p:
+            with profiler.RecordEvent("forward"):
+                time.sleep(0.01)
+        assert p.trace_path and os.path.exists(p.trace_path)
+
+
+class TestWatchdog:
+    def test_detects_stall_and_recovers(self):
+        from paddle_tpu.distributed.watchdog import Watchdog
+        hangs = []
+        wd = Watchdog(timeout=0.2, poll_interval=0.05,
+                      on_hang=lambda w: hangs.append(1)).start()
+        try:
+            wd.begin_work()
+            time.sleep(0.6)     # no heartbeat -> stall fires
+            wd.end_work()
+        finally:
+            wd.stop()
+        assert wd.hang_count >= 1 and hangs
+
+    def test_no_false_positive_with_progress(self):
+        from paddle_tpu.distributed.watchdog import Watchdog
+        wd = Watchdog(timeout=0.3, poll_interval=0.05).start()
+        try:
+            wd.begin_work()
+            for _ in range(6):
+                time.sleep(0.1)
+                wd.heartbeat()
+            wd.end_work()
+        finally:
+            wd.stop()
+        assert wd.hang_count == 0
+
+    def test_op_dispatch_feeds_heartbeat(self):
+        from paddle_tpu.distributed.watchdog import (start_watchdog,
+                                                     stop_watchdog)
+        wd = start_watchdog(timeout=10.0)
+        before = wd._last_progress
+        time.sleep(0.01)
+        paddle.to_tensor(np.ones(3, np.float32)) + 1
+        assert wd._last_progress > before
+        stop_watchdog()
+
+
+class TestLauncher:
+    def test_single_proc_round_trip(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(
+            "import os\n"
+            "assert os.environ['PADDLE_TRAINER_ID'] == '0'\n"
+            "assert os.environ['PADDLE_TRAINERS_NUM'] == '1'\n"
+            "print('worker ok')\n")
+        from paddle_tpu.distributed.launch import launch
+        code = launch(["--nproc_per_node", "1", str(script)])
+        assert code == 0
+
+    def test_elastic_restart(self, tmp_path):
+        marker = tmp_path / "marker"
+        script = tmp_path / "flaky.py"
+        script.write_text(
+            f"import os, sys\n"
+            f"m = {str(marker)!r}\n"
+            f"if not os.path.exists(m):\n"
+            f"    open(m, 'w').close()\n"
+            f"    sys.exit(3)\n"
+            f"print('recovered')\n")
+        from paddle_tpu.distributed.launch import launch
+        code = launch(["--max_restarts", "1", str(script)])
+        assert code == 0
+
+    def test_failure_propagates(self, tmp_path):
+        script = tmp_path / "bad.py"
+        script.write_text("import sys; sys.exit(7)\n")
+        from paddle_tpu.distributed.launch import launch
+        code = launch([str(script)])
+        assert code == 7
+
+
+class TestNative:
+    def test_native_builds(self):
+        from paddle_tpu import native
+        assert native.AVAILABLE
+
+    def test_collate_matches_numpy(self):
+        from paddle_tpu import native
+        arrays = [np.random.randn(64, 64).astype(np.float32)
+                  for _ in range(32)]
+        np.testing.assert_array_equal(native.collate_stack(arrays),
+                                      np.stack(arrays))
+
+    def test_collate_ragged_falls_back(self):
+        from paddle_tpu import native
+        arrays = [np.zeros((2, 2), np.float32), np.zeros((2, 2), np.float64)]
+        out = native.collate_stack(arrays)    # mixed dtype -> numpy path
+        assert out.shape == (2, 2, 2)
+
+    def test_host_allocator_stats(self):
+        from paddle_tpu import native
+        before = native.host_memory_stats()
+        buf = native.HostBuffer(1 << 20)
+        mid = native.host_memory_stats()
+        assert mid["allocated"] >= before["allocated"] + (1 << 20)
+        arr = buf.as_array((256, 1024), np.float32)
+        arr[:] = 1.0
+        assert float(arr.sum()) == 256 * 1024
+        # freeing while a view is alive must refuse (no use-after-free)
+        with pytest.raises(RuntimeError, match="live array view"):
+            buf.free()
+        del arr
+        buf.free()
+        after = native.host_memory_stats()
+        assert after["allocated"] <= mid["allocated"] - (1 << 20)
+        assert after["peak"] >= mid["allocated"]
+
+    def test_dataloader_uses_native_collate(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class Ds(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.full((512, 512), i, np.float32)
+
+        loader = DataLoader(Ds(), batch_size=8)
+        batch = next(iter(loader))
+        assert batch.shape == (8, 512, 512) or \
+            list(batch.shape) == [8, 512, 512]
+
+
+class TestDeviceStats:
+    def test_memory_stats_api(self):
+        import paddle_tpu.device as device
+        n = device.memory_allocated()
+        assert n >= 0
+        assert device.max_memory_allocated() >= n
+        assert device.cuda.device_count() >= 1
+
+    def test_rank_logger(self, capsys):
+        from paddle_tpu.distributed.utils import get_logger
+        log = get_logger()
+        log.info("hello from test")
+        err = capsys.readouterr().err
+        assert "rank 0" in err and "hello from test" in err
