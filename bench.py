@@ -237,6 +237,49 @@ def _bench_bert(small):
     }
 
 
+def _bench_llama(small):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, llama_tiny
+
+    if small:
+        cfg = llama_tiny(use_flash_attention=False)
+        batch, seq, iters = 2, 128, 2
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
+                          intermediate_size=2048, num_layers=12,
+                          num_heads=12, max_seq_len=2048)
+        batch, seq, iters = 4, 2048, 5
+    from paddle_tpu.models import LlamaForCausalLM
+    model = LlamaForCausalLM(cfg)
+    params = [p for p in model.parameters() if not p.stop_gradient]
+
+    def make_inputs(i):
+        rng = np.random.RandomState(i)
+        return (jnp.asarray(rng.randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int64)),)
+
+    def loss_of(model, ids):
+        _, loss = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        return loss
+
+    dt, loss0, loss_end, n_params = _run_train_bench(
+        model, params, make_inputs, loss_of, iters)
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = 6 * n_params + \
+        12 * cfg.num_layers * cfg.hidden_size * seq
+    mfu = flops_per_token * tokens_per_sec / chip_peak_flops(
+        jax.devices()[0])
+    return {
+        "metric": "llama_110m_s2048_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
+                  "params": n_params, "loss_first": round(loss0, 3),
+                  "loss_last": round(loss_end, 3)},
+    }
+
+
 def main():
     if os.environ.get("BENCH_SMALL") == "1":
         # local testing: force the host platform before any backend init
@@ -246,7 +289,7 @@ def main():
 
     which = os.environ.get("BENCH_MODEL", "gpt2")
     bench = {"gpt2": _bench_gpt, "resnet50": _bench_resnet50,
-             "bert": _bench_bert}[which]
+             "bert": _bench_bert, "llama": _bench_llama}[which]
     print(json.dumps(bench(small)))
 
 

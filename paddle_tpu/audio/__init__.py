@@ -1,0 +1,3 @@
+from . import features, functional
+
+__all__ = ["features", "functional"]

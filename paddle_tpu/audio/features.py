@@ -1,0 +1,111 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram / LogMelSpectrogram
+/ MFCC layers (reference: python/paddle/audio/features/layers.py). Built on
+paddle.signal.stft + audio.functional filterbanks; every stage is a
+dispatched jnp op so features are differentiable (trainable front ends).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length=None, win_length=None,
+                 window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", AF.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        from .. import signal
+        spec = signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                           window=self.window, center=self.center,
+                           pad_mode=self.pad_mode)
+
+        def f(s):
+            mag = jnp.abs(s)
+            return mag if self.power == 1.0 else mag ** self.power
+        return dispatch.call("spectrogram_power", f, [spec])
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512, hop_length=None,
+                 win_length=None, window: str = "hann", power: float = 2.0,
+                 center: bool = True, pad_mode: str = "reflect",
+                 n_mels: int = 64, f_min: float = 50.0, f_max=None,
+                 htk: bool = False, dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                       window, power, center, pad_mode,
+                                       dtype)
+        self.register_buffer(
+            "fbank", AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min,
+                                             f_max, htk, dtype))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)        # [..., n_bins, frames]
+
+        def f(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+        return dispatch.call("mel_project", f, [spec, self.fbank])
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, *args, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db=None, **kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(*args, **kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)
+
+        def f(s):
+            log_spec = 10.0 * jnp.log10(jnp.maximum(s, self.amin))
+            log_spec = log_spec - 10.0 * math.log10(
+                max(self.ref_value, self.amin))
+            if self.top_db is not None:
+                log_spec = jnp.maximum(log_spec,
+                                       log_spec.max() - self.top_db)
+            return log_spec
+        return dispatch.call("log_mel", f, [m])
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 13, **mel_kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, **mel_kwargs)
+        self.n_mfcc = n_mfcc
+
+    def forward(self, x):
+        logm = self.log_mel(x)
+
+        def f(s):
+            # DCT-II over the mel axis (orthonormal)
+            n = s.shape[-2]
+            k = jnp.arange(n)[None, :]
+            m = jnp.arange(self.n_mfcc)[:, None]
+            basis = jnp.cos(math.pi * m * (2 * k + 1) / (2 * n))
+            scale = jnp.where(m == 0, math.sqrt(1.0 / n),
+                              math.sqrt(2.0 / n))
+            return jnp.einsum("cm,...mt->...ct", basis * scale, s)
+        return dispatch.call("mfcc_dct", f, [logm])
+
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,83 @@
+"""paddle.audio.functional — windows + mel filterbanks.
+
+Reference: python/paddle/audio/functional/window.py get_window,
+functional.py hz_to_mel/mel_to_hz/compute_fbank_matrix. Pure jnp math.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype: str = "float32") -> Tensor:
+    n = win_length
+    periodic = fftbins
+    m = n if periodic else n - 1
+    k = jnp.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * k / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * k / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * k / m)
+             + 0.08 * jnp.cos(4 * math.pi * k / m))
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones((n,))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(w.astype(dtype))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    if htk:
+        return 2595.0 * math.log10(1.0 + freq / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if freq >= min_log_hz:
+        mels = min_log_mel + math.log(freq / min_log_hz) / logstep
+    return mels
+
+
+def mel_to_hz(mel, htk: bool = False):
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if mel >= min_log_mel:
+        freqs = min_log_hz * math.exp(logstep * (mel - min_log_mel))
+    return freqs
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None,
+                         htk: bool = False, dtype: str = "float32"
+                         ) -> Tensor:
+    """[n_mels, n_fft//2 + 1] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    n_bins = n_fft // 2 + 1
+    fft_freqs = jnp.linspace(0, sr / 2.0, n_bins)
+    mel_lo, mel_hi = hz_to_mel(f_min, htk), hz_to_mel(f_max, htk)
+    mel_pts = [mel_to_hz(mel_lo + (mel_hi - mel_lo) * i / (n_mels + 1),
+                         htk) for i in range(n_mels + 2)]
+    mel_pts = jnp.asarray(mel_pts)
+    lower = mel_pts[:-2][:, None]
+    center = mel_pts[1:-1][:, None]
+    upper = mel_pts[2:][:, None]
+    up = (fft_freqs[None, :] - lower) / jnp.maximum(center - lower, 1e-10)
+    down = (upper - fft_freqs[None, :]) / jnp.maximum(upper - center,
+                                                      1e-10)
+    fbank = jnp.maximum(0.0, jnp.minimum(up, down))
+    return Tensor(fbank.astype(dtype))
+
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "compute_fbank_matrix"]

@@ -213,6 +213,11 @@ def _example_arrays(input_spec):
     from jax import export as jax_export
 
     avals = []
+    # ONE symbolic scope shared by every spec (jax.export rejects mixed
+    # scopes). A -1 at axis i is named d<i> in that scope, so the same
+    # axis of different inputs shares one symbol — inputs with dynamic
+    # batch dims stay broadcast-compatible (the reference's -1 contract).
+    scope = jax_export.SymbolicScope()
     for spec in input_spec:
         if isinstance(spec, Tensor):
             avals.append(jax.ShapeDtypeStruct(tuple(spec.shape),
@@ -223,9 +228,10 @@ def _example_arrays(input_spec):
             continue
         shape = tuple(spec.shape)
         if any(s == -1 for s in shape):
-            names = ",".join(f"d{i}" if s == -1 else str(s)
-                             for i, s in enumerate(shape))
-            shape = jax_export.symbolic_shape(f"({names})")
+            parts = [f"d{i}" if s == -1 else str(s)
+                     for i, s in enumerate(shape)]
+            shape = jax_export.symbolic_shape(f"({','.join(parts)})",
+                                              scope=scope)
         dtype = jnp.bfloat16 if str(spec.dtype) == "bfloat16" \
             else np.dtype(spec.dtype)
         avals.append(jax.ShapeDtypeStruct(shape, dtype))
@@ -288,6 +294,7 @@ def save(layer, path, input_spec=None, **configs):
 
     with open(path + ".pdmodel", "wb") as f:
         pickle.dump({"format": "paddle_tpu.jit/1",
+                     "n_inputs": len(list(input_spec)),
                      "stablehlo": exported.serialize()}, f)
     _save(state, path + ".pdparams")
 
@@ -296,9 +303,10 @@ class TranslatedLayer:
     """A loaded program: callable without the original model class
     (reference: python/paddle/jit/translated_layer.py TranslatedLayer)."""
 
-    def __init__(self, exported, state):
+    def __init__(self, exported, state, n_inputs: int = 1):
         self._exported = exported
         self._state = state
+        self.n_inputs = n_inputs
         self._param_arrays = {
             k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
             for k, v in state.items()}
@@ -351,4 +359,5 @@ def load(path, **configs):
     with open(model_file, "rb") as f:
         blob = pickle.load(f)
     exported = jax_export.deserialize(blob["stablehlo"])
-    return TranslatedLayer(exported, state)
+    return TranslatedLayer(exported, state,
+                           n_inputs=int(blob.get("n_inputs", 1)))

@@ -4,8 +4,16 @@ These are the BASELINE.md ladder configs: LeNet, ResNet, BERT, GPT, LLaMA.
 """
 from .lenet import LeNet
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_medium
+from .bert import (BertConfig, BertForPretraining,
+                   BertForSequenceClassification, BertModel)
+from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
+                    llama_7b, llama_tiny)
 
 __all__ = [
     "LeNet", "GPTConfig", "GPTModel", "GPTForCausalLM",
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertForSequenceClassification",
+    "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+    "llama_7b", "llama_tiny",
     "gpt2_small", "gpt2_medium",
 ]

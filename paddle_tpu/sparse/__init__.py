@@ -1,0 +1,194 @@
+"""paddle.sparse — COO/CSR sparse tensors and ops.
+
+Capability parity with the reference sparse stack (reference:
+paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h;
+python/paddle/sparse/ — sparse_coo_tensor, sparse_csr_tensor, matmul, add,
+relu, to_dense). TPU-native: storage is jax.experimental.sparse BCOO
+(XLA-compiled scatter/gather kernels); CSR inputs convert to BCOO
+internally (crow decompression is a one-shot row expansion). A
+SparseCooTensor IS a Tensor whose payload is the values array, so the
+autograd tape flows through sparse ops exactly like dense ones — the
+indices are static structure, the values carry the gradient.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+
+
+def _arr(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor(Tensor):
+    """A Tensor whose payload is the nnz values array plus static COO
+    indices. Passing ``values_tensor`` adopts its tape lineage so sparse
+    ops stay differentiable end to end."""
+
+    def __init__(self, indices, values_or_tensor, shape,
+                 stop_gradient=True):
+        vt = values_or_tensor if isinstance(values_or_tensor, Tensor) \
+            else None
+        data = vt._data if vt is not None else jnp.asarray(values_or_tensor)
+        if vt is not None:
+            stop_gradient = vt.stop_gradient
+        super().__init__(data, stop_gradient=stop_gradient)
+        if vt is not None:
+            self.grad_node = vt.grad_node
+            self.output_index = vt.output_index
+            if vt.grad_node is None and not vt.stop_gradient:
+                # leaf values: share the accumulation identity so grads
+                # land in the USER's tensor (vt.grad), not this facade
+                from ..autograd.engine import AccumulationNode
+                if getattr(vt, "_accum_node", None) is None:
+                    vt._accum_node = AccumulationNode(vt)
+                self._accum_node = vt._accum_node
+        self._coo_indices = jnp.asarray(indices)      # [nnz, ndim]
+        self._coo_shape = tuple(int(s) for s in shape)
+
+    @property
+    def _bcoo(self) -> "jsparse.BCOO":
+        return jsparse.BCOO((self._data, self._coo_indices),
+                            shape=self._coo_shape)
+
+    @property
+    def shape(self):
+        return list(self._coo_shape)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._coo_indices.T)     # [ndim, nnz] (reference)
+
+    def values(self) -> Tensor:
+        # a live view of the values ON the tape (not a detached copy)
+        return dispatch.call("sparse_values", lambda v: v, [self])
+
+    def nnz(self) -> int:
+        return int(self._coo_indices.shape[0])
+
+    def to_dense(self) -> Tensor:
+        idx, shape = self._coo_indices, self._coo_shape
+
+        def f(vals):
+            return jsparse.BCOO((vals, idx), shape=shape).todense()
+        return dispatch.call("sparse_to_dense", f, [self])
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self._data.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      stop_gradient=True):
+    """Build a COO tensor (reference python/paddle/sparse/creation.py
+    sparse_coo_tensor: indices [ndim, nnz]). Tensor ``values`` keep their
+    autograd lineage."""
+    idx = np.asarray(_arr(indices)).T          # -> [nnz, ndim]
+    vt = values if isinstance(values, Tensor) else None
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+        vt = None      # cast breaks identity; fall back to raw values
+    if shape is None:
+        if idx.shape[0] == 0:
+            raise ValueError(
+                "shape is required for an empty sparse tensor (no indices "
+                "to infer it from)")
+        shape = tuple(int(m) + 1 for m in idx.max(axis=0))
+    return SparseCooTensor(idx, vt if vt is not None else vals,
+                           shape, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      stop_gradient=True):
+    """Build from CSR triplets (reference sparse_csr_tensor); stored as
+    COO after a one-shot row decompression."""
+    crows_np = np.asarray(_arr(crows))
+    cols_np = np.asarray(_arr(cols))
+    rows = np.repeat(np.arange(len(crows_np) - 1), np.diff(crows_np))
+    idx = np.stack([rows, cols_np], axis=1)
+    vt = values if isinstance(values, Tensor) else None
+    vals = _arr(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+        vt = None
+    t = SparseCooTensor(idx, vt if vt is not None else vals, shape,
+                        stop_gradient=stop_gradient)
+    t._csr = (crows_np, cols_np)
+    return t
+
+
+def to_dense(x) -> Tensor:
+    return x.to_dense() if isinstance(x, SparseCooTensor) else as_tensor(x)
+
+
+def matmul(x, y, name=None) -> Tensor:
+    """sparse @ dense (reference python/paddle/sparse/binary.py matmul)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.matmul expects a SparseCooTensor lhs")
+    idx, shape = x._coo_indices, x._coo_shape
+    yt = y if isinstance(y, Tensor) else as_tensor(y)
+
+    def f(vals, dense):
+        return jsparse.BCOO((vals, idx), shape=shape) @ dense
+    return dispatch.call("sparse_matmul", f, [x, yt])
+
+
+def add(x, y, name=None):
+    """sparse+sparse (union of patterns, grads flow to both) or
+    sparse+dense."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if x._coo_shape != y._coo_shape:
+            raise ValueError("shape mismatch in sparse.add")
+        # result STRUCTURE (indices + per-input positions) is computed
+        # eagerly; the VALUES go through the tape
+        merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
+            (jnp.concatenate([jnp.zeros_like(x._data),
+                              jnp.zeros_like(y._data)]),
+             jnp.concatenate([x._coo_indices, y._coo_indices])),
+            shape=x._coo_shape))
+        res_idx = np.asarray(merged.indices)
+        lookup = {tuple(r): i for i, r in enumerate(res_idx)}
+        pos_x = jnp.asarray([lookup[tuple(r)]
+                             for r in np.asarray(x._coo_indices)])
+        pos_y = jnp.asarray([lookup[tuple(r)]
+                             for r in np.asarray(y._coo_indices)])
+        n_out = res_idx.shape[0]
+
+        def f(va, vb):
+            out = jnp.zeros((n_out,), va.dtype)
+            return out.at[pos_x].add(va).at[pos_y].add(vb)
+        vals = dispatch.call("sparse_add", f, [x, y])
+        return SparseCooTensor(res_idx, vals, x._coo_shape)
+    return to_dense(x) + to_dense(y)
+
+
+def relu(x, name=None):
+    if isinstance(x, SparseCooTensor):
+        out = dispatch.call("sparse_relu",
+                            lambda v: jnp.maximum(v, 0), [x])
+        return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+    from ..nn import functional as F
+    return F.relu(x)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, SparseCooTensor)
+
+
+__all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+           "to_dense", "matmul", "add", "relu", "is_sparse"]

@@ -1,0 +1,102 @@
+"""Custom op registration.
+
+Capability parity with the reference custom-op ABI (reference:
+paddle/phi/capi/ + python/paddle/utils/cpp_extension/ — user kernels with
+optional hand-written grads registered into the op registry and callable
+like builtins). TPU-native: a "kernel" is a jax-traceable function (jnp, or
+a Pallas kernel for hand-tiled TPU code); the optional backward installs a
+jax.custom_vjp, and the op lands in paddle_tpu.ops.registry + the autograd
+tape exactly like built-in ops — no C ABI needed, and the custom op fuses
+with its neighbors under jit.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+from ..ops.registry import OPS, OpDef
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None,
+                       num_inputs: Optional[int] = None,
+                       category: str = "custom"):
+    """Register ``name`` as a framework op.
+
+    forward(*arrays, **attrs) -> array | tuple — jax-traceable lowering
+    (jnp ops or a Pallas kernel).
+    backward(residuals, *out_grads) -> tuple(in_grads) with residuals =
+    (inputs, outputs); omit to use jax autodiff of ``forward``.
+
+    Returns the user-facing function taking/returning Tensors.
+    """
+    if name in OPS:
+        raise ValueError(f"op {name!r} already registered")
+
+    if backward is not None:
+        # one custom_vjp per distinct attrs (attrs are static config and
+        # must reach BOTH the primal and the residual-producing fwd rule)
+        _cores = {}
+
+        def _get_core(attrs):
+            key = tuple(sorted(attrs.items()))
+            core = _cores.get(key)
+            if core is not None:
+                return core
+
+            @jax.custom_vjp
+            def core(*arrays):
+                return forward(*arrays, **attrs)
+
+            def fwd_rule(*arrays):
+                out = forward(*arrays, **attrs)
+                return out, (arrays, out)
+
+            def bwd_rule(res, g):
+                grads = backward(res,
+                                 *(g if isinstance(g, tuple) else (g,)))
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                return tuple(grads)
+
+            core.defvjp(fwd_rule, bwd_rule)
+            _cores[key] = core
+            return core
+    else:
+        _get_core = None
+
+    def user_fn(*inputs, **attrs):
+        tensors = [i if isinstance(i, Tensor) else as_tensor(i)
+                   for i in inputs]
+        if _get_core is not None:
+            fn = _get_core(attrs)
+        elif attrs:
+            fn = lambda *xs: forward(*xs, **attrs)
+        else:
+            fn = forward
+        return dispatch.call(name, fn, tensors)
+
+    user_fn.__name__ = name
+    OPS[name] = OpDef(name=name, category=category, lowering=user_fn,
+                      doc=forward.__doc__ or "")
+    return user_fn
+
+
+class CppExtension:
+    """Source-compat shim for paddle.utils.cpp_extension: CUDA/C++ op
+    builds have no TPU analog — point users at register_custom_op (jax/
+    Pallas kernels) or paddle_tpu/native (ctypes C++ host runtime)."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "C++/CUDA op extensions do not target TPU; use "
+            "paddle_tpu.utils.register_custom_op with a jax or Pallas "
+            "kernel (device code), or the ctypes pattern in "
+            "paddle_tpu/native (host code)")
+
+
+__all__ = ["register_custom_op", "CppExtension"]
