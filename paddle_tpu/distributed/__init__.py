@@ -22,6 +22,8 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
 from . import fleet
 from . import sharding
 from . import checkpoint
+from . import auto_tuner
+from .auto_parallel.engine import Engine
 from .checkpoint import load_state_dict, save_state_dict
 from .fleet.mpu.mp_ops import split
 

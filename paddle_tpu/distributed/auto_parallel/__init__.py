@@ -1,3 +1,5 @@
 from .api import (Partial, Placement, ProcessMesh, Replicate, Shard,
                   dtensor_from_fn, reshard, shard_dataloader, shard_layer,
                   shard_optimizer, shard_tensor)
+
+from .engine import Engine

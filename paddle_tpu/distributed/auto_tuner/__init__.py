@@ -1,0 +1,3 @@
+from .tuner import AutoTuner, tune
+
+__all__ = ["AutoTuner", "tune"]

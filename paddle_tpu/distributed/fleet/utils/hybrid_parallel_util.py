@@ -1,0 +1,79 @@
+"""Hybrid-parallel sync utilities.
+
+Capability parity with the reference helpers (reference:
+python/paddle/distributed/fleet/utils/hybrid_parallel_util.py —
+broadcast_{dp,mp,sep,sharding}_parameters:168-275 push rank-0's params to
+the axis group at startup; fused_allreduce_gradients:241 bucketed grad
+allreduce). TPU-native: parameters are GLOBAL jax.Arrays, so every axis
+sees one consistent value by construction — the broadcasts validate that
+invariant (and re-assert replication placements) instead of moving bytes;
+the grad allreduce is compiled into backward by the SPMD partitioner, so
+the fused helper only re-asserts grad placements.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....core.tensor import Tensor
+from ... import mesh as mesh_mod
+
+
+def _assert_replicated(model, axis: str):
+    """Re-assert replication of params over `axis` (the reference
+    broadcast's post-state). With global arrays this is a placement
+    constraint, not a transfer."""
+    mesh = mesh_mod.get_mesh()
+    if axis not in mesh.axis_names or int(mesh.shape[axis]) == 1:
+        return model
+    for p in model.parameters():
+        sh = getattr(p._data, "sharding", None)
+        spec = sh.spec if isinstance(sh, NamedSharding) else P()
+        # a param sharded over `axis` stays sharded (TP weights); an
+        # unsharded param gets an explicit replicated placement
+        if not any(axis in (e if isinstance(e, tuple) else (e,))
+                   for e in spec if e is not None):
+            p._data = jax.device_put(p._data, NamedSharding(mesh, spec))
+    return model
+
+
+def broadcast_dp_parameters(model, hcg=None):
+    return _assert_replicated(model, "dp")
+
+
+def broadcast_mp_parameters(model, hcg=None):
+    return _assert_replicated(model, "mp")
+
+
+def broadcast_sep_parameters(model, hcg=None):
+    return _assert_replicated(model, "sep")
+
+
+def broadcast_sharding_parameters(model, hcg=None):
+    return _assert_replicated(model, "sharding")
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    """reference :168 — make batch inputs consistent across the mp group
+    (mp ranks must see identical data). Global arrays already are; pass
+    through with Tensor coercion."""
+    outs = [i if isinstance(i, Tensor) or not hasattr(i, "__len__")
+            else Tensor(jax.numpy.asarray(i)) for i in inputs]
+    if kwargs:
+        return outs, kwargs
+    return outs if len(outs) > 1 else outs[0]
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """reference :241 — bucketed dp grad allreduce. The SPMD partitioner
+    already reduced grads when backward ran; this re-asserts each grad's
+    placement matches its param (a cheap no-op when already true)."""
+    for p in parameter_list:
+        if p.grad is None:
+            continue
+        sh = getattr(p._data, "sharding", None)
+        if isinstance(sh, NamedSharding) and not isinstance(
+                p.grad._data, jax.core.Tracer):
+            p.grad._data = jax.device_put(p.grad._data, sh)

@@ -17,11 +17,27 @@ class _RankFilter(logging.Filter):
         return True
 
 
+class _LateStderrHandler(logging.StreamHandler):
+    """Resolve sys.stderr at EMIT time, so redirection (pytest capture,
+    launcher log files) set up after logger creation still applies."""
+
+    def __init__(self):
+        super().__init__()
+
+    @property
+    def stream(self):
+        return sys.stderr
+
+    @stream.setter
+    def stream(self, value):
+        pass
+
+
 def get_logger(level=logging.INFO, name: str = "paddle_tpu",
                fmt: str = None) -> logging.Logger:
     log = logging.getLogger(name)
     if not log.handlers:
-        handler = logging.StreamHandler(sys.stderr)
+        handler = _LateStderrHandler()
         handler.setFormatter(logging.Formatter(
             fmt or "%(asctime)s [rank %(rank)s] %(levelname)s: "
                    "%(message)s"))

@@ -17,3 +17,4 @@ def in_pir_mode():
 
 def use_pir_api():
     return False
+from .tensor_types import SelectedRows, TensorArray

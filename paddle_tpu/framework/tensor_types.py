@@ -1,0 +1,105 @@
+"""Auxiliary tensor containers: TensorArray, SelectedRows.
+
+Capability parity with the reference container tensor types (reference:
+paddle/phi/core/tensor_array.h TensorArray — dynamic list of tensors fed
+by while_loop/array_write; paddle/phi/core/selected_rows.h SelectedRows —
+(rows, value) pairs holding sparse gradient slices for embeddings).
+TPU-native: TensorArray is a Python list facade whose ``stack`` produces
+one jnp array (inside scans, jax carries the stacked form directly);
+SelectedRows keeps (rows, values) and scatters into dense on demand.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+
+
+class TensorArray:
+    """reference tensor_array.h — write/read by index, stack/concat."""
+
+    def __init__(self, values: Optional[Sequence[Tensor]] = None):
+        self._items: List[Optional[Tensor]] = list(values or [])
+
+    def write(self, index: int, value) -> "TensorArray":
+        value = value if isinstance(value, Tensor) else as_tensor(value)
+        while len(self._items) <= index:
+            self._items.append(None)
+        self._items[index] = value
+        return self
+
+    append = lambda self, v: self.write(len(self._items), v)
+
+    def read(self, index: int) -> Tensor:
+        v = self._items[index]
+        if v is None:
+            raise IndexError(f"TensorArray slot {index} never written")
+        return v
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, i):
+        return self.read(i)
+
+    def _all_written(self):
+        holes = [i for i, t in enumerate(self._items) if t is None]
+        if holes:
+            raise ValueError(
+                f"TensorArray slots {holes} were never written; stack/"
+                "concat over a sparse array would misalign indices")
+        return self._items
+
+    def stack(self, axis: int = 0) -> Tensor:
+        return dispatch.call(
+            "tensor_array_stack",
+            lambda *xs: jnp.stack(xs, axis=axis), self._all_written())
+
+    def concat(self, axis: int = 0) -> Tensor:
+        return dispatch.call(
+            "tensor_array_concat",
+            lambda *xs: jnp.concatenate(xs, axis=axis),
+            self._all_written())
+
+
+class SelectedRows:
+    """reference selected_rows.h — sparse row-slice gradient container."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(
+            rows._data if isinstance(rows, Tensor) else rows)
+        self.value = value if isinstance(value, Tensor) else as_tensor(
+            value)
+        self.height = int(height)
+
+    def to_dense(self) -> Tensor:
+        rows, height = self.rows, self.height
+
+        def f(vals):
+            out = jnp.zeros((height,) + vals.shape[1:], vals.dtype)
+            return out.at[rows].add(vals)
+        return dispatch.call("selected_rows_to_dense", f, [self.value])
+
+    def merge(self) -> "SelectedRows":
+        """Merge duplicate rows (reference merge_selected_rows op)."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=self.height)
+
+        def f(vals):
+            out = jnp.zeros((uniq.shape[0],) + vals.shape[1:], vals.dtype)
+            return out.at[inv].add(vals)
+        merged = dispatch.call("merge_selected_rows", f, [self.value])
+        keep = uniq < self.height
+        return SelectedRows(uniq[keep],
+                            Tensor(merged._data[keep]), self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={int(self.rows.shape[0])})")
+
+
+__all__ = ["TensorArray", "SelectedRows"]

@@ -1,0 +1,151 @@
+"""paddle.quantization — PTQ/QAT surface.
+
+Capability parity with the reference quantization stack (reference:
+python/paddle/quantization/ — QuantConfig config.py, PTQ ptq.py, QAT
+qat.py; weight_quantize/weight_dequantize ops in phi). TPU-native: int8
+abs-max weight quantization as jnp ops (the VPU handles int8<->fp convert;
+XLA fuses dequant into the consuming matmul), fake-quant QAT via a
+straight-through estimator expressed with stop_gradient — no custom CUDA
+kernels needed.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+from ..nn.layer.layers import Layer
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def weight_quantize(x, algo: str = "abs_max", bits: int = 8):
+    """-> (int8 weights, per-channel (last dim) fp scales) (reference op
+    weight_quantize)."""
+    if algo not in ("abs_max", "weight_only_int8"):
+        raise NotImplementedError(f"algo {algo!r}")
+    qmax = 2 ** (bits - 1) - 1
+
+    def f(w):
+        scale = jnp.max(jnp.abs(w), axis=0, keepdims=True) / qmax
+        scale = jnp.maximum(scale, 1e-8)
+        q = jnp.clip(jnp.round(w / scale), -qmax - 1, qmax)
+        return q.astype(jnp.int8), scale[0]
+    out = dispatch.call("weight_quantize", f, [_t(x)])
+    return out[0], out[1]
+
+
+def weight_dequantize(q, scale):
+    def f(qa, s):
+        return qa.astype(s.dtype) * s[None, :]
+    return dispatch.call("weight_dequantize", f, [_t(q), _t(scale)])
+
+
+def fake_quant(x, scale=None, bits: int = 8):
+    """QAT fake-quant with straight-through estimator (reference
+    fake_quantize_dequantize ops): forward rounds, backward passes
+    through."""
+    qmax = 2 ** (bits - 1) - 1
+
+    def f(a):
+        s = (jnp.max(jnp.abs(a)) / qmax) if scale is None else scale
+        s = jnp.maximum(s, 1e-8)
+        q = jnp.clip(jnp.round(a / s), -qmax - 1, qmax) * s
+        # STE: q = a + stop_grad(q - a) -> dq/da = 1
+        return a + jax.lax.stop_gradient(q - a)
+    return dispatch.call("fake_quantize_dequantize", f, [_t(x)])
+
+
+class QuantConfig:
+    """reference quantization/config.py QuantConfig."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_types = []
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._layer_types.append((layer_type, activation, weight))
+        return self
+
+
+class QuantedLinear(Layer):
+    """Linear running on int8 weights + fp scales (weight-only PTQ)."""
+
+    def __init__(self, linear):
+        super().__init__()
+        q, scale = weight_quantize(linear.weight)
+        # detached inference constants: no tape lineage back to the fp
+        # weight, no VJP recording on serving forwards
+        self.qweight = Tensor(q._data)
+        self.scales = Tensor(scale._data)
+        self.bias = getattr(linear, "bias", None)
+
+    def forward(self, x):
+        def f(a, q, s, *b):
+            w = q.astype(a.dtype) * s[None, :]
+            out = a @ w
+            if b:
+                out = out + b[0]
+            return out
+        args = [x if isinstance(x, Tensor) else as_tensor(x),
+                self.qweight, self.scales]
+        if self.bias is not None:
+            args.append(self.bias)
+        return dispatch.call("quant_linear", f, args)
+
+
+class PTQ:
+    """Post-training weight-only quantization driver (reference ptq.py):
+    swap eligible Linear layers for QuantedLinear."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from ..nn import Linear
+        target = model if inplace else copy.deepcopy(model)
+        if isinstance(target, Linear):      # bare top-level Linear
+            return QuantedLinear(target)
+        for name, layer in list(target.named_sublayers()):
+            if isinstance(layer, Linear):
+                owner = target._locate_owner(name)
+                attr = name.rsplit(".", 1)[-1]
+                if owner is not None:
+                    owner.add_sublayer(attr, QuantedLinear(layer))
+        return target
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py): Linear
+    forwards compute with fake-quantized weights; the STE passes gradients
+    through to the fp master weights the optimizer holds."""
+
+    def __init__(self, config: Optional[QuantConfig] = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        from ..nn import Linear
+        from ..nn import functional as F
+        target = model if inplace else copy.deepcopy(model)
+        layers = [target] if isinstance(target, Linear) else []
+        layers += [l for _, l in target.named_sublayers()]
+        for layer in layers:
+            if isinstance(layer, Linear) and not getattr(
+                    layer, "_qat_wrapped", False):
+                def qat_forward(x, _layer=layer):
+                    return F.linear(x, fake_quant(_layer.weight),
+                                    getattr(_layer, "bias", None))
+                layer.forward = qat_forward
+                layer._qat_wrapped = True
+        return target
+
+
+__all__ = ["weight_quantize", "weight_dequantize", "fake_quant",
+           "QuantConfig", "QuantedLinear", "PTQ", "QAT"]
