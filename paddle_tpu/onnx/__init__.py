@@ -1,0 +1,18 @@
+"""paddle.onnx — export surface (reference: python/paddle/onnx/export.py
+delegating to the external paddle2onnx package). The TPU-native deployment
+artifact is serialized StableHLO (paddle_tpu.jit.save / paddle_tpu.
+inference); ONNX conversion would require the external converter, which
+has no TPU-side analog — export() points users at the supported path."""
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not supported in the TPU-native stack (the "
+        "reference delegates to the external paddle2onnx CUDA toolchain). "
+        "Use paddle_tpu.jit.save(layer, path, input_spec=...) to produce "
+        "a portable StableHLO program and serve it with "
+        "paddle_tpu.inference.create_predictor")
+
+
+__all__ = ["export"]

@@ -1,0 +1,104 @@
+"""paddle.text — text-domain helpers (reference: python/paddle/text/
+datasets: Imdb/Conll05/...; viterbi_decode). Dataset downloads need
+egress, so the dataset classes raise with a pointer; viterbi_decode is a
+faithful implementation of the reference kernel."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core_imports import Tensor, as_tensor, dispatch  # noqa: F401
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Batched Viterbi decode (reference python/paddle/text/
+    viterbi_decode.py:26 + phi viterbi_decode_kernel.cc:215-300).
+
+    potentials: [B, T, N] emissions; transition_params: [N, N];
+    lengths: [B] valid lengths (None = full length).
+    ``include_bos_eos_tag=True`` (reference default) treats the LAST row of
+    transitions as the start tag's outgoing scores (added at step 0) and
+    the SECOND-TO-LAST row as the stop tag's scores (added at each
+    sequence's final valid step). Returns (scores [B], paths [B, T]) with
+    path entries past a sequence's length set to 0.
+    """
+    pot = as_tensor(potentials)
+    trans = as_tensor(transition_params)
+    if lengths is None:
+        import numpy as np
+        lengths = jnp.full((pot.shape[0],), pot.shape[1], jnp.int32)
+    else:
+        lengths = as_tensor(lengths)._data.astype(jnp.int32)
+
+    def f(p, tr):
+        b, t, n = p.shape
+        start = tr[n - 1]            # kernel: start_trans = last row
+        stop = tr[n - 2]             # kernel: stop_trans = row n-2
+        left0 = lengths
+
+        alpha = p[:, 0]
+        if include_bos_eos_tag:
+            alpha = alpha + start[None, :]
+            alpha = alpha + jnp.where((left0 == 1)[:, None], stop[None, :],
+                                      0.0)
+        ident = jnp.broadcast_to(jnp.arange(n)[None, :], (b, n))
+
+        def step(carry, emit):
+            alpha, left = carry
+            scores = alpha[:, :, None] + tr[None, :, :]
+            best = jnp.max(scores, axis=1) + emit
+            back = jnp.argmax(scores, axis=1)
+            valid = (left > 0)[:, None]
+            if include_bos_eos_tag:
+                best = best + jnp.where((left == 1)[:, None],
+                                        stop[None, :], 0.0)
+            alpha = jnp.where(valid, best, alpha)
+            back = jnp.where(valid, back, ident)  # padded: pass-through
+            return (alpha, left - 1), back
+
+        emits = jnp.swapaxes(p, 0, 1)[1:]
+        (alpha, _), backptrs = jax.lax.scan(
+            step, (alpha, left0 - 1), emits)
+        best_score = jnp.max(alpha, axis=-1)
+        last = jnp.argmax(alpha, axis=-1)
+
+        def backtrack(tag, back):
+            prev = jnp.take_along_axis(back, tag[:, None], axis=1)[:, 0]
+            return prev, tag
+
+        tag0, path_rev = jax.lax.scan(backtrack, last, backptrs,
+                                      reverse=True)
+        path = jnp.concatenate([tag0[None, :], path_rev], axis=0)  # [T, B]
+        path = jnp.swapaxes(path, 0, 1)                            # [B, T]
+        mask = jnp.arange(t)[None, :] < lengths[:, None]
+        return best_score, jnp.where(mask, path, 0)
+
+    out = dispatch.call("viterbi_decode", f, [pot, trans])
+    return out[0], out[1]
+
+
+class ViterbiDecoder:
+    """reference viterbi_decode.py:144 layer form."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class _NeedsDownload:
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "dataset download requires network egress; provide local files "
+            "through paddle_tpu.io.Dataset instead")
+
+
+Imdb = Conll05st = Movielens = UCIHousing = WMT14 = WMT16 = _NeedsDownload
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "Imdb", "Conll05st",
+           "Movielens", "UCIHousing", "WMT14", "WMT16"]

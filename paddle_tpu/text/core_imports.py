@@ -1,0 +1,5 @@
+"""Internal import indirection for paddle_tpu.text."""
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["dispatch", "Tensor", "as_tensor"]

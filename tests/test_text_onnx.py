@@ -1,0 +1,82 @@
+"""paddle.text / paddle.onnx surface tests."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _brute_force(pot_b, trans, length, include_tag):
+    """Best path over `length` steps; BOS = last trans row added at t=0,
+    EOS = second-to-last row added at the final valid step (reference
+    viterbi_decode_kernel.cc semantics)."""
+    n = pot_b.shape[1]
+    best, bp = -1e30, None
+    for seq in itertools.product(range(n), repeat=length):
+        s = pot_b[0, seq[0]]
+        if include_tag:
+            s += trans[n - 1, seq[0]]
+        for i in range(1, length):
+            s += trans[seq[i - 1], seq[i]] + pot_b[i, seq[i]]
+        if include_tag:
+            s += trans[n - 2, seq[length - 1]]
+        if s > best:
+            best, bp = s, seq
+    return best, bp
+
+
+@pytest.mark.parametrize("include_tag", [False, True])
+def test_viterbi_matches_brute_force(include_tag):
+    from paddle_tpu.text import viterbi_decode
+    B, T, N = 2, 5, 3
+    rng = np.random.RandomState(0)
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    scores, paths = viterbi_decode(paddle.to_tensor(pot),
+                                   paddle.to_tensor(trans),
+                                   include_bos_eos_tag=include_tag)
+    for b in range(B):
+        best, bp = _brute_force(pot[b], trans, T, include_tag)
+        assert abs(best - float(scores.numpy()[b])) < 1e-4
+        assert list(bp) == list(paths.numpy()[b])
+
+
+def test_viterbi_respects_lengths():
+    from paddle_tpu.text import viterbi_decode
+    B, T, N = 2, 6, 3
+    rng = np.random.RandomState(1)
+    pot = rng.randn(B, T, N).astype(np.float32)
+    trans = rng.randn(N, N).astype(np.float32)
+    lengths = np.array([3, 6])
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        lengths=paddle.to_tensor(lengths), include_bos_eos_tag=False)
+    for b in range(B):
+        best, bp = _brute_force(pot[b], trans, int(lengths[b]), False)
+        assert abs(best - float(scores.numpy()[b])) < 1e-4, b
+        got = list(paths.numpy()[b])
+        assert got[:lengths[b]] == list(bp)
+        assert all(v == 0 for v in got[lengths[b]:])   # padding masked
+
+
+def test_viterbi_decoder_layer():
+    from paddle_tpu.text import ViterbiDecoder
+    rng = np.random.RandomState(2)
+    dec = ViterbiDecoder(paddle.to_tensor(
+        rng.randn(4, 4).astype(np.float32)))
+    scores, paths = dec(paddle.to_tensor(
+        rng.randn(1, 4, 4).astype(np.float32)))
+    assert paths.shape == [1, 4]
+
+
+def test_onnx_export_points_to_stablehlo():
+    import paddle_tpu.onnx as onnx
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        onnx.export(None, "x")
+
+
+def test_text_datasets_raise_clearly():
+    from paddle_tpu.text import Imdb
+    with pytest.raises(NotImplementedError, match="egress"):
+        Imdb()
