@@ -65,16 +65,18 @@ def llama_tiny(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
-def rotary_embedding(x, theta: float = 10000.0, pos_offset: int = 0):
+def rotary_embedding(x, theta: float = 10000.0, pos_offset=0):
     """Apply RoPE to [B, S, H, D] (reference fused_rope op). Pairs are the
-    (even, odd) channel convention."""
+    (even, odd) channel convention. ``pos_offset`` may be a traced scalar
+    (cached decoding uses one compiled step for every position)."""
     def f(a):
         b, s, h, d = a.shape
         half = d // 2
         freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32)
                                  / half))
-        pos = jnp.arange(pos_offset, pos_offset + s,
-                         dtype=jnp.float32)[:, None] * freqs[None, :]
+        positions = (jnp.asarray(pos_offset, jnp.float32)
+                     + jnp.arange(s, dtype=jnp.float32))
+        pos = positions[:, None] * freqs[None, :]
         cos = jnp.cos(pos)[None, :, None, :]
         sin = jnp.sin(pos)[None, :, None, :]
         x1, x2 = a[..., :half], a[..., half:]
@@ -121,14 +123,16 @@ class LlamaAttention(nn.Layer):
         self.v_proj = _make_linear(col, h, kv)
         self.o_proj = _make_linear(row, h, h, is_row=True)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos: int = 0):
         b, s, h = x.shape
         hd, nh, nkv = self.head_dim, self.num_heads, self.num_kv_heads
         q = ops.reshape(self.q_proj(x), [b, s, nh, hd])
         k = ops.reshape(self.k_proj(x), [b, s, nkv, hd])
         v = ops.reshape(self.v_proj(x), [b, s, nkv, hd])
-        q = rotary_embedding(q, self.cfg.rope_theta)
-        k = rotary_embedding(k, self.cfg.rope_theta)
+        q = rotary_embedding(q, self.cfg.rope_theta, pos_offset=pos)
+        k = rotary_embedding(k, self.cfg.rope_theta, pos_offset=pos)
+        if cache is not None:
+            return self._cached_attention(x, q, k, v, cache, pos)
         if nkv != nh:   # GQA: repeat kv heads
             rep = nh // nkv
             k = ops.reshape(
@@ -149,6 +153,43 @@ class LlamaAttention(nn.Layer):
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         return self.o_proj(ops.reshape(out, [b, s, h]))
+
+    def _cached_attention(self, x, q, k, v, cache, pos: int):
+        """Decode-time attention against the KV cache (reference cached
+        decoding in fused_multi_transformer): writes this step's K/V at
+        ``pos`` and attends the query over all cached positions <= its
+        global position. Returns (out, new_cache)."""
+        import jax
+        b, s, h = x.shape
+        nh, nkv, hd = self.num_heads, self.num_kv_heads, self.head_dim
+        scale = 1.0 / math.sqrt(hd)
+
+        def f(qa, ka, va, kc, vc):
+            zero = jnp.asarray(0, jnp.int32)
+            p0 = jnp.asarray(pos, jnp.int32)
+            kc = jax.lax.dynamic_update_slice(kc, ka,
+                                              (zero, p0, zero, zero))
+            vc = jax.lax.dynamic_update_slice(vc, va,
+                                              (zero, p0, zero, zero))
+            kk, vv = kc, vc
+            if nkv != nh:
+                rep = nh // nkv
+                kk = jnp.repeat(kc, rep, axis=2)
+                vv = jnp.repeat(vc, rep, axis=2)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", qa,
+                                kk).astype(jnp.float32) * scale
+            total = kk.shape[1]
+            kpos = jnp.arange(total)[None, None, None, :]
+            qpos = (p0 + jnp.arange(s))[None, None, :, None]
+            logits = jnp.where(kpos <= qpos, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(qa.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, vv)
+            return out.reshape(b, s, nh * hd), kc, vc
+
+        out, kc, vc = dispatch.call(
+            "llama_cached_attention", f,
+            [q, k, v, Tensor(cache["k"]), Tensor(cache["v"])])
+        return self.o_proj(out), {"k": kc._data, "v": vc._data}
 
 
 class LlamaMLP(nn.Layer):
@@ -176,7 +217,13 @@ class LlamaBlock(nn.Layer):
                                                    epsilon=cfg.rms_eps)
         self.mlp = LlamaMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos: int = 0):
+        if cache is not None:
+            att, new_cache = self.self_attn(self.input_layernorm(x),
+                                            cache=cache, pos=pos)
+            x = x + att
+            return x + self.mlp(self.post_attention_layernorm(x)), \
+                new_cache
         x = x + self.self_attn(self.input_layernorm(x))
         return x + self.mlp(self.post_attention_layernorm(x))
 
@@ -237,21 +284,113 @@ class LlamaForCausalLM(nn.Layer):
 
     @dispatch.no_grad()
     def generate(self, input_ids, max_new_tokens: int = 32,
-                 temperature: float = 0.0):
-        """Greedy / temperature sampling without KV cache (full-context
-        recompute per token — correct first, fast later)."""
+                 temperature: float = 0.0, use_cache: bool = True):
+        """Autoregressive decode. ``use_cache=True`` (default) runs a
+        KV-cached jitted decode loop — prefill once, then one [B, 1] step
+        per token against the cache (reference: the fused_multi_transformer
+        cached-decoding path); ``use_cache=False`` recomputes the full
+        context every token (numerics ground truth)."""
         from ..core.generator import next_key
         import jax
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(jnp.asarray(input_ids))
-        for _ in range(max_new_tokens):
-            logits = self(ids)
-            last = logits[:, -1, :]
-            if temperature > 0:
-                arr = last._data / temperature
-                nxt = jax.random.categorical(next_key(), arr, axis=-1)
-            else:
-                nxt = jnp.argmax(last._data, axis=-1)
-            ids = ops.concat([ids, Tensor(nxt[:, None].astype(
-                ids._data.dtype))], axis=1)
-        return ids
+        # identical RNG contract on both paths: greedy consumes no keys;
+        # sampling pre-splits one stream of per-token keys
+        keys = (jax.random.split(next_key(), max_new_tokens)
+                if temperature > 0 else
+                jnp.zeros((max_new_tokens, 2), jnp.uint32))
+        if not use_cache:
+            for i in range(max_new_tokens):
+                logits = self(ids)
+                last = logits[:, -1, :]
+                if temperature > 0:
+                    nxt = jax.random.categorical(
+                        keys[i], last._data / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(last._data, axis=-1)
+                ids = ops.concat([ids, Tensor(nxt[:, None].astype(
+                    ids._data.dtype))], axis=1)
+            return ids
+        return self._generate_cached(ids, max_new_tokens, temperature,
+                                     keys)
+
+    def _decode_logits(self, token_arr, cache, pos: int):
+        """One cached step: token_arr [B, t]; returns (last-token logits,
+        new cache) — traced under jit by _generate_cached."""
+        h = self.model.embed_tokens(Tensor(token_arr))
+        new_cache = []
+        for li, blk in enumerate(self.model.layers):
+            h, c = blk(h, cache=cache[li], pos=pos)
+            new_cache.append(c)
+        h = self.model.norm(h)
+        if self.lm_head is None:
+            logits = ops.matmul(h, self.model.embed_tokens.weight,
+                                transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        return logits._data[:, -1, :], new_cache
+
+    def _generate_cached(self, ids: Tensor, max_new_tokens: int,
+                         temperature: float, keys):
+        import jax
+        cfg = self.cfg
+        b, prompt_len = ids.shape
+        total = prompt_len + max_new_tokens
+        hd = cfg.hidden_size // cfg.num_heads
+        cache = [
+            {"k": jnp.zeros((b, total, cfg.num_kv_heads, hd), jnp.float32),
+             "v": jnp.zeros((b, total, cfg.num_kv_heads, hd), jnp.float32)}
+            for _ in range(cfg.num_layers)]
+        params = list(self.parameters())
+
+        def with_params(fn):
+            def wrapped(pa, *args):
+                originals = [p._data for p in params]
+                for p, a in zip(params, pa):
+                    p._data = a
+                try:
+                    return fn(*args)
+                finally:
+                    for p, o in zip(params, originals):
+                        p._data = o
+            return wrapped
+
+        # ONE compiled program: prefill + a lax.scan over decode steps
+        # (pos is a traced scalar; the cache lives in the scan carry, so
+        # there is a single device dispatch for the whole generation)
+        tok_dtype = ids._data.dtype
+
+        def decode_all(prompt, cache_, keys):
+            logits, cache_ = self._decode_logits(prompt, cache_, 0)
+
+            def body(carry, key):
+                logits, cache_, pos = carry
+                if temperature > 0:
+                    nxt = jax.random.categorical(
+                        key, logits / temperature, axis=-1)
+                else:
+                    nxt = jnp.argmax(logits, axis=-1)
+                logits, cache_ = self._decode_logits(
+                    nxt[:, None].astype(tok_dtype), cache_, pos)
+                return (logits, cache_, pos + 1), nxt
+
+            init = (logits, cache_, jnp.asarray(prompt_len, jnp.int32))
+            (_, _, _), new_toks = jax.lax.scan(body, init, keys)
+            return jnp.swapaxes(new_toks, 0, 1).astype(tok_dtype)  # [B, n]
+
+        if not hasattr(self, "_decode_jit"):
+            self._decode_jit = {}
+        # the concrete temperature is baked into the compiled body, so it
+        # must key the cache; cap the cache (serving with many distinct
+        # prompt lengths should bucket/pad prompts instead)
+        jit_key = (b, prompt_len, max_new_tokens, float(temperature))
+        fn = self._decode_jit.get(jit_key)
+        if fn is None:
+            if len(self._decode_jit) >= 16:
+                self._decode_jit.pop(next(iter(self._decode_jit)))
+            fn = jax.jit(with_params(decode_all))
+            self._decode_jit[jit_key] = fn
+
+        pa = [p._data for p in params]
+        new_toks = fn(pa, ids._data, cache, keys)
+        return Tensor(jnp.concatenate([ids._data, new_toks], axis=1))

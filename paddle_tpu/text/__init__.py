@@ -7,7 +7,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .core_imports import Tensor, as_tensor, dispatch  # noqa: F401
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -26,7 +27,6 @@ def viterbi_decode(potentials, transition_params, lengths=None,
     pot = as_tensor(potentials)
     trans = as_tensor(transition_params)
     if lengths is None:
-        import numpy as np
         lengths = jnp.full((pot.shape[0],), pot.shape[1], jnp.int32)
     else:
         lengths = as_tensor(lengths)._data.astype(jnp.int32)

@@ -60,6 +60,33 @@ class TestLlama:
         np.testing.assert_allclose(out.numpy()[:, 0], x.numpy()[:, 0],
                                    atol=1e-6)
 
+    def test_kv_cache_decode_matches_no_cache(self):
+        from paddle_tpu.models import LlamaForCausalLM
+        paddle.seed(5)
+        ids = paddle.to_tensor(
+            np.random.randint(0, 512, (2, 8)).astype(np.int64))
+        for kv in (4, 2):     # MHA and GQA
+            m = LlamaForCausalLM(self._tiny(num_kv_heads=kv))
+            a = m.generate(ids, max_new_tokens=6, use_cache=False).numpy()
+            b = m.generate(ids, max_new_tokens=6, use_cache=True).numpy()
+            np.testing.assert_array_equal(a, b)
+
+    def test_sampled_decode_rng_parity(self):
+        """temperature>0: same seed -> identical samples on both paths
+        (the per-token key stream is shared)."""
+        from paddle_tpu.models import LlamaForCausalLM
+        paddle.seed(6)
+        m = LlamaForCausalLM(self._tiny())
+        ids = paddle.to_tensor(
+            np.random.randint(0, 512, (1, 8)).astype(np.int64))
+        paddle.seed(123)
+        a = m.generate(ids, max_new_tokens=5, temperature=1.0,
+                       use_cache=False).numpy()
+        paddle.seed(123)
+        b = m.generate(ids, max_new_tokens=5, temperature=1.0,
+                       use_cache=True).numpy()
+        np.testing.assert_array_equal(a, b)
+
     def test_generate_greedy_deterministic(self):
         from paddle_tpu.models import LlamaForCausalLM
         paddle.seed(2)
