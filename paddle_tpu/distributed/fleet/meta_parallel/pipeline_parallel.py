@@ -113,6 +113,17 @@ def spmd_pipeline(block_fn: Callable, stacked: Sequence, xs, *, mesh,
     L = stacked[0].shape[0]
     K = L // S
     assert K * S == L, (L, S)
+    # Schedule semantics on TPU: the scan compiles to ONE program whose
+    # bubble fraction is (S-1)/(m+S-1) — identical for FThenB and 1F1B —
+    # and XLA's latency-hiding scheduler overlaps the reversed (backward)
+    # scan with collective permutes. What distinguishes the reference
+    # schedules is MEMORY: FThenB retains every tick's activations; 1F1B
+    # (and the VPP/ZBH1 names, which exist to shrink per-rank residency
+    # further) rematerialize per tick via jax.checkpoint, giving the
+    # 1F1B-steady-state footprint. A true interleaved-VPP tick table
+    # (chunked stages cycling the ring) is a possible future schedule;
+    # its bubble advantage on GPU comes from finer send/recv granularity
+    # that the fused XLA program does not pay in the first place.
     if schedule.upper() in ("1F1B", "VPP", "ZBH1"):
         block_fn = jax.checkpoint(block_fn)
 
