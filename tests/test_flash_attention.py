@@ -161,6 +161,63 @@ def test_fully_masked_rows_causal_sq_gt_sk():
     assert np.all(np.isfinite(np.asarray(gv)))
 
 
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_attn_unpadded_matches_per_sequence(causal):
+    """Varlen packed attention == dense attention run per sequence."""
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import flash_attn_unpadded
+
+    rng = np.random.RandomState(0)
+    lens = [5, 9, 3]
+    total, h, d = sum(lens), 2, 16
+    q = rng.randn(total, h, d).astype(np.float32)
+    k = rng.randn(total, h, d).astype(np.float32)
+    v = rng.randn(total, h, d).astype(np.float32)
+    cu = np.cumsum([0] + lens).astype(np.int32)
+    scale = 1.0 / math.sqrt(d)
+
+    out, _ = flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu),
+        max(lens), max(lens), scale, causal=causal)
+    got = out.numpy()
+
+    for i, L in enumerate(lens):
+        s, e = cu[i], cu[i + 1]
+        ref = _ref_attn(jnp.asarray(q[None, s:e]), jnp.asarray(k[None, s:e]),
+                        jnp.asarray(v[None, s:e]), causal, scale)
+        np.testing.assert_allclose(got[s:e], np.asarray(ref)[0],
+                                   atol=2e-5,
+                                   err_msg=f"sequence {i} mismatch")
+
+
+def test_flash_attn_unpadded_no_cross_sequence_leak():
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional import flash_attn_unpadded
+
+    rng = np.random.RandomState(1)
+    lens = [4, 4]
+    total, h, d = 8, 1, 8
+    q = rng.randn(total, h, d).astype(np.float32)
+    k = rng.randn(total, h, d).astype(np.float32)
+    v = rng.randn(total, h, d).astype(np.float32)
+    cu = np.cumsum([0] + lens).astype(np.int32)
+    out1, _ = flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 4, 4,
+        1.0 / math.sqrt(d))
+    # perturb sequence 2's K/V: sequence 1's output must not change
+    k2, v2 = k.copy(), v.copy()
+    k2[4:] += 100.0
+    v2[4:] -= 100.0
+    out2, _ = flash_attn_unpadded(
+        paddle.to_tensor(q), paddle.to_tensor(k2), paddle.to_tensor(v2),
+        paddle.to_tensor(cu), paddle.to_tensor(cu), 4, 4,
+        1.0 / math.sqrt(d))
+    np.testing.assert_allclose(out1.numpy()[:4], out2.numpy()[:4],
+                               atol=1e-6)
+
+
 def test_grad_under_jit():
     q, k, v = _rand_qkv(s=64)
     f = jax.jit(jax.grad(lambda q: jnp.sum(fa.flash_attention_fwd(
