@@ -23,6 +23,7 @@ from . import fleet
 from . import sharding
 from . import checkpoint
 from . import auto_tuner
+from . import rpc
 from .auto_parallel.engine import Engine
 from .checkpoint import load_state_dict, save_state_dict
 from .fleet.mpu.mp_ops import split

@@ -1,0 +1,117 @@
+"""paddle.distributed.rpc — user RPC API.
+
+Capability parity with the reference RPC surface (reference:
+python/paddle/distributed/rpc/rpc.py — init_rpc, rpc_sync, rpc_async,
+shutdown over brpc). TPU-native: under the single-controller SPMD model
+one Python process drives all local devices, so an in-process executor
+IS the worker-local fast path (the reference also short-circuits
+self-targeted calls); cross-HOST RPC would ride the launcher's
+coordinator channel and is gated until multi-host wiring lands.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import threading
+from typing import Any, Dict, Optional
+
+_workers: Dict[str, dict] = {}
+_pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
+_current_name: Optional[str] = None
+
+
+class WorkerInfo:
+    def __init__(self, name: str, rank: int, ip: str = "127.0.0.1",
+                 port: int = 0):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+    def __repr__(self):
+        return f"WorkerInfo(name={self.name!r}, rank={self.rank})"
+
+
+def init_rpc(name: str, rank: int = 0, world_size: int = 1,
+             master_endpoint: Optional[str] = None):
+    """Register this process as an RPC worker.
+
+    ``master_endpoint`` is accepted for reference-signature parity but
+    unused by the in-process executor (a warning is emitted). Cross-host
+    RPC (world_size > 1) is gated until the multi-host coordinator
+    channel lands — it raises up front rather than failing at call time.
+    """
+    global _pool, _current_name
+    if world_size > 1:
+        raise NotImplementedError(
+            "cross-host RPC needs the multi-host launcher (coordinator "
+            "channel); single-controller hosts register in-process workers")
+    if master_endpoint is not None:
+        import warnings
+        warnings.warn("master_endpoint is ignored by the in-process RPC "
+                      "executor")
+    _workers[name] = {"info": WorkerInfo(name, rank)}
+    _current_name = name
+    if _pool is None:
+        _pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+    return _workers[name]["info"]
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    name = name or _current_name
+    if name not in _workers:
+        raise RuntimeError(f"unknown RPC worker {name!r}; call init_rpc")
+    return _workers[name]["info"]
+
+
+def get_all_worker_infos():
+    return [w["info"] for w in _workers.values()]
+
+
+def _check(to: str):
+    if to not in _workers:
+        raise RuntimeError(f"unknown RPC worker {to!r}")
+
+
+def rpc_sync(to: str, fn, args=(), kwargs=None, timeout: float = -1):
+    """Run ``fn`` on worker ``to`` and wait for the result."""
+    return rpc_async(to, fn, args, kwargs, timeout).result()
+
+
+class _TimedFuture:
+    """Future wrapper enforcing the rpc_async timeout on result()."""
+
+    def __init__(self, fut, timeout):
+        self._fut = fut
+        self._timeout = None if timeout in (-1, None) else timeout
+
+    def result(self, timeout=None):
+        return self._fut.result(timeout if timeout is not None
+                                else self._timeout)
+
+    def done(self):
+        return self._fut.done()
+
+    def wait(self):
+        return self.result()
+
+
+def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = -1):
+    """Run ``fn`` on worker ``to``; returns a Future whose ``result()``
+    honors ``timeout`` (seconds; -1 = wait forever)."""
+    _check(to)
+    if _pool is None:
+        raise RuntimeError("call init_rpc first")
+    return _TimedFuture(_pool.submit(fn, *args, **(kwargs or {})), timeout)
+
+
+def shutdown():
+    global _pool, _current_name
+    if _pool is not None:
+        _pool.shutdown(wait=True)
+        _pool = None
+    _workers.clear()
+    _current_name = None
+
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
