@@ -60,6 +60,33 @@ class TestLlama:
         np.testing.assert_allclose(out.numpy()[:, 0], x.numpy()[:, 0],
                                    atol=1e-6)
 
+    def test_ring_attention_with_tp(self):
+        """LLaMA with context_parallel='ring' + mp TP on a sep x mp mesh:
+        loss matches the dense single-config model on the same weights."""
+        from paddle_tpu.distributed import mesh as mesh_mod
+        from paddle_tpu.models import LlamaForCausalLM
+
+        old = mesh_mod._global_mesh
+        try:
+            mesh_mod.set_mesh(mesh_mod.build_mesh({"sep": 4, "mp": 2}))
+            paddle.seed(9)
+            m = LlamaForCausalLM(self._tiny(context_parallel="ring",
+                                            mp_degree=2))
+            ids = paddle.to_tensor(
+                np.random.randint(0, 512, (2, 32)).astype(np.int64))
+            _, loss = m(ids, labels=ids)
+            loss.backward()
+            assert all(p.grad is not None for p in m.parameters()
+                       if not p.stop_gradient)
+
+            dense = LlamaForCausalLM(self._tiny())
+            dense.set_state_dict(m.state_dict())
+            _, ref = dense(ids, labels=ids)
+            np.testing.assert_allclose(float(loss.numpy()),
+                                       float(ref.numpy()), rtol=1e-4)
+        finally:
+            mesh_mod._global_mesh = old
+
     def test_kv_cache_decode_matches_no_cache(self):
         from paddle_tpu.models import LlamaForCausalLM
         paddle.seed(5)
