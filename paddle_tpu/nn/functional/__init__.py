@@ -9,5 +9,7 @@ from .pooling import *     # noqa: F401,F403
 from .norm import *        # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .flash_attention import *  # noqa: F401,F403
+from .vision import *      # noqa: F401,F403
 
-from . import activation, common, conv, flash_attention, loss, norm, pooling
+from . import (activation, common, conv, flash_attention, loss, norm,
+               pooling, vision)

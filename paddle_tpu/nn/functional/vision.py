@@ -1,0 +1,183 @@
+"""Vision functionals: affine_grid / grid_sample / temporal_shift.
+
+Reference: python/paddle/nn/functional/vision.py (affine_grid:28,
+grid_sample:237, channel_shuffle lives in common here), phi kernels
+paddle/phi/kernels/impl/affine_grid_kernel_impl.h, gpu/grid_sample_kernel.cu,
+gpu/temporal_shift_kernel.cu. TPU-native: the sampler is a pair of gathers +
+elementwise lerps that XLA fuses into one kernel; everything is static-shape
+and fully differentiable through ``dispatch.call`` (jax.vjp), so
+``grid_sample`` backprops to both the input feature map and the grid — same
+contract as the reference CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+
+__all__ = ["affine_grid", "grid_sample", "temporal_shift"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _affine_base_grid(n, h, w, align_corners, dtype):
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, w, dtype=dtype)
+        ys = jnp.linspace(-1.0, 1.0, h, dtype=dtype)
+    else:
+        xs = (jnp.arange(w, dtype=dtype) * 2 + 1) / w - 1
+        ys = (jnp.arange(h, dtype=dtype) * 2 + 1) / h - 1
+    gx, gy = jnp.meshgrid(xs, ys)  # (h, w)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # (h, w, 3)
+    return jnp.broadcast_to(base, (n, h, w, 3))
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Generate a 2D/3D sampling grid from batched affine matrices.
+
+    theta: (N, 2, 3) for 2D -> grid (N, H, W, 2);
+           (N, 3, 4) for 3D -> grid (N, D, H, W, 3).
+    Reference: python/paddle/nn/functional/vision.py:28.
+    """
+    theta = _t(theta)
+    shape = [int(s) for s in out_shape]
+
+    def f(th):
+        dtype = th.dtype
+        if th.shape[-2:] == (2, 3):
+            n, _, h, w = shape
+            base = _affine_base_grid(n, h, w, align_corners, dtype)
+            # (n,h,w,3) @ (n,3,2) -> (n,h,w,2); highest precision — grid
+            # coords feed a sampler, bf16 MXU rounding visibly blurs output
+            return jnp.einsum("nhwk,nck->nhwc", base, th,
+                              precision="highest")
+        n, _, d, h, w = shape
+        if align_corners:
+            def axis(sz):
+                return jnp.linspace(-1.0, 1.0, sz, dtype=dtype)
+        else:
+            def axis(sz):
+                return (jnp.arange(sz, dtype=dtype) * 2 + 1) / sz - 1
+        gz, gy, gx = jnp.meshgrid(axis(d), axis(h), axis(w), indexing="ij")
+        base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+        base = jnp.broadcast_to(base, (n, d, h, w, 4))
+        return jnp.einsum("ndhwk,nck->ndhwc", base, th, precision="highest")
+
+    return dispatch.call("affine_grid", f, [theta])
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1) * 0.5 * (size - 1)
+    return ((coord + 1) * size - 1) * 0.5
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample ``x`` (N,C,H,W) at normalized ``grid`` (N,Hg,Wg,2) locations.
+
+    grid[..., 0] is x (width) in [-1, 1], grid[..., 1] is y (height).
+    Modes: bilinear | nearest. Padding: zeros | border | reflection.
+    Reference: python/paddle/nn/functional/vision.py:237,
+    paddle/phi/kernels/gpu/grid_sample_kernel.cu.
+    """
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample mode must be bilinear|nearest, got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode}")
+    x, grid = _t(x), _t(grid)
+
+    def f(a, g):
+        n, c, h, w = a.shape
+        gdt = g.dtype
+        ix = _unnormalize(g[..., 0], w, align_corners)
+        iy = _unnormalize(g[..., 1], h, align_corners)
+
+        def reflect(coord, size):
+            # reference reflects about pixel centers (align) or borders
+            if align_corners:
+                span = 2 * (size - 1)
+                if span == 0:
+                    return jnp.zeros_like(coord)
+                coord = jnp.abs(coord) % span
+                return jnp.where(coord > size - 1, span - coord, coord)
+            span = 2 * size
+            coord = jnp.abs(coord + 0.5) % span
+            coord = jnp.where(coord > size, span - coord, coord)
+            return jnp.clip(coord - 0.5, 0, size - 1)
+
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, w - 1)
+            iy = jnp.clip(iy, 0, h - 1)
+        elif padding_mode == "reflection":
+            ix = reflect(ix, w)
+            iy = reflect(iy, h)
+
+        def gather(yi, xi):
+            # (n, hg, wg) integer coords -> (n, c, hg, wg) values
+            yi_c = jnp.clip(yi, 0, h - 1)
+            xi_c = jnp.clip(xi, 0, w - 1)
+            batch = jnp.arange(n).reshape(n, 1, 1)
+            vals = a[batch, :, yi_c, xi_c]          # (n, hg, wg, c)
+            if padding_mode == "zeros":
+                ok = ((yi >= 0) & (yi <= h - 1) & (xi >= 0) & (xi <= w - 1))
+                vals = vals * ok[..., None].astype(vals.dtype)
+            return jnp.moveaxis(vals, -1, 1)        # (n, c, hg, wg)
+
+        if mode == "nearest":
+            return gather(jnp.round(iy).astype(jnp.int32),
+                          jnp.round(ix).astype(jnp.int32))
+
+        x0 = jnp.floor(ix)
+        y0 = jnp.floor(iy)
+        x1, y1 = x0 + 1, y0 + 1
+        wx1 = (ix - x0).astype(gdt)
+        wy1 = (iy - y0).astype(gdt)
+        wx0, wy0 = 1 - wx1, 1 - wy1
+        x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+        y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+        out = (gather(y0i, x0i) * (wy0 * wx0)[:, None]
+               + gather(y0i, x1i) * (wy0 * wx1)[:, None]
+               + gather(y1i, x0i) * (wy1 * wx0)[:, None]
+               + gather(y1i, x1i) * (wy1 * wx1)[:, None])
+        return out
+
+    return dispatch.call("grid_sample", f, [x, grid])
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift: roll a channel slice one step along time.
+
+    x: (N*T, C, H, W) with T=seg_num. The first ``shift_ratio`` of channels
+    shifts backward in time, the next ``shift_ratio`` forward, rest unchanged.
+    Reference: paddle/phi/kernels/gpu/temporal_shift_kernel.cu,
+    python/paddle/nn/functional/extension.py temporal_shift.
+    """
+    x = _t(x)
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format}")
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.transpose(a, (0, 3, 1, 2))
+        nt, c, h, w = a.shape
+        t = seg_num
+        n = nt // t
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        v = a.reshape(n, t, c, h, w)
+        pad = jnp.zeros((n, 1, c, h, w), dtype=a.dtype)
+        back = jnp.concatenate([v[:, 1:, :c1], pad[:, :, :c1]], axis=1)
+        fwd = jnp.concatenate([pad[:, :, c1:c2], v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return dispatch.call("temporal_shift", f, [x])

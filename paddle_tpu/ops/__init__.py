@@ -215,6 +215,10 @@ def _register_all():
         register_module(mod, cat)
     from ..nn.functional import flash_attention as _fa
     register_module(_fa, "attention")
+    from ..nn.functional import vision as _vis
+    register_module(_vis, "vision")
+    from ..vision import ops as _vops
+    register_module(_vops, "vision")
 
 
 _register_all()

@@ -1,4 +1,5 @@
 """paddle.vision surface (reference: python/paddle/vision/)."""
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 
-__all__ = ["models"]
+__all__ = ["models", "ops"]

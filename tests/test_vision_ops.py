@@ -1,0 +1,367 @@
+"""Vision/detection op tests: NMS family, ROI family, codecs, YOLO,
+grid_sample/affine_grid, deform_conv2d.
+
+Reference behaviors: python/paddle/vision/ops.py and the phi kernels; where
+torch implements the same op (grid_sample, roi_align via torchvision absent
+— use hand checks), we verify against torch CPU or hand-computed values.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+import paddle_tpu.nn.functional as F
+
+
+def _np_nms(boxes, scores, thr):
+    order = np.argsort(-scores)
+    keep = []
+    sup = np.zeros(len(boxes), bool)
+    for i in order:
+        if sup[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if sup[j] or j == i:
+                continue
+            xx1 = max(boxes[i, 0], boxes[j, 0])
+            yy1 = max(boxes[i, 1], boxes[j, 1])
+            xx2 = min(boxes[i, 2], boxes[j, 2])
+            yy2 = min(boxes[i, 3], boxes[j, 3])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            a1 = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a2 = (boxes[j, 2] - boxes[j, 0]) * (boxes[j, 3] - boxes[j, 1])
+            iou = inter / (a1 + a2 - inter)
+            if iou > thr:
+                sup[j] = True
+    return keep
+
+
+class TestNMS:
+    def test_matches_reference_greedy(self):
+        rng = np.random.RandomState(0)
+        xy = rng.uniform(0, 50, (40, 2)).astype(np.float32)
+        wh = rng.uniform(5, 30, (40, 2)).astype(np.float32)
+        boxes = np.concatenate([xy, xy + wh], axis=1)
+        scores = rng.uniform(0, 1, 40).astype(np.float32)
+        out = vops.nms(paddle.to_tensor(boxes), 0.4,
+                       scores=paddle.to_tensor(scores))
+        np.testing.assert_array_equal(out.numpy(),
+                                      np.asarray(_np_nms(boxes, scores, 0.4)))
+
+    def test_no_scores_keeps_input_order(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40]],
+                         dtype=np.float32)
+        out = vops.nms(paddle.to_tensor(boxes), 0.3)
+        np.testing.assert_array_equal(out.numpy(), [0, 2])
+
+    def test_categories_do_not_suppress_each_other(self):
+        boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], dtype=np.float32)
+        scores = np.array([0.9, 0.8], dtype=np.float32)
+        cats = np.array([0, 1])
+        out = vops.nms(paddle.to_tensor(boxes), 0.3,
+                       scores=paddle.to_tensor(scores),
+                       category_idxs=paddle.to_tensor(cats),
+                       categories=[0, 1])
+        assert len(out.numpy()) == 2
+
+    def test_multiclass_nms(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [30, 30, 40, 40]],
+                         dtype=np.float32)
+        scores = np.array([[0.9, 0.85, 0.2], [0.1, 0.2, 0.7]],
+                          dtype=np.float32)  # (C=2, N=3)
+        out, idx, num = vops.multiclass_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.3, nms_threshold=0.3, return_index=True)
+        o = out.numpy()
+        assert int(num.numpy()[0]) == o.shape[0] == 2
+        assert o.shape[1] == 6
+        # both detections above threshold survive per-class NMS
+        assert set(o[:, 0].astype(int)) == {0, 1}
+
+    def test_matrix_nms_decays_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10], [0.5, 0.5, 10.5, 10.5],
+                          [30, 30, 40, 40]], dtype=np.float32)
+        scores = np.array([[0.0, 0.0, 0.0], [0.9, 0.8, 0.7]],
+                          dtype=np.float32)
+        out, num = vops.matrix_nms(paddle.to_tensor(boxes),
+                                   paddle.to_tensor(scores),
+                                   score_threshold=0.1, background_label=0)
+        o = out.numpy()
+        assert int(num.numpy()[0]) == 3
+        # the overlapping second box's score is decayed below its raw 0.8
+        row = o[np.isclose(o[:, 2], 0.5)][0]
+        assert row[1] < 0.8
+
+
+class TestRoI:
+    def test_roi_align_uniform_map(self):
+        # constant feature map -> every bin averages to the constant
+        x = paddle.to_tensor(np.full((1, 1, 8, 8), 3.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+        out = vops.roi_align(x, boxes, [1], output_size=2, aligned=False)
+        np.testing.assert_allclose(out.numpy(), np.full((1, 1, 2, 2), 3.0),
+                                   rtol=1e-5)
+
+    def test_roi_align_gradient_flows(self):
+        x = paddle.to_tensor(
+            np.arange(64, dtype=np.float32).reshape(1, 1, 8, 8))
+        x.stop_gradient = False
+        boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+        out = vops.roi_align(x, boxes, [1], output_size=2)
+        out.sum().backward()
+        assert x.grad is not None
+        assert float(x.grad.numpy().sum()) == pytest.approx(4.0, rel=1e-4)
+
+    def test_roi_pool_max(self):
+        a = np.zeros((1, 1, 8, 8), np.float32)
+        a[0, 0, 2, 2] = 7.0
+        a[0, 0, 6, 6] = 9.0
+        x = paddle.to_tensor(a)
+        boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+        out = vops.roi_pool(x, boxes, [1], output_size=2)
+        o = out.numpy()[0, 0]
+        assert o[0, 0] == 7.0 and o[1, 1] == 9.0
+
+    def test_psroi_pool_channel_groups(self):
+        # C = out_c(2) * 2 * 2; each bin reads its own channel group
+        a = np.stack([np.full((8, 8), float(c)) for c in range(8)])[None]
+        x = paddle.to_tensor(a.astype(np.float32))
+        boxes = paddle.to_tensor(np.array([[0, 0, 7, 7]], np.float32))
+        out = vops.psroi_pool(x, boxes, [1], output_size=2)
+        o = out.numpy()
+        assert o.shape == (1, 2, 2, 2)
+        np.testing.assert_allclose(o[0, 0], [[0, 1], [2, 3]], rtol=1e-5)
+        np.testing.assert_allclose(o[0, 1], [[4, 5], [6, 7]], rtol=1e-5)
+
+
+class TestBoxes:
+    def test_prior_box_shapes_and_range(self):
+        inp = paddle.zeros([1, 3, 4, 4])
+        img = paddle.zeros([1, 3, 32, 32])
+        boxes, var = vops.prior_box(inp, img, min_sizes=[8.0],
+                                    aspect_ratios=[1.0, 2.0], clip=True)
+        assert boxes.shape == [4, 4, 2, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+        assert var.shape == [4, 4, 2, 4]
+
+    def test_box_coder_roundtrip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        gt = np.array([[1, 1, 9, 9]], np.float32)
+        var = [0.1, 0.1, 0.2, 0.2]
+        enc = vops.box_coder(paddle.to_tensor(priors), var,
+                             paddle.to_tensor(gt),
+                             code_type="encode_center_size")
+        dec = vops.box_coder(paddle.to_tensor(priors), var,
+                             paddle.to_tensor(enc.numpy()),
+                             code_type="decode_center_size", axis=1)
+        d = dec.numpy()  # (M, N, 4) -> each row decodes back to gt
+        np.testing.assert_allclose(d[0, 0], gt[0], atol=1e-4)
+        np.testing.assert_allclose(d[0, 1], gt[0], atol=1e-4)
+
+    def test_box_clip(self):
+        boxes = paddle.to_tensor(
+            np.array([[[-5, -5, 50, 50]]], np.float32))
+        im_info = paddle.to_tensor(np.array([[40.0, 30.0, 1.0]], np.float32))
+        out = vops.box_clip(boxes, im_info)
+        np.testing.assert_allclose(out.numpy()[0, 0], [0, 0, 29, 39])
+
+    def test_bipartite_match_greedy(self):
+        d = np.array([[0.9, 0.1, 0.3], [0.2, 0.8, 0.4]], np.float32)
+        idx, dist = vops.bipartite_match(paddle.to_tensor(d))
+        np.testing.assert_array_equal(idx.numpy()[0], [0, 1, -1])
+        np.testing.assert_allclose(dist.numpy()[0], [0.9, 0.8, 0.0])
+
+    def test_bipartite_match_per_prediction(self):
+        d = np.array([[0.9, 0.6, 0.3]], np.float32)
+        idx, _ = vops.bipartite_match(paddle.to_tensor(d),
+                                      match_type="per_prediction",
+                                      dist_threshold=0.5)
+        np.testing.assert_array_equal(idx.numpy()[0], [0, 0, -1])
+
+
+class TestYolo:
+    def test_yolo_box_shapes_and_sigmoid_center(self):
+        n, na, c, h, w = 2, 2, 3, 4, 4
+        x = paddle.to_tensor(
+            np.zeros((n, na * (5 + c), h, w), np.float32))
+        img = paddle.to_tensor(np.full((n, 2), 64, np.int32))
+        boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30],
+                                      class_num=c, conf_thresh=0.0,
+                                      downsample_ratio=16)
+        assert boxes.shape == [n, na * h * w, 4]
+        assert scores.shape == [n, na * h * w, c]
+        # zero logits -> sigmoid 0.5 center in first cell -> cx=0.5/4*64=8
+        b0 = boxes.numpy()[0, 0]
+        assert b0[2] > b0[0] and b0[3] > b0[1]
+
+    def test_yolo_loss_decreases_on_fit(self):
+        rng = np.random.RandomState(0)
+        n, na, c, h, w = 1, 3, 2, 4, 4
+        x = paddle.to_tensor(
+            rng.randn(n, na * (5 + c), h, w).astype(np.float32))
+        x.stop_gradient = False
+        gt = paddle.to_tensor(
+            np.array([[[0.4, 0.4, 0.3, 0.3]]], np.float32))
+        lbl = paddle.to_tensor(np.array([[1]], np.int64))
+        loss = vops.yolo_loss(x, gt, lbl, anchors=[10, 13, 16, 30, 33, 23],
+                              anchor_mask=[0, 1, 2], class_num=c,
+                              ignore_thresh=0.7, downsample_ratio=8)
+        assert loss.shape == [n]
+        loss.sum().backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+    def test_generate_proposals(self):
+        rng = np.random.RandomState(1)
+        scores = rng.uniform(0, 1, (1, 3, 4, 4)).astype(np.float32)
+        deltas = rng.randn(1, 12, 4, 4).astype(np.float32) * 0.1
+        anchors = np.zeros((4, 4, 3, 4), np.float32)
+        for i in range(4):
+            for j in range(4):
+                for a, sz in enumerate([8, 16, 32]):
+                    cx, cy = j * 8 + 4, i * 8 + 4
+                    anchors[i, j, a] = [cx - sz / 2, cy - sz / 2,
+                                        cx + sz / 2, cy + sz / 2]
+        var = np.full_like(anchors, 1.0)
+        rois, rscores, num = vops.generate_proposals(
+            paddle.to_tensor(scores), paddle.to_tensor(deltas),
+            paddle.to_tensor(np.array([[32.0, 32.0]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(var),
+            pre_nms_top_n=50, post_nms_top_n=10, min_size=2.0)
+        r = rois.numpy()
+        assert r.shape[0] == int(num.numpy()[0]) <= 10
+        assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+        s = rscores.numpy()
+        assert (np.diff(s) <= 1e-6).all()  # score-descending
+
+    def test_distribute_fpn_proposals(self):
+        rois = np.array([[0, 0, 10, 10],      # small -> low level
+                         [0, 0, 200, 200]], np.float32)  # large -> high
+        multi, restore = vops.distribute_fpn_proposals(
+            paddle.to_tensor(rois), 2, 5, 4, 224)
+        assert len(multi) == 4
+        sizes = [m.shape[0] for m in multi]
+        assert sum(sizes) == 2
+        assert sorted(restore.numpy().ravel().tolist()) == [0, 1]
+
+
+class TestGridSample:
+    def test_identity_grid_bilinear(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 5, 7).astype(np.float32)
+        theta = np.tile(np.array([[1, 0, 0], [0, 1, 0]], np.float32),
+                        (2, 1, 1))
+        grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 7],
+                             align_corners=True)
+        out = F.grid_sample(paddle.to_tensor(x), grid, align_corners=True)
+        np.testing.assert_allclose(out.numpy(), x, atol=1e-5)
+
+    def test_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 2, 6, 6).astype(np.float32)
+        grid = rng.uniform(-1.3, 1.3, (2, 4, 5, 2)).astype(np.float32)
+        for mode in ("bilinear", "nearest"):
+            for padding in ("zeros", "border", "reflection"):
+                ours = F.grid_sample(paddle.to_tensor(x),
+                                     paddle.to_tensor(grid), mode=mode,
+                                     padding_mode=padding,
+                                     align_corners=True).numpy()
+                ref = torch.nn.functional.grid_sample(
+                    torch.tensor(x), torch.tensor(grid), mode=mode,
+                    padding_mode=padding, align_corners=True).numpy()
+                np.testing.assert_allclose(ours, ref, atol=1e-4,
+                                           err_msg=f"{mode}/{padding}")
+
+    def test_affine_grid_matches_torch_unaligned(self):
+        torch = pytest.importorskip("torch")
+        theta = np.array([[[0.8, 0.1, -0.2], [0.0, 1.2, 0.3]]], np.float32)
+        ours = F.affine_grid(paddle.to_tensor(theta), [1, 1, 4, 6],
+                             align_corners=False).numpy()
+        ref = torch.nn.functional.affine_grid(
+            torch.tensor(theta), [1, 1, 4, 6], align_corners=False).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_grid_sample_grad_wrt_grid(self):
+        x = paddle.to_tensor(
+            np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        g = paddle.to_tensor(np.zeros((1, 2, 2, 2), np.float32))
+        g.stop_gradient = False
+        out = F.grid_sample(x, g, align_corners=True)
+        out.sum().backward()
+        assert g.grad is not None
+        assert np.abs(g.grad.numpy()).sum() > 0
+
+
+class TestTemporalShift:
+    def test_shift_semantics(self):
+        # N=1, T=2, C=4, 1x1 spatial; ratio 0.25 -> 1 ch back, 1 ch fwd
+        v = np.arange(8, dtype=np.float32).reshape(2, 4, 1, 1)
+        out = F.temporal_shift(paddle.to_tensor(v), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        # ch0: backward shift (t gets t+1): out[t=0]=v[t=1], out[t=1]=0
+        assert out[0, 0, 0, 0] == v[1, 0, 0, 0]
+        assert out[1, 0, 0, 0] == 0
+        # ch1: forward shift: out[t=0]=0, out[t=1]=v[t=0]
+        assert out[0, 1, 0, 0] == 0
+        assert out[1, 1, 0, 0] == v[0, 1, 0, 0]
+        # ch2,3 unchanged
+        np.testing.assert_array_equal(out[:, 2:], v[:, 2:])
+
+
+class TestDeformConv:
+    def test_zero_offset_equals_conv2d(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = rng.randn(4, 3, 3, 3).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+        out = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                 paddle.to_tensor(w))
+        ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_mask_scales_output(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = rng.randn(2, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 4, 4), np.float32)
+        mask_half = np.full((1, 9, 4, 4), 0.5, np.float32)
+        full = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                  paddle.to_tensor(w))
+        half = vops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                  paddle.to_tensor(w),
+                                  mask=paddle.to_tensor(mask_half))
+        np.testing.assert_allclose(half.numpy(), full.numpy() * 0.5,
+                                   atol=1e-4)
+
+    def test_layer_and_grads(self):
+        layer = vops.DeformConv2D(2, 3, 3, padding=1)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 2, 5, 5).astype(np.float32))
+        x.stop_gradient = False
+        off = paddle.to_tensor(np.zeros((1, 18, 5, 5), np.float32))
+        out = layer(x, off)
+        assert out.shape == [1, 3, 5, 5]
+        out.sum().backward()
+        assert layer.weight.grad is not None
+        assert x.grad is not None
+
+
+class TestImageIO:
+    def test_read_decode_jpeg_roundtrip(self, tmp_path):
+        from PIL import Image
+        gy, gx = np.mgrid[0:10, 0:12]
+        arr = np.stack([gy * 20, gx * 15, gy * 10 + gx * 5],
+                       axis=-1).astype(np.uint8)
+        p = tmp_path / "img.jpg"
+        Image.fromarray(arr).save(p, quality=95)
+        raw = vops.read_file(str(p))
+        assert raw.numpy().dtype == np.uint8
+        img = vops.decode_jpeg(raw, mode="rgb")
+        assert img.shape == [3, 10, 12]
+        # lossy codec: just sanity-check closeness
+        diff = np.abs(img.numpy().transpose(1, 2, 0).astype(int)
+                      - arr.astype(int)).mean()
+        assert diff < 20
