@@ -77,7 +77,7 @@ _LAZY_MODULES = {
     "nn", "optimizer", "amp", "io", "jit", "distributed", "vision", "metric",
     "profiler", "autograd", "incubate", "framework", "device", "static", "hapi",
     "distribution", "linalg", "fft", "signal", "sparse", "text", "onnx", "quantization",
-    "models", "utils", "inference", "native", "audio",
+    "models", "utils", "inference", "native", "audio", "geometric",
 }
 
 

@@ -134,6 +134,12 @@ class Tensor:
     def numpy(self) -> np.ndarray:
         return np.asarray(self._data)
 
+    def __array__(self, dtype=None, copy=None):
+        # numpy protocol: one bulk device->host transfer instead of numpy
+        # falling back to per-element __getitem__ (each a dispatched gather)
+        arr = np.asarray(self._data)
+        return arr.astype(dtype) if dtype is not None else arr
+
     def item(self, *args):
         arr = np.asarray(self._data)
         return arr.item(*args)

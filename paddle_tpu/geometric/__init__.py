@@ -1,0 +1,304 @@
+"""paddle.geometric — graph learning ops (segment reductions, message
+passing, neighbor sampling, reindex).
+
+Reference: python/paddle/geometric/ (math.py segment_*, message_passing/
+send_recv.py send_u_recv:36 / send_ue_recv / send_uv, sampling/neighbors.py,
+reindex.py) over phi kernels (segment_pool_kernel, graph_send_recv_kernel,
+graph_sample_neighbors_kernel).
+
+TPU-native design: segment reductions and message passing lower to
+``jax.ops.segment_*`` — XLA scatter-reduce, which is exactly the TPU shape
+of the reference's CUDA atomic-scatter kernels, and differentiable through
+``dispatch.call`` for the training-path ops. Neighbor sampling and reindex
+are host-side (data-dependent shapes, dataloader territory) like the
+reference CPU kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv", "segment_sum", "segment_mean",
+    "segment_min", "segment_max", "reindex_graph", "reindex_heter_graph",
+    "sample_neighbors", "weighted_sample_neighbors",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _segment(data, segment_ids, mode, op_name):
+    data, seg = _t(data), _t(segment_ids)
+    n_seg = int(np.asarray(seg._data).max()) + 1 if seg.size else 0
+
+    def f(d, s):
+        s = s.astype(jnp.int32)
+        if mode == "sum":
+            return jax.ops.segment_sum(d, s, num_segments=n_seg)
+        if mode == "mean":
+            tot = jax.ops.segment_sum(d, s, num_segments=n_seg)
+            cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), s,
+                                      num_segments=n_seg)
+            cnt = jnp.maximum(cnt, 1).reshape((-1,) + (1,) * (d.ndim - 1))
+            return tot / cnt
+        if mode == "min":
+            out = jax.ops.segment_min(d, s, num_segments=n_seg)
+        else:
+            out = jax.ops.segment_max(d, s, num_segments=n_seg)
+        # empty segments: the reference memsets output to 0
+        # (phi/kernels/impl/segment_pool_kernel_impl.h)
+        touched = jax.ops.segment_sum(
+            jnp.ones((d.shape[0],), jnp.int32), s, num_segments=n_seg) > 0
+        return jnp.where(
+            touched.reshape((-1,) + (1,) * (d.ndim - 1)), out,
+            jnp.zeros((), d.dtype))
+
+    return dispatch.call(op_name, f, [data, seg],
+                         differentiable_mask=[True, False])
+
+
+def segment_sum(data, segment_ids, name=None):
+    """Sum-reduce rows of ``data`` by segment id (reference
+    python/paddle/geometric/math.py:23)."""
+    return _segment(data, segment_ids, "sum", "segment_sum")
+
+
+def segment_mean(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "mean", "segment_mean")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "min", "segment_min")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment(data, segment_ids, "max", "segment_max")
+
+
+def _recv_reduce(msgs, dst, n_out, reduce_op, dtype):
+    dst = dst.astype(jnp.int32)
+    if reduce_op == "sum":
+        return jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n_out)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],), dtype), dst,
+                                  num_segments=n_out)
+        return tot / jnp.maximum(cnt, 1).reshape(
+            (-1,) + (1,) * (msgs.ndim - 1))
+    if reduce_op == "min":
+        out = jax.ops.segment_min(msgs, dst, num_segments=n_out)
+    else:
+        out = jax.ops.segment_max(msgs, dst, num_segments=n_out)
+    # untouched rows hold the dtype identity (inf / INT_MAX); the reference
+    # zeroes them — mask by touched-ness, which also covers integer dtypes
+    touched = jax.ops.segment_sum(
+        jnp.ones((msgs.shape[0],), jnp.int32), dst, num_segments=n_out) > 0
+    return jnp.where(touched.reshape((-1,) + (1,) * (msgs.ndim - 1)), out,
+                     jnp.zeros((), msgs.dtype))
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] and scatter-reduce onto dst (reference
+    python/paddle/geometric/message_passing/send_recv.py:36)."""
+    x, src, dst = _t(x), _t(src_index), _t(dst_index)
+    n_out = int(out_size) if out_size is not None else x.shape[0]
+
+    def f(xa, s, d):
+        return _recv_reduce(xa[s.astype(jnp.int32)], d, n_out, reduce_op,
+                            xa.dtype)
+
+    return dispatch.call("send_u_recv", f, [x, src, dst],
+                         differentiable_mask=[True, False, False])
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine x[src] with edge feature y (add/sub/mul/div), then
+    scatter-reduce onto dst (reference send_recv.py send_ue_recv)."""
+    x, y, src, dst = _t(x), _t(y), _t(src_index), _t(dst_index)
+    n_out = int(out_size) if out_size is not None else x.shape[0]
+
+    def f(xa, ya, s, d):
+        m = xa[s.astype(jnp.int32)]
+        if message_op == "add":
+            m = m + ya
+        elif message_op == "sub":
+            m = m - ya
+        elif message_op == "mul":
+            m = m * ya
+        elif message_op == "div":
+            m = m / ya
+        else:
+            raise ValueError(f"unknown message_op {message_op}")
+        return _recv_reduce(m, d, n_out, reduce_op, m.dtype)
+
+    return dispatch.call("send_ue_recv", f, [x, y, src, dst],
+                         differentiable_mask=[True, True, False, False])
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints: x[src] (op) y[dst]
+    (reference send_recv.py send_uv)."""
+    x, y, src, dst = _t(x), _t(y), _t(src_index), _t(dst_index)
+
+    def f(xa, ya, s, d):
+        a = xa[s.astype(jnp.int32)]
+        b = ya[d.astype(jnp.int32)]
+        if message_op == "add":
+            return a + b
+        if message_op == "sub":
+            return a - b
+        if message_op == "mul":
+            return a * b
+        if message_op == "div":
+            return a / b
+        raise ValueError(f"unknown message_op {message_op}")
+
+    return dispatch.call("send_uv", f, [x, y, src, dst],
+                         differentiable_mask=[True, True, False, False])
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to a local contiguous space.
+
+    Returns (reindex_src, reindex_dst, out_nodes) where out_nodes is
+    [x, unique new neighbors] and reindex_* are edges in local ids.
+    Reference: python/paddle/geometric/reindex.py reindex_graph,
+    phi/kernels/gpu/graph_reindex_kernel.cu. Host-side: output shape is
+    data-dependent (sampler pipeline, not the training graph).
+    """
+    xs = np.asarray(_t(x)._data).ravel()
+    nb = np.asarray(_t(neighbors)._data).ravel()
+    cnt = np.asarray(_t(count)._data).ravel()
+    mapping = {}
+    out_nodes = []
+    for v in xs.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    for v in nb.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    reindex_src = np.asarray([mapping[v] for v in nb.tolist()], np.int64)
+    dst = np.repeat(np.arange(xs.shape[0]), cnt)
+    reindex_dst = dst.astype(np.int64)
+    return (Tensor(jnp.asarray(reindex_src.astype(np.int32))),
+            Tensor(jnp.asarray(reindex_dst.astype(np.int32))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int32))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are lists per edge type
+    (reference reindex.py reindex_heter_graph)."""
+    xs = np.asarray(_t(x)._data).ravel()
+    mapping = {}
+    out_nodes = []
+    for v in xs.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+    srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(_t(nb_t)._data).ravel()
+        cnt = np.asarray(_t(cnt_t)._data).ravel()
+        for v in nb.tolist():
+            if v not in mapping:
+                mapping[v] = len(out_nodes)
+                out_nodes.append(v)
+        srcs.append(np.asarray([mapping[v] for v in nb.tolist()], np.int64))
+        dsts.append(np.repeat(np.arange(xs.shape[0]), cnt).astype(np.int64))
+    return (Tensor(jnp.asarray(np.concatenate(srcs).astype(np.int32))),
+            Tensor(jnp.asarray(np.concatenate(dsts).astype(np.int32))),
+            Tensor(jnp.asarray(np.asarray(out_nodes, np.int32))))
+
+
+def _csr_neighbors(row, colptr, nodes):
+    """Slice CSC/CSR storage for each query node (host)."""
+    row = np.asarray(row).ravel()
+    ptr = np.asarray(colptr).ravel()
+    return [(int(ptr[v]), int(ptr[v + 1])) for v in nodes.tolist()]
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors per node from
+    CSC storage (reference python/paddle/geometric/sampling/neighbors.py,
+    phi/kernels/gpu/graph_sample_neighbors_kernel.cu). Host-side sampler.
+    """
+    from ..core.generator import default_generator
+    nodes = np.asarray(_t(input_nodes)._data).ravel()
+    rownp = np.asarray(_t(row)._data).ravel()
+    spans = _csr_neighbors(rownp, np.asarray(_t(colptr)._data), nodes)
+    eid_np = (np.asarray(_t(eids)._data).ravel()
+              if eids is not None else None)
+    key = default_generator().next_key()
+    rng = np.random.RandomState(
+        int(jax.random.randint(key, (), 0, 2**31 - 1)))
+    out, cnt, oeids = [], [], []
+    for lo, hi in spans:
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            pick = lo + rng.choice(deg, size=sample_size, replace=False)
+        out.append(rownp[pick])
+        cnt.append(pick.shape[0])
+        if eid_np is not None:
+            oeids.append(eid_np[pick])
+    out_nb = Tensor(jnp.asarray(
+        np.concatenate(out) if out else np.zeros((0,), rownp.dtype)))
+    out_cnt = Tensor(jnp.asarray(np.asarray(cnt, np.int32)))
+    if return_eids:
+        if eid_np is None:
+            raise ValueError("return_eids=True requires eids")
+        return out_nb, out_cnt, Tensor(jnp.asarray(np.concatenate(oeids)))
+    return out_nb, out_cnt
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted (without-replacement) neighbor sampling — probability
+    proportional to edge weight (reference weighted_sample_neighbors,
+    phi/kernels/gpu/weighted_sample_neighbors_kernel.cu)."""
+    from ..core.generator import default_generator
+    nodes = np.asarray(_t(input_nodes)._data).ravel()
+    rownp = np.asarray(_t(row)._data).ravel()
+    wnp = np.asarray(_t(edge_weight)._data).ravel().astype(np.float64)
+    spans = _csr_neighbors(rownp, np.asarray(_t(colptr)._data), nodes)
+    eid_np = (np.asarray(_t(eids)._data).ravel()
+              if eids is not None else None)
+    key = default_generator().next_key()
+    rng = np.random.RandomState(
+        int(jax.random.randint(key, (), 0, 2**31 - 1)))
+    out, cnt, oeids = [], [], []
+    for lo, hi in spans:
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(lo, hi)
+        else:
+            w = wnp[lo:hi]
+            p = w / w.sum() if w.sum() > 0 else None
+            pick = lo + rng.choice(deg, size=sample_size, replace=False, p=p)
+        out.append(rownp[pick])
+        cnt.append(pick.shape[0])
+        if eid_np is not None:
+            oeids.append(eid_np[pick])
+    out_nb = Tensor(jnp.asarray(
+        np.concatenate(out) if out else np.zeros((0,), rownp.dtype)))
+    out_cnt = Tensor(jnp.asarray(np.asarray(cnt, np.int32)))
+    if return_eids:
+        if eid_np is None:
+            raise ValueError("return_eids=True requires eids")
+        return out_nb, out_cnt, Tensor(jnp.asarray(np.concatenate(oeids)))
+    return out_nb, out_cnt

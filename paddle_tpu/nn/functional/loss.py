@@ -367,3 +367,192 @@ def huber_loss(input, label, delta=1.0, reduction="mean", name=None):
     return dispatch.call("huber_loss", f, [i, l])
 
 __all__ += ['log_loss', 'huber_loss']
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss over a complete binary class tree.
+
+    Default tree (no path_table): leaf for class c is node ``c + K - 1`` in a
+    heap-indexed complete binary tree with K-1 internal nodes; walking to the
+    root emits one sigmoid decision per internal node. With
+    path_table/path_code the custom tree is used (reference
+    python/paddle/nn/functional/loss.py hsigmoid_loss,
+    phi/kernels/cpu/hsigmoid_loss_kernel.cc).
+    """
+    inp, lab = _t(input), _t(label)
+    w = _t(weight)
+    tensors = [inp, w]
+    if bias is not None:
+        bias = _t(bias)
+        tensors.append(bias)
+    lab_np = np.asarray(lab._data).astype(np.int64).ravel()
+    K = num_classes
+    if path_table is None:
+        # build (B, D) node ids + codes on host (labels are data)
+        depth = max(int(np.ceil(np.log2(max(K, 2)))), 1)
+        nodes = np.zeros((lab_np.shape[0], depth), np.int64)
+        codes = np.zeros((lab_np.shape[0], depth), np.float32)
+        valid = np.zeros((lab_np.shape[0], depth), np.float32)
+        for b, c in enumerate(lab_np):
+            i = int(c) + K - 1
+            d = 0
+            while i > 0 and d < depth:
+                parent = (i - 1) // 2
+                nodes[b, d] = parent
+                codes[b, d] = 1.0 if i == 2 * parent + 1 else 0.0
+                valid[b, d] = 1.0
+                i = parent
+                d += 1
+    else:
+        nodes = np.asarray(_t(path_table)._data).astype(np.int64)
+        codes = np.asarray(_t(path_code)._data).astype(np.float32)
+        valid = (nodes >= 0).astype(np.float32)
+        nodes = np.maximum(nodes, 0)
+
+    def f(x, wt, *rest):
+        bv = rest[0] if bias is not None else None
+        wsel = wt[nodes]                      # (B, D, F)
+        logits = jnp.einsum("bdf,bf->bd", wsel, x)
+        if bv is not None:
+            logits = logits + bv.reshape(-1)[nodes]
+        c = jnp.asarray(codes)
+        v = jnp.asarray(valid)
+        # BCE with logits against the path code, masked by path validity
+        per = (jnp.maximum(logits, 0) - logits * c
+               + jnp.log1p(jnp.exp(-jnp.abs(logits)))) * v
+        return per.sum(axis=1, keepdims=True)
+
+    return dispatch.call("hsigmoid_loss", f, tensors)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row (reference
+    python/paddle/nn/functional/loss.py edit_distance,
+    phi/kernels/impl/edit_distance_kernel_impl.h). Host DP — the op is a
+    metric over integer id sequences, not a training-path kernel.
+
+    Returns (distance (B,1) float, sequence_num (1,) int).
+    """
+    a = np.asarray(_t(input)._data)
+    b = np.asarray(_t(label)._data)
+    il = (np.asarray(_t(input_length)._data).ravel()
+          if input_length is not None else
+          np.full(a.shape[0], a.shape[1], np.int64))
+    ll = (np.asarray(_t(label_length)._data).ravel()
+          if label_length is not None else
+          np.full(b.shape[0], b.shape[1], np.int64))
+    ign = set(ignored_tokens or ())
+    out = np.zeros((a.shape[0], 1), np.float32)
+    for i in range(a.shape[0]):
+        s1 = [t for t in a[i, :il[i]].tolist() if t not in ign]
+        s2 = [t for t in b[i, :ll[i]].tolist() if t not in ign]
+        m, n = len(s1), len(s2)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for r in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = r
+            for c in range(1, n + 1):
+                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+        d = float(dp[n])
+        if normalized:
+            d = d / max(n, 1)
+        out[i, 0] = d
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray([a.shape[0]], dtype=jnp.int32)))
+
+
+def ctc_align(input, input_length=None, blank=0, padding_value=0, name=None):
+    """CTC greedy alignment: merge repeats then drop blanks
+    (reference ctc_align op, phi/kernels/cpu/ctc_align_kernel.cc).
+    input: (B, T) argmax token ids."""
+    a = np.asarray(_t(input)._data)
+    il = (np.asarray(_t(input_length)._data).ravel()
+          if input_length is not None else
+          np.full(a.shape[0], a.shape[1], np.int64))
+    rows, lens = [], []
+    for i in range(a.shape[0]):
+        seq = a[i, :il[i]]
+        prev = None
+        out = []
+        for tkn in seq.tolist():
+            if tkn != prev and tkn != blank:
+                out.append(tkn)
+            prev = tkn
+        rows.append(out)
+        lens.append(len(out))
+    width = max(max(lens, default=0), 1)
+    res = np.full((a.shape[0], width), padding_value, dtype=a.dtype)
+    for i, r in enumerate(rows):
+        res[i, :len(r)] = r
+    return (Tensor(jnp.asarray(res)),
+            Tensor(jnp.asarray(lens, dtype=jnp.int32)))
+
+
+def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference warprnnt op,
+    phi/kernels/impl/warprnnt_kernel_impl.h; paddle.nn.functional.rnnt_loss).
+
+    logits: (B, T, U+1, V) unnormalized; labels: (B, U) int. TPU-native: the
+    alpha recursion runs as U+1 vectorized row updates (each a lax-style
+    cumulative band update over T), fully differentiable by jax.vjp — no
+    hand-written backward, no warp-rnnt CUDA.
+    """
+    lg, lb = _t(logits), _t(labels)
+    tl = np.asarray(_t(logit_lengths)._data).ravel()
+    ul = np.asarray(_t(label_lengths)._data).ravel()
+    lab_np = np.asarray(lb._data).astype(np.int64)
+
+    def f_all(lp):
+        B, T, U1, V = lp.shape
+        logp = jax.nn.log_softmax(lp, axis=-1)
+        blank_lp = logp[..., blank]
+        lab = jnp.asarray(lab_np)
+        emit_lp = jnp.take_along_axis(
+            logp[:, :, :U1 - 1, :], lab[:, None, :, None], axis=-1)[..., 0]
+        if fastemit_lambda:
+            # FastEmit (arXiv:2010.11148): scale the gradient through emit
+            # terms by (1 + lambda) without changing the loss value — the
+            # identity x + l*(x - stop_grad(x)) adds 0 forward, scales vjp
+            emit_lp = emit_lp + fastemit_lambda * (
+                emit_lp - jax.lax.stop_gradient(emit_lp))
+        NEG = -1e30
+        tmask = jnp.arange(T)[None, :] < jnp.asarray(tl)[:, None]
+        alpha0 = jnp.concatenate(
+            [jnp.zeros((B, 1)), jnp.cumsum(blank_lp[:, :-1, 0], axis=1)],
+            axis=1)
+        alpha0 = jnp.where(tmask, alpha0, NEG)
+        rows = [alpha0]
+        for u in range(1, U1):
+            start = rows[-1] + emit_lp[:, :, u - 1]
+            bl_u = blank_lp[:, :, u]
+
+            def t_step(carry, t, start=start, bl_u=bl_u):
+                cur = jnp.logaddexp(start[:, t], carry + bl_u[:, t - 1])
+                return cur, cur
+
+            first = start[:, 0]
+            _, rest = jax.lax.scan(t_step, first, jnp.arange(1, T))
+            au = jnp.concatenate([first[:, None], rest.T], axis=1)
+            au = jnp.where(tmask, au, NEG)
+            rows.append(au)
+        A = jnp.stack(rows, axis=2)                     # (B, T, U1)
+        tb = jnp.asarray(tl) - 1
+        ub = jnp.asarray(ul)
+        binx = jnp.arange(B)
+        ll = A[binx, tb, ub] + blank_lp[binx, tb, ub]
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+
+    return dispatch.call("rnnt_loss", f_all, [lg])
+
+
+__all__ += ['hsigmoid_loss', 'edit_distance', 'ctc_align', 'rnnt_loss']

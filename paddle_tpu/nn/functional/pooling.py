@@ -268,9 +268,166 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return (out, None) if return_mask else out
 
 
+def _max_unpool_nd(x, indices, kernel_size, stride, padding, nd,
+                   output_size, op_name):
+    """Scatter pooled values back to their argmax positions (reference
+    unpool/unpool3d kernels, phi/kernels/gpu/unpool_kernel.cu). The flat
+    spatial ``indices`` come from max_poolNd(return_mask=True)."""
+    x, indices = _t(x), _t(indices)
+    ksize = _ntuple(kernel_size, nd)
+    stride_t = _ntuple(stride if stride is not None else kernel_size, nd)
+    pad = _ntuple(padding, nd)
+    in_spatial = x.shape[2:]
+    if output_size is None:
+        out_spatial = tuple(
+            (in_spatial[i] - 1) * stride_t[i] - 2 * pad[i] + ksize[i]
+            for i in range(nd))
+    else:
+        out_spatial = tuple(output_size[-nd:])
+
+    def f(a, idx):
+        n, c = a.shape[:2]
+        flat = int(np.prod(out_spatial))
+        k = int(np.prod(a.shape[2:]))
+        av = a.reshape(n * c, k)
+        iv = idx.reshape(n * c, k).astype(jnp.int32)
+        out = jnp.zeros((n * c, flat), dtype=a.dtype)
+        rows = jnp.arange(n * c)[:, None]
+        out = out.at[rows, iv].set(av)
+        return out.reshape((n, c) + out_spatial)
+
+    return dispatch.call(op_name, f, [x, indices],
+                         differentiable_mask=[True, False])
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding, 1,
+                          output_size, "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding, 2,
+                          output_size, "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _max_unpool_nd(x, indices, kernel_size, stride, padding, 3,
+                          output_size, "max_unpool3d")
+
+
+def _fractional_intervals(u, in_size, out_size, pool_size):
+    """Pseudo-random pooling-region starts (Graham, Fractional Max-Pooling;
+    same sequence rule as the reference fractional_max_pool kernels)."""
+    starts = np.zeros(out_size, dtype=np.int64)
+    if out_size > 1:
+        alpha = (in_size - pool_size) / (out_size - 1)
+        i = np.arange(out_size - 1)
+        starts[:-1] = ((i + u) * alpha).astype(np.int64) - int(u * alpha)
+    starts[out_size - 1] = in_size - pool_size
+    return starts
+
+
+def _fractional_max_pool_nd(x, output_size, kernel_size, random_u, nd,
+                            return_mask, op_name):
+    x = _t(x)
+    out_sz = _ntuple(output_size, nd)
+    in_spatial = x.shape[2:]
+    if kernel_size is None:
+        ksize = tuple(in_spatial[i] // out_sz[i] for i in range(nd))
+    else:
+        ksize = _ntuple(kernel_size, nd)
+    if random_u is None:
+        from ...core.generator import default_generator
+        import jax as _jax
+        u = float(_jax.random.uniform(default_generator().next_key(), ()))
+    else:
+        u = float(random_u)
+    starts = [_fractional_intervals(u, in_spatial[i], out_sz[i], ksize[i])
+              for i in range(nd)]
+
+    def f(a):
+        # one gather + running max per static kernel offset (k^nd of them,
+        # fused by XLA); flat argmax tracked alongside for return_mask
+        idx_axes = [jnp.asarray(starts[i]) for i in range(nd)]
+        out = None
+        mask = None
+        for off in np.ndindex(*ksize):
+            coords = [idx_axes[i] + off[i] for i in range(nd)]
+            v = a
+            flat = 0
+            for i, cc in enumerate(coords):
+                v = jnp.take(v, cc, axis=2 + i)
+                flat = flat * in_spatial[i] + cc.reshape(
+                    (-1,) + (1,) * (nd - 1 - i))
+            if out is None:
+                out, mask = v, jnp.broadcast_to(flat, v.shape)
+            else:
+                upd = v > out
+                mask = jnp.where(upd, jnp.broadcast_to(flat, v.shape), mask)
+                out = jnp.maximum(out, v)
+        return out, mask.astype(jnp.int32)
+
+    out, mask = dispatch.call(op_name, f, [x])
+    return (out, mask) if return_mask else out
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """Fractional max pooling (reference fractional_max_pool2d,
+    phi/kernels/impl/fractional_max_pool_kernel_impl.h)."""
+    return _fractional_max_pool_nd(x, output_size, kernel_size, random_u, 2,
+                                   return_mask, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_max_pool_nd(x, output_size, kernel_size, random_u, 3,
+                                   return_mask, "fractional_max_pool3d")
+
+
+def _lp_pool(x, norm_type, kernel_size, stride, padding, nd, ceil_mode,
+             data_format, op_name):
+    """Power-average pooling: (sum |x|^p)^(1/p) (reference lp_pool2d,
+    phi lp pool kernels; p=inf degenerates to max pool)."""
+    x = _t(x)
+    p = float(norm_type)
+    if p == float("inf"):
+        return _pool_nd(x, kernel_size, stride, padding, nd,
+                        data_format in ("NHWC", "NLC"), "max",
+                        ceil_mode=ceil_mode, op_name=op_name)
+
+    def f(a):
+        return jnp.abs(a) ** p
+
+    powed = dispatch.call(op_name + "_pow", f, [x])
+    s = _pool_nd(powed, kernel_size, stride, padding, nd,
+                 data_format in ("NHWC", "NLC"), "avg", exclusive=False,
+                 ceil_mode=ceil_mode, op_name=op_name)
+    k = float(np.prod(_ntuple(kernel_size, nd)))
+    return dispatch.call(op_name + "_root",
+                         lambda a: (a * k) ** (1.0 / p), [s])
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1, ceil_mode,
+                    data_format, "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2, ceil_mode,
+                    data_format, "lp_pool2d")
+
+
 __all__ = [
     "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d", "max_pool2d",
     "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
-    "adaptive_max_pool3d",
+    "adaptive_max_pool3d", "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d", "lp_pool1d",
+    "lp_pool2d",
 ]

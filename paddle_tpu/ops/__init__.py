@@ -219,6 +219,12 @@ def _register_all():
     register_module(_vis, "vision")
     from ..vision import ops as _vops
     register_module(_vops, "vision")
+    from .. import geometric as _geo
+    register_module(_geo, "geometric")
+    from .. import signal as _sig
+    register_module(_sig, "signal")
+    from .. import quantization as _quant
+    register_module(_quant, "quantization")
 
 
 _register_all()

@@ -322,3 +322,46 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
         return u[..., :qq], s[..., :qq], jnp.swapaxes(vt, -1, -2)[..., :qq]
     outs = dispatch.call("pca_lowrank", f, [xt])
     return outs
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack ``lu`` factorization into (P, L, U).
+
+    x: packed LU (the `lu` output), y: 1-based pivots. Reference:
+    python/paddle/tensor/linalg.py lu_unpack, phi/kernels/impl/
+    lu_unpack_kernel_impl.h.
+    """
+    xt, yt = _t(x), _t(y)
+
+    def f(lu_, piv):
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_[..., :, :k], -1) + jnp.eye(m, k, dtype=lu_.dtype)
+        U = jnp.triu(lu_[..., :k, :])
+        # pivots -> permutation matrix: apply row swaps to identity
+        pv = piv.astype(jnp.int32) - 1
+
+        def perm_one(p1):
+            perm = jnp.arange(m)
+
+            def body(i, pm):
+                j = p1[i]
+                a, b = pm[i], pm[j]
+                return pm.at[i].set(b).at[j].set(a)
+
+            perm = jax.lax.fori_loop(0, p1.shape[0], body, perm)
+            return jnp.eye(m, dtype=lu_.dtype)[:, perm]  # P s.t. P@L@U = A
+
+        if pv.ndim == 1:
+            P = perm_one(pv)
+        else:
+            bshape = pv.shape[:-1]
+            P = jax.vmap(perm_one)(pv.reshape(-1, pv.shape[-1]))
+            P = P.reshape(bshape + (m, m))
+        return P, L, U
+
+    return dispatch.call("lu_unpack", f, [xt, yt],
+                         differentiable_mask=[True, False])
+
+
+__all__ += ["lu_unpack"]

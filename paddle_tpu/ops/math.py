@@ -362,5 +362,65 @@ def fill_diagonal(x, value, offset=0, wrap=False, name=None):
 def logaddexp(x, y, name=None):
     return dispatch.call("logaddexp", jnp.logaddexp, [_t(x), _t(y)])
 
+
+def gammainc(x, y, name=None):
+    """Regularized lower incomplete gamma P(x, y) (reference gammainc op,
+    phi/kernels/impl/gammaincc_kernel_impl.h family)."""
+    import jax.scipy.special as jsp
+    return dispatch.call("gammainc", jsp.gammainc, [_t(x), _t(y)])
+
+
+def gammaincc(x, y, name=None):
+    """Regularized upper incomplete gamma Q(x, y) (reference gammaincc op)."""
+    import jax.scipy.special as jsp
+    return dispatch.call("gammaincc", jsp.gammaincc, [_t(x), _t(y)])
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    """Write tensor ``y`` onto the (dim1, dim2) diagonal band of ``x``
+    (reference fill_diagonal_tensor op, phi/kernels/
+    fill_diagonal_tensor_kernel.h). y's last axis runs along the diagonal."""
+    x, y = _t(x), _t(y)
+
+    def f(a, v):
+        nd = a.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        # move the two diagonal dims to the back: (..., rows, cols)
+        rest = [i for i in range(nd) if i not in (d1, d2)]
+        perm = rest + [d1, d2]
+        ap = jnp.transpose(a, perm)
+        rows, cols = ap.shape[-2], ap.shape[-1]
+        if offset >= 0:
+            n = max(min(rows, cols - offset), 0)
+            ri = jnp.arange(n)
+            ci = ri + offset
+        else:
+            n = max(min(rows + offset, cols), 0)
+            ri = jnp.arange(n) - offset
+            ci = jnp.arange(n)
+        ap = ap.at[..., ri, ci].set(v)
+        inv = np.argsort(perm)
+        return jnp.transpose(ap, inv)
+
+    return dispatch.call("fill_diagonal_tensor", f, [x, y])
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce ``x`` down to ``target``'s (broadcastable) shape
+    (reference reduce_as op, phi/kernels/reduce_as_kernel.h)."""
+    x, target = _t(x), _t(target)
+    tshape = tuple(target.shape)
+
+    def f(a):
+        extra = a.ndim - len(tshape)
+        axes = tuple(range(extra)) + tuple(
+            i + extra for i, s in enumerate(tshape) if a.shape[i + extra] != s)
+        out = jnp.sum(a, axis=axes, keepdims=False)
+        return out.reshape(tshape)
+
+    return dispatch.call("reduce_as", f, [x])
+
+
 __all__ += ["gammaln", "polygamma", "i0", "i0e", "i1", "i1e",
-            "increment", "renorm", "fill_diagonal", "logaddexp"]
+            "increment", "renorm", "fill_diagonal", "logaddexp",
+            "gammainc", "gammaincc", "fill_diagonal_tensor", "reduce_as"]

@@ -185,3 +185,113 @@ def isin(x, test_x, assume_unique=False, invert=False, name=None):
 
 def index_of_max(x):
     return argmax(x)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus (top-p) sampling over probability rows.
+
+    x: (B, V) probabilities; ps: (B,) per-row p. Returns (probs, ids) of the
+    sampled token per row — the reference contract
+    (phi/kernels/gpu/top_p_sampling_kernel.cu, python/paddle/tensor/search.py
+    top_p_sampling). TPU-native: sort + cumsum + masked categorical draw in
+    one fused program; no host loop.
+    """
+    from ..core.generator import default_generator
+    xt, pt = _t(x), _t(ps)
+    if seed is not None and seed >= 0:
+        key = jax.random.key(seed)
+    else:
+        key = default_generator().next_key()
+
+    def f(probs, p):
+        order = jnp.argsort(-probs, axis=-1)
+        sp = jnp.take_along_axis(probs, order, axis=-1)
+        csum = jnp.cumsum(sp, axis=-1)
+        # keep tokens while cumulative mass (exclusive) < p; always keep top-1
+        keep = (csum - sp) < p[:, None]
+        keep = keep.at[:, 0].set(True)
+        masked = jnp.where(keep, sp, 0.0)
+        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        gumbel = -jnp.log(-jnp.log(
+            jax.random.uniform(key, masked.shape, minval=1e-20, maxval=1.0)))
+        choice = jnp.argmax(jnp.where(keep, jnp.log(masked + 1e-20) + gumbel,
+                                      -jnp.inf), axis=-1)
+        ids = jnp.take_along_axis(order, choice[:, None], axis=-1)
+        out_p = jnp.take_along_axis(probs, ids, axis=-1)
+        return out_p, ids
+
+    return dispatch.call("top_p_sampling", f, [xt, pt],
+                         differentiable_mask=[False, False])
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search backtrace: follow parent pointers from the last step.
+
+    ids/parents: (T, B, W). Reference: phi/kernels/cpu/gather_tree_kernel.cc,
+    python/paddle/nn/decode.py gather_tree. A reverse lax.scan — one
+    compiled program, no host loop.
+    """
+    idt, pat = _t(ids), _t(parents)
+
+    def f(idv, pav):
+        T, B, W = idv.shape
+        binx = jnp.arange(B)[:, None]
+
+        def step(beam, t):
+            # beam: (B, W) current beam slot per output column
+            out = idv[t][binx, beam]          # (B, W)
+            beam = pav[t][binx, beam]
+            return beam, out
+
+        init = jnp.broadcast_to(jnp.arange(W)[None], (B, W))
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return dispatch.call("gather_tree", f, [idt, pat],
+                         differentiable_mask=[False, False])
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample class centers: all positive classes + random negatives up to
+    ``num_samples``; relabel into the sampled index space.
+
+    Returns (remapped_label, sampled_class_center). Reference:
+    python/paddle/nn/functional/common.py class_center_sample,
+    phi/kernels/gpu/class_center_sample_kernel.cu. Host-side (data-dependent
+    unique set), like the reference's CPU path.
+    """
+    lt = _t(label)
+    lab = np.asarray(lt._data).astype(np.int64).ravel()
+    pos = np.unique(lab)
+    if pos.shape[0] >= num_samples:
+        sampled = pos
+    else:
+        from ..core.generator import default_generator
+        key = default_generator().next_key()
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        perm = np.asarray(jax.random.permutation(key, neg_pool.shape[0]))
+        extra = neg_pool[perm[:num_samples - pos.shape[0]]]
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, dtype=np.int64)
+    remap[sampled] = np.arange(sampled.shape[0])
+    return (Tensor(jnp.asarray(remap[lab].reshape(lt.shape))),
+            Tensor(jnp.asarray(sampled)))
+
+
+def shuffle_batch(x, seed=None, name=None):
+    """Random permutation along axis 0 (reference shuffle_batch op,
+    fluid contrib; used by recommender pipelines)."""
+    from ..core.generator import default_generator
+    xt = _t(x)
+    key = (jax.random.key(seed) if seed is not None
+           else default_generator().next_key())
+
+    def f(a):
+        return jax.random.permutation(key, a, axis=0)
+
+    return dispatch.call("shuffle_batch", f, [xt])
+
+
+__all__ += ["top_p_sampling", "gather_tree", "class_center_sample",
+            "shuffle_batch"]

@@ -149,3 +149,107 @@ class QAT:
 
 __all__ = ["weight_quantize", "weight_dequantize", "fake_quant",
            "QuantConfig", "QuantedLinear", "PTQ", "QAT"]
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """Matmul against int8/int4-quantized weights with on-the-fly dequant
+    (reference weight_only_linear op, phi/kernels/gpu/
+    weight_only_linear_kernel.cu). TPU-native: dequantize into the matmul —
+    XLA fuses the scale multiply into the MXU epilogue; activations stay in
+    their original dtype.
+
+    weight: (in, out) int8 (or int4 stored as int8), weight_scale: (out,).
+    """
+    xt, wt = _t(x), _t(weight)
+    tensors = [xt, wt]
+    if weight_scale is not None:
+        st = _t(weight_scale)
+        tensors.append(st)
+    if bias is not None:
+        bt = _t(bias)
+        tensors.append(bt)
+
+    def f(a, w, *rest):
+        i = 0
+        s = None
+        if weight_scale is not None:
+            s = rest[i]; i += 1
+        b = rest[i] if bias is not None else None
+        wd = w.astype(a.dtype)
+        if s is not None:
+            wd = wd * s[None, :].astype(a.dtype)
+        out = a @ wd
+        if b is not None:
+            out = out + b
+        return out
+
+    mask = [True, False] + ([False] if weight_scale is not None else []) \
+        + ([True] if bias is not None else [])
+    return dispatch.call("weight_only_linear", f, tensors,
+                         differentiable_mask=mask)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """LLM.int8() mixed decomposition: columns of ``x`` with outliers
+    (|x| > threshold) run in the activation dtype against dequantized
+    weights; the rest runs int8xint8 (reference llm_int8_linear op,
+    phi/kernels/gpu/llm_int8_linear_kernel.cu).
+
+    On TPU both halves lower to MXU matmuls; the int8 half feeds the MXU's
+    8-bit path. weight: (in, out) int8; weight_scale: (out,).
+    """
+    xt, wt = _t(x), _t(weight)
+    tensors = [xt, wt]
+    if weight_scale is not None:
+        tensors.append(_t(weight_scale))
+    if bias is not None:
+        tensors.append(_t(bias))
+
+    def f(a, w, *rest):
+        i = 0
+        s = None
+        if weight_scale is not None:
+            s = rest[i]; i += 1
+        b = rest[i] if bias is not None else None
+        outlier = jnp.any(jnp.abs(a) > threshold, axis=tuple(
+            range(a.ndim - 1)))                     # (in,) outlier columns
+        keep = ~outlier
+        # int8 path: quantize the non-outlier activation columns per-row
+        a_int = jnp.where(keep[None], a, 0.0) if a.ndim == 2 else \
+            jnp.where(keep[(None,) * (a.ndim - 1)], a, 0.0)
+        row_scale = jnp.max(jnp.abs(a_int), axis=-1, keepdims=True) / 127.0
+        row_scale = jnp.maximum(row_scale, 1e-8)
+        aq = jnp.clip(jnp.round(a_int / row_scale), -128, 127).astype(
+            jnp.int8)
+        int_out = jax.lax.dot_general(
+            aq, w, (((aq.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32).astype(a.dtype) * row_scale
+        # fp path for outlier columns against dequantized weight
+        wd = w.astype(a.dtype)
+        a_fp = a - a_int
+        fp_out = a_fp @ wd
+        out = int_out + fp_out
+        if s is not None:
+            out = out * s.astype(a.dtype)
+        if b is not None:
+            out = out + b
+        return out
+
+    mask = [True, False] + ([False] if weight_scale is not None else []) \
+        + ([True] if bias is not None else [])
+    return dispatch.call("llm_int8_linear", f, tensors,
+                         differentiable_mask=mask)
+
+
+def apply_per_channel_scale(x, scales):
+    """Divide activations by per-channel smoothing scales (SmoothQuant
+    pre-scale; reference apply_per_channel_scale op)."""
+    return dispatch.call("apply_per_channel_scale",
+                         lambda a, s: a / s, [_t(x), _t(scales)],
+                         differentiable_mask=[True, False])
+
+
+__all__ += ["weight_only_linear", "llm_int8_linear",
+            "apply_per_channel_scale"]

@@ -268,14 +268,43 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             rh = jnp.maximum(rh, 1.0)
         bin_h = rh / ph
         bin_w = rw / pw
-        sr = sampling_ratio if sampling_ratio > 0 else 2
-        # sample grid: (R, ph, sr) y-coords x (R, pw, sr) x-coords
+        if sampling_ratio > 0:
+            sr_h = sr_w = None  # fixed grid
+            cap_h = cap_w = sampling_ratio
+        else:
+            # reference adaptivity (roi_align_kernel: ceil(roi/out) samples
+            # per bin, per ROI). Counts are data (fine under jit); only the
+            # static CAP needs a concrete value — take it from the boxes
+            # when eager, else fall back to 4 samples.
+            sr_h = jnp.maximum(jnp.ceil(bin_h), 1.0)
+            sr_w = jnp.maximum(jnp.ceil(bin_w), 1.0)
+            if isinstance(rois, jax.core.Tracer):
+                cap_h = cap_w = 4
+                sr_h = jnp.minimum(sr_h, cap_h)
+                sr_w = jnp.minimum(sr_w, cap_w)
+            else:
+                cap_h = max(int(jnp.max(sr_h)), 1)
+                cap_w = max(int(jnp.max(sr_w)), 1)
+        # sample grid: (R, ph, cap) y-coords x (R, pw, cap) x-coords; with
+        # adaptive counts, sample k of bin (k+0.5)/sr_i and mask k >= sr_i
+        if sr_h is None:
+            off_h = (jnp.arange(cap_h)[None, None, :] + 0.5) / cap_h
+            off_w = (jnp.arange(cap_w)[None, None, :] + 0.5) / cap_w
+            wgt_h = jnp.ones((rois.shape[0], 1, cap_h))
+            wgt_w = jnp.ones((rois.shape[0], 1, cap_w))
+            cnt = float(cap_h * cap_w)
+        else:
+            kh = jnp.arange(cap_h)[None, None, :]
+            kw = jnp.arange(cap_w)[None, None, :]
+            off_h = (kh + 0.5) / sr_h[:, None, None]
+            off_w = (kw + 0.5) / sr_w[:, None, None]
+            wgt_h = (kh < sr_h[:, None, None]).astype(jnp.float32)
+            wgt_w = (kw < sr_w[:, None, None]).astype(jnp.float32)
+            cnt = None
         iy = (y1[:, None, None] + bin_h[:, None, None]
-              * (jnp.arange(ph)[None, :, None]
-                 + (jnp.arange(sr)[None, None, :] + 0.5) / sr))
+              * (jnp.arange(ph)[None, :, None] + off_h))
         ix = (x1[:, None, None] + bin_w[:, None, None]
-              * (jnp.arange(pw)[None, :, None]
-                 + (jnp.arange(sr)[None, None, :] + 0.5) / sr))
+              * (jnp.arange(pw)[None, :, None] + off_w))
 
         def bilinear(img, yy, xx):
             # img (c,h,w); yy (ph,sr); xx (pw,sr) -> (c, ph, sr, pw, sr)
@@ -303,8 +332,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
         def per_roi(r):
             img = a[batch_idx[r]]
-            v = bilinear(img, iy[r], ix[r])      # (c, ph, sr, pw, sr)
-            return v.mean(axis=(2, 4))           # (c, ph, pw)
+            v = bilinear(img, iy[r], ix[r])      # (c, ph, cap_h, pw, cap_w)
+            w_ = (wgt_h[r][0][None, None, :, None, None]
+                  * wgt_w[r][0][None, None, None, None, :])
+            denom = cnt if cnt is not None else (sr_h[r] * sr_w[r])
+            return (v * w_).sum(axis=(2, 4)) / denom   # (c, ph, pw)
 
         return jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
 
@@ -587,10 +619,13 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
 
     def f(a):
         n, _, h, w = a.shape
-        a = a.reshape(n, na, -1, h, w)
         if iou_aware:
-            ioup = jax.nn.sigmoid(a[:, :, -1])
-            a = a[:, :, :-1]
+            # reference layout (phi/kernels/funcs/yolo_box_util.h): the na
+            # IoU channels are a LEADING block before the na*(5+C) box block
+            ioup = jax.nn.sigmoid(a[:, :na])
+            a = a[:, na:].reshape(n, na, -1, h, w)
+        else:
+            a = a.reshape(n, na, -1, h, w)
         gx = jnp.arange(w, dtype=jnp.float32)
         gy = jnp.arange(h, dtype=jnp.float32)
         bx = ((jax.nn.sigmoid(a[:, :, 0]) - 0.5) * scale_x_y + 0.5
@@ -1012,3 +1047,39 @@ class DeformConv2D(Layer):
         return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
                              self.padding, self.dilation,
                              self.deformable_groups, self.groups, mask)
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
+                stride2, corr_type_multiply=1, name=None):
+    """FlowNet correlation (cost volume) between two feature maps.
+
+    out[n, k, i, j] = mean_c x1[n,c,si,sj] · x2[n,c,si+di,sj+dj] for each
+    displacement (di,dj) on the stride2 grid within ±max_displacement —
+    one fused gather+reduce per static displacement, which XLA vectorizes;
+    no CUDA kernel needed. kernel_size must be 1 (the FlowNet setting).
+    Reference: phi/kernels/gpu/correlation_kernel.cu.
+    """
+    if kernel_size != 1:
+        raise NotImplementedError("correlation: only kernel_size=1")
+    xt1, xt2 = _t(x1), _t(x2)
+    d = max_displacement // stride2
+
+    def f(a, b):
+        n, c, h, w = a.shape
+        pad_cfg = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
+        ap = jnp.pad(a, pad_cfg)
+        bp = jnp.pad(b, pad_cfg)
+        outs = []
+        for di in range(-d, d + 1):
+            for dj in range(-d, d + 1):
+                oy, ox = di * stride2, dj * stride2
+                shifted = jnp.roll(bp, (-oy, -ox), axis=(2, 3))
+                prod = (ap * shifted).mean(axis=1)  # (n, H+2p, W+2p)
+                outs.append(prod)
+        out = jnp.stack(outs, axis=1)
+        return out[:, :, ::stride1, ::stride1]
+
+    return dispatch.call("correlation", f, [xt1, xt2])
+
+
+__all__.append("correlation")

@@ -1,0 +1,507 @@
+"""Tests for the op-breadth batch: pooling variants, math tail, sampling,
+geometric (graph) ops, sequence/text losses, quantized linears, metrics.
+
+Reference behaviors: python/paddle/nn/functional/{pooling,loss}.py,
+python/paddle/geometric/, python/paddle/tensor/{math,search}.py; torch CPU
+used as an independent oracle where it implements the same op.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestPoolingVariants:
+    def test_max_unpool2d_roundtrip(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(2, 3, 8, 8).astype(np.float32))
+        out, mask = F.max_pool2d(x, 2, return_mask=True)
+        rec = F.max_unpool2d(out, mask, 2)
+        assert rec.shape == [2, 3, 8, 8]
+        # every pooled value lands back at its argmax position
+        assert float(np.abs(rec.numpy().sum() - out.numpy().sum())) < 1e-5
+        nz = rec.numpy() != 0
+        assert nz.sum() == out.numpy().size
+
+    def test_max_unpool2d_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(1).rand(1, 2, 6, 6).astype(np.float32)
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, return_mask=True)
+        ours = F.max_unpool2d(out, mask, 2).numpy()
+        to, tm = torch.nn.functional.max_pool2d(
+            torch.tensor(x), 2, return_indices=True)
+        ref = torch.nn.functional.max_unpool2d(to, tm, 2).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+    def test_max_unpool1d_3d(self):
+        x1 = paddle.to_tensor(
+            np.random.RandomState(2).rand(2, 2, 8).astype(np.float32))
+        o1, m1 = F.max_pool1d(x1, 2, return_mask=True)
+        assert F.max_unpool1d(o1, m1, 2).shape == [2, 2, 8]
+        x3 = paddle.to_tensor(
+            np.random.RandomState(3).rand(1, 2, 4, 4, 4).astype(np.float32))
+        o3, m3 = F.max_pool3d(x3, 2, return_mask=True)
+        assert F.max_unpool3d(o3, m3, 2).shape == [1, 2, 4, 4, 4]
+
+    def test_fractional_max_pool2d_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.random.RandomState(4).rand(2, 3, 9, 9).astype(np.float32)
+        u = 0.37
+        ours = F.fractional_max_pool2d(paddle.to_tensor(x), output_size=4,
+                                       kernel_size=2, random_u=u).numpy()
+        samples = torch.full((2, 3, 2), u, dtype=torch.float64)
+        ref = torch.nn.functional.fractional_max_pool2d(
+            torch.tensor(x, dtype=torch.float64), 2, output_size=(4, 4),
+            _random_samples=samples).numpy()
+        np.testing.assert_allclose(ours, ref, atol=1e-5)
+
+    def test_fractional_max_pool3d_shape_and_mask(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(5).rand(1, 2, 8, 8, 8).astype(np.float32))
+        out, mask = F.fractional_max_pool3d(x, output_size=3, kernel_size=2,
+                                            random_u=0.5, return_mask=True)
+        assert out.shape == [1, 2, 3, 3, 3]
+        assert mask.shape == [1, 2, 3, 3, 3]
+        # mask holds flat spatial indices into 8*8*8
+        m = mask.numpy()
+        assert (m >= 0).all() and (m < 512).all()
+
+    def test_lp_pool2d_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        x = np.abs(np.random.RandomState(6).rand(2, 2, 8, 8)
+                   ).astype(np.float32)
+        for p in (1.0, 2.0, 3.0):
+            ours = F.lp_pool2d(paddle.to_tensor(x), p, 2).numpy()
+            ref = torch.nn.functional.lp_pool2d(
+                torch.tensor(x), p, 2).numpy()
+            np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5,
+                                       err_msg=f"p={p}")
+
+    def test_lp_pool1d_grad(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(7).rand(1, 2, 8).astype(np.float32) + 0.1)
+        x.stop_gradient = False
+        F.lp_pool1d(x, 2.0, 2).sum().backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestMathTail:
+    def test_gammainc_pair(self):
+        from scipy import special
+        a = np.array([0.5, 2.0, 5.0], np.float32)
+        x = np.array([1.0, 1.0, 4.0], np.float32)
+        np.testing.assert_allclose(
+            paddle.gammainc(paddle.to_tensor(a), paddle.to_tensor(x)).numpy(),
+            special.gammainc(a, x), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.gammaincc(paddle.to_tensor(a),
+                             paddle.to_tensor(x)).numpy(),
+            special.gammaincc(a, x), rtol=1e-5)
+
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(6, 6).astype(np.float32)
+        lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+        rec = P.numpy() @ L.numpy() @ U.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+        # L unit-lower, U upper
+        assert np.allclose(np.triu(L.numpy(), 1), 0)
+        assert np.allclose(np.diag(L.numpy()), 1)
+        assert np.allclose(np.tril(U.numpy(), -1), 0)
+
+    def test_lu_unpack_rectangular(self):
+        rng = np.random.RandomState(1)
+        a = rng.randn(5, 3).astype(np.float32)
+        lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+        rec = P.numpy() @ L.numpy() @ U.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-5)
+        assert L.shape == [5, 3] and U.shape == [3, 3]
+
+    def test_fill_diagonal_tensor(self):
+        x = paddle.zeros([3, 4])
+        y = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = paddle.fill_diagonal_tensor(x, y)
+        np.testing.assert_allclose(np.diag(out.numpy()), [1, 2, 3])
+        out2 = paddle.fill_diagonal_tensor(
+            paddle.zeros([3, 4]),
+            paddle.to_tensor(np.array([5.0, 6.0, 7.0], np.float32)),
+            offset=1)
+        np.testing.assert_allclose(out2.numpy()[0, 1], 5.0)
+        np.testing.assert_allclose(out2.numpy()[2, 3], 7.0)
+
+    def test_reduce_as(self):
+        x = paddle.to_tensor(np.ones((2, 3, 4), np.float32))
+        t = paddle.zeros([1, 3, 1])
+        out = paddle.reduce_as(x, t)
+        assert out.shape == [1, 3, 1]
+        np.testing.assert_allclose(out.numpy(), np.full((1, 3, 1), 8.0))
+        t2 = paddle.zeros([4])
+        out2 = paddle.reduce_as(x, t2)
+        np.testing.assert_allclose(out2.numpy(), np.full((4,), 6.0))
+
+
+class TestSampling:
+    def test_top_p_sampling_stays_in_nucleus(self):
+        probs = np.array([[0.5, 0.3, 0.1, 0.05, 0.05],
+                          [0.05, 0.05, 0.1, 0.3, 0.5]], np.float32)
+        ps = np.array([0.6, 0.6], np.float32)
+        hits = set()
+        for seed in range(20):
+            _, ids = paddle.top_p_sampling(paddle.to_tensor(probs),
+                                           paddle.to_tensor(ps), seed=seed)
+            i = ids.numpy().ravel()
+            hits.add((int(i[0]), int(i[1])))
+            assert i[0] in (0, 1) and i[1] in (3, 4)
+        assert len(hits) > 1  # actually random
+
+    def test_gather_tree_matches_manual(self):
+        # T=3, B=1, W=2 beam backtrace
+        ids = np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64)
+        parents = np.array([[[0, 0]], [[0, 0]], [[1, 0]]], np.int64)
+        out = paddle.gather_tree(paddle.to_tensor(ids),
+                                 paddle.to_tensor(parents)).numpy()
+        # final beam 0 follows parent 1 at t=2: path ids [1, 4, 5]
+        np.testing.assert_array_equal(out[:, 0, 0], [1, 4, 5])
+        # final beam 1 follows parent 0: [1, 3, 6]
+        np.testing.assert_array_equal(out[:, 0, 1], [1, 3, 6])
+
+    def test_class_center_sample(self):
+        label = paddle.to_tensor(np.array([2, 7, 2, 9], np.int64))
+        remap, sampled = paddle.class_center_sample(label, 20, 6)
+        s = sampled.numpy()
+        assert set([2, 7, 9]).issubset(set(s.tolist()))
+        assert len(s) == 6
+        r = remap.numpy()
+        # remapped labels index into sampled
+        np.testing.assert_array_equal(s[r], [2, 7, 2, 9])
+
+    def test_shuffle_batch_permutes(self):
+        x = np.arange(12, dtype=np.float32).reshape(6, 2)
+        out = paddle.shuffle_batch(paddle.to_tensor(x), seed=3).numpy()
+        assert not np.array_equal(out, x)
+        np.testing.assert_allclose(np.sort(out[:, 0]), x[:, 0])
+
+
+class TestGeometric:
+    def test_send_u_recv_reference_example(self):
+        # reference docstring example (geometric/message_passing/send_recv.py)
+        x = paddle.to_tensor(
+            np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum").numpy()
+        np.testing.assert_allclose(out, [[0, 2, 3], [2, 8, 10], [1, 4, 5]])
+
+    def test_send_u_recv_reduce_ops(self):
+        x = paddle.to_tensor(
+            np.array([[1.0], [2.0], [3.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2]))
+        dst = paddle.to_tensor(np.array([0, 0, 0]))
+        assert paddle.geometric.send_u_recv(x, src, dst, "mean").numpy()[0, 0] == 2.0
+        assert paddle.geometric.send_u_recv(x, src, dst, "max").numpy()[0, 0] == 3.0
+        assert paddle.geometric.send_u_recv(x, src, dst, "min").numpy()[0, 0] == 1.0
+
+    def test_send_ue_recv_and_uv_grads(self):
+        x = paddle.to_tensor(np.ones((3, 2), np.float32))
+        x.stop_gradient = False
+        y = paddle.to_tensor(np.full((4, 2), 2.0, np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = paddle.geometric.send_ue_recv(x, y, src, dst, "mul", "sum")
+        out.sum().backward()
+        # each edge contributes y=2 per feature; node0 appears as src twice
+        np.testing.assert_allclose(x.grad.numpy()[0], [4.0, 4.0])
+        x2 = paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(3, 2))
+        out2 = paddle.geometric.send_uv(x2, x2, src, dst, "add")
+        assert out2.shape == [4, 2]
+
+    def test_segment_ops(self):
+        data = paddle.to_tensor(
+            np.array([[1.0, 2], [3, 4], [5, 6], [7, 8]], np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        np.testing.assert_allclose(
+            paddle.geometric.segment_sum(data, seg).numpy(),
+            [[4, 6], [12, 14]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_mean(data, seg).numpy(),
+            [[2, 3], [6, 7]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_max(data, seg).numpy(),
+            [[3, 4], [7, 8]])
+        np.testing.assert_allclose(
+            paddle.geometric.segment_min(data, seg).numpy(),
+            [[1, 2], [5, 6]])
+
+    def test_segment_sum_grad(self):
+        data = paddle.to_tensor(np.ones((4, 2), np.float32))
+        data.stop_gradient = False
+        seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+        paddle.geometric.segment_sum(data, seg).sum().backward()
+        np.testing.assert_allclose(data.grad.numpy(), np.ones((4, 2)))
+
+    def test_reindex_graph(self):
+        x = paddle.to_tensor(np.array([0, 5, 8], np.int64))
+        neighbors = paddle.to_tensor(np.array([8, 9, 0, 4, 7, 6, 7], np.int64))
+        count = paddle.to_tensor(np.array([2, 3, 2], np.int32))
+        src, dst, nodes = paddle.geometric.reindex_graph(x, neighbors, count)
+        n = nodes.numpy()
+        np.testing.assert_array_equal(n[:3], [0, 5, 8])
+        # every reindexed edge maps back to the original neighbor ids
+        np.testing.assert_array_equal(n[src.numpy()], neighbors.numpy())
+        np.testing.assert_array_equal(dst.numpy(),
+                                      [0, 0, 1, 1, 1, 2, 2])
+
+    def test_sample_neighbors(self):
+        # CSC graph: node0 <- {1,2,3}, node1 <- {0}, node2 <- {}
+        row = paddle.to_tensor(np.array([1, 2, 3, 0], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 3, 4, 4], np.int64))
+        nodes = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        nb, cnt = paddle.geometric.sample_neighbors(row, colptr, nodes,
+                                                    sample_size=2)
+        c = cnt.numpy()
+        np.testing.assert_array_equal(c, [2, 1, 0])
+        assert set(nb.numpy()[:2].tolist()).issubset({1, 2, 3})
+        full, cf = paddle.geometric.sample_neighbors(row, colptr, nodes)
+        np.testing.assert_array_equal(cf.numpy(), [3, 1, 0])
+
+    def test_weighted_sample_neighbors(self):
+        row = paddle.to_tensor(np.array([1, 2, 3], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 3], np.int64))
+        w = paddle.to_tensor(np.array([100.0, 1e-6, 1e-6], np.float32))
+        nodes = paddle.to_tensor(np.array([0], np.int64))
+        heavy = 0
+        for _ in range(10):
+            nb, cnt = paddle.geometric.weighted_sample_neighbors(
+                row, colptr, w, nodes, sample_size=1)
+            heavy += int(nb.numpy()[0] == 1)
+        assert heavy >= 8  # weight-proportional sampling
+
+
+class TestSequenceLosses:
+    def test_hsigmoid_loss_trains(self):
+        rng = np.random.RandomState(0)
+        K, Fdim, B = 8, 4, 16
+        x = paddle.to_tensor(rng.randn(B, Fdim).astype(np.float32))
+        lab = paddle.to_tensor(rng.randint(0, K, B).astype(np.int64))
+        w = paddle.to_tensor(rng.randn(K - 1, Fdim).astype(np.float32) * 0.1)
+        w.stop_gradient = False
+        losses = []
+        opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[w])
+        for _ in range(30):
+            loss = F.hsigmoid_loss(x, lab, K, w).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.7
+
+    def test_edit_distance(self):
+        inp = paddle.to_tensor(np.array([[1, 2, 3, 4]], np.int64))
+        lab = paddle.to_tensor(np.array([[1, 3, 4, 0]], np.int64))
+        d, n = F.edit_distance(inp, lab, normalized=False,
+                               label_length=paddle.to_tensor(
+                                   np.array([3], np.int64)))
+        # "1234" vs "134" -> one deletion
+        assert float(d.numpy()[0, 0]) == 1.0
+        assert int(n.numpy()[0]) == 1
+        dn, _ = F.edit_distance(inp, lab, normalized=True,
+                                label_length=paddle.to_tensor(
+                                    np.array([3], np.int64)))
+        np.testing.assert_allclose(dn.numpy()[0, 0], 1 / 3, rtol=1e-5)
+
+    def test_ctc_align(self):
+        inp = paddle.to_tensor(np.array([[0, 1, 1, 0, 2, 2, 0]], np.int64))
+        out, lens = F.ctc_align(inp, blank=0)
+        np.testing.assert_array_equal(out.numpy()[0, :2], [1, 2])
+        assert int(lens.numpy()[0]) == 2
+
+    def test_rnnt_loss_brute_force(self):
+        # tiny case: enumerate all alignments
+        B, T, U1, V = 1, 2, 2, 3
+        rng = np.random.RandomState(0)
+        logits = rng.randn(B, T, U1, V).astype(np.float32)
+        labels = np.array([[1]], np.int64)
+        loss = F.rnnt_loss(paddle.to_tensor(logits),
+                           paddle.to_tensor(labels),
+                           paddle.to_tensor(np.array([T])),
+                           paddle.to_tensor(np.array([1])),
+                           reduction="none")
+        lp = logits[0] - np.log(np.exp(logits[0]).sum(-1, keepdims=True))
+        # paths: emit at t=0 or t=1
+        p0 = lp[0, 0, 1] + lp[0, 1, 0] + lp[1, 1, 0]
+        p1 = lp[0, 0, 0] + lp[1, 0, 1] + lp[1, 1, 0]
+        expect = -np.logaddexp(p0, p1)
+        np.testing.assert_allclose(float(loss.numpy()[0]), expect, rtol=1e-4)
+
+    def test_rnnt_loss_grad(self):
+        rng = np.random.RandomState(1)
+        logits = paddle.to_tensor(rng.randn(2, 4, 3, 5).astype(np.float32))
+        logits.stop_gradient = False
+        labels = paddle.to_tensor(rng.randint(1, 5, (2, 2)).astype(np.int64))
+        loss = F.rnnt_loss(logits, labels,
+                           paddle.to_tensor(np.array([4, 3])),
+                           paddle.to_tensor(np.array([2, 1])))
+        loss.backward()
+        g = logits.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+class TestQuantLinear:
+    def test_weight_only_linear_close_to_fp(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(4, 16).astype(np.float32)
+        w = rng.randn(16, 8).astype(np.float32)
+        from paddle_tpu import quantization as Q
+        qw, scale = Q.weight_quantize(paddle.to_tensor(w))
+        out = Q.weight_only_linear(paddle.to_tensor(x), qw,
+                                   weight_scale=scale)
+        ref = x @ w
+        err = np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+        assert err < 0.03
+
+    def test_llm_int8_linear_outlier_decomposition(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(4, 16).astype(np.float32)
+        x[:, 3] *= 50  # outlier column
+        w = rng.randn(16, 8).astype(np.float32)
+        from paddle_tpu import quantization as Q
+        qw, scale = Q.weight_quantize(paddle.to_tensor(w))
+        out = Q.llm_int8_linear(paddle.to_tensor(x), qw, weight_scale=scale,
+                                threshold=6.0)
+        ref = x @ (np.round(np.clip(w / (np.abs(w).max(0) / 127), -128, 127))
+                   * (np.abs(w).max(0) / 127))
+        err = np.abs(out.numpy() - ref).max() / np.abs(ref).max()
+        assert err < 0.05
+
+    def test_apply_per_channel_scale(self):
+        from paddle_tpu import quantization as Q
+        x = paddle.to_tensor(np.full((2, 3), 6.0, np.float32))
+        s = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        np.testing.assert_allclose(
+            Q.apply_per_channel_scale(x, s).numpy(), [[6, 3, 2], [6, 3, 2]])
+
+
+class TestCorrelation:
+    def test_zero_displacement_channel_is_self_correlation(self):
+        from paddle_tpu.vision import ops as vops
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 4, 6, 6).astype(np.float32)
+        out = vops.correlation(paddle.to_tensor(x), paddle.to_tensor(x),
+                               pad_size=2, kernel_size=1,
+                               max_displacement=2, stride1=1, stride2=1)
+        d = 2
+        n_disp = (2 * d + 1) ** 2
+        assert out.shape[1] == n_disp
+        center = out.numpy()[0, n_disp // 2]
+        expect = (x[0] ** 2).mean(axis=0)
+        # interior (away from padding) matches self-correlation
+        np.testing.assert_allclose(center[2:-2, 2:-2], expect, rtol=1e-4)
+
+
+class TestMetrics:
+    def test_chunk_eval_iob(self):
+        from paddle_tpu import metric
+        # tags: type0 {B=0, I=1}, type1 {B=2, I=3}; O = 4
+        label = np.array([[0, 1, 4, 2, 3, 4]], np.int64)
+        pred = np.array([[0, 1, 4, 2, 4, 4]], np.int64)
+        p, r, f1, ninf, nlab, ncor = metric.chunk_eval(
+            pred, label, "IOB", 2)
+        assert int(nlab.numpy()[0]) == 2
+        assert int(ninf.numpy()[0]) == 2
+        assert int(ncor.numpy()[0]) == 1  # only the type0 chunk matches
+        np.testing.assert_allclose(p.numpy()[0], 0.5)
+        np.testing.assert_allclose(r.numpy()[0], 0.5)
+
+    def test_detection_map_perfect(self):
+        from paddle_tpu import metric
+        m = metric.DetectionMAP(class_num=2)
+        det = np.array([[0, 0.9, 0, 0, 10, 10], [1, 0.8, 20, 20, 30, 30]],
+                       np.float32)
+        gt = np.array([[0, 0, 0, 10, 10], [1, 20, 20, 30, 30]], np.float32)
+        m.update(det, gt)
+        assert m.accumulate() == pytest.approx(1.0)
+
+    def test_detection_map_half(self):
+        from paddle_tpu import metric
+        m = metric.DetectionMAP(class_num=1)
+        det = np.array([[0, 0.9, 0, 0, 10, 10],
+                        [0, 0.8, 50, 50, 60, 60]], np.float32)  # 1 fp
+        gt = np.array([[0, 0, 0, 10, 10], [0, 80, 80, 90, 90]], np.float32)
+        m.update(det, gt)
+        # 1 tp of 2 gts, fp at rank 2: integral AP = 0.5
+        assert m.accumulate() == pytest.approx(0.5)
+
+
+class TestReviewFixes:
+    def test_segment_min_empty_segment_zero(self):
+        data = paddle.to_tensor(
+            np.array([[1.0, 2], [3, 4], [5, 6]], np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 2]))
+        out = paddle.geometric.segment_min(data, seg).numpy()
+        np.testing.assert_allclose(out[1], [0, 0])  # empty segment -> 0
+        np.testing.assert_allclose(out[0], [1, 2])
+
+    def test_send_u_recv_int_min_empty_dst(self):
+        x = paddle.to_tensor(np.array([[5], [7]], np.int32))
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([0, 0]))
+        out = paddle.geometric.send_u_recv(x, src, dst, "min",
+                                           out_size=3).numpy()
+        assert out[0, 0] == 5
+        assert out[1, 0] == 0 and out[2, 0] == 0  # not INT_MAX
+
+    def test_yolo_box_iou_aware_layout(self):
+        from paddle_tpu.vision import ops as vops
+        n, na, c, h, w = 1, 2, 3, 2, 2
+        # iou block leads: na channels, then na*(5+c)
+        arr = np.zeros((n, na + na * (5 + c), h, w), np.float32)
+        arr[:, :na] = 5.0  # iou logits -> sigmoid ~ 0.993
+        img = paddle.to_tensor(np.full((n, 2), 32, np.int32))
+        boxes, scores = vops.yolo_box(
+            paddle.to_tensor(arr), img, anchors=[10, 13, 16, 30],
+            class_num=c, conf_thresh=0.0, downsample_ratio=16,
+            iou_aware=True, iou_aware_factor=0.5)
+        assert boxes.shape == [n, na * h * w, 4]
+        # conf = sigmoid(0)^0.5 * sigmoid(5)^0.5 ~ 0.705; score = conf * 0.5
+        np.testing.assert_allclose(scores.numpy(),
+                                   np.full((n, na * h * w, c),
+                                           np.sqrt(0.5 * 0.9933) * 0.5),
+                                   rtol=1e-3)
+
+    def test_roi_align_adaptive_sampling(self):
+        from paddle_tpu.vision import ops as vops
+        # large ROI -> adaptive grid (ceil(roi/out) samples/bin): average of
+        # a linear ramp over each bin must equal the bin-center value
+        H = 16
+        ramp = np.broadcast_to(
+            np.arange(H, dtype=np.float32)[None, :], (H, H))
+        x = paddle.to_tensor(ramp[None, None].copy())
+        boxes = paddle.to_tensor(np.array([[0, 0, 16, 16]], np.float32))
+        out = vops.roi_align(x, boxes, [1], output_size=2,
+                             sampling_ratio=-1, aligned=False)
+        # adaptive grid = 8 samples/bin at fraction centers 0.5..7.5:
+        # bin0 mean = 4.0; bin1 samples 8.5..15.5 (15.5 clamps to 15)
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], [4.0, 11.9375],
+                                   atol=1e-3)
+
+    def test_rnnt_fastemit_scales_grad_not_value(self):
+        rng = np.random.RandomState(2)
+        logits_np = rng.randn(1, 3, 2, 4).astype(np.float32)
+        labels = paddle.to_tensor(np.array([[1]], np.int64))
+        tl = paddle.to_tensor(np.array([3]))
+        ul = paddle.to_tensor(np.array([1]))
+        vals, grads = [], []
+        for lam in (0.0, 0.5):
+            lg = paddle.to_tensor(logits_np)
+            lg.stop_gradient = False
+            loss = F.rnnt_loss(lg, labels, tl, ul, fastemit_lambda=lam,
+                               reduction="sum")
+            loss.backward()
+            vals.append(float(loss.numpy()))
+            grads.append(lg.grad.numpy().copy())
+        np.testing.assert_allclose(vals[0], vals[1], rtol=1e-6)
+        assert np.abs(grads[0] - grads[1]).max() > 1e-6

@@ -27,6 +27,10 @@ ALIASES = {
     "reduce_min": "min", "reduce_prod": "prod", "fill_constant": "full",
     "top_k": "topk", "arg_max": "argmax", "arg_min": "argmin",
     "softmax_with_cross_entropy": "cross_entropy",
+    "deformable_conv": "deform_conv2d", "multiclass_nms3": "multiclass_nms",
+    "unpool": "max_unpool2d", "unpool3d": "max_unpool3d",
+    "warprnnt": "rnnt_loss", "graph_sample_neighbors": "sample_neighbors",
+    "graph_reindex": "reindex_graph",
 }
 
 # reference ops that are CUDA/infra-specific and have no TPU-user surface:
@@ -36,6 +40,15 @@ EXCLUDE_PREFIXES = (
     "pull_", "onednn_", "xpu_", "dgc", "nop", "share_", "memcpy",
     "quantize", "dequantize", "fake_quantize", "fake_dequantize",
     "sparse_", "coalesce",
+    # parameter-server / tree-based-recommender infra (L4 PS mode — the
+    # TPU design replaces the PS path wholesale with SPMD sharding):
+    "pyramid_hash", "tdm_", "rank_attention", "shuffle_batch_",
+    # legacy LoD (variable-length static-graph) sequence kernels; varlen
+    # here is flash_attn_unpadded / padding-mask based, not LoD tensors
+    "sequence_conv", "sequence_pool",
+    # channel-wise fake-quant observers (quantization.fake_quant covers
+    # the capability; channel-wise handled inside PTQ/QAT observers)
+    "fake_channel_wise_",
 )
 
 # reference ops whose capability lives at a different API level here —
@@ -123,6 +136,35 @@ SUBSUMED = {
     "disable_check_model_nan_inf": "amp.debugging",
     "check_numerics": "amp.debugging",
     "npu_identity": "ops.assign",
+    "assign_value_": "ops.assign",
+    "viterbi_decode": "text.viterbi_decode",
+    "crf_decoding": "text.viterbi_decode",
+    "chunk_eval": "metric.chunk_eval",
+    "detection_map": "metric.DetectionMAP",
+    "edit_distance": "nn.functional.edit_distance",
+    "ctc_align": "nn.functional.ctc_align",
+    "flash_attn_unpadded": "nn.functional.flash_attn_unpadded",
+    "flash_attn_varlen_qkvpacked": "nn.functional.flash_attn_unpadded",
+    "flash_attn_with_sparse_mask": "nn.functional.flash_attention(mask)",
+    "block_multihead_attention_": "nn.functional.flash_attention + KV cache",
+    "segment_pool": "geometric.segment_sum/mean/min/max",
+    "graph_khop_sampler": "geometric.sample_neighbors (per hop) + reindex",
+    "weighted_sample_neighbors": "geometric.weighted_sample_neighbors",
+    "reindex_graph": "geometric.reindex_graph",
+    "send_u_recv": "geometric.send_u_recv",
+    "send_ue_recv": "geometric.send_ue_recv",
+    "send_uv": "geometric.send_uv",
+    "merge_selected_rows": "framework.SelectedRows",
+    "shuffle_channel": "nn.functional.channel_shuffle",
+    "pad3d": "nn.functional.pad (NCDHW)",
+    "yolo_box_head": "vision.ops.yolo_box",
+    "yolo_box_post": "vision.ops.yolo_box + multiclass_nms",
+    "weight_quantize": "quantization.weight_quantize",
+    "weight_dequantize": "quantization.weight_dequantize",
+    "weight_only_linear": "quantization.weight_only_linear",
+    "llm_int8_linear": "quantization.llm_int8_linear",
+    "apply_per_channel_scale": "quantization.apply_per_channel_scale",
+    "hsigmoid_loss": "nn.functional.hsigmoid_loss",
 }
 
 
