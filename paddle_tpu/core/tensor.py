@@ -137,6 +137,10 @@ class Tensor:
     def __array__(self, dtype=None, copy=None):
         # numpy protocol: one bulk device->host transfer instead of numpy
         # falling back to per-element __getitem__ (each a dispatched gather)
+        if copy is False:
+            raise ValueError(
+                "cannot expose a device tensor as a zero-copy numpy view; "
+                "call with copy=None/True")
         arr = np.asarray(self._data)
         return arr.astype(dtype) if dtype is not None else arr
 

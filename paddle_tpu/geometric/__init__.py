@@ -222,9 +222,8 @@ def reindex_heter_graph(x, neighbors, count, value_buffer=None,
             Tensor(jnp.asarray(np.asarray(out_nodes, np.int32))))
 
 
-def _csr_neighbors(row, colptr, nodes):
-    """Slice CSC/CSR storage for each query node (host)."""
-    row = np.asarray(row).ravel()
+def _csr_neighbors(colptr, nodes):
+    """Per-query-node (start, end) spans into CSC/CSR storage (host)."""
     ptr = np.asarray(colptr).ravel()
     return [(int(ptr[v]), int(ptr[v + 1])) for v in nodes.tolist()]
 
@@ -238,7 +237,7 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
     from ..core.generator import default_generator
     nodes = np.asarray(_t(input_nodes)._data).ravel()
     rownp = np.asarray(_t(row)._data).ravel()
-    spans = _csr_neighbors(rownp, np.asarray(_t(colptr)._data), nodes)
+    spans = _csr_neighbors(np.asarray(_t(colptr)._data), nodes)
     eid_np = (np.asarray(_t(eids)._data).ravel()
               if eids is not None else None)
     key = default_generator().next_key()
@@ -275,7 +274,7 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
     nodes = np.asarray(_t(input_nodes)._data).ravel()
     rownp = np.asarray(_t(row)._data).ravel()
     wnp = np.asarray(_t(edge_weight)._data).ravel().astype(np.float64)
-    spans = _csr_neighbors(rownp, np.asarray(_t(colptr)._data), nodes)
+    spans = _csr_neighbors(np.asarray(_t(colptr)._data), nodes)
     eid_np = (np.asarray(_t(eids)._data).ravel()
               if eids is not None else None)
     key = default_generator().next_key()

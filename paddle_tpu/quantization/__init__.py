@@ -159,8 +159,16 @@ def weight_only_linear(x, weight, bias=None, weight_scale=None,
     XLA fuses the scale multiply into the MXU epilogue; activations stay in
     their original dtype.
 
-    weight: (in, out) int8 (or int4 stored as int8), weight_scale: (out,).
+    weight: (in, out) int8, weight_scale: (out,).
     """
+    if weight_dtype != "int8":
+        raise NotImplementedError(
+            f"weight_only_linear: weight_dtype={weight_dtype!r} not "
+            f"supported (int8 only; int4 nibble packing has no TPU path)")
+    if group_size != -1:
+        raise NotImplementedError(
+            "weight_only_linear: group-wise scales not supported "
+            "(per-output-channel only)")
     xt, wt = _t(x), _t(weight)
     tensors = [xt, wt]
     if weight_scale is not None:

@@ -1,5 +1,7 @@
 """paddle.vision surface (reference: python/paddle/vision/)."""
 from . import models  # noqa: F401
 from . import ops  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
 
-__all__ = ["models", "ops"]
+__all__ = ["models", "ops", "transforms", "datasets"]

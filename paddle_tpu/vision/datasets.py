@@ -1,0 +1,228 @@
+"""paddle.vision.datasets — MNIST/FashionMNIST/Cifar/ImageFolder.
+
+Reference: python/paddle/vision/datasets/{mnist,cifar,folder}.py. The
+reference downloads archives on demand; this environment has no egress, so
+constructors take a local ``image_path``/``data_file`` and raise a clear
+error when files are absent. Parsing (IDX / cifar pickle) matches the
+reference formats byte-for-byte, so files fetched for the reference work
+unchanged.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder"]
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference mnist.py MNIST).
+
+    mode: train|test; image_path/label_path point at the (optionally
+    .gz-compressed) ubyte files.
+    """
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        if image_path is None or label_path is None:
+            base = os.environ.get(
+                "PADDLE_TPU_DATA_HOME",
+                os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+            tag = "train" if mode == "train" else "t10k"
+            image_path = image_path or os.path.join(
+                base, self.NAME, f"{tag}-images-idx3-ubyte.gz")
+            label_path = label_path or os.path.join(
+                base, self.NAME, f"{tag}-labels-idx1-ubyte.gz")
+        for p in (image_path, label_path):
+            if not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{self.NAME}: {p} not found. No-egress environment — "
+                    f"place the IDX files there (same files the reference "
+                    f"downloads) or pass image_path/label_path.")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(
+            path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad IDX image magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad IDX label magic {magic}")
+            return np.frombuffer(f.read(n), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        lbl = int(self.labels[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(lbl, dtype=np.int64)
+
+    def __len__(self):
+        return self.images.shape[0]
+
+
+class FashionMNIST(MNIST):
+    """Same IDX layout, different archive (reference mnist.py FashionMNIST)."""
+
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 python-pickle archive (reference cifar.py Cifar10)."""
+
+    _batches_train = [f"data_batch_{i}" for i in range(1, 6)]
+    _batches_test = ["test_batch"]
+    _prefix = "cifar-10-batches-py"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        if data_file is None:
+            base = os.environ.get(
+                "PADDLE_TPU_DATA_HOME",
+                os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+            data_file = os.path.join(base, "cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"cifar: {data_file} not found (no-egress environment; "
+                f"provide the same tar.gz the reference downloads)")
+        names = (self._batches_train if mode == "train"
+                 else self._batches_test)
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for name in names:
+                member = tf.getmember(f"{self._prefix}/{name}")
+                with tf.extractfile(member) as f:
+                    batch = pickle.load(f, encoding="bytes")
+                images.append(np.asarray(batch[b"data"], dtype=np.uint8))
+                key = b"labels" if b"labels" in batch else b"fine_labels"
+                labels.extend(batch[key])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].transpose(1, 2, 0)  # HWC for transforms
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return self.images.shape[0]
+
+
+class Cifar100(Cifar10):
+    _batches_train = ["train"]
+    _batches_test = ["test"]
+    _prefix = "cifar-100-python"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if data_file is None:
+            base = os.environ.get(
+                "PADDLE_TPU_DATA_HOME",
+                os.path.expanduser("~/.cache/paddle_tpu/datasets"))
+            data_file = os.path.join(base, "cifar-100-python.tar.gz")
+        super().__init__(data_file, mode, transform, download, backend)
+
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".gif", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory image tree (reference folder.py DatasetFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        extensions = extensions or _IMG_EXTS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise ValueError(f"no class folders under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(tuple(extensions)))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise ValueError(f"no images found under {root}")
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, np.asarray(target, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat/recursive image list without labels (reference folder.py
+    ImageFolder)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or DatasetFolder._pil_loader
+        extensions = extensions or _IMG_EXTS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(tuple(extensions)))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise ValueError(f"no images found under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

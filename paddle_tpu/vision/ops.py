@@ -250,6 +250,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     if isinstance(output_size, int):
         output_size = (output_size, output_size)
     ph, pw = output_size
+    if boxes.shape[0] == 0:
+        return as_tensor(jnp.zeros((0, x.shape[1], ph, pw),
+                                   dtype=x._data.dtype))
     batch_idx = _roi_batch_index(
         boxes_num.numpy() if hasattr(boxes_num, "numpy") else boxes_num,
         boxes.shape[0])
@@ -1064,18 +1067,26 @@ def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
     xt1, xt2 = _t(x1), _t(x2)
     d = max_displacement // stride2
 
+    border = max_displacement
+
     def f(a, b):
         n, c, h, w = a.shape
         pad_cfg = ((0, 0), (0, 0), (pad_size, pad_size), (pad_size, pad_size))
         ap = jnp.pad(a, pad_cfg)
         bp = jnp.pad(b, pad_cfg)
+        hp, wp = h + 2 * pad_size, w + 2 * pad_size
+        # reference output covers only positions where every displacement
+        # stays inside the padded map: [border, Hp-border) — sliced reads,
+        # never jnp.roll (roll would wrap displaced reads to the far edge)
+        eh, ew = hp - 2 * border, wp - 2 * border
+        base = ap[:, :, border:border + eh, border:border + ew]
         outs = []
         for di in range(-d, d + 1):
             for dj in range(-d, d + 1):
                 oy, ox = di * stride2, dj * stride2
-                shifted = jnp.roll(bp, (-oy, -ox), axis=(2, 3))
-                prod = (ap * shifted).mean(axis=1)  # (n, H+2p, W+2p)
-                outs.append(prod)
+                shifted = bp[:, :, border + oy:border + oy + eh,
+                             border + ox:border + ox + ew]
+                outs.append((base * shifted).mean(axis=1))  # (n, eh, ew)
         out = jnp.stack(outs, axis=1)
         return out[:, :, ::stride1, ::stride1]
 

@@ -396,10 +396,35 @@ class TestCorrelation:
         d = 2
         n_disp = (2 * d + 1) ** 2
         assert out.shape[1] == n_disp
+        # output crops the max_displacement border of the padded map:
+        # (6 + 2*2 - 2*2) = 6 -> exactly the original extent here
+        assert out.shape[2] == 6 and out.shape[3] == 6
         center = out.numpy()[0, n_disp // 2]
         expect = (x[0] ** 2).mean(axis=0)
-        # interior (away from padding) matches self-correlation
-        np.testing.assert_allclose(center[2:-2, 2:-2], expect, rtol=1e-4)
+        np.testing.assert_allclose(center, expect, rtol=1e-4)
+
+    def test_no_wraparound_with_small_pad(self):
+        from paddle_tpu.vision import ops as vops
+        # pad_size=0 < max_displacement: displaced reads at the border must
+        # see zeros, never the opposite edge
+        x = np.zeros((1, 1, 4, 4), np.float32)
+        x[0, 0, 0, 0] = 1.0
+        x[0, 0, -1, -1] = 100.0
+        out = vops.correlation(paddle.to_tensor(x), paddle.to_tensor(x),
+                               pad_size=1, kernel_size=1,
+                               max_displacement=1, stride1=1, stride2=1)
+        o = out.numpy()[0]  # (9, 4, 4)
+        # channel (dy=-1,dx=-1) at position (0,0): displaced read is out of
+        # bounds -> 0, NOT the 100 at the opposite corner
+        assert o[0, 0, 0] == 0.0
+
+    def test_roi_align_empty_rois(self):
+        from paddle_tpu.vision import ops as vops
+        x = paddle.to_tensor(np.ones((1, 3, 8, 8), np.float32))
+        boxes = paddle.to_tensor(np.zeros((0, 4), np.float32))
+        out = vops.roi_align(x, boxes, [0], output_size=2,
+                             sampling_ratio=-1)
+        assert out.shape == [0, 3, 2, 2]
 
 
 class TestMetrics:

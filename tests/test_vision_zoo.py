@@ -1,0 +1,220 @@
+"""Vision model zoo + transforms + datasets tests.
+
+Reference: python/paddle/vision/models/, transforms/, datasets/.
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models, transforms as T
+
+
+class TestZooForward:
+    @pytest.mark.parametrize("ctor,size", [
+        ("vgg11", 64), ("mobilenet_v1", 64), ("mobilenet_v2", 64),
+        ("mobilenet_v3_small", 64), ("mobilenet_v3_large", 64),
+        ("alexnet", 96), ("squeezenet1_0", 96), ("squeezenet1_1", 96),
+        ("shufflenet_v2_x0_25", 64), ("shufflenet_v2_swish", 64),
+        ("densenet121", 64),
+    ])
+    def test_forward_shape(self, ctor, size):
+        net = getattr(models, ctor)(num_classes=7)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(
+            2, 3, size, size).astype(np.float32))
+        assert net(x).shape == [2, 7]
+
+    def test_googlenet_aux_heads(self):
+        net = models.googlenet(num_classes=5)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(
+            1, 3, 96, 96).astype(np.float32))
+        out, a1, a2 = net(x)
+        assert out.shape == [1, 5] and a1.shape == [1, 5] and a2.shape == [1, 5]
+
+    def test_inception_v3(self):
+        net = models.inception_v3(num_classes=4)
+        net.eval()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(
+            1, 3, 128, 128).astype(np.float32))
+        assert net(x).shape == [1, 4]
+
+    def test_lenet_zoo_variant(self):
+        net = models.LeNet()
+        x = paddle.to_tensor(np.random.RandomState(0).rand(
+            2, 1, 28, 28).astype(np.float32))
+        assert net(x).shape == [2, 10]
+
+    def test_mobilenet_v2_trains(self):
+        net = models.mobilenet_v2(scale=0.25, num_classes=2)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(4, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor((rng.rand(4) > 0.5).astype(np.int64))
+        import paddle_tpu.nn.functional as F
+        l0 = lN = None
+        for i in range(6):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if i == 0:
+                l0 = float(loss.numpy())
+            lN = float(loss.numpy())
+        assert lN < l0
+
+
+class TestTransforms:
+    def _img(self, h=32, w=48):
+        rng = np.random.RandomState(0)
+        return rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+
+    def test_to_tensor_scales_and_chw(self):
+        t = T.ToTensor()
+        out = t(self._img())
+        assert out.shape == [3, 32, 48]
+        a = out.numpy()
+        assert a.max() <= 1.0 and a.min() >= 0.0
+
+    def test_resize_int_keeps_aspect(self):
+        out = T.Resize(16)(self._img(32, 48))
+        assert np.asarray(out).shape[:2] == (16, 24)
+        out2 = T.Resize((8, 9))(self._img())
+        assert np.asarray(out2).shape[:2] == (8, 9)
+
+    def test_center_crop(self):
+        out = T.CenterCrop(16)(self._img())
+        arr = np.asarray(out)
+        assert arr.shape[:2] == (16, 16)
+        np.testing.assert_array_equal(arr, self._img()[8:24, 16:32])
+
+    def test_random_crop_within_bounds(self):
+        out = T.RandomCrop(20)(self._img())
+        assert np.asarray(out).shape[:2] == (20, 20)
+
+    def test_flips(self):
+        img = self._img()
+        np.testing.assert_array_equal(
+            np.asarray(T.RandomHorizontalFlip(prob=1.0)(img)),
+            img[:, ::-1])
+        np.testing.assert_array_equal(
+            np.asarray(T.RandomVerticalFlip(prob=1.0)(img)), img[::-1])
+
+    def test_normalize_chw(self):
+        x = np.ones((3, 4, 4), np.float32)
+        out = T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])(x)
+        np.testing.assert_allclose(np.asarray(out), np.ones((3, 4, 4)))
+
+    def test_compose_pipeline(self):
+        pipe = T.Compose([
+            T.Resize(40), T.RandomCrop(32), T.RandomHorizontalFlip(),
+            T.ColorJitter(0.1, 0.1, 0.1, 0.1), T.ToTensor(),
+            T.Normalize([0.5] * 3, [0.25] * 3)])
+        out = pipe(self._img(64, 64))
+        assert out.shape == [3, 32, 32]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_pad_and_rotation_and_gray(self):
+        img = self._img()
+        assert np.asarray(T.Pad(2)(img)).shape == (36, 52, 3)
+        assert np.asarray(T.RandomRotation(30)(img)).shape == (32, 48, 3)
+        g = T.Grayscale()(img)
+        assert np.asarray(g).ndim == 2 or np.asarray(g).shape[2] == 1
+        g3 = T.Grayscale(3)(img)
+        a3 = np.asarray(g3)
+        np.testing.assert_array_equal(a3[..., 0], a3[..., 1])
+
+    def test_random_erasing(self):
+        x = paddle.to_tensor(np.ones((3, 16, 16), np.float32))
+        out = T.RandomErasing(prob=1.0, value=0.0)(x)
+        assert (out.numpy() == 0).sum() > 0
+
+    def test_transpose(self):
+        out = T.Transpose()(self._img())
+        assert np.asarray(out).shape == (3, 32, 48)
+
+
+def _write_idx(tmp, images, labels, tag):
+    ip = os.path.join(tmp, f"{tag}-images-idx3-ubyte.gz")
+    lp = os.path.join(tmp, f"{tag}-labels-idx1-ubyte.gz")
+    n, r, c = images.shape
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, n, r, c))
+        f.write(images.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, n))
+        f.write(labels.tobytes())
+    return ip, lp
+
+
+class TestDatasets:
+    def test_mnist_idx_roundtrip(self, tmp_path):
+        rng = np.random.RandomState(0)
+        images = rng.randint(0, 255, (10, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, 10).astype(np.uint8)
+        ip, lp = _write_idx(str(tmp_path), images, labels, "train")
+        ds = datasets.MNIST(image_path=ip, label_path=lp, mode="train")
+        assert len(ds) == 10
+        img, lbl = ds[3]
+        np.testing.assert_array_equal(img, images[3])
+        assert int(lbl) == int(labels[3])
+
+    def test_mnist_with_transform_and_loader(self, tmp_path):
+        rng = np.random.RandomState(1)
+        images = rng.randint(0, 255, (8, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, 8).astype(np.uint8)
+        ip, lp = _write_idx(str(tmp_path), images, labels, "t10k")
+        ds = datasets.MNIST(image_path=ip, label_path=lp, mode="test",
+                            transform=T.Compose([T.ToTensor()]))
+        loader = paddle.io.DataLoader(ds, batch_size=4)
+        batch = next(iter(loader))
+        x, y = batch
+        assert list(x.shape) == [4, 1, 28, 28]
+        assert list(y.shape) == [4]
+
+    def test_missing_file_raises_clearly(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="no-egress|not found"):
+            datasets.MNIST(image_path=str(tmp_path / "nope.gz"),
+                           label_path=str(tmp_path / "nope2.gz"))
+
+    def test_cifar10_tar(self, tmp_path):
+        rng = np.random.RandomState(0)
+        os.makedirs(tmp_path / "cifar-10-batches-py")
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + ["test_batch"]:
+            batch = {b"data": rng.randint(0, 255, (5, 3072),
+                                          dtype=np.uint8),
+                     b"labels": rng.randint(0, 10, 5).tolist()}
+            with open(tmp_path / "cifar-10-batches-py" / name, "wb") as f:
+                pickle.dump(batch, f)
+        tar = tmp_path / "cifar-10-python.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(tmp_path / "cifar-10-batches-py",
+                   arcname="cifar-10-batches-py")
+        tr = datasets.Cifar10(str(tar), mode="train")
+        te = datasets.Cifar10(str(tar), mode="test")
+        assert len(tr) == 25 and len(te) == 5
+        img, lbl = tr[0]
+        assert img.shape == (32, 32, 3) and 0 <= int(lbl) < 10
+
+    def test_dataset_folder(self, tmp_path):
+        from PIL import Image
+        for cls in ("cat", "dog"):
+            os.makedirs(tmp_path / "train" / cls)
+            for i in range(3):
+                arr = np.full((8, 8, 3), 100 + i, np.uint8)
+                Image.fromarray(arr).save(
+                    tmp_path / "train" / cls / f"{i}.png")
+        ds = datasets.DatasetFolder(str(tmp_path / "train"))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, lbl = ds[0]
+        assert int(lbl) == 0
+        flat = datasets.ImageFolder(str(tmp_path / "train"))
+        assert len(flat) == 6
