@@ -6,8 +6,14 @@ frontends. TPU-native design: instead of transpiling Python to a Program IR,
 tracing (payloads become tracers), the autograd tape records as usual, and
 XLA compiles the whole step. Guards = jax's shape/dtype dispatch cache.
 
-This v0 supports function capture with static control flow. Graph-break
-fallback and bytecode-level capture (SOT) land on top of this API.
+Supports function capture with static control flow, plus SOT-style
+graph-break fallback (reference sot/translate.py): with full_graph=False
+(the default, matching the reference's SOT mode), data-dependent Python
+control flow falls back to eager with a warning and a recorded
+``graph_break_reason`` instead of erroring; full_graph=True makes breaks
+hard errors. Bytecode-level partial-frame capture is intentionally not
+replicated — the capture unit here is the function, with jax's shape/dtype
+dispatch cache playing the role of SOT guards.
 """
 from __future__ import annotations
 
@@ -77,6 +83,23 @@ class StaticFunction:
         functools.update_wrapper(self, fn)
         self._jitted = None
         self._params = None
+        # SOT-style graph-break state (reference sot/translate.py: on
+        # untraceable code, fall back and record why). full_graph=True
+        # makes a break an error, like the reference's full_graph flag.
+        self._full_graph = full_graph
+        # break reasons keyed per dispatch signature (statics + array
+        # shapes/dtypes) — one breaking signature must not disable jit for
+        # signatures that trace fine (the reference SOT falls back
+        # per-guard, not per-function)
+        self._graph_breaks: dict = {}
+
+    @property
+    def graph_break_reason(self):
+        """Why the most recent breaking signature fell back to eager
+        (None = no signature has broken)."""
+        if not self._graph_breaks:
+            return None
+        return next(reversed(self._graph_breaks.values()))
 
     def _collect_params(self, args):
         """Find Layer instances bound to the function (self for methods),
@@ -166,8 +189,33 @@ class StaticFunction:
 
             self._jitted = jax.jit(jit_target,
                                    static_argnums=(2, 3))
-        out, mutated = self._jitted([p._data for p in params], arrays,
-                                    treedef, statics)
+        sig = (treedef, statics,
+               tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
+        if sig in self._graph_breaks:
+            return fn(*args, **kwargs)
+        try:
+            out, mutated = self._jitted([p._data for p in params], arrays,
+                                        treedef, statics)
+        except (jax.errors.TracerBoolConversionError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            # graph break: data-dependent Python control flow (or a host
+            # round-trip) inside the traced region. The reference SOT
+            # falls back to eager for the breaking frame; our capture unit
+            # is the whole function, so this SIGNATURE runs eagerly —
+            # other signatures keep their compiled programs.
+            reason = f"{type(e).__name__}: {str(e).splitlines()[0]}"
+            if self._full_graph:
+                raise
+            self._graph_breaks[sig] = reason
+            import warnings
+            warnings.warn(
+                f"to_static graph break in {self.__name__!r} — running "
+                f"eagerly ({reason}). Use lax-style control flow "
+                f"(paddle.where / static shapes) to capture fully.",
+                stacklevel=2)
+            return fn(*args, **kwargs)
         for i, arr in mutated.items():
             params[i]._swap_payload(arr)
         return _wrap(out)
@@ -182,7 +230,7 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=True):
+              backend=None, full_graph=False):
     def decorate(fn):
         if hasattr(fn, "forward") and callable(getattr(fn, "forward")):
             # Layer instance: wrap its forward

@@ -42,12 +42,39 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
     def f(logits, lab, *w):
         ax = axis if axis >= 0 else logits.ndim + axis
         c = logits.shape[ax]
+        hard = not (soft_label or (lab.ndim == logits.ndim
+                                   and lab.shape[ax] == c
+                                   and jnp.issubdtype(lab.dtype,
+                                                      jnp.floating)))
+        if use_softmax and hard:
+            # streaming formulation: nll = lse - logits[label]. Never
+            # materializes an f32 (N, V) log-prob tensor — the f32 cast
+            # fuses into the reductions, the big buffer stays in the
+            # input dtype (bf16 under AMP). Cuts the GPT-class lm-head
+            # loss from ~5 HBM passes of f32 to ~3 passes of bf16.
+            m = jax.lax.stop_gradient(
+                jnp.max(logits, axis=ax, keepdims=True))
+            shifted = (logits - m).astype(jnp.float32)
+            sumexp = jnp.sum(jnp.exp(shifted), axis=ax)
+            lse = jnp.log(sumexp) + jnp.squeeze(m.astype(jnp.float32), ax)
+            lab_i = lab.astype(jnp.int32)
+            if lab_i.ndim == logits.ndim and lab_i.shape[ax] == 1:
+                lab_i = jnp.squeeze(lab_i, axis=ax)
+            valid = lab_i != ignore_index
+            safe = jnp.where(valid, lab_i, 0)
+            picked = jnp.squeeze(jnp.take_along_axis(
+                logits, jnp.expand_dims(safe, ax), axis=ax), ax)
+            nll = lse - picked.astype(jnp.float32)
+            if label_smoothing > 0:
+                # mean_logp = mean(logits) - lse
+                smooth = lse - jnp.mean(logits.astype(jnp.float32), axis=ax)
+                nll = (1 - label_smoothing) * nll + label_smoothing * smooth
+            return _hard_label_reduce(nll, valid, w, has_w, safe, reduction)
         if use_softmax:
             logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=ax)
         else:
             logp = jnp.log(jnp.clip(logits.astype(jnp.float32), 1e-15, 1.0))
-        if soft_label or (lab.ndim == logits.ndim and lab.shape[ax] == c
-                          and jnp.issubdtype(lab.dtype, jnp.floating)):
+        if not hard:
             soft = lab.astype(jnp.float32)
             if label_smoothing > 0:
                 soft = soft * (1 - label_smoothing) + label_smoothing / c
@@ -68,20 +95,25 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         if label_smoothing > 0:
             smooth = -jnp.mean(logp, axis=ax)
             nll = (1 - label_smoothing) * nll + label_smoothing * smooth
-        if has_w:
-            wv = jnp.take(w[0].astype(jnp.float32), safe)
-            nll = nll * wv
-            nll = jnp.where(valid, nll, 0.0)
-            if reduction == "mean":
-                return jnp.sum(nll) / jnp.maximum(
-                    jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
-            return _reduce(nll, reduction)
-        nll = jnp.where(valid, nll, 0.0)
-        if reduction == "mean":
-            return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
-        return _reduce(nll, reduction)
+        return _hard_label_reduce(nll, valid, w, has_w, safe, reduction)
     return dispatch.call("cross_entropy", f, inputs,
                          differentiable_mask=[True, soft_label] + [False] * has_w)
+
+
+def _hard_label_reduce(nll, valid, w, has_w, safe, reduction):
+    """Shared ignore_index/weight epilogue of both hard-label CE paths."""
+    if has_w:
+        wv = jnp.take(w[0].astype(jnp.float32), safe)
+        nll = nll * wv
+        nll = jnp.where(valid, nll, 0.0)
+        if reduction == "mean":
+            return jnp.sum(nll) / jnp.maximum(
+                jnp.sum(jnp.where(valid, wv, 0.0)), 1e-12)
+        return _reduce(nll, reduction)
+    nll = jnp.where(valid, nll, 0.0)
+    if reduction == "mean":
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+    return _reduce(nll, reduction)
 
 
 def softmax_with_cross_entropy(logits, label, soft_label=False,

@@ -360,3 +360,28 @@ class CosineAnnealingWarmRestarts(LRScheduler):
                 self.T_cur = epoch
         self.last_epoch = epoch
         self.last_lr = self.get_lr()
+
+
+class LinearLR(LRScheduler):
+    """Linearly ramp the LR factor from start_factor to end_factor over
+    total_steps (reference python/paddle/optimizer/lr.py LinearLR:2355)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1.0 / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        if total_steps <= 0:
+            raise ValueError("total_steps must be positive")
+        if not 0 < start_factor <= 1:
+            raise ValueError("start_factor must be in (0, 1]")
+        if not 0 <= end_factor <= 1:
+            raise ValueError("end_factor must be in [0, 1]")
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        t = min(max(self.last_epoch, 0), self.total_steps)
+        factor = (self.start_factor
+                  + (self.end_factor - self.start_factor)
+                  * t / self.total_steps)
+        return self.base_lr * factor

@@ -39,9 +39,26 @@ def _to_pil(img):
     if _is_pil(img):
         return img
     arr = np.asarray(img)
+    if arr.ndim == 3 and arr.shape[2] == 1:
+        arr = arr[:, :, 0]  # PIL has no (H, W, 1) mode; use mode L
     if arr.dtype != np.uint8:
+        # normalized float input: scale to the uint8 range instead of
+        # truncating everything in [0, 1] to {0, 1}
+        if np.issubdtype(arr.dtype, np.floating) and arr.size \
+                and float(arr.max()) <= 1.0 and float(arr.min()) >= 0.0:
+            arr = arr * 255.0
         arr = np.clip(arr, 0, 255).astype(np.uint8)
     return Image.fromarray(arr)
+
+
+def _resample_float(arr, op):
+    """Apply a PIL geometric op to a float HWC array channel-wise via
+     32-bit 'F' mode images (lossless for float inputs)."""
+    from PIL import Image
+    chans = [np.asarray(op(Image.fromarray(arr[:, :, c].astype(np.float32),
+                                           mode="F")))
+             for c in range(arr.shape[2])]
+    return np.stack(chans, axis=-1).astype(arr.dtype)
 
 
 def _to_np(img):
@@ -75,16 +92,19 @@ def resize(img, size, interpolation="bilinear"):
     from PIL import Image
     modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
              "bicubic": Image.BICUBIC, "lanczos": Image.LANCZOS}
-    pil = _to_pil(img)
+    arr0 = _to_np(img)
+    h0, w0 = arr0.shape[:2]
     if isinstance(size, int):
-        w, h = pil.size
-        if w < h:
-            ow, oh = size, int(size * h / w)
+        if w0 < h0:
+            ow, oh = size, int(size * h0 / w0)
         else:
-            ow, oh = int(size * w / h), size
+            ow, oh = int(size * w0 / h0), size
     else:
         oh, ow = size
-    out = pil.resize((ow, oh), modes[interpolation])
+    if not _is_pil(img) and np.issubdtype(arr0.dtype, np.floating):
+        return _resample_float(
+            arr0, lambda im: im.resize((ow, oh), modes[interpolation]))
+    out = _to_pil(img).resize((ow, oh), modes[interpolation])
     return out if _is_pil(img) else _to_np(out)
 
 
@@ -148,9 +168,14 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     from PIL import Image
     modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
              "bicubic": Image.BICUBIC}
-    pil = _to_pil(img)
-    out = pil.rotate(angle, resample=modes[interpolation], expand=expand,
-                     center=center, fillcolor=fill)
+    arr0 = None if _is_pil(img) else _to_np(img)
+    if arr0 is not None and np.issubdtype(arr0.dtype, np.floating):
+        return _resample_float(
+            arr0, lambda im: im.rotate(angle, resample=modes[interpolation],
+                                       expand=expand, center=center,
+                                       fillcolor=float(fill)))
+    out = _to_pil(img).rotate(angle, resample=modes[interpolation],
+                              expand=expand, center=center, fillcolor=fill)
     return out if _is_pil(img) else _to_np(out)
 
 
@@ -164,19 +189,33 @@ def to_grayscale(img, num_output_channels=1):
 
 
 def adjust_brightness(img, factor):
-    arr = _to_np(img).astype(np.float32) * factor
-    out = np.clip(arr, 0, 255).astype(np.uint8)
+    raw = _to_np(img)
+    arr = raw.astype(np.float32) * factor
+    if raw.dtype == np.uint8:
+        out = np.clip(arr, 0, 255).astype(np.uint8)
+    else:
+        out = arr.astype(raw.dtype)  # float pipeline: dtype-preserving
     return _to_pil(out) if _is_pil(img) else out
 
 
 def adjust_contrast(img, factor):
-    arr = _to_np(img).astype(np.float32)
+    raw = _to_np(img)
+    arr = raw.astype(np.float32)
     mean = arr.mean()
-    out = np.clip((arr - mean) * factor + mean, 0, 255).astype(np.uint8)
+    out = (arr - mean) * factor + mean
+    if raw.dtype == np.uint8:
+        out = np.clip(out, 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(raw.dtype)
     return _to_pil(out) if _is_pil(img) else out
 
 
 def adjust_hue(img, factor):
+    if not _is_pil(img) and np.issubdtype(np.asarray(img).dtype,
+                                          np.floating):
+        raise TypeError(
+            "adjust_hue requires a uint8/PIL image (HSV path); apply it "
+            "before ToTensor/Normalize in the pipeline")
     pil = _to_pil(img).convert("HSV")
     h, s, v = pil.split()
     h_arr = np.asarray(h, dtype=np.int16)
@@ -409,6 +448,11 @@ class SaturationTransform(BaseTransform):
     def _apply_image(self, img):
         if self.value == 0:
             return img
+        if not _is_pil(img) and np.issubdtype(np.asarray(img).dtype,
+                                              np.floating):
+            raise TypeError(
+                "SaturationTransform requires a uint8/PIL image; apply it "
+                "before ToTensor/Normalize in the pipeline")
         from PIL import ImageEnhance
         factor = random.uniform(max(0, 1 - self.value), 1 + self.value)
         out = ImageEnhance.Color(_to_pil(img)).enhance(factor)

@@ -120,3 +120,80 @@ def test_params_only_fallback(tmp_path):
     fio_save(net.state_dict(), path + ".pdparams")
     out = paddle.jit.load(path)
     assert isinstance(out, dict) and "fc1.weight" in out
+
+
+class TestGraphBreakFallback:
+    """SOT-style graph breaks (reference sot/translate.py fallback)."""
+
+    def test_data_dependent_branch_falls_back(self):
+        import warnings
+        import numpy as np
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static(full_graph=False)
+        def f(x):
+            if float(x.sum().numpy()) > 0:  # python branch on data
+                return x * 2
+            return x - 1
+
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(x)
+        assert any("graph break" in str(m.message) for m in w)
+        np.testing.assert_allclose(out.numpy(), 2.0 * np.ones((2, 2)))
+        assert f.graph_break_reason is not None
+        # both branches work eagerly after the break
+        out2 = f(paddle.to_tensor(-np.ones((2, 2), np.float32)))
+        np.testing.assert_allclose(out2.numpy(), -2.0 * np.ones((2, 2)))
+
+    def test_full_graph_true_raises(self):
+        import numpy as np
+        import pytest
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static(full_graph=True)
+        def g(x):
+            if float(x.sum().numpy()) > 0:
+                return x * 2
+            return x - 1
+
+        import jax
+        with pytest.raises((jax.errors.TracerBoolConversionError,
+                            jax.errors.TracerArrayConversionError,
+                            jax.errors.ConcretizationTypeError)):
+            g(paddle.to_tensor(np.ones((2,), np.float32)))
+
+    def test_static_function_still_captures(self):
+        import numpy as np
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static(full_graph=False)
+        def h(x):
+            return x @ x + 1
+
+        out = h(paddle.to_tensor(np.eye(3, dtype=np.float32)))
+        np.testing.assert_allclose(out.numpy(), np.eye(3) + 1)
+        assert h.graph_break_reason is None
+
+    def test_break_is_per_signature(self):
+        import numpy as np
+        import warnings
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static(full_graph=False)
+        def f(x, branchy):
+            if branchy:  # static python flag -> separate signatures
+                if float(x.sum().numpy()) > 0:  # breaks only this sig
+                    return x * 2
+                return x - 1
+            return x + 10
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            f(x, True)  # breaks
+        assert f.graph_break_reason is not None
+        # the traceable signature still compiles and runs jitted
+        out = f(x, False)
+        np.testing.assert_allclose(out.numpy(), np.full((2,), 11.0))

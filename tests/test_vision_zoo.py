@@ -218,3 +218,35 @@ class TestDatasets:
         assert int(lbl) == 0
         flat = datasets.ImageFolder(str(tmp_path / "train"))
         assert len(flat) == 6
+
+
+class TestTransformsFloatAndGray:
+    def test_resize_preserves_float(self):
+        rng = np.random.RandomState(0)
+        x = rng.rand(8, 8, 3).astype(np.float32)
+        out = T.resize(x, 4)
+        assert out.dtype == np.float32
+        # bilinear downscale of values in [0,1] stays in range, non-trivial
+        assert 0.2 < float(np.asarray(out).mean()) < 0.8
+
+    def test_rotate_preserves_float(self):
+        x = np.ones((8, 8, 1), np.float32) * 0.5
+        out = T.rotate(x, 90)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(np.asarray(out)[2:-2, 2:-2], 0.5)
+
+    def test_pad_grayscale_pil(self):
+        from PIL import Image
+        img = Image.fromarray(np.zeros((8, 8), np.uint8))
+        out = T.Pad(2)(img)
+        assert np.asarray(out).shape[:2] == (12, 12)
+
+    def test_brightness_float_dtype_preserving(self):
+        x = np.full((4, 4, 3), 0.4, np.float32)
+        out = T.adjust_brightness(x, 1.5)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out, 0.6, rtol=1e-6)
+
+    def test_hue_on_float_raises(self):
+        with pytest.raises(TypeError, match="uint8"):
+            T.adjust_hue(np.random.rand(4, 4, 3).astype(np.float32), 0.1)
