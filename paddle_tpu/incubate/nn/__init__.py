@@ -1,5 +1,6 @@
 """incubate.nn: MoE layers at the reference import path (reference:
 python/paddle/incubate/distributed/models/moe/moe_layer.py MoELayer)."""
 from ...distributed.fleet.moe import MoELayer, TopKGate
+from . import functional  # noqa: F401
 
-__all__ = ["MoELayer", "TopKGate"]
+__all__ = ["MoELayer", "TopKGate", "functional"]

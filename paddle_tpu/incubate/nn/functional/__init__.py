@@ -1,0 +1,5 @@
+"""incubate.nn.functional — reference import path for serving-fused
+attention (reference: python/paddle/incubate/nn/functional/)."""
+from ....nn.functional.paged_attention import block_multihead_attention
+
+__all__ = ["block_multihead_attention"]

@@ -199,7 +199,8 @@ class StaticFunction:
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError,
-                jax.errors.ConcretizationTypeError) as e:
+                jax.errors.ConcretizationTypeError,
+                jax.errors.NonConcreteBooleanIndexError) as e:
             # graph break: data-dependent Python control flow (or a host
             # round-trip) inside the traced region. The reference SOT
             # falls back to eager for the breaking frame; our capture unit

@@ -10,6 +10,7 @@ from .norm import *        # noqa: F401,F403
 from .loss import *        # noqa: F401,F403
 from .flash_attention import *  # noqa: F401,F403
 from .vision import *      # noqa: F401,F403
+from .paged_attention import *  # noqa: F401,F403
 
 from . import (activation, common, conv, flash_attention, loss, norm,
-               pooling, vision)
+               paged_attention, pooling, vision)

@@ -217,6 +217,8 @@ def _register_all():
     register_module(_fa, "attention")
     from ..nn.functional import vision as _vis
     register_module(_vis, "vision")
+    from ..nn.functional import paged_attention as _paged
+    register_module(_paged, "attention")
     from ..vision import ops as _vops
     register_module(_vops, "vision")
     from .. import geometric as _geo

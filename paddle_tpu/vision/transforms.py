@@ -92,8 +92,12 @@ def resize(img, size, interpolation="bilinear"):
     from PIL import Image
     modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
              "bicubic": Image.BICUBIC, "lanczos": Image.LANCZOS}
-    arr0 = _to_np(img)
-    h0, w0 = arr0.shape[:2]
+    if _is_pil(img):
+        w0, h0 = img.size  # free attribute — no pixel decode
+        arr0 = None
+    else:
+        arr0 = _to_np(img)
+        h0, w0 = arr0.shape[:2]
     if isinstance(size, int):
         if w0 < h0:
             ow, oh = size, int(size * h0 / w0)
@@ -101,7 +105,7 @@ def resize(img, size, interpolation="bilinear"):
             ow, oh = int(size * w0 / h0), size
     else:
         oh, ow = size
-    if not _is_pil(img) and np.issubdtype(arr0.dtype, np.floating):
+    if arr0 is not None and np.issubdtype(arr0.dtype, np.floating):
         return _resample_float(
             arr0, lambda im: im.resize((ow, oh), modes[interpolation]))
     out = _to_pil(img).resize((ow, oh), modes[interpolation])
@@ -170,10 +174,17 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
              "bicubic": Image.BICUBIC}
     arr0 = None if _is_pil(img) else _to_np(img)
     if arr0 is not None and np.issubdtype(arr0.dtype, np.floating):
-        return _resample_float(
-            arr0, lambda im: im.rotate(angle, resample=modes[interpolation],
-                                       expand=expand, center=center,
-                                       fillcolor=float(fill)))
+        def chan_fill(c):
+            if isinstance(fill, (tuple, list)):
+                return float(fill[c] if c < len(fill) else fill[-1])
+            return float(fill)
+        from PIL import Image
+        chans = [np.asarray(Image.fromarray(
+            arr0[:, :, c].astype(np.float32), mode="F").rotate(
+                angle, resample=modes[interpolation], expand=expand,
+                center=center, fillcolor=chan_fill(c)))
+            for c in range(arr0.shape[2])]
+        return np.stack(chans, axis=-1).astype(arr0.dtype)
     out = _to_pil(img).rotate(angle, resample=modes[interpolation],
                               expand=expand, center=center, fillcolor=fill)
     return out if _is_pil(img) else _to_np(out)

@@ -197,3 +197,19 @@ class TestGraphBreakFallback:
         # the traceable signature still compiles and runs jitted
         out = f(x, False)
         np.testing.assert_allclose(out.numpy(), np.full((2,), 11.0))
+
+    def test_boolean_index_break_falls_back(self):
+        import warnings
+        import numpy as np
+        import paddle_tpu as paddle
+
+        @paddle.jit.to_static(full_graph=False)
+        def f(x):
+            return x[x > 0]  # data-dependent shape
+
+        x = paddle.to_tensor(np.array([1.0, -2.0, 3.0], np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = f(x)
+        assert any("graph break" in str(m.message) for m in w)
+        np.testing.assert_allclose(out.numpy(), [1.0, 3.0])

@@ -1,0 +1,187 @@
+"""Paged (block-table) KV-cache attention tests.
+
+Reference capability: block_multi_head_attention
+(phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu). Oracle:
+dense softmax attention over the ragged per-sequence history.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _dense_attn(q, k, v, causal_offset):
+    """q (T,H,D), k/v (S,KVH,D) -> (T,H,D) with causal mask at offset."""
+    T, H, D = q.shape
+    S, KVH, _ = k.shape
+    g = H // KVH
+    qg = q.reshape(T, KVH, g, D).astype(np.float64)
+    s = np.einsum("tkgd,skd->tkgs", qg, k.astype(np.float64)) / np.sqrt(D)
+    jpos = np.arange(S)[None, None, None, :]
+    qpos = (causal_offset + np.arange(T)).reshape(T, 1, 1, 1)
+    s = np.where(jpos <= qpos, s, -np.inf)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return np.einsum("tkgs,skd->tkgd", p, v.astype(np.float64)).reshape(
+        T, H, D)
+
+
+def _build_cache(rng, lens, bs, H, KVH, D, max_blocks, shuffle=True):
+    """Random ragged KV histories scattered into a paged cache."""
+    B = len(lens)
+    nb = B * max_blocks + 3
+    kc = np.zeros((nb, bs, KVH, D), np.float32)
+    vc = np.zeros((nb, bs, KVH, D), np.float32)
+    ids = np.arange(1, nb)  # keep block 0 unused to catch indexing bugs
+    if shuffle:
+        rng.shuffle(ids)
+    tables = np.zeros((B, max_blocks), np.int32)
+    ks, vs = [], []
+    pos = 0
+    for b in range(B):
+        kseq = rng.randn(lens[b], KVH, D).astype(np.float32)
+        vseq = rng.randn(lens[b], KVH, D).astype(np.float32)
+        ks.append(kseq)
+        vs.append(vseq)
+        for blk_i in range(max_blocks):
+            tables[b, blk_i] = ids[pos]
+            lo = blk_i * bs
+            chunk = kseq[lo:lo + bs]
+            kc[ids[pos], :chunk.shape[0]] = chunk
+            vc[ids[pos], :chunk.shape[0]] = vseq[lo:lo + bs]
+            pos += 1
+    return kc, vc, tables, ks, vs
+
+
+class TestPagedAttention:
+    def test_decode_matches_dense(self):
+        rng = np.random.RandomState(0)
+        B, H, KVH, D, bs, mb = 3, 4, 4, 16, 8, 4
+        lens = [5, 17, 32]
+        kc, vc, tables, ks, vs = _build_cache(rng, [l - 1 for l in lens],
+                                              bs, H, KVH, D, mb)
+        q = rng.randn(B, 1, H, D).astype(np.float32)
+        nk = rng.randn(B, 1, KVH, D).astype(np.float32)
+        nv = rng.randn(B, 1, KVH, D).astype(np.float32)
+        out, kc2, vc2 = F.block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables), paddle.to_tensor(np.asarray(lens)),
+            new_k=paddle.to_tensor(nk), new_v=paddle.to_tensor(nv))
+        for b in range(B):
+            k_full = np.concatenate([ks[b], nk[b]], axis=0)
+            v_full = np.concatenate([vs[b], nv[b]], axis=0)
+            ref = _dense_attn(q[b], k_full, v_full, lens[b] - 1)
+            np.testing.assert_allclose(out.numpy()[b], ref, atol=2e-5)
+
+    def test_gqa_heads(self):
+        rng = np.random.RandomState(1)
+        B, H, KVH, D, bs, mb = 2, 8, 2, 8, 4, 3
+        lens = [6, 11]
+        kc, vc, tables, ks, vs = _build_cache(rng, lens, bs, H, KVH, D, mb)
+        q = rng.randn(B, 1, H, D).astype(np.float32)
+        out, _, _ = F.block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables), paddle.to_tensor(np.asarray(lens)))
+        for b in range(B):
+            ref = _dense_attn(q[b], ks[b][:lens[b]], vs[b][:lens[b]],
+                              lens[b] - 1)
+            np.testing.assert_allclose(out.numpy()[b], ref, atol=2e-5)
+
+    def test_chunked_prefill_causal(self):
+        # T=4 new tokens appended to a 6-token history; each new token must
+        # only see history + itself/earlier new tokens
+        rng = np.random.RandomState(2)
+        B, H, KVH, D, bs, mb = 1, 2, 2, 8, 4, 4
+        hist = 6
+        T = 4
+        kc, vc, tables, ks, vs = _build_cache(rng, [hist], bs, H, KVH, D, mb)
+        q = rng.randn(B, T, H, D).astype(np.float32)
+        nk = rng.randn(B, T, KVH, D).astype(np.float32)
+        nv = rng.randn(B, T, KVH, D).astype(np.float32)
+        out, kc2, vc2 = F.block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables),
+            paddle.to_tensor(np.asarray([hist + T])),
+            new_k=paddle.to_tensor(nk), new_v=paddle.to_tensor(nv))
+        k_full = np.concatenate([ks[0], nk[0]], axis=0)
+        v_full = np.concatenate([vs[0], nv[0]], axis=0)
+        ref = _dense_attn(q[0], k_full, v_full, hist)
+        np.testing.assert_allclose(out.numpy()[0], ref, atol=2e-5)
+
+    def test_cache_write_positions(self):
+        # new KV must land exactly at [len-T, len) in logical order
+        rng = np.random.RandomState(3)
+        B, H, KVH, D, bs, mb = 1, 2, 2, 4, 4, 3
+        kc, vc, tables, ks, vs = _build_cache(rng, [5], bs, H, KVH, D, mb,
+                                              shuffle=True)
+        nk = np.full((1, 2, KVH, D), 7.0, np.float32)
+        nv = np.full((1, 2, KVH, D), 9.0, np.float32)
+        q = rng.randn(1, 2, H, D).astype(np.float32)
+        _, kc2, vc2 = F.block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables), paddle.to_tensor(np.asarray([7])),
+            new_k=paddle.to_tensor(nk), new_v=paddle.to_tensor(nv))
+        kc2 = kc2.numpy()
+        # logical positions 5, 6 -> block idx 1, offsets 1, 2
+        blk = tables[0, 1]
+        np.testing.assert_allclose(kc2[blk, 1], 7.0)
+        np.testing.assert_allclose(kc2[blk, 2], 7.0)
+        # history untouched
+        np.testing.assert_allclose(kc2[tables[0, 0]], kc[tables[0, 0]])
+
+    def test_jitted_decode_loop_matches_full_context(self):
+        """Greedy paged decode step-by-step == one dense pass (serving
+        steady state: the step jits once, caches donated)."""
+        import jax
+        rng = np.random.RandomState(4)
+        H, KVH, D, bs, mb = 2, 2, 8, 4, 4
+        S = 10
+        ks = rng.randn(S, KVH, D).astype(np.float32)
+        vs = rng.randn(S, KVH, D).astype(np.float32)
+        qs = rng.randn(S, H, D).astype(np.float32)
+        kc = np.zeros((mb + 1, bs, KVH, D), np.float32)
+        vc = np.zeros_like(kc)
+        tables = np.arange(1, mb + 1, dtype=np.int32)[None]
+
+        kc_t, vc_t = paddle.to_tensor(kc), paddle.to_tensor(vc)
+        outs = []
+        for t in range(S):
+            out, kc_t, vc_t = F.block_multihead_attention(
+                paddle.to_tensor(qs[None, t:t + 1]), kc_t, vc_t,
+                paddle.to_tensor(tables),
+                paddle.to_tensor(np.asarray([t + 1])),
+                new_k=paddle.to_tensor(ks[None, t:t + 1]),
+                new_v=paddle.to_tensor(vs[None, t:t + 1]))
+            outs.append(out.numpy()[0, 0])
+        stepped = np.stack(outs)
+        ref = _dense_attn(qs, ks, vs, 0)
+        np.testing.assert_allclose(stepped, ref, atol=2e-5)
+
+    def test_padded_row_no_corruption_and_zero_output(self):
+        # seq_len=0 row with new KV of T=1... pos=-1 must NOT wrap into a
+        # live block; its output must be 0, not NaN
+        rng = np.random.RandomState(5)
+        H, KVH, D, bs, mb = 2, 2, 4, 4, 2
+        kc = rng.randn(5, bs, KVH, D).astype(np.float32)
+        vc = rng.randn(5, bs, KVH, D).astype(np.float32)
+        tables = np.array([[1, 2], [3, 4]], np.int32)
+        lens = np.array([0, 3])  # row 0 is padding
+        q = rng.randn(2, 1, H, D).astype(np.float32)
+        nk = np.full((2, 1, KVH, D), 55.0, np.float32)
+        nv = np.full((2, 1, KVH, D), 66.0, np.float32)
+        out, kc2, vc2 = F.block_multihead_attention(
+            paddle.to_tensor(q), paddle.to_tensor(kc), paddle.to_tensor(vc),
+            paddle.to_tensor(tables), paddle.to_tensor(lens),
+            new_k=paddle.to_tensor(nk), new_v=paddle.to_tensor(nv))
+        o = out.numpy()
+        assert np.isfinite(o).all()
+        np.testing.assert_allclose(o[0], 0.0)          # padded row -> 0
+        kc2 = kc2.numpy()
+        # row 1 wrote at logical pos 2 -> block 3 offset 2
+        np.testing.assert_allclose(kc2[3, 2], 55.0)
+        # no other slot of any block got the 55 write (no wrap into
+        # blocks 1/2/4 from the padded row)
+        mask = np.ones_like(kc2, bool)
+        mask[3, 2] = False
+        assert not np.any(kc2[mask] == 55.0)
