@@ -1,0 +1,239 @@
+"""HTTP KV store + master rendezvous for multi-node launch.
+
+Reference: python/paddle/distributed/launch/utils/kv_server.py (KVServer:
+a threaded HTTP server holding a scoped key/value dict), wired as the
+built-in HTTPMaster at controllers/master.py:87 — peers register under a
+prefix, poll until everyone arrived, then proceed (ETCDMaster is the etcd
+variant; etcd is out of scope here).
+
+TPU-native role: jax.distributed's coordinator handles the PJRT-level
+rendezvous, so this KV layer only covers the *launcher*'s needs — peer
+discovery before the coordinator exists, a job-level barrier, and
+heartbeat-based failure detection for the elastic restart policy
+(fleet/elastic/manager.py:124 lease analog).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+__all__ = ["KVServer", "KVClient", "sync_peers", "Heartbeat"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-kv/1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _store(self):
+        return self.server.kv, self.server.lock
+
+    def do_PUT(self):
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        if self.headers.get("X-KV-Stamp") == "server":
+            # server-side timestamping: lease-style keys must not trust
+            # the writer's clock (cross-host skew would fake death)
+            value = repr(time.time()).encode()
+        kv, lock = self._store()
+        with lock:
+            kv[self.path] = value
+        self.send_response(200)
+        self.end_headers()
+
+    def do_GET(self):
+        kv, lock = self._store()
+        if self.path.endswith("/"):
+            # prefix scan: GET /prefix/ -> json {path: value}
+            with lock:
+                matches = {k: v.decode("utf-8", "replace")
+                           for k, v in kv.items()
+                           if k.startswith(self.path)}
+            body = json.dumps(matches).encode()
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        with lock:
+            body = kv.get(self.path)
+        if body is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_DELETE(self):
+        kv, lock = self._store()
+        with lock:
+            removed = [k for k in kv if k == self.path
+                       or k.startswith(self.path.rstrip("/") + "/")]
+            for k in removed:
+                del kv[k]
+        self.send_response(200)
+        self.end_headers()
+
+
+class KVServer:
+    """Threaded HTTP KV store (reference KVServer)."""
+
+    def __init__(self, port: int, host: str = "0.0.0.0"):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.kv = {}
+        self._httpd.lock = threading.Lock()
+        self._thread = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+class KVClient:
+    """Client for KVServer (reference launch/utils/kv_client.py)."""
+
+    def __init__(self, endpoint: str):
+        if not endpoint.startswith("http"):
+            endpoint = "http://" + endpoint
+        self.endpoint = endpoint.rstrip("/")
+
+    def _url(self, key: str) -> str:
+        return self.endpoint + ("/" + key.lstrip("/"))
+
+    def put(self, key: str, value, server_stamp: bool = False) -> bool:
+        if isinstance(value, str):
+            value = value.encode()
+        headers = {"X-KV-Stamp": "server"} if server_stamp else {}
+        req = urllib.request.Request(self._url(key), data=value,
+                                     method="PUT", headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def get(self, key: str):
+        try:
+            with urllib.request.urlopen(self._url(key), timeout=5) as r:
+                return r.read().decode()
+        except (urllib.error.URLError, OSError):
+            return None
+
+    def get_prefix(self, prefix: str) -> dict:
+        out = self.get(prefix.rstrip("/") + "/")
+        if out is None:
+            return {}
+        try:
+            return json.loads(out)
+        except json.JSONDecodeError:
+            return {}
+
+    def delete(self, key: str) -> bool:
+        req = urllib.request.Request(self._url(key), method="DELETE")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return r.status == 200
+        except (urllib.error.URLError, OSError):
+            return False
+
+    def wait(self, key: str, timeout: float = 60.0, interval: float = 0.2):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            v = self.get(key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"kv wait timed out on {key!r}")
+
+
+def sync_peers(master: str, node_rank: int, nnodes: int, payload: str = "",
+               job_id: str = "default", timeout: float = 120.0):
+    """HTTPMaster.sync_peers (reference controllers/master.py:87): every
+    node registers its payload under /<job>/<rank>, waits until all nnodes
+    arrived, returns the ordered peer payload list."""
+    client = KVClient(master)
+    prefix = f"/{job_id}"
+    t0 = time.time()
+    # retry registration until the master is reachable — in real cluster
+    # schedulers other nodes routinely start before node 0's server binds
+    while not client.put(f"{prefix}/{node_rank}",
+                         payload or str(node_rank)):
+        if time.time() - t0 > timeout:
+            raise ConnectionError(
+                f"cannot reach launch KV master at {master} "
+                f"within {timeout}s")
+        time.sleep(0.5)
+    want = [f"{prefix}/{r}" for r in range(nnodes)]
+    while time.time() - t0 < timeout:
+        peers = client.get_prefix(prefix)
+        if all(k in peers for k in want):
+            return [peers[k] for k in want]
+        time.sleep(0.3)
+    missing = [k for k in want if k not in client.get_prefix(prefix)]
+    raise TimeoutError(
+        f"sync_peers: ranks {missing} never registered within {timeout}s")
+
+
+class Heartbeat:
+    """Node lease for elastic failure detection (reference
+    fleet/elastic/manager.py etcd3 lease): each node PUTs a timestamp
+    every ``interval``; ``dead_nodes`` reports peers whose heartbeat is
+    older than ``ttl``."""
+
+    def __init__(self, master: str, node_rank: int, job_id: str = "default",
+                 interval: float = 2.0, ttl: float = 10.0):
+        self.client = KVClient(master)
+        self.key = f"/heartbeat/{job_id}/{node_rank}"
+        self.prefix = f"/heartbeat/{job_id}"
+        self.interval = interval
+        self.ttl = ttl
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        def beat():
+            while not self._stop.wait(self.interval):
+                self.client.put(self.key, b"", server_stamp=True)
+
+        self.client.put(self.key, b"", server_stamp=True)
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def dead_nodes(self):
+        """Peers whose (server-stamped) heartbeat lags the freshest one by
+        more than ttl. All comparisons use the SERVER clock, so neither
+        cross-host skew nor this caller's clock can fake a death."""
+        stamps = {}
+        for key, ts in self.client.get_prefix(self.prefix).items():
+            try:
+                stamps[int(key.rsplit("/", 1)[1])] = float(ts)
+            except ValueError:
+                stamps[int(key.rsplit("/", 1)[1])] = float("-inf")
+        if not stamps:
+            return []
+        freshest = max(stamps.values())
+        return sorted(r for r, ts in stamps.items()
+                      if freshest - ts > self.ttl)

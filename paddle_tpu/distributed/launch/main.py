@@ -94,8 +94,28 @@ class _Worker:
 
 def launch(argv=None) -> int:
     """Spawn + monitor the workers; elastic restart up to --max_restarts
-    (reference elastic/manager.py watchdog loop)."""
+    (reference elastic/manager.py watchdog loop). Multi-node: node 0 runs
+    the HTTP KV master (kv_server.py, reference HTTPMaster) on
+    master_port+1; all nodes barrier through sync_peers before spawning."""
     args = _parse(argv)
+    kv = None
+    if args.nnodes > 1:
+        from .kv_server import KVServer, sync_peers
+        host, _, port = args.master.rpartition(":")
+        if not host or not port.isdigit():
+            raise SystemExit(
+                f"--master must be host:port, got {args.master!r}")
+        kv_addr = f"{host}:{int(port) + 1}"
+        try:
+            if args.node_rank == 0:
+                kv = KVServer(int(port) + 1).start()
+            peers = sync_peers(kv_addr, args.node_rank, args.nnodes,
+                               payload=f"node{args.node_rank}")
+        except BaseException:
+            if kv is not None:
+                kv.stop()
+            raise
+        print(f"[launch] {args.nnodes} nodes rendezvoused: {peers}")
     workers: List[_Worker] = [
         _Worker(args, i) for i in range(args.nproc_per_node)]
     for w in workers:
@@ -137,6 +157,8 @@ def launch(argv=None) -> int:
     finally:
         for w in workers:
             w.close()
+        if kv is not None:
+            kv.stop()
     return exit_code
 
 

@@ -184,3 +184,120 @@ class TestDeviceStats:
         log.info("hello from test")
         err = capsys.readouterr().err
         assert "rank 0" in err and "hello from test" in err
+
+
+class TestKVRendezvous:
+    """HTTP KV master + peer sync + heartbeat (reference
+    launch/utils/kv_server.py, controllers/master.py HTTPMaster,
+    fleet/elastic/manager.py lease)."""
+
+    def test_kv_put_get_prefix_delete(self):
+        from paddle_tpu.distributed.launch.kv_server import (KVClient,
+                                                             KVServer)
+        srv = KVServer(0).start()
+        try:
+            c = KVClient(f"127.0.0.1:{srv.port}")
+            assert c.put("/job/0", "alpha")
+            assert c.put("/job/1", "beta")
+            assert c.get("/job/0") == "alpha"
+            peers = c.get_prefix("/job")
+            assert peers == {"/job/0": "alpha", "/job/1": "beta"}
+            assert c.delete("/job")
+            assert c.get_prefix("/job") == {}
+            assert c.get("/job/0") is None
+        finally:
+            srv.stop()
+
+    def test_sync_peers_barrier(self):
+        import threading
+        from paddle_tpu.distributed.launch.kv_server import (KVServer,
+                                                             sync_peers)
+        srv = KVServer(0).start()
+        addr = f"127.0.0.1:{srv.port}"
+        results = {}
+
+        def node(rank):
+            results[rank] = sync_peers(addr, rank, 3,
+                                       payload=f"host{rank}:900{rank}",
+                                       job_id="sync_test")
+
+        try:
+            threads = [threading.Thread(target=node, args=(r,))
+                       for r in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=30)
+            for r in range(3):
+                assert results[r] == ["host0:9000", "host1:9001",
+                                      "host2:9002"]
+        finally:
+            srv.stop()
+
+    def test_heartbeat_detects_dead_node(self):
+        import time
+        from paddle_tpu.distributed.launch.kv_server import (Heartbeat,
+                                                             KVClient,
+                                                             KVServer)
+        srv = KVServer(0).start()
+        addr = f"127.0.0.1:{srv.port}"
+        try:
+            hb0 = Heartbeat(addr, 0, job_id="hbtest", interval=0.1,
+                            ttl=0.5).start()
+            # node 1 heartbeats once then dies
+            KVClient(addr).put("/heartbeat/hbtest/1", b"", server_stamp=True)
+            time.sleep(0.8)
+            assert hb0.dead_nodes() == [1]
+            hb0.stop()
+        finally:
+            srv.stop()
+
+    def test_wait_timeout(self):
+        import pytest
+        from paddle_tpu.distributed.launch.kv_server import (KVClient,
+                                                             KVServer)
+        srv = KVServer(0).start()
+        try:
+            c = KVClient(f"127.0.0.1:{srv.port}")
+            with pytest.raises(TimeoutError):
+                c.wait("/never", timeout=0.5, interval=0.1)
+            c.put("/soon", "x")
+            assert c.wait("/soon", timeout=1) == "x"
+        finally:
+            srv.stop()
+
+
+    def test_sync_peers_tolerates_late_master(self):
+        import threading
+        import time
+        from paddle_tpu.distributed.launch.kv_server import (KVServer,
+                                                             sync_peers)
+        import socket
+        sock = socket.socket()
+        sock.bind(("127.0.0.1", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        addr = f"127.0.0.1:{port}"
+        holder = {}
+
+        def late_start():
+            time.sleep(0.8)
+            holder["srv"] = KVServer(port).start()
+            sync_peers(addr, 0, 2, job_id="late")
+
+        t = threading.Thread(target=late_start)
+        t.start()
+        try:
+            # registers before the server exists -> must retry, not raise
+            peers = sync_peers(addr, 1, 2, job_id="late", timeout=15)
+            assert len(peers) == 2
+        finally:
+            t.join(timeout=20)
+            holder["srv"].stop()
+
+    def test_launch_rejects_bad_master(self):
+        import pytest
+        from paddle_tpu.distributed.launch.main import launch
+        with pytest.raises(SystemExit):
+            launch(["--nnodes", "2", "--master", "no-port-here",
+                    "script.py"])
