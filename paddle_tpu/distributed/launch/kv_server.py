@@ -229,9 +229,13 @@ class Heartbeat:
         stamps = {}
         for key, ts in self.client.get_prefix(self.prefix).items():
             try:
-                stamps[int(key.rsplit("/", 1)[1])] = float(ts)
+                rank = int(key.rsplit("/", 1)[1])
             except ValueError:
-                stamps[int(key.rsplit("/", 1)[1])] = float("-inf")
+                continue  # non-rank key under the prefix: not a node
+            try:
+                stamps[rank] = float(ts)
+            except ValueError:
+                stamps[rank] = float("-inf")  # garbage stamp = stale
         if not stamps:
             return []
         freshest = max(stamps.values())

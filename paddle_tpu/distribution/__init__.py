@@ -205,8 +205,13 @@ class Categorical(Distribution):
     def log_prob(self, value):
         def f(logits, v):
             logp = jax.nn.log_softmax(logits, axis=-1)
-            return jnp.take_along_axis(
-                logp, v[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            v = v.astype(jnp.int32)
+            # value and batch dims broadcast against each other (value may
+            # add leading sample dims, or be size-1 against the batch)
+            bshape = jnp.broadcast_shapes(v.shape, logp.shape[:-1])
+            logp = jnp.broadcast_to(logp, bshape + logp.shape[-1:])
+            v = jnp.broadcast_to(v, bshape)
+            return jnp.take_along_axis(logp, v[..., None], axis=-1)[..., 0]
         return dispatch.call("categorical_log_prob", f,
                              [self.logits, _t(value)])
 
@@ -261,7 +266,12 @@ class Bernoulli(Distribution):
 
 def kl_divergence(p: Distribution, q: Distribution):
     """reference distribution/kl.py:34 registry; closed forms for the
-    matching pairs, Monte-Carlo fallback otherwise not provided."""
+    registered pairs (register_kl in families.py), Monte-Carlo fallback
+    otherwise not provided."""
+    from .families import _lookup_kl
+    fn = _lookup_kl(p, q)
+    if fn is not None:
+        return fn(p, q)
     if isinstance(p, Normal) and isinstance(q, Normal):
         def f(l1, s1, l2, s2):
             var1, var2 = s1 * s1, s2 * s2
@@ -299,3 +309,7 @@ def kl_divergence(p: Distribution, q: Distribution):
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "kl_divergence"]
+
+from .families import *  # noqa: E402,F401,F403
+from . import families as _families  # noqa: E402
+__all__ += _families.__all__

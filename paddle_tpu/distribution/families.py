@@ -1,0 +1,1120 @@
+"""The full distribution family set + transforms + KL registry.
+
+Reference: python/paddle/distribution/{beta,binomial,cauchy,chi2,
+continuous_bernoulli,dirichlet,exponential,exponential_family,gamma,
+geometric,gumbel,independent,laplace,lognormal,multinomial,
+multivariate_normal,poisson,student_t,transform,
+transformed_distribution}.py and kl.py (register_kl:63 pairwise registry).
+
+TPU-native: every sampler is a `jax.random.*` draw keyed by the
+framework's counter-based PRNG (reproducible under `paddle.seed`, safe
+under vmap/jit); log_prob/entropy are jnp expressions through
+`dispatch.call`, so they differentiate and fuse. `rsample` is provided
+exactly where the reference provides reparameterized gradients.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.generator import next_key
+from ..core.tensor import Tensor, as_tensor
+from . import (Bernoulli, Categorical, Distribution, Normal,  # noqa: F401
+               Uniform, _t)
+
+
+def _call(name, f, tensors, no_grad=False):
+    if no_grad:
+        with dispatch.no_grad():
+            return dispatch.call(name, f, tensors)
+    return dispatch.call(name, f, tensors)
+
+
+class ExponentialFamily(Distribution):
+    """Base for natural-parameter families (reference
+    exponential_family.py). entropy() via the Bregman identity when a
+    subclass provides natural params + log normalizer."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural):
+        raise NotImplementedError
+
+
+class Exponential(ExponentialFamily):
+    """reference exponential.py — rate parameterization."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return _call("exp_mean", lambda r: 1.0 / r, [self.rate])
+
+    @property
+    def variance(self):
+        return _call("exp_var", lambda r: 1.0 / (r * r), [self.rate])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(r):
+            return jax.random.exponential(
+                key, shape + r.shape, dtype=r.dtype) / r
+
+        return _call("exp_sample", f, [self.rate], no_grad=True)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(r):
+            return jax.random.exponential(
+                key, shape + r.shape, dtype=r.dtype) / r
+
+        return _call("exp_rsample", f, [self.rate])
+
+    def log_prob(self, value):
+        return _call("exp_log_prob",
+                     lambda r, v: jnp.where(v >= 0, jnp.log(r) - r * v,
+                                            -jnp.inf),
+                     [self.rate, _t(value)])
+
+    def entropy(self):
+        return _call("exp_entropy", lambda r: 1.0 - jnp.log(r), [self.rate])
+
+    def cdf(self, value):
+        return _call("exp_cdf",
+                     lambda r, v: jnp.clip(1 - jnp.exp(-r * v), 0, 1),
+                     [self.rate, _t(value)])
+
+    def icdf(self, value):
+        return _call("exp_icdf", lambda r, u: -jnp.log1p(-u) / r,
+                     [self.rate, _t(value)])
+
+
+class Gamma(ExponentialFamily):
+    """reference gamma.py — (concentration, rate)."""
+
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.concentration._data.shape, self.rate._data.shape)))
+
+    @property
+    def mean(self):
+        return _call("gamma_mean", lambda a, r: a / r,
+                     [self.concentration, self.rate])
+
+    @property
+    def variance(self):
+        return _call("gamma_var", lambda a, r: a / (r * r),
+                     [self.concentration, self.rate])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(a, r):
+            a_b, r_b = jnp.broadcast_arrays(a, r)
+            return jax.random.gamma(key, a_b, shape + a_b.shape,
+                                    dtype=a.dtype) / r_b
+
+        return _call("gamma_rsample", f, [self.concentration, self.rate])
+
+    def log_prob(self, value):
+        def f(a, r, v):
+            return (a * jnp.log(r) + (a - 1) * jnp.log(v) - r * v
+                    - jax.scipy.special.gammaln(a))
+
+        return _call("gamma_log_prob", f,
+                     [self.concentration, self.rate, _t(value)])
+
+    def entropy(self):
+        def f(a, r):
+            return (a - jnp.log(r) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * jax.scipy.special.digamma(a))
+
+        return _call("gamma_entropy", f, [self.concentration, self.rate])
+
+
+class Chi2(Gamma):
+    """reference chi2.py — Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(
+            dispatch.call("chi2_a", lambda d: d / 2.0, [self.df]),
+            as_tensor(np.float32(0.5)))
+
+
+class Beta(ExponentialFamily):
+    """reference beta.py — (alpha, beta)."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.alpha._data.shape, self.beta._data.shape)))
+
+    @property
+    def mean(self):
+        return _call("beta_mean", lambda a, b: a / (a + b),
+                     [self.alpha, self.beta])
+
+    @property
+    def variance(self):
+        return _call("beta_var",
+                     lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)),
+                     [self.alpha, self.beta])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(a, b):
+            a_b, b_b = jnp.broadcast_arrays(a, b)
+            return jax.random.beta(key, a_b, b_b, shape + a_b.shape,
+                                   dtype=a.dtype)
+
+        return _call("beta_rsample", f, [self.alpha, self.beta])
+
+    def log_prob(self, value):
+        def f(a, b, v):
+            return ((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                    - jax.scipy.special.betaln(a, b))
+
+        return _call("beta_log_prob", f, [self.alpha, self.beta, _t(value)])
+
+    def entropy(self):
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            return (jax.scipy.special.betaln(a, b)
+                    - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return _call("beta_entropy", f, [self.alpha, self.beta])
+
+
+class Dirichlet(ExponentialFamily):
+    """reference dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        shp = tuple(self.concentration.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return _call("dir_mean",
+                     lambda c: c / jnp.sum(c, -1, keepdims=True),
+                     [self.concentration])
+
+    @property
+    def variance(self):
+        def f(c):
+            c0 = jnp.sum(c, -1, keepdims=True)
+            m = c / c0
+            return m * (1 - m) / (c0 + 1)
+
+        return _call("dir_var", f, [self.concentration])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(c):
+            return jax.random.dirichlet(key, c, shape + c.shape[:-1],
+                                        dtype=c.dtype)
+
+        return _call("dir_rsample", f, [self.concentration])
+
+    def log_prob(self, value):
+        def f(c, v):
+            return (jnp.sum((c - 1) * jnp.log(v), -1)
+                    + jax.scipy.special.gammaln(jnp.sum(c, -1))
+                    - jnp.sum(jax.scipy.special.gammaln(c), -1))
+
+        return _call("dir_log_prob", f, [self.concentration, _t(value)])
+
+    def entropy(self):
+        def f(c):
+            gl, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            return (jnp.sum(gl(c), -1) - gl(c0)
+                    + (c0 - k) * dg(c0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+
+        return _call("dir_entropy", f, [self.concentration])
+
+
+class Laplace(Distribution):
+    """reference laplace.py — (loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape)))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _call("lap_var", lambda s: 2 * s * s, [self.scale])
+
+    @property
+    def stddev(self):
+        return _call("lap_std", lambda s: math.sqrt(2.0) * s, [self.scale])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, s):
+            l_b, s_b = jnp.broadcast_arrays(l, s)
+            eps = jax.random.laplace(key, shape + l_b.shape, dtype=l.dtype)
+            return l_b + s_b * eps
+
+        return _call("lap_rsample", f, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        return _call("lap_log_prob",
+                     lambda l, s, v: -jnp.abs(v - l) / s - jnp.log(2 * s),
+                     [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        return _call("lap_entropy", lambda s: 1 + jnp.log(2 * s),
+                     [self.scale])
+
+    def cdf(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return _call("lap_cdf", f, [self.loc, self.scale, _t(value)])
+
+    def icdf(self, value):
+        def f(l, s, u):
+            return l - s * jnp.sign(u - 0.5) * jnp.log1p(-2 * jnp.abs(u - 0.5))
+
+        return _call("lap_icdf", f, [self.loc, self.scale, _t(value)])
+
+
+class Cauchy(Distribution):
+    """reference cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape)))
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, s):
+            l_b, s_b = jnp.broadcast_arrays(l, s)
+            eps = jax.random.cauchy(key, shape + l_b.shape, dtype=l.dtype)
+            return l_b + s_b * eps
+
+        return _call("cauchy_rsample", f, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -jnp.log(math.pi * s * (1 + z * z))
+
+        return _call("cauchy_log_prob", f,
+                     [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        return _call("cauchy_entropy",
+                     lambda s: jnp.log(4 * math.pi * s), [self.scale])
+
+    def cdf(self, value):
+        def f(l, s, v):
+            return jnp.arctan((v - l) / s) / math.pi + 0.5
+
+        return _call("cauchy_cdf", f, [self.loc, self.scale, _t(value)])
+
+
+class Gumbel(Distribution):
+    """reference gumbel.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape)))
+
+    @property
+    def mean(self):
+        return _call("gumbel_mean",
+                     lambda l, s: l + np.float32(np.euler_gamma) * s,
+                     [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        return _call("gumbel_var",
+                     lambda s: (math.pi ** 2 / 6) * s * s, [self.scale])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, s):
+            l_b, s_b = jnp.broadcast_arrays(l, s)
+            eps = jax.random.gumbel(key, shape + l_b.shape, dtype=l.dtype)
+            return l_b + s_b * eps
+
+        return _call("gumbel_rsample", f, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return _call("gumbel_log_prob", f,
+                     [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        return _call("gumbel_entropy",
+                     lambda s: jnp.log(s) + 1 + np.float32(np.euler_gamma),
+                     [self.scale])
+
+
+class LogNormal(Distribution):
+    """reference lognormal.py — exp(Normal(loc, scale))."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape)))
+
+    @property
+    def mean(self):
+        return _call("lognorm_mean",
+                     lambda l, s: jnp.exp(l + s * s / 2),
+                     [self.loc, self.scale])
+
+    @property
+    def variance(self):
+        def f(l, s):
+            return (jnp.exp(s * s) - 1) * jnp.exp(2 * l + s * s)
+
+        return _call("lognorm_var", f, [self.loc, self.scale])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, s):
+            l_b, s_b = jnp.broadcast_arrays(l, s)
+            eps = jax.random.normal(key, shape + l_b.shape, dtype=l.dtype)
+            return jnp.exp(l_b + s_b * eps)
+
+        return _call("lognorm_rsample", f, [self.loc, self.scale])
+
+    def log_prob(self, value):
+        def f(l, s, v):
+            logv = jnp.log(v)
+            return (-((logv - l) ** 2) / (2 * s * s) - logv
+                    - jnp.log(s) - 0.5 * math.log(2 * math.pi))
+
+        return _call("lognorm_log_prob", f,
+                     [self.loc, self.scale, _t(value)])
+
+    def entropy(self):
+        return _call("lognorm_entropy",
+                     lambda l, s: 0.5 + 0.5 * math.log(2 * math.pi)
+                     + jnp.log(s) + l,
+                     [self.loc, self.scale])
+
+
+class Geometric(Distribution):
+    """reference geometric.py — #failures before first success, support
+    {0, 1, ...}, parameterized by success prob."""
+
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(tuple(self.probs.shape))
+
+    @property
+    def mean(self):
+        return _call("geom_mean", lambda p: (1 - p) / p, [self.probs])
+
+    @property
+    def variance(self):
+        return _call("geom_var", lambda p: (1 - p) / (p * p), [self.probs])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, shape + p.shape, dtype=p.dtype,
+                                   minval=1e-12)
+            return jnp.floor(jnp.log(u) / jnp.log1p(-p))
+
+        return _call("geom_sample", f, [self.probs], no_grad=True)
+
+    def log_prob(self, value):
+        return _call("geom_log_prob",
+                     lambda p, v: v * jnp.log1p(-p) + jnp.log(p),
+                     [self.probs, _t(value)])
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(q) + p * jnp.log(p)) / p
+
+        return _call("geom_entropy", f, [self.probs])
+
+
+class Poisson(Distribution):
+    """reference poisson.py — rate parameterization."""
+
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(tuple(self.rate.shape))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(r):
+            return jax.random.poisson(key, r, shape + r.shape).astype(
+                r.dtype)
+
+        return _call("poisson_sample", f, [self.rate], no_grad=True)
+
+    def log_prob(self, value):
+        def f(r, v):
+            return (v * jnp.log(r) - r
+                    - jax.scipy.special.gammaln(v + 1))
+
+        return _call("poisson_log_prob", f, [self.rate, _t(value)])
+
+
+class Binomial(Distribution):
+    """reference binomial.py — (total_count, probs)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.total_count._data.shape, self.probs._data.shape)))
+
+    @property
+    def mean(self):
+        return _call("binom_mean", lambda n, p: n * p,
+                     [self.total_count, self.probs])
+
+    @property
+    def variance(self):
+        return _call("binom_var", lambda n, p: n * p * (1 - p),
+                     [self.total_count, self.probs])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(n, p):
+            n_b, p_b = jnp.broadcast_arrays(n, p)
+            return jax.random.binomial(key, n_b, p_b,
+                                       shape + n_b.shape).astype(p.dtype)
+
+        return _call("binom_sample", f, [self.total_count, self.probs],
+                     no_grad=True)
+
+    def log_prob(self, value):
+        def f(n, p, v):
+            gl = jax.scipy.special.gammaln
+            logc = gl(n + 1) - gl(v + 1) - gl(n - v + 1)
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        return _call("binom_log_prob", f,
+                     [self.total_count, self.probs, _t(value)])
+
+
+class Multinomial(Distribution):
+    """reference multinomial.py — (total_count, probs over last axis)."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        shp = tuple(self.probs.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return _call("multi_mean", lambda p: self.total_count * p,
+                     [self.probs])
+
+    @property
+    def variance(self):
+        return _call("multi_var",
+                     lambda p: self.total_count * p * (1 - p),
+                     [self.probs])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+        n = self.total_count
+
+        def f(p):
+            return jax.random.multinomial(
+                key, jnp.asarray(float(n), p.dtype), p,
+                shape=shape + p.shape).astype(p.dtype)
+
+        return _call("multi_sample", f, [self.probs], no_grad=True)
+
+    def log_prob(self, value):
+        n = float(self.total_count)
+
+        def f(p, v):
+            gl = jax.scipy.special.gammaln
+            return (gl(n + 1) - jnp.sum(gl(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+
+        return _call("multi_log_prob", f, [self.probs, _t(value)])
+
+
+class StudentT(Distribution):
+    """reference student_t.py — (df, loc, scale)."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(tuple(jnp.broadcast_shapes(
+            self.df._data.shape, self.loc._data.shape,
+            self.scale._data.shape)))
+
+    @property
+    def mean(self):
+        return _call("t_mean",
+                     lambda d, l: jnp.where(d > 1, l, jnp.nan),
+                     [self.df, self.loc])
+
+    @property
+    def variance(self):
+        def f(d, s):
+            v = s * s * d / (d - 2)
+            return jnp.where(d > 2, v, jnp.where(d > 1, jnp.inf, jnp.nan))
+
+        return _call("t_var", f, [self.df, self.scale])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(d, l, s):
+            d_b, l_b, s_b = jnp.broadcast_arrays(d, l, s)
+            eps = jax.random.t(key, d_b, shape + d_b.shape, dtype=l.dtype)
+            return l_b + s_b * eps
+
+        return _call("t_rsample", f, [self.df, self.loc, self.scale])
+
+    def log_prob(self, value):
+        def f(d, l, s, v):
+            gl = jax.scipy.special.gammaln
+            z = (v - l) / s
+            return (gl((d + 1) / 2) - gl(d / 2)
+                    - 0.5 * jnp.log(d * math.pi) - jnp.log(s)
+                    - (d + 1) / 2 * jnp.log1p(z * z / d))
+
+        return _call("t_log_prob", f,
+                     [self.df, self.loc, self.scale, _t(value)])
+
+
+class MultivariateNormal(Distribution):
+    """reference multivariate_normal.py — (loc, covariance_matrix)."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = _t(loc)
+        if (covariance_matrix is None) == (scale_tril is None):
+            raise ValueError(
+                "provide exactly one of covariance_matrix / scale_tril")
+        if scale_tril is not None:
+            self.scale_tril = _t(scale_tril)
+            self.covariance_matrix = dispatch.call(
+                "mvn_cov", lambda L: L @ jnp.swapaxes(L, -1, -2),
+                [self.scale_tril])
+        else:
+            self.covariance_matrix = _t(covariance_matrix)
+            self.scale_tril = dispatch.call(
+                "mvn_chol", jnp.linalg.cholesky, [self.covariance_matrix])
+        shp = tuple(self.loc.shape)
+        super().__init__(shp[:-1], shp[-1:])
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return _call("mvn_var",
+                     lambda c: jnp.diagonal(c, axis1=-2, axis2=-1),
+                     [self.covariance_matrix])
+
+    def sample(self, shape=()):
+        with dispatch.no_grad():
+            return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(l, L):
+            eps = jax.random.normal(key, shape + l.shape, dtype=l.dtype)
+            return l + jnp.einsum("...ij,...j->...i", L, eps)
+
+        return _call("mvn_rsample", f, [self.loc, self.scale_tril])
+
+    def log_prob(self, value):
+        def f(l, L, v):
+            d = l.shape[-1]
+            diff = v - l
+            sol = jax.scipy.linalg.solve_triangular(L, diff[..., None],
+                                                    lower=True)[..., 0]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                             -1)
+            return (-0.5 * jnp.sum(sol * sol, -1) - logdet
+                    - 0.5 * d * math.log(2 * math.pi))
+
+        return _call("mvn_log_prob", f,
+                     [self.loc, self.scale_tril, _t(value)])
+
+    def entropy(self):
+        def f(L):
+            d = L.shape[-1]
+            logdet = jnp.sum(jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)),
+                             -1)
+            return 0.5 * d * (1 + math.log(2 * math.pi)) + logdet
+
+        return _call("mvn_entropy", f, [self.scale_tril])
+
+
+class ContinuousBernoulli(Distribution):
+    """reference continuous_bernoulli.py — CB(probs) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _t(probs)
+        self._lims = lims
+        super().__init__(tuple(self.probs.shape))
+
+    def _log_norm(self, p):
+        # C(p) = 2 atanh(1-2p) / (1-2p), with the p=0.5 limit -> log 2
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        c = (jnp.log(jnp.abs(jnp.arctanh(1 - 2 * safe)))
+             + jnp.log(2.0) - jnp.log(jnp.abs(1 - 2 * safe)))
+        # Taylor around 0.5: log C ~ log 2 + 4/3 (p-1/2)^2
+        taylor = math.log(2.0) + 4.0 / 3.0 * (p - 0.5) ** 2
+        return jnp.where(near, taylor, c)
+
+    def log_prob(self, value):
+        def f(p, v):
+            return (v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                    + self._log_norm(p))
+
+        return _call("cb_log_prob", f, [self.probs, _t(value)])
+
+    def sample(self, shape=()):
+        key = next_key()
+        shape = tuple(shape)
+
+        def f(p):
+            u = jax.random.uniform(key, shape + p.shape, dtype=p.dtype,
+                                   minval=1e-6, maxval=1 - 1e-6)
+            # inverse CDF: x = (log1p(u(p/(1-p) - 1) ... ) standard CB icdf
+            q = 1 - p
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe_p = jnp.where(near, 0.25, p)
+            safe_q = 1 - safe_p
+            x = (jnp.log1p(u * (safe_p / safe_q - 1))
+                 / (jnp.log(safe_p) - jnp.log(safe_q)))
+            return jnp.where(near, u, x)
+
+        return _call("cb_sample", f, [self.probs], no_grad=True)
+
+    @property
+    def mean(self):
+        def f(p):
+            near = (p > self._lims[0]) & (p < self._lims[1])
+            safe = jnp.where(near, 0.25, p)
+            m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+            return jnp.where(near, 0.5, m)
+
+        return _call("cb_mean", f, [self.probs])
+
+
+class Independent(Distribution):
+    """reference independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = base.batch_shape
+        super().__init__(bshape[:len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:]
+                         + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return dispatch.call(
+            "independent_sum",
+            lambda a: jnp.sum(a, axis=tuple(range(a.ndim - self.rank,
+                                                  a.ndim))), [lp])
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return dispatch.call(
+            "independent_ent_sum",
+            lambda a: jnp.sum(a, axis=tuple(range(a.ndim - self.rank,
+                                                  a.ndim))), [ent])
+
+
+# --------------------------- transforms ------------------------------
+class Transform:
+    """reference transform.py Transform — forward/inverse +
+    log|det J|."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+    def inverse_log_det_jacobian(self, y):
+        neg = self.forward_log_det_jacobian(self.inverse(y))
+        return dispatch.call("t_neg", lambda a: -a, [neg])
+
+    def __call__(self, x):
+        return self.forward(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def forward(self, x):
+        return _call("affine_fwd", lambda l, s, x: l + s * x,
+                     [self.loc, self.scale, _t(x)])
+
+    def inverse(self, y):
+        return _call("affine_inv", lambda l, s, y: (y - l) / s,
+                     [self.loc, self.scale, _t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        def f(s, x):
+            return jnp.broadcast_to(jnp.log(jnp.abs(s)), x.shape)
+
+        return _call("affine_ldj", f, [self.scale, _t(x)])
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return _call("expt_fwd", jnp.exp, [_t(x)])
+
+    def inverse(self, y):
+        return _call("expt_inv", jnp.log, [_t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return _t(x)
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return _call("sig_fwd", jax.nn.sigmoid, [_t(x)])
+
+    def inverse(self, y):
+        return _call("sig_inv", lambda y: jnp.log(y) - jnp.log1p(-y),
+                     [_t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return _call("sig_ldj",
+                     lambda x: -jax.nn.softplus(-x) - jax.nn.softplus(x),
+                     [_t(x)])
+
+
+class TanhTransform(Transform):
+    def forward(self, x):
+        return _call("tanh_fwd", jnp.tanh, [_t(x)])
+
+    def inverse(self, y):
+        return _call("tanh_inv", jnp.arctanh, [_t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return _call("tanh_ldj",
+                     lambda x: 2 * (math.log(2.0) - x
+                                    - jax.nn.softplus(-2 * x)), [_t(x)])
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def forward(self, x):
+        return _call("pow_fwd", lambda p, x: jnp.power(x, p),
+                     [self.power, _t(x)])
+
+    def inverse(self, y):
+        return _call("pow_inv", lambda p, y: jnp.power(y, 1.0 / p),
+                     [self.power, _t(y)])
+
+    def forward_log_det_jacobian(self, x):
+        return _call("pow_ldj",
+                     lambda p, x: jnp.log(jnp.abs(p * jnp.power(x, p - 1))),
+                     [self.power, _t(x)])
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def forward(self, x):
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t.inverse(y)
+        return y
+
+    def forward_log_det_jacobian(self, x):
+        total = None
+        for t in self.transforms:
+            ldj = t.forward_log_det_jacobian(x)
+            total = ldj if total is None else dispatch.call(
+                "chain_add", lambda a, b: a + b, [total, ldj])
+            x = t.forward(x)
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """reference transformed_distribution.py."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = _t(value)
+        lp_terms = []
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp_terms.append(t.forward_log_det_jacobian(x))
+            y = x
+        lp = self.base.log_prob(y)
+        for term in lp_terms:
+            lp = dispatch.call("td_sub", lambda a, b: a - b, [lp, term])
+        return lp
+
+
+# --------------------------- KL registry ------------------------------
+_KL_REGISTRY = {}
+
+
+def register_kl(type_p, type_q):
+    """reference kl.py:63 — decorator registering a closed-form KL."""
+
+    def deco(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+
+    return deco
+
+
+def _lookup_kl(p, q):
+    # most-derived match first (reference dispatches on exact class then
+    # walks the MRO)
+    best = None
+    for (tp, tq), fn in _KL_REGISTRY.items():
+        if isinstance(p, tp) and isinstance(q, tq):
+            if best is None or (issubclass(tp, best[0])
+                                and issubclass(tq, best[1])):
+                best = (tp, tq, fn)
+    return best[2] if best else None
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp(p, q):
+    return _call("kl_exp",
+                 lambda r1, r2: jnp.log(r1) - jnp.log(r2) + r2 / r1 - 1,
+                 [p.rate, q.rate])
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def f(a1, r1, a2, r2):
+        gl, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        return ((a1 - a2) * dg(a1) - gl(a1) + gl(a2)
+                + a2 * (jnp.log(r1) - jnp.log(r2))
+                + a1 * (r2 - r1) / r1)
+
+    return _call("kl_gamma", f,
+                 [p.concentration, p.rate, q.concentration, q.rate])
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def f(a1, b1, a2, b2):
+        gl, dg = jax.scipy.special.betaln, jax.scipy.special.digamma
+        return (gl(a2, b2) - gl(a1, b1)
+                + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+    return _call("kl_beta", f, [p.alpha, p.beta, q.alpha, q.beta])
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def f(c1, c2):
+        gl, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        s1 = jnp.sum(c1, -1)
+        return (gl(s1) - jnp.sum(gl(c1), -1)
+                - jax.scipy.special.gammaln(jnp.sum(c2, -1))
+                + jnp.sum(gl(c2), -1)
+                + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))
+
+    return _call("kl_dirichlet", f, [p.concentration, q.concentration])
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    def f(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1)
+
+    return _call("kl_laplace", f, [p.loc, p.scale, q.loc, q.scale])
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def f(p1, p2):
+        return ((1 - p1) / p1 * (jnp.log1p(-p1) - jnp.log1p(-p2))
+                + jnp.log(p1) - jnp.log(p2))
+
+    return _call("kl_geom", f, [p.probs, q.probs])
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _call("kl_poisson",
+                 lambda r1, r2: r1 * (jnp.log(r1) - jnp.log(r2))
+                 + r2 - r1,
+                 [p.rate, q.rate])
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    def f(l1, L1, l2, L2):
+        d = l1.shape[-1]
+        M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+        tr = jnp.sum(M * M, axis=(-2, -1))
+        diff = l2 - l1
+        sol = jax.scipy.linalg.solve_triangular(L2, diff[..., None],
+                                                lower=True)[..., 0]
+        maha = jnp.sum(sol * sol, -1)
+        logdet = (jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), -1)
+                  - jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)),
+                            -1))
+        return 0.5 * (tr + maha - d) + logdet
+
+    return _call("kl_mvn", f, [p.loc, p.scale_tril, q.loc, q.scale_tril])
+
+
+__all__ = [
+    "ExponentialFamily", "Exponential", "Gamma", "Chi2", "Beta",
+    "Dirichlet", "Laplace", "Cauchy", "Gumbel", "LogNormal", "Geometric",
+    "Poisson", "Binomial", "Multinomial", "StudentT",
+    "MultivariateNormal", "ContinuousBernoulli", "Independent",
+    "Transform", "AffineTransform", "ExpTransform", "SigmoidTransform",
+    "TanhTransform", "PowerTransform", "ChainTransform",
+    "TransformedDistribution", "register_kl",
+]

