@@ -156,17 +156,7 @@ def add(x, y, name=None):
             raise ValueError("shape mismatch in sparse.add")
         # result STRUCTURE (indices + per-input positions) is computed
         # eagerly; the VALUES go through the tape
-        merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
-            (jnp.concatenate([jnp.zeros_like(x._data),
-                              jnp.zeros_like(y._data)]),
-             jnp.concatenate([x._coo_indices, y._coo_indices])),
-            shape=x._coo_shape))
-        res_idx = np.asarray(merged.indices)
-        lookup = {tuple(r): i for i, r in enumerate(res_idx)}
-        pos_x = jnp.asarray([lookup[tuple(r)]
-                             for r in np.asarray(x._coo_indices)])
-        pos_y = jnp.asarray([lookup[tuple(r)]
-                             for r in np.asarray(y._coo_indices)])
+        res_idx, pos_x, pos_y = _merge_patterns(x, y)
         n_out = res_idx.shape[0]
 
         def f(va, vb):
@@ -192,3 +182,252 @@ def is_sparse(x) -> bool:
 
 __all__ = ["SparseCooTensor", "sparse_coo_tensor", "sparse_csr_tensor",
            "to_dense", "matmul", "add", "relu", "is_sparse"]
+
+
+# ---------------------------------------------------------------------------
+# Unary value-ops: apply elementwise to stored values, keep the pattern
+# (reference python/paddle/sparse/unary.py — each is a distinct phi
+# sparse kernel; here one generic lowering, XLA fuses the elementwise op)
+# ---------------------------------------------------------------------------
+def _unary(op_name, jfn):
+    def op(x, name=None):  # name: paddle API convention, display only
+        if isinstance(x, SparseCooTensor):
+            out = dispatch.call(f"sparse_{op_name}", jfn, [x])
+            return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+        return dispatch.call(op_name, jfn, [as_tensor(x)])
+
+    op.__name__ = op_name
+    op.__doc__ = (f"sparse.{op_name}: elementwise {op_name} over stored "
+                  f"values (reference python/paddle/sparse/unary.py "
+                  f"{op_name}).")
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001 - reference name
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+isnan = _unary("isnan", jnp.isnan)
+
+
+def pow(x, factor, name=None):  # noqa: A001 - reference name
+    if isinstance(x, SparseCooTensor):
+        out = dispatch.call("sparse_pow",
+                            lambda v: jnp.power(v, factor), [x])
+        return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+    return dispatch.call("pow", lambda v: jnp.power(v, factor),
+                         [as_tensor(x)])
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.cast expects a sparse tensor")
+    vals = (dispatch.call("sparse_cast",
+                          lambda v: v.astype(value_dtype), [x])
+            if value_dtype is not None else x.values())
+    idx = (np.asarray(x._coo_indices).astype(index_dtype)
+           if index_dtype is not None else x._coo_indices)
+    return SparseCooTensor(idx, vals, x._coo_shape)
+
+
+# ---------------------------------------------------------------------------
+# Binary / structure ops
+# ---------------------------------------------------------------------------
+def _merge_patterns(x, y):
+    """Union pattern + per-input scatter positions (host; the pattern is
+    structure, not data)."""
+    merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
+        (jnp.concatenate([jnp.zeros_like(x._data),
+                          jnp.zeros_like(y._data)]),
+         jnp.concatenate([x._coo_indices, y._coo_indices])),
+        shape=x._coo_shape))
+    res_idx = np.asarray(merged.indices)
+    lookup = {tuple(r): i for i, r in enumerate(res_idx)}
+    pos_x = jnp.asarray([lookup[tuple(r)]
+                         for r in np.asarray(x._coo_indices)])
+    pos_y = jnp.asarray([lookup[tuple(r)]
+                         for r in np.asarray(y._coo_indices)])
+    return res_idx, pos_x, pos_y
+
+
+def subtract(x, y, name=None):
+    """sparse - sparse over the union pattern (reference binary.py)."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if x._coo_shape != y._coo_shape:
+            raise ValueError("shape mismatch in sparse.subtract")
+        res_idx, pos_x, pos_y = _merge_patterns(x, y)
+        n_out = res_idx.shape[0]
+
+        def f(va, vb):
+            out = jnp.zeros((n_out,), va.dtype)
+            return out.at[pos_x].add(va).at[pos_y].add(-vb)
+
+        vals = dispatch.call("sparse_subtract", f, [x, y])
+        return SparseCooTensor(res_idx, vals, x._coo_shape)
+    return to_dense(x) - to_dense(y)
+
+
+def multiply(x, y, name=None):
+    """Elementwise multiply. sparse*sparse multiplies matching positions
+    (intersection pattern == union with zeros elsewhere); sparse*scalar
+    scales values (reference binary.py multiply)."""
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        if x._coo_shape != y._coo_shape:
+            raise ValueError("shape mismatch in sparse.multiply")
+        res_idx, pos_x, pos_y = _merge_patterns(x, y)
+        n_out = res_idx.shape[0]
+
+        def f(va, vb):
+            a = jnp.zeros((n_out,), va.dtype).at[pos_x].add(va)
+            b = jnp.zeros((n_out,), vb.dtype).at[pos_y].add(vb)
+            return a * b
+
+        vals = dispatch.call("sparse_multiply", f, [x, y])
+        return SparseCooTensor(res_idx, vals, x._coo_shape)
+    if isinstance(x, SparseCooTensor):
+        if isinstance(y, Tensor) and y.size == 1:
+            # grad-tracked scalar: keep it on the tape
+            out = dispatch.call("sparse_scale",
+                                lambda v, s: v * s.reshape(()), [x, y])
+            return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+        if np.isscalar(y):
+            out = dispatch.call("sparse_scale",
+                                lambda v: v * float(y), [x])
+            return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+        return to_dense(x) * to_dense(y)
+    return to_dense(x) * to_dense(y)
+
+
+def divide(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and np.isscalar(y):
+        out = dispatch.call("sparse_div", lambda v: v / float(y), [x])
+        return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+    return to_dense(x) / to_dense(y)
+
+
+def mv(x, vec, name=None):
+    """sparse matrix @ dense vector (reference binary.py mv)."""
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at mask's sparsity pattern — SDDMM
+    (reference binary.py masked_matmul). TPU-native: gather the needed
+    rows/cols and batch the row·col dot products; never materializes the
+    dense product."""
+    if not isinstance(mask, SparseCooTensor):
+        raise TypeError("masked_matmul mask must be sparse")
+    xt = x if isinstance(x, Tensor) else as_tensor(x)
+    yt = y if isinstance(y, Tensor) else as_tensor(y)
+    rows = jnp.asarray(mask._coo_indices[:, 0])
+    cols = jnp.asarray(mask._coo_indices[:, 1])
+
+    def f(a, b):
+        return jnp.einsum("nk,nk->n", a[rows], b[:, cols].T)
+
+    vals = dispatch.call("masked_matmul", f, [xt, yt])
+    return SparseCooTensor(mask._coo_indices, vals, mask._coo_shape)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference multiary.py)."""
+    prod = matmul(x, y)
+    inp = input if isinstance(input, Tensor) else as_tensor(input)
+    return dispatch.call("sparse_addmm",
+                         lambda i, p: beta * i + alpha * p, [inp, prod])
+
+
+def transpose(x, perm, name=None):
+    """Permute sparse dims: permute index columns + reorder (reference
+    unary.py transpose)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.transpose expects a sparse tensor")
+    idx = np.asarray(x._coo_indices)[:, list(perm)]
+    shape = tuple(np.asarray(x._coo_shape)[list(perm)])
+    order = np.lexsort(tuple(idx[:, d] for d in range(idx.shape[1] - 1, -1, -1)))
+    vals = dispatch.call("sparse_transpose_gather",
+                         lambda v: v[jnp.asarray(order)], [x])
+    return SparseCooTensor(idx[order], vals, shape)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    """Sum of stored values over axis (reference unary.py sum). Full
+    reduction returns a dense scalar; axis reduction returns dense."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.sum expects a sparse tensor")
+    if axis is None:
+        return dispatch.call(
+            "sparse_sum_all",
+            lambda v: jnp.sum(v.astype(dtype) if dtype else v), [x])
+    out = to_dense(x)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return out.sum(axis=axis, keepdim=keepdim)
+
+
+def coalesce(x, name=None):
+    """Merge duplicate coordinates (reference unary.py coalesce)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.coalesce expects a sparse tensor")
+    merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
+        (jnp.zeros_like(x._data), x._coo_indices), shape=x._coo_shape))
+    res_idx = np.asarray(merged.indices)
+    lookup = {tuple(r): i for i, r in enumerate(res_idx)}
+    pos = jnp.asarray([lookup[tuple(r)]
+                       for r in np.asarray(x._coo_indices)])
+    n_out = res_idx.shape[0]
+
+    def f(v):
+        return jnp.zeros((n_out,), v.dtype).at[pos].add(v)
+
+    vals = dispatch.call("sparse_coalesce", f, [x])
+    return SparseCooTensor(res_idx, vals, x._coo_shape)
+
+
+def is_same_shape(x, y) -> bool:
+    xs = x._coo_shape if isinstance(x, SparseCooTensor) else tuple(x.shape)
+    ys = y._coo_shape if isinstance(y, SparseCooTensor) else tuple(y.shape)
+    return tuple(xs) == tuple(ys)
+
+
+def reshape(x, shape, name=None):
+    """Reshape the sparse tensor by re-deriving coordinates from flat
+    offsets (reference unary.py reshape)."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.reshape expects a sparse tensor")
+    old = np.asarray(x._coo_shape)
+    idx = np.asarray(x._coo_indices)
+    flat = np.zeros(idx.shape[0], np.int64)
+    for d in range(idx.shape[1]):
+        flat = flat * old[d] + idx[:, d]
+    new = np.asarray(shape)
+    neg = new < 0
+    if neg.any():
+        new = new.copy()
+        new[neg] = int(np.prod(old)) // int(np.prod(new[~neg]))
+    coords = []
+    rem = flat
+    for d in range(len(new) - 1, -1, -1):
+        coords.append(rem % new[d])
+        rem = rem // new[d]
+    new_idx = np.stack(coords[::-1], axis=1)
+    return SparseCooTensor(new_idx, x.values(), tuple(int(s) for s in new))
+
+
+__all__ += ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
+            "atanh", "sqrt", "square", "log1p", "abs", "neg", "expm1",
+            "deg2rad", "rad2deg", "isnan", "pow", "cast", "subtract",
+            "multiply", "divide", "mv", "masked_matmul", "addmm",
+            "transpose", "sum", "coalesce", "is_same_shape", "reshape"]
