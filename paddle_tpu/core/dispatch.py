@@ -168,6 +168,25 @@ def _amp_cast_inputs(op_name: str, arrays: List):
 # Dispatch
 # ---------------------------------------------------------------------------
 _op_hooks: List[Callable] = []  # profiler / debugging taps
+_recorder_tls = threading.local()  # program capture is per-thread: a
+# guard on thread A must not record ops dispatched by thread B
+
+
+def _recorder_hooks() -> List[Callable]:
+    hooks = getattr(_recorder_tls, "hooks", None)
+    if hooks is None:
+        hooks = _recorder_tls.hooks = []
+    return hooks
+
+
+def register_recorder_hook(fn):
+    _recorder_hooks().append(fn)
+
+
+def unregister_recorder_hook(fn):
+    hooks = _recorder_hooks()
+    if fn in hooks:
+        hooks.remove(fn)
 
 
 def register_op_hook(fn):
@@ -293,6 +312,10 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
             jax.block_until_ready(o)
     for hook in _op_hooks:
         hook(op_name, tensor_inputs, out_tensors, attrs)
+    for hook in _recorder_hooks():
+        # recorder taps (static.Program capture) additionally receive the
+        # attr-bound lowering so the op can be replayed on new payloads
+        hook(op_name, f, tensor_inputs, out_tensors)
 
     if single:
         return out_tensors[0]

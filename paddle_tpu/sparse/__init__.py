@@ -245,6 +245,12 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
 # ---------------------------------------------------------------------------
 # Binary / structure ops
 # ---------------------------------------------------------------------------
+def _positions(res_idx, idx):
+    """Scatter position of each row of ``idx`` inside ``res_idx``."""
+    lookup = {tuple(r): i for i, r in enumerate(res_idx)}
+    return jnp.asarray([lookup[tuple(r)] for r in np.asarray(idx)])
+
+
 def _merge_patterns(x, y):
     """Union pattern + per-input scatter positions (host; the pattern is
     structure, not data)."""
@@ -254,12 +260,8 @@ def _merge_patterns(x, y):
          jnp.concatenate([x._coo_indices, y._coo_indices])),
         shape=x._coo_shape))
     res_idx = np.asarray(merged.indices)
-    lookup = {tuple(r): i for i, r in enumerate(res_idx)}
-    pos_x = jnp.asarray([lookup[tuple(r)]
-                         for r in np.asarray(x._coo_indices)])
-    pos_y = jnp.asarray([lookup[tuple(r)]
-                         for r in np.asarray(y._coo_indices)])
-    return res_idx, pos_x, pos_y
+    return (res_idx, _positions(res_idx, x._coo_indices),
+            _positions(res_idx, y._coo_indices))
 
 
 def subtract(x, y, name=None):
@@ -314,6 +316,17 @@ def divide(x, y, name=None):
     if isinstance(x, SparseCooTensor) and np.isscalar(y):
         out = dispatch.call("sparse_div", lambda v: v / float(y), [x])
         return SparseCooTensor(x._coo_indices, out, x._coo_shape)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        # implicit zeros make off-pattern quotients 0/0; only the
+        # identical-pattern case has well-defined sparse semantics
+        if (x._coo_indices.shape == y._coo_indices.shape
+                and bool(jnp.all(x._coo_indices == y._coo_indices))):
+            vals = dispatch.call("sparse_div_vv", lambda a, b: a / b,
+                                 [x, y])
+            return SparseCooTensor(x._coo_indices, vals, x._coo_shape)
+        raise ValueError(
+            "sparse.divide requires identical sparsity patterns "
+            "(off-pattern positions would be 0/0)")
     return to_dense(x) / to_dense(y)
 
 
@@ -384,9 +397,7 @@ def coalesce(x, name=None):
     merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
         (jnp.zeros_like(x._data), x._coo_indices), shape=x._coo_shape))
     res_idx = np.asarray(merged.indices)
-    lookup = {tuple(r): i for i, r in enumerate(res_idx)}
-    pos = jnp.asarray([lookup[tuple(r)]
-                       for r in np.asarray(x._coo_indices)])
+    pos = _positions(res_idx, x._coo_indices)
     n_out = res_idx.shape[0]
 
     def f(v):
@@ -414,9 +425,20 @@ def reshape(x, shape, name=None):
         flat = flat * old[d] + idx[:, d]
     new = np.asarray(shape)
     neg = new < 0
+    if neg.sum() > 1:
+        raise ValueError("sparse.reshape: at most one -1 dim")
     if neg.any():
         new = new.copy()
-        new[neg] = int(np.prod(old)) // int(np.prod(new[~neg]))
+        rest = int(np.prod(new[~neg]))
+        if rest == 0 or int(np.prod(old)) % rest:
+            raise ValueError(
+                f"sparse.reshape: cannot infer -1 for {tuple(shape)} "
+                f"from {tuple(old)}")
+        new[neg] = int(np.prod(old)) // rest
+    if int(np.prod(new)) != int(np.prod(old)):
+        raise ValueError(
+            f"sparse.reshape: size mismatch {tuple(old)} -> "
+            f"{tuple(shape)}")
     coords = []
     rem = flat
     for d in range(len(new) - 1, -1, -1):

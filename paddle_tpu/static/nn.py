@@ -1,0 +1,50 @@
+"""static.nn — layer helpers for construct-then-execute code
+(reference python/paddle/static/nn/common.py fc, embedding)."""
+from __future__ import annotations
+
+from .. import nn as _nn
+
+
+def fc(x, size, num_flatten_dims=1, activation=None, name=None,
+       weight_attr=None, bias_attr=None):
+    """Fully-connected over the flattened trailing dims (reference
+    static/nn/common.py fc). Creates its parameters at build time; they
+    are captured by the enclosing Program as weights. bias_attr=False
+    drops the bias; other attrs pass through to the Linear layer."""
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    layer = _nn.Linear(in_features, size, weight_attr=weight_attr,
+                       bias_attr=bias_attr)
+    # flatten derives its shape from the runtime array, so the recorded
+    # program stays batch-polymorphic (a reshape attr would freeze the
+    # build-time example batch)
+    h = x.flatten(start_axis=num_flatten_dims) if num_flatten_dims < len(
+        x.shape) - 1 else x
+    out = layer(h)
+    if activation == "relu":
+        from ..nn import functional as F
+        out = F.relu(out)
+    elif activation == "tanh":
+        out = out.tanh()
+    elif activation == "sigmoid":
+        out = out.sigmoid()
+    elif activation is not None:
+        raise ValueError(f"unsupported activation {activation!r}")
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    """reference static/nn/common.py embedding. is_sparse is a gradient
+    storage hint the SPMD design does not need; non-float32 dtype is not
+    supported here (raise rather than silently ignore)."""
+    if str(dtype) not in ("float32", "paddle.float32"):
+        raise NotImplementedError(
+            f"static.nn.embedding: dtype={dtype!r} (float32 only)")
+    layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                          weight_attr=param_attr)
+    return layer(input)
+
+
+__all__ = ["fc", "embedding"]

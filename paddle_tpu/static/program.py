@@ -1,0 +1,213 @@
+"""Static-graph Program / Executor.
+
+Reference: python/paddle/static/ — Program (framework.py), program_guard,
+data (input.py), Executor (executor.py), default_main_program. The
+reference builds a ProgramDesc of OpDescs that the C++ interpreter runs;
+here a Program records the dispatched ops of its `program_guard` block
+(op name + attr-bound lowering + value ids) — an inspectable op-list IR —
+and `Executor.run` replays it over feeds as ONE `jax.jit` program per feed
+signature (the compiled-program/Plan cache). Training-path capture stays
+on `jit.to_static`; this surface serves reference-style
+construct-then-execute code.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+
+_state = threading.local()
+
+
+class _OpRecord:
+    __slots__ = ("name", "fn", "in_ids", "out_ids")
+
+    def __init__(self, name, fn, in_ids, out_ids):
+        self.name = name
+        self.fn = fn
+        self.in_ids = in_ids
+        self.out_ids = out_ids
+
+    def __repr__(self):
+        ins = ", ".join(f"v{i}" for i in self.in_ids)
+        outs = ", ".join(f"v{o}" for o in self.out_ids)
+        return f"{outs} = {self.name}({ins})"
+
+
+class _Block:
+    """Single-block program body (reference Block; control flow in this
+    design lives inside lowerings as lax ops, so one block suffices)."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+
+    def __repr__(self):
+        return "\n".join(f"  {op!r}" for op in self.ops)
+
+
+class Program:
+    """Recorded op-list program (reference static.Program)."""
+
+    def __init__(self):
+        self._block = _Block()
+        self.feed_vars: Dict[str, int] = {}   # data() name -> value id
+        self._feed_shapes: Dict[str, tuple] = {}
+        self._feed_dtypes: Dict[str, str] = {}
+        # constants/parameters read by ops but produced by no op and not
+        # fed: id -> live Tensor (weights update in place between runs)
+        self._captured: Dict[int, Tensor] = {}
+        self._keepalive: List[Tensor] = []  # id stability across guards
+        self._produced: set = set()  # incremental: capture stays O(n)
+        self._jit_cache: Dict[tuple, "jax._src.stages.Wrapped"] = {}
+
+    # -- construction -----------------------------------------------------
+    def _record(self, op_name, fn, tensor_inputs, out_tensors):
+        in_ids = [id(t) for t in tensor_inputs]
+        out_ids = [id(t) for t in out_tensors]
+        for t in tensor_inputs:
+            if (id(t) not in self._produced
+                    and id(t) not in self.feed_vars.values()
+                    and id(t) not in self._captured):
+                self._captured[id(t)] = t
+        self._produced.update(out_ids)
+        self._keepalive.extend(out_tensors)
+        self._block.ops.append(_OpRecord(op_name, fn, in_ids, out_ids))
+
+    def global_block(self):
+        return self._block
+
+    def list_vars(self):
+        seen = []
+        for op in self._block.ops:
+            for i in op.in_ids + op.out_ids:
+                if i not in seen:
+                    seen.append(i)
+        return seen
+
+    def to_string(self, throw_on_error=False, with_details=False):
+        feeds = ", ".join(f"{n}: v{i}{list(self._feed_shapes[n])}"
+                          for n, i in self.feed_vars.items())
+        return (f"Program(feeds=[{feeds}], "
+                f"params={len(self._captured)})\n{self._block!r}")
+
+    __repr__ = to_string
+
+    # -- execution --------------------------------------------------------
+    def run(self, feed: Dict[str, np.ndarray], fetch_ids: List[int]):
+        names = sorted(self.feed_vars)
+        missing = [n for n in names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feeds: {missing}")
+        arrays = [jnp.asarray(feed[n]) for n in names]
+        sig = (tuple((n, a.shape, str(a.dtype))
+                     for n, a in zip(names, arrays)), tuple(fetch_ids))
+        if sig not in self._jit_cache:
+            feed_ids = [self.feed_vars[n] for n in names]
+            cap_ids = list(self._captured.keys())
+
+            def compiled(feed_arrays, cap_arrays):
+                env = self._replay_by_ids(feed_ids, feed_arrays, cap_ids,
+                                          cap_arrays)
+                return [env[i] for i in fetch_ids]
+
+            self._jit_cache[sig] = jax.jit(compiled)
+        cap_arrays = [t._data for t in self._captured.values()]
+        outs = self._jit_cache[sig](arrays, cap_arrays)
+        return [np.asarray(o) for o in outs]
+
+    def _replay_by_ids(self, feed_ids, feed_arrays, cap_ids, cap_arrays):
+        env = dict(zip(feed_ids, feed_arrays))
+        env.update(zip(cap_ids, cap_arrays))
+        for op in self._block.ops:
+            args = [env[i] for i in op.in_ids]
+            out = op.fn(*args)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oid, val in zip(op.out_ids, outs):
+                env[oid] = val
+        return env
+
+
+def _current() -> Optional[Program]:
+    return getattr(_state, "program", None)
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program() -> Program:
+    return _current() or _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    """Record the block's dispatched ops into ``main`` (reference
+    static.program_guard)."""
+
+    def __init__(self, main: Program, startup: Optional[Program] = None):
+        self.main = main
+        self.startup = startup
+
+    def __enter__(self):
+        self._prev = _current()
+        _state.program = self.main
+        self._hook = self.main._record
+        dispatch.register_recorder_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc):
+        dispatch.unregister_recorder_hook(self._hook)
+        _state.program = self._prev
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Declare a program input (reference static.data). Returns a
+    placeholder Tensor (zeros at the example shape) whose id marks the
+    feed slot; -1/None dims replay at whatever size the feed supplies."""
+    prog = _current()
+    if prog is None:
+        raise RuntimeError("static.data must be called under program_guard")
+    example = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
+    t = Tensor(jnp.zeros(example, dtype=np.dtype(dtype)), name=name)
+    prog.feed_vars[name] = id(t)
+    prog._feed_shapes[name] = tuple(
+        -1 if (s is None or s == -1) else int(s) for s in shape)
+    prog._feed_dtypes[name] = str(dtype)
+    return t
+
+
+class Executor:
+    """Replay a Program over feeds (reference static.Executor). The place
+    argument is accepted for parity; XLA owns placement."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None, feed=None,
+            fetch_list=None, return_numpy=True):
+        program = program or default_main_program()
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        fetch_ids = [id(t) for t in fetch_list]
+        outs = program.run(feed, fetch_ids)
+        if return_numpy:
+            return outs
+        return [Tensor(jnp.asarray(o)) for o in outs]
+
+
+class CompiledProgram:
+    """Parity alias (reference CompiledProgram) — every Program here is
+    compiled per feed signature already."""
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program

@@ -182,3 +182,20 @@ class TestReviewFixes:
         d = _rand((3, 3), seed=22)
         out = sp.sum(_coo(d))
         assert abs(float(out.numpy()) - d.sum()) < 1e-5
+
+    def test_divide_same_pattern_and_mismatch_raises(self):
+        a = _coo(np.array([[4.0, 0], [0, 6.0]], np.float32))
+        b = _coo(np.array([[2.0, 0], [0, 3.0]], np.float32))
+        out = sp.divide(a, b)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   [[2, 0], [0, 2]])
+        c = _coo(np.array([[0, 1.0], [0, 1.0]], np.float32))
+        with pytest.raises(ValueError, match="pattern"):
+            sp.divide(a, c)
+
+    def test_reshape_validates(self):
+        d = _rand((2, 6), seed=23)
+        with pytest.raises(ValueError, match="size mismatch"):
+            sp.reshape(_coo(d), [5, 2])
+        with pytest.raises(ValueError, match="-1"):
+            sp.reshape(_coo(d), [-1, -1])
