@@ -159,7 +159,7 @@ def _bench_resnet50(small):
     from paddle_tpu.nn import functional as F
     from paddle_tpu.vision.models import resnet50
 
-    batch, hw, iters = (4, 64, 2) if small else (64, 224, 10)
+    batch, hw, iters = (4, 64, 2) if small else (256, 224, 10)
     model = resnet50()
     model.train()
     params = [p for p in model.parameters() if not p.stop_gradient]
@@ -205,7 +205,7 @@ def _bench_bert(small):
     else:
         cfg = BertConfig(hidden_dropout_prob=0.0,
                          attention_probs_dropout_prob=0.0)
-        batch, seq, iters = 16, 512, 10
+        batch, seq, iters = 32, 512, 10
     model = BertForPretraining(cfg)
     params = [p for p in model.parameters() if not p.stop_gradient]
 

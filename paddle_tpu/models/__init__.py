@@ -7,13 +7,13 @@ from .gpt import GPTConfig, GPTModel, GPTForCausalLM, gpt2_small, gpt2_medium
 from .bert import (BertConfig, BertForPretraining,
                    BertForSequenceClassification, BertModel)
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
-                    llama_7b, llama_tiny)
+                    llama_7b, llama_tiny, llama2_13b, llama2_70b)
 
 __all__ = [
     "LeNet", "GPTConfig", "GPTModel", "GPTForCausalLM",
     "BertConfig", "BertModel", "BertForPretraining",
     "BertForSequenceClassification",
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-    "llama_7b", "llama_tiny",
+    "llama_7b", "llama_tiny", "llama2_13b", "llama2_70b",
     "gpt2_small", "gpt2_medium",
 ]

@@ -65,6 +65,23 @@ def llama_tiny(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
+def llama2_13b(**kw) -> LlamaConfig:
+    kw.setdefault("hidden_size", 5120)
+    kw.setdefault("intermediate_size", 13824)
+    kw.setdefault("num_layers", 40)
+    kw.setdefault("num_heads", 40)
+    return LlamaConfig(**kw)
+
+
+def llama2_70b(**kw) -> LlamaConfig:
+    kw.setdefault("hidden_size", 8192)
+    kw.setdefault("intermediate_size", 28672)
+    kw.setdefault("num_layers", 80)
+    kw.setdefault("num_heads", 64)
+    kw.setdefault("num_kv_heads", 8)   # GQA
+    return LlamaConfig(**kw)
+
+
 def rotary_embedding(x, theta: float = 10000.0, pos_offset=0):
     """Apply RoPE to [B, S, H, D] (reference fused_rope op). Pairs are the
     (even, odd) channel convention. ``pos_offset`` may be a traced scalar

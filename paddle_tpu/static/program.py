@@ -104,9 +104,18 @@ class Program:
         missing = [n for n in names if n not in feed]
         if missing:
             raise KeyError(f"missing feeds: {missing}")
-        arrays = [jnp.asarray(feed[n]) for n in names]
+        arrays = []
+        for n in names:
+            a = jnp.asarray(feed[n])
+            declared = self._feed_dtypes.get(n)
+            if declared and str(a.dtype) != declared:
+                a = a.astype(np.dtype(declared))  # honor the declaration
+            arrays.append(a)
+        # the signature includes the captured-id set: extending the
+        # program with new weights must invalidate compiled closures
         sig = (tuple((n, a.shape, str(a.dtype))
-                     for n, a in zip(names, arrays)), tuple(fetch_ids))
+                     for n, a in zip(names, arrays)), tuple(fetch_ids),
+               tuple(self._captured.keys()))
         if sig not in self._jit_cache:
             feed_ids = [self.feed_vars[n] for n in names]
             cap_ids = list(self._captured.keys())
@@ -159,6 +168,12 @@ class program_guard:
 
     def __enter__(self):
         self._prev = _current()
+        # suspend the outer program's recorder: nested guards record into
+        # the INNER program only (reference nested program_guard behavior)
+        self._prev_hook = (self._prev._record
+                           if self._prev is not None else None)
+        if self._prev_hook is not None:
+            dispatch.unregister_recorder_hook(self._prev_hook)
         _state.program = self.main
         self._hook = self.main._record
         dispatch.register_recorder_hook(self._hook)
@@ -166,6 +181,8 @@ class program_guard:
 
     def __exit__(self, *exc):
         dispatch.unregister_recorder_hook(self._hook)
+        if self._prev_hook is not None:
+            dispatch.register_recorder_hook(self._prev_hook)
         _state.program = self._prev
         return False
 
@@ -179,6 +196,7 @@ def data(name: str, shape, dtype="float32", lod_level=0):
         raise RuntimeError("static.data must be called under program_guard")
     example = tuple(1 if (s is None or s == -1) else int(s) for s in shape)
     t = Tensor(jnp.zeros(example, dtype=np.dtype(dtype)), name=name)
+    prog._keepalive.append(t)  # pin the id: reuse would alias the slot
     prog.feed_vars[name] = id(t)
     prog._feed_shapes[name] = tuple(
         -1 if (s is None or s == -1) else int(s) for s in shape)
