@@ -2,5 +2,12 @@
 python/paddle/incubate/distributed/models/moe/moe_layer.py MoELayer)."""
 from ...distributed.fleet.moe import MoELayer, TopKGate
 from . import functional  # noqa: F401
+from .layers import (FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
+                     FusedFeedForward, FusedLinear,
+                     FusedMultiHeadAttention,
+                     FusedTransformerEncoderLayer)
 
-__all__ = ["MoELayer", "TopKGate", "functional"]
+__all__ = ["MoELayer", "TopKGate", "functional", "FusedLinear",
+           "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
+           "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

@@ -200,7 +200,7 @@ def data(name: str, shape, dtype="float32", lod_level=0):
     prog.feed_vars[name] = id(t)
     prog._feed_shapes[name] = tuple(
         -1 if (s is None or s == -1) else int(s) for s in shape)
-    prog._feed_dtypes[name] = str(dtype)
+    prog._feed_dtypes[name] = str(np.dtype(dtype))  # normalized
     return t
 
 

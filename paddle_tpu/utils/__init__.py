@@ -1,4 +1,4 @@
-from . import custom_op
+from . import cpp_extension, custom_op
 from .custom_op import register_custom_op
 
-__all__ = ["custom_op", "register_custom_op"]
+__all__ = ["cpp_extension", "custom_op", "register_custom_op"]

@@ -1,0 +1,136 @@
+"""C++ extension loader: compile user C++ into host custom ops.
+
+Reference: python/paddle/utils/cpp_extension/ (setup/load building
+pybind+CUDA ops; paddle/phi/capi C ABI). TPU-native split: DEVICE custom
+kernels are jax/Pallas code (``register_custom_op``); this module covers
+the HOST side — user C++ compiled with g++ into a shared library, bound
+through ctypes, and exposed as framework ops that work both eagerly and
+under ``jit`` (via ``jax.pure_callback``, which XLA schedules as a host
+callback). The exported C ABI is flat-buffer style, like the native
+runtime's collation library:
+
+    extern "C" void my_op(const float* x, float* out, int64_t n);
+
+(same-shape float32 transform — the common "custom activation /
+data-side transform in C++" case; reductions/shape changes belong in
+jax/Pallas device code).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from types import SimpleNamespace
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["load", "CppExtension"]
+
+
+def _compile(name: str, sources: Sequence[str], extra_cflags, build_dir,
+             verbose: bool) -> str:
+    build_dir = build_dir or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    # content-hashed filename: dlopen caches by path, so rebuilding edited
+    # sources to the SAME path would silently keep running the old code
+    import hashlib
+    h = hashlib.sha256()
+    for src in sources:
+        with open(src, "rb") as fh:
+            h.update(fh.read())
+    out = os.path.join(build_dir, f"lib{name}_{h.hexdigest()[:12]}.so")
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", out, *sources,
+           *(extra_cflags or [])]
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"cpp_extension build failed:\n{proc.stderr}")
+    return out
+
+
+def _bind(lib_path: str, fn_name: str):
+    lib = ctypes.CDLL(lib_path)
+    try:
+        cfn = getattr(lib, fn_name)
+    except AttributeError:
+        raise RuntimeError(
+            f"{lib_path} does not export {fn_name!r} "
+            f"(declare it extern \"C\")")
+    cfn.restype = None
+    cfn.argtypes = [ctypes.POINTER(ctypes.c_float),
+                    ctypes.POINTER(ctypes.c_float), ctypes.c_int64]
+
+    def host_impl(arr: np.ndarray) -> np.ndarray:
+        arr = np.ascontiguousarray(arr, dtype=np.float32)
+        out = np.empty_like(arr)
+        cfn(arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            ctypes.c_int64(arr.size))
+        return out
+
+    return host_impl
+
+
+def load(name: str, sources: Sequence[str],
+         functions: Optional[List[str]] = None, extra_cflags=None,
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """Compile ``sources`` and return a namespace of framework ops, one
+    per exported function (reference cpp_extension.load contract).
+
+    Each op takes/returns a float32 Tensor of unchanged shape. It runs
+    the C++ code on host — eagerly via ctypes, under jit via
+    ``jax.pure_callback`` (a host callback op inside the XLA program).
+    """
+    if not functions:
+        raise ValueError("pass functions=[...] naming the extern \"C\" "
+                         "symbols to bind")
+    lib_path = _compile(name, sources, extra_cflags, build_directory,
+                        verbose)
+    ns = {}
+    for fn_name in functions:
+        host_impl = _bind(lib_path, fn_name)
+
+        def lowering(a, _impl=host_impl):
+            spec = jax.ShapeDtypeStruct(a.shape, jnp.float32)
+            return jax.pure_callback(
+                lambda arr: _impl(np.asarray(arr)), spec,
+                a.astype(jnp.float32))
+
+        def op(x, _lowering=lowering, _name=fn_name):
+            t = x if isinstance(x, Tensor) else as_tensor(x)
+            return dispatch.call(f"{name}.{_name}", _lowering, [t],
+                                 differentiable_mask=[False])
+
+        op.__name__ = fn_name
+        ns[fn_name] = op
+    module = SimpleNamespace(**ns)
+    module.__file__ = lib_path
+    return module
+
+
+class CppExtension:
+    """Build-spec record for setup()-style builds (reference
+    cpp_extension.CppExtension). ``load`` is the JIT path; for packaged
+    builds, instantiate with sources and call .build()."""
+
+    def __init__(self, sources: Sequence[str], name: str = "custom_ext",
+                 extra_compile_args=None, **kwargs):
+        self.name = name
+        self.sources = list(sources)
+        self.extra_compile_args = extra_compile_args or []
+
+    def build(self, functions: List[str], build_directory=None,
+              verbose: bool = False):
+        return load(self.name, self.sources, functions=functions,
+                    extra_cflags=self.extra_compile_args,
+                    build_directory=build_directory, verbose=verbose)

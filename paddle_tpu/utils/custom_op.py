@@ -86,17 +86,6 @@ def register_custom_op(name: str, forward: Callable,
     return user_fn
 
 
-class CppExtension:
-    """Source-compat shim for paddle.utils.cpp_extension: CUDA/C++ op
-    builds have no TPU analog — point users at register_custom_op (jax/
-    Pallas kernels) or paddle_tpu/native (ctypes C++ host runtime)."""
-
-    def __init__(self, *a, **k):
-        raise NotImplementedError(
-            "C++/CUDA op extensions do not target TPU; use "
-            "paddle_tpu.utils.register_custom_op with a jax or Pallas "
-            "kernel (device code), or the ctypes pattern in "
-            "paddle_tpu/native (host code)")
-
+from .cpp_extension import CppExtension  # noqa: E402  (real impl)
 
 __all__ = ["register_custom_op", "CppExtension"]

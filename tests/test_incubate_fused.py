@@ -1,0 +1,176 @@
+"""incubate.nn fused layers + utils.cpp_extension tests.
+
+Reference: python/paddle/incubate/nn/layer/fused_transformer.py,
+python/paddle/utils/cpp_extension/.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate, nn
+
+
+def _x(b=2, s=6, d=16, seed=0):
+    return paddle.to_tensor(
+        np.random.RandomState(seed).randn(b, s, d).astype(np.float32))
+
+
+class TestFusedLayers:
+    def test_fused_linear(self):
+        fl = incubate.nn.FusedLinear(8, 4)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 8).astype(np.float32))
+        ref = x @ fl.weight + fl.bias
+        np.testing.assert_allclose(fl(x).numpy(), ref.numpy(), atol=1e-6)
+
+    def test_fused_dropout_add_eval(self):
+        fda = incubate.nn.FusedDropoutAdd(p=0.5)
+        fda.eval()
+        x, y = _x(seed=1), _x(seed=2)
+        np.testing.assert_allclose(fda(x, y).numpy(),
+                                   (x + y).numpy(), atol=1e-6)
+
+    def test_bias_dropout_residual_ln(self):
+        layer = incubate.nn.FusedBiasDropoutResidualLayerNorm(
+            16, dropout_rate=0.0)
+        layer.eval()
+        x, res = _x(seed=3), _x(seed=4)
+        out = layer(x, res)
+        # matches LN(res + x + bias)
+        ref = layer.norm(res + x + layer.linear_bias)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-6)
+
+    @pytest.mark.parametrize("pre", [False, True])
+    def test_fused_mha_matches_manual(self, pre):
+        paddle.seed(0)
+        mha = incubate.nn.FusedMultiHeadAttention(
+            16, 4, dropout_rate=0.0, attn_dropout_rate=0.0,
+            normalize_before=pre)
+        mha.eval()
+        x = _x(seed=5)
+        out = mha(x)
+        # manual: same weights, explicit SDPA path
+        import paddle_tpu.nn.functional as F
+        from paddle_tpu import ops
+        h = mha.norm(x) if pre else x
+        b, s, d = h.shape
+        qkv = ops.reshape(mha.qkv(h), [b, s, 3, 4, 4])
+        q, k, v = ops.unbind(qkv, axis=2)
+        att = F.scaled_dot_product_attention(q, k, v)
+        ref = x + mha.out_proj(ops.reshape(att, [b, s, d]))
+        if not pre:
+            ref = mha.norm(ref)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_fused_ffn_and_encoder_layer_train(self):
+        layer = incubate.nn.FusedTransformerEncoderLayer(
+            16, 4, 32, dropout_rate=0.0)
+        x = _x(seed=6)
+        out = layer(x)
+        assert out.shape == [2, 6, 16]
+        # trains: grads reach every parameter
+        out.mean().backward()
+        grads = [p.grad for p in layer.parameters()
+                 if not p.stop_gradient]
+        assert all(g is not None for g in grads)
+
+    def test_need_weights_raises(self):
+        with pytest.raises(NotImplementedError):
+            incubate.nn.FusedMultiHeadAttention(16, 4, need_weights=True)
+
+
+class TestCppExtension:
+    def test_load_and_run(self, tmp_path):
+        src = tmp_path / "ops.cc"
+        src.write_text(
+            '#include <cstdint>\n'
+            'extern "C" void triple(const float* x, float* o, int64_t n)'
+            '{ for (int64_t i = 0; i < n; ++i) o[i] = 3.0f * x[i]; }\n')
+        ext = paddle.utils.cpp_extension.load(
+            "t3", [str(src)], functions=["triple"],
+            build_directory=str(tmp_path))
+        x = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+        np.testing.assert_allclose(ext.triple(x).numpy(), [3.0, -6.0])
+
+    def test_under_jit(self, tmp_path):
+        src = tmp_path / "ops2.cc"
+        src.write_text(
+            '#include <cstdint>\n'
+            'extern "C" void negate(const float* x, float* o, int64_t n)'
+            '{ for (int64_t i = 0; i < n; ++i) o[i] = -x[i]; }\n')
+        ext = paddle.utils.cpp_extension.load(
+            "neg1", [str(src)], functions=["negate"],
+            build_directory=str(tmp_path))
+
+        @paddle.jit.to_static
+        def f(a):
+            return ext.negate(a + 1)
+
+        out = f(paddle.to_tensor(np.array([1.0, 2.0], np.float32)))
+        np.testing.assert_allclose(out.numpy(), [-2.0, -3.0])
+
+    def test_missing_symbol_raises(self, tmp_path):
+        src = tmp_path / "ops3.cc"
+        src.write_text('extern "C" void here() {}\n')
+        with pytest.raises(RuntimeError, match="does not export"):
+            paddle.utils.cpp_extension.load(
+                "m1", [str(src)], functions=["not_here"],
+                build_directory=str(tmp_path))
+
+    def test_build_error_raises(self, tmp_path):
+        src = tmp_path / "bad.cc"
+        src.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            paddle.utils.cpp_extension.load(
+                "bad1", [str(src)], functions=["x"],
+                build_directory=str(tmp_path))
+
+
+class TestReviewFixes:
+    def test_fused_linear_transpose_weight(self):
+        fl = incubate.nn.FusedLinear(8, 4, transpose_weight=True)
+        assert list(fl.weight.shape) == [4, 8]
+        assert list(fl.bias.shape) == [4]
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(3, 8).astype(np.float32))
+        out = fl(x)
+        ref = x.numpy() @ fl.weight.numpy().T + fl.bias.numpy()
+        np.testing.assert_allclose(out.numpy(), ref, atol=1e-6)
+
+    def test_static_data_np_dtype(self):
+        static = paddle.static
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [2], np.float32)  # non-string dtype
+            y = x * 2
+        (out,) = static.Executor().run(
+            main, feed={"x": np.array([1.0, 2.0], np.float32)},
+            fetch_list=[y])
+        np.testing.assert_allclose(out, [2.0, 4.0])
+
+    def test_cpp_extension_reload_picks_up_edits(self, tmp_path):
+        src = tmp_path / "evolve.cc"
+        src.write_text(
+            '#include <cstdint>\n'
+            'extern "C" void f(const float* x, float* o, int64_t n)'
+            '{ for (int64_t i = 0; i < n; ++i) o[i] = x[i] + 1.0f; }\n')
+        ext1 = paddle.utils.cpp_extension.load(
+            "evolve", [str(src)], functions=["f"],
+            build_directory=str(tmp_path))
+        x = paddle.to_tensor(np.array([1.0], np.float32))
+        np.testing.assert_allclose(ext1.f(x).numpy(), [2.0])
+        src.write_text(
+            '#include <cstdint>\n'
+            'extern "C" void f(const float* x, float* o, int64_t n)'
+            '{ for (int64_t i = 0; i < n; ++i) o[i] = x[i] + 10.0f; }\n')
+        ext2 = paddle.utils.cpp_extension.load(
+            "evolve", [str(src)], functions=["f"],
+            build_directory=str(tmp_path))
+        np.testing.assert_allclose(ext2.f(x).numpy(), [11.0])
+
+    def test_encoder_cache_raises(self):
+        layer = incubate.nn.FusedTransformerEncoderLayer(16, 4, 32)
+        with pytest.raises(NotImplementedError, match="cache"):
+            layer(_x(), cache={})
