@@ -1,3 +1,6 @@
-from . import features, functional
+"""paddle.audio (reference: python/paddle/audio/ — features, functional,
+backends)."""
+from . import backends, features, functional
+from .backends import info, load, save
 
-__all__ = ["features", "functional"]
+__all__ = ["features", "functional", "backends", "info", "load", "save"]

@@ -1,0 +1,111 @@
+"""Audio IO backend (reference: python/paddle/audio/backends/
+wave_backend.py — info/load/save over the stdlib wave module, the
+fallback backend when soundfile is absent; backend registry in
+backends/init_backend.py). Host-side IO, like the reference.
+"""
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str = "PCM_S"
+
+
+def info(filepath: str) -> AudioInfo:
+    """reference wave_backend.py:37."""
+    with wave.open(filepath, "rb") as f:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=8 * f.getsampwidth())
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    """Read a PCM wav -> (Tensor [C, L] (or [L, C]), sample_rate)
+    (reference wave_backend.py:89). normalize=True scales to [-1, 1]
+    float32 like the reference."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    with wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - f.tell() if num_frames < 0 else num_frames
+        raw = f.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, dtype="<i2")
+        scale = 32768.0
+    elif width == 1:  # unsigned 8-bit PCM
+        data = np.frombuffer(raw, dtype=np.uint8)
+        scale = 128.0
+    elif width == 4:
+        data = np.frombuffer(raw, dtype="<i4")
+        scale = 2147483648.0
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    data = data.reshape(-1, nch)
+    if normalize:
+        data = data.astype(np.float32)
+        if width == 1:
+            data = data - 128.0
+        data = data / scale
+    # normalize=False: raw integer PCM, reference wave_backend contract
+    if channels_first:
+        data = data.T
+    return Tensor(jnp.asarray(np.ascontiguousarray(data))), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    """Write float [-1,1] (or int16) data as PCM wav (reference
+    wave_backend.py:168)."""
+    if bits_per_sample != 16 or encoding != "PCM_16":
+        raise NotImplementedError("save supports PCM_16 only")
+    arr = np.asarray(getattr(src, "numpy", lambda: src)())
+    if arr.ndim == 1:
+        arr = arr[None] if channels_first else arr[:, None]
+    if channels_first:
+        arr = arr.T  # -> (L, C)
+    if np.issubdtype(arr.dtype, np.floating):
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype("<i2")
+    else:
+        arr = arr.astype("<i2")
+    with wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(arr.tobytes())
+
+
+_backend = "wave"
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return _backend
+
+
+def set_backend(backend_name: str):
+    global _backend
+    if backend_name not in list_available_backends():
+        raise ValueError(f"unknown audio backend {backend_name!r}")
+    _backend = backend_name

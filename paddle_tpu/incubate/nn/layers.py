@@ -96,6 +96,16 @@ class FusedMultiHeadAttention(nn.Layer):
             raise NotImplementedError(
                 "need_weights=True is unsupported (flash attention does "
                 "not materialize probabilities)")
+        if nranks > 1 or ring_id >= 0:
+            raise NotImplementedError(
+                "tensor-parallel FusedMultiHeadAttention: use "
+                "fleet.ColumnParallelLinear/RowParallelLinear layers (the "
+                "mp mesh axis), not nranks/ring_id")
+        if (kdim not in (None, embed_dim)) or (vdim not in (None,
+                                                            embed_dim)):
+            raise NotImplementedError(
+                "cross-attention kdim/vdim != embed_dim is unsupported "
+                "in the fused layer; use nn.MultiHeadAttention")
         self.embed_dim = embed_dim
         self.num_heads = num_heads
         self.head_dim = embed_dim // num_heads
@@ -110,7 +120,10 @@ class FusedMultiHeadAttention(nn.Layer):
                                   bias_attr=linear_bias_attr)
         self.attn_dropout_rate = attn_dropout_rate
         self.dropout = nn.Dropout(dropout_rate)
-        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        norm_w = pre_ln_scale_attr if normalize_before else ln_scale_attr
+        norm_b = pre_ln_bias_attr if normalize_before else ln_bias_attr
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon,
+                                 weight_attr=norm_w, bias_attr=norm_b)
 
     def forward(self, x, attn_mask=None):
         residual = x
@@ -150,6 +163,10 @@ class FusedFeedForward(nn.Layer):
                  ln1_bias_attr=None, ln2_scale_attr=None,
                  ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
         super().__init__()
+        if nranks > 1 or ring_id >= 0:
+            raise NotImplementedError(
+                "tensor-parallel FusedFeedForward: use the fleet mp "
+                "layers, not nranks/ring_id")
         self.normalize_before = normalize_before
         self.linear1 = nn.Linear(d_model, dim_feedforward,
                                  weight_attr=linear1_weight_attr,
@@ -162,7 +179,10 @@ class FusedFeedForward(nn.Layer):
                             else act_dropout_rate)
         self.act_dropout = nn.Dropout(act_dropout_rate)
         self.dropout = nn.Dropout(dropout_rate)
-        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+        norm_w = ln1_scale_attr if normalize_before else ln2_scale_attr
+        norm_b = ln1_bias_attr if normalize_before else ln2_bias_attr
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon,
+                                 weight_attr=norm_w, bias_attr=norm_b)
 
     def forward(self, x):
         residual = x

@@ -46,6 +46,7 @@ def _compile(name: str, sources: Sequence[str], extra_cflags, build_dir,
     for src in sources:
         with open(src, "rb") as fh:
             h.update(fh.read())
+    h.update(" ".join(extra_cflags or []).encode())  # flags change codegen
     out = os.path.join(build_dir, f"lib{name}_{h.hexdigest()[:12]}.so")
     cmd = ["g++", "-O2", "-shared", "-fPIC", "-o", out, *sources,
            *(extra_cflags or [])]

@@ -305,3 +305,37 @@ class TestKVRendezvous:
         with pytest.raises(SystemExit):
             launch(["--nnodes", "2", "--master", "no-port-here",
                     "script.py"])
+
+
+class TestAudioBackend:
+    def test_wav_roundtrip_mono_stereo(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        sr = 16000
+        t = np.linspace(0, 1, sr, endpoint=False)
+        mono = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+        p = str(tmp_path / "tone.wav")
+        paddle.audio.save(p, paddle.to_tensor(mono), sr)
+        meta = paddle.audio.info(p)
+        assert (meta.sample_rate, meta.num_channels,
+                meta.bits_per_sample) == (sr, 1, 16)
+        back, sr2 = paddle.audio.load(p)
+        assert sr2 == sr and list(back.shape) == [1, sr]
+        np.testing.assert_allclose(back.numpy()[0], mono, atol=2e-4)
+        # stereo channels-first
+        st = np.stack([mono, -mono])
+        p2 = str(tmp_path / "st.wav")
+        paddle.audio.save(p2, paddle.to_tensor(st), sr)
+        b2, _ = paddle.audio.load(p2)
+        assert list(b2.shape) == [2, sr]
+        np.testing.assert_allclose(b2.numpy(), st, atol=2e-4)
+
+    def test_load_offset_and_count(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        sig = np.arange(100, dtype=np.float32) / 200.0
+        p = str(tmp_path / "seg.wav")
+        paddle.audio.save(p, paddle.to_tensor(sig), 8000)
+        seg, _ = paddle.audio.load(p, frame_offset=10, num_frames=20)
+        assert list(seg.shape) == [1, 20]
+        np.testing.assert_allclose(seg.numpy()[0], sig[10:30], atol=2e-4)
