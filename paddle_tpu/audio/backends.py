@@ -84,8 +84,16 @@ def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
     if np.issubdtype(arr.dtype, np.floating):
         arr = np.clip(arr, -1.0, 1.0)
         arr = (arr * 32767.0).astype("<i2")
-    else:
+    elif arr.dtype == np.int16:
         arr = arr.astype("<i2")
+    elif arr.dtype == np.int32:
+        arr = (arr >> 16).astype("<i2")  # rescale 32-bit PCM
+    elif arr.dtype == np.uint8:
+        arr = ((arr.astype(np.int16) - 128) << 8).astype("<i2")
+    else:
+        raise ValueError(
+            f"save: unsupported integer dtype {arr.dtype}; pass float "
+            f"[-1,1] or int16/int32/uint8 PCM")
     with wave.open(filepath, "wb") as f:
         f.setnchannels(arr.shape[1])
         f.setsampwidth(2)

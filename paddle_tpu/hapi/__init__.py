@@ -1,4 +1,8 @@
+from . import callbacks
+from .callbacks import (Callback, EarlyStopping, LRScheduler,
+                        ModelCheckpoint, ReduceLROnPlateau)
 from .model import Model
 from .summary import summary
 
-__all__ = ["Model", "summary"]
+__all__ = ["Model", "summary", "callbacks", "Callback", "EarlyStopping",
+           "LRScheduler", "ModelCheckpoint", "ReduceLROnPlateau"]

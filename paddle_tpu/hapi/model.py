@@ -90,17 +90,27 @@ class Model:
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None):
         from ..io import DataLoader
+        from .callbacks import CallbackList
         loader = train_data
         if not isinstance(train_data, DataLoader):
             loader = DataLoader(train_data, batch_size=batch_size,
                                 shuffle=shuffle, drop_last=drop_last,
                                 num_workers=num_workers)
+        cbks = CallbackList(_to_list(callbacks))
+        cbks.set_model(self)
+        cbks.set_params({"epochs": epochs, "batch_size": batch_size,
+                         "verbose": verbose, "save_dir": save_dir,
+                         "metrics": [m.name() for m in self._metrics]})
+        self.stop_training = False
         history = []
+        cbks.on_train_begin()
         for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             losses = []
             for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
                 batch = _to_list(batch)
                 xs, ys = batch[:-1], batch[-1:]
                 out = self.train_batch(xs, ys)
@@ -113,34 +123,55 @@ class Model:
                                     else []):
                         msg += f" {m.name()}={v}"
                     print(msg)
-            history.append(float(np.mean(losses)))
+                cbks.on_train_batch_end(step, {"loss": loss})
+            epoch_logs = {"loss": float(np.mean(losses))}
+            history.append(epoch_logs["loss"])
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
+                eval_res = self.evaluate(eval_data, batch_size=batch_size,
+                                         verbose=verbose,
+                                         callbacks=cbks.callbacks)
+                # namespace eval results: 'loss' stays the TRAIN loss
+                # (same float type with or without eval_data)
+                for k, v in eval_res.items():
+                    if isinstance(v, (list, tuple)) and len(v) == 1:
+                        v = float(v[0])
+                    epoch_logs[f"eval_{k}"] = v
             if save_dir and (epoch + 1) % max(save_freq, 1) == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
+            cbks.on_epoch_end(epoch, epoch_logs)
+            if self.stop_training:
+                break
+        cbks.on_train_end({"loss": history[-1] if history else None})
         return history
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):
         from ..io import DataLoader
+        from .callbacks import CallbackList
         loader = eval_data
         if not isinstance(eval_data, DataLoader):
             loader = DataLoader(eval_data, batch_size=batch_size,
                                 num_workers=num_workers)
+        cbks = CallbackList(_to_list(callbacks))
+        cbks.set_model(self)
+        cbks.on_eval_begin()
         for m in self._metrics:
             m.reset()
         losses = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
             batch = _to_list(batch)
             xs, ys = batch[:-1], batch[-1:]
             out = self.eval_batch(xs, ys)
-            losses.append(out[0][0] if isinstance(out, tuple) else out[0])
+            loss = out[0][0] if isinstance(out, tuple) else out[0]
+            losses.append(loss)
+            cbks.on_eval_batch_end(step, {"loss": loss})
         result = {"loss": [float(np.mean(losses))]}
         for m in self._metrics:
             result[m.name()] = m.accumulate()
         if verbose:
             print("eval:", result)
+        cbks.on_eval_end(result)
         return result
 
     def predict(self, test_data, batch_size=1, num_workers=0,

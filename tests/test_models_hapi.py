@@ -188,3 +188,114 @@ class TestMetrics:
         auc = Auc()
         auc.update(np.array([1.0, 1.0]), np.array([1, 0]))
         assert abs(auc.accumulate() - 0.5) < 1e-3
+
+
+class TestCallbacks:
+    def _setup(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.io import TensorDataset
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 4).astype(np.float32)
+        y = (x.sum(-1) > 2).astype(np.int64)
+        ds = TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        net = nn.Sequential(nn.Linear(4, 16), nn.ReLU(), nn.Linear(16, 2))
+        model = paddle.hapi.Model(net)
+        return paddle, model, net, ds
+
+    def test_callback_hooks_fire_in_order(self):
+        paddle, model, net, ds = self._setup()
+
+        calls = []
+
+        class Spy(paddle.hapi.Callback):
+            def on_train_begin(self, logs=None):
+                calls.append("train_begin")
+
+            def on_epoch_begin(self, epoch, logs=None):
+                calls.append(f"epoch_begin{epoch}")
+
+            def on_train_batch_end(self, step, logs=None):
+                calls.append("batch")
+
+            def on_epoch_end(self, epoch, logs=None):
+                calls.append(f"epoch_end{epoch}")
+                assert "loss" in (logs or {})
+
+            def on_train_end(self, logs=None):
+                calls.append("train_end")
+
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        model.fit(ds, epochs=2, batch_size=16, verbose=0,
+                  callbacks=[Spy()])
+        assert calls[0] == "train_begin" and calls[-1] == "train_end"
+        assert calls.count("batch") == 4  # 2 epochs x 2 steps
+        assert "epoch_begin0" in calls and "epoch_end1" in calls
+
+    def test_early_stopping(self):
+        paddle, model, net, ds = self._setup()
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=0.0, parameters=net.parameters()),  # no progress
+            paddle.nn.CrossEntropyLoss())
+        es = paddle.hapi.EarlyStopping(monitor="loss", patience=1,
+                                       verbose=0)
+        model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+                  callbacks=[es])
+        assert model.stop_training
+        assert es.wait >= 1
+
+    def test_lr_scheduler_callback_steps(self):
+        paddle, model, net, ds = self._setup()
+        sched = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+        model.prepare(paddle.optimizer.SGD(
+            learning_rate=sched, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        model.fit(ds, epochs=1, batch_size=16, verbose=0,
+                  callbacks=[paddle.hapi.LRScheduler(by_step=True)])
+        # 2 steps -> scheduler advanced twice -> lr halved once
+        assert abs(sched() - 0.05) < 1e-9
+
+    def test_model_checkpoint(self, tmp_path):
+        paddle, model, net, ds = self._setup()
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        model.fit(ds, epochs=2, batch_size=16, verbose=0,
+                  callbacks=[paddle.hapi.ModelCheckpoint(
+                      save_freq=1, save_dir=str(tmp_path))])
+        import os
+        assert os.path.exists(str(tmp_path / "0.pdparams")) or \
+            os.path.exists(str(tmp_path / "0"))
+        assert any("final" in f for f in os.listdir(tmp_path))
+
+    def test_early_stopping_saves_best(self, tmp_path):
+        paddle, model, net, ds = self._setup()
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        model.fit(ds, eval_data=ds, epochs=3, batch_size=16, verbose=0,
+                  save_dir=str(tmp_path),
+                  callbacks=[paddle.hapi.EarlyStopping(
+                      monitor="loss", patience=10, verbose=0)])
+        import os
+        assert any("best_model" in f for f in os.listdir(tmp_path))
+
+    def test_epoch_logs_namespaced(self):
+        paddle, model, net, ds = self._setup()
+        seen = {}
+
+        class Spy(paddle.hapi.Callback):
+            def on_epoch_end(self, epoch, logs=None):
+                seen.update(logs or {})
+
+        model.prepare(paddle.optimizer.Adam(
+            learning_rate=1e-2, parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        model.fit(ds, eval_data=ds, epochs=1, batch_size=16, verbose=0,
+                  callbacks=[Spy()])
+        assert isinstance(seen["loss"], float)        # train loss
+        assert isinstance(seen["eval_loss"], float)   # namespaced eval

@@ -339,3 +339,13 @@ class TestAudioBackend:
         seg, _ = paddle.audio.load(p, frame_offset=10, num_frames=20)
         assert list(seg.shape) == [1, 20]
         np.testing.assert_allclose(seg.numpy()[0], sig[10:30], atol=2e-4)
+
+    def test_save_int32_rescales(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        sig = (np.sin(np.linspace(0, 6.28, 100)) * 2**30).astype(np.int32)
+        p = str(tmp_path / "i32.wav")
+        paddle.audio.save(p, sig, 8000)
+        back, _ = paddle.audio.load(p)
+        ref = sig.astype(np.float64) / 2**31
+        np.testing.assert_allclose(back.numpy()[0], ref, atol=2e-4)
