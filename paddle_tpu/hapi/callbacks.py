@@ -17,6 +17,18 @@ __all__ = ["Callback", "CallbackList", "ModelCheckpoint", "EarlyStopping",
            "LRScheduler", "ReduceLROnPlateau"]
 
 
+def _scalar(logs, key):
+    """Pull a numeric metric out of a logs dict (values may be scalars or
+    one-element lists, e.g. evaluate()'s {"loss": [v]})."""
+    value = (logs or {}).get(key)
+    if isinstance(value, (list, tuple, np.ndarray)):
+        arr = np.asarray(value).ravel()
+        if arr.size != 1:
+            return None
+        value = float(arr[0])
+    return value if isinstance(value, numbers.Number) else None
+
+
 class Callback:
     """Base callback (reference callbacks.py Callback)."""
 
@@ -137,13 +149,8 @@ class EarlyStopping(Callback):
         self.best = self.baseline
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        value = logs.get(self.monitor)
+        value = _scalar(logs, self.monitor)
         if value is None:
-            return
-        if isinstance(value, (list, tuple, np.ndarray)):
-            value = float(np.asarray(value).ravel()[0])
-        if not isinstance(value, numbers.Number):
             return
         if self._improved(value):
             self.best = value
@@ -158,6 +165,11 @@ class EarlyStopping(Callback):
                 if self.verbose:
                     print(f"[EarlyStopping] no {self.monitor} improvement "
                           f"for {self.wait} evals; stopping")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if getattr(self.model, "stop_training", False) \
+                and self.stopped_epoch < 0:
+            self.stopped_epoch = epoch
 
 
 class LRScheduler(Callback):
@@ -199,12 +211,9 @@ class ReduceLROnPlateau(Callback):
         self.monitor = monitor
 
     def on_eval_end(self, logs=None):
-        logs = logs or {}
-        value = logs.get(self.monitor)
+        value = _scalar(logs, self.monitor)
         if value is None:
             return
-        if isinstance(value, (list, tuple, np.ndarray)):
-            value = float(np.asarray(value).ravel()[0])
         opt = getattr(self.model, "_optimizer", None)
         sched = getattr(opt, "_learning_rate", None)
         from ..optimizer.lr import ReduceOnPlateau as _ROP

@@ -132,10 +132,11 @@ class Model:
                                          callbacks=cbks.callbacks)
                 # namespace eval results: 'loss' stays the TRAIN loss
                 # (same float type with or without eval_data)
-                for k, v in eval_res.items():
-                    if isinstance(v, (list, tuple)) and len(v) == 1:
-                        v = float(v[0])
-                    epoch_logs[f"eval_{k}"] = v
+                from .callbacks import _scalar
+                for k in eval_res:
+                    v = _scalar(eval_res, k)
+                    epoch_logs[f"eval_{k}"] = (v if v is not None
+                                               else eval_res[k])
             if save_dir and (epoch + 1) % max(save_freq, 1) == 0:
                 self.save(f"{save_dir}/epoch_{epoch}")
             cbks.on_epoch_end(epoch, epoch_logs)

@@ -1,5 +1,5 @@
 """paddle.incubate surface: experimental APIs kept at reference import
 paths (reference: python/paddle/incubate/)."""
-from . import nn  # noqa: F401
+from . import asp, nn  # noqa: F401
 
-__all__ = ["nn"]
+__all__ = ["nn", "asp"]

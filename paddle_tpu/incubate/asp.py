@@ -1,0 +1,259 @@
+"""Automatic SParsity (ASP): n:m structured sparsity training.
+
+Reference: python/paddle/incubate/asp/ — utils.py (get_mask_1d:~,
+get_mask_2d_greedy, check_mask_*, calculate_density, MaskAlgo/CheckMethod),
+asp.py (prune_model:302, decorate:216 re-applying masks after each
+optimizer step, set/reset_excluded_layers). The reference targets
+Ampere's 2:4 sparse tensor cores; on TPU the win is model compression +
+the masked weights staying exactly zero through training (the MXU has no
+sparse mode, so masked matmuls run dense — the capability preserved here
+is the TRAINING protocol and the checkable n:m structure).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "MaskAlgo", "CheckMethod", "calculate_density", "get_mask_1d",
+    "get_mask_2d_greedy", "check_mask_1d", "check_mask_2d",
+    "check_sparsity", "create_mask", "prune_model", "decorate",
+    "set_excluded_layers", "reset_excluded_layers", "ASPHelper",
+]
+
+
+class MaskAlgo(enum.Enum):
+    MASK_1D = "mask_1d"
+    MASK_2D_GREEDY = "mask_2d_greedy"
+    MASK_2D_BEST = "mask_2d_best"
+
+
+class CheckMethod(enum.Enum):
+    CHECK_1D = "check_1d"
+    CHECK_2D = "check_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo: MaskAlgo):
+        if mask_algo == MaskAlgo.MASK_1D:
+            return CheckMethod.CHECK_1D
+        return CheckMethod.CHECK_2D
+
+
+def calculate_density(x) -> float:
+    """Fraction of nonzeros (reference utils.calculate_density)."""
+    arr = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-|w| of every m consecutive elements per row
+    (reference utils.get_mask_1d)."""
+    mat = np.asarray(mat)
+    shape = mat.shape
+    flat = mat.reshape(-1)
+    pad = (-flat.size) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    groups = np.abs(flat.reshape(-1, m))
+    # indices of the (m-n) smallest per group -> zeroed
+    order = np.argsort(groups, axis=1)
+    mask = np.ones_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, :m - n], False, axis=1)
+    mask = mask.reshape(-1)[:mat.size].reshape(shape)
+    return mask.astype(mat.dtype if np.issubdtype(mat.dtype, np.floating)
+                       else np.float32)
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy 2D n:m mask over m x m tiles: keep the largest entries while
+    keeping every row AND column of the tile at most n nonzeros
+    (reference utils.get_mask_2d_greedy)."""
+    mat = np.asarray(mat)
+    if mat.ndim != 2:
+        return get_mask_1d(mat, n, m)
+    rows, cols = mat.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    work = np.abs(np.pad(mat, ((0, pr), (0, pc))))
+    mask = np.zeros_like(work, dtype=bool)
+    for r0 in range(0, work.shape[0], m):
+        for c0 in range(0, work.shape[1], m):
+            tile = work[r0:r0 + m, c0:c0 + m]
+            order = np.argsort(-tile, axis=None)
+            rcnt = np.zeros(m, np.int64)
+            ccnt = np.zeros(m, np.int64)
+            for flat_i in order:
+                i, j = divmod(int(flat_i), m)
+                if rcnt[i] < n and ccnt[j] < n:
+                    mask[r0 + i, c0 + j] = True
+                    rcnt[i] += 1
+                    ccnt[j] += 1
+    mask = mask[:rows, :cols]
+    return mask.astype(mat.dtype if np.issubdtype(mat.dtype, np.floating)
+                       else np.float32)
+
+
+get_mask_2d_best = get_mask_2d_greedy  # greedy is the practical reference
+
+
+def check_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    """Every m consecutive elements hold at most n nonzeros (reference
+    utils.check_mask_1d)."""
+    flat = np.asarray(mat).reshape(-1)
+    pad = (-flat.size) % m
+    if pad:
+        flat = np.concatenate([flat, np.zeros(pad, flat.dtype)])
+    return bool(((flat.reshape(-1, m) != 0).sum(axis=1) <= n).all())
+
+
+def check_mask_2d(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    """Every m x m tile has per-row and per-column nonzeros <= n."""
+    mat = np.asarray(mat)
+    if mat.ndim != 2:
+        return check_mask_1d(mat, n, m)
+    rows, cols = mat.shape
+    pr, pc = (-rows) % m, (-cols) % m
+    work = np.pad(mat, ((0, pr), (0, pc))) != 0
+    for r0 in range(0, work.shape[0], m):
+        for c0 in range(0, work.shape[1], m):
+            tile = work[r0:r0 + m, c0:c0 + m]
+            if (tile.sum(axis=0) > n).any() or (tile.sum(axis=1) > n).any():
+                return False
+    return True
+
+
+def check_sparsity(mat, n: int = 2, m: int = 4,
+                   func_name: CheckMethod = CheckMethod.CHECK_1D) -> bool:
+    arr = np.asarray(mat.numpy() if isinstance(mat, Tensor) else mat)
+    if func_name == CheckMethod.CHECK_1D:
+        return check_mask_1d(arr, n, m)
+    return check_mask_2d(arr, n, m)
+
+
+def create_mask(tensor, func_name: MaskAlgo = MaskAlgo.MASK_1D, n: int = 2,
+                m: int = 4):
+    arr = np.asarray(tensor.numpy() if isinstance(tensor, Tensor)
+                     else tensor)
+    if func_name == MaskAlgo.MASK_1D:
+        return get_mask_1d(arr, n, m)
+    return get_mask_2d_greedy(arr, n, m)
+
+
+class ASPHelper:
+    """Mask registry + training protocol (reference asp.py ASPHelper).
+
+    Masks are stored per parameter name; ``prune_model`` computes and
+    applies them, and a decorated optimizer re-applies them after every
+    ``step()`` so pruned weights stay exactly zero through training."""
+
+    # id(param) -> (weakref to param, mask): the weakref guards against
+    # CPython id reuse after a pruned model is garbage collected, and
+    # keys masks per PARAMETER so two models sharing layer names cannot
+    # alias each other's masks
+    _masks_by_id: Dict[int, tuple] = {}
+    _masks: Dict[str, np.ndarray] = {}      # name -> mask (introspection)
+    _excluded: set = set()
+
+    MIN_PRUNABLE_DIM = 2
+
+    @classmethod
+    def is_supported_layer(cls, param_name: str, param) -> bool:
+        # exact name, or a dotted-prefix (layer path) match — plain
+        # substring would over-match ("0." is inside "10.weight")
+        def excluded(e):
+            prefix = e if e.endswith(".") else e + "."
+            return param_name == e or param_name.startswith(prefix)
+
+        if any(excluded(e) for e in cls._excluded):
+            return False
+        shape = param.shape
+        # prune matmul-class weights only (reference supported_layer_list:
+        # Linear/Conv kernels, not biases/norms/embeddings)
+        return len(shape) >= cls.MIN_PRUNABLE_DIM and min(
+            int(s) for s in shape) >= 4 and "bias" not in param_name
+
+    @classmethod
+    def prune_model(cls, model, n=2, m=4, mask_algo=MaskAlgo.MASK_1D,
+                    with_mask=True):
+        masks = {}
+        for name, p in model.named_parameters():
+            if p.stop_gradient or not cls.is_supported_layer(name, p):
+                continue
+            w = np.asarray(p.numpy())
+            flat2d = w.reshape(w.shape[0], -1)
+            mask = create_mask(flat2d, mask_algo, n, m).reshape(w.shape)
+            p.set_value(Tensor(jnp.asarray(w * mask)))
+            if with_mask:
+                import weakref
+                masks[name] = mask
+                cls._masks_by_id[id(p)] = (weakref.ref(p), mask)
+        cls._masks.update(masks)
+        return masks
+
+    @classmethod
+    def mask_for(cls, p):
+        entry = cls._masks_by_id.get(id(p))
+        if entry is None:
+            return None
+        ref, mask = entry
+        if ref() is not p:   # stale id reuse: drop the dead entry
+            del cls._masks_by_id[id(p)]
+            return None
+        return mask
+
+    @classmethod
+    def apply_masks(cls, model):
+        for _, p in model.named_parameters():
+            mask = cls.mask_for(p)
+            if mask is not None:
+                p.set_value(Tensor(p._data * jnp.asarray(mask)))
+
+    @classmethod
+    def reset(cls):
+        cls._masks.clear()
+        cls._masks_by_id.clear()
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    """reference asp.py:40 — exclude parameters (by name/prefix) from
+    pruning."""
+    ASPHelper._excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    ASPHelper._excluded.clear()
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune supported weights to n:m sparsity (reference asp.py:302)."""
+    algo = MaskAlgo(mask_algo) if not isinstance(mask_algo, MaskAlgo) \
+        else mask_algo
+    return ASPHelper.prune_model(model, n=n, m=m, mask_algo=algo,
+                                 with_mask=with_mask)
+
+
+class _DecoratedStep:
+    def __init__(self, optimizer):
+        self._opt = optimizer
+        self._orig_step = optimizer.step
+
+    def __call__(self, *args, **kwargs):
+        out = self._orig_step(*args, **kwargs)
+        # re-apply masks to every registered param this optimizer owns
+        params = getattr(self._opt, "_parameter_list", None) or []
+        for p in params:
+            mask = ASPHelper.mask_for(p)
+            if mask is not None:
+                p.set_value(Tensor(p._data * jnp.asarray(mask)))
+        return out
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply sparsity masks after each update
+    (reference asp.py:216 OptimizerWithSparsityGuarantee)."""
+    optimizer.step = _DecoratedStep(optimizer)
+    return optimizer

@@ -1,0 +1,136 @@
+"""Automatic SParsity (ASP) tests (reference python/paddle/incubate/asp/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import asp
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    asp.ASPHelper.reset()
+    asp.reset_excluded_layers()
+    yield
+    asp.ASPHelper.reset()
+    asp.reset_excluded_layers()
+
+
+class TestMasks:
+    def test_mask_1d_structure_and_magnitude(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 16).astype(np.float32)
+        mask = asp.get_mask_1d(w, 2, 4)
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert abs(asp.calculate_density(mask) - 0.5) < 1e-6
+        # kept entries are the 2 largest |w| per group of 4
+        groups = np.abs(w.reshape(-1, 4))
+        kept = mask.reshape(-1, 4).astype(bool)
+        for g, k in zip(groups, kept):
+            assert set(np.argsort(-g)[:2]) == set(np.nonzero(k)[0])
+
+    def test_mask_2d_greedy(self):
+        rng = np.random.RandomState(1)
+        w = rng.randn(8, 8).astype(np.float32)
+        mask = asp.get_mask_2d_greedy(w, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        assert asp.calculate_density(mask) <= 0.5 + 1e-6
+
+    def test_check_rejects_dense(self):
+        assert not asp.check_mask_1d(np.ones((4, 8)), 2, 4)
+        assert not asp.check_mask_2d(np.ones((8, 8)), 2, 4)
+
+    def test_checking_method_mapping(self):
+        assert asp.CheckMethod.get_checking_method(
+            asp.MaskAlgo.MASK_1D) == asp.CheckMethod.CHECK_1D
+        assert asp.CheckMethod.get_checking_method(
+            asp.MaskAlgo.MASK_2D_GREEDY) == asp.CheckMethod.CHECK_2D
+
+
+class TestPruneAndTrain:
+    def _model(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                             nn.Linear(32, 4))
+
+    def test_prune_model_sparsifies_weights_only(self):
+        net = self._model()
+        masks = asp.prune_model(net)
+        assert len(masks) == 2  # two Linear weights, no biases
+        for name, p in net.named_parameters():
+            if name in masks:
+                arr = p.numpy()
+                assert asp.check_mask_1d(arr, 2, 4)
+                assert abs(asp.calculate_density(arr) - 0.5) < 0.01
+
+    def test_excluded_layers(self):
+        net = self._model()
+        asp.set_excluded_layers(["0."])  # first Linear
+        masks = asp.prune_model(net)
+        assert len(masks) == 1
+
+    def test_decorated_optimizer_preserves_sparsity(self):
+        net = self._model()
+        asp.prune_model(net)
+        opt = asp.decorate(paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=net.parameters()))
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randint(0, 4, 8).astype(np.int64))
+        import paddle_tpu.nn.functional as F
+        l0 = lN = None
+        for i in range(10):
+            loss = F.cross_entropy(net(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if i == 0:
+                l0 = float(loss.numpy())
+            lN = float(loss.numpy())
+        assert lN < l0  # still trains
+        for name, p in net.named_parameters():
+            if "bias" not in name:
+                arr = p.numpy()
+                assert asp.check_mask_1d(arr, 2, 4), name
+                assert abs(asp.calculate_density(arr) - 0.5) < 0.01
+
+
+class TestReviewFixes:
+    def test_exclusion_prefix_no_overmatch(self):
+        # "0." must not exclude layer "10."
+        layers = [nn.Linear(8, 8) for _ in range(11)]
+        net = nn.Sequential(*layers)
+        asp.set_excluded_layers(["0."])
+        masks = asp.prune_model(net)
+        assert not any(k.startswith("0.") for k in masks)
+        assert any(k.startswith("10.") for k in masks)
+
+    def test_two_models_same_names_independent_masks(self):
+        a = nn.Sequential(nn.Linear(8, 16))
+        b = nn.Sequential(nn.Linear(8, 32))  # same name "0.weight"
+        asp.prune_model(a)
+        asp.prune_model(b)
+        # each decorated optimizer applies its own model's mask
+        pa = dict(a.named_parameters())["0.weight"]
+        pb = dict(b.named_parameters())["0.weight"]
+        ma = asp.ASPHelper.mask_for(pa)
+        mb = asp.ASPHelper.mask_for(pb)
+        assert ma.shape == (8, 16) and mb.shape == (8, 32)
+
+    def test_stopped_epoch_recorded(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.io import TensorDataset
+        rng = np.random.RandomState(0)
+        ds = TensorDataset([
+            paddle.to_tensor(rng.rand(16, 4).astype(np.float32)),
+            paddle.to_tensor(rng.randint(0, 2, 16).astype(np.int64))])
+        net = nn.Linear(4, 2)
+        model = paddle.hapi.Model(net)
+        model.prepare(paddle.optimizer.SGD(learning_rate=0.0,
+                                           parameters=net.parameters()),
+                      paddle.nn.CrossEntropyLoss())
+        es = paddle.hapi.EarlyStopping(monitor="loss", patience=1,
+                                       verbose=0)
+        model.fit(ds, eval_data=ds, epochs=10, batch_size=16, verbose=0,
+                  callbacks=[es])
+        assert es.stopped_epoch >= 0
