@@ -205,7 +205,7 @@ def _bench_bert(small):
     else:
         cfg = BertConfig(hidden_dropout_prob=0.0,
                          attention_probs_dropout_prob=0.0)
-        batch, seq, iters = 32, 512, 10
+        batch, seq, iters = 48, 512, 10
     model = BertForPretraining(cfg)
     params = [p for p in model.parameters() if not p.stop_gradient]
 

@@ -198,7 +198,8 @@ class TestPagedAttention:
             pytest.skip("needs >= 4 devices")
         mesh = mesh_mod.build_mesh({"dp": 2, "mp": 2},
                                    devices=jax.devices()[:4])
-        prev = mesh_mod.get_mesh() if hasattr(mesh_mod, "get_mesh") else None
+        # save WITHOUT the lazy-create side effect of get_mesh()
+        prev = mesh_mod._global_mesh
         mesh_mod.set_mesh(mesh)
         try:
             rng = np.random.RandomState(7)
@@ -225,5 +226,4 @@ class TestPagedAttention:
             np.testing.assert_allclose(out.numpy(), ref.numpy(),
                                        atol=2e-5)
         finally:
-            if prev is not None:
-                mesh_mod.set_mesh(prev)
+            mesh_mod.set_mesh(prev)  # restore exactly, including None
