@@ -1,0 +1,108 @@
+"""paddle.text.datasets tests (reference python/paddle/text/datasets/)
+— miniature archives in the exact reference formats."""
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.text import Imdb, Imikolov, UCIHousing
+
+
+class TestUCIHousing:
+    def _write(self, tmp_path, rows=20):
+        rng = np.random.RandomState(0)
+        data = rng.rand(rows, 14).astype(np.float32) * 10
+        p = tmp_path / "housing.data"
+        with open(p, "w") as f:
+            for r in data:
+                f.write(" ".join(f"{v:.4f}" for v in r) + "\n")
+        return str(p), data
+
+    def test_split_and_normalization(self, tmp_path):
+        p, raw = self._write(tmp_path)
+        tr = UCIHousing(data_file=p, mode="train")
+        te = UCIHousing(data_file=p, mode="test")
+        assert len(tr) == 16 and len(te) == 4
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features normalized ((v-avg)/(max-min)) -> bounded by 1
+        assert np.abs(x).max() <= 1.0
+        # target column untouched
+        np.testing.assert_allclose(float(y[0]), raw[0, -1], rtol=1e-4)
+
+    def test_missing_file(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="No-egress"):
+            UCIHousing(data_file=str(tmp_path / "nope"))
+
+
+def _write_imdb(tmp_path):
+    root = tmp_path / "aclImdb"
+    texts = {
+        ("train", "pos"): ["great movie really great", "loved it great fun"],
+        ("train", "neg"): ["terrible film really terrible",
+                           "hated it terrible bore"],
+        ("test", "pos"): ["great fun"],
+        ("test", "neg"): ["terrible bore"],
+    }
+    for (split, senti), docs in texts.items():
+        d = root / split / senti
+        os.makedirs(d)
+        for i, t in enumerate(docs):
+            (d / f"{i}.txt").write_text(t)
+    tar = tmp_path / "aclImdb_v1.tar.gz"
+    with tarfile.open(tar, "w:gz") as tf:
+        tf.add(root, arcname="aclImdb")
+    return str(tar)
+
+
+class TestImdb:
+    def test_word_dict_and_labels(self, tmp_path):
+        tar = _write_imdb(tmp_path)
+        ds = Imdb(data_file=tar, mode="train", cutoff=1)
+        # words with freq > 1 across the whole corpus
+        assert "great" in ds.word_idx and "terrible" in ds.word_idx
+        assert "<unk>" in ds.word_idx
+        assert len(ds) == 4
+        labels = [int(ds[i][1]) for i in range(len(ds))]
+        assert labels.count(0) == 2 and labels.count(1) == 2  # pos=0, neg=1
+        ids, _ = ds[0]
+        assert ids.dtype == np.int64 and ids.ndim == 1
+
+    def test_test_split(self, tmp_path):
+        tar = _write_imdb(tmp_path)
+        ds = Imdb(data_file=tar, mode="test", cutoff=1)
+        assert len(ds) == 2
+
+
+class TestImikolov:
+    def _write(self, tmp_path):
+        root = tmp_path / "simple-examples" / "data"
+        os.makedirs(root)
+        train = "the cat sat\nthe dog sat\nthe cat ran\n" * 20
+        valid = "the cat sat\n"
+        (root / "ptb.train.txt").write_text(train)
+        (root / "ptb.valid.txt").write_text(valid)
+        tar = tmp_path / "simple-examples.tgz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(root.parent, arcname="simple-examples")
+        return str(tar)
+
+    def test_ngram_windows(self, tmp_path):
+        tar = self._write(tmp_path)
+        ds = Imikolov(data_file=tar, data_type="NGRAM", window_size=3,
+                      mode="train", min_word_freq=5)
+        assert "the" in ds.word_idx and "cat" in ds.word_idx
+        (w,) = ds[0]
+        assert w.shape == (3,)
+        # each 5-token wrapped sentence yields 3 windows; 60 sentences
+        assert len(ds) == 180
+
+    def test_seq_mode_valid_split(self, tmp_path):
+        tar = self._write(tmp_path)
+        ds = Imikolov(data_file=tar, data_type="SEQ", mode="valid",
+                      min_word_freq=5)
+        assert len(ds) == 1
+        (seq,) = ds[0]
+        assert seq.shape == (5,)  # <s> the cat sat <e>

@@ -77,6 +77,9 @@ def test_onnx_export_points_to_stablehlo():
 
 
 def test_text_datasets_raise_clearly():
-    from paddle_tpu.text import Imdb
-    with pytest.raises(NotImplementedError, match="egress"):
+    # implemented loaders require a local archive; the rest still stub
+    from paddle_tpu.text import Conll05st, Imdb
+    with pytest.raises(FileNotFoundError, match="No-egress"):
         Imdb()
+    with pytest.raises(NotImplementedError, match="egress"):
+        Conll05st()
