@@ -118,7 +118,8 @@ class Imdb(Dataset):
                 self.labels.append(label)
 
     def __getitem__(self, idx):
-        return self.docs[idx], np.asarray(self.labels[idx], np.int64)
+        # label shape (1,) like the reference (np.array([label]))
+        return self.docs[idx], np.asarray([self.labels[idx]], np.int64)
 
     def __len__(self):
         return len(self.docs)
@@ -136,6 +137,7 @@ class Imikolov(Dataset):
             raise ValueError("data_type must be NGRAM or SEQ")
         if data_type.upper() == "NGRAM" and window_size < 1:
             raise ValueError("NGRAM needs window_size >= 1")
+        # SEQ mode: window_size > 0 filters long sequences (reference)
         if mode.lower() not in ("train", "valid", "test"):
             raise ValueError(f"mode must be train|valid|test, got {mode!r}")
         self.data_file = data_file
@@ -152,16 +154,21 @@ class Imikolov(Dataset):
         raise ValueError(f"no ptb.{split}.txt in {self.data_file}")
 
     def _build_word_dict(self, min_word_freq):
+        # reference word_count: train + valid files, with <s>/<e> counted
+        # once per line so they always earn real dict entries
         freq = collections.Counter()
         with tarfile.open(self.data_file) as tarf:
-            text = tarf.extractfile(
-                self._member(tarf, "train")).read().decode()
-        for line in text.splitlines():
-            for w in line.strip().split():
-                freq[w] += 1
+            for split in ("train", "valid"):
+                text = tarf.extractfile(
+                    self._member(tarf, split)).read().decode()
+                for line in text.splitlines():
+                    if not line.strip():
+                        continue
+                    freq["<s>"] += 1
+                    freq["<e>"] += 1
+                    for w in line.strip().split():
+                        freq[w] += 1
         freq.pop("<unk>", None)
-        freq.pop("<s>", None)
-        freq.pop("<e>", None)
         words = [w for w, f in freq.items() if f > min_word_freq]
         words.sort(key=lambda w: (-freq[w], w))
         word_idx = {w: i for i, w in enumerate(words)}
@@ -179,19 +186,28 @@ class Imikolov(Dataset):
         for line in text.splitlines():
             if not line.strip():
                 continue
-            ids = ([self.word_idx.get("<s>", unk)]
-                   + [self.word_idx.get(w, unk)
-                      for w in line.strip().split()]
-                   + [self.word_idx.get("<e>", unk)])
+            body = [self.word_idx.get(w, unk)
+                    for w in line.strip().split()]
+            s_id = self.word_idx.get("<s>", unk)
+            e_id = self.word_idx.get("<e>", unk)
             if self.data_type == "NGRAM":
+                ids = [s_id] + body + [e_id]
                 for i in range(len(ids) - self.window_size + 1):
                     self.data.append(
                         np.asarray(ids[i:i + self.window_size], np.int64))
             else:
-                self.data.append(np.asarray(ids, np.int64))
+                # reference SEQ contract: (src=[<s>]+l, trg=l+[<e>]),
+                # dropped when window_size > 0 and src exceeds it
+                src = [s_id] + body
+                trg = body + [e_id]
+                if 0 < self.window_size < len(src):
+                    continue
+                self.data.append((np.asarray(src, np.int64),
+                                  np.asarray(trg, np.int64)))
 
     def __getitem__(self, idx):
-        return (self.data[idx],)
+        item = self.data[idx]
+        return item if isinstance(item, tuple) else (item,)
 
     def __len__(self):
         return len(self.data)

@@ -20,7 +20,7 @@ import numpy as np
 from ..io import Dataset
 
 __all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
-           "ImageFolder"]
+           "ImageFolder", "Flowers", "VOC2012"]
 
 
 class MNIST(Dataset):
@@ -226,3 +226,111 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class Flowers(Dataset):
+    """Flowers102 (reference flowers.py Flowers): 102flowers.tgz image
+    archive + imagelabels.mat + setid.mat subset indices. Like the
+    reference, the tgz is extracted to a sibling directory once — gzip
+    tars have no cheap random access, and per-file reads are
+    fork-worker-safe."""
+
+    _SPLIT_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        if mode not in self._SPLIT_KEY:
+            raise ValueError(f"mode must be train|valid|test, got {mode!r}")
+        for p, what in ((data_file, "Flowers images (102flowers.tgz)"),
+                        (label_file, "Flowers labels (imagelabels.mat)"),
+                        (setid_file, "Flowers splits (setid.mat)")):
+            if p is None or not os.path.exists(p):
+                raise FileNotFoundError(
+                    f"{what}: {p!r} not found (no-egress environment; "
+                    f"provide the reference archives)")
+        from scipy.io import loadmat
+        self.transform = transform
+        self.labels = loadmat(label_file)["labels"].ravel()
+        self.indexes = loadmat(setid_file)[
+            self._SPLIT_KEY[mode]].ravel()
+        # reference behavior: one-time extractall next to the archive
+        self.data_path = data_file + ".extracted"
+        marker = os.path.join(self.data_path, ".complete")
+        if not os.path.exists(marker):
+            os.makedirs(self.data_path, exist_ok=True)
+            with tarfile.open(data_file) as tar:
+                tar.extractall(self.data_path)
+            open(marker, "w").close()
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        index = int(self.indexes[idx])
+        label = np.array([int(self.labels[index - 1])], np.int64)
+        path = os.path.join(self.data_path, "jpg",
+                            "image_%05d.jpg" % index)
+        image = Image.open(path).convert("RGB")
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """VOC2012 segmentation (reference voc2012.py VOC2012): reads
+    JPEGImages + SegmentationClass pairs for the split listed under
+    ImageSets/Segmentation/{mode}.txt, straight from the tar."""
+
+    SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    # reference MODE_FLAG_MAP (voc2012.py): the VOCtrainval archive has no
+    # held-out test listing, so 'train' reads trainval and 'test' train
+    MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        if mode not in self.MODE_FLAG_MAP:
+            raise ValueError(
+                f"mode must be train|valid|test, got {mode!r}")
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"VOC2012: {data_file!r} not found (no-egress environment; "
+                f"provide the reference VOCtrainval archive)")
+        self.transform = transform
+        self._data_file = data_file
+        self._pid = os.getpid()
+        self._tar = tarfile.open(data_file)
+        self.name2mem = {m.name: m for m in self._tar.getmembers()}
+        listing = self._tar.extractfile(self.name2mem[
+            self.SET_FILE.format(self.MODE_FLAG_MAP[mode])]).read().decode()
+        self.ids = [l.strip() for l in listing.splitlines() if l.strip()]
+
+    def _tarfile(self):
+        # forked DataLoader workers share the parent's fd offset; each
+        # process must own its handle
+        if os.getpid() != self._pid:
+            self._tar = tarfile.open(self._data_file)
+            self.name2mem = {m.name: m for m in self._tar.getmembers()}
+            self._pid = os.getpid()
+        return self._tar
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+        name = self.ids[idx]
+        tar = self._tarfile()
+        data = tar.extractfile(
+            self.name2mem[self.DATA_FILE.format(name)]).read()
+        label = tar.extractfile(
+            self.name2mem[self.LABEL_FILE.format(name)]).read()
+        image = Image.open(_io.BytesIO(data)).convert("RGB")
+        seg = Image.open(_io.BytesIO(label))
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, np.asarray(seg, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.ids)

@@ -67,8 +67,9 @@ class TestImdb:
         assert len(ds) == 4
         labels = [int(ds[i][1]) for i in range(len(ds))]
         assert labels.count(0) == 2 and labels.count(1) == 2  # pos=0, neg=1
-        ids, _ = ds[0]
+        ids, lbl = ds[0]
         assert ids.dtype == np.int64 and ids.ndim == 1
+        assert lbl.shape == (1,)  # reference label shape
 
     def test_test_split(self, tmp_path):
         tar = _write_imdb(tmp_path)
@@ -96,7 +97,8 @@ class TestImikolov:
         assert "the" in ds.word_idx and "cat" in ds.word_idx
         (w,) = ds[0]
         assert w.shape == (3,)
-        # each 5-token wrapped sentence yields 3 windows; 60 sentences
+        # each 5-token wrapped sentence yields 3 windows; 60 train + 1
+        # valid sentences feed the DICT, windows come from train only
         assert len(ds) == 180
 
     def test_seq_mode_valid_split(self, tmp_path):
@@ -104,5 +106,21 @@ class TestImikolov:
         ds = Imikolov(data_file=tar, data_type="SEQ", mode="valid",
                       min_word_freq=5)
         assert len(ds) == 1
-        (seq,) = ds[0]
-        assert seq.shape == (5,)  # <s> the cat sat <e>
+        src, trg = ds[0]  # reference pair contract
+        assert src.shape == (4,) and trg.shape == (4,)
+        # src starts with <s>, trg ends with <e>
+        assert int(src[0]) == ds.word_idx["<s>"]
+        assert int(trg[-1]) == ds.word_idx["<e>"]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+    def test_seq_window_filter(self, tmp_path):
+        tar = self._write(tmp_path)
+        ds = Imikolov(data_file=tar, data_type="SEQ", mode="train",
+                      window_size=3, min_word_freq=5)
+        assert len(ds) == 0  # all src sequences are length 4 > 3
+
+    def test_boundary_tokens_in_dict(self, tmp_path):
+        tar = self._write(tmp_path)
+        ds = Imikolov(data_file=tar, data_type="NGRAM", window_size=2,
+                      mode="train", min_word_freq=5)
+        assert "<s>" in ds.word_idx and "<e>" in ds.word_idx

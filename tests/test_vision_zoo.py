@@ -250,3 +250,70 @@ class TestTransformsFloatAndGray:
     def test_hue_on_float_raises(self):
         with pytest.raises(TypeError, match="uint8"):
             T.adjust_hue(np.random.rand(4, 4, 3).astype(np.float32), 0.1)
+
+
+class TestFlowersVOC:
+    def test_flowers(self, tmp_path):
+        from PIL import Image
+        from scipy.io import savemat
+        # 4-image miniature in the reference layout
+        jpg_dir = tmp_path / "jpg"
+        os.makedirs(jpg_dir)
+        for i in range(1, 5):
+            arr = np.full((6, 6, 3), i * 40, np.uint8)
+            Image.fromarray(arr).save(jpg_dir / ("image_%05d.jpg" % i))
+        data_tar = tmp_path / "102flowers.tgz"
+        with tarfile.open(data_tar, "w:gz") as tf:
+            tf.add(jpg_dir, arcname="jpg")
+        savemat(tmp_path / "imagelabels.mat",
+                {"labels": np.array([[3, 1, 4, 1]])})
+        savemat(tmp_path / "setid.mat",
+                {"trnid": np.array([[1, 3]]), "valid": np.array([[2]]),
+                 "tstid": np.array([[4]])})
+        from paddle_tpu.vision.datasets import Flowers
+        tr = Flowers(str(data_tar), str(tmp_path / "imagelabels.mat"),
+                     str(tmp_path / "setid.mat"), mode="train")
+        assert len(tr) == 2
+        img, lbl = tr[0]
+        assert int(lbl[0]) == 3 and np.asarray(img).shape == (6, 6, 3)
+        te = Flowers(str(data_tar), str(tmp_path / "imagelabels.mat"),
+                     str(tmp_path / "setid.mat"), mode="test")
+        assert len(te) == 1 and int(te[0][1][0]) == 1
+
+    def test_voc2012(self, tmp_path):
+        from PIL import Image
+        root = tmp_path / "VOCdevkit" / "VOC2012"
+        os.makedirs(root / "JPEGImages")
+        os.makedirs(root / "SegmentationClass")
+        os.makedirs(root / "ImageSets" / "Segmentation")
+        names = ["2007_000032", "2007_000033"]
+        for n in names:
+            Image.fromarray(np.zeros((5, 7, 3), np.uint8)).save(
+                root / "JPEGImages" / f"{n}.jpg")
+            Image.fromarray(np.full((5, 7), 2, np.uint8)).save(
+                root / "SegmentationClass" / f"{n}.png")
+        (root / "ImageSets" / "Segmentation" / "trainval.txt").write_text(
+            "\n".join(names))
+        (root / "ImageSets" / "Segmentation" / "train.txt").write_text(
+            "\n".join(names))
+        (root / "ImageSets" / "Segmentation" / "val.txt").write_text(
+            names[0])
+        tar = tmp_path / "voc.tar"
+        with tarfile.open(tar, "w") as tf:
+            tf.add(tmp_path / "VOCdevkit", arcname="VOCdevkit")
+        from paddle_tpu.vision.datasets import VOC2012
+        tr = VOC2012(str(tar), mode="train")
+        assert len(tr) == 2
+        img, seg = tr[0]
+        assert np.asarray(img).shape == (5, 7, 3)
+        assert seg.shape == (5, 7) and int(seg[0, 0]) == 2
+        va = VOC2012(str(tar), mode="valid")
+        assert len(va) == 1
+
+    def test_missing_archives_raise(self, tmp_path):
+        from paddle_tpu.vision.datasets import VOC2012, Flowers
+        with pytest.raises(FileNotFoundError):
+            Flowers(str(tmp_path / "a"), str(tmp_path / "b"),
+                    str(tmp_path / "c"))
+        with pytest.raises(FileNotFoundError):
+            VOC2012(str(tmp_path / "nope.tar"))
