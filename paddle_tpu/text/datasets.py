@@ -15,12 +15,14 @@ import os
 import re
 import string
 import tarfile
+import zipfile
 
 import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["UCIHousing", "Imdb", "Imikolov"]
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens",
+           "MovieInfo", "UserInfo"]
 
 
 def _require(path, what):
@@ -208,6 +210,101 @@ class Imikolov(Dataset):
     def __getitem__(self, idx):
         item = self.data[idx]
         return item if isinstance(item, tuple) else (item,)
+
+    def __len__(self):
+        return len(self.data)
+
+
+_AGE_TABLE = [1, 18, 25, 35, 45, 50, 56]
+
+
+class MovieInfo:
+    """Movie id/categories/title record (reference movielens.py
+    MovieInfo)."""
+
+    def __init__(self, index, categories, title):
+        self.index = int(index)
+        self.categories = categories
+        self.title = title
+
+    def value(self, categories_dict, movie_title_dict):
+        return [
+            [self.index],
+            [categories_dict[c] for c in self.categories],
+            [movie_title_dict[w.lower()] for w in self.title.split()],
+        ]
+
+
+class UserInfo:
+    """User id/gender/age/job record (reference movielens.py UserInfo)."""
+
+    def __init__(self, index, gender, age, job_id):
+        self.index = int(index)
+        self.is_male = gender == "M"
+        self.age = _AGE_TABLE.index(int(age))
+        self.job_id = int(job_id)
+
+    def value(self):
+        return [[self.index], [0 if self.is_male else 1], [self.age],
+                [self.job_id]]
+
+
+class Movielens(Dataset):
+    """Movielens-1M ratings (reference movielens.py Movielens): parses
+    ml-1m/{movies,users,ratings}.dat from the zip; each item is
+    (uid, gender, age, job, movie_id, categories, title_words, rating)
+    with rating rescaled to [-5+2, 5] via r*2-5 and a random
+    test_ratio split seeded by rand_seed."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        _require(data_file, "Movielens (ml-1m.zip)")
+        if mode.lower() not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode!r}")
+        self.mode = mode.lower()
+        pattern = re.compile(r"^(.*)\((\d+)\)$")
+        self.movie_info, self.user_info = {}, {}
+        title_words, categories = set(), set()
+        with zipfile.ZipFile(data_file) as package:
+            with package.open("ml-1m/movies.dat") as f:
+                for line in f:
+                    mid, title, cats = line.decode(
+                        "latin").strip().split("::")
+                    cats = cats.split("|")
+                    categories.update(cats)
+                    title = pattern.match(title).group(1)
+                    self.movie_info[int(mid)] = MovieInfo(mid, cats, title)
+                    title_words.update(w.lower() for w in title.split())
+            self.movie_title_dict = {w: i for i, w in
+                                     enumerate(sorted(title_words))}
+            self.categories_dict = {c: i for i, c in
+                                    enumerate(sorted(categories))}
+            with package.open("ml-1m/users.dat") as f:
+                for line in f:
+                    uid, gender, age, job, _ = line.decode(
+                        "latin").strip().split("::")
+                    self.user_info[int(uid)] = UserInfo(uid, gender, age,
+                                                        job)
+            rng = np.random.RandomState(rand_seed)
+            is_test = self.mode == "test"
+            self.data = []
+            with package.open("ml-1m/ratings.dat") as f:
+                for line in f:
+                    if (rng.random_sample() < test_ratio) != is_test:
+                        continue
+                    uid, mid, rating, _ = line.decode(
+                        "latin").strip().split("::")
+                    rating = float(rating) * 2 - 5.0
+                    mov = self.movie_info[int(mid)]
+                    usr = self.user_info[int(uid)]
+                    self.data.append(
+                        usr.value()
+                        + mov.value(self.categories_dict,
+                                    self.movie_title_dict)
+                        + [[rating]])
+
+    def __getitem__(self, idx):
+        return tuple(np.array(d) for d in self.data[idx])
 
     def __len__(self):
         return len(self.data)

@@ -124,3 +124,48 @@ class TestImikolov:
         ds = Imikolov(data_file=tar, data_type="NGRAM", window_size=2,
                       mode="train", min_word_freq=5)
         assert "<s>" in ds.word_idx and "<e>" in ds.word_idx
+
+
+class TestMovielens:
+    def _write(self, tmp_path):
+        import zipfile
+        z = tmp_path / "ml-1m.zip"
+        movies = ("1::Toy Story (1995)::Animation|Comedy\n"
+                  "2::Heat (1995)::Action|Crime\n")
+        users = ("1::M::25::3::55117\n"
+                 "2::F::18::7::02460\n")
+        ratings = "".join(f"{u}::{m}::{r}::978300760\n"
+                          for u, m, r in [(1, 1, 5), (1, 2, 3),
+                                          (2, 1, 4), (2, 2, 1)] * 10)
+        with zipfile.ZipFile(z, "w") as zf:
+            zf.writestr("ml-1m/movies.dat", movies)
+            zf.writestr("ml-1m/users.dat", users)
+            zf.writestr("ml-1m/ratings.dat", ratings)
+        return str(z)
+
+    def test_parse_and_split(self, tmp_path):
+        from paddle_tpu.text import Movielens
+        z = self._write(tmp_path)
+        tr = Movielens(data_file=z, mode="train", test_ratio=0.25,
+                       rand_seed=0)
+        te = Movielens(data_file=z, mode="test", test_ratio=0.25,
+                       rand_seed=0)
+        assert len(tr) + len(te) == 40
+        assert len(te) > 0
+        item = tr[0]
+        # (uid, gender, age_idx, job, movie_id, categories, title, rating)
+        assert len(item) == 8
+        uid, gender, age, job, mid, cats, title, rating = item
+        assert gender[0] in (0, 1)
+        assert rating.shape == (1,) and -5.0 <= float(rating[0]) <= 5.0
+        # rating rescale r*2-5: raw 5 -> 5.0, raw 1 -> -3.0
+        all_ratings = {float(tr[i][7][0]) for i in range(len(tr))}
+        assert all_ratings.issubset({5.0, 1.0, 3.0, -3.0})
+
+    def test_vocab_dicts(self, tmp_path):
+        from paddle_tpu.text import Movielens
+        z = self._write(tmp_path)
+        ds = Movielens(data_file=z, mode="train")
+        assert set(ds.categories_dict) == {"Animation", "Comedy",
+                                           "Action", "Crime"}
+        assert "toy" in ds.movie_title_dict and "heat" in ds.movie_title_dict
