@@ -22,7 +22,7 @@ import numpy as np
 from ..io import Dataset
 
 __all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens",
-           "MovieInfo", "UserInfo"]
+           "MovieInfo", "UserInfo", "Conll05st"]
 
 
 def _require(path, what):
@@ -308,3 +308,135 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference conll05.py Conll05st): parses
+    the words/props gz pair inside the release tar into BIO-tagged
+    (sentence, predicate, labels) items, with word/predicate/label dicts
+    from their separate files. Yields the reference 9-tuple:
+    (word, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred, mark, label).
+    """
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, mode="test",
+                 download=True):
+        import gzip as _gzip
+        if mode != "test":
+            raise ValueError(
+                "Conll05st ships only the WSJ test split (the reference "
+                "loader likewise); mode must be 'test'")
+        for p, what in ((data_file, "Conll05st release tar"),
+                        (word_dict_file, "word dict"),
+                        (verb_dict_file, "verb dict"),
+                        (target_dict_file, "target dict")):
+            _require(p, f"Conll05st {what}")
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self.sentences, self.predicates, self.labels = [], [], []
+        with tarfile.open(data_file) as tf:
+            wf = tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz")
+            pf = tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz")
+            with _gzip.GzipFile(fileobj=wf) as words_file, \
+                    _gzip.GzipFile(fileobj=pf) as props_file:
+                self._parse(words_file, props_file)
+
+    @staticmethod
+    def _load_dict(filename):
+        with open(filename) as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(filename):
+        tag_dict = set()
+        with open(filename) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tag_dict.add(line[2:])
+        d = {}
+        index = 0
+        for tag in sorted(tag_dict):  # deterministic across processes
+            d["B-" + tag] = index
+            d["I-" + tag] = index + 1
+            index += 2
+        d["O"] = index
+        return d
+
+    def _parse(self, words_file, props_file):
+        """Column-major props -> BIO spans (reference _load_anno)."""
+        sentences, labels, one_seg = [], [], []
+        for word, label in zip(words_file, props_file):
+            word = word.strip().decode()
+            label = label.strip().decode().split()
+            if len(label) == 0:  # sentence boundary
+                for i in range(len(one_seg[0]) if one_seg else 0):
+                    labels.append([x[i] for x in one_seg])
+                if len(labels) >= 1:
+                    verb_list = [x for x in labels[0] if x != "-"]
+                    for i, lbl in enumerate(labels[1:]):
+                        cur_tag = "O"
+                        in_bracket = False
+                        seq = []
+                        for tok in lbl:
+                            if tok == "*" and not in_bracket:
+                                seq.append("O")
+                            elif tok == "*" and in_bracket:
+                                seq.append("I-" + cur_tag)
+                            elif tok == "*)":
+                                seq.append("I-" + cur_tag)
+                                in_bracket = False
+                            elif "(" in tok and ")" in tok:
+                                cur_tag = tok[1:tok.find("*")]
+                                seq.append("B-" + cur_tag)
+                                in_bracket = False
+                            elif "(" in tok:
+                                cur_tag = tok[1:tok.find("*")]
+                                seq.append("B-" + cur_tag)
+                                in_bracket = True
+                            else:
+                                raise RuntimeError(
+                                    f"Unexpected label: {tok}")
+                        self.sentences.append(sentences)
+                        self.predicates.append(verb_list[i])
+                        self.labels.append(seq)
+                sentences, labels, one_seg = [], [], []
+            else:
+                sentences.append(word)
+                one_seg.append(label)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        predicate = self.predicates[idx]
+        labels = self.labels[idx]
+        sen_len = len(sentence)
+        verb_index = labels.index("B-V")
+        mark = [0] * len(labels)
+        ctx = {}
+        for off, key, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                              (0, "0", None), (1, "p1", "eos"),
+                              (2, "p2", "eos")):
+            j = verb_index + off
+            if 0 <= j < len(labels):
+                mark[j] = 1
+                ctx[key] = sentence[j]
+            else:
+                ctx[key] = pad
+        get = lambda w: self.word_dict.get(w, self.UNK_IDX)
+        return (np.array([get(w) for w in sentence]),
+                np.array([get(ctx["n2"])] * sen_len),
+                np.array([get(ctx["n1"])] * sen_len),
+                np.array([get(ctx["0"])] * sen_len),
+                np.array([get(ctx["p1"])] * sen_len),
+                np.array([get(ctx["p2"])] * sen_len),
+                np.array([self.predicate_dict.get(predicate)] * sen_len),
+                np.array(mark),
+                np.array([self.label_dict.get(w) for w in labels]))
+
+    def __len__(self):
+        return len(self.sentences)

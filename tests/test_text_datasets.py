@@ -169,3 +169,81 @@ class TestMovielens:
         assert set(ds.categories_dict) == {"Animation", "Comedy",
                                            "Action", "Crime"}
         assert "toy" in ds.movie_title_dict and "heat" in ds.movie_title_dict
+
+
+class TestConll05st:
+    def _write(self, tmp_path):
+        import gzip
+        root = tmp_path / "conll05st-release" / "test.wsj"
+        os.makedirs(root / "words")
+        os.makedirs(root / "props")
+        wlines, plines = [], []
+        # sentence 1: one predicate
+        for w, pr, tg in zip(["The", "cat", "sat", "."],
+                             [["-"], ["-"], ["sat"], ["-"]],
+                             [["(A0*"], ["*)"], ["(V*)"], ["*"]]):
+            wlines.append(w)
+            plines.append("\t".join(pr + tg))
+        wlines.append("")
+        plines.append("")
+        # sentence 2: TWO predicates (two tag columns) — exercises the
+        # column transposition + verb_list alignment
+        for w, pr, t1, t2 in zip(
+                ["Dogs", "ran", "and", "barked"],
+                [["-"], ["ran"], ["-"], ["barked"]],
+                [["(A0*)"], ["(V*)"], ["*"], ["*"]],
+                [["(A0*)"], ["*"], ["*"], ["(V*)"]]):
+            wlines.append(w)
+            plines.append("\t".join(pr + t1 + t2))
+        wlines.append("")
+        plines.append("")
+        with gzip.open(root / "words" / "test.wsj.words.gz", "wt") as f:
+            f.write("\n".join(wlines) + "\n")
+        with gzip.open(root / "props" / "test.wsj.props.gz", "wt") as f:
+            f.write("\n".join(plines) + "\n")
+        tar = tmp_path / "conll05st-tests.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(tmp_path / "conll05st-release",
+                   arcname="conll05st-release")
+        (tmp_path / "wordDict.txt").write_text(
+            "UNK\nThe\ncat\nsat\n.\n")
+        (tmp_path / "verbDict.txt").write_text("sat\nran\nbarked\n")
+        (tmp_path / "targetDict.txt").write_text(
+            "B-A0\nI-A0\nB-V\nI-V\nO\n")
+        return (str(tar), str(tmp_path / "wordDict.txt"),
+                str(tmp_path / "verbDict.txt"),
+                str(tmp_path / "targetDict.txt"))
+
+    def test_parse_and_getitem(self, tmp_path):
+        from paddle_tpu.text import Conll05st
+        tar, wd, vd, td = self._write(tmp_path)
+        ds = Conll05st(data_file=tar, word_dict_file=wd,
+                       verb_dict_file=vd, target_dict_file=td)
+        assert len(ds) == 3  # 1 predicate + 2 predicates
+        (word, n2, n1, c0, p1, p2, pred, mark, label) = ds[0]
+        assert word.shape == (4,)
+        # BIO conversion: (A0* *) (V*) * -> B-A0 I-A0 B-V O
+        names = {v: k for k, v in ds.label_dict.items()}
+        assert [names[int(x)] for x in label] == \
+            ["B-A0", "I-A0", "B-V", "O"]
+        # mark flags the verb window
+        assert mark.tolist().count(1) >= 3
+        assert int(pred[0]) == ds.predicate_dict["sat"]
+        # multi-predicate sentence: each item aligned to ITS verb column
+        names = {v: k for k, v in ds.label_dict.items()}
+        (_, _, _, _, _, _, pred2, _, lab2) = ds[1]
+        assert int(pred2[0]) == ds.predicate_dict["ran"]
+        assert [names[int(x)] for x in lab2] == ["B-A0", "B-V", "O", "O"]
+        (_, _, _, _, _, _, pred3, _, lab3) = ds[2]
+        assert int(pred3[0]) == ds.predicate_dict["barked"]
+        assert [names[int(x)] for x in lab3] == ["B-A0", "O", "O", "B-V"]
+
+    def test_mode_validation(self, tmp_path):
+        from paddle_tpu.text import Conll05st
+        with pytest.raises(ValueError, match="test"):
+            Conll05st(data_file="x", mode="train")
+
+    def test_missing_files_raise(self, tmp_path):
+        from paddle_tpu.text import Conll05st
+        with pytest.raises(FileNotFoundError, match="No-egress"):
+            Conll05st(data_file=str(tmp_path / "x"))
