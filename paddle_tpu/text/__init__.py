@@ -102,11 +102,11 @@ class _NeedsDownload:
 
 # implemented loaders read LOCAL copies of the reference archives
 # (no-egress environment); the rest still point at io.Dataset
-WMT14 = WMT16 = _NeedsDownload
+WMT14 = _NeedsDownload
 
 from . import datasets  # noqa: E402,F401
-from .datasets import (Conll05st, Imdb, Imikolov,  # noqa: E402,F401
-                       Movielens, UCIHousing)
+from .datasets import (WMT16, Conll05st, Imdb,  # noqa: E402,F401
+                       Imikolov, Movielens, UCIHousing)
 
 __all__ = ["datasets", "viterbi_decode", "ViterbiDecoder", "Imdb",
            "Imikolov", "Conll05st", "Movielens", "UCIHousing", "WMT14",

@@ -22,7 +22,7 @@ import numpy as np
 from ..io import Dataset
 
 __all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens",
-           "MovieInfo", "UserInfo", "Conll05st"]
+           "MovieInfo", "UserInfo", "Conll05st", "WMT16"]
 
 
 def _require(path, what):
@@ -328,7 +328,7 @@ class Conll05st(Dataset):
             raise ValueError(
                 "Conll05st ships only the WSJ test split (the reference "
                 "loader likewise); mode must be 'test'")
-        for p, what in ((data_file, "Conll05st release tar"),
+        for p, what in ((data_file, "release tar"),
                         (word_dict_file, "word dict"),
                         (verb_dict_file, "verb dict"),
                         (target_dict_file, "target dict")):
@@ -371,7 +371,11 @@ class Conll05st(Dataset):
     def _parse(self, words_file, props_file):
         """Column-major props -> BIO spans (reference _load_anno)."""
         sentences, labels, one_seg = [], [], []
-        for word, label in zip(words_file, props_file):
+        lines = list(zip(words_file, props_file))
+        # a file without a trailing separator must still flush its last
+        # sentence — append a synthetic boundary
+        lines.append((b"", b""))
+        for word, label in lines:
             word = word.strip().decode()
             label = label.strip().decode().split()
             if len(label) == 0:  # sentence boundary
@@ -440,3 +444,96 @@ class Conll05st(Dataset):
 
     def __len__(self):
         return len(self.sentences)
+
+
+class WMT16(Dataset):
+    """WMT16 en-de MT dataset (reference wmt16.py WMT16): the archive
+    holds wmt16/{train,val,test} TSV pairs; dictionaries are built from
+    the train split (frequency-sorted, capped, with <s>/<e>/<unk> heads)
+    and cached next to the archive. Items are
+    (src_ids, trg_ids, trg_ids_next) with <s>/<e> framing."""
+
+    START_MARK, END_MARK, UNK_MARK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        _require(data_file, "WMT16 (wmt16.tar.gz)")
+        if mode not in ("train", "test", "val"):
+            raise ValueError(f"mode must be train|test|val, got {mode!r}")
+        if lang not in ("en", "de"):
+            raise ValueError(f"lang must be en|de, got {lang!r}")
+        self.data_file = data_file
+        self.mode = mode
+        self.lang = lang
+        self.src_dict = self._load_dict(lang, src_dict_size)
+        self.trg_dict = self._load_dict("de" if lang == "en" else "en",
+                                        trg_dict_size)
+        self._load_data()
+
+    def _train_freqs(self):
+        """One decompression pass counts BOTH columns (the reference
+        streams the gz train split once per language)."""
+        if getattr(self, "_freq_cache", None) is None:
+            en, de = collections.Counter(), collections.Counter()
+            with tarfile.open(self.data_file) as tf:
+                for line in tf.extractfile("wmt16/train"):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    for w in parts[0].split():
+                        en[w] += 1
+                    for w in parts[1].split():
+                        de[w] += 1
+            self._freq_cache = {"en": en, "de": de}
+        return self._freq_cache
+
+    def _build_dict(self, dict_path, dict_size, lang):
+        freq = self._train_freqs()[lang]
+        # atomic: an interrupted build must not leave a truncated cache
+        tmp_path = dict_path + ".tmp"
+        with open(tmp_path, "w") as f:
+            f.write(f"{self.START_MARK}\n{self.END_MARK}\n"
+                    f"{self.UNK_MARK}\n")
+            for idx, (word, _) in enumerate(
+                    sorted(freq.items(), key=lambda x: (-x[1], x[0]))):
+                if dict_size > 0 and idx + 3 == dict_size:
+                    break
+                f.write(word + "\n")
+        os.replace(tmp_path, dict_path)
+
+    def _load_dict(self, lang, dict_size):
+        dict_path = f"{self.data_file}.{lang}_{dict_size}.dict"
+        if not os.path.exists(dict_path):
+            self._build_dict(dict_path, dict_size, lang)
+        with open(dict_path) as f:
+            return {line.strip(): idx for idx, line in enumerate(f)}
+
+    def _load_data(self):
+        start_id = self.src_dict[self.START_MARK]
+        end_id = self.src_dict[self.END_MARK]
+        unk_id = self.src_dict[self.UNK_MARK]
+        src_col = 0 if self.lang == "en" else 1
+        trg_col = 1 - src_col
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{self.mode}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = ([start_id]
+                       + [self.src_dict.get(w, unk_id)
+                          for w in parts[src_col].split()]
+                       + [end_id])
+                trg_body = [self.trg_dict.get(w, unk_id)
+                            for w in parts[trg_col].split()]
+                self.src_ids.append(src)
+                self.trg_ids.append([start_id] + trg_body)
+                self.trg_ids_next.append(trg_body + [end_id])
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]),
+                np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)

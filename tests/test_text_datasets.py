@@ -247,3 +247,77 @@ class TestConll05st:
         from paddle_tpu.text import Conll05st
         with pytest.raises(FileNotFoundError, match="No-egress"):
             Conll05st(data_file=str(tmp_path / "x"))
+
+
+class TestWMT16:
+    def _write(self, tmp_path):
+        root = tmp_path / "wmt16"
+        os.makedirs(root)
+        train = ("the cat\tdie katze\n"
+                 "the dog\tder hund\n"
+                 "a cat\teine katze\n") * 5
+        (root / "train").write_text(train)
+        (root / "val").write_text("the cat\tdie katze\n")
+        (root / "test").write_text("a dog\tein hund\n")
+        tar = tmp_path / "wmt16.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(root, arcname="wmt16")
+        return str(tar)
+
+    def test_dict_and_items(self, tmp_path):
+        from paddle_tpu.text import WMT16
+        tar = self._write(tmp_path)
+        ds = WMT16(data_file=tar, mode="train", lang="en")
+        # special marks head the dict
+        assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+        assert ds.src_dict["<unk>"] == 2
+        assert "the" in ds.src_dict and "katze" in ds.trg_dict
+        assert len(ds) == 15
+        src, trg, trg_next = ds[0]
+        # <s> the cat <e> / <s> die katze / die katze <e>
+        assert src[0] == 0 and src[-1] == 1
+        assert trg[0] == 0 and trg_next[-1] == 1
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+    def test_val_split_and_dict_cache(self, tmp_path):
+        from paddle_tpu.text import WMT16
+        tar = self._write(tmp_path)
+        va = WMT16(data_file=tar, mode="val", lang="en")
+        assert len(va) == 1
+        # dict files cached next to the archive
+        import glob
+        assert glob.glob(str(tmp_path / "wmt16.tar.gz.*dict"))
+
+    def test_dict_size_cap_and_de_lang(self, tmp_path):
+        from paddle_tpu.text import WMT16
+        tar = self._write(tmp_path)
+        ds = WMT16(data_file=tar, mode="train", lang="de",
+                   src_dict_size=5, trg_dict_size=5)
+        assert len(ds.src_dict) == 5  # 3 marks + 2 words
+        # de source: src column is the German side
+        src, _, _ = ds[0]
+        assert len(src) == 4  # <s> die katze <e>
+
+    def test_no_trailing_separator_still_parses(self, tmp_path):
+        import gzip
+        from paddle_tpu.text import Conll05st
+        root = tmp_path / "conll05st-release" / "test.wsj"
+        os.makedirs(root / "words")
+        os.makedirs(root / "props")
+        # no trailing blank line after the last sentence
+        with gzip.open(root / "words" / "test.wsj.words.gz", "wt") as f:
+            f.write("The\ncat\nsat\n.")
+        with gzip.open(root / "props" / "test.wsj.props.gz", "wt") as f:
+            f.write("-\t(A0*\n-\t*)\nsat\t(V*)\n-\t*")
+        tar = tmp_path / "c.tar.gz"
+        with tarfile.open(tar, "w:gz") as tf:
+            tf.add(tmp_path / "conll05st-release",
+                   arcname="conll05st-release")
+        (tmp_path / "wd.txt").write_text("UNK\nThe\ncat\nsat\n.\n")
+        (tmp_path / "vd.txt").write_text("sat\n")
+        (tmp_path / "td.txt").write_text("B-A0\nI-A0\nB-V\nO\n")
+        ds = Conll05st(data_file=str(tar),
+                       word_dict_file=str(tmp_path / "wd.txt"),
+                       verb_dict_file=str(tmp_path / "vd.txt"),
+                       target_dict_file=str(tmp_path / "td.txt"))
+        assert len(ds) == 1

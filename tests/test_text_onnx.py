@@ -78,10 +78,12 @@ def test_onnx_export_points_to_stablehlo():
 
 def test_text_datasets_raise_clearly():
     # implemented loaders require a local archive; the rest still stub
-    from paddle_tpu.text import WMT14, Conll05st, Imdb
+    from paddle_tpu.text import WMT14, WMT16, Conll05st, Imdb
     with pytest.raises(FileNotFoundError, match="No-egress"):
         Imdb()
     with pytest.raises(FileNotFoundError, match="No-egress"):
         Conll05st()
+    with pytest.raises(FileNotFoundError, match="No-egress"):
+        WMT16()
     with pytest.raises(NotImplementedError, match="egress"):
         WMT14()
