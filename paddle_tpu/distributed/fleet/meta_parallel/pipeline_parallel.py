@@ -27,6 +27,20 @@ trivially cheap next to the blocks and removes the reference's
 embedding/head special stages and tied-weight allreduce
 (pp_layers.py SharedLayerDesc machinery).
 
+``schedule_mode`` selects between four real schedules (see
+``pipeline_schedules.py`` for VPP/ZBH1/hetero):
+* ``FThenB`` — the scan above, full activation retention;
+* ``1F1B`` — same ticks + per-tick rematerialization (1F1B-steady-state
+  memory);
+* ``VPP`` — interleaved virtual pipeline: K non-contiguous chunks per
+  rank, ``mK + S - 1`` block ticks instead of ``(m + S - 1)K`` (the
+  bubble shrinks ~K×; reference PipelineParallelWithInterleave:1010);
+* ``ZBH1`` — zero-bubble dX/dW split backward (reference
+  pipeline_zero_bubble.py).
+Models without a homogeneous block run no longer fall back to
+unpipelined accumulation: they are segmented into unequal stages and
+pipelined with per-rank switch programs (``spmd_pipeline_hetero``).
+
 Exact-numerics contract: ``forward_backward_pipeline`` reproduces the
 sequential model bit-for-bit up to float reassociation (tested against
 ``PipelineLayer.forward``).
@@ -44,7 +58,9 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ....core.tensor import Tensor
 from ....nn.layer.layers import Layer
 from ... import mesh as mesh_mod
-from .pp_layers import PipelineLayer
+from .pipeline_schedules import (spmd_pipeline_hetero,
+                                 spmd_pipeline_interleaved, spmd_pipeline_zb)
+from .pp_layers import PipelineLayer, SegmentLayers
 
 
 def _trainable(layer: Layer) -> List[Tensor]:
@@ -82,17 +98,27 @@ def _find_homogeneous_run(funcs: Sequence, num_stages: int
     return best
 
 
+def _stage_caller(funcs: Sequence, params: Sequence[Tensor]):
+    """Build ``f(arrays, x_arr)`` running a sub-stack of layers/callables
+    with ``arrays`` swapped in for the stack's trainable params."""
+    def f(arrays, x_arr):
+        originals = [p._data for p in params]
+        for p, a in zip(params, arrays):
+            p._data = a
+        try:
+            h = Tensor(x_arr, stop_gradient=False)
+            for fn in funcs:
+                h = fn(h)
+            return h._data
+        finally:
+            for p, o in zip(params, originals):
+                p._data = o
+    return f
+
+
 def _swap_call(layer: Layer, params: Sequence[Tensor], arrays, x_arr):
     """Run `layer` with `arrays` substituted for its param payloads."""
-    originals = [p._data for p in params]
-    for p, a in zip(params, arrays):
-        p._data = a
-    try:
-        out = layer(Tensor(x_arr, stop_gradient=False))
-        return out._data
-    finally:
-        for p, o in zip(params, originals):
-            p._data = o
+    return _stage_caller([layer], params)(arrays, x_arr)
 
 
 def spmd_pipeline(block_fn: Callable, stacked: Sequence, xs, *, mesh,
@@ -201,12 +227,8 @@ class PipelineParallel(Layer):
         funcs = layers.run_function
         run = (_find_homogeneous_run(funcs, self.num_stages)
                if self.num_stages > 1 else None)
-        if run is None and self.num_stages > 1:
-            warnings.warn(
-                "PipelineParallel: no homogeneous block run divisible by "
-                f"{self.num_stages} stages found; falling back to "
-                "non-overlapped micro-batch accumulation")
         self._run = run
+        self._hetero_stages = None
         if run is not None:
             start, length = run
             self._prologue = funcs[:start]
@@ -214,7 +236,31 @@ class PipelineParallel(Layer):
             self._epilogue = funcs[start + length:]
             self._template = self._blocks[0]
             self._template_params = _trainable(self._template)
+        elif self.num_stages > 1 and len(funcs) >= self.num_stages:
+            # Heterogeneous model: segment the whole stack into S unequal
+            # stages and pipeline them with per-rank switch programs
+            # (pipeline_schedules.spmd_pipeline_hetero) instead of giving
+            # up on pipelining.
+            self._prologue = []
+            self._blocks = []
+            self._epilogue = []
+            # honor the segmentation PipelineLayer computed from the
+            # user's seg_method when it matches our stage count
+            if (getattr(layers, "num_stages", None) == self.num_stages
+                    and getattr(layers, "segment_parts", None) is not None
+                    and len(layers.segment_parts) == self.num_stages + 1):
+                bounds = layers.segment_parts
+            else:
+                bounds = SegmentLayers.uniform(len(funcs), self.num_stages)
+            self._hetero_stages = [
+                funcs[bounds[s]:bounds[s + 1]]
+                for s in range(self.num_stages)]
         else:
+            if self.num_stages > 1:
+                warnings.warn(
+                    "PipelineParallel: fewer layers than pipeline stages; "
+                    "falling back to non-overlapped micro-batch "
+                    "accumulation")
             self._prologue = list(funcs)
             self._blocks = []
             self._epilogue = []
@@ -229,11 +275,24 @@ class PipelineParallel(Layer):
                 seen.setdefault(id(p), p)
         self._params: List[Tensor] = list(seen.values())
         self._block_param_ids = []
+        order = {id(p): i for i, p in enumerate(self._params)}
         if run is not None:
-            order = {id(p): i for i, p in enumerate(self._params)}
             for blk in self._blocks:
                 self._block_param_ids.append(
                     [order[id(p)] for p in _trainable(blk)])
+        self._stage_param_refs = None
+        if self._hetero_stages is not None:
+            self._stage_param_refs = []
+            for seg in self._hetero_stages:
+                uniq, seen_ids = [], set()
+                for fn in seg:
+                    if isinstance(fn, Layer):
+                        for p in _trainable(fn):
+                            if id(p) not in seen_ids:
+                                seen_ids.add(id(p))
+                                uniq.append(p)
+                self._stage_param_refs.append(
+                    (uniq, [order[id(p)] for p in uniq]))
         self._jit_cache = {}
         # reference surface
         self.total_loss = None
@@ -259,9 +318,14 @@ class PipelineParallel(Layer):
             p._data = a
         try:
             m = xs.shape[0]
-            flat = xs.reshape((-1,) + xs.shape[2:])
-            h = self._run_funcs(self._prologue, Tensor(flat,
-                                                       stop_gradient=False))
+            if self._hetero_stages is not None:
+                out = self._run_hetero(param_arrays, xs)
+                h = Tensor(out.reshape((-1,) + out.shape[2:]),
+                           stop_gradient=False)
+            else:
+                flat = xs.reshape((-1,) + xs.shape[2:])
+                h = self._run_funcs(
+                    self._prologue, Tensor(flat, stop_gradient=False))
             if self._run is not None:
                 harr = h._data.reshape((m, -1) + h._data.shape[1:])
                 stacked = []
@@ -275,10 +339,20 @@ class PipelineParallel(Layer):
                     return _swap_call(self._template, self._template_params,
                                       per_block, x_arr)
 
-                out = spmd_pipeline(block_fn, stacked, harr,
-                                    mesh=self._mesh,
-                                    num_stages=self.num_stages,
-                                    schedule=self.schedule_mode)
+                sched = self.schedule_mode.upper()
+                if sched in ("VPP", "INTERLEAVE", "INTERLEAVED"):
+                    out = spmd_pipeline_interleaved(
+                        block_fn, stacked, harr, mesh=self._mesh,
+                        num_stages=self.num_stages)
+                elif sched in ("ZBH1", "ZB", "ZBV"):
+                    out = spmd_pipeline_zb(
+                        block_fn, stacked, harr, mesh=self._mesh,
+                        num_stages=self.num_stages)
+                else:
+                    out = spmd_pipeline(block_fn, stacked, harr,
+                                        mesh=self._mesh,
+                                        num_stages=self.num_stages,
+                                        schedule=self.schedule_mode)
                 h = Tensor(out.reshape((-1,) + out.shape[2:]),
                            stop_gradient=False)
             out = self._run_funcs(self._epilogue, h)
@@ -287,6 +361,24 @@ class PipelineParallel(Layer):
         finally:
             for p, o in zip(params, originals):
                 p._data = o
+
+    def _run_hetero(self, param_arrays, xs):
+        """Pipeline heterogeneous segments (per-rank switch programs)."""
+        import jax as _jax
+        S = self.num_stages
+        stage_fns, stage_arrays = [], []
+        for seg, (params, ids) in zip(self._hetero_stages,
+                                      self._stage_param_refs):
+            stage_fns.append(_stage_caller(seg, params))
+            stage_arrays.append([param_arrays[i] for i in ids])
+        avals = [_jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype)]
+        for s in range(S):
+            avals.append(_jax.eval_shape(stage_fns[s], stage_arrays[s],
+                                         avals[-1]))
+        return spmd_pipeline_hetero(
+            stage_fns, stage_arrays, xs, mesh=self._mesh, num_stages=S,
+            out_aval=avals[-1], stage_in_avals=avals[:-1],
+            remat=self.schedule_mode.upper() != "FTHENB")
 
     def forward_backward_pipeline(self, data, scaler=None) -> Tensor:
         x, y = data

@@ -182,19 +182,68 @@ class TestPipelineParallel:
                   for _ in range(5)]
         assert losses[-1] < losses[0]
 
-    def test_heterogeneous_fallback(self, pp_mesh):
+    def test_heterogeneous_stages_pipeline(self, pp_mesh):
+        # shape-changing, param-heterogeneous stack now pipelines (switch
+        # programs per rank) and must match the sequential model exactly
         paddle.seed(7)
-        with pytest.warns(UserWarning, match="falling back"):
-            pl = PipelineLayer(
-                layers=[LayerDesc(Block), LayerDesc(Block),
-                        LayerDesc(Head), LayerDesc(Head, d=4, out=4)],
-                num_stages=4, loss_fn=_mse)
-            pp = PipelineParallel(pl, None,
-                                  fleet_pkg.DistributedStrategy())
-        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
-        y = paddle.to_tensor(np.random.randn(4, 4).astype(np.float32))
+        pl = PipelineLayer(
+            layers=[LayerDesc(Block), LayerDesc(Block),
+                    LayerDesc(Head), LayerDesc(Head, d=4, out=4)],
+            num_stages=4, loss_fn=_mse)
+        strategy = fleet_pkg.DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 4}
+        pp = PipelineParallel(pl, None, strategy)
+        assert pp._hetero_stages is not None
+        x = paddle.to_tensor(np.random.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
         loss = pp.forward_backward_pipeline((x, y))
-        assert np.isfinite(float(loss.numpy()))
+        ref = _mse(pl(x), y)
+        np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                                   rtol=1e-5)
+        got = {n: np.asarray(p.grad._data)
+               for n, p in pl.named_parameters() if p.grad is not None}
+        for p in pl.parameters():
+            p.clear_grad()
+        ref.backward()
+        for n, p in pl.named_parameters():
+            if not p.stop_gradient:
+                np.testing.assert_allclose(
+                    got[n], np.asarray(p.grad._data), atol=2e-5,
+                    err_msg=f"hetero grad mismatch for {n}")
+
+    def test_too_few_layers_rejected(self, pp_mesh):
+        # reference contract: PipelineLayer refuses fewer layers than
+        # stages at construction (SegmentLayers check)
+        with pytest.raises(ValueError, match="should be greater"):
+            PipelineLayer(layers=[LayerDesc(Block), LayerDesc(Head)],
+                          num_stages=4, loss_fn=_mse)
+
+    @pytest.mark.parametrize("mode", ["VPP", "ZBH1"])
+    def test_schedule_modes_match_sequential(self, pp_mesh, mode):
+        paddle.seed(11)
+        pl = PipelineLayer(
+            layers=[LayerDesc(Block) for _ in range(8)] + [LayerDesc(Head)],
+            num_stages=4, loss_fn=_mse)
+        strategy = fleet_pkg.DistributedStrategy()
+        strategy.pipeline_configs = {"accumulate_steps": 8,
+                                     "schedule_mode": mode}
+        pp = PipelineParallel(pl, None, strategy)
+        x = paddle.to_tensor(np.random.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(np.random.randn(8, 4).astype(np.float32))
+        loss = pp.forward_backward_pipeline((x, y))
+        ref = _mse(pl(x), y)
+        np.testing.assert_allclose(float(loss.numpy()), float(ref.numpy()),
+                                   rtol=1e-5)
+        got = {n: np.asarray(p.grad._data)
+               for n, p in pl.named_parameters() if p.grad is not None}
+        for p in pl.parameters():
+            p.clear_grad()
+        ref.backward()
+        for n, p in pl.named_parameters():
+            if not p.stop_gradient:
+                np.testing.assert_allclose(
+                    got[n], np.asarray(p.grad._data), atol=2e-5,
+                    err_msg=f"{mode} grad mismatch for {n}")
 
     def test_fleet_distributed_model_pp(self, pp_mesh):
         fleet = fleet_pkg.fleet
