@@ -281,6 +281,63 @@ def _bench_llama(small):
     }
 
 
+def _bench_dispatch(small):
+    """Per-op eager dispatch latency (VERDICT: SURVEY §7 hard part #1).
+
+    Measures µs/op for a 128×128 matmul in a Python loop: eager with grad
+    tape recording, eager under no_grad, and the same loop jitted. The
+    eager path must not linearize (lazy-vjp dispatch), so tape-on overhead
+    is bookkeeping only. Reference bar: generated C++ ad_func pipeline is
+    µs-level (eager_gen.py:301)."""
+    import paddle_tpu as paddle
+
+    n = 50 if small else 300
+    x = paddle.to_tensor(np.random.randn(128, 128).astype(np.float32))
+    w = paddle.to_tensor(np.random.randn(128, 128).astype(np.float32))
+    w.stop_gradient = False
+
+    def loop_eager():
+        y = x
+        for _ in range(n):
+            y = paddle.ops.matmul(y, w)
+        return y
+
+    def timed(f):
+        out = f()
+        jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+        t0 = time.perf_counter()
+        out = f()
+        jax.block_until_ready(out._data if hasattr(out, "_data") else out)
+        return (time.perf_counter() - t0) / n * 1e6  # µs/op
+
+    with_tape = timed(loop_eager)
+    with paddle.no_grad():
+        no_tape = timed(loop_eager)
+
+    def jit_loop(xa, wa):
+        def body(y, _):
+            return y @ wa, None
+        y, _ = jax.lax.scan(body, xa, None, length=n)
+        return y
+
+    jitted = jax.jit(jit_loop)
+    jax.block_until_ready(jitted(x._data, w._data))
+    t0 = time.perf_counter()
+    jax.block_until_ready(jitted(x._data, w._data))
+    jit_us = (time.perf_counter() - t0) / n * 1e6
+
+    return {
+        "metric": "eager_dispatch_overhead_us_per_op",
+        "value": round(with_tape, 2),
+        "unit": "us/op",
+        "vs_baseline": round(jit_us / max(with_tape, 1e-9), 4),
+        "extra": {"eager_tape_us": round(with_tape, 2),
+                  "eager_no_grad_us": round(no_tape, 2),
+                  "jit_us": round(jit_us, 2),
+                  "matmul": "128x128", "iters": n},
+    }
+
+
 def main():
     if os.environ.get("BENCH_SMALL") == "1":
         # local testing: force the host platform before any backend init
@@ -289,7 +346,8 @@ def main():
     small = (not on_tpu) or os.environ.get("BENCH_SMALL") == "1"
 
     benches = {"gpt2": _bench_gpt, "resnet50": _bench_resnet50,
-               "bert": _bench_bert, "llama": _bench_llama}
+               "bert": _bench_bert, "llama": _bench_llama,
+               "dispatch": _bench_dispatch}
     which = os.environ.get("BENCH_MODEL", "all")
     if which != "all":
         print(json.dumps(benches[which](small)))

@@ -26,6 +26,7 @@ from .tensor import Tensor
 # package re-exports dispatch's grad-mode contexts).
 GradNode = None
 AccumulationNode = None
+_sot = None  # bound on first eager dispatch (core<->jit import cycle)
 
 
 def _bind_engine():
@@ -167,6 +168,15 @@ def _amp_cast_inputs(op_name: str, arrays: List):
 # ---------------------------------------------------------------------------
 # Dispatch
 # ---------------------------------------------------------------------------
+# Hot-path flag mirror: dispatch reads these per op, so they are kept in
+# sync by flag observers instead of registry lookups per call.
+_hot_flags = {"check_nan_inf": flags.get_flag("check_nan_inf"),
+              "benchmark": flags.get_flag("benchmark")}
+flags.on_change("check_nan_inf",
+                lambda v: _hot_flags.__setitem__("check_nan_inf", v))
+flags.on_change("benchmark",
+                lambda v: _hot_flags.__setitem__("benchmark", v))
+
 _op_hooks: List[Callable] = []  # profiler / debugging taps
 _recorder_tls = threading.local()  # program capture is per-thread: a
 # guard on thread A must not record ops dispatched by thread B
@@ -203,6 +213,8 @@ def unregister_op_hook(fn):
 
 def _check_nan_inf(op_name, outs):
     for o in outs:
+        if not isinstance(o, (jax.Array, np.ndarray)):
+            continue  # SOT LazyArray / tracer: checked when materialized
         d = np.dtype(o.dtype)
         if np.issubdtype(d, np.floating) or d == dtypes.bfloat16:
             bad = bool(jnp.any(~jnp.isfinite(o)))
@@ -215,13 +227,18 @@ def _check_nan_inf(op_name, outs):
 
 
 def _lazy_vjp(f, arrays):
-    """Deferred vjp for ops recorded under an outer trace: linearize only
-    when the tape backward actually runs (while the tracers are live)."""
+    """Deferred vjp: linearize only when the tape backward actually runs
+    (the primal recomputes inside jax.vjp then — remat-style, so forward
+    dispatch never pays for a backward that may never happen)."""
     state = {}
 
     def vjp_fn(cts):
         if "vjp" not in state:
-            _, state["vjp"] = jax.vjp(f, *arrays)
+            # SOT LazyArray payloads must be concretized explicitly —
+            # jax no longer honors __jax_array__ during abstractification
+            concrete = [a.__jax_array__() if hasattr(a, "__jax_array__")
+                        else a for a in arrays]
+            _, state["vjp"] = jax.vjp(f, *concrete)
         return state["vjp"](cts)
 
     return vjp_fn
@@ -233,12 +250,18 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
     """Run one op: ``fn(*arrays, **attrs)`` over the payloads of
     ``tensor_inputs``, recording a GradNode when grad is enabled and any
     input requires grad. Returns Tensor or list of Tensors."""
+    global _sot
     attrs = attrs or {}
     s = _tls()
     if GradNode is None:
         _bind_engine()
 
     arrays = [t._data for t in tensor_inputs]
+    if _sot is not None and not _sot.active():
+        # payloads that escaped an earlier SOT capture concretize here
+        # (jax no longer coerces via __jax_array__ automatically)
+        arrays = [a.concrete() if type(a) is _sot.LazyArray else a
+                  for a in arrays]
     amp_cast = _amp_cast_inputs(op_name, arrays)
     if amp_cast is not arrays:
         # fold the AMP cast INTO the differentiated function so vjp
@@ -264,14 +287,32 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
 
     node = None
     traced = any(isinstance(a, jax.core.Tracer) for a in arrays)
-    if record and not traced:
-        outs, vjp_fn = jax.vjp(f, *arrays)
+    sot_rec = None
+    if not traced:
+        if _sot is None:
+            from ..jit import sot as _sot_mod
+            _sot = _sot_mod
+        if _sot.active():
+            sot_rec = _sot.record_or_none(op_name, f, arrays, attrs)
+    if sot_rec is not None:
+        # SOT lazy capture: the op joined the pending segment graph; its
+        # outputs are LazyArrays that materialize at the next graph break.
+        lazies, sot_multi = sot_rec
+        outs = list(lazies) if sot_multi else lazies[0]
+        vjp_fn = _lazy_vjp(f, arrays) if record else None
     else:
-        # Under an outer jax transform the eager linearization is wasted
-        # work (the transform differentiates the primal directly) and
-        # breaks custom_vjp kernels (second-order AD). Compute the primal
-        # only; if the tape IS walked while the trace is live (recompute
-        # replay), derive the vjp lazily then.
+        if _sot is not None and any(type(a) is _sot.LazyArray
+                                    for a in arrays):
+            # implicit SOT break (shape inference refused the op): the
+            # segment was flushed; run on the materialized values — jax
+            # rejects LazyArray wrappers during abstractification
+            arrays = [a.concrete() if type(a) is _sot.LazyArray else a
+                      for a in arrays]
+        # Eager linearization here would be wasted work whenever backward
+        # never runs, and under an outer jax transform it also breaks
+        # custom_vjp kernels (second-order AD). Compute the primal only;
+        # if the tape IS walked, derive the vjp lazily then (the primal is
+        # recomputed inside jax.vjp at that point — remat-style).
         outs = f(*arrays)
         vjp_fn = _lazy_vjp(f, arrays) if record else None
 
@@ -305,11 +346,12 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
             t.output_index = i
         out_tensors.append(t)
 
-    if flags.get_flag("check_nan_inf"):
+    if _hot_flags["check_nan_inf"]:
         _check_nan_inf(op_name, out_list)
-    if flags.get_flag("benchmark"):
+    if _hot_flags["benchmark"]:
         for o in out_list:
-            jax.block_until_ready(o)
+            if isinstance(o, jax.Array):
+                jax.block_until_ready(o)
     for hook in _op_hooks:
         hook(op_name, tensor_inputs, out_tensors, attrs)
     for hook in _recorder_hooks():

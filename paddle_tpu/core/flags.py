@@ -62,7 +62,12 @@ def get_flags(names) -> Dict[str, Any]:
 
 
 def get_flag(name: str):
-    return next(iter(get_flags([name]).values()))
+    # lock-free fast path (dict reads are GIL-atomic); the eager dispatch
+    # hot loop reads flags per op, so this must stay at dict-lookup cost
+    f = _registry.get(name[6:] if name.startswith("FLAGS_") else name)
+    if f is None:
+        raise KeyError(f"Flag {name!r} is not defined")
+    return f.value
 
 
 def set_flags(flags: Dict[str, Any]):

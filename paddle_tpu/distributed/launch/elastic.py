@@ -1,0 +1,144 @@
+"""Elastic membership manager — scale-in/out decisions for multi-node jobs.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:124 — the
+ElasticManager watches etcd node leases; on membership change (a node's
+lease expires, or a new node registers) it decides whether the job must
+relaunch with a new world spec, waits out a grace period for flapping
+nodes, and enforces the ``--nnodes min:max`` bounds.
+
+TPU-native redesign: leases are server-stamped heartbeats in the launch KV
+master (kv_server.Heartbeat); the *decision* is pure logic here, and the
+*action* is a job-group restart with a bumped elastic epoch — a fresh
+``jax.distributed`` world (PJRT forbids re-initialize in-process, so the
+epoch restart IS the reference's relaunch path).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from .kv_server import Heartbeat, KVClient
+
+__all__ = ["ElasticManager", "parse_nnodes"]
+
+
+def parse_nnodes(spec) -> Tuple[int, int]:
+    """``--nnodes 2`` -> (2, 2); ``--nnodes 2:4`` -> (2, 4) (reference
+    elastic range syntax)."""
+    s = str(spec)
+    if ":" in s:
+        lo, hi = s.split(":", 1)
+        lo, hi = int(lo), int(hi)
+    else:
+        lo = hi = int(s)
+    if not (1 <= lo <= hi):
+        raise ValueError(f"invalid nnodes spec {spec!r}")
+    return lo, hi
+
+
+class ElasticManager:
+    """Watches peer heartbeats and publishes elastic epochs.
+
+    Node 0 runs ``watch()``; every node (including 0) polls
+    ``current_epoch()`` and group-restarts its local workers when the
+    epoch moves. Decisions:
+
+    * a peer's heartbeat goes stale past ``grace`` seconds → scale-in:
+      drop it from the live set and bump the epoch (if ``len(live) >=
+      min_nodes``; otherwise the job FAILS — below quorum);
+    * a new peer registers while the job runs → scale-out: bump the epoch
+      so the world re-forms including it (capped at ``max_nodes``).
+    """
+
+    def __init__(self, master: str, node_rank: int, nnodes="1",
+                 job_id: str = "default", grace: float = 10.0,
+                 interval: float = 2.0):
+        self.client = KVClient(master)
+        self.node_rank = node_rank
+        self.min_nodes, self.max_nodes = parse_nnodes(nnodes)
+        self.job_id = job_id
+        self.grace = grace
+        self.interval = interval
+        self.heartbeat = Heartbeat(master, node_rank, job_id=job_id,
+                                   interval=min(1.0, grace / 4),
+                                   ttl=grace)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._epoch_key = f"/elastic/{self.job_id}/epoch"
+        self._world_key = f"/elastic/{self.job_id}/world"
+
+    # ------------------------------------------------------------ state
+    def current_epoch(self) -> int:
+        v = self.client.get(self._epoch_key)
+        return int(v) if v else 0
+
+    def current_world(self) -> Optional[List[int]]:
+        v = self.client.get(self._world_key)
+        if not v:
+            return None
+        return [int(r) for r in v.split(",") if r != ""]
+
+    def live_peers(self) -> List[int]:
+        return self.heartbeat.live_nodes()
+
+    # --------------------------------------------------------- decisions
+    def decide(self, known_world: List[int], live: List[int]):
+        """Pure decision step (unit-testable): returns
+        ``("noop"|"rescale"|"fail", new_world)``."""
+        live = sorted(set(live))[: self.max_nodes]
+        if live == sorted(known_world):
+            return "noop", known_world
+        if len(live) < self.min_nodes:
+            return "fail", live
+        return "rescale", live
+
+    def publish(self, new_world: List[int]):
+        epoch = self.current_epoch() + 1
+        self.client.put(self._world_key,
+                        ",".join(str(r) for r in new_world))
+        self.client.put(self._epoch_key, str(epoch))
+        return epoch
+
+    # ------------------------------------------------------------- watch
+    def start(self, initial_world: List[int]):
+        """Begin heartbeating; node 0 additionally watches membership and
+        publishes rescale epochs."""
+        self.heartbeat.start()
+        if self.client.get(self._world_key) is None and self.node_rank == 0:
+            self.client.put(self._world_key,
+                            ",".join(str(r) for r in initial_world))
+            self.client.put(self._epoch_key, "0")
+        if self.node_rank != 0:
+            return self
+
+        def watch():
+            # let every peer's first heartbeat land before judging
+            time.sleep(self.heartbeat.interval * 2)
+            while not self._stop.wait(self.interval):
+                known = self.current_world() or initial_world
+                action, new_world = self.decide(known, self.live_peers())
+                if action == "rescale":
+                    epoch = self.publish(new_world)
+                    print(f"[elastic] membership {known} -> {new_world}; "
+                          f"epoch {epoch}")
+                elif action == "fail":
+                    self.client.put(f"/elastic/{self.job_id}/failed",
+                                    f"below quorum: live={new_world}, "
+                                    f"min={self.min_nodes}")
+                    print(f"[elastic] job below quorum ({new_world}); "
+                          f"marking failed")
+                    return
+
+        self._thread = threading.Thread(target=watch, daemon=True)
+        self._thread.start()
+        return self
+
+    def failed_reason(self) -> Optional[str]:
+        return self.client.get(f"/elastic/{self.job_id}/failed")
+
+    def stop(self):
+        self._stop.set()
+        self.heartbeat.stop()
+        if self._thread:
+            self._thread.join(timeout=5)

@@ -222,10 +222,9 @@ class Heartbeat:
         if self._thread:
             self._thread.join(timeout=5)
 
-    def dead_nodes(self):
-        """Peers whose (server-stamped) heartbeat lags the freshest one by
-        more than ttl. All comparisons use the SERVER clock, so neither
-        cross-host skew nor this caller's clock can fake a death."""
+    def stamps(self):
+        """rank -> server-stamped heartbeat time; garbage stamps map to
+        -inf (= stale). The single source of truth for liveness."""
         stamps = {}
         for key, ts in self.client.get_prefix(self.prefix).items():
             try:
@@ -236,8 +235,28 @@ class Heartbeat:
                 stamps[rank] = float(ts)
             except ValueError:
                 stamps[rank] = float("-inf")  # garbage stamp = stale
+        return stamps
+
+    def _is_stale(self, ts: float, freshest: float) -> bool:
+        return freshest - ts > self.ttl
+
+    def dead_nodes(self):
+        """Peers whose (server-stamped) heartbeat lags the freshest one by
+        more than ttl. All comparisons use the SERVER clock, so neither
+        cross-host skew nor this caller's clock can fake a death."""
+        stamps = self.stamps()
         if not stamps:
             return []
         freshest = max(stamps.values())
         return sorted(r for r, ts in stamps.items()
-                      if freshest - ts > self.ttl)
+                      if self._is_stale(ts, freshest))
+
+    def live_nodes(self):
+        """Complement of dead_nodes over the known rank set — both views
+        share one staleness rule."""
+        stamps = self.stamps()
+        if not stamps:
+            return []
+        freshest = max(stamps.values())
+        return sorted(r for r, ts in stamps.items()
+                      if not self._is_stale(ts, freshest))

@@ -24,7 +24,8 @@ def _parse(argv=None):
     p = argparse.ArgumentParser(
         prog="paddle_tpu.distributed.launch",
         description="launch a multi-process / multi-host job")
-    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--nnodes", default="1",
+                   help="node count, or elastic range 'min:max'")
     p.add_argument("--node_rank", type=int,
                    default=int(os.environ.get("PADDLE_NODE_RANK", 0)))
     p.add_argument("--nproc_per_node", type=int, default=1,
@@ -40,9 +41,17 @@ def _parse(argv=None):
     return p.parse_args(argv)
 
 
-def _worker_env(args, local_rank: int) -> dict:
-    world = args.nnodes * args.nproc_per_node
-    rank = args.node_rank * args.nproc_per_node + local_rank
+def _worker_env(args, local_rank: int, epoch: int = 0,
+                nnodes: int = None, node_rank: int = None) -> dict:
+    nnodes = nnodes if nnodes is not None else args.nnodes_now
+    node_rank = node_rank if node_rank is not None else args.node_rank
+    world = nnodes * args.nproc_per_node
+    rank = node_rank * args.nproc_per_node + local_rank
+    host, _, port = args.master.rpartition(":")
+    # every elastic epoch is a FRESH jax.distributed world: PJRT cannot
+    # re-initialize in-process, so the epoch moves the coordinator port
+    coord = f"{host}:{int(port) + 2 * epoch}" if port.isdigit() \
+        else args.master
     env = dict(os.environ)
     env.update({
         # reference names (compat for user scripts)
@@ -50,8 +59,9 @@ def _worker_env(args, local_rank: int) -> dict:
         "PADDLE_TRAINERS_NUM": str(world),
         "PADDLE_LOCAL_RANK": str(local_rank),
         "PADDLE_MASTER": args.master,
+        "PADDLE_ELASTIC_EPOCH": str(epoch),
         # jax.distributed coordinates (paddle_tpu.distributed.init reads)
-        "JAX_COORDINATOR_ADDRESS": args.master,
+        "JAX_COORDINATOR_ADDRESS": coord,
         "JAX_NUM_PROCESSES": str(world),
         "JAX_PROCESS_ID": str(rank),
     })
@@ -66,7 +76,8 @@ class _Worker:
         self.proc: subprocess.Popen | None = None
         self.log = None
 
-    def start(self):
+    def start(self, epoch: int = 0, nnodes: int = None,
+              node_rank: int = None):
         args = self.args
         cmd = [sys.executable, args.training_script,
                *args.training_script_args]
@@ -77,8 +88,19 @@ class _Worker:
                 args.log_dir, f"worker.{self.local_rank}.log"), "ab")
             stdout = self.log
         self.proc = subprocess.Popen(
-            cmd, env=_worker_env(args, self.local_rank),
+            cmd, env=_worker_env(args, self.local_rank, epoch=epoch,
+                                 nnodes=nnodes, node_rank=node_rank),
             stdout=stdout, stderr=subprocess.STDOUT if stdout else None)
+
+    def wait_dead(self, timeout: float = 10.0):
+        if self.proc is None or self.proc.poll() is not None:
+            return
+        self.proc.terminate()
+        try:
+            self.proc.wait(timeout)
+        except subprocess.TimeoutExpired:
+            self.proc.kill()
+            self.proc.wait()
 
     def poll(self):
         return self.proc.poll() if self.proc else None
@@ -93,13 +115,28 @@ class _Worker:
 
 
 def launch(argv=None) -> int:
-    """Spawn + monitor the workers; elastic restart up to --max_restarts
-    (reference elastic/manager.py watchdog loop). Multi-node: node 0 runs
-    the HTTP KV master (kv_server.py, reference HTTPMaster) on
-    master_port+1; all nodes barrier through sync_peers before spawning."""
+    """Spawn + monitor the workers (reference elastic/manager.py watchdog
+    loop). A worker failure with restarts remaining relaunches the WHOLE
+    local group at the next elastic epoch — each epoch is a fresh
+    jax.distributed world (coordinator port moves with the epoch), since
+    a collective world cannot survive a member death in place.
+
+    Multi-node: node 0 runs the HTTP KV master (kv_server.py, reference
+    HTTPMaster) on master_port+1; all nodes barrier through sync_peers,
+    then an ElasticManager (launch/elastic.py) heartbeats membership —
+    scale-in/out publishes a new epoch + world, and every node's launcher
+    relaunches its group with re-ranked coordinates."""
+    from .elastic import parse_nnodes
+
     args = _parse(argv)
+    nnodes_min, nnodes_max = parse_nnodes(args.nnodes)
+    args.nnodes_now = nnodes_min
     kv = None
-    if args.nnodes > 1:
+    manager = None
+    kv_addr = None
+    node_rank_now = args.node_rank
+    if nnodes_min > 1 or nnodes_max > 1:
+        from .elastic import ElasticManager
         from .kv_server import KVServer, sync_peers
         host, _, port = args.master.rpartition(":")
         if not host or not port.isdigit():
@@ -109,17 +146,23 @@ def launch(argv=None) -> int:
         try:
             if args.node_rank == 0:
                 kv = KVServer(int(port) + 1).start()
-            peers = sync_peers(kv_addr, args.node_rank, args.nnodes,
+            peers = sync_peers(kv_addr, args.node_rank, nnodes_min,
                                payload=f"node{args.node_rank}")
         except BaseException:
             if kv is not None:
                 kv.stop()
             raise
-        print(f"[launch] {args.nnodes} nodes rendezvoused: {peers}")
+        print(f"[launch] {nnodes_min} nodes rendezvoused: {peers}")
+        manager = ElasticManager(kv_addr, args.node_rank,
+                                 nnodes=args.nnodes)
+        manager.start(initial_world=list(range(nnodes_min)))
+
+    epoch = 0
+    group_restarts = 0
     workers: List[_Worker] = [
         _Worker(args, i) for i in range(args.nproc_per_node)]
     for w in workers:
-        w.start()
+        w.start(epoch=epoch)
 
     def _sig(_s, _f):
         for w in workers:
@@ -129,34 +172,79 @@ def launch(argv=None) -> int:
     signal.signal(signal.SIGINT, _sig)
     signal.signal(signal.SIGTERM, _sig)
 
+    def group_restart(new_epoch: int, nnodes: int = None,
+                      node_rank: int = None):
+        for w in workers:
+            w.wait_dead()
+        for w in workers:
+            w.start(epoch=new_epoch, nnodes=nnodes, node_rank=node_rank)
+
     exit_code = 0
     try:
         while True:
-            alive = False
-            for w in workers:
-                code = w.poll()
-                if code is None:
-                    alive = True
-                elif code != 0:
-                    if w.restarts < args.max_restarts:
-                        w.restarts += 1
-                        print(f"[launch] worker {w.local_rank} exited "
-                              f"{code}; restart "
-                              f"{w.restarts}/{args.max_restarts}")
-                        w.start()
-                        alive = True
-                    else:
-                        print(f"[launch] worker {w.local_rank} failed "
-                              f"with {code}; stopping job")
-                        for other in workers:
-                            other.terminate()
-                        return code
-            if not alive:
+            codes = [w.poll() for w in workers]
+            if manager is not None:
+                reason = manager.failed_reason()
+                if reason is not None:
+                    print(f"[launch] elastic: {reason}; stopping job")
+                    for w in workers:
+                        w.terminate()
+                    return 1
+                new_epoch = manager.current_epoch()
+                if new_epoch > epoch:
+                    world = manager.current_world() or []
+                    if args.node_rank not in world:
+                        print("[launch] this node was scaled out of the "
+                              "job; exiting")
+                        for w in workers:
+                            w.terminate()
+                        return 0
+                    node_rank_now = world.index(args.node_rank)
+                    args.nnodes_now = len(world)
+                    epoch = new_epoch
+                    print(f"[launch] elastic epoch {epoch}: world={world}"
+                          f", this node re-ranked {node_rank_now}")
+                    group_restart(epoch, nnodes=len(world),
+                                  node_rank=node_rank_now)
+                    continue
+            if any(c is not None and c != 0 for c in codes):
+                bad = next(c for c in codes if c is not None and c != 0)
+                if group_restarts < args.max_restarts:
+                    group_restarts += 1
+                    if manager is not None:
+                        # multi-node: a local bump alone would desync the
+                        # coordinator port/world from the other nodes —
+                        # publish the epoch through the manager so EVERY
+                        # node's launcher restarts its group in step
+                        world = manager.current_world() \
+                            or list(range(args.nnodes_now))
+                        new_epoch = manager.publish(world)
+                        print(f"[launch] worker failed ({bad}); "
+                              f"published job-wide elastic epoch "
+                              f"{new_epoch} ({group_restarts}/"
+                              f"{args.max_restarts})")
+                        time.sleep(0.2)
+                        continue  # epoch-poll path restarts the group
+                    epoch += 1
+                    print(f"[launch] worker failed ({bad}); elastic "
+                          f"group restart {group_restarts}/"
+                          f"{args.max_restarts} at epoch {epoch}")
+                    group_restart(epoch, nnodes=args.nnodes_now,
+                                  node_rank=node_rank_now)
+                    continue
+                print(f"[launch] worker failed with {bad}; "
+                      f"restart budget exhausted; stopping job")
+                for w in workers:
+                    w.terminate()
+                return bad
+            if all(c == 0 for c in codes):
                 break
             time.sleep(0.2)
     finally:
         for w in workers:
             w.close()
+        if manager is not None:
+            manager.stop()
         if kv is not None:
             kv.stop()
     return exit_code

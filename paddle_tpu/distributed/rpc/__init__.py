@@ -2,21 +2,33 @@
 
 Capability parity with the reference RPC surface (reference:
 python/paddle/distributed/rpc/rpc.py — init_rpc, rpc_sync, rpc_async,
-shutdown over brpc). TPU-native: under the single-controller SPMD model
-one Python process drives all local devices, so an in-process executor
-IS the worker-local fast path (the reference also short-circuits
-self-targeted calls); cross-HOST RPC would ride the launcher's
-coordinator channel and is gated until multi-host wiring lands.
+shutdown over brpc + a master-kept worker registry). TPU-native:
+
+* single-controller hosts (``world_size == 1``) register an in-process
+  executor — the worker-local fast path (the reference also
+  short-circuits self-targeted calls);
+* ``world_size > 1`` rides the launcher's coordinator channel: each
+  worker starts an HTTP executor on an ephemeral port, registers
+  ``name -> endpoint`` in the launch KV master (``master_endpoint``),
+  barriers until every rank arrived, and cross-process calls POST a
+  pickled ``(fn, args, kwargs)`` to the target's executor. Functions
+  resolve by module-qualified pickling, matching the reference's
+  serialization contract.
 """
 from __future__ import annotations
 
 import concurrent.futures
+import pickle
 import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 _workers: Dict[str, dict] = {}
 _pool: Optional[concurrent.futures.ThreadPoolExecutor] = None
 _current_name: Optional[str] = None
+_server: Optional["_ExecServer"] = None
+_rendezvous: Optional[tuple] = None  # (master_endpoint, rank, world_size)
 
 
 class WorkerInfo:
@@ -31,28 +43,122 @@ class WorkerInfo:
         return f"WorkerInfo(name={self.name!r}, rank={self.rank})"
 
 
+class _ExecHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-rpc/1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_POST(self):
+        # job-token check BEFORE deserializing: the payload is pickle, so
+        # an unauthenticated request must never reach pickle.loads
+        if self.headers.get("X-RPC-Token") != self.server.token:
+            self.send_response(403)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        try:
+            fn, args, kwargs = pickle.loads(payload)
+            result = ("ok", fn(*args, **(kwargs or {})))
+        except Exception as e:  # propagate the remote exception
+            result = ("err", e)
+        body = pickle.dumps(result)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _reachable_ip(master_endpoint: str) -> str:
+    """The address peers can reach this host at: the local address of a
+    socket pointed toward the master (no traffic is sent)."""
+    import socket
+    host = master_endpoint.rsplit(":", 1)[0].replace("http://", "")
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((host, 9))
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return "127.0.0.1"
+
+
+class _ExecServer:
+    """Per-worker HTTP executor for cross-process calls. Binds all
+    interfaces (cross-HOST workers must reach it); every request must
+    carry the job token distributed through the KV master."""
+
+    def __init__(self, token: str):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", 0), _ExecHandler)
+        self._httpd.token = token
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
 def init_rpc(name: str, rank: int = 0, world_size: int = 1,
              master_endpoint: Optional[str] = None):
     """Register this process as an RPC worker.
 
-    ``master_endpoint`` is accepted for reference-signature parity but
-    unused by the in-process executor (a warning is emitted). Cross-host
-    RPC (world_size > 1) is gated until the multi-host coordinator
-    channel lands — it raises up front rather than failing at call time.
+    ``world_size == 1``: in-process executor only. ``world_size > 1``:
+    requires ``master_endpoint`` (the launch KV master, reference
+    master-endpoint contract) — starts the HTTP executor, registers this
+    worker, and waits for all peers.
     """
-    global _pool, _current_name
-    if world_size > 1:
-        raise NotImplementedError(
-            "cross-host RPC needs the multi-host launcher (coordinator "
-            "channel); single-controller hosts register in-process workers")
-    if master_endpoint is not None:
-        import warnings
-        warnings.warn("master_endpoint is ignored by the in-process RPC "
-                      "executor")
-    _workers[name] = {"info": WorkerInfo(name, rank)}
+    global _pool, _current_name, _server, _rendezvous
     _current_name = name
     if _pool is None:
         _pool = concurrent.futures.ThreadPoolExecutor(max_workers=4)
+    if world_size <= 1:
+        if master_endpoint is not None:
+            import warnings
+            warnings.warn("master_endpoint is unused for world_size==1 "
+                          "(in-process RPC executor)")
+        _workers[name] = {"info": WorkerInfo(name, rank), "local": True}
+        return _workers[name]["info"]
+
+    if master_endpoint is None:
+        raise ValueError(
+            "init_rpc(world_size>1) needs master_endpoint — the launch "
+            "KV master ('host:port', see paddle_tpu.distributed.launch)")
+    from ..launch.kv_server import KVClient, sync_peers
+
+    # job token: rank 0 mints it, everyone reads it from the KV master
+    # (the master is the job's trust root, like the reference's cluster)
+    kvc = KVClient(master_endpoint)
+    if rank == 0:
+        import secrets
+        token = secrets.token_hex(16)
+        kvc.put("/rpc-token", token)
+    else:
+        token = kvc.wait("/rpc-token", timeout=120)
+
+    _server = _ExecServer(token)
+    _rendezvous = (master_endpoint, rank, world_size, token)
+    endpoint = f"{_reachable_ip(master_endpoint)}:{_server.port}"
+    peers = sync_peers(master_endpoint, rank, world_size,
+                       payload=f"{name}@{endpoint}", job_id="rpc")
+    for r, entry in enumerate(peers):
+        pname, _, pend = entry.partition("@")
+        host, _, port = pend.partition(":")
+        _workers[pname] = {
+            "info": WorkerInfo(pname, r, ip=host, port=int(port)),
+            "local": r == rank,
+            "endpoint": pend,
+        }
+    _workers[name]["local"] = True
     return _workers[name]["info"]
 
 
@@ -95,20 +201,56 @@ class _TimedFuture:
         return self.result()
 
 
+def _remote_call(endpoint: str, fn, args, kwargs, timeout):
+    token = _rendezvous[3] if _rendezvous else ""
+    payload = pickle.dumps((fn, args, kwargs))
+    req = urllib.request.Request(f"http://{endpoint}/call", data=payload,
+                                 method="POST",
+                                 headers={"X-RPC-Token": token})
+    http_timeout = None if timeout in (-1, None) else timeout
+    with urllib.request.urlopen(req, timeout=http_timeout) as r:
+        status, value = pickle.loads(r.read())
+    if status == "err":
+        raise value
+    return value
+
+
 def rpc_async(to: str, fn, args=(), kwargs=None, timeout: float = -1):
     """Run ``fn`` on worker ``to``; returns a Future whose ``result()``
     honors ``timeout`` (seconds; -1 = wait forever)."""
     _check(to)
     if _pool is None:
         raise RuntimeError("call init_rpc first")
-    return _TimedFuture(_pool.submit(fn, *args, **(kwargs or {})), timeout)
+    w = _workers[to]
+    if w.get("local", False):
+        return _TimedFuture(_pool.submit(fn, *args, **(kwargs or {})),
+                            timeout)
+    return _TimedFuture(
+        _pool.submit(_remote_call, w["endpoint"], fn, args, kwargs,
+                     timeout),
+        timeout)
 
 
 def shutdown():
-    global _pool, _current_name
+    """Drain and tear down. Cross-process mode barriers through the KV
+    master first (reference rpc.shutdown contract) so no peer stops its
+    executor while another's call is still in flight."""
+    global _pool, _current_name, _server, _rendezvous
     if _pool is not None:
         _pool.shutdown(wait=True)
         _pool = None
+    if _rendezvous is not None:
+        from ..launch.kv_server import sync_peers
+        master, rank, world = _rendezvous[:3]
+        try:
+            sync_peers(master, rank, world, payload="bye",
+                       job_id="rpc-shutdown", timeout=60)
+        except Exception:
+            pass  # master already gone: peers are exiting anyway
+        _rendezvous = None
+    if _server is not None:
+        _server.stop()
+        _server = None
     _workers.clear()
     _current_name = None
 

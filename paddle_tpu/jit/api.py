@@ -90,8 +90,14 @@ class StaticFunction:
         # break reasons keyed per dispatch signature (statics + array
         # shapes/dtypes) — one breaking signature must not disable jit for
         # signatures that trace fine (the reference SOT falls back
-        # per-guard, not per-function)
+        # per-guard, not per-function). Bounded: a transient error must
+        # not grow this without limit across many distinct shapes.
         self._graph_breaks: dict = {}
+        self._graph_breaks_max = 256
+        # SOT partial-frame capture state: per-signature compiled-segment
+        # caches + stats of the most recent SOT run (see jit/sot).
+        self._sot_caches: dict = {}
+        self.sot_stats: Optional[dict] = None
 
     @property
     def graph_break_reason(self):
@@ -140,6 +146,12 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         if in_capture_mode():
+            return self._dygraph_fn(*args, **kwargs)
+        from . import sot as sot_mod
+        if sot_mod.active():
+            # called inside an outer SOT capture: the outer segment graph
+            # records these ops; a fresh jit here would choke on the
+            # LazyArray payloads
             return self._dygraph_fn(*args, **kwargs)
         self._check_input_spec(args)
         params = self._collect_params(args)
@@ -192,7 +204,7 @@ class StaticFunction:
         sig = (treedef, statics,
                tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
         if sig in self._graph_breaks:
-            return fn(*args, **kwargs)
+            return self._run_sot(sig, fn, args, kwargs)
         try:
             out, mutated = self._jitted([p._data for p in params], arrays,
                                         treedef, statics)
@@ -209,17 +221,42 @@ class StaticFunction:
             reason = f"{type(e).__name__}: {str(e).splitlines()[0]}"
             if self._full_graph:
                 raise
+            if len(self._graph_breaks) >= self._graph_breaks_max:
+                evicted = next(iter(self._graph_breaks))
+                self._graph_breaks.pop(evicted)
+                # drop the compiled segments with the signature — they
+                # hold XLA executables, far heavier than reason strings
+                self._sot_caches.pop(evicted, None)
             self._graph_breaks[sig] = reason
             import warnings
             warnings.warn(
-                f"to_static graph break in {self.__name__!r} — running "
-                f"eagerly ({reason}). Use lax-style control flow "
-                f"(paddle.where / static shapes) to capture fully.",
+                f"to_static graph break in {self.__name__!r} — switching "
+                f"to SOT partial-frame capture ({reason}): the op "
+                f"sequences between breaks still compile as XLA "
+                f"subgraphs. Use lax-style control flow (paddle.where / "
+                f"static shapes) to capture the whole function.",
                 stacklevel=2)
-            return fn(*args, **kwargs)
+            return self._run_sot(sig, fn, args, kwargs)
         for i, arr in mutated.items():
             params[i]._swap_payload(arr)
         return _wrap(out)
+
+    def _run_sot(self, sig, fn, args, kwargs):
+        """Partial-frame capture for a signature that cannot full-graph
+        trace (reference jit/sot/translate.py contract): ops before each
+        concretization point compile as one cached XLA subgraph, the break
+        runs eagerly, capture resumes after."""
+        from . import sot as sot_mod
+        if sot_mod.active():
+            # nested break inside an outer SOT capture: the outer segment
+            # machinery already records these ops — just run the frame
+            return fn(*args, **kwargs)
+        cache = self._sot_caches.setdefault(sig, {})
+        cap = sot_mod.capture(cache)
+        with cap:
+            out = fn(*args, **kwargs)
+        self.sot_stats = dict(cap.stats)
+        return out
 
     @property
     def code(self):

@@ -1,0 +1,267 @@
+"""SOT v1 — partial-frame graph breaks via deferred (lazy) execution.
+
+Reference contract: python/paddle/jit/sot/translate.py:98 (frame-eval entry),
+sot/symbolic/statement_ir.py (captured op-statement IR), and
+symbolic/compile_cache.py (guarded per-site program cache): when a function
+hits an untraceable construct, the reference compiles the statements BEFORE
+the break, runs the break eagerly, and resumes capture after it — instead of
+abandoning the whole frame.
+
+TPU-native redesign — no bytecode simulation. Python runs the frame
+normally, but ops dispatched while SOT capture is active do not execute:
+they append to a **segment graph** (the StatementIR analogue) and return
+``LazyArray`` placeholders carrying abstract shapes. Any concretization
+point — ``Tensor.numpy()``, ``bool()``, ``item()``, a host round-trip —
+**flushes** the current segment: the accumulated op list is compiled as ONE
+XLA program (the pre-break subgraph), executed, and capture resumes into a
+fresh segment. Function exit flushes the tail segment. A function with one
+mid-frame ``numpy()`` sync therefore yields exactly two compiled subgraphs.
+
+Guards + cache: each flushed segment is keyed by (site index, op-sequence
+fingerprint, external input shapes/dtypes) — re-running the function with
+the same shapes reuses the compiled programs (the compile_cache.py role).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+import weakref
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_tls = threading.local()
+
+
+def active() -> bool:
+    return getattr(_tls, "capture", None) is not None
+
+
+def current_segment() -> Optional["Segment"]:
+    cap = getattr(_tls, "capture", None)
+    return cap.segment if cap is not None else None
+
+
+class LazyArray:
+    """Placeholder payload for a Tensor whose value is a pending segment
+    node. Carries the abstract shape/dtype; concretizing (``__array__`` /
+    ``__jax_array__``) flushes the owning segment."""
+
+    __slots__ = ("segment", "node_id", "out_idx", "aval", "_value",
+                 "__weakref__")
+
+    def __init__(self, segment, node_id, out_idx, aval):
+        self.segment = segment
+        self.node_id = node_id
+        self.out_idx = out_idx
+        self.aval = aval
+        self._value = None
+
+    # ---- abstract metadata (Tensor.shape/.dtype/.ndim read these)
+    @property
+    def shape(self):
+        return tuple(self.aval.shape)
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    # ---- concretization = graph break boundary
+    def concrete(self):
+        if self._value is None:
+            self.segment.flush()
+        if self._value is None:  # pragma: no cover - defensive
+            raise RuntimeError("segment flush did not materialize node")
+        return self._value
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self.concrete())
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __jax_array__(self):
+        return self.concrete()
+
+    def astype(self, dtype):
+        seg = current_segment()
+        if self._value is None and seg is self.segment:
+            return seg.add("astype",
+                           lambda x, _d=dtype: x.astype(_d), [self],
+                           attr_key=str(dtype))[0]
+        return self.concrete().astype(dtype)
+
+    def __repr__(self):
+        state = "pending" if self._value is None else "materialized"
+        return (f"LazyArray(shape={self.shape}, dtype={self.dtype}, "
+                f"{state})")
+
+
+def _aval_of(x) -> jax.ShapeDtypeStruct:
+    if isinstance(x, LazyArray):
+        return x.aval
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    a = jnp.asarray(x) if not hasattr(x, "dtype") else x
+    return jax.ShapeDtypeStruct(tuple(getattr(a, "shape", ())), a.dtype)
+
+
+class Segment:
+    """One pre-break subgraph under construction (StatementIR analogue)."""
+
+    def __init__(self, owner: "capture"):
+        self.owner = owner
+        self.nodes: List[Tuple[str, Callable, tuple, int, str]] = []
+        self.ext_arrays: List[Any] = []
+        self._ext_ids: dict = {}
+        self._lazy: List[weakref.ref] = []
+        self._flushed = False
+
+    # ------------------------------------------------------------- inputs
+    def _ext(self, arr) -> int:
+        key = id(arr)
+        idx = self._ext_ids.get(key)
+        if idx is None:
+            idx = len(self.ext_arrays)
+            self.ext_arrays.append(arr)
+            self._ext_ids[key] = idx
+        return idx
+
+    def _ref_of(self, a):
+        if isinstance(a, LazyArray) and a._value is None \
+                and a.segment is self:
+            return ("n", a.node_id, a.out_idx)
+        if isinstance(a, LazyArray):
+            return ("x", self._ext(a.concrete()))
+        return ("x", self._ext(a))
+
+    # ------------------------------------------------------------ capture
+    def add(self, op_name: str, f: Callable, arrays: Sequence,
+            attr_key: str = "") -> List[LazyArray]:
+        """Append one op; returns LazyArrays for its outputs. Raises if the
+        op cannot be shape-inferred (caller falls back to concrete)."""
+        lazies, _multi = self.add_with_structure(op_name, f, arrays,
+                                                attr_key)
+        return lazies
+
+    def add_with_structure(self, op_name: str, f: Callable,
+                           arrays: Sequence, attr_key: str = ""):
+        in_refs = tuple(self._ref_of(a) for a in arrays)
+        avals = [a.aval if isinstance(a, LazyArray) and a._value is None
+                 else _aval_of(a) for a in arrays]
+        out = jax.eval_shape(f, *avals)
+        multi = isinstance(out, (tuple, list))
+        out_avals = list(out) if multi else [out]
+        node_id = len(self.nodes)
+        self.nodes.append((op_name, f, in_refs, len(out_avals), attr_key))
+        lazies = [LazyArray(self, node_id, i, av)
+                  for i, av in enumerate(out_avals)]
+        self._lazy.extend(weakref.ref(l) for l in lazies)
+        return lazies, multi
+
+    # -------------------------------------------------------------- flush
+    def fingerprint(self, out_refs) -> tuple:
+        return (
+            tuple((op, attr_key, in_refs, n_out)
+                  for op, _f, in_refs, n_out, attr_key in self.nodes),
+            tuple((tuple(_aval_of(a).shape), str(_aval_of(a).dtype))
+                  for a in self.ext_arrays),
+            tuple(out_refs),
+        )
+
+    def flush(self) -> None:
+        """Compile + execute the accumulated subgraph, materialize every
+        live LazyArray, and hand the capture a fresh segment."""
+        if self._flushed:
+            return
+        self._flushed = True
+        self.owner._segment_closed(self)
+        if not self.nodes:
+            return
+        live = [l for l in (r() for r in self._lazy)
+                if l is not None and l._value is None]
+        out_refs = sorted({(l.node_id, l.out_idx) for l in live})
+        key = (self.owner.site_idx, self.fingerprint(out_refs))
+        jitted = self.owner.cache.get(key)
+        if jitted is None:
+            nodes = self.nodes
+
+            def seg_fn(ext):
+                env: List[List[Any]] = []
+                for _op, f, in_refs, _n, _ak in nodes:
+                    ins = [env[r[1]][r[2]] if r[0] == "n" else ext[r[1]]
+                           for r in in_refs]
+                    o = f(*ins)
+                    env.append(list(o) if isinstance(o, (tuple, list))
+                               else [o])
+                return [env[i][j] for i, j in out_refs]
+
+            jitted = jax.jit(seg_fn)
+            self.owner.cache[key] = jitted
+            self.owner.stats["compiled"] += 1
+        results = jitted(self.ext_arrays)
+        value_of = dict(zip(out_refs, results))
+        for l in live:
+            l._value = value_of[(l.node_id, l.out_idx)]
+        self.owner.stats["segments"] += 1
+        self.owner.site_idx += 1
+
+
+class capture:
+    """Context manager activating SOT lazy capture on this thread.
+
+    ``cache`` persists across invocations (per StaticFunction+signature);
+    ``stats`` counts segments flushed / programs compiled for this run.
+    """
+
+    def __init__(self, cache: Optional[dict] = None):
+        self.cache = cache if cache is not None else {}
+        self.stats = {"segments": 0, "compiled": 0}
+        self.segment = Segment(self)
+        self.site_idx = 0
+
+    def _segment_closed(self, seg: Segment):
+        if seg is self.segment:
+            self.segment = Segment(self)
+
+    def __enter__(self):
+        if getattr(_tls, "capture", None) is not None:
+            raise RuntimeError("SOT capture is not reentrant")
+        _tls.capture = self
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _tls.capture = None
+        if exc_type is None:
+            self.segment.flush()
+        return False
+
+
+def record_or_none(op_name: str, f: Callable, arrays: Sequence,
+                   attrs: Optional[dict]):
+    """Dispatch hook: append the op to the active segment. Returns
+    ``(lazy_outputs, is_multi_output)``, or None when SOT is inactive /
+    the op cannot be deferred (shape inference failed → caller executes
+    concretely after we flush, an implicit break)."""
+    seg = current_segment()
+    if seg is None:
+        return None
+    try:
+        attr_key = repr(sorted((attrs or {}).items()))
+    except Exception:
+        attr_key = f"<unrepr:{op_name}>"
+    try:
+        return seg.add_with_structure(op_name, f, arrays,
+                                      attr_key=attr_key)
+    except Exception:
+        # data-dependent output shape (nonzero, unique, …): break here —
+        # flush the prefix and let the op run on concrete values
+        seg.flush()
+        return None
