@@ -199,6 +199,22 @@ def unregister_recorder_hook(fn):
         hooks.remove(fn)
 
 
+_export_hooks: List[Callable] = []  # ONNX/interchange tracers: receive
+# (op_name, tensor_inputs, out_tensors, export_attrs) — the SEMANTIC op
+# parameters (stride/padding/...) that the jax lowering closures over
+
+
+def register_export_hook(fn):
+    _export_hooks.append(fn)
+
+
+def unregister_export_hook(fn):
+    try:
+        _export_hooks.remove(fn)
+    except ValueError:
+        pass
+
+
 def register_op_hook(fn):
     _op_hooks.append(fn)
     return fn
@@ -246,10 +262,13 @@ def _lazy_vjp(f, arrays):
 
 def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
          attrs: Optional[dict] = None, multi_output: bool = False,
-         differentiable_mask: Optional[Sequence[bool]] = None):
+         differentiable_mask: Optional[Sequence[bool]] = None,
+         export_attrs: Optional[dict] = None):
     """Run one op: ``fn(*arrays, **attrs)`` over the payloads of
     ``tensor_inputs``, recording a GradNode when grad is enabled and any
-    input requires grad. Returns Tensor or list of Tensors."""
+    input requires grad. Returns Tensor or list of Tensors.
+    ``export_attrs`` carries the op's semantic parameters for interchange
+    tracers (ONNX export) — it never affects execution."""
     global _sot
     attrs = attrs or {}
     s = _tls()
@@ -358,6 +377,12 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
         # recorder taps (static.Program capture) additionally receive the
         # attr-bound lowering so the op can be replayed on new payloads
         hook(op_name, f, tensor_inputs, out_tensors)
+    if _export_hooks:
+        merged = dict(attrs)
+        if export_attrs:
+            merged.update(export_attrs)
+        for hook in _export_hooks:
+            hook(op_name, tensor_inputs, out_tensors, merged)
 
     if single:
         return out_tensors[0]

@@ -1,0 +1,148 @@
+"""Analytic cost model for parallel-config pruning.
+
+Reference: python/paddle/distributed/auto_parallel/static/cost_model.py +
+cluster.py — the static planner estimates per-config memory and
+communication cost and prunes infeasible candidates before any trial
+runs. TPU-native form: closed-form transformer estimates (params, grads,
+optimizer states, activations vs per-chip HBM; ring-allreduce /
+tensor-parallel / pipeline p2p bytes vs ICI bandwidth) over a
+``ClusterSpec`` describing the chip generation.
+
+All byte math is per CHIP. Transformer activation footprint follows the
+standard sequence-parallel accounting (selective remat toggles the
+per-layer constant); the point is pruning and ordering, not exactness —
+trial runs remain the ground truth for survivors.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["ClusterSpec", "estimate", "prune_by_cost"]
+
+
+@dataclass
+class ClusterSpec:
+    """Per-chip capability description (reference cluster.py JSON)."""
+    hbm_bytes: float = 16e9            # v5e: 16 GB
+    peak_flops: float = 197e12         # bf16
+    ici_bw: float = 4.5e10             # bytes/s per link-direction (~45 GB/s)
+    dcn_bw: float = 6.25e9             # bytes/s (~50 Gb/s)
+    mem_fraction: float = 0.90         # usable HBM after runtime reserve
+
+    @classmethod
+    def v5e(cls):
+        return cls()
+
+    @classmethod
+    def v4(cls):
+        return cls(hbm_bytes=32e9, peak_flops=275e12, ici_bw=9e10)
+
+    @classmethod
+    def v5p(cls):
+        return cls(hbm_bytes=95e9, peak_flops=459e12, ici_bw=9e10)
+
+
+def _degrees(cfg: Dict) -> Tuple[int, int, int, int]:
+    return (int(cfg.get("dp", 1)), int(cfg.get("mp", 1)),
+            int(cfg.get("pp", 1)), int(cfg.get("sharding", 1)))
+
+
+def estimate(model_cfg: Dict, parallel_cfg: Dict,
+             train_cfg: Optional[Dict] = None,
+             cluster: Optional[ClusterSpec] = None) -> Dict:
+    """Closed-form per-chip cost estimate for one candidate config.
+
+    model_cfg: num_layers, hidden_size, num_heads, vocab_size, seq_len.
+    train_cfg: global_batch (sequences), micro_batch, recompute (bool),
+    param_bytes (2 = bf16), optim_bytes_per_param (12 = Adam m+v+master).
+    Returns memory/comm/time fields plus ``fits`` and ``reasons``.
+    """
+    train_cfg = train_cfg or {}
+    cluster = cluster or ClusterSpec.v5e()
+    dp, mp, pp, sd = _degrees(parallel_cfg)
+    L = int(model_cfg.get("num_layers", 12))
+    h = int(model_cfg.get("hidden_size", 768))
+    a = int(model_cfg.get("num_heads", max(1, h // 64)))
+    V = int(model_cfg.get("vocab_size", 50257))
+    s = int(model_cfg.get("seq_len", 1024))
+    B = int(train_cfg.get("global_batch", 8))
+    mbs = int(train_cfg.get("micro_batch", max(1, B // (dp * sd))))
+    remat = bool(train_cfg.get("recompute", False))
+    pbytes = float(train_cfg.get("param_bytes", 2.0))
+    obytes = float(train_cfg.get("optim_bytes_per_param", 12.0))
+
+    # ---- memory (per chip)
+    n_params = 12 * L * h * h + V * h
+    p_shard = n_params / (mp * pp)              # dp/sharding replicate...
+    weights = p_shard * pbytes
+    grads = p_shard * pbytes
+    optim = p_shard * obytes / max(sd * dp, 1)  # ...ZeRO shards states
+    b_local = max(1, B // (dp * sd))
+    micro = min(mbs, b_local)
+    # per-layer activation bytes per microbatch (Korthikanti-style):
+    # full retention ~ sbh(34 + 5 a s / h); selective remat ~ 2 sbh
+    if remat:
+        act_layer = 2.0 * s * micro * h
+    else:
+        act_layer = s * micro * h * (34.0 + 5.0 * a * s / h) / mp
+    in_flight = min(pp, max(1, b_local // micro))
+    acts = act_layer * (L / pp) * in_flight
+    mem = weights + grads + optim + acts
+    budget = cluster.hbm_bytes * cluster.mem_fraction
+
+    # ---- communication bytes per step (per chip, ICI)
+    ring = lambda n, bytes_: 2.0 * (n - 1) / max(n, 1) * bytes_
+    comm_dp = ring(dp * sd, grads) if dp * sd > 1 else 0.0
+    n_micro = max(1, b_local // micro)
+    comm_mp = (4.0 * L / pp * s * micro * h * pbytes * 2.0 * n_micro
+               if mp > 1 else 0.0)              # fwd+bwd allreduce pairs
+    comm_pp = (2.0 * n_micro * s * micro * h * pbytes
+               if pp > 1 else 0.0)              # boundary p2p both ways
+    comm = comm_dp + comm_mp + comm_pp
+
+    # ---- step-time model: compute + exposed comm
+    flops = 6.0 * n_params * (B * s) / (dp * mp * pp * sd)
+    if remat:
+        flops *= 4.0 / 3.0
+    t_compute = flops / cluster.peak_flops
+    t_comm = comm / cluster.ici_bw
+    bubble = (pp - 1) / max(n_micro + pp - 1, 1)
+    t_step = (t_compute + t_comm) / max(1.0 - bubble, 1e-6)
+
+    reasons = []
+    if mem > budget:
+        reasons.append(
+            f"OOM: needs {mem / 1e9:.2f} GB/chip > "
+            f"{budget / 1e9:.2f} GB usable")
+    # divisibility is only a USER constraint: enforce it solely when the
+    # caller actually specified a global batch (a defaulted B must never
+    # reject otherwise-valid configs)
+    if "global_batch" in train_cfg and (b_local < 1 or B % (dp * sd)):
+        reasons.append(f"global batch {B} not divisible by dp*sharding "
+                       f"{dp * sd}")
+    return {"mem_bytes": mem, "weights": weights, "grads": grads,
+            "optim": optim, "activations": acts, "comm_bytes": comm,
+            "est_step_time": t_step, "fits": not reasons,
+            "reasons": reasons}
+
+
+def prune_by_cost(configs: List[Dict], model_cfg: Dict,
+                  train_cfg: Optional[Dict] = None,
+                  cluster: Optional[ClusterSpec] = None
+                  ) -> Tuple[List[Dict], List[Dict]]:
+    """Split candidates into (kept, rejected) WITHOUT running anything;
+    kept is ordered by estimated step time so trials hit likely winners
+    first (reference tuner's cost-guided search order)."""
+    kept, rejected = [], []
+    for cfg in configs:
+        est = estimate(model_cfg, cfg, train_cfg, cluster)
+        if est["fits"]:
+            kept.append({**cfg, "_est": est})
+        else:
+            rejected.append({**cfg, "pruned": "; ".join(est["reasons"]),
+                             "est_mem_gb": round(est["mem_bytes"] / 1e9,
+                                                 2)})
+    kept.sort(key=lambda c: c["_est"]["est_step_time"])
+    kept = [{k: v for k, v in c.items() if k != "_est"} for c in kept]
+    return kept, rejected

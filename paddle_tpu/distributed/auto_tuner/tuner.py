@@ -56,11 +56,19 @@ def prune(configs: List[Dict], model_cfg: Optional[Dict] = None
 
 class AutoTuner:
     def __init__(self, probe_fn: Callable[[Dict], float],
-                 model_cfg: Optional[Dict] = None):
+                 model_cfg: Optional[Dict] = None,
+                 train_cfg: Optional[Dict] = None, cluster=None):
         """probe_fn(config) -> step_time_seconds; raise to reject.
-        (Warmup/repeat policy belongs to the probe — see default_probe.)"""
+        (Warmup/repeat policy belongs to the probe — see default_probe.)
+        ``train_cfg``/``cluster`` enable analytic cost-model pruning
+        (cost_model.py, reference auto_parallel/static/cost_model.py):
+        configs whose estimated per-chip HBM exceeds the cluster budget
+        are rejected WITHOUT a trial run, and survivors are tried in
+        estimated-step-time order."""
         self.probe_fn = probe_fn
         self.model_cfg = model_cfg
+        self.train_cfg = train_cfg
+        self.cluster = cluster
         self.results: List[Dict] = []
 
     def tune(self, n_devices: Optional[int] = None,
@@ -69,6 +77,16 @@ class AutoTuner:
         configs = prune(candidate_configs(n, axes), self.model_cfg)
         if not configs:
             raise ValueError("no valid parallel configs to try")
+        if self.model_cfg and (self.train_cfg is not None
+                               or self.cluster is not None):
+            from .cost_model import prune_by_cost
+            configs, rejected = prune_by_cost(
+                configs, self.model_cfg, self.train_cfg, self.cluster)
+            self.results.extend(rejected)
+            if not configs:
+                raise ValueError(
+                    "cost model rejected every candidate config: "
+                    + "; ".join(r["pruned"] for r in rejected[:3]))
         best = None
         for cfg in configs:
             try:

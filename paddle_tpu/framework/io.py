@@ -1,14 +1,25 @@
 """Single-process save/load.
 
-Reference: python/paddle/framework/io.py (save:743 / load:985 — pickled
-nested state_dict, protocol 4). Tensors are serialized as numpy arrays and
-rehydrated onto the current device on load; bfloat16 round-trips through a
-uint16 view since numpy lacks the dtype.
+Reference: python/paddle/framework/io.py (save:743 / load:985 — the
+reference chunks large pickles to dodge the 4 GB single-bytes limits of
+old protocols and pins the pickle protocol). TPU-native format: the
+pickled structure stays SMALL — every array above a threshold is replaced
+by an indexed placeholder and its bytes are streamed to the same file in
+fixed-size chunks after the pickle blob, so a multi-GB state_dict never
+materializes a second copy in memory and no pickle frame approaches the
+4 GB limits regardless of protocol. bfloat16 arrays round-trip natively
+(ml_dtypes numpy dtype).
+
+Layout: ``magic | u64 pickle_len | pickle | raw segments… | footer
+pickle | u64 footer_off`` — the footer maps placeholder index ->
+(offset, nbytes, dtype, shape). Legacy plain-pickle files (round-2
+checkpoints) still load.
 """
 from __future__ import annotations
 
 import os
 import pickle
+import struct
 
 import jax.numpy as jnp
 import numpy as np
@@ -17,44 +28,140 @@ from ..core import dtype as dtypes
 from ..core.tensor import Tensor
 
 _BF16_TAG = "__bf16__"
+_EXT_TAG = "__ext_seg__"
+_MAGIC = b"PTCKPT01"
+_SEG_THRESHOLD = 1 << 20        # arrays >= 1 MB stream as raw segments
+_CHUNK = 64 << 20               # 64 MB write/read granularity
 
 
-def _pack(obj):
+def _to_numpy(arr) -> np.ndarray:
+    return np.asarray(arr)
+
+
+def _pack(obj, segments):
     if isinstance(obj, Tensor):
-        arr = obj._data
-        if np.dtype(arr.dtype) == dtypes.bfloat16:
-            return {_BF16_TAG: True,
-                    "data": np.asarray(arr.astype(jnp.float32))}
-        return np.asarray(arr)
+        obj = obj._data
+        # fall through: payloads serialize as arrays, tagged for rehydrate
+        arr = _to_numpy(obj)
+        if arr.nbytes >= _SEG_THRESHOLD:
+            segments.append(arr)
+            return {_EXT_TAG: len(segments) - 1, "tensor": True}
+        return {"__tensor__": True, "data": arr}
+    if isinstance(obj, (jnp.ndarray, np.ndarray)) and not np.isscalar(obj):
+        arr = _to_numpy(obj)
+        if arr.nbytes >= _SEG_THRESHOLD:
+            segments.append(arr)
+            return {_EXT_TAG: len(segments) - 1, "tensor": False}
+        return arr
     if isinstance(obj, dict):
-        return {k: _pack(v) for k, v in obj.items()}
+        return {k: _pack(v, segments) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         t = type(obj)
-        return t(_pack(v) for v in obj)
+        return t(_pack(v, segments) for v in obj)
     return obj
 
 
-def _unpack(obj):
+def _rehydrate_array(arr: np.ndarray, as_tensor: bool):
+    # every loaded array rehydrates as Tensor regardless of segment size —
+    # the load contract must not depend on the save-side threshold
+    del as_tensor
+    return Tensor(jnp.asarray(arr))
+
+
+def _unpack(obj, seg_arrays):
     if isinstance(obj, dict):
-        if obj.get(_BF16_TAG):
+        if _EXT_TAG in obj:
+            return _rehydrate_array(seg_arrays[obj[_EXT_TAG]],
+                                    obj.get("tensor", True))
+        if obj.get(_BF16_TAG):  # legacy round-2 bf16 encoding
             return Tensor(jnp.asarray(obj["data"]).astype(dtypes.bfloat16))
-        return {k: _unpack(v) for k, v in obj.items()}
+        if obj.get("__tensor__"):
+            return Tensor(jnp.asarray(obj["data"]))
+        return {k: _unpack(v, seg_arrays) for k, v in obj.items()}
     if isinstance(obj, np.ndarray):
         return Tensor(jnp.asarray(obj))
     if isinstance(obj, (list, tuple)):
         t = type(obj)
-        return t(_unpack(v) for v in obj)
+        return t(_unpack(v, seg_arrays) for v in obj)
     return obj
 
 
+def _write_segment(f, arr: np.ndarray) -> tuple:
+    offset = f.tell()
+    view = memoryview(np.ascontiguousarray(arr).reshape(-1).view(np.uint8))
+    for pos in range(0, len(view), _CHUNK):
+        f.write(view[pos:pos + _CHUNK])
+    return (offset, arr.nbytes, str(arr.dtype), tuple(arr.shape))
+
+
+def _read_segment(f, offset, nbytes, dtype, shape) -> np.ndarray:
+    out = np.empty(int(np.prod(shape)) if shape else 1, np.dtype(dtype))
+    buf = out.view(np.uint8).reshape(-1)
+    f.seek(offset)
+    pos = 0
+    while pos < nbytes:
+        n = f.readinto(memoryview(buf)[pos:pos + _CHUNK])
+        if not n:
+            raise EOFError(f"truncated checkpoint segment at {offset}")
+        pos += n
+    return out.reshape(shape)
+
+
 def save(obj, path, protocol=4, **configs):
+    """Persist ``obj`` (state_dict / nested containers / Tensors).
+
+    ``protocol`` is pinned to the 2..5 range (reference io.py contract);
+    large arrays bypass pickle entirely, so any allowed protocol handles
+    arbitrarily large checkpoints.
+    """
+    if not 2 <= int(protocol) <= pickle.HIGHEST_PROTOCOL:
+        raise ValueError(
+            f"pickle protocol must be in [2, {pickle.HIGHEST_PROTOCOL}], "
+            f"got {protocol}")
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
+    segments = []
+    packed = _pack(obj, segments)
+    blob = pickle.dumps(packed, protocol=int(protocol))
     with open(path, "wb") as f:
-        pickle.dump(_pack(obj), f, protocol=protocol)
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(blob)))
+        f.write(blob)
+        index = [_write_segment(f, arr) for arr in segments]
+        footer = pickle.dumps(index, protocol=int(protocol))
+        footer_off = f.tell()
+        f.write(footer)
+        f.write(struct.pack("<Q", footer_off))
 
 
 def load(path, **configs):
     with open(path, "rb") as f:
-        return _unpack(pickle.load(f))
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            # legacy round-2 format: one plain pickle
+            f.seek(0)
+            return _unpack_legacy(pickle.load(f))
+        (blob_len,) = struct.unpack("<Q", f.read(8))
+        packed = pickle.loads(f.read(blob_len))
+        f.seek(-8, os.SEEK_END)
+        (footer_off,) = struct.unpack("<Q", f.read(8))
+        f.seek(footer_off)
+        end = f.seek(0, os.SEEK_END) - 8
+        f.seek(footer_off)
+        index = pickle.loads(f.read(end - footer_off))
+        seg_arrays = [_read_segment(f, *entry) for entry in index]
+        return _unpack(packed, seg_arrays)
+
+
+def _unpack_legacy(obj):
+    if isinstance(obj, dict):
+        if obj.get(_BF16_TAG):
+            return Tensor(jnp.asarray(obj["data"]).astype(dtypes.bfloat16))
+        return {k: _unpack_legacy(v) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray):
+        return Tensor(jnp.asarray(obj))
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack_legacy(v) for v in obj)
+    return obj

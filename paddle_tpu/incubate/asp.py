@@ -243,8 +243,14 @@ class _DecoratedStep:
 
     def __call__(self, *args, **kwargs):
         out = self._orig_step(*args, **kwargs)
-        # re-apply masks to every registered param this optimizer owns
-        params = getattr(self._opt, "_parameter_list", None) or []
+        # re-apply masks to every registered param this optimizer owns;
+        # if the optimizer stores params elsewhere (param groups, custom
+        # subclass), fall back to ALL live registered masks so pruned
+        # weights can never silently drift nonzero
+        params = getattr(self._opt, "_parameter_list", None)
+        if not params:
+            params = [ref() for ref, _m in ASPHelper._masks_by_id.values()]
+            params = [p for p in params if p is not None]
         for p in params:
             mask = ASPHelper.mask_for(p)
             if mask is not None:

@@ -134,7 +134,7 @@ def softmax(x, axis=-1, dtype=None, name=None):
             from ...core.dtype import convert_dtype
             a = a.astype(convert_dtype(dtype))
         return jax.nn.softmax(a, axis=axis)
-    return dispatch.call("softmax", f, [x])
+    return dispatch.call("softmax", f, [x], export_attrs={"axis": axis})
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

@@ -73,7 +73,9 @@ def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, channel_last,
             shape[-1 if channel_last else 1] = b.size
             y = y + b.reshape(shape)
         return y
-    return dispatch.call(op_name, f, inputs)
+    return dispatch.call(op_name, f, inputs, export_attrs={
+        "stride": stride, "padding": pad, "dilation": dilation,
+        "groups": groups, "channel_last": channel_last})
 
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,

@@ -136,7 +136,14 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
              / jnp.sqrt(rv.astype(jnp.float32).reshape(shape) + epsilon))
         y = _affine(y, wb, has_w, has_b, ch_axis, a.ndim)
         return y.astype(dt)
-    return dispatch.call("batch_norm", f, inputs)
+    # the running-stat snapshots force a device->host sync, so they are
+    # built only while an export tracer is actually registered
+    ea = None
+    if dispatch._export_hooks:
+        ea = {"epsilon": epsilon, "ch_axis": ch_axis, "has_w": has_w,
+              "has_b": has_b, "mean": np.asarray(rm, np.float32),
+              "var": np.asarray(rv, np.float32)}
+    return dispatch.call("batch_norm", f, inputs, export_attrs=ea)
 
 
 def _affine(y, wb, has_w, has_b, ch_axis, ndim):

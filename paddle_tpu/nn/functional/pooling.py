@@ -78,7 +78,11 @@ def _pool_nd(x, ksize, stride, padding, nd, channel_last, mode,
             cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, cfg)
             return s / cnt
         return s / float(np.prod(ksize))
-    return dispatch.call(op_name, f, [x])
+    return dispatch.call(op_name, f, [x], export_attrs={
+        "kernel_size": ksize, "stride": stride,
+        "padding": pad if pad is not None else pad_mode, "mode": mode,
+        "exclusive": exclusive, "ceil_mode": ceil_mode,
+        "channel_last": channel_last})
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -232,7 +236,9 @@ def _adaptive_pool_nd(x, output_size, nd, channel_last, mode, op_name):
             inv = (0,) + tuple(range(2, 2 + nd)) + (1,)
             y = jnp.transpose(y, inv)
         return y
-    return dispatch.call(op_name, f, [x])
+    return dispatch.call(op_name, f, [x], export_attrs={
+        "output_size": output_size, "mode": mode,
+        "channel_last": channel_last})
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):

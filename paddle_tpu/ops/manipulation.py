@@ -75,7 +75,8 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
             return a.reshape(1)
         new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
         return a.reshape(new_shape)
-    return dispatch.call("flatten", f, [xt])
+    return dispatch.call("flatten", f, [xt],
+                         export_attrs={"start_axis": s, "stop_axis": e})
 
 
 @register("squeeze", category="manipulation")

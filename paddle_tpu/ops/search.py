@@ -204,6 +204,11 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
         key = default_generator().next_key()
 
     def f(probs, p):
+        if threshold is not None:
+            # reference threshold semantics: tokens whose probability is
+            # below the floor never enter the nucleus (their mass is
+            # dropped before the cumulative-p cut)
+            probs = jnp.where(probs >= threshold, probs, 0.0)
         order = jnp.argsort(-probs, axis=-1)
         sp = jnp.take_along_axis(probs, order, axis=-1)
         csum = jnp.cumsum(sp, axis=-1)
@@ -211,7 +216,8 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
         keep = (csum - sp) < p[:, None]
         keep = keep.at[:, 0].set(True)
         masked = jnp.where(keep, sp, 0.0)
-        masked = masked / jnp.sum(masked, axis=-1, keepdims=True)
+        masked = masked / jnp.maximum(
+            jnp.sum(masked, axis=-1, keepdims=True), 1e-20)
         gumbel = -jnp.log(-jnp.log(
             jax.random.uniform(key, masked.shape, minval=1e-20, maxval=1.0)))
         choice = jnp.argmax(jnp.where(keep, jnp.log(masked + 1e-20) + gumbel,

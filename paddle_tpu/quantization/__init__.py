@@ -209,6 +209,7 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     8-bit path. weight: (in, out) int8; weight_scale: (out,).
     """
     xt, wt = _t(x), _t(weight)
+    use_ste = dispatch.grad_enabled() and not xt.stop_gradient
     tensors = [xt, wt]
     if weight_scale is not None:
         tensors.append(_t(weight_scale))
@@ -231,11 +232,22 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
         row_scale = jnp.maximum(row_scale, 1e-8)
         aq = jnp.clip(jnp.round(a_int / row_scale), -128, 127).astype(
             jnp.int8)
-        int_out = jax.lax.dot_general(
+        int_exact = jax.lax.dot_general(
             aq, w, (((aq.ndim - 1,), (0,)), ((), ())),
             preferred_element_type=jnp.int32).astype(a.dtype) * row_scale
-        # fp path for outlier columns against dequantized weight
         wd = w.astype(a.dtype)
+        if use_ste:
+            # straight-through estimator: forward keeps the true int8 MXU
+            # matmul; backward flows through the float surrogate so the
+            # activation gradient of non-outlier columns is not silently
+            # dropped by round/clip's zero derivative. Built only when a
+            # gradient can flow — inference pays for the int8 path alone.
+            int_surrogate = a_int @ wd
+            int_out = int_surrogate + jax.lax.stop_gradient(
+                int_exact - int_surrogate)
+        else:
+            int_out = int_exact
+        # fp path for outlier columns against dequantized weight
         a_fp = a - a_int
         fp_out = a_fp @ wd
         out = int_out + fp_out

@@ -37,13 +37,15 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
 def embedding(input, size, is_sparse=False, padding_idx=None,
               param_attr=None, dtype="float32"):
     """reference static/nn/common.py embedding. is_sparse is a gradient
-    storage hint the SPMD design does not need; non-float32 dtype is not
-    supported here (raise rather than silently ignore)."""
-    if str(dtype) not in ("float32", "paddle.float32"):
-        raise NotImplementedError(
-            f"static.nn.embedding: dtype={dtype!r} (float32 only)")
+    storage hint the SPMD design does not need; ``dtype`` selects the
+    embedding weight dtype (float16/bfloat16/float32; float64 requires
+    JAX_ENABLE_X64)."""
+    from ..core import dtype as dtypes
     layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
                           weight_attr=param_attr)
+    want = dtypes.convert_dtype(str(dtype).replace("paddle.", ""))
+    if layer.weight.dtype != want:
+        layer.weight._swap_payload(layer.weight._data.astype(want))
     return layer(input)
 
 

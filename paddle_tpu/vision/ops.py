@@ -1056,18 +1056,23 @@ def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
                 stride2, corr_type_multiply=1, name=None):
     """FlowNet correlation (cost volume) between two feature maps.
 
-    out[n, k, i, j] = mean_c x1[n,c,si,sj] · x2[n,c,si+di,sj+dj] for each
-    displacement (di,dj) on the stride2 grid within ±max_displacement —
-    one fused gather+reduce per static displacement, which XLA vectorizes;
-    no CUDA kernel needed. kernel_size must be 1 (the FlowNet setting).
+    out[n, k, i, j] = mean over channels and the kernel_size² patch of
+    x1[n,c,si+u,sj+v] · x2[n,c,si+di+u,sj+dj+v] for each displacement
+    (di,dj) on the stride2 grid within ±max_displacement — one fused
+    gather+reduce per static displacement plus a box filter for the
+    patch, which XLA vectorizes; no CUDA kernel needed.
     Reference: phi/kernels/gpu/correlation_kernel.cu.
     """
-    if kernel_size != 1:
-        raise NotImplementedError("correlation: only kernel_size=1")
+    if kernel_size % 2 != 1:
+        raise ValueError("correlation: kernel_size must be odd")
     xt1, xt2 = _t(x1), _t(x2)
     d = max_displacement // stride2
-
-    border = max_displacement
+    r = (kernel_size - 1) // 2
+    border = max_displacement + r
+    if pad_size < border:
+        raise ValueError(
+            f"correlation: pad_size {pad_size} must cover "
+            f"max_displacement + (kernel_size-1)//2 = {border}")
 
     def f(a, b):
         n, c, h, w = a.shape
@@ -1075,18 +1080,26 @@ def correlation(x1, x2, pad_size, kernel_size, max_displacement, stride1,
         ap = jnp.pad(a, pad_cfg)
         bp = jnp.pad(b, pad_cfg)
         hp, wp = h + 2 * pad_size, w + 2 * pad_size
-        # reference output covers only positions where every displacement
-        # stays inside the padded map: [border, Hp-border) — sliced reads,
-        # never jnp.roll (roll would wrap displaced reads to the far edge)
+        # reference output covers only positions where every displaced
+        # PATCH stays inside the padded map: [border, Hp-border) — sliced
+        # reads, never jnp.roll (roll would wrap displaced reads to the
+        # far edge)
         eh, ew = hp - 2 * border, wp - 2 * border
-        base = ap[:, :, border:border + eh, border:border + ew]
+        base = ap[:, :, border - r:border + eh + r,
+                  border - r:border + ew + r]
         outs = []
         for di in range(-d, d + 1):
             for dj in range(-d, d + 1):
                 oy, ox = di * stride2, dj * stride2
-                shifted = bp[:, :, border + oy:border + oy + eh,
-                             border + ox:border + ox + ew]
-                outs.append((base * shifted).mean(axis=1))  # (n, eh, ew)
+                shifted = bp[:, :, border + oy - r:border + oy + eh + r,
+                             border + ox - r:border + ox + ew + r]
+                prod = (base * shifted).mean(axis=1)  # (n, eh+2r, ew+2r)
+                if r:
+                    prod = jax.lax.reduce_window(
+                        prod, 0.0, jax.lax.add,
+                        (1, kernel_size, kernel_size), (1, 1, 1),
+                        "VALID") / float(kernel_size * kernel_size)
+                outs.append(prod)                     # (n, eh, ew)
         out = jnp.stack(outs, axis=1)
         return out[:, :, ::stride1, ::stride1]
 

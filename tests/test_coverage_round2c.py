@@ -153,6 +153,50 @@ class TestAutoTuner:
         best = auto_tuner.tune(probe, n_devices=8, axes=("dp", "mp"))
         assert best["dp"] == 8 and best["mp"] == 1
 
+    def test_cost_model_prunes_without_execution(self):
+        # reference auto_parallel/static/cost_model.py contract: configs
+        # whose estimated per-chip HBM exceeds the cluster budget are
+        # rejected BEFORE any trial run
+        from paddle_tpu.distributed.auto_tuner.cost_model import (
+            ClusterSpec, estimate, prune_by_cost)
+        from paddle_tpu.distributed.auto_tuner.tuner import AutoTuner
+
+        model_cfg = {"num_layers": 32, "hidden_size": 4096,
+                     "num_heads": 32, "vocab_size": 32000,
+                     "seq_len": 2048}
+        train_cfg = {"global_batch": 8, "micro_batch": 1,
+                     "recompute": True}
+        # 7B-class params on 16GB chips: pure-dp replication cannot fit
+        est_dp = estimate(model_cfg, {"dp": 8}, train_cfg,
+                          ClusterSpec.v5e())
+        assert not est_dp["fits"] and "OOM" in est_dp["reasons"][0]
+        est_mp = estimate(model_cfg, {"mp": 4, "pp": 2}, train_cfg,
+                          ClusterSpec.v5e())
+        assert est_mp["mem_bytes"] < est_dp["mem_bytes"]
+
+        probed = []
+
+        def probe(cfg):
+            probed.append(dict(cfg))
+            return 1.0
+
+        tuner = AutoTuner(probe, model_cfg, train_cfg,
+                          cluster=ClusterSpec.v5e())
+        best = tuner.tune(n_devices=8, axes=("dp", "mp", "pp"))
+        # every pure-dp (replicated-weights) config was pruned unexecuted
+        assert all(c["mp"] * c["pp"] > 1 for c in probed)
+        pruned = [r for r in tuner.results if "pruned" in r]
+        assert any(r["dp"] == 8 for r in pruned)
+        assert all("OOM" in r["pruned"] for r in pruned)
+        assert best["mp"] * best["pp"] > 1
+
+        kept, rejected = prune_by_cost(
+            [{"dp": 8}, {"mp": 4, "pp": 2}, {"mp": 8}], model_cfg,
+            train_cfg, ClusterSpec.v5e())
+        assert {"dp": 8} not in kept
+        # survivors come back ordered by estimated step time
+        assert len(kept) >= 1 and all("pruned" in r for r in rejected)
+
 
 class TestSyncUtils:
     def test_broadcasts_and_fused_allreduce(self, dp_mesh):

@@ -1,0 +1,75 @@
+"""Chunked checkpoint format tests (reference framework/io.py:743 —
+large-pickle chunking + protocol handling)."""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import io as fio
+
+
+class TestChunkedFormat:
+    def test_segment_roundtrip_mixed_dtypes(self, tmp_path):
+        import jax.numpy as jnp
+        big_f32 = paddle.to_tensor(
+            np.arange(2 * fio._SEG_THRESHOLD // 4, dtype=np.float32))
+        big_bf16 = paddle.Tensor(
+            jnp.arange(fio._SEG_THRESHOLD, dtype=jnp.bfloat16))
+        small = paddle.to_tensor(np.asarray([1.5, 2.5], np.float32))
+        state = {"w": big_f32, "h": big_bf16, "b": small,
+                 "step": 7, "name": "ckpt"}
+        path = str(tmp_path / "chunked.pdparams")
+        fio.save(state, path)
+        with open(path, "rb") as f:
+            assert f.read(8) == fio._MAGIC
+        out = fio.load(path)
+        np.testing.assert_array_equal(np.asarray(out["w"]._data),
+                                      np.asarray(big_f32._data))
+        assert str(out["h"].dtype) == "bfloat16"
+        np.testing.assert_array_equal(
+            np.asarray(out["h"]._data.astype(jnp.float32)),
+            np.asarray(big_bf16._data.astype(jnp.float32)))
+        np.testing.assert_array_equal(np.asarray(out["b"]._data),
+                                      [1.5, 2.5])
+        assert out["step"] == 7 and out["name"] == "ckpt"
+
+    def test_legacy_plain_pickle_still_loads(self, tmp_path):
+        path = str(tmp_path / "legacy.pdparams")
+        legacy = {"w": np.asarray([[1.0, 2.0]], np.float32),
+                  "h": {fio._BF16_TAG: True,
+                        "data": np.asarray([3.0], np.float32)}}
+        with open(path, "wb") as f:
+            pickle.dump(legacy, f, protocol=4)
+        out = fio.load(path)
+        np.testing.assert_array_equal(np.asarray(out["w"]._data),
+                                      [[1.0, 2.0]])
+        assert str(out["h"].dtype) == "bfloat16"
+
+    def test_protocol_pinned(self, tmp_path):
+        with pytest.raises(ValueError, match="protocol"):
+            fio.save({"a": 1}, str(tmp_path / "x"), protocol=1)
+        fio.save({"a": 1}, str(tmp_path / "y"), protocol=2)
+        assert fio.load(str(tmp_path / "y"))["a"] == 1
+
+    def test_over_4gb_state_dict(self, tmp_path):
+        """A >4 GB state_dict streams through without any pickle frame
+        near the 4 GB limit (reference io.py:743 chunking contract)."""
+        gib = 1 << 30
+        state = {
+            "embed": paddle.to_tensor(
+                np.zeros(gib // 2, np.float32)),      # 2.0 GiB
+            "ffn": paddle.to_tensor(
+                np.zeros(gib // 2, np.float32)),      # 2.0 GiB
+            "head": paddle.to_tensor(
+                np.full(gib // 8, 3.0, np.float32)),  # 0.5 GiB
+        }
+        path = str(tmp_path / "big.pdparams")
+        fio.save(state, path)
+        assert os.path.getsize(path) > 4 * gib
+        out = fio.load(path)
+        assert out["embed"].shape == [gib // 2]
+        assert float(out["head"]._data[0]) == 3.0
+        assert float(out["ffn"]._data[-1]) == 0.0
+        del state, out

@@ -1,0 +1,156 @@
+"""Native ONNX export tests.
+
+Reference contract: python/paddle/onnx/export.py — paddle.onnx.export
+produces a .onnx file whose execution matches the live model's logits.
+No onnx/onnxruntime in the image, so verification uses the bundled
+protobuf parser + numpy evaluator (paddle_tpu/onnx/runtime.py); an
+onnxruntime cross-check runs automatically when that package exists.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.onnx as ponnx
+from paddle_tpu import nn
+
+
+def _export_and_run(model, x, tmp_path, name):
+    path = ponnx.export(model, str(tmp_path / name),
+                        input_spec=[paddle.to_tensor(x)])
+    got = ponnx.run(path, {"x0": x})[0]
+    model.eval()
+    ref = model(paddle.to_tensor(x))
+    np.testing.assert_allclose(got, np.asarray(ref.numpy()),
+                               atol=1e-4, rtol=1e-4)
+    return path
+
+
+class TestLeNetExport:
+    def test_logits_match(self, tmp_path):
+        from paddle_tpu.vision.models import LeNet
+        paddle.seed(0)
+        m = LeNet()
+        x = np.random.RandomState(0).randn(2, 1, 28, 28).astype(np.float32)
+        path = _export_and_run(m, x, tmp_path, "lenet")
+        # the file is a real ModelProto our parser round-trips
+        from paddle_tpu.onnx import proto
+        with open(path, "rb") as f:
+            model = proto.parse_model(f.read())
+        assert model["opset"] == 13
+        ops = [n["op_type"] for n in model["graph"]["nodes"]]
+        assert "Conv" in ops and "MaxPool" in ops and "Gemm" in ops
+
+    def test_onnxruntime_if_available(self, tmp_path):
+        ort = pytest.importorskip("onnxruntime")
+        from paddle_tpu.vision.models import LeNet
+        m = LeNet()
+        x = np.random.randn(1, 1, 28, 28).astype(np.float32)
+        path = ponnx.export(m, str(tmp_path / "lenet_ort"),
+                            input_spec=[paddle.to_tensor(x)])
+        sess = ort.InferenceSession(path)
+        got = sess.run(None, {"x0": x})[0]
+        ref = m(paddle.to_tensor(x))
+        np.testing.assert_allclose(got, np.asarray(ref.numpy()),
+                                   atol=1e-4)
+
+
+class TestResNetExport:
+    def test_resnet18_logits_match(self, tmp_path):
+        from paddle_tpu.vision.models import resnet18
+        paddle.seed(1)
+        m = resnet18(num_classes=10)
+        x = np.random.RandomState(1).randn(1, 3, 64, 64).astype(np.float32)
+        _export_and_run(m, x, tmp_path, "resnet18")
+
+
+class TestOpVariants:
+    def test_conv_stride_padding_groups(self, tmp_path):
+        paddle.seed(2)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = nn.Conv2D(4, 8, 3, stride=2, padding=1)
+                self.c2 = nn.Conv2D(8, 8, 3, padding=2, dilation=2,
+                                    groups=2)
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+                return F.relu(self.c2(F.relu(self.c1(x))))
+
+        x = np.random.RandomState(2).randn(2, 4, 16, 16).astype(np.float32)
+        _export_and_run(Net(), x, tmp_path, "convs")
+
+    def test_pool_and_softmax(self, tmp_path):
+        paddle.seed(3)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 4)
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+                h = F.avg_pool2d(x, 2, stride=2)
+                h = paddle.ops.reshape(h, [h.shape[0], -1])
+                return F.softmax(self.fc(h), axis=-1)
+
+        x = np.random.RandomState(3).randn(2, 4, 4, 4).astype(np.float32)
+        _export_and_run(Net(), x, tmp_path, "pool_softmax")
+
+    def test_same_padding_roundtrip(self, tmp_path):
+        paddle.seed(4)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c = nn.Conv2D(3, 6, 3, stride=2, padding="SAME")
+
+            def forward(self, x):
+                import paddle_tpu.nn.functional as F
+                return F.max_pool2d(F.relu(self.c(x)), 2, stride=2,
+                                    padding="SAME")
+
+        x = np.random.RandomState(4).randn(2, 3, 9, 9).astype(np.float32)
+        _export_and_run(Net(), x, tmp_path, "same_pad")
+
+    def test_flatten_variants(self, tmp_path):
+        paddle.seed(5)
+
+        class Net(nn.Layer):
+            def forward(self, x):
+                a = paddle.ops.flatten(x, start_axis=1)      # Flatten
+                b = paddle.ops.flatten(x, start_axis=0)      # full ravel
+                return a, paddle.ops.reshape(b, [1, -1])
+
+        x = np.random.RandomState(5).randn(2, 3, 4).astype(np.float32)
+        m = Net()
+        path = ponnx.export(m, str(tmp_path / "flat"),
+                            input_spec=[paddle.to_tensor(x)])
+        outs = ponnx.run(path, {"x0": x})
+        refs = m(paddle.to_tensor(x))
+        for got, ref in zip(outs, refs):
+            np.testing.assert_allclose(got, np.asarray(ref.numpy()),
+                                       atol=1e-6)
+
+    def test_batch_merging_reshape(self, tmp_path):
+        class Net(nn.Layer):
+            def forward(self, x):
+                return paddle.ops.reshape(x, [x.shape[0] * x.shape[1], -1])
+
+        x = np.random.RandomState(6).randn(2, 3, 4).astype(np.float32)
+        m = Net()
+        path = ponnx.export(m, str(tmp_path / "merge"),
+                            input_spec=[paddle.to_tensor(x)])
+        got = ponnx.run(path, {"x0": x})[0]
+        np.testing.assert_allclose(got, x.reshape(6, 4), atol=1e-6)
+
+    def test_unsupported_op_raises_clearly(self, tmp_path):
+        class Net(nn.Layer):
+            def forward(self, x):
+                return paddle.ops.cumsum(x, axis=1)
+
+        x = np.random.randn(2, 3).astype(np.float32)
+        with pytest.raises(NotImplementedError, match="cumsum"):
+            ponnx.export(Net(), str(tmp_path / "bad"),
+                         input_spec=[paddle.to_tensor(x)])

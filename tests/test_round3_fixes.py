@@ -1,0 +1,141 @@
+"""Round-3 advisor/verdict fix tests: top_p threshold, llm_int8 STE
+gradient, ASP decorate fallback, correlation kernel_size>1, static.nn
+embedding dtypes."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestTopPThreshold:
+    def test_threshold_excludes_low_prob_tokens(self):
+        # row: p=0.9 nucleus over [0.5, 0.3, 0.15, 0.05]; threshold 0.1
+        # must also drop the 0.05 token even though p would admit it
+        probs = np.asarray([[0.5, 0.3, 0.15, 0.05]], np.float32)
+        ps = np.asarray([0.999], np.float32)
+        seen = set()
+        for seed in range(40):
+            _, ids = paddle.ops.top_p_sampling(
+                paddle.to_tensor(probs), paddle.to_tensor(ps),
+                threshold=0.1, seed=seed)
+            seen.add(int(np.asarray(ids.numpy()).ravel()[0]))
+        assert 3 not in seen        # below threshold: never sampled
+        assert seen <= {0, 1, 2}
+
+    def test_no_threshold_unchanged(self):
+        probs = np.asarray([[0.6, 0.4]], np.float32)
+        ps = np.asarray([1.0], np.float32)
+        _, ids = paddle.ops.top_p_sampling(
+            paddle.to_tensor(probs), paddle.to_tensor(ps), seed=0)
+        assert int(np.asarray(ids.numpy()).ravel()[0]) in (0, 1)
+
+
+class TestLlmInt8Gradient:
+    def test_activation_gradient_flows_through_int8_path(self):
+        import jax.numpy as jnp
+        from paddle_tpu.quantization import llm_int8_linear
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+        x.stop_gradient = False
+        w8 = paddle.to_tensor(
+            rng.randint(-127, 127, (8, 5)).astype(np.int8))
+        scale = paddle.to_tensor(np.full((5,), 0.01, np.float32))
+        out = llm_int8_linear(x, w8, weight_scale=scale, threshold=6.0)
+        paddle.ops.mean(out ** 2).backward()
+        g = np.asarray(x.grad._data)
+        # STE: every activation column (none are outliers here) carries
+        # gradient; before the fix round()'s zero derivative killed it
+        assert np.abs(g).max() > 1e-6
+        assert np.count_nonzero(np.abs(g).sum(axis=0)) == 8
+
+    def test_forward_matches_int8_math(self):
+        from paddle_tpu.quantization import llm_int8_linear
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 4).astype(np.float32)
+        w8 = rng.randint(-127, 127, (4, 3)).astype(np.int8)
+        out = llm_int8_linear(paddle.to_tensor(x), paddle.to_tensor(w8),
+                              threshold=6.0)
+        # exact path reproducible in numpy
+        row_scale = np.maximum(np.abs(x).max(-1, keepdims=True) / 127.0,
+                               1e-8)
+        aq = np.clip(np.round(x / row_scale), -128, 127)
+        ref = (aq @ w8.astype(np.float32)) * row_scale \
+            + (x - x) @ w8.astype(np.float32)
+        np.testing.assert_allclose(np.asarray(out.numpy()), ref,
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestAspDecorateFallback:
+    def test_masks_reapplied_without_parameter_list(self):
+        import jax.numpy as jnp
+        from paddle_tpu.incubate import asp
+        from paddle_tpu import nn
+        paddle.seed(5)
+        asp.ASPHelper.reset()
+        model = nn.Linear(8, 8)
+        asp.prune_model(model, n=2, m=4)
+        w0 = np.asarray(model.weight.numpy())
+        assert (w0 == 0).sum() >= w0.size // 2
+
+        class OddOptimizer:
+            # stores params under a nonstandard attribute
+            def __init__(self, params):
+                self.my_params = list(params)
+
+            def step(self):
+                for p in self.my_params:
+                    p._swap_payload(p._data + 1.0)  # breaks sparsity
+
+        opt = asp.decorate(OddOptimizer(model.parameters()))
+        opt.step()
+        w1 = np.asarray(model.weight.numpy())
+        # the fallback over registered masks re-zeroed pruned entries
+        assert ((w1 == 0) == (w0 == 0)).all()
+
+
+class TestCorrelationKernelSize:
+    def test_k3_matches_box_filtered_k1(self):
+        from paddle_tpu.vision.ops import correlation
+        rng = np.random.RandomState(2)
+        x1 = rng.randn(1, 3, 10, 10).astype(np.float32)
+        x2 = rng.randn(1, 3, 10, 10).astype(np.float32)
+        out = correlation(paddle.to_tensor(x1), paddle.to_tensor(x2),
+                          pad_size=3, kernel_size=3, max_displacement=2,
+                          stride1=1, stride2=1)
+        arr = np.asarray(out.numpy())
+        assert arr.shape[1] == 25          # (2*2+1)^2 displacements
+
+        # brute-force reference at one position/displacement
+        p = 3
+        x1p = np.pad(x1, ((0, 0), (0, 0), (p, p), (p, p)))
+        x2p = np.pad(x2, ((0, 0), (0, 0), (p, p), (p, p)))
+        di, dj = -2, 1
+        k_idx = (di + 2) * 5 + (dj + 2)
+        i = j = 4  # output position -> padded center (border=3)
+        ci, cj = i + 3, j + 3
+        acc = 0.0
+        for u in (-1, 0, 1):
+            for v in (-1, 0, 1):
+                acc += (x1p[0, :, ci + u, cj + v]
+                        * x2p[0, :, ci + di + u, cj + dj + v]).mean()
+        np.testing.assert_allclose(arr[0, k_idx, i, j], acc / 9.0,
+                                   rtol=1e-5)
+
+    def test_pad_too_small_raises(self):
+        from paddle_tpu.vision.ops import correlation
+        x = paddle.to_tensor(np.zeros((1, 1, 8, 8), np.float32))
+        with pytest.raises(ValueError, match="pad_size"):
+            correlation(x, x, pad_size=2, kernel_size=3,
+                        max_displacement=2, stride1=1, stride2=1)
+
+
+class TestStaticNnEmbeddingDtype:
+    def test_non_float32_dtypes(self):
+        from paddle_tpu.static import nn as snn
+        ids = paddle.to_tensor(np.asarray([[0, 2], [1, 3]], np.int64))
+        # float64 additionally needs JAX_ENABLE_X64 (jax truncates it to
+        # f32 otherwise), so the portable set is fp32/bf16/fp16
+        for dt in ("float32", "bfloat16", "float16"):
+            out = snn.embedding(ids, size=(4, 6), dtype=dt)
+            assert str(out.dtype) == dt
+            assert out.shape == [2, 2, 6]

@@ -70,9 +70,9 @@ def test_viterbi_decoder_layer():
     assert paths.shape == [1, 4]
 
 
-def test_onnx_export_points_to_stablehlo():
+def test_onnx_export_requires_input_spec():
     import paddle_tpu.onnx as onnx
-    with pytest.raises(NotImplementedError, match="jit.save"):
+    with pytest.raises(ValueError, match="input_spec"):
         onnx.export(None, "x")
 
 
