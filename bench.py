@@ -49,18 +49,36 @@ def chip_peak_flops(device) -> float:
     return 1e12  # CPU fallback so the math stays finite
 
 
-def _run_train_bench(model, params, make_inputs, loss_of, iters):
+def _run_train_bench(model, params, make_inputs, loss_of, iters,
+                     bf16_weights=True):
     """Shared harness: jit fwd+bwd+AdamW as one program; each timed iter
     uses a DIFFERENT input batch (the axon tunnel replays identical
-    executions from cache, which would fake the timing otherwise)."""
+    executions from cache, which would fake the timing otherwise), and
+    the final sync is a VALUE read (block_until_ready does not reliably
+    drain the tunnel). With ``bf16_weights`` float params live
+    bf16-resident with an f32 master in the optimizer (mixed-precision
+    discipline: halves weight HBM traffic on the hot path; measured +3%
+    tok/s on GPT-2 — but bf16-resident CONV weights compile ~15 min via
+    the remote-compile tunnel for no gain, so the conv rung opts out)."""
     import paddle_tpu as paddle  # noqa: F401
     from paddle_tpu import amp
 
     b1, b2, eps, wd, lr = 0.9, 0.95, 1e-8, 0.1, 2.5e-4
-    m_state = [jnp.zeros_like(p._data) for p in params]
-    v_state = [jnp.zeros_like(p._data) for p in params]
 
-    def train_step(param_arrays, m_st, v_st, step_t, *inputs):
+    def bf16_resident(p):
+        return bf16_weights and np.dtype(p._data.dtype) == np.float32
+
+    # live and master are SEPARATELY donated arguments: each leaf must be
+    # a distinct buffer (an aliased buffer donated twice is a runtime
+    # error), so both are materialized as copies
+    master = [jnp.array(p._data, copy=True) for p in params]
+    live = [m.astype(jnp.bfloat16) if bf16_resident(p)
+            else jnp.array(m, copy=True) for p, m in zip(params, master)]
+    m_state = [jnp.zeros_like(m) for m in master]
+    v_state = [jnp.zeros_like(m) for m in master]
+
+    def train_step(live_arrays, master_arrays, m_st, v_st, step_t,
+                   *inputs):
         def loss_fn(pa):
             originals = [p._data for p in params]
             for p, a in zip(params, pa):
@@ -73,39 +91,41 @@ def _run_train_bench(model, params, make_inputs, loss_of, iters):
                 for p, o in zip(params, originals):
                     p._data = o
 
-        loss, grads = jax.value_and_grad(loss_fn)(param_arrays)
+        loss, grads = jax.value_and_grad(loss_fn)(live_arrays)
         t = step_t.astype(jnp.float32)
-        new_p, new_m, new_v = [], [], []
-        for p, g, m, v in zip(param_arrays, grads, m_st, v_st):
+        new_live, new_master, new_m, new_v = [], [], [], []
+        for w, mw, g, m, v in zip(live_arrays, master_arrays, grads,
+                                  m_st, v_st):
+            g = g.astype(jnp.float32)
             m = b1 * m + (1 - b1) * g
             v = b2 * v + (1 - b2) * g * g
             m_hat = m / (1 - b1 ** t)
             v_hat = v / (1 - b2 ** t)
-            p = p * (1 - lr * wd)
-            p = p - lr * m_hat / (jnp.sqrt(v_hat) + eps)
-            new_p.append(p)
+            mw = mw * (1 - lr * wd)
+            mw = mw - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+            new_master.append(mw)
+            new_live.append(mw.astype(w.dtype))
             new_m.append(m)
             new_v.append(v)
-        return loss, new_p, new_m, new_v
+        return loss, new_live, new_master, new_m, new_v
 
-    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2))
-    pa = [p._data for p in params]
+    jitted = jax.jit(train_step, donate_argnums=(0, 1, 2, 3))
     batches = [make_inputs(i) for i in range(iters + 1)]
 
-    loss0, pa, m_state, v_state = jitted(
-        pa, m_state, v_state, jnp.asarray(1, jnp.int32), *batches[0])
-    jax.block_until_ready(loss0)
+    loss0, live, master, m_state, v_state = jitted(
+        live, master, m_state, v_state, jnp.asarray(1, jnp.int32),
+        *batches[0])
     loss0 = float(loss0)
 
     t0 = time.perf_counter()
     for i in range(iters):
-        loss, pa, m_state, v_state = jitted(
-            pa, m_state, v_state, jnp.asarray(2 + i, jnp.int32),
+        loss, live, master, m_state, v_state = jitted(
+            live, master, m_state, v_state, jnp.asarray(2 + i, jnp.int32),
             *batches[1 + i])
-    jax.block_until_ready(loss)
+    loss_end = float(loss)  # chained state: forces every iter to execute
     dt = (time.perf_counter() - t0) / iters
-    n_params = sum(int(np.prod(p.shape)) for p in pa)
-    return dt, loss0, float(loss), n_params
+    n_params = sum(int(np.prod(m.shape)) for m in master)
+    return dt, loss0, loss_end, n_params
 
 
 def _bench_gpt(small):
@@ -177,16 +197,25 @@ def _bench_resnet50(small):
         return F.cross_entropy(logits, paddle.Tensor(y))
 
     dt, loss0, loss_end, n_params = _run_train_bench(
-        model, params, make_inputs, loss_of, iters)
+        model, params, make_inputs, loss_of, iters, bf16_weights=False)
     imgs_per_sec = batch / dt
-    # ~2080 A100 img/s for fp16 ResNet50 training (MLPerf-class number)
+    # chip-relative utilization bar, consistent with the token rungs'
+    # MFU-vs-0.40 treatment: ResNet50 training is ~12.3 GFLOPs/img
+    # (3x the 4.1 GFLOP forward); the A100 reference 2080 img/s is
+    # 2080*12.3e12/312e12 = 8.2% utilization of A100 peak bf16. Raw
+    # img/s would compare chips, not frameworks.
+    flops_per_img = 3 * 4.1e9
+    util = flops_per_img * imgs_per_sec / chip_peak_flops(jax.devices()[0])
+    a100_util = 2080 * flops_per_img / 312e12
     return {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(imgs_per_sec, 1),
         "unit": "images/s",
-        "vs_baseline": round(imgs_per_sec / 2080.0, 4),
+        "vs_baseline": round(util / a100_util, 4),
         "extra": {"step_time_s": round(dt, 4), "params": n_params,
-                  "batch": batch, "loss_first": round(loss0, 3),
+                  "batch": batch, "mfu": round(util, 4),
+                  "a100_ref_util": round(a100_util, 4),
+                  "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
 

@@ -10,6 +10,12 @@ grads; XLA fuses the scatter into the backward programs.
 """
 from __future__ import annotations
 
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....mesh import get_mesh
 from ..parallel_wrappers import _MeshInputWrapper
 
 
@@ -21,6 +27,45 @@ class GroupShardedStage2(_MeshInputWrapper):
         self._sharding_optimizers = (
             sharding_optimizer if isinstance(sharding_optimizer, list)
             else [sharding_optimizer])
+        if sync_buffers:
+            self.sync_buffers()
+
+    # ------------------------------------------------------------- buffers
+    def sync_buffers(self):
+        """Make every non-trainable buffer (BN running stats, …)
+        mesh-replicated (reference __sync_buffers broadcast: rank-0's
+        value wins; as global arrays there is one value by construction,
+        so sync = pinning the replicated layout so later per-axis math
+        cannot leave a buffer sharded)."""
+        mesh = self._mesh or get_mesh()
+        if mesh is None:
+            return
+        for _, buf in self._layers.named_buffers():
+            arr = buf._data
+            repl = NamedSharding(mesh, P(*([None] * arr.ndim)))
+            sh = getattr(arr, "sharding", None)
+            if sh is not None and sh != repl:
+                buf._swap_payload(jax.device_put(arr, repl))
+
+    # ------------------------------------------------------------ no_sync
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Accumulate grads WITHOUT the reduce-scatter (reference
+        no_sync): the params' ``_grad_sharding`` tags are lifted for the
+        scope, so backward stores full (unsharded) partial grads; the
+        next synchronized backward re-shards and folds them in."""
+        tagged = []
+        for opt in self._sharding_optimizers:
+            for p in getattr(opt, "_parameter_list", []):
+                sh = getattr(p, "_grad_sharding", None)
+                if sh is not None:
+                    tagged.append((p, sh))
+                    del p._grad_sharding
+        try:
+            yield
+        finally:
+            for p, sh in tagged:
+                p._grad_sharding = sh
 
     def to(self, *args, **kwargs):
         return self

@@ -122,6 +122,24 @@ class TestStage2:
             np.testing.assert_allclose(np.asarray(p.numpy()), b,
                                        rtol=2e-4, atol=2e-5)
 
+    def test_no_sync_defers_grad_sharding(self, sharding_mesh):
+        mesh_mod.set_mesh(sharding_mesh)
+        model, xs, ys = _model_and_data()
+        inner = Adam(learning_rate=0.01, parameters=model.parameters())
+        wrapped, opt, _ = group_sharded_parallel(model, inner, "os_g")
+
+        w = model[0].weight
+        with wrapped.no_sync():
+            out = wrapped(paddle.to_tensor(xs[0]))
+            ((out - paddle.to_tensor(ys[0])) ** 2).mean().backward()
+            # inside no_sync the stored grad is NOT reduce-scattered
+            assert "sharding" not in _spec_axes(w.grad._data)
+        # tags restored: the next synchronized backward shards again
+        out = wrapped(paddle.to_tensor(xs[1]))
+        ((out - paddle.to_tensor(ys[1])) ** 2).mean().backward()
+        assert "sharding" in _spec_axes(w.grad._data)
+        wrapped.sync_buffers()  # surface exists and is a safe no-op here
+
 
 class TestStage3:
     def test_params_sharded_and_numerics_match(self, sharding_mesh):

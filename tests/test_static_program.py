@@ -159,12 +159,12 @@ class TestStaticNNAttrs:
             main, feed={"x": np.zeros((2, 3), np.float32)}, fetch_list=[y])
         np.testing.assert_allclose(out, 0.0)  # no bias -> zero input = zero
 
-    def test_embedding_bad_dtype_raises(self):
+    def test_embedding_dtype_selects_weight_dtype(self):
         main = static.Program()
         with static.program_guard(main):
             ids = static.data("ids", [2, 2], "int64")
-            with pytest.raises(NotImplementedError, match="dtype"):
-                static.nn.embedding(ids, [4, 3], dtype="float64")
+            out = static.nn.embedding(ids, [4, 3], dtype="bfloat16")
+            assert str(out.dtype) == "bfloat16"
 
     def test_recorder_is_thread_local(self):
         import threading
