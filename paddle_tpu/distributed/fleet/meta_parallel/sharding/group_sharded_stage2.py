@@ -15,7 +15,6 @@ import contextlib
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ....mesh import get_mesh
 from ..parallel_wrappers import _MeshInputWrapper
 
 
@@ -37,9 +36,7 @@ class GroupShardedStage2(_MeshInputWrapper):
         value wins; as global arrays there is one value by construction,
         so sync = pinning the replicated layout so later per-axis math
         cannot leave a buffer sharded)."""
-        mesh = self._mesh or get_mesh()
-        if mesh is None:
-            return
+        mesh = self._mesh
         for _, buf in self._layers.named_buffers():
             arr = buf._data
             repl = NamedSharding(mesh, P(*([None] * arr.ndim)))

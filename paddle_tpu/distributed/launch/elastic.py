@@ -134,6 +134,45 @@ class ElasticManager:
         self._thread.start()
         return self
 
+    # ------------------------------------------------- job-wide completion
+    def mark_done(self, epoch: int):
+        """Record that this node's workers all exited 0 at ``epoch``. The
+        node must NOT leave yet — the job may still rescale (another
+        node's failure bumps the epoch and relaunches everyone)."""
+        self.client.put(f"/elastic/{self.job_id}/done/e{epoch}/"
+                        f"{self.node_rank}", "0")
+
+    def all_done(self, epoch: int) -> bool:
+        world = self.current_world() or [self.node_rank]
+        done = self.client.get_prefix(
+            f"/elastic/{self.job_id}/done/e{epoch}")
+        have = set()
+        for key in done:
+            try:
+                have.add(int(key.rsplit("/", 1)[1]))
+            except ValueError:
+                continue
+        return all(r in have for r in world)
+
+    def mark_complete(self, epoch: int):
+        """Publish job-wide completion (written by whichever node first
+        observes all done markers; idempotent)."""
+        self.client.put(f"/elastic/{self.job_id}/complete", str(epoch))
+
+    def is_complete(self) -> Optional[int]:
+        v = self.client.get(f"/elastic/{self.job_id}/complete")
+        return int(v) if v else None
+
+    def master_alive(self) -> bool:
+        """Probe the KV master with a write (GETs cannot distinguish a
+        missing key from a dead server). A finished node whose master
+        disappeared can conclude the master's node exited — job over."""
+        return self.client.put(
+            f"/elastic/{self.job_id}/ping/{self.node_rank}", "1")
+
+    def mark_failed(self, reason: str):
+        self.client.put(f"/elastic/{self.job_id}/failed", reason)
+
     def failed_reason(self) -> Optional[str]:
         return self.client.get(f"/elastic/{self.job_id}/failed")
 

@@ -159,6 +159,8 @@ def launch(argv=None) -> int:
 
     epoch = 0
     group_restarts = 0
+    done_marked: dict = {}
+    master_misses = 0
     workers: List[_Worker] = [
         _Worker(args, i) for i in range(args.nproc_per_node)]
     for w in workers:
@@ -234,11 +236,46 @@ def launch(argv=None) -> int:
                     continue
                 print(f"[launch] worker failed with {bad}; "
                       f"restart budget exhausted; stopping job")
+                if manager is not None:
+                    manager.mark_failed(
+                        f"node {args.node_rank}: worker exit {bad}, "
+                        f"budget exhausted")
                 for w in workers:
                     w.terminate()
                 return bad
             if all(c == 0 for c in codes):
-                break
+                if manager is None:
+                    break
+                # multi-node: a cleanly finished node must wait for the
+                # JOB — peers may still fail and bump the epoch, which
+                # relaunches this node's group too
+                if not done_marked.get(epoch):
+                    manager.mark_done(epoch)
+                    done_marked[epoch] = True
+                comp = manager.is_complete()
+                if comp is not None and comp >= epoch:
+                    break
+                if manager.all_done(epoch):
+                    manager.mark_complete(epoch)
+                    break
+                if comp is None and args.node_rank != 0:
+                    # the KV master rides node 0; if it stays unreachable
+                    # after we marked done, node 0 finished the job. One
+                    # failed probe is NOT proof (a blip or a saturated
+                    # server must not abandon a live job) — require
+                    # several consecutive misses.
+                    if not manager.master_alive():
+                        master_misses += 1
+                    else:
+                        master_misses = 0
+                    if master_misses >= 3:
+                        print("[launch] master gone after local "
+                              "completion; treating job as finished")
+                        break
+                # finished-and-waiting is not latency-critical: poll the
+                # completion keys gently, not at the worker-exit cadence
+                time.sleep(1.0)
+                continue
             time.sleep(0.2)
     finally:
         for w in workers:

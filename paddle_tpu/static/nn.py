@@ -40,10 +40,20 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     storage hint the SPMD design does not need; ``dtype`` selects the
     embedding weight dtype (float16/bfloat16/float32; float64 requires
     JAX_ENABLE_X64)."""
+    import jax
+
+    import numpy as _np
+
     from ..core import dtype as dtypes
+    want = dtypes.convert_dtype(str(dtype).replace("paddle.", ""))
+    if _np.dtype(want) == _np.float64 and not jax.config.jax_enable_x64:
+        # jax silently truncates f64->f32 without x64 mode; a wrong-dtype
+        # result must be an error, not a warning
+        raise NotImplementedError(
+            "static.nn.embedding: dtype='float64' requires "
+            "JAX_ENABLE_X64=1 (jax would silently truncate to float32)")
     layer = _nn.Embedding(size[0], size[1], padding_idx=padding_idx,
                           weight_attr=param_attr)
-    want = dtypes.convert_dtype(str(dtype).replace("paddle.", ""))
     if layer.weight.dtype != want:
         layer.weight._swap_payload(layer.weight._data.astype(want))
     return layer(input)

@@ -31,6 +31,26 @@ def _clean_env():
     return env
 
 
+def _free_port_pair():
+    """A port whose successor is also free: the launcher binds the KV
+    master on master_port + 1."""
+    import socket
+    for _ in range(50):
+        s1 = socket.socket()
+        s1.bind(("127.0.0.1", 0))
+        port = s1.getsockname()[1]
+        s2 = socket.socket()
+        try:
+            s2.bind(("127.0.0.1", port + 1))
+        except OSError:
+            continue
+        finally:
+            s1.close()
+            s2.close()
+        return port
+    raise RuntimeError("no consecutive free port pair found")
+
+
 class TestParseNnodes:
     def test_forms(self):
         assert parse_nnodes(2) == (2, 2)
@@ -156,6 +176,70 @@ if epoch == 0 and rank == 1:
 """
 
 
+TWO_NODE_WORKER = r"""
+import os, sys
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+epoch = int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0"))
+outdir = sys.argv[1]
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+    num_processes=world, process_id=rank)
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(jax.devices(), ("dp",))
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("dp")), jnp.ones((1, 4)) * (rank + 1),
+    (world, 4))
+tot = jax.jit(lambda a: jnp.sum(a),
+              out_shardings=NamedSharding(mesh, P()))(x)
+with open(os.path.join(outdir, f"e{epoch}.r{rank}"), "w") as f:
+    f.write(str(float(tot)))
+jax.distributed.shutdown()
+if epoch == 0 and rank == 1:
+    os._exit(13)   # node 1 fails after the epoch-0 collective
+"""
+
+
+class TestTwoNodeElastic:
+    def test_two_launchers_epoch_restart(self, tmp_path):
+        """Full multi-NODE elastic flow: two launcher processes (one per
+        'host') rendezvous through the KV master, their workers form a
+        jax.distributed world; node 1's worker dies, node 1's launcher
+        publishes a job-wide epoch, BOTH launchers relaunch in step, and
+        the finished node waits on job-wide done markers instead of
+        abandoning the job."""
+        script = tmp_path / "worker.py"
+        script.write_text(TWO_NODE_WORKER)
+        outdir = tmp_path / "out"
+        outdir.mkdir()
+        port = _free_port_pair()
+        env = _clean_env()
+
+        def launcher(node_rank):
+            return subprocess.Popen(
+                [sys.executable, "-m", "paddle_tpu.distributed.launch",
+                 "--nnodes", "2", "--node_rank", str(node_rank),
+                 "--nproc_per_node", "1", "--max_restarts", "1",
+                 "--master", f"127.0.0.1:{port}",
+                 str(script), str(outdir)],
+                env=env, cwd=REPO, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+
+        procs = [launcher(0), launcher(1)]
+        logs = [p.communicate(timeout=420)[0] for p in procs]
+        for r, (p, log) in enumerate(zip(procs, logs)):
+            assert p.returncode == 0, f"node {r} launcher:\n{log}"
+        assert any("elastic epoch" in l or "published job-wide" in l
+                   for l in logs), logs
+        for fname in ("e0.r0", "e0.r1", "e1.r0", "e1.r1"):
+            f = outdir / fname
+            assert f.exists(), f"{fname} missing; logs:\n" + "\n".join(logs)
+            assert float(f.read_text()) == 12.0
+
+
 class TestLaunchElasticCollective:
     def test_rendezvous_collective_kill_restart(self, tmp_path):
         """The round-3 'Done' criterion: 2 processes rendezvous, run a
@@ -166,11 +250,7 @@ class TestLaunchElasticCollective:
         script.write_text(COLLECTIVE_WORKER)
         outdir = tmp_path / "out"
         outdir.mkdir()
-        import socket
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        port = _free_port_pair()
         env = _clean_env()
         proc = subprocess.run(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",

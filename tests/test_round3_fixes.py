@@ -139,3 +139,8 @@ class TestStaticNnEmbeddingDtype:
             out = snn.embedding(ids, size=(4, 6), dtype=dt)
             assert str(out.dtype) == dt
             assert out.shape == [2, 2, 6]
+        # without x64 mode a silent f64->f32 truncation must be an error
+        import jax
+        if not jax.config.jax_enable_x64:
+            with pytest.raises(NotImplementedError, match="X64"):
+                snn.embedding(ids, size=(4, 6), dtype="float64")

@@ -1,17 +1,13 @@
-"""Experiment: bf16-resident weights + f32 master copy vs f32 weights
-with per-step bf16 autocast, on the GPT-2 bench rung.
-
-Rationale: with f32-resident params the forward/backward re-reads 4-byte
-weights every step (the autocast is fused but the HBM traffic is f32);
-keeping params bf16-resident halves weight bytes on the hot path while
-the optimizer updates a f32 master (standard mixed-precision discipline,
-reference amp O2 + master_weights).
+"""A/B: bf16-resident weights + f32 master vs f32-resident weights, on
+the GPT-2 bench rung — driven through bench.py's OWN harness
+(`_run_train_bench(bf16_weights=...)`) so the comparison always measures
+the shipped timing/donation/sync discipline rather than a copy that can
+drift.
 
 Run on the real chip: ``python tools/bench_weight_dtype.py``.
 """
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -23,8 +19,8 @@ import numpy as np
 
 def main():
     import paddle_tpu as paddle
+    from bench import _run_train_bench, chip_peak_flops
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
-    from bench import chip_peak_flops
 
     small = jax.default_backend() not in ("tpu", "axon")
     if small:
@@ -37,98 +33,30 @@ def main():
         batch, seq, iters = 8, 1024, 10
     model = GPTForCausalLM(cfg)
     params = [p for p in model.parameters() if not p.stop_gradient]
-    b1, b2, eps, wd, lr = 0.9, 0.95, 1e-8, 0.1, 2.5e-4
 
-    def make_ids(i):
+    def make_inputs(i):
         rng = np.random.RandomState(i)
-        return jnp.asarray(rng.randint(0, cfg.vocab_size,
-                                       (batch, seq)).astype(np.int64))
+        return (jnp.asarray(rng.randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int64)),)
 
-    def loss_of(pa, ids):
-        originals = [p._data for p in params]
-        for p, a in zip(params, pa):
-            p._data = a
-        try:
-            from paddle_tpu import amp
-            with amp.auto_cast(level="O1", dtype="bfloat16"):
-                _, loss = model(paddle.Tensor(ids),
-                                labels=paddle.Tensor(ids))
-            return loss._data.astype(jnp.float32)
-        finally:
-            for p, o in zip(params, originals):
-                p._data = o
+    def loss_of(model, ids):
+        _, loss = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
+        return loss
 
-    def run(variant):
-        bf16 = variant == "bf16_weights"
-        # explicit copy: same-dtype astype aliases the model's arrays and
-        # donation would delete them for the next variant
-        master = [jnp.array(p._data, jnp.float32, copy=True)
-                  for p in params]
-        live = [m.astype(jnp.bfloat16) for m in master] if bf16 else None
-        m_st = [jnp.zeros_like(m) for m in master]
-        v_st = [jnp.zeros_like(m) for m in master]
-
-        def adam(mw, g, m, v, tf):
-            g32 = g.astype(jnp.float32)
-            m = b1 * m + (1 - b1) * g32
-            v = b2 * v + (1 - b2) * g32 * g32
-            mh = m / (1 - b1 ** tf)
-            vh = v / (1 - b2 ** tf)
-            mw = mw * (1 - lr * wd) - lr * mh / (jnp.sqrt(vh) + eps)
-            return mw, m, v
-
-        if bf16:
-            def step(live, master, m_st, v_st, t, ids):
-                loss, grads = jax.value_and_grad(loss_of)(live, ids)
-                tf = t.astype(jnp.float32)
-                outs = [adam(mw, g, m, v, tf) for mw, g, m, v
-                        in zip(master, grads, m_st, v_st)]
-                return (loss, [mw.astype(jnp.bfloat16) for mw, _, _ in outs],
-                        [mw for mw, _, _ in outs],
-                        [m for _, m, _ in outs], [v for _, _, v in outs])
-
-            jitted = jax.jit(step, donate_argnums=(0, 1, 2, 3))
-            state = (live, master, m_st, v_st)
-
-            def call(state, t, ids):
-                loss, live, master, m_st, v_st = jitted(*state, t, ids)
-                return loss, (live, master, m_st, v_st)
-        else:
-            def step(master, m_st, v_st, t, ids):
-                loss, grads = jax.value_and_grad(loss_of)(master, ids)
-                tf = t.astype(jnp.float32)
-                outs = [adam(mw, g, m, v, tf) for mw, g, m, v
-                        in zip(master, grads, m_st, v_st)]
-                return (loss, [mw for mw, _, _ in outs],
-                        [m for _, m, _ in outs], [v for _, _, v in outs])
-
-            jitted = jax.jit(step, donate_argnums=(0, 1, 2))
-            state = (master, m_st, v_st)
-
-            def call(state, t, ids):
-                loss, master, m_st, v_st = jitted(*state, t, ids)
-                return loss, (master, m_st, v_st)
-
-        batches = [make_ids(i) for i in range(iters + 1)]
-        loss, state = call(state, jnp.asarray(1, jnp.int32), batches[0])
-        float(loss)   # force real execution (tunnel-safe sync)
-        t0 = time.perf_counter()
-        for i in range(iters):
-            loss, state = call(state, jnp.asarray(2 + i, jnp.int32),
-                               batches[1 + i])
-        lv = float(loss)  # chained state forces all iters to execute
-        dt = (time.perf_counter() - t0) / iters
-        n_params = sum(int(np.prod(p.shape)) for p in params)
+    results = {}
+    for flag in (False, True):
+        dt, loss0, loss_end, n_params = _run_train_bench(
+            model, params, make_inputs, loss_of, iters,
+            bf16_weights=flag)
         tok_s = batch * seq / dt
         fpt = 6 * n_params + 12 * cfg.num_layers * cfg.hidden_size * seq
         mfu = fpt * tok_s / chip_peak_flops(jax.devices()[0])
-        print(f"{variant}: {tok_s:,.0f} tok/s  step {dt*1e3:.1f} ms  "
-              f"MFU {mfu:.4f}  loss {lv:.3f}")
-        return tok_s
-
-    a = run("f32_weights")
-    b = run("bf16_weights")
-    print(f"bf16/f32 speedup: {b / a:.4f}x")
+        name = "bf16_weights" if flag else "f32_weights"
+        results[name] = tok_s
+        print(f"{name}: {tok_s:,.0f} tok/s  step {dt*1e3:.1f} ms  "
+              f"MFU {mfu:.4f}  loss {loss_end:.3f}")
+    print(f"bf16/f32 speedup: "
+          f"{results['bf16_weights'] / results['f32_weights']:.4f}x")
 
 
 if __name__ == "__main__":
