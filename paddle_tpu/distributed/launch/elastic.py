@@ -135,12 +135,13 @@ class ElasticManager:
         return self
 
     # ------------------------------------------------- job-wide completion
-    def mark_done(self, epoch: int):
+    def mark_done(self, epoch: int) -> bool:
         """Record that this node's workers all exited 0 at ``epoch``. The
         node must NOT leave yet — the job may still rescale (another
-        node's failure bumps the epoch and relaunches everyone)."""
-        self.client.put(f"/elastic/{self.job_id}/done/e{epoch}/"
-                        f"{self.node_rank}", "0")
+        node's failure bumps the epoch and relaunches everyone). Returns
+        whether the PUT was confirmed (callers retry until it is)."""
+        return self.client.put(f"/elastic/{self.job_id}/done/e{epoch}/"
+                               f"{self.node_rank}", "0")
 
     def all_done(self, epoch: int) -> bool:
         world = self.current_world() or [self.node_rank]

@@ -248,10 +248,11 @@ def launch(argv=None) -> int:
                     break
                 # multi-node: a cleanly finished node must wait for the
                 # JOB — peers may still fail and bump the epoch, which
-                # relaunches this node's group too
+                # relaunches this node's group too. mark_done is
+                # idempotent and a PUT can blip, so re-issue it until one
+                # is confirmed delivered.
                 if not done_marked.get(epoch):
-                    manager.mark_done(epoch)
-                    done_marked[epoch] = True
+                    done_marked[epoch] = manager.mark_done(epoch)
                 comp = manager.is_complete()
                 if comp is not None and comp >= epoch:
                     break

@@ -125,4 +125,7 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-__all__ = ["Config", "Predictor", "create_predictor"]
+from .serving import BlockManager, LlamaPagedEngine, Request  # noqa: E402
+
+__all__ = ["Config", "Predictor", "create_predictor",
+           "BlockManager", "LlamaPagedEngine", "Request"]

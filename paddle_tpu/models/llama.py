@@ -84,18 +84,23 @@ def llama2_70b(**kw) -> LlamaConfig:
 
 def rotary_embedding(x, theta: float = 10000.0, pos_offset=0):
     """Apply RoPE to [B, S, H, D] (reference fused_rope op). Pairs are the
-    (even, odd) channel convention. ``pos_offset`` may be a traced scalar
-    (cached decoding uses one compiled step for every position)."""
+    (even, odd) channel convention. ``pos_offset`` may be a python int, a
+    traced scalar (cached decoding compiles one step for every position),
+    or a per-batch ``(B,)`` vector (continuous-batching serving: every
+    sequence in the batch sits at a different length)."""
     def f(a):
         b, s, h, d = a.shape
         half = d // 2
         freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32)
                                  / half))
-        positions = (jnp.asarray(pos_offset, jnp.float32)
-                     + jnp.arange(s, dtype=jnp.float32))
-        pos = positions[:, None] * freqs[None, :]
-        cos = jnp.cos(pos)[None, :, None, :]
-        sin = jnp.sin(pos)[None, :, None, :]
+        off = jnp.asarray(pos_offset, jnp.float32)
+        if off.ndim == 0:
+            off = off[None]                        # (1,) broadcast over B
+        positions = (off[:, None]
+                     + jnp.arange(s, dtype=jnp.float32)[None, :])
+        pos = positions[:, :, None] * freqs[None, None, :]
+        cos = jnp.cos(pos)[:, :, None, :]          # (B|1, S, 1, half)
+        sin = jnp.sin(pos)[:, :, None, :]
         x1, x2 = a[..., :half], a[..., half:]
         return jnp.concatenate(
             [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
