@@ -125,7 +125,9 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-from .serving import BlockManager, LlamaPagedEngine, Request  # noqa: E402
+from .serving import (BlockManager, GPTPagedEngine,  # noqa: E402
+                      LlamaPagedEngine, PagedEngine, Request)
 
-__all__ = ["Config", "Predictor", "create_predictor",
-           "BlockManager", "LlamaPagedEngine", "Request"]
+__all__ = ["Config", "Predictor", "create_predictor", "BlockManager",
+           "PagedEngine", "LlamaPagedEngine", "GPTPagedEngine",
+           "Request"]

@@ -14,11 +14,14 @@ free list, and admission/eviction is plain Python between ticks:
 * decode runs ALL active slots in one (B, 1) step; idle slots point at a
   reserved trash block so the compiled program never branches on
   occupancy;
-* RoPE uses per-slot positions (each sequence is at a different length —
-  the batch shares one program, not one position).
+* positions are per-slot (each sequence is at a different length — the
+  batch shares one program, not one position): RoPE offsets for Llama,
+  learned-position gathers for GPT (architecture adapters `_LlamaArch` /
+  `_GPTArch`).
 
-Greedy sampling v1; numerics are locked to the training model by a
-token-parity test against ``LlamaForCausalLM.generate``.
+Greedy sampling v1; numerics are locked to the training models by
+token-parity tests against ``LlamaForCausalLM.generate`` and a
+full-recompute GPT greedy loop.
 """
 from __future__ import annotations
 
@@ -32,7 +35,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["BlockManager", "Request", "LlamaPagedEngine"]
+__all__ = ["BlockManager", "Request", "PagedEngine", "LlamaPagedEngine",
+           "GPTPagedEngine"]
 
 
 class BlockManager:
@@ -71,21 +75,111 @@ class Request:
         return len(self.prompt) + len(self.generated)
 
 
-class LlamaPagedEngine:
-    """Continuous-batching engine for :class:`LlamaForCausalLM`."""
+class _LlamaArch:
+    """Architecture adapter: per-chunk forward for LlamaForCausalLM."""
+
+    def __init__(self, model):
+        self.model = model
+        self.cfg = model.cfg
+        self.num_kv_heads = model.cfg.num_kv_heads or model.cfg.num_heads
+
+    def forward_chunk(self, tokens, start, attend):
+        import paddle_tpu.nn.functional as F  # noqa: F401
+        from paddle_tpu import ops
+        from ..models.llama import rotary_embedding
+
+        model = self.model
+        cfg = self.cfg
+        B, T = tokens.shape
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        nkv = self.num_kv_heads
+        x = model.model.embed_tokens(Tensor(tokens))
+        for li, blk in enumerate(model.model.layers):
+            ln = blk.input_layernorm(x)
+            q = ops.reshape(blk.self_attn.q_proj(ln), [B, T, nh, hd])
+            k = ops.reshape(blk.self_attn.k_proj(ln), [B, T, nkv, hd])
+            v = ops.reshape(blk.self_attn.v_proj(ln), [B, T, nkv, hd])
+            q = rotary_embedding(q, cfg.rope_theta, pos_offset=start)
+            k = rotary_embedding(k, cfg.rope_theta, pos_offset=start)
+            out = attend(li, q, k, v)
+            x = x + blk.self_attn.o_proj(
+                ops.reshape(out, [B, T, nh * hd]))
+            x = x + blk.mlp(blk.post_attention_layernorm(x))
+        x = model.model.norm(x)
+        last = Tensor(x._data[:, -1:, :])
+        if model.lm_head is None:
+            return ops.matmul(last, model.model.embed_tokens.weight,
+                              transpose_y=True)
+        return model.lm_head(last)
+
+
+class _GPTArch:
+    """Architecture adapter for GPTForCausalLM (learned positions, fused
+    qkv, tied head)."""
+
+    def __init__(self, model):
+        self.model = model
+        self.cfg = model.cfg
+        self.num_kv_heads = model.cfg.num_heads
+
+    def forward_chunk(self, tokens, start, attend):
+        from paddle_tpu import ops
+
+        m = self.model.gpt
+        cfg = self.cfg
+        B, T = tokens.shape
+        nh = cfg.num_heads
+        hd = cfg.hidden_size // nh
+        # learned positional embeddings at per-slot positions
+        pos_idx = (start[:, None]
+                   + jnp.arange(T, dtype=start.dtype)[None, :])
+        pos_emb = jnp.take(m.wpe.weight._data, pos_idx, axis=0)
+        x = m.wte(Tensor(tokens)) + Tensor(pos_emb)
+        for li, blk in enumerate(m.blocks):
+            ln = blk.ln1(x)
+            qkv = blk.attn.qkv_proj(ln)
+            q, k, v = ops.split(qkv, 3, axis=-1)
+            q = ops.reshape(q, [B, T, nh, hd])
+            k = ops.reshape(k, [B, T, nh, hd])
+            v = ops.reshape(v, [B, T, nh, hd])
+            out = attend(li, q, k, v)
+            x = x + blk.attn.out_proj(ops.reshape(out, [B, T, nh * hd]))
+            x = x + blk.mlp(blk.ln2(x))
+        x = m.ln_f(x)
+        last = Tensor(x._data[:, -1:, :])
+        return ops.matmul(last, m.wte.weight, transpose_y=True)
+
+
+def _pick_arch(model):
+    name = type(model).__name__
+    if name == "LlamaForCausalLM":
+        return _LlamaArch(model)
+    if name == "GPTForCausalLM":
+        return _GPTArch(model)
+    raise TypeError(
+        f"PagedEngine supports LlamaForCausalLM / GPTForCausalLM, got "
+        f"{name}")
+
+
+class PagedEngine:
+    """Continuous-batching engine for causal LMs (paged KV caches)."""
 
     def __init__(self, model, *, max_batch: int = 8, block_size: int = 16,
                  num_blocks: int = 256, max_blocks_per_seq: int = 32,
                  eos_id: Optional[int] = None):
         self.model = model
+        self.arch = _pick_arch(model)
         self.cfg = model.cfg
         self.max_batch = max_batch
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.eos_id = eos_id
+        if hasattr(model, "eval"):
+            model.eval()          # serving: dropout always off
         cfg = self.cfg
         self.head_dim = cfg.hidden_size // cfg.num_heads
-        nkv = cfg.num_kv_heads or cfg.num_heads
+        nkv = self.arch.num_kv_heads
         self.num_kv_heads = nkv
 
         self.bm = BlockManager(num_blocks)
@@ -101,16 +195,21 @@ class LlamaPagedEngine:
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.slot_blocks: List[List[int]] = [[] for _ in range(max_batch)]
         self.queue: List[Request] = []
-        self.finished: Dict[int, Request] = {}
         self._params = [p for p in model.parameters()]
-        self._jit_cache: Dict[tuple, object] = {}
+        # one jit wrapper: jax.jit itself specializes per (B, T) shape
+        self._fn = jax.jit(self._forward, donate_argnums=(1, 2))
+        self._done: List[Request] = []
         self._rid = 0
 
     # ---------------------------------------------------------------- API
     def add_request(self, prompt_ids, max_new_tokens: int = 32) -> int:
+        prompt = [int(t) for t in prompt_ids]
+        if not prompt:
+            raise ValueError("add_request: prompt must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError("add_request: max_new_tokens must be >= 1")
         self._rid += 1
-        self.queue.append(Request(self._rid, [int(t) for t in prompt_ids],
-                                  max_new_tokens))
+        self.queue.append(Request(self._rid, prompt, max_new_tokens))
         return self._rid
 
     @property
@@ -121,73 +220,38 @@ class LlamaPagedEngine:
         return bool(self.queue) or self.num_active > 0
 
     # ----------------------------------------------------------- compute
-    def _rope(self, x, start):
-        """Per-slot RoPE — the TRAINING rope with a (B,) position vector,
-        so serving numerics can never drift from the model's."""
-        from ..models.llama import rotary_embedding
-        return rotary_embedding(Tensor(x), self.cfg.rope_theta,
-                                pos_offset=start)._data
-
     def _forward(self, param_arrays, kcs, vcs, tokens, seq_lens, tables):
         """One chunk for a (B, T) token batch; returns (next-token ids,
         new caches). Traced under jit."""
         import paddle_tpu.nn.functional as F
-        from paddle_tpu import ops
 
-        model = self.model
-        cfg = self.cfg
         params = self._params
         originals = [p._data for p in params]
         for p, a in zip(params, param_arrays):
             p._data = a
         try:
             B, T = tokens.shape
-            nh, hd = cfg.num_heads, self.head_dim
-            nkv = self.num_kv_heads
-            x = model.model.embed_tokens(Tensor(tokens))
             start = seq_lens - T
             sl_t = Tensor(seq_lens)
             tb_t = Tensor(tables)
-            for li, blk in enumerate(model.model.layers):
-                ln = blk.input_layernorm(x)
-                q = ops.reshape(blk.self_attn.q_proj(ln), [B, T, nh, hd])
-                k = ops.reshape(blk.self_attn.k_proj(ln), [B, T, nkv, hd])
-                v = ops.reshape(blk.self_attn.v_proj(ln), [B, T, nkv, hd])
-                q = Tensor(self._rope(q._data, start))
-                k = Tensor(self._rope(k._data, start))
+
+            def attend(li, q, k, v):
                 out, nkc, nvc = F.block_multihead_attention(
                     q, Tensor(kcs[li]), Tensor(vcs[li]), tb_t, sl_t,
                     new_k=k, new_v=v, causal=True)
                 kcs[li] = nkc._data
                 vcs[li] = nvc._data
-                x = x + blk.self_attn.o_proj(
-                    ops.reshape(out, [B, T, nh * hd]))
-                x = x + blk.mlp(blk.post_attention_layernorm(x))
-            x = model.model.norm(x)
-            last = Tensor(x._data[:, -1:, :])
-            if model.lm_head is None:
-                logits = ops.matmul(last, model.model.embed_tokens.weight,
-                                    transpose_y=True)
-            else:
-                logits = model.lm_head(last)
+                return out
+
+            logits = self.arch.forward_chunk(tokens, start, attend)
             nxt = jnp.argmax(logits._data[:, -1, :], axis=-1)
             return nxt.astype(jnp.int32), kcs, vcs
         finally:
             for p, o in zip(params, originals):
                 p._data = o
 
-    def _step_fn(self, B: int, T: int):
-        key = (B, T)
-        fn = self._jit_cache.get(key)
-        if fn is None:
-            fn = jax.jit(self._forward, donate_argnums=(1, 2))
-            self._jit_cache[key] = fn
-        return fn
-
     def _run_chunk(self, tokens_np, seq_lens_np, tables_np):
-        B, T = tokens_np.shape
-        fn = self._step_fn(B, T)
-        nxt, self.kc, self.vc = fn(
+        nxt, self.kc, self.vc = self._fn(
             [p._data for p in self._params], self.kc, self.vc,
             jnp.asarray(tokens_np), jnp.asarray(seq_lens_np),
             jnp.asarray(tables_np))
@@ -223,6 +287,9 @@ class LlamaPagedEngine:
                 len(req.prompt) + req.max_new_tokens)
             if (need_total > self.max_blocks_per_seq
                     or need_total > self._total_usable):
+                # dequeue BEFORE raising: a caller that catches this to
+                # reject the request keeps serving everyone behind it
+                self.queue.pop(0)
                 raise MemoryError(
                     f"request {req.rid} can never fit: needs {need_total}"
                     f" blocks (max_blocks_per_seq="
@@ -280,7 +347,7 @@ class LlamaPagedEngine:
         last = req.generated[-1] if req.generated else None
         if (len(req.generated) >= req.max_new_tokens
                 or (self.eos_id is not None and last == self.eos_id)):
-            self.finished[req.rid] = req
+            self._done.append(req)
             self.slots[slot] = None
             self.bm.release(self.slot_blocks[slot])
             self.slot_blocks[slot] = []
@@ -292,7 +359,6 @@ class LlamaPagedEngine:
         """One engine tick: admit + prefill queued requests, then a single
         batched decode step for every active slot. Returns {rid:
         generated_tokens} for requests that finished this tick."""
-        before = set(self.finished)
         self._admit()
         active = [i for i, s in enumerate(self.slots) if s is not None]
         if active:
@@ -318,8 +384,7 @@ class LlamaPagedEngine:
                 # retry next tick with its blocks available.
                 victim = max(skipped, key=lambda i: self.slots[i].rid)
                 self._evict(victim)
-                return {rid: self.finished[rid].generated
-                        for rid in set(self.finished) - before}
+                return self._drain_done()
             tokens = self.last_token[:, None].astype(np.int32)
             nxt = self._run_chunk(tokens, seq, self.tables)
             for i in active:
@@ -330,8 +395,14 @@ class LlamaPagedEngine:
                 self.seq_lens[i] = int(seq[i])   # cached positions now
                 self.last_token[i] = int(nxt[i])
                 self._maybe_finish(i)
-        return {rid: self.finished[rid].generated
-                for rid in set(self.finished) - before}
+        return self._drain_done()
+
+    def _drain_done(self) -> Dict[int, List[int]]:
+        """Hand completed requests to the caller and DROP them — a
+        long-running server must not retain every request ever served."""
+        out = {req.rid: req.generated for req in self._done}
+        self._done.clear()
+        return out
 
     def run_to_completion(self, max_ticks: int = 10_000):
         """Drain the queue; returns {rid: generated_tokens}."""
@@ -343,3 +414,8 @@ class LlamaPagedEngine:
             if ticks > max_ticks:
                 raise RuntimeError("serving engine did not converge")
         return out
+
+
+# Backward-compatible names: the generic engine picks the adapter itself.
+LlamaPagedEngine = PagedEngine
+GPTPagedEngine = PagedEngine

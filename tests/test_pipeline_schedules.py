@@ -109,10 +109,15 @@ class TestVPP:
         assert bubble_vpp == pytest.approx((S - 1) / (m * K + S - 1))
         assert bubble_vpp < bubble_1f1b / (K - 1)
 
-    @pytest.mark.skipif((os.cpu_count() or 1) < 4,
-                        reason="wall-clock bubble comparison needs real "
-                               "core-level parallelism across the virtual "
-                               "devices")
+    @pytest.mark.skipif(
+        jax.default_backend() != "tpu" or jax.device_count() < 4,
+        reason="wall-clock bubble comparison is only meaningful on real "
+               "multi-device hardware: on a CPU-emulated mesh the devices "
+               "timeshare host cores, so per-tick overheads (finer "
+               "ppermutes) dominate the tick-count saving the schedule "
+               "exists for. The schedule advantage itself is asserted "
+               "deterministically by test_measured_bubble_fraction_shrinks "
+               "(the compiled program counts its own idle ticks).")
     def test_vpp_faster_than_stage_major(self, pp_mesh):
         from paddle_tpu.distributed.fleet.meta_parallel.pipeline_parallel \
             import spmd_pipeline

@@ -114,3 +114,46 @@ class TestPagedEngineParity:
         eng.add_request(list(range(1, 30)), max_new_tokens=4)
         with pytest.raises(MemoryError):
             eng.run_to_completion()
+        # the rejected request was dequeued: serving continues for others
+        assert not eng.queue
+        rid = eng.add_request([1, 2, 3], max_new_tokens=2)
+        out = eng.run_to_completion()
+        assert len(out[rid]) == 2
+
+    def test_request_validation(self):
+        model = _tiny_model()
+        eng = LlamaPagedEngine(model, max_batch=1, block_size=4,
+                               num_blocks=8, max_blocks_per_seq=4)
+        with pytest.raises(ValueError, match="non-empty"):
+            eng.add_request([])
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            eng.add_request([1], max_new_tokens=0)
+
+
+class TestGPTPagedEngine:
+    def test_gpt_matches_full_recompute_greedy(self):
+        from paddle_tpu.inference import PagedEngine
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        paddle.seed(11)
+        cfg = GPTConfig(vocab_size=83, hidden_size=64, num_layers=2,
+                        num_heads=4, max_seq_len=64, dropout=0.0,
+                        use_flash_attention=False)
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(5)
+        prompt = [int(t) for t in rng.randint(1, 83, size=7)]
+
+        # reference: full-recompute greedy loop through the model itself
+        ids = list(prompt)
+        ref = []
+        for _ in range(6):
+            logits = model(paddle.to_tensor(np.asarray([ids], np.int64)))
+            nxt = int(np.argmax(np.asarray(logits.numpy())[0, -1]))
+            ref.append(nxt)
+            ids.append(nxt)
+
+        eng = PagedEngine(model, max_batch=2, block_size=4,
+                          num_blocks=32, max_blocks_per_seq=8)
+        rid = eng.add_request(prompt, max_new_tokens=6)
+        out = eng.run_to_completion()
+        assert out[rid] == ref
