@@ -68,6 +68,8 @@ class Request:
     rid: int
     prompt: List[int]
     max_new_tokens: int = 32
+    temperature: float = 0.0          # 0 = greedy
+    top_p: float = 1.0
     generated: List[int] = field(default_factory=list)
 
     @property
@@ -84,7 +86,6 @@ class _LlamaArch:
         self.num_kv_heads = model.cfg.num_kv_heads or model.cfg.num_heads
 
     def forward_chunk(self, tokens, start, attend):
-        import paddle_tpu.nn.functional as F  # noqa: F401
         from paddle_tpu import ops
         from ..models.llama import rotary_embedding
 
@@ -152,14 +153,15 @@ class _GPTArch:
 
 
 def _pick_arch(model):
-    name = type(model).__name__
-    if name == "LlamaForCausalLM":
+    from ..models.gpt import GPTForCausalLM
+    from ..models.llama import LlamaForCausalLM
+    if isinstance(model, LlamaForCausalLM):
         return _LlamaArch(model)
-    if name == "GPTForCausalLM":
+    if isinstance(model, GPTForCausalLM):
         return _GPTArch(model)
     raise TypeError(
-        f"PagedEngine supports LlamaForCausalLM / GPTForCausalLM, got "
-        f"{name}")
+        f"PagedEngine supports LlamaForCausalLM / GPTForCausalLM (or "
+        f"subclasses), got {type(model).__name__}")
 
 
 class PagedEngine:
@@ -167,7 +169,7 @@ class PagedEngine:
 
     def __init__(self, model, *, max_batch: int = 8, block_size: int = 16,
                  num_blocks: int = 256, max_blocks_per_seq: int = 32,
-                 eos_id: Optional[int] = None):
+                 eos_id: Optional[int] = None, seed: int = 0):
         self.model = model
         self.arch = _pick_arch(model)
         self.cfg = model.cfg
@@ -175,8 +177,6 @@ class PagedEngine:
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
         self.eos_id = eos_id
-        if hasattr(model, "eval"):
-            model.eval()          # serving: dropout always off
         cfg = self.cfg
         self.head_dim = cfg.hidden_size // cfg.num_heads
         nkv = self.arch.num_kv_heads
@@ -195,21 +195,29 @@ class PagedEngine:
         self.slots: List[Optional[Request]] = [None] * max_batch
         self.slot_blocks: List[List[int]] = [[] for _ in range(max_batch)]
         self.queue: List[Request] = []
+        self.rejected: Dict[int, str] = {}
         self._params = [p for p in model.parameters()]
         # one jit wrapper: jax.jit itself specializes per (B, T) shape
         self._fn = jax.jit(self._forward, donate_argnums=(1, 2))
+        self._key = jax.random.key(seed)
         self._done: List[Request] = []
         self._rid = 0
 
     # ---------------------------------------------------------------- API
-    def add_request(self, prompt_ids, max_new_tokens: int = 32) -> int:
+    def add_request(self, prompt_ids, max_new_tokens: int = 32,
+                    temperature: float = 0.0, top_p: float = 1.0) -> int:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("add_request: prompt must be non-empty")
         if max_new_tokens < 1:
             raise ValueError("add_request: max_new_tokens must be >= 1")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError("add_request: top_p must be in (0, 1]")
+        if not temperature >= 0.0:   # also rejects NaN
+            raise ValueError("add_request: temperature must be >= 0")
         self._rid += 1
-        self.queue.append(Request(self._rid, prompt, max_new_tokens))
+        self.queue.append(Request(self._rid, prompt, max_new_tokens,
+                                  temperature=temperature, top_p=top_p))
         return self._rid
 
     @property
@@ -220,7 +228,8 @@ class PagedEngine:
         return bool(self.queue) or self.num_active > 0
 
     # ----------------------------------------------------------- compute
-    def _forward(self, param_arrays, kcs, vcs, tokens, seq_lens, tables):
+    def _forward(self, param_arrays, kcs, vcs, tokens, seq_lens, tables,
+                 temps, top_ps, key):
         """One chunk for a (B, T) token batch; returns (next-token ids,
         new caches). Traced under jit."""
         import paddle_tpu.nn.functional as F
@@ -244,17 +253,43 @@ class PagedEngine:
                 return out
 
             logits = self.arch.forward_chunk(tokens, start, attend)
-            nxt = jnp.argmax(logits._data[:, -1, :], axis=-1)
+            nxt = self._sample(logits._data[:, -1, :], temps, top_ps, key)
             return nxt.astype(jnp.int32), kcs, vcs
         finally:
             for p, o in zip(params, originals):
                 p._data = o
 
-    def _run_chunk(self, tokens_np, seq_lens_np, tables_np):
-        nxt, self.kc, self.vc = self._fn(
-            [p._data for p in self._params], self.kc, self.vc,
-            jnp.asarray(tokens_np), jnp.asarray(seq_lens_np),
-            jnp.asarray(tables_np))
+    @staticmethod
+    def _sample(logits, temps, top_ps, key):
+        """Per-slot greedy / temperature / nucleus sampling — the same
+        kernel as ops.top_p_sampling (shared helper), keyed per tick so
+        the program is reusable across calls."""
+        from ..ops.search import nucleus_sample_ids
+        greedy = jnp.argmax(logits, axis=-1)
+        safe_t = jnp.maximum(temps, 1e-6)[:, None]
+        probs = jax.nn.softmax(logits / safe_t, axis=-1)
+        sampled = nucleus_sample_ids(probs, top_ps, key)[:, 0]
+        return jnp.where(temps > 0, sampled, greedy)
+
+    def _run_chunk(self, tokens_np, seq_lens_np, tables_np,
+                   temps_np, top_ps_np):
+        self._key, sub = jax.random.split(self._key)
+        # serving always runs eval-mode (dropout off); restore the
+        # caller's training flag afterwards — the engine must not mutate
+        # a model a training loop is still using
+        was_training = getattr(self.model, "training", False)
+        if was_training:
+            self.model.eval()
+        try:
+            nxt, self.kc, self.vc = self._fn(
+                [p._data for p in self._params], self.kc, self.vc,
+                jnp.asarray(tokens_np), jnp.asarray(seq_lens_np),
+                jnp.asarray(tables_np),
+                jnp.asarray(temps_np, jnp.float32),
+                jnp.asarray(top_ps_np, jnp.float32), sub)
+        finally:
+            if was_training:
+                self.model.train()
         return np.asarray(nxt)
 
     # -------------------------------------------------------- scheduling
@@ -287,14 +322,16 @@ class PagedEngine:
                 len(req.prompt) + req.max_new_tokens)
             if (need_total > self.max_blocks_per_seq
                     or need_total > self._total_usable):
-                # dequeue BEFORE raising: a caller that catches this to
-                # reject the request keeps serving everyone behind it
+                # reject WITHOUT raising mid-step: completed results from
+                # other requests must never be lost to one bad request.
+                # Callers read eng.rejected; run_to_completion raises
+                # AFTER everything else finished.
                 self.queue.pop(0)
-                raise MemoryError(
-                    f"request {req.rid} can never fit: needs {need_total}"
-                    f" blocks (max_blocks_per_seq="
+                self.rejected[req.rid] = (
+                    f"needs {need_total} blocks (max_blocks_per_seq="
                     f"{self.max_blocks_per_seq}, usable="
                     f"{self._total_usable})")
+                continue
             if (self._blocks_needed(prefix_len + 1)
                     > self.bm.available):
                 break  # head-of-line blocks until memory frees
@@ -319,7 +356,10 @@ class PagedEngine:
             if not self._ensure_blocks(slot, new_len):
                 raise MemoryError("admission raced cache exhaustion")
             seq = np.asarray([new_len], np.int32)
-            nxt = self._run_chunk(chunk, seq, self.tables[slot:slot + 1])
+            nxt = self._run_chunk(
+                chunk, seq, self.tables[slot:slot + 1],
+                np.asarray([req.temperature], np.float32),
+                np.asarray([req.top_p], np.float32))
             done = new_len
         self.seq_lens[slot] = len(prefix)
         tok = int(nxt[0])
@@ -386,7 +426,12 @@ class PagedEngine:
                 self._evict(victim)
                 return self._drain_done()
             tokens = self.last_token[:, None].astype(np.int32)
-            nxt = self._run_chunk(tokens, seq, self.tables)
+            temps = np.zeros((self.max_batch,), np.float32)
+            top_ps = np.ones((self.max_batch,), np.float32)
+            for i in active:
+                temps[i] = self.slots[i].temperature
+                top_ps[i] = self.slots[i].top_p
+            nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps)
             for i in active:
                 if seq[i] == 0:
                     continue
@@ -405,7 +450,10 @@ class PagedEngine:
         return out
 
     def run_to_completion(self, max_ticks: int = 10_000):
-        """Drain the queue; returns {rid: generated_tokens}."""
+        """Drain the queue; returns {rid: generated_tokens}. If any
+        request was rejected as never-fitting, raises MemoryError AFTER
+        all servable requests completed (their results stay retrievable
+        via step()/self.rejected for callers that need partial output)."""
         out: Dict[int, List[int]] = {}
         ticks = 0
         while self.has_work():
@@ -413,6 +461,12 @@ class PagedEngine:
             ticks += 1
             if ticks > max_ticks:
                 raise RuntimeError("serving engine did not converge")
+        if self.rejected:
+            detail = "; ".join(f"request {rid}: {why}"
+                               for rid, why in self.rejected.items())
+            self.rejected.clear()
+            raise MemoryError(f"rejected never-fitting request(s): "
+                              f"{detail}")
         return out
 
 

@@ -187,6 +187,27 @@ def index_of_max(x):
     return argmax(x)
 
 
+def nucleus_sample_ids(probs, p, key):
+    """Key-taking nucleus-sampling kernel shared by ``top_p_sampling``
+    and the serving engine: sort desc, exclusive-cumsum keep mask
+    (top-1 always kept), gumbel-max draw inside the nucleus. Returns
+    (B, 1) sampled ids."""
+    order = jnp.argsort(-probs, axis=-1)
+    sp = jnp.take_along_axis(probs, order, axis=-1)
+    csum = jnp.cumsum(sp, axis=-1)
+    # keep tokens while cumulative mass (exclusive) < p; always keep top-1
+    keep = (csum - sp) < p[:, None]
+    keep = keep.at[:, 0].set(True)
+    masked = jnp.where(keep, sp, 0.0)
+    masked = masked / jnp.maximum(
+        jnp.sum(masked, axis=-1, keepdims=True), 1e-20)
+    gumbel = -jnp.log(-jnp.log(
+        jax.random.uniform(key, masked.shape, minval=1e-20, maxval=1.0)))
+    choice = jnp.argmax(jnp.where(keep, jnp.log(masked + 1e-20) + gumbel,
+                                  -jnp.inf), axis=-1)
+    return jnp.take_along_axis(order, choice[:, None], axis=-1)
+
+
 def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
     """Nucleus (top-p) sampling over probability rows.
 
@@ -209,20 +230,7 @@ def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
             # below the floor never enter the nucleus (their mass is
             # dropped before the cumulative-p cut)
             probs = jnp.where(probs >= threshold, probs, 0.0)
-        order = jnp.argsort(-probs, axis=-1)
-        sp = jnp.take_along_axis(probs, order, axis=-1)
-        csum = jnp.cumsum(sp, axis=-1)
-        # keep tokens while cumulative mass (exclusive) < p; always keep top-1
-        keep = (csum - sp) < p[:, None]
-        keep = keep.at[:, 0].set(True)
-        masked = jnp.where(keep, sp, 0.0)
-        masked = masked / jnp.maximum(
-            jnp.sum(masked, axis=-1, keepdims=True), 1e-20)
-        gumbel = -jnp.log(-jnp.log(
-            jax.random.uniform(key, masked.shape, minval=1e-20, maxval=1.0)))
-        choice = jnp.argmax(jnp.where(keep, jnp.log(masked + 1e-20) + gumbel,
-                                      -jnp.inf), axis=-1)
-        ids = jnp.take_along_axis(order, choice[:, None], axis=-1)
+        ids = nucleus_sample_ids(probs, p, key)
         out_p = jnp.take_along_axis(probs, ids, axis=-1)
         return out_p, ids
 

@@ -234,7 +234,35 @@ def main():
         f.write("## Missing (user-relevant)\n\n")
         for op in missing:
             f.write(f"- `{op}`\n")
-        f.write("\n## Subsumed (capability at a different API level)\n\n")
+        f.write("""
+## Caveats on subsumption claims
+
+"Subsumed" means the *capability* exists behind a different API — NOT a
+drop-in op. Users porting reference code should note in particular:
+
+- `graph_khop_sampler` → composition: call `geometric.sample_neighbors`
+  once per hop and `geometric.reindex_graph` yourself; there is no single
+  fused k-hop call.
+- `yolo_box_post` → composition of `vision.ops.yolo_box` +
+  `vision.ops.multiclass_nms`; the fused post-process op does not exist.
+- optimizer kernel ops (`adam_`, `sgd_`, ...) are subsumed by the
+  `optimizer` package's jitted pytree step — there is no per-op
+  functional form.
+- `sequence_conv` / `sequence_pool` / `fake_channel_wise_*` are
+  **excluded** (LoD-sequence and simulated-quant infrastructure), not
+  re-expressed; code using them must be rewritten against padded-batch
+  ops / the `quantization` package.
+
+## Exact-parity limits (the reference has the same restriction)
+
+- `signal.frame` / `signal.overlap_add`: axis in {0, -1} — the reference
+  raises for other axes too (python/paddle/signal.py:104).
+- `audio.backends.save`: PCM_16 only — the reference wave_backend
+  supports only 16-bit PCM (python/paddle/audio/backends/wave_backend.py
+  save docstring).
+
+""")
+        f.write("## Subsumed (capability at a different API level)\n\n")
         f.write("| reference op | covered by |\n|---|---|\n")
         for op, via in subsumed:
             f.write(f"| `{op}` | `{via}` |\n")
