@@ -128,6 +128,18 @@ def _run_train_bench(model, params, make_inputs, loss_of, iters,
     return dt, loss0, loss_end, n_params
 
 
+def _env_int(name, default):
+    v = os.environ.get(name)
+    return default if v is None else int(v)
+
+
+def _env_bool(name, default):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() not in ("0", "false", "no", "off", "")
+
+
 def _bench_gpt(small):
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
@@ -138,8 +150,12 @@ def _bench_gpt(small):
                         use_flash_attention=False)
         batch, seq, iters = 2, 128, 2
     else:
-        cfg = GPTConfig(max_seq_len=1024)
-        batch, seq, iters = 8, 1024, 10
+        # BASELINE.md config #4: GPT-2 345M (gpt2-medium geometry)
+        cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        max_seq_len=1024,
+                        recompute=_env_bool("BENCH_RECOMPUTE", False),
+                        fused_loss=_env_bool("BENCH_FUSED", True))
+        batch, seq, iters = _env_int("BENCH_BATCH", 8), 1024, 10
     model = GPTForCausalLM(cfg)
     params = [p for p in model.parameters() if not p.stop_gradient]
 
@@ -161,7 +177,7 @@ def _bench_gpt(small):
     mfu = flops_per_token * tokens_per_sec / chip_peak_flops(
         jax.devices()[0])
     return {
-        "metric": "gpt2_124m_train_tokens_per_sec_per_chip"
+        "metric": "gpt2_345m_train_tokens_per_sec_per_chip"
                   if not small else "gpt_tiny_train_tokens_per_sec",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
@@ -233,9 +249,15 @@ def _bench_bert(small):
                          attention_probs_dropout_prob=0.0)
         batch, seq, iters = 2, 128, 2
     else:
-        cfg = BertConfig(hidden_dropout_prob=0.0,
-                         attention_probs_dropout_prob=0.0)
-        batch, seq, iters = 48, 512, 10
+        # vocab padded 30522 -> 30592 (next multiple of 128: MXU lane
+        # alignment for the MLM head matmul, the standard GPT-2-style
+        # padded-vocab trick); fused chunked head+loss
+        cfg = BertConfig(vocab_size=_env_int("BENCH_VOCAB", 30592),
+                         hidden_dropout_prob=0.0,
+                         attention_probs_dropout_prob=0.0,
+                         recompute=_env_bool("BENCH_RECOMPUTE", False),
+                         fused_loss=_env_bool("BENCH_FUSED", True))
+        batch, seq, iters = _env_int("BENCH_BATCH", 48), 512, 10
     model = BertForPretraining(cfg)
     params = [p for p in model.parameters() if not p.stop_gradient]
 
@@ -275,10 +297,15 @@ def _bench_llama(small):
         cfg = llama_tiny(use_flash_attention=False)
         batch, seq, iters = 2, 128, 2
     else:
-        cfg = LlamaConfig(vocab_size=32000, hidden_size=768,
-                          intermediate_size=2048, num_layers=12,
-                          num_heads=12, max_seq_len=2048)
-        batch, seq, iters = 4, 2048, 5
+        # largest LLaMA that trains on one 16 GB v5e at S=2048 with
+        # bf16-resident weights + f32 master + f32 Adam moments
+        # (14 B/param of state) and block remat: ~770M params
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                          intermediate_size=4096, num_layers=24,
+                          num_heads=12, max_seq_len=2048,
+                          recompute=_env_bool("BENCH_RECOMPUTE", True),
+                          fused_loss=_env_bool("BENCH_FUSED", True))
+        batch, seq, iters = _env_int("BENCH_BATCH", 4), 2048, 5
     from paddle_tpu.models import LlamaForCausalLM
     model = LlamaForCausalLM(cfg)
     params = [p for p in model.parameters() if not p.stop_gradient]
@@ -300,7 +327,7 @@ def _bench_llama(small):
     mfu = flops_per_token * tokens_per_sec / chip_peak_flops(
         jax.devices()[0])
     return {
-        "metric": "llama_110m_s2048_train_tokens_per_sec_per_chip",
+        "metric": "llama_770m_s2048_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),

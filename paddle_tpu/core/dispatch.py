@@ -110,6 +110,9 @@ class set_grad_enabled_ctx:
 AMP_WHITE_OPS = {
     "matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "conv2d_transpose",
     "einsum", "linear", "addmm", "flash_attention", "scaled_dot_product_attention",
+    # chunked head+loss fusion: the matmul dominates, internal lse math
+    # accumulates in f32 regardless of the input dtype
+    "fused_linear_cross_entropy",
 }
 AMP_BLACK_OPS = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",

@@ -78,13 +78,14 @@ def set_flags(flags: Dict[str, Any]):
                 raise KeyError(f"Flag {n!r} is not defined")
             f = _registry[key]
             f.value = _coerce(f.type, v)
-            obs = _observers.get(key)
-            if obs is not None:
+            for obs in _observers.get(key, ()):
                 obs(f.value)
 
 
 def on_change(name: str, fn: Callable[[Any], None]):
-    _observers[name] = fn
+    # multiple subscribers per flag: dispatch's hot mirror AND any user
+    # tap must both see every set_flags
+    _observers.setdefault(name, []).append(fn)
 
 
 def all_flags() -> Iterable[str]:

@@ -126,7 +126,15 @@ class Fleet:
         if hcg.get_sep_parallel_world_size() > 1:
             return SegmentParallel(model, hcg, self._strategy)
         from ..parallel import DataParallel
-        return DataParallel(model)
+        s = self._strategy or DistributedStrategy()
+        # comm-tuning knobs ride through to the wrapper (where XLA's
+        # collective scheduling subsumes manual bucketing, the wrapper
+        # documents exactly that instead of silently dropping them)
+        return DataParallel(
+            model,
+            comm_buffer_size=s.fuse_grad_size_in_MB,
+            last_comm_buffer_size=s.last_comm_group_size_MB,
+            find_unused_parameters=s.find_unused_parameters)
 
     def distributed_optimizer(self, optimizer, strategy=None):
         return HybridParallelOptimizer(optimizer, self._hcg,

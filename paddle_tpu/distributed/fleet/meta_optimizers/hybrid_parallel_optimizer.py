@@ -64,14 +64,49 @@ class HybridParallelOptimizer:
             optimizer = DygraphShardingOptimizer(optimizer, hcg)
         self._inner_opt = optimizer
 
+        # strategy-driven gradient merge (reference: distributed/passes/
+        # auto_parallel_gradient_merge.py:530 GradientMergePass — k-step
+        # grad accumulation with optional averaging): the first k-1
+        # ``step()`` calls bank the micro-batch grads and skip the update;
+        # the k-th applies the merged grad through the inner optimizer.
+        self._gm_k = 1
+        self._gm_avg = True
+        if strategy is not None and getattr(strategy, "gradient_merge",
+                                            False):
+            cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+            self._gm_k = max(int(cfg.get("k_steps", 1)), 1)
+            self._gm_avg = bool(cfg.get("avg", True))
+        self._gm_step = 0
+        self._gm_bufs = {}          # id(param) -> (param, accumulated jnp)
+
         # re-route a plain global-norm clip through the hybrid clip
         # (reference :280 region replaces inner_opt._grad_clip)
         inner = getattr(optimizer, "_inner_opt", optimizer)
         if isinstance(inner._grad_clip, ClipGradByGlobalNorm):
             inner._grad_clip = HybridParallelClipGrad(inner._grad_clip, hcg)
 
+    def _gm_params(self):
+        return [p for p in self._inner_opt._parameter_list
+                if (not p.stop_gradient) and p.grad is not None]
+
     @dispatch.no_grad()
     def step(self):
+        if self._gm_k <= 1:
+            self._inner_opt.step()
+            return
+        self._gm_step += 1
+        for p in self._gm_params():
+            ent = self._gm_bufs.get(id(p))
+            g = p.grad._data
+            self._gm_bufs[id(p)] = (p, g if ent is None else ent[1] + g)
+        if self._gm_step % self._gm_k:
+            # non-boundary micro step: grads are banked, no update;
+            # the caller's clear_grad() wipes p.grad, not the bank
+            return
+        from ....core.tensor import Tensor
+        for p, acc in self._gm_bufs.values():
+            p.grad = Tensor(acc / self._gm_k if self._gm_avg else acc)
+        self._gm_bufs = {}
         self._inner_opt.step()
 
     def minimize(self, loss, startup_program=None, parameters=None,

@@ -94,11 +94,20 @@ class ElasticManager:
         return "rescale", live
 
     def publish(self, new_world: List[int]):
-        epoch = self.current_epoch() + 1
+        # world first, THEN the epoch bump (watchers read epoch -> world);
+        # the bump is a server-side atomic increment so concurrent
+        # publishers each take a unique epoch and no restart is swallowed
         self.client.put(self._world_key,
                         ",".join(str(r) for r in new_world))
-        self.client.put(self._epoch_key, str(epoch))
-        return epoch
+        for _ in range(3):
+            epoch = self.client.incr(self._epoch_key)
+            if epoch is not None:
+                return epoch
+        # master unreachable after retries: do NOT fall back to a blind
+        # read-increment-put — it could double-bump (an incr whose
+        # response timed out after applying) or overwrite a concurrently
+        # incremented higher epoch with a lower one. Report best-effort.
+        return self.current_epoch()
 
     # ------------------------------------------------------------- watch
     def start(self, initial_world: List[int]):

@@ -46,6 +46,32 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(200)
         self.end_headers()
 
+    def do_POST(self):
+        # atomic counter increment: POST /key (body: optional int delta)
+        # -> new value. Concurrent bumpers (elastic watch thread vs a
+        # failing node's launcher) each get a UNIQUE epoch — a plain
+        # read-increment-write could publish the same number twice and
+        # swallow one group restart.
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length) if length else b""
+        try:
+            delta = int(body) if body.strip() else 1
+        except ValueError:
+            self.send_response(400)
+            self.end_headers()
+            return
+        kv, lock = self._store()
+        with lock:
+            try:
+                cur = int(kv.get(self.path, b"0") or b"0")
+            except ValueError:
+                cur = 0
+            new = cur + delta
+            kv[self.path] = str(new).encode()
+        self.send_response(200)
+        self.end_headers()
+        self.wfile.write(str(new).encode())
+
     def do_GET(self):
         kv, lock = self._store()
         if self.path.endswith("/"):
@@ -134,6 +160,18 @@ class KVClient:
             with urllib.request.urlopen(self._url(key), timeout=5) as r:
                 return r.read().decode()
         except (urllib.error.URLError, OSError):
+            return None
+
+    def incr(self, key: str, delta: int = 1):
+        """Server-side atomic increment; returns the new value or None if
+        the master is unreachable."""
+        req = urllib.request.Request(self._url(key),
+                                     data=str(delta).encode(),
+                                     method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=5) as r:
+                return int(r.read().decode())
+        except (urllib.error.URLError, OSError, ValueError):
             return None
 
     def get_prefix(self, prefix: str) -> dict:

@@ -116,6 +116,16 @@ class DataParallel(Layer):
                  group=None):
         super().__init__()
         self._layers = layers
+        # comm_buffer_size / last_comm_buffer_size (reference: grad-fusion
+        # bucket MBs for the EagerReducer) have no effect on TPU: XLA
+        # schedules and fuses the dp psums itself. find_unused_parameters
+        # is likewise subsumed — jax autodiff produces zero grads for
+        # unused params and every grad's psum is compiler-inserted, so
+        # there is no reducer to hang; the reference semantics of
+        # find_unused_parameters=True hold unconditionally here.
+        self.comm_buffer_size = comm_buffer_size
+        self.last_comm_buffer_size = last_comm_buffer_size
+        self.find_unused_parameters = find_unused_parameters
         mesh = mesh_mod.get_mesh()
         axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
         self._pmesh = ProcessMesh(list(range(int(mesh.shape[axis]))),

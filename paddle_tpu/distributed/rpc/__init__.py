@@ -63,7 +63,17 @@ class _ExecHandler(BaseHTTPRequestHandler):
             result = ("ok", fn(*args, **(kwargs or {})))
         except Exception as e:  # propagate the remote exception
             result = ("err", e)
-        body = pickle.dumps(result)
+        try:
+            body = pickle.dumps(result)
+        except Exception:
+            # the result (often an exception holding sockets/tracers) is
+            # unpicklable — degrade to a picklable repr instead of dying
+            # inside the handler and showing the client a bare connection
+            # error
+            kind = "exception" if result[0] == "err" else "result"
+            body = pickle.dumps(
+                ("err", RuntimeError(
+                    f"unpicklable RPC {kind}: {result[1]!r}")))
         self.send_response(200)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -33,6 +33,90 @@ import numpy as np
 
 _tls = threading.local()
 
+#: bound on compiled segments per (StaticFunction, signature) cache —
+#: long-running shape-diverse workloads must not grow XLA executables
+#: without limit (compile_cache.py's cache is similarly bounded by
+#: guard invalidation in the reference)
+SEGMENT_CACHE_MAX = 128
+
+_PRIM = (int, float, bool, str, bytes, complex, type(None))
+
+
+def _const_repr(v, depth: int) -> str:
+    """Stable repr of a captured Python constant for guard keys."""
+    if isinstance(v, _PRIM) or isinstance(v, (np.integer, np.floating,
+                                              np.bool_)):
+        return repr(v)
+    if isinstance(v, (tuple, list)):
+        if depth <= 0:
+            return f"<seq:{len(v)}>"
+        return "[" + ",".join(_const_repr(x, depth - 1) for x in v) + "]"
+    if isinstance(v, dict):
+        if depth <= 0:
+            return f"<dict:{len(v)}>"
+        try:
+            items = sorted(v.items())
+        except TypeError:
+            items = list(v.items())
+        return "{" + ",".join(f"{k!r}:{_const_repr(x, depth - 1)}"
+                              for k, x in items) + "}"
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        shape = tuple(getattr(v, "shape", ()))
+        size = int(np.prod(shape)) if shape else 1
+        if size <= 1:
+            # scalar arrays DO value-guard: a loss scale / step counter
+            # baked into a lowering must invalidate on change (the sync
+            # is one host read of one element)
+            try:
+                return f"<arr:{shape}:{v.dtype}:{np.asarray(v).item()!r}>"
+            except Exception:
+                pass
+        # larger payloads guard shape/dtype only (cheap); value-captured
+        # big arrays should be op INPUTS, not closure constants
+        return f"<arr:{shape}:{v.dtype}>"
+    if callable(v):
+        return fn_fingerprint(v, depth - 1)
+    # plain object: guard its primitive/scalar attributes one level deep
+    # (e.g. a GradScaler captured via ``self`` — its _scale must key the
+    # cache, or a post-overflow segment stale-hits the old scale)
+    d = getattr(v, "__dict__", None)
+    if d and depth > 0:
+        attrs = ",".join(
+            f"{k}:{_const_repr(x, 0)}" for k, x in
+            sorted(d.items())[:16]
+            if isinstance(x, _PRIM + (np.integer, np.floating, np.bool_))
+            or (hasattr(x, "shape") and hasattr(x, "dtype")))
+        return f"<{type(v).__name__}:{attrs}>"
+    return f"<{type(v).__name__}>"
+
+
+def fn_fingerprint(f, depth: int = 2) -> str:
+    """Guard key covering the VALUES a lowering closed over, not just its
+    attrs (reference: sot/symbolic/compile_cache.py object guards over
+    globals/closure cells). A non-tensor Python value baked into the
+    lowering closure (e.g. a rope theta, a scale factor) changes the key,
+    so the cached program recompiles instead of stale-hitting."""
+    import functools
+    if isinstance(f, functools.partial):
+        return ("partial(" + fn_fingerprint(f.func, depth) + ","
+                + _const_repr(f.args, depth) + ","
+                + _const_repr(f.keywords, depth) + ")")
+    code = getattr(f, "__code__", None)
+    if code is None:
+        return f"<callable:{type(f).__name__}>"
+    parts = [code.co_filename, str(code.co_firstlineno)]
+    if depth > 0:
+        for cell in getattr(f, "__closure__", None) or ():
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                parts.append("<empty>")
+                continue
+            parts.append(_const_repr(v, depth))
+        for d in getattr(f, "__defaults__", None) or ():
+            parts.append(_const_repr(d, depth))
+    return "|".join(parts)
+
 
 def active() -> bool:
     return getattr(_tls, "capture", None) is not None
@@ -190,6 +274,11 @@ class Segment:
         out_refs = sorted({(l.node_id, l.out_idx) for l in live})
         key = (self.owner.site_idx, self.fingerprint(out_refs))
         jitted = self.owner.cache.get(key)
+        if jitted is not None:
+            # LRU touch: FIFO eviction would throw out the steady-state
+            # hot segment first and thrash recompiles
+            self.owner.cache.pop(key)
+            self.owner.cache[key] = jitted
         if jitted is None:
             nodes = self.nodes
 
@@ -204,6 +293,8 @@ class Segment:
                 return [env[i][j] for i, j in out_refs]
 
             jitted = jax.jit(seg_fn)
+            if len(self.owner.cache) >= SEGMENT_CACHE_MAX:
+                self.owner.cache.pop(next(iter(self.owner.cache)))
             self.owner.cache[key] = jitted
             self.owner.stats["compiled"] += 1
         results = jitted(self.ext_arrays)
@@ -257,6 +348,9 @@ def record_or_none(op_name: str, f: Callable, arrays: Sequence,
         attr_key = repr(sorted((attrs or {}).items()))
     except Exception:
         attr_key = f"<unrepr:{op_name}>"
+    # value-guard the lowering's closure: constants captured OUTSIDE the
+    # attrs dict must invalidate the cached segment when they change
+    attr_key += "#" + fn_fingerprint(f)
     try:
         return seg.add_with_structure(op_name, f, arrays,
                                       attr_key=attr_key)

@@ -29,6 +29,10 @@ class BertConfig:
     max_position_embeddings: int = 512
     type_vocab_size: int = 2
     initializer_range: float = 0.02
+    recompute: bool = False          # activation-checkpoint every layer
+    #: fused MLM decoder + chunked streaming CE over the tied embedding
+    #: matrix (forward returns (None, nsp_logits, loss) with labels)
+    fused_loss: bool = False
 
 
 def bert_base(**kw) -> "BertConfig":
@@ -95,7 +99,16 @@ class BertModel(nn.Layer):
             m = ops.reshape(attention_mask,
                             [attention_mask.shape[0], 1, 1, -1])
             attention_mask = (1.0 - m.astype("float32")) * -1e4
-        seq = self.encoder(x, src_mask=attention_mask)
+        if self.cfg.recompute:
+            from ._remat import remat_block
+            seq = x
+            for mod in self.encoder.layers:
+                if attention_mask is None:
+                    seq = remat_block(mod, seq)
+                else:
+                    seq = remat_block(mod, seq, attention_mask)
+        else:
+            seq = self.encoder(x, src_mask=attention_mask)
         return seq, self.pooler(seq)
 
 
@@ -131,6 +144,17 @@ class BertForPretraining(nn.Layer):
         h = self.mlm_norm(F.gelu(self.mlm_dense(seq), approximate=True))
         # tied decoder: project onto word embedding matrix
         w = self.bert.embeddings.word_embeddings.weight
+        if masked_lm_labels is not None and self.bert.cfg.fused_loss:
+            hidden = self.bert.cfg.hidden_size
+            loss = F.fused_linear_cross_entropy(
+                ops.reshape(h, [-1, hidden]), w,
+                ops.reshape(masked_lm_labels, [-1]), transpose_y=True,
+                ignore_index=-100)
+            nsp_logits = self.nsp(pooled)
+            if next_sentence_labels is not None:
+                loss = loss + F.cross_entropy(nsp_logits,
+                                              next_sentence_labels)
+            return None, nsp_logits, loss
         mlm_logits = ops.matmul(h, w, transpose_y=True)
         nsp_logits = self.nsp(pooled)
         if masked_lm_labels is None:

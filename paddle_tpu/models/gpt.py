@@ -37,6 +37,13 @@ class GPTConfig:
     use_flash_attention: bool = True
     mp_degree: int = 1              # tensor-parallel ways ('mp' mesh axis)
     sequence_parallel: bool = False
+    #: activation-checkpoint every block (reference recompute pass) —
+    #: required to train the 345M+ rungs on a 16 GB chip
+    recompute: bool = False
+    #: fuse the lm-head matmul into the loss (chunked streaming CE; the
+    #: full (B*S, V) logits tensor is never materialized). forward()
+    #: then returns (None, loss) when labels are given.
+    fused_loss: bool = False
     #: long-context attention backend over the 'sep' axis:
     #: "" (dense/flash local), "ring" (ring attention), "ulysses"
     #: (all-to-all head-scatter) — see fleet.meta_parallel.sep_utils
@@ -184,8 +191,13 @@ class GPTModel(nn.Layer):
         b, s = input_ids.shape
         pos = ops.arange(0, s, dtype="int64")
         x = self.wte(input_ids) + self.wpe(pos)
-        for blk in self.blocks:
-            x = blk(x)
+        if self.cfg.recompute:
+            from ._remat import remat_block
+            for blk in self.blocks:
+                x = remat_block(blk, x)
+        else:
+            for blk in self.blocks:
+                x = blk(x)
         return self.ln_f(x)
 
 
@@ -199,6 +211,12 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.gpt(input_ids)
+        if labels is not None and self.cfg.fused_loss:
+            loss = F.fused_linear_cross_entropy(
+                ops.reshape(h[:, :-1, :], [-1, self.cfg.hidden_size]),
+                self.gpt.wte.weight,
+                ops.reshape(labels[:, 1:], [-1]), transpose_y=True)
+            return None, loss
         logits = ops.matmul(h, self.gpt.wte.weight, transpose_y=True)
         if labels is None:
             return logits

@@ -40,6 +40,9 @@ class LlamaConfig:
     mp_degree: int = 1
     sequence_parallel: bool = False
     context_parallel: str = ""       # "", "ring", "ulysses"
+    recompute: bool = False          # activation-checkpoint every block
+    #: fused lm-head + chunked streaming CE (forward returns (None, loss))
+    fused_loss: bool = False
 
     def __post_init__(self):
         if self.num_kv_heads == 0:
@@ -267,8 +270,13 @@ class LlamaModel(nn.Layer):
 
     def forward(self, input_ids):
         x = self.embed_tokens(input_ids)
-        for blk in self.layers:
-            x = blk(x)
+        if self.cfg.recompute:
+            from ._remat import remat_block
+            for blk in self.layers:
+                x = remat_block(blk, x)
+        else:
+            for blk in self.layers:
+                x = blk(x)
         return self.norm(x)
 
 
@@ -288,6 +296,17 @@ class LlamaForCausalLM(nn.Layer):
 
     def forward(self, input_ids, labels=None):
         h = self.model(input_ids)
+        if labels is not None and self.cfg.fused_loss:
+            hh = ops.reshape(h[:, :-1, :], [-1, self.cfg.hidden_size])
+            lab = ops.reshape(labels[:, 1:], [-1])
+            if self.lm_head is None:
+                loss = F.fused_linear_cross_entropy(
+                    hh, self.model.embed_tokens.weight, lab,
+                    transpose_y=True)
+            else:
+                loss = F.fused_linear_cross_entropy(
+                    hh, self.lm_head.weight, lab)
+            return None, loss
         if self.lm_head is None:
             logits = ops.matmul(h, self.model.embed_tokens.weight,
                                 transpose_y=True)

@@ -588,3 +588,90 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
 
 
 __all__ += ['hsigmoid_loss', 'edit_distance', 'ctc_align', 'rnnt_loss']
+
+
+def fused_linear_cross_entropy(x, weight, label, bias=None,
+                               transpose_y=False, ignore_index=-100,
+                               reduction="mean", chunk_rows=4096):
+    """Cross entropy of ``x @ weight (+ bias)`` against hard ``label``
+    WITHOUT materializing the full ``(N, V)`` logits tensor.
+
+    TPU-native fusion of the LM-head matmul with the loss (the reference
+    computes them as two ops — ``matmul`` then ``cross_entropy_with_softmax``
+    — which forces the ``(batch*seq, vocab)`` logits through HBM twice in
+    forward and again in backward). Here the rows are processed in
+    ``chunk_rows`` slices under ``jax.lax.scan``; each slice's logits are
+    a transient and are REcomputed inside backward (``jax.checkpoint``), so
+    peak memory is ``O(chunk_rows * V)`` and the logits never round-trip
+    HBM between ops. The streaming max/lse accumulate in f32 while the
+    matmul stays in the input dtype (bf16 under AMP).
+
+    Args follow ``cross_entropy``; ``x`` is ``(N, H)`` (callers flatten
+    batch/seq), ``weight`` is ``(H, V)`` (or ``(V, H)`` with
+    ``transpose_y=True`` for embedding-tied heads), ``label`` is ``(N,)``.
+    ``reduction`` in {"mean", "sum", "none"}; mean averages over
+    non-ignored rows.
+    """
+    x, weight, label = _t(x), _t(weight), _t(label)
+    inputs = [x, weight, label]
+    has_b = bias is not None
+    if has_b:
+        inputs.append(_t(bias))
+
+    def f(xa, wa, lab, *b):
+        n, h = xa.shape
+        if n == 0:      # e.g. seq_len==1 -> empty shifted labels
+            if reduction == "none":
+                return jnp.zeros((0,), jnp.float32)
+            return jnp.asarray(0.0, jnp.float32)
+        chunk = min(chunk_rows, n)
+        pad = (-n) % chunk
+        if pad:
+            xa = jnp.concatenate(
+                [xa, jnp.zeros((pad, h), xa.dtype)], axis=0)
+            lab = jnp.concatenate(
+                [lab, jnp.full((pad,), ignore_index, lab.dtype)], axis=0)
+        n_chunks = xa.shape[0] // chunk
+        xc = xa.reshape(n_chunks, chunk, h)
+        lc = lab.reshape(n_chunks, chunk)
+
+        def chunk_nll(x_c, l_c):
+            logits = (x_c @ wa.T) if transpose_y else (x_c @ wa)
+            if has_b:
+                logits = logits + b[0]
+            m = jax.lax.stop_gradient(
+                jnp.max(logits, axis=-1, keepdims=True))
+            shifted = (logits - m).astype(jnp.float32)
+            lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1)) \
+                + jnp.squeeze(m.astype(jnp.float32), -1)
+            l_i = l_c.astype(jnp.int32)
+            valid = l_i != ignore_index
+            safe = jnp.where(valid, l_i, 0)
+            picked = jnp.squeeze(jnp.take_along_axis(
+                logits, safe[:, None], axis=-1), -1)
+            nll = jnp.where(valid, lse - picked.astype(jnp.float32), 0.0)
+            return nll, valid
+
+        chunk_nll = jax.checkpoint(chunk_nll)
+
+        def body(carry, xl):
+            s, c = carry
+            nll, valid = chunk_nll(*xl)
+            return (s + jnp.sum(nll), c + jnp.sum(valid)), \
+                (nll if reduction == "none" else None)
+
+        (total, count), per_row = jax.lax.scan(
+            body, (jnp.asarray(0.0, jnp.float32),
+                   jnp.asarray(0, jnp.int32)), (xc, lc))
+        if reduction == "none":
+            return per_row.reshape(-1)[:n]
+        if reduction == "sum":
+            return total
+        return total / jnp.maximum(count, 1)
+
+    return dispatch.call("fused_linear_cross_entropy", f, inputs,
+                         differentiable_mask=[True, True, False]
+                         + [True] * has_b)
+
+
+__all__ += ['fused_linear_cross_entropy']
