@@ -174,11 +174,14 @@ def _amp_cast_inputs(op_name: str, arrays: List):
 # Hot-path flag mirror: dispatch reads these per op, so they are kept in
 # sync by flag observers instead of registry lookups per call.
 _hot_flags = {"check_nan_inf": flags.get_flag("check_nan_inf"),
-              "benchmark": flags.get_flag("benchmark")}
+              "benchmark": flags.get_flag("benchmark"),
+              "eager_jit_cache": flags.get_flag("eager_jit_cache")}
 flags.on_change("check_nan_inf",
                 lambda v: _hot_flags.__setitem__("check_nan_inf", v))
 flags.on_change("benchmark",
                 lambda v: _hot_flags.__setitem__("benchmark", v))
+flags.on_change("eager_jit_cache",
+                lambda v: _hot_flags.__setitem__("eager_jit_cache", v))
 
 _op_hooks: List[Callable] = []  # profiler / debugging taps
 _recorder_tls = threading.local()  # program capture is per-thread: a
@@ -243,6 +246,139 @@ def _check_nan_inf(op_name, outs):
                 if level == 0:
                     raise FloatingPointError(msg)
                 print(f"[paddle_tpu][nan_inf] {msg}")
+
+
+# ---------------------------------------------------------------------------
+# Eager compiled-lowering cache: steady-state eager ops run as cached
+# jax.jit programs instead of unamortized JAX eager dispatch (reference
+# bar: the generated C++ ad_func path, eager_gen.py:301, is µs-level).
+# A lowering is cacheable only when its closure is fully described by
+# primitives — anything value-opaque (arrays, objects) falls back to
+# plain eager so a stale compile can never be served.
+# ---------------------------------------------------------------------------
+_EAGER_JIT_MAX = 1024
+#: eager executions of a key before the compiled lowering is installed —
+#: steady-state loops amortize one compile, while code that touches an
+#: op only a handful of times never pays XLA compilation for it
+_JIT_AFTER = 3
+_eager_jit_cache: Dict = {}   # (op, closure key) -> count | jitted | False
+
+_PRIM_TYPES = (int, float, bool, str, bytes, complex, type(None))
+
+
+def _const_key(v, depth: int):
+    """Hashable key fully describing a closed-over constant, or None if
+    the value cannot be exactly keyed (= uncacheable)."""
+    if isinstance(v, _PRIM_TYPES):
+        # type-qualified: 2, 2.0 and True hash/compare equal in python,
+        # but bake into DIFFERENT compiled programs (dtype promotion)
+        return (type(v).__name__, v)
+    if isinstance(v, (np.integer, np.floating, np.bool_)):
+        return ("nps", type(v).__name__, v.item())
+    if isinstance(v, np.dtype):
+        return ("dt", str(v))
+    if isinstance(v, (tuple, list)):
+        out = []
+        for x in v:
+            k = _const_key(x, depth - 1) if depth > 0 else None
+            if k is None and x is not None:
+                return None
+            out.append(k)
+        return ("seq", tuple(out))
+    if isinstance(v, dict):
+        if depth <= 0:
+            return None
+        try:
+            items = sorted(v.items())
+        except TypeError:
+            return None
+        out = []
+        for key, x in items:
+            k = _const_key(x, depth - 1)
+            if k is None and x is not None:
+                return None
+            out.append((key, k))
+        return ("map", tuple(out))
+    if callable(v):
+        return _closure_cache_key(v, depth - 1)
+    return None
+
+
+def _closure_cache_key(f, depth: int = 3):
+    """Key of a lowering = code identity + every closure/default value;
+    None when any captured value is not exactly keyable."""
+    if depth < 0:
+        return None
+    import functools
+    if isinstance(f, functools.partial):
+        sub = _closure_cache_key(f.func, depth - 1)
+        ar = _const_key(tuple(f.args), depth - 1)
+        kw = _const_key(f.keywords or {}, depth - 1)
+        if sub is None or ar is None or kw is None:
+            return None
+        return ("partial", sub, ar, kw)
+    if isinstance(f, np.ufunc) or type(f).__module__.startswith(
+            ("jax.", "numpy")):
+        # stateless callable objects (np/jnp ufuncs, jitted wrappers):
+        # identity-keyed; the key tuple holds a strong ref so the id
+        # cannot be recycled
+        return ("uf", f)
+    if getattr(f, "__self__", None) is not None:
+        # bound method: behavior can depend on mutable receiver state the
+        # closure walk cannot see — never cache
+        return None
+    code = getattr(f, "__code__", None)
+    if code is None:
+        return None
+    parts: List = [code.co_filename, code.co_firstlineno, code.co_name]
+    for cell in getattr(f, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:
+            return None
+        k = _const_key(v, depth - 1)
+        if k is None and v is not None:
+            return None
+        parts.append(k)
+    for d in getattr(f, "__defaults__", None) or ():
+        k = _const_key(d, depth - 1)
+        if k is None and d is not None:
+            return None
+        parts.append(k)
+    return tuple(parts)
+
+
+def _all_jax_arrays(outs) -> bool:
+    seq = outs if isinstance(outs, (tuple, list)) else [outs]
+    return all(isinstance(o, jax.Array) for o in seq)
+
+
+def _jit_cached_call(op_name: str, f: Callable, arrays):
+    """Execute an eager lowering through the compiled cache. First sight
+    of a key runs eagerly (verifying the outputs are pure jax arrays) and
+    installs the jitted entry; later calls hit jax.jit's C++ fast path —
+    jit's own aval cache handles shape/dtype polymorphism under one
+    entry."""
+    key0 = _closure_cache_key(f)
+    if key0 is None:
+        return f(*arrays)
+    key = (op_name, key0)
+    ent = _eager_jit_cache.get(key)
+    if ent is False:
+        return f(*arrays)
+    if ent is None or isinstance(ent, int):
+        outs = f(*arrays)
+        if ent is None:
+            if len(_eager_jit_cache) >= _EAGER_JIT_MAX:
+                _eager_jit_cache.pop(next(iter(_eager_jit_cache)))
+            _eager_jit_cache[key] = (1 if _all_jax_arrays(outs)
+                                     else False)
+        elif ent + 1 >= _JIT_AFTER:
+            _eager_jit_cache[key] = jax.jit(f)
+        else:
+            _eager_jit_cache[key] = ent + 1
+        return outs
+    return ent(*arrays)
 
 
 def _lazy_vjp(f, arrays):
@@ -335,7 +471,12 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
         # custom_vjp kernels (second-order AD). Compute the primal only;
         # if the tape IS walked, derive the vjp lazily then (the primal is
         # recomputed inside jax.vjp at that point — remat-style).
-        outs = f(*arrays)
+        if traced or not _hot_flags["eager_jit_cache"]:
+            # under an outer trace, injecting nested jit boundaries would
+            # fragment the caller's XLA fusion — run the lowering inline
+            outs = f(*arrays)
+        else:
+            outs = _jit_cached_call(op_name, f, arrays)
         vjp_fn = _lazy_vjp(f, arrays) if record else None
 
     out_tuple = isinstance(outs, (tuple, list))

@@ -295,10 +295,25 @@ def spmd_pipeline_zb(block_fn: Callable, stacked: Sequence, xs, *,
 # Heterogeneous stages: flat ring buffer + per-rank lax.switch
 # --------------------------------------------------------------------------
 
-def _flatten_pack(arrays, size):
-    flat = (jnp.concatenate([jnp.ravel(a).astype(jnp.float32)
+def _buffer_dtype(dtypes):
+    """Narrowest float buffer that round-trips every entry EXACTLY:
+    all-bf16 (or all-f16) stages ride a same-width ring — half the
+    ppermute bytes and per-rank buffer HBM of an fp32 ring; any f32 (or
+    integer) entry widens to f32 (bf16<->f32 casts are exact, integers
+    are exact up to 2**24)."""
+    floats = {np.dtype(d) for d in dtypes
+              if np.issubdtype(np.dtype(d), np.floating)
+              or np.dtype(d) == np.dtype("bfloat16")}
+    non_floats = {np.dtype(d) for d in dtypes} - floats
+    if not non_floats and len(floats) == 1:
+        return jnp.dtype(next(iter(floats)))
+    return jnp.float32
+
+
+def _flatten_pack(arrays, size, buf_dtype=jnp.float32):
+    flat = (jnp.concatenate([jnp.ravel(a).astype(buf_dtype)
                              for a in arrays])
-            if arrays else jnp.zeros((0,), jnp.float32))
+            if arrays else jnp.zeros((0,), buf_dtype))
     return jnp.pad(flat, (0, size - flat.shape[0]))
 
 def _unpack(flat, shapes, dtypes):
@@ -317,10 +332,19 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
     """Pipeline ``S`` *unequal* stages inside one SPMD program.
 
     ``stage_fns[s](params_s, x_s) -> y_s`` with arbitrary per-stage
-    parameter pytrees and inter-stage activation shapes (uniform float
-    dtype). Parameters are packed into one padded fp32 buffer sharded over
-    ``pp``; activations ride a flat ring buffer sized for the largest
-    inter-stage tensor; rank ``r`` runs branch ``r`` of a ``lax.switch``.
+    parameter pytrees and inter-stage activation shapes. Parameters are
+    packed into one padded buffer sharded over ``pp``; activations ride a
+    flat ring buffer sized for the largest inter-stage tensor; rank ``r``
+    runs branch ``r`` of a ``lax.switch``. Buffers take the NARROWEST
+    float dtype that round-trips every entry exactly (``_buffer_dtype``):
+    an all-bf16 model pays bf16 bytes per element — not a 4-byte fp32
+    slot — in both per-rank param HBM and ppermute ring bandwidth; any
+    f32 entry widens the buffer to f32 (bf16<->f32 is exact either way,
+    so per-stage dtypes always round-trip bit-exactly). One SPMD program
+    means one rectangular array per input, so each rank's buffer is
+    padded to the LARGEST stage's byte need — per-rank memory is bounded
+    by max-stage, not sum-of-stages (replication) nor exactly own-stage
+    (which would need per-rank shapes, i.e. MPMD).
     ``stage_in_avals[s]`` is the activation aval entering stage ``s``
     (``stage_in_avals[0]`` = micro-batch aval); ``out_aval`` is the final
     stage's output aval.
@@ -334,12 +358,16 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
     p_sizes = [sum(int(np.prod(s)) if s else 1 for s in shp)
                for shp in p_shapes]
     Pmax = max(p_sizes + [1])
-    packed = jnp.stack([_flatten_pack(ps, Pmax) for ps in stage_params])
+    param_dtype = _buffer_dtype(
+        [d for ds in p_dtypes for d in ds] or [jnp.float32])
+    packed = jnp.stack([_flatten_pack(ps, Pmax, param_dtype)
+                        for ps in stage_params])
 
     act_avals = list(stage_in_avals) + [out_aval]
     act_sizes = [int(np.prod(a.shape)) for a in act_avals]
     Amax = max(act_sizes)
     out_size = act_sizes[-1]
+    act_dtype = _buffer_dtype([a.dtype for a in act_avals])
     if remat:
         stage_fns = [jax.checkpoint(f) for f in stage_fns]
 
@@ -352,7 +380,7 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
             n_in = act_sizes[s]
             x = flat_x[:n_in].reshape(in_aval.shape).astype(in_aval.dtype)
             y = fn(params, x)
-            yf = jnp.ravel(y).astype(jnp.float32)
+            yf = jnp.ravel(y).astype(act_dtype)
             return jnp.pad(yf, (0, Amax - yf.shape[0]))
         return run
 
@@ -365,10 +393,10 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
         local = packed_local[0]
         idx = jax.lax.axis_index("pp")
         xs_flat = jnp.pad(
-            xs.reshape(m, -1).astype(jnp.float32),
+            xs.reshape(m, -1).astype(act_dtype),
             ((0, 0), (0, Amax - in_size)))
-        state = jnp.zeros((Amax,), jnp.float32)
-        out = jnp.zeros((m, Amax), jnp.float32)
+        state = jnp.zeros((Amax,), act_dtype)
+        out = jnp.zeros((m, Amax), act_dtype)
 
         def tick(carry, t):
             state, out = carry

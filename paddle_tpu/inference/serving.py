@@ -123,6 +123,7 @@ class _GPTArch:
         self.model = model
         self.cfg = model.cfg
         self.num_kv_heads = model.cfg.num_heads
+        self.max_positions = model.cfg.max_seq_len
 
     def forward_chunk(self, tokens, start, attend):
         from paddle_tpu import ops
@@ -169,7 +170,8 @@ class PagedEngine:
 
     def __init__(self, model, *, max_batch: int = 8, block_size: int = 16,
                  num_blocks: int = 256, max_blocks_per_seq: int = 32,
-                 eos_id: Optional[int] = None, seed: int = 0):
+                 eos_id: Optional[int] = None, seed: int = 0,
+                 kv_dtype=None):
         self.model = model
         self.arch = _pick_arch(model)
         self.cfg = model.cfg
@@ -184,8 +186,18 @@ class PagedEngine:
 
         self.bm = BlockManager(num_blocks)
         self._total_usable = num_blocks - 1
+        # K/V pages live in the model's compute dtype (the attention math
+        # upcasts to f32 inside the kernel) — a bf16 model must not pay
+        # 2x KV HBM for fp32 pages; on a 16 GB chip KV capacity IS the
+        # serving ceiling.
+        if kv_dtype is None:
+            kv_dtype = next(
+                (p._data.dtype for p in model.parameters()
+                 if jnp.issubdtype(p._data.dtype, jnp.floating)),
+                jnp.float32)
+        self.kv_dtype = jnp.dtype(kv_dtype)
         self.kc = [jnp.zeros((num_blocks, block_size, nkv, self.head_dim),
-                             jnp.float32) for _ in range(cfg.num_layers)]
+                             self.kv_dtype) for _ in range(cfg.num_layers)]
         self.vc = [jnp.zeros_like(self.kc[0])
                    for _ in range(cfg.num_layers)]
 
@@ -215,6 +227,14 @@ class PagedEngine:
             raise ValueError("add_request: top_p must be in (0, 1]")
         if not temperature >= 0.0:   # also rejects NaN
             raise ValueError("add_request: temperature must be >= 0")
+        max_pos = getattr(self.arch, "max_positions", None)
+        if max_pos is not None and len(prompt) + max_new_tokens > max_pos:
+            # learned-position models: a sequence growing past the table
+            # would silently clip-gather the last embedding
+            raise ValueError(
+                f"add_request: prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds the model's position table "
+                f"({max_pos})")
         self._rid += 1
         self.queue.append(Request(self._rid, prompt, max_new_tokens,
                                   temperature=temperature, top_p=top_p))
@@ -313,6 +333,7 @@ class PagedEngine:
         return True
 
     def _admit(self):
+        admitted = []
         for slot in range(self.max_batch):
             if not self.queue or self.slots[slot] is not None:
                 continue
@@ -339,33 +360,73 @@ class PagedEngine:
             self.slots[slot] = req
             self.tables[slot, :] = 0
             self.slot_blocks[slot] = []
-            self._prefill(slot, req)
+            # allocate the prefix blocks NOW so the next admission's
+            # availability check sees the reduced pool
+            if not self._ensure_blocks(slot, prefix_len):
+                raise MemoryError("admission raced cache exhaustion")
+            admitted.append(slot)
+        if admitted:
+            self._prefill_batch(admitted)
+
+    def _prefill_batch(self, slots: List[int]):
+        """Prefill every same-tick admission TOGETHER: one (max_batch,
+        block_size) chunk program per chunk tick instead of per-request
+        [1, t] loops. Each slot's prefix is LEFT-padded to a multiple of
+        block_size — padded positions sit at negative sequence positions,
+        which the paged-attention kernel drops from the cache write and
+        fully masks from attention, so only two compiled shapes exist in
+        steady state: (max_batch, block_size) and the (max_batch, 1)
+        decode. The final chunk of each slot yields its first sampled
+        token."""
+        bs = self.block_size
+        prefixes = {}
+        chunks_of = {}
+        pad_of = {}
+        for slot in slots:
+            req = self.slots[slot]
+            prefix = np.asarray(req.prompt + req.generated, np.int32)
+            n_chunks = -(-len(prefix) // bs)
+            prefixes[slot] = np.concatenate(
+                [np.zeros(n_chunks * bs - len(prefix), np.int32), prefix])
+            chunks_of[slot] = n_chunks
+            pad_of[slot] = n_chunks * bs - len(prefix)
+        nxt_of = {}
+        for j in range(max(chunks_of.values())):
+            tokens = np.zeros((self.max_batch, bs), np.int32)
+            seq = np.zeros((self.max_batch,), np.int32)   # 0 = inactive
+            temps = np.zeros((self.max_batch,), np.float32)
+            top_ps = np.ones((self.max_batch,), np.float32)
+            involved = []
+            for slot in slots:
+                if j >= chunks_of[slot]:
+                    continue
+                req = self.slots[slot]
+                tokens[slot] = prefixes[slot][j * bs:(j + 1) * bs]
+                seq[slot] = (j + 1) * bs - pad_of[slot]
+                temps[slot] = req.temperature
+                top_ps[slot] = req.top_p
+                involved.append(slot)
+            nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps)
+            for slot in involved:
+                if j == chunks_of[slot] - 1:
+                    nxt_of[slot] = int(nxt[slot])
+        for slot in slots:
+            req = self.slots[slot]
+            self.seq_lens[slot] = len(req.prompt) + len(req.generated)
+            tok = nxt_of[slot]
+            req.generated.append(tok)
+            self.last_token[slot] = tok
+            self._maybe_finish(slot)
 
     def _prefill(self, slot: int, req: Request):
-        """Consume the prefix (prompt + any tokens generated before a
-        preemption) in block_size chunks; the final chunk's logits produce
-        the next generated token."""
-        bs = self.block_size
-        prefix = np.asarray(req.prompt + req.generated, np.int32)
-        done = 0
-        nxt = None
-        while done < len(prefix):
-            t = min(bs, len(prefix) - done)
-            chunk = prefix[done:done + t][None, :]
-            new_len = done + t
-            if not self._ensure_blocks(slot, new_len):
-                raise MemoryError("admission raced cache exhaustion")
-            seq = np.asarray([new_len], np.int32)
-            nxt = self._run_chunk(
-                chunk, seq, self.tables[slot:slot + 1],
-                np.asarray([req.temperature], np.float32),
-                np.asarray([req.top_p], np.float32))
-            done = new_len
-        self.seq_lens[slot] = len(prefix)
-        tok = int(nxt[0])
-        req.generated.append(tok)
-        self.last_token[slot] = tok
-        self._maybe_finish(slot)
+        """Single-request prefill (kept for API continuity; admission now
+        batches same-tick prefills through _prefill_batch). Unlike
+        _prefill_batch — whose only caller _admit allocates at admission
+        — this entry point still owns its block allocation."""
+        if not self._ensure_blocks(slot,
+                                   len(req.prompt) + len(req.generated)):
+            raise MemoryError("admission raced cache exhaustion")
+        self._prefill_batch([slot])
 
     def _evict(self, slot: int):
         """Preempt a running request: release its blocks and requeue it
@@ -464,9 +525,15 @@ class PagedEngine:
         if self.rejected:
             detail = "; ".join(f"request {rid}: {why}"
                                for rid, why in self.rejected.items())
+            rejected = dict(self.rejected)
             self.rejected.clear()
-            raise MemoryError(f"rejected never-fitting request(s): "
+            err = MemoryError(f"rejected never-fitting request(s): "
                               f"{detail}")
+            # completed generations must survive the raise — callers that
+            # catch can still read every successful result
+            err.results = out
+            err.rejected = rejected
+            raise err
         return out
 
 

@@ -24,6 +24,9 @@ def _t(x):
 
 
 def _use_pallas():
+    from ...core import flags
+    if not flags.get_flag("use_pallas_kernels"):
+        return False
     try:
         return jax.default_backend() in ("tpu", "axon")
     except Exception:

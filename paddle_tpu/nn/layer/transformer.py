@@ -78,16 +78,38 @@ class MultiHeadAttention(Layer):
         from ...ops import manipulation
         key = query if key is None else key
         value = key if value is None else value
-        q = self._reshape_heads(self.q_proj(query))
-        if isinstance(cache, self.StaticCache):
-            k, v = cache.k, cache.v
+        if (key is query and value is query and cache is None
+                and self.kdim == self.embed_dim
+                and self.vdim == self.embed_dim):
+            # self-attention fast path: one fused (h, 3h) projection
+            # instead of three h x h GEMMs (reference fused_attention op;
+            # the weight concat is trivially fused by XLA, the single
+            # wider matmul keeps the MXU busier)
+            from .. import functional as F
+            w = manipulation.concat(
+                [self.q_proj.weight, self.k_proj.weight,
+                 self.v_proj.weight], axis=1)
+            b = None
+            if self.q_proj.bias is not None:
+                b = manipulation.concat(
+                    [self.q_proj.bias, self.k_proj.bias,
+                     self.v_proj.bias], axis=0)
+            qkv = F.linear(query, w, b)
+            q, k, v = manipulation.split(qkv, 3, axis=-1)
+            q = self._reshape_heads(q)
+            k = self._reshape_heads(k)
+            v = self._reshape_heads(v)
         else:
-            k = self._reshape_heads(self.k_proj(key))
-            v = self._reshape_heads(self.v_proj(value))
-            if isinstance(cache, self.Cache):
-                k = manipulation.concat([cache.k, k], axis=1)
-                v = manipulation.concat([cache.v, v], axis=1)
-                cache = self.Cache(k, v)
+            q = self._reshape_heads(self.q_proj(query))
+            if isinstance(cache, self.StaticCache):
+                k, v = cache.k, cache.v
+            else:
+                k = self._reshape_heads(self.k_proj(key))
+                v = self._reshape_heads(self.v_proj(value))
+                if isinstance(cache, self.Cache):
+                    k = manipulation.concat([cache.k, k], axis=1)
+                    v = manipulation.concat([cache.v, v], axis=1)
+                    cache = self.Cache(k, v)
 
         mask = _convert_attention_mask(attn_mask, None)
         out = F.scaled_dot_product_attention(

@@ -49,7 +49,11 @@ def mm(x, y, name=None):
 
 
 def bmm(x, y, name=None):
-    return dispatch.call("bmm", lambda a, b: jnp.matmul(a, b, precision=_precision()),
+    # read the flag OUTSIDE the lowering: a flag read inside would be
+    # baked into the eager-jit cache's compiled program and go stale
+    prec = _precision()
+    return dispatch.call("bmm",
+                         lambda a, b: jnp.matmul(a, b, precision=prec),
                          [_t(x), _t(y)])
 
 
@@ -71,16 +75,20 @@ def mv(x, vec, name=None):
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    prec = _precision()
     return dispatch.call("addmm",
-                         lambda i, a, b: beta * i + alpha * jnp.matmul(a, b, precision=_precision()),
+                         lambda i, a, b: beta * i + alpha * jnp.matmul(
+                             a, b, precision=prec),
                          [_t(input), _t(x), _t(y)])
 
 
 @register("einsum", category="linalg")
 def einsum(equation, *operands):
     ts = [_t(o) for o in operands]
+    prec = _precision()
     return dispatch.call("einsum",
-                         lambda *xs: jnp.einsum(equation, *xs, precision=_precision()), ts)
+                         lambda *xs: jnp.einsum(equation, *xs,
+                                                precision=prec), ts)
 
 
 def t(x, name=None):

@@ -472,7 +472,8 @@ def as_complex(x, name=None):
 
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     """im2col (reference phi unfold kernel)."""
-    from .nn_ops import _pair
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
     ks, st, pd, dl = _pair(kernel_sizes), _pair(strides), _pair(paddings), _pair(dilations)
     def f(a):
         n, c, h, w = a.shape

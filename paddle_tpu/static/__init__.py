@@ -38,7 +38,8 @@ from .program import (CompiledProgram, Executor, Program,  # noqa: E402
                       data, default_main_program,
                       default_startup_program, program_guard)
 from . import nn  # noqa: E402,F401
+from .nn import ExponentialMovingAverage  # noqa: E402,F401
 
 __all__ = ["InputSpec", "Program", "program_guard", "data", "Executor",
            "CompiledProgram", "default_main_program",
-           "default_startup_program", "nn"]
+           "default_startup_program", "nn", "ExponentialMovingAverage"]

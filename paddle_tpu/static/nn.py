@@ -59,4 +59,197 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     return layer(input)
 
 
-__all__ = ["fc", "embedding"]
+def _act(out, activation):
+    if activation is None:
+        return out
+    from ..nn import functional as F
+    fn = getattr(F, activation, None)
+    if fn is None:
+        raise ValueError(f"unsupported activation {activation!r}")
+    return fn(out)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCHW", name=None):
+    """reference static/nn/common.py conv2d:779 — build-time Conv2D whose
+    weights are captured by the enclosing Program."""
+    in_channels = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _nn.Conv2D(in_channels, num_filters, filter_size,
+                       stride=stride, padding=padding, dilation=dilation,
+                       groups=groups, weight_attr=param_attr,
+                       bias_attr=bias_attr, data_format=data_format)
+    return _act(layer(input), act)
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None):
+    """reference static/nn/common.py batch_norm:2616. ``is_test`` freezes
+    the running statistics (eval mode)."""
+    num_channels = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = _nn.BatchNorm(num_channels, momentum=momentum,
+                          epsilon=epsilon, weight_attr=param_attr,
+                          bias_attr=bias_attr, data_format=data_layout)
+    if is_test:
+        layer.eval()
+    return _act(layer(input), act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    """reference static/nn/common.py layer_norm:3555 — normalizes over
+    dims [begin_norm_axis:]."""
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = _nn.LayerNorm(shape, epsilon=epsilon,
+                          weight_attr=param_attr if scale else False,
+                          bias_attr=bias_attr if shift else False)
+    return _act(layer(input), act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    """reference static/nn/common.py instance_norm:271 (NCHW)."""
+    layer = _nn.InstanceNorm2D(int(input.shape[1]), epsilon=epsilon,
+                               weight_attr=param_attr, bias_attr=bias_attr)
+    return layer(input)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """reference static/nn/common.py spectral_norm:3417 — normalizes a
+    weight by its largest singular value (power iteration)."""
+    layer = _nn.SpectralNorm(list(weight.shape), dim=dim,
+                             power_iters=power_iters, epsilon=eps)
+    return layer(weight)
+
+
+def py_func(func, x, out, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """reference static/nn/common.py py_func:3118 — run host Python inside
+    the graph. TPU-native: ``jax.pure_callback`` (XLA host-callback op),
+    so the call survives jit/program capture. ``out`` is a template
+    Tensor (or list) carrying the result shapes/dtypes; ``backward_func``
+    receives ONLY the upstream output gradients (one per output, in
+    order) and returns one gradient per input — unlike the reference it
+    is NOT handed the forward inputs/outputs, so
+    ``skip_vars_in_backward_input`` has nothing to skip and is rejected
+    rather than silently ignored (close over forward values instead)."""
+    if skip_vars_in_backward_input is not None:
+        raise NotImplementedError(
+            "py_func: backward_func here receives only the upstream "
+            "output gradients; skip_vars_in_backward_input is not "
+            "applicable — close over any forward values you need")
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ..core import dispatch as _dispatch
+    from ..core.tensor import Tensor as _T
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [_T(v) if not isinstance(v, _T) else v for v in xs]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    specs = [jax.ShapeDtypeStruct(tuple(o.shape), _np.dtype(o.dtype))
+             for o in outs]
+    multi = isinstance(out, (list, tuple))
+
+    def _np_call(fn, templates, *arrays):
+        res = fn(*[_np.asarray(a) for a in arrays])
+        rs = res if isinstance(res, (list, tuple)) else [res]
+        return tuple(_np.asarray(r, dtype=t.dtype).reshape(t.shape)
+                     for r, t in zip(rs, templates))
+
+    def f(*arrays):
+        res = jax.pure_callback(
+            lambda *a: _np_call(func, specs, *a), tuple(specs), *arrays)
+        return list(res) if multi else res[0]
+
+    if backward_func is not None:
+        in_specs = [jax.ShapeDtypeStruct(tuple(v.shape),
+                                         _np.dtype(v.dtype)) for v in xs]
+        fwd = f
+
+        @jax.custom_vjp
+        def f(*arrays):
+            return fwd(*arrays)
+
+        def _fwd(*arrays):
+            return fwd(*arrays), arrays
+
+        def _bwd(arrays, cts):
+            ct_list = list(cts) if isinstance(cts, (tuple, list)) else [cts]
+            grads = jax.pure_callback(
+                lambda *a: _np_call(backward_func, in_specs, *a),
+                tuple(in_specs), *ct_list)
+            return tuple(jnp.asarray(g) for g in grads)
+
+        f.defvjp(_fwd, _bwd)
+
+    return _dispatch.call("py_func", f, xs, multi_output=multi)
+
+
+class ExponentialMovingAverage:
+    """reference static/nn/common.py:4040 ExponentialMovingAverage.
+
+    Tracks shadow (EMA) copies of trainable parameters:
+    ``shadow = decay * shadow + (1 - decay) * param`` on every
+    ``update()``; ``apply()`` swaps the shadows in for evaluation (as a
+    context manager it restores on exit; ``restore()`` does it
+    explicitly). ``thres_steps`` enables the reference's ramped decay
+    ``min(decay, (1 + t) / (10 + t))``.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "ExponentialMovingAverage needs the parameter list "
+                "(dygraph-first design: there is no global program to "
+                "collect them from)")
+        self._decay = float(decay)
+        self._thres_steps = thres_steps
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self._shadow = [p._data for p in self._params]
+        self._backup = None
+        self._step = 0
+
+    def update(self):
+        import jax.numpy as jnp
+        self._step += 1
+        d = self._decay
+        if self._thres_steps is not None:
+            d = min(d, (1.0 + self._step) / (10.0 + self._step))
+        self._shadow = [
+            (d * s + (1.0 - d) * p._data).astype(p._data.dtype)
+            for s, p in zip(self._shadow, self._params)]
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        self._backup = [p._data for p in self._params]
+        for p, s in zip(self._params, self._shadow):
+            p._swap_payload(s)
+
+        ema = self
+
+        @contextlib.contextmanager
+        def ctx():
+            try:
+                yield ema
+            finally:
+                if need_restore:
+                    ema.restore()
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._swap_payload(b)
+        self._backup = None
+
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm",
+           "instance_norm", "spectral_norm", "py_func",
+           "ExponentialMovingAverage"]

@@ -31,7 +31,11 @@ def check_grad(fn, inputs, attrs=None, grad_input_idx=None,
     """Compare analytic grads (backward through the tape) vs central finite
     differences on a scalar sum-of-outputs loss."""
     attrs = attrs or {}
-    inputs = [np.asarray(x, dtype=np.float64).astype(np.float32) for x in inputs]
+    # float inputs are canonicalized to f32 for the FD math; integer/bool
+    # inputs (indices, masks) must keep their dtype
+    inputs = [np.asarray(x).astype(np.float32)
+              if np.issubdtype(np.asarray(x).dtype, np.floating)
+              else np.asarray(x) for x in inputs]
     idxs = grad_input_idx if grad_input_idx is not None else range(len(inputs))
 
     def loss_np(arrs):

@@ -262,3 +262,67 @@ class TestHetero:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=1e-5)
+
+
+class TestHeteroBf16Skewed:
+    """Round-4: per-stage dtype preservation — an all-bf16 skewed model
+    (fat embedding-like stage + thin blocks) rides bf16 buffers (half the
+    per-rank param HBM and ring bandwidth of the old forced-fp32 packing)
+    and still matches the sequential reference."""
+
+    def test_buffer_dtype_selection(self):
+        from paddle_tpu.distributed.fleet.meta_parallel. \
+            pipeline_schedules import _buffer_dtype
+        assert _buffer_dtype([jnp.bfloat16, jnp.bfloat16]) == jnp.bfloat16
+        assert _buffer_dtype([jnp.float16]) == jnp.float16
+        assert _buffer_dtype([jnp.bfloat16, jnp.float32]) == jnp.float32
+        assert _buffer_dtype([jnp.bfloat16, jnp.int32]) == jnp.float32
+        assert _buffer_dtype([jnp.float32]) == jnp.float32
+
+    def test_skewed_bf16_stages_roundtrip(self, pp_mesh):
+        # stage 0 is a fat embedding-style stage (64x16), stages 1-3 are
+        # thin 16x16 blocks — Pmax tracks the fat stage; all bf16
+        rng = np.random.RandomState(8)
+        m, B, V, H = 4, 2, 64, 16
+        fat = jnp.asarray(rng.randn(V, H).astype(np.float32) * 0.2
+                          ).astype(jnp.bfloat16)
+        thin = [jnp.asarray(rng.randn(H, H).astype(np.float32) * 0.2
+                            ).astype(jnp.bfloat16) for _ in range(3)]
+        xs = jnp.asarray(
+            rng.randint(0, V, (m, B)).astype(np.int32))
+
+        def embed_stage(params, x):
+            (w,) = params
+            return jnp.take(w, x.astype(jnp.int32), axis=0)
+
+        def block_stage(params, x):
+            (w,) = params
+            return jnp.tanh(x @ w)
+
+        stage_fns = [embed_stage] + [block_stage] * 3
+        stage_params = [[fat]] + [[w] for w in thin]
+        in_avals = [jax.ShapeDtypeStruct((B,), jnp.int32)] + \
+            [jax.ShapeDtypeStruct((B, H), jnp.bfloat16)] * 3
+        out_aval = jax.ShapeDtypeStruct((B, H), jnp.bfloat16)
+
+        got = jax.jit(lambda xs: spmd_pipeline_hetero(
+            stage_fns, stage_params, xs, mesh=pp_mesh, num_stages=4,
+            out_aval=out_aval, stage_in_avals=in_avals))(xs)
+        assert got.dtype == jnp.bfloat16
+
+        h = jnp.take(fat, xs.reshape(-1), axis=0)
+        for w in thin:
+            h = jnp.tanh(h @ w)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32).reshape(-1, H),
+            np.asarray(h, np.float32), atol=1e-2)
+
+    def test_param_buffer_is_bf16_for_bf16_model(self, pp_mesh):
+        # the packed per-rank param buffer must cost 2 B/element, not 4
+        from paddle_tpu.distributed.fleet.meta_parallel import \
+            pipeline_schedules as PS
+        dts = [jnp.bfloat16] * 4
+        assert PS._buffer_dtype(dts) == jnp.bfloat16
+        flat = PS._flatten_pack(
+            [jnp.ones((8, 8), jnp.bfloat16)], 100, jnp.bfloat16)
+        assert flat.dtype == jnp.bfloat16 and flat.nbytes == 200
