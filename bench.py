@@ -410,9 +410,14 @@ def _bench_pipeline(small):
     import subprocess
     import sys
 
+    if os.environ.get("BENCH_PIPE_CHILD") == "1":
+        # the child runs on a virtual CPU mesh, which would flip main()'s
+        # small-detection — honor the parent's choice instead
+        small = os.environ.get("BENCH_PIPE_SMALL") == "1"
     if jax.device_count() < 4 and os.environ.get("BENCH_PIPE_CHILD") != "1":
         env = dict(os.environ)
         env.update(BENCH_PIPE_CHILD="1", BENCH_MODEL="pipeline",
+                   BENCH_PIPE_SMALL="1" if small else "0",
                    JAX_PLATFORMS="cpu")
         flags = [f for f in env.get("XLA_FLAGS", "").split()
                  if not f.startswith("--xla_force_host_platform")]
@@ -435,7 +440,8 @@ def _bench_pipeline(small):
 
     mesh_mod.set_mesh(mesh_mod.build_mesh({"pp": 4},
                                           devices=jax.devices()[:4]))
-    d = 192 if small else 768
+    d = _env_int("BENCH_PIPE_HIDDEN", 192)
+    mb_rows = _env_int("BENCH_PIPE_BATCH", 4 if small else 32)
     m = 8                      # micro-batches
 
     class _Blk(nn.Layer):
@@ -446,8 +452,10 @@ def _bench_pipeline(small):
         def forward(self, x):
             return paddle.ops.tanh(self.fc(x))
 
-    x = paddle.to_tensor(np.random.randn(m * 4, d).astype(np.float32))
-    y = paddle.to_tensor(np.random.randn(m * 4, d).astype(np.float32))
+    x = paddle.to_tensor(
+        np.random.randn(m * mb_rows, d).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.randn(m * mb_rows, d).astype(np.float32))
 
     def run_one(sched, L):
         paddle.seed(99)
@@ -483,7 +491,7 @@ def _bench_pipeline(small):
         "extra": {"step_ms": {k: round(v, 2) for k, v in times.items()},
                   "vpp_speedup": {k: round(v, 4)
                                   for k, v in speedups.items()},
-                  "m": m, "stages": 4, "hidden": d,
+                  "m": m, "stages": 4, "hidden": d, "micro_rows": mb_rows,
                   "host": jax.default_backend()},
     }
 
@@ -491,6 +499,11 @@ def _bench_pipeline(small):
 def main():
     if os.environ.get("BENCH_SMALL") == "1":
         # local testing: force the host platform before any backend init
+        jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("BENCH_PIPE_CHILD") == "1":
+        # the image's sitecustomize re-registers the TPU backend and
+        # overrides JAX_PLATFORMS, so the pipeline child's CPU-mesh
+        # switch must be programmatic (same dance as __graft_entry__)
         jax.config.update("jax_platforms", "cpu")
     on_tpu = jax.default_backend() in ("tpu", "axon")
     small = (not on_tpu) or os.environ.get("BENCH_SMALL") == "1"

@@ -345,6 +345,15 @@ def _closure_cache_key(f, depth: int = 3):
         if k is None and d is not None:
             return None
         parts.append(k)
+    # keyword-only defaults carry real state: the AMP wrapper binds the
+    # true lowering as __inner=... — missing these would key every
+    # AMP-wrapped op of a name to one compiled program
+    kwd = getattr(f, "__kwdefaults__", None) or {}
+    for kname in sorted(kwd):
+        k = _const_key(kwd[kname], depth - 1)
+        if k is None and kwd[kname] is not None:
+            return None
+        parts.append((kname, k))
     return tuple(parts)
 
 

@@ -418,15 +418,6 @@ class PagedEngine:
             self.last_token[slot] = tok
             self._maybe_finish(slot)
 
-    def _prefill(self, slot: int, req: Request):
-        """Single-request prefill (kept for API continuity; admission now
-        batches same-tick prefills through _prefill_batch). Unlike
-        _prefill_batch — whose only caller _admit allocates at admission
-        — this entry point still owns its block allocation."""
-        if not self._ensure_blocks(slot,
-                                   len(req.prompt) + len(req.generated)):
-            raise MemoryError("admission raced cache exhaustion")
-        self._prefill_batch([slot])
 
     def _evict(self, slot: int):
         """Preempt a running request: release its blocks and requeue it

@@ -227,6 +227,12 @@ class ExponentialMovingAverage:
     def apply(self, executor=None, need_restore=True):
         import contextlib
 
+        if self._backup is not None:
+            raise RuntimeError(
+                "ExponentialMovingAverage.apply() called while shadows "
+                "are already applied — a second backup would capture the "
+                "shadow values and lose the training weights; call "
+                "restore() first")
         self._backup = [p._data for p in self._params]
         for p, s in zip(self._params, self._shadow):
             p._swap_payload(s)

@@ -630,6 +630,10 @@ def test_registry_fully_classified():
     assert not unclassified, (
         f"{len(unclassified)} registry ops lack a sweep recipe or a "
         f"skip reason: {unclassified}")
+    # and no recipe/skip entry names a non-existent op (a typo would
+    # silently test nothing)
+    phantom = sorted((set(R) | set(SKIP)) - set(OPS))
+    assert not phantom, f"recipes/skips for unknown ops: {phantom}"
     # and the partition is meaningful: the large majority is swept
     assert len(ALL_SWEPT) >= 300, (len(ALL_SWEPT), len(OPS))
 
