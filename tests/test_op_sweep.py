@@ -615,6 +615,12 @@ def _leaves(out):
     return []
 
 
+#: the registry as it stands at import (collection) time — ops that
+#: OTHER tests register at runtime (custom-op suites exercising the
+#: registration API) are not part of the framework surface this sweep
+#: pins, and their presence must not depend on test execution order
+_BASELINE_OPS = set(OPS)
+
 ALL_SWEPT = sorted(set(R) & set(OPS))
 
 
@@ -623,9 +629,10 @@ def test_registry_fully_classified():
     an unclassified new op fails the suite. Ops registered at RUNTIME by
     other tests (custom-op tests register from test modules) are out of
     scope — only the framework's own surface is pinned."""
-    framework = {n for n, d in OPS.items()
-                 if getattr(d.lowering, "__module__", "").startswith(
-                     "paddle_tpu")}
+    framework = {n for n in _BASELINE_OPS
+                 if getattr(OPS.get(n), "lowering", None) is not None
+                 and getattr(OPS[n].lowering, "__module__",
+                             "").startswith("paddle_tpu")}
     unclassified = sorted(framework - set(R) - set(SKIP))
     assert not unclassified, (
         f"{len(unclassified)} registry ops lack a sweep recipe or a "
