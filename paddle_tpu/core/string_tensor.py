@@ -1,0 +1,261 @@
+"""StringTensor: host-resident tensor of variable-length strings.
+
+Reference contract: ``paddle/phi/core/string_tensor.h`` (StringTensor over
+``pstring`` elements with dense-tensor-like meta) and the string kernel set
+``paddle/phi/kernels/strings/`` (``strings_empty_kernel.h``,
+``strings_copy_kernel.h``, ``strings_lower_upper_kernel.h`` with the
+ASCII/UTF-8 converter pair in ``case_utils.h``).
+
+TPU-first design: there is no string compute on the MXU, and XLA has no
+string dtype — the reference itself pins StringTensor to CPU pinned memory
+even in GPU builds. So the TPU-native design keeps string data on the host
+in a numpy object array (ragged byte strings need pointer storage exactly
+like the reference's ``pstring*`` buffers), gives it the same tensor-shaped
+meta/indexing surface, and crosses to device tensors only through consumers
+that produce numeric data (FasterTokenizer → int32 ids).
+
+Case-conversion semantics follow the reference kernels precisely:
+
+* ASCII mode (``use_utf8_encoding=False``): a per-byte map touching only
+  ``A-Z``/``a-z`` (``case_utils.h`` ``AsciiToLower``/``AsciiToUpper``);
+  non-ASCII bytes pass through untouched.
+* UTF-8 mode: a per-codepoint 1:1 case map over the BMP (the reference's
+  ``cases_map`` is a ``uint16`` table filled from utf8proc, so multi-char
+  expansions and astral-plane mappings are out of scope there too).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = [
+    "StringTensor", "to_string_tensor", "empty", "empty_like", "copy",
+    "lower", "upper",
+]
+
+
+def _ascii_lower(s: str) -> str:
+    # byte-level A-Z map, identical to AsciiToLower over the utf8 buffer
+    return s.translate(_ASCII_LOWER_TABLE)
+
+
+def _ascii_upper(s: str) -> str:
+    return s.translate(_ASCII_UPPER_TABLE)
+
+
+_ASCII_LOWER_TABLE = {c: c + 32 for c in range(ord("A"), ord("Z") + 1)}
+_ASCII_UPPER_TABLE = {c: c - 32 for c in range(ord("a"), ord("z") + 1)}
+
+
+def _utf8_map_char(ch: str, to_lower: bool) -> str:
+    # 1:1 BMP case map: the reference's cases_map is uint16-valued and only
+    # consulted for codepoints <= 0xFFFF whose unicode flag marks them as
+    # cased; anything else passes through unchanged.
+    if ord(ch) > 0xFFFF:
+        return ch
+    mapped = ch.lower() if to_lower else ch.upper()
+    if len(mapped) == 1 and ord(mapped) <= 0xFFFF:
+        return mapped
+    return ch  # multi-char expansion (e.g. ß→SS) doesn't fit a 1:1 map
+
+
+def _utf8_lower(s: str) -> str:
+    return "".join(_utf8_map_char(c, True) for c in s)
+
+
+def _utf8_upper(s: str) -> str:
+    return "".join(_utf8_map_char(c, False) for c in s)
+
+
+class StringTensor:
+    """Dense tensor of python strings with dense-tensor meta.
+
+    Mirrors the reference container surface (shape/numel/dims, shallow
+    copy-on-assign, ``data()`` access) without pretending strings can live
+    on the TPU.
+    """
+
+    def __init__(self, data=None, shape: Sequence[int] = None):
+        if data is None:
+            shape = tuple(shape) if shape is not None else (0,)
+            arr = np.empty(shape, dtype=object)
+            arr.fill("")
+        else:
+            arr = _as_object_array(data)
+            if shape is not None:
+                arr = arr.reshape(tuple(shape))
+        self._data = arr
+
+    # ------------------------------------------------------------- meta
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def dims(self) -> List[int]:
+        return self.shape
+
+    @property
+    def place(self) -> str:
+        return "cpu"  # reference pins string data to (pinned) host memory
+
+    def initialized(self) -> bool:
+        return all(v is not None for v in self._data.flat)
+
+    # ------------------------------------------------------------- data
+    def numpy(self) -> np.ndarray:
+        return self._data.copy()
+
+    def data(self) -> np.ndarray:
+        """The live buffer (reference ``StringTensor::data()``)."""
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    # ------------------------------------------------------ tensor-like
+    def reshape(self, shape: Sequence[int]) -> "StringTensor":
+        out = StringTensor.__new__(StringTensor)
+        out._data = self._data.reshape(tuple(shape))
+        return out
+
+    def __getitem__(self, idx):
+        sub = self._data[idx]
+        if isinstance(sub, np.ndarray):
+            out = StringTensor.__new__(StringTensor)
+            out._data = sub
+            return out
+        return sub
+
+    def __setitem__(self, idx, value):
+        if isinstance(value, StringTensor):
+            value = value._data
+        self._data[idx] = value
+
+    def __len__(self) -> int:
+        if not self._data.ndim:
+            raise TypeError("len() of a 0-d StringTensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        if not self._data.ndim:
+            raise TypeError("iteration over a 0-d StringTensor")
+        for i in range(len(self)):
+            yield self[i]
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, StringTensor):
+            return (self._data.shape == other._data.shape
+                    and bool((self._data == other._data).all()))
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return (f"StringTensor(shape={self.shape}, "
+                f"data={self._data.tolist()!r})")
+
+    # ---------------------------------------------------------- kernels
+    def lower(self, use_utf8_encoding: bool = False) -> "StringTensor":
+        return lower(self, use_utf8_encoding)
+
+    def upper(self, use_utf8_encoding: bool = False) -> "StringTensor":
+        return upper(self, use_utf8_encoding)
+
+    def copy_(self, src: "StringTensor") -> "StringTensor":
+        """In-place copy (reference ``strings_copy_kernel``)."""
+        if tuple(src._data.shape) != tuple(self._data.shape):
+            self._data = src._data.copy()
+        else:
+            np.copyto(self._data, src._data)
+        return self
+
+
+def _as_object_array(data) -> np.ndarray:
+    if isinstance(data, StringTensor):
+        return data._data.copy()
+    if isinstance(data, np.ndarray):
+        return data.astype(object)
+    if isinstance(data, (str, bytes)):
+        arr = np.empty((), dtype=object)
+        arr[()] = data if isinstance(data, str) else data.decode("utf-8")
+        return arr.reshape(())
+
+    # nested lists: determine rectangular shape, matching dense meta
+    def build(d) -> Tuple[Tuple[int, ...], list]:
+        if isinstance(d, (str, bytes)):
+            return (), d if isinstance(d, str) else d.decode("utf-8")
+        if isinstance(d, Iterable):
+            items = [build(x) for x in d]
+            if not items:
+                return (0,), []
+            shapes = {s for s, _ in items}
+            if len(shapes) != 1:
+                raise ValueError(
+                    f"ragged string nest: sub-shapes {sorted(shapes)}")
+            (sub,) = shapes
+            return (len(items),) + sub, [v for _, v in items]
+        raise TypeError(f"cannot build StringTensor from {type(d)}")
+
+    shape, nested = build(data)
+    arr = np.empty(shape, dtype=object)
+    flat = arr.reshape(-1)
+
+    def fill(n, off):
+        if isinstance(n, list):
+            for item in n:
+                off = fill(item, off)
+            return off
+        flat[off] = n
+        return off + 1
+
+    fill(nested, 0)
+    return arr
+
+
+# ------------------------------------------------------------------ ops
+def to_string_tensor(data) -> StringTensor:
+    """Build a StringTensor from str / bytes / (nested) lists / ndarray."""
+    return StringTensor(data)
+
+
+def empty(shape: Sequence[int]) -> StringTensor:
+    """All-empty-string tensor (``strings_empty_kernel``)."""
+    return StringTensor(shape=shape)
+
+
+def empty_like(x: StringTensor) -> StringTensor:
+    return StringTensor(shape=x.shape)
+
+
+def copy(x: StringTensor) -> StringTensor:
+    out = StringTensor.__new__(StringTensor)
+    out._data = x._data.copy()
+    return out
+
+
+def _case_kernel(x: StringTensor, fn) -> StringTensor:
+    out = StringTensor.__new__(StringTensor)
+    if x._data.size:
+        vec = np.frompyfunc(fn, 1, 1)
+        # frompyfunc collapses 0-d input to a bare str — re-box it
+        out._data = np.asarray(vec(x._data), dtype=object).reshape(
+            x._data.shape)
+    else:
+        out._data = x._data.copy()
+    return out
+
+
+def lower(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """``strings_lower``: ASCII byte map or 1:1 BMP codepoint map."""
+    return _case_kernel(x, _utf8_lower if use_utf8_encoding else _ascii_lower)
+
+
+def upper(x: StringTensor, use_utf8_encoding: bool = False) -> StringTensor:
+    """``strings_upper``: ASCII byte map or 1:1 BMP codepoint map."""
+    return _case_kernel(x, _utf8_upper if use_utf8_encoding else _ascii_upper)

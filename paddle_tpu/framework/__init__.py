@@ -18,3 +18,4 @@ def in_pir_mode():
 def use_pir_api():
     return False
 from .tensor_types import SelectedRows, TensorArray
+from ..core.string_tensor import StringTensor, to_string_tensor

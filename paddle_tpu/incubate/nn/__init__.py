@@ -6,8 +6,10 @@ from .layers import (FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
                      FusedFeedForward, FusedLinear,
                      FusedMultiHeadAttention,
                      FusedTransformerEncoderLayer)
+from .faster_tokenizer import (BertTokenizer, FasterTokenizer, load_vocab)
 
 __all__ = ["MoELayer", "TopKGate", "functional", "FusedLinear",
            "FusedDropoutAdd", "FusedBiasDropoutResidualLayerNorm",
            "FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer", "FasterTokenizer",
+           "BertTokenizer", "load_vocab"]

@@ -1,0 +1,305 @@
+"""FasterTokenizer: in-framework BERT tokenization over StringTensor.
+
+Reference contract: ``paddle/fluid/operators/string/faster_tokenizer_op.h``
+(BasicTokenizer / WordPieceTokenizer / BertTokenizer and the
+``faster_tokenizer`` op: Text [+ TextPair] + Vocab → InputIds, SegmentIds)
+and ``faster_tokenizer_op.cc`` for the exact character-class and wordpiece
+semantics.
+
+TPU-first design: tokenization is host work — the reference also runs it on
+CPU inside the op. Here the tokenizer consumes a host ``StringTensor`` (or
+plain python strings) and emits device int32 id tensors, the natural handoff
+point to XLA (int32 over int64: TPU-native index dtype; ids are vocab-sized
+so int32 is lossless).
+
+Character classes mirror ``faster_tokenizer_op.cc``:
+* control: U+0000/U+FFFD dropped; ``Cc``/``Cf`` dropped except tab/LF/CR
+  (``IsControl``, :43)
+* whitespace: space/tab/LF/CR or category ``Zs`` (``IsWhiteSpace``, :59)
+* punctuation: ASCII punct blocks or any ``P*`` category
+  (``IsPunctuation``, :70)
+* CJK: the ideograph ranges of ``IsChineseChar`` (:50), always split as
+  single-char tokens and looked up whole (BertTokenizer::Tokenize :219)
+* lowercase: 1:1 per-codepoint ``utf8proc_tolower`` (:82)
+"""
+from __future__ import annotations
+
+import unicodedata
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ...core.string_tensor import StringTensor
+
+__all__ = ["BasicTokenizer", "WordPieceTokenizer", "BertTokenizer",
+           "FasterTokenizer", "load_vocab"]
+
+Vocab = Dict[str, int]
+
+
+def load_vocab(path: str) -> Vocab:
+    """Load a BERT ``vocab.txt`` (one token per line, id = line number)."""
+    vocab: Vocab = {}
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f):
+            tok = line.rstrip("\n")
+            if tok:
+                vocab[tok] = i
+    return vocab
+
+
+def _is_control(ch: str) -> bool:
+    if ch in ("\t", "\n", "\r"):
+        return False
+    return unicodedata.category(ch) in ("Cc", "Cf")
+
+
+def _is_whitespace(ch: str) -> bool:
+    if ch in (" ", "\t", "\n", "\r"):
+        return True
+    return unicodedata.category(ch) == "Zs"
+
+
+def _is_punctuation(ch: str) -> bool:
+    cp = ord(ch)
+    if (33 <= cp <= 47 or 58 <= cp <= 64 or 91 <= cp <= 96
+            or 123 <= cp <= 126):
+        return True
+    return unicodedata.category(ch).startswith("P")
+
+
+def _is_chinese_char(ch: str) -> bool:
+    cp = ord(ch)
+    return ((0x4E00 <= cp <= 0x9FFF) or (0x3400 <= cp <= 0x4DBF)
+            or (0x20000 <= cp <= 0x2A6DF) or (0x2A700 <= cp <= 0x2B73F)
+            or (0x2B740 <= cp <= 0x2B81F) or (0x2B820 <= cp <= 0x2CEAF)
+            or (0xF900 <= cp <= 0xFAFF) or (0x2F800 <= cp <= 0x2FA1F))
+
+
+def _char_lower(ch: str) -> str:
+    # utf8proc_tolower is a 1:1 codepoint map; keep multi-char expansions out
+    low = ch.lower()
+    return low if len(low) == 1 else ch
+
+
+class BasicTokenizer:
+    """Whitespace/punct/CJK splitter (reference BasicTokenizer::Tokenize)."""
+
+    def __init__(self, do_lower_case: bool = True):
+        self.do_lower_case = do_lower_case
+
+    def tokenize(self, text: str) -> List[str]:
+        tokens: List[str] = []
+        cache: List[str] = []
+
+        def flush():
+            if cache:
+                tokens.append("".join(cache))
+                cache.clear()
+
+        for ch in text:
+            if ch == "\x00" or ch == "�" or _is_control(ch):
+                continue
+            if self.do_lower_case:
+                ch = _char_lower(ch)
+            if _is_chinese_char(ch) or _is_punctuation(ch):
+                flush()
+                tokens.append(ch)
+            elif _is_whitespace(ch):
+                flush()
+            else:
+                cache.append(ch)
+        flush()
+        return tokens
+
+
+class WordPieceTokenizer:
+    """Greedy longest-match-first subword splitter, ``##`` continuations."""
+
+    def __init__(self, vocab: Vocab, unk_token: str = "[UNK]",
+                 max_input_chars_per_word: int = 100):
+        self.vocab = vocab
+        self.unk_token_id = vocab[unk_token]
+        self.max_input_chars_per_word = max_input_chars_per_word
+
+    def tokenize(self, word: str) -> List[int]:
+        n = len(word)
+        if n > self.max_input_chars_per_word:
+            return [self.unk_token_id]
+        whole = self.vocab.get(word)
+        if whole is not None:
+            return [whole]
+        ids: List[int] = []
+        start = 0
+        while start < n:
+            end = n
+            hit = None
+            while start < end:
+                sub = word[start:end]
+                if start > 0:
+                    sub = "##" + sub
+                hit = self.vocab.get(sub)
+                if hit is not None:
+                    break
+                end -= 1
+            if hit is None:
+                return [self.unk_token_id]  # whole word → UNK, not partial
+            ids.append(hit)
+            start = end
+        return ids
+
+
+class BertTokenizer:
+    """Full encode pipeline (reference BertTokenizer)."""
+
+    def __init__(self, vocab: Vocab, do_lower_case: bool = False,
+                 unk_token: str = "[UNK]", pad_token: str = "[PAD]",
+                 cls_token: str = "[CLS]", mask_token: str = "[MASK]",
+                 sep_token: str = "[SEP]"):
+        self.vocab = vocab
+        self.basic = BasicTokenizer(do_lower_case)
+        self.wordpiece = WordPieceTokenizer(vocab, unk_token)
+        self.unk_token_id = vocab[unk_token]
+        self.pad_token_id = vocab[pad_token]
+        self.cls_token_id = vocab[cls_token]
+        self.sep_token_id = vocab[sep_token]
+        self.mask_token_id = vocab.get(mask_token)
+
+    def tokenize(self, text: str) -> List[int]:
+        ids: List[int] = []
+        for tok in self.basic.tokenize(text):
+            if len(tok) == 1 and _is_chinese_char(tok):
+                ids.append(self.vocab.get(tok, self.unk_token_id))
+            else:
+                ids.extend(self.wordpiece.tokenize(tok))
+        return ids
+
+    def num_special_tokens_to_add(self, pair: bool = False) -> int:
+        return 3 if pair else 2  # [CLS] a [SEP] (b [SEP])
+
+    def build_inputs_with_special_tokens(
+            self, ids: List[int],
+            pair_ids: Optional[List[int]] = None) -> List[int]:
+        out = [self.cls_token_id] + ids + [self.sep_token_id]
+        if pair_ids:
+            out += pair_ids + [self.sep_token_id]
+        return out
+
+    def create_token_type_ids(self, ids: List[int],
+                              pair_ids: Optional[List[int]] = None
+                              ) -> List[int]:
+        tt = [0] * (len(ids) + 2)
+        if pair_ids:
+            tt += [1] * (len(pair_ids) + 1)
+        return tt
+
+    def truncate_sequence(self, ids: List[int], pair_ids: List[int],
+                          num_tokens_to_remove: int = 0) -> None:
+        # longest-first, one token at a time (reference TruncateSequence)
+        for _ in range(num_tokens_to_remove):
+            if not ids and not pair_ids:
+                return  # nothing left; encode's length check rejects below
+            if not pair_ids or len(ids) > len(pair_ids):
+                ids.pop()
+            else:
+                pair_ids.pop()
+
+    def encode(self, text: str, text_pair: str = "",
+               is_split_into_words: bool = False, max_seq_len: int = 0,
+               pad_to_max_seq_len: bool = False
+               ) -> Optional[Dict[str, List[int]]]:
+        if not is_split_into_words:
+            ids = self.tokenize(text)
+            if not ids:
+                return None
+            pair_ids = self.tokenize(text_pair) if text_pair else []
+            if text_pair and not pair_ids:
+                return None
+        else:
+            # char-per-token mode: each codepoint looked up directly
+            ids = [self.vocab.get(c, self.unk_token_id) for c in text]
+            pair_ids = []
+
+        total = (len(ids) + len(pair_ids)
+                 + self.num_special_tokens_to_add(bool(pair_ids)))
+        if max_seq_len and total > max_seq_len:
+            self.truncate_sequence(ids, pair_ids, total - max_seq_len)
+
+        input_ids = self.build_inputs_with_special_tokens(ids, pair_ids)
+        token_type_ids = self.create_token_type_ids(ids, pair_ids)
+        if max_seq_len and len(input_ids) > max_seq_len:
+            return None
+        if pad_to_max_seq_len and max_seq_len and len(input_ids) < max_seq_len:
+            # right-pad both streams with pad_token_id (reference Encode)
+            pad = max_seq_len - len(input_ids)
+            input_ids += [self.pad_token_id] * pad
+            token_type_ids += [self.pad_token_id] * pad
+        return {"input_ids": input_ids, "token_type_ids": token_type_ids}
+
+    def batch_encode(self, texts: Sequence[str],
+                     text_pairs: Optional[Sequence[str]] = None,
+                     is_split_into_words: bool = False,
+                     max_seq_len: int = 0,
+                     pad_to_max_seq_len: bool = False
+                     ) -> List[Dict[str, List[int]]]:
+        if text_pairs is not None and len(text_pairs) != len(texts):
+            raise ValueError(
+                f"text ({len(texts)}) and text_pair ({len(text_pairs)}) "
+                "must have the same number of sequences")
+        out = []
+        for i, t in enumerate(texts):
+            enc = self.encode(
+                t, text_pairs[i] if text_pairs is not None else "",
+                is_split_into_words, max_seq_len, pad_to_max_seq_len)
+            out.append(enc or {"input_ids": [], "token_type_ids": []})
+        return out
+
+
+class FasterTokenizer:
+    """The ``faster_tokenizer`` op as a host layer: strings in, ids out.
+
+    forward(text[, text_pair]) → (input_ids, token_type_ids) as device
+    int32 tensors, batch right-padded to the batch max length with the pad
+    token id (reference FasterTokenizerKernel::Compute).
+    """
+
+    def __init__(self, vocab: Union[Vocab, str], do_lower_case: bool = False,
+                 is_split_into_words: bool = False, max_seq_len: int = 0,
+                 pad_to_max_seq_len: bool = False):
+        if isinstance(vocab, str):
+            vocab = load_vocab(vocab)
+        self.tokenizer = BertTokenizer(vocab, do_lower_case)
+        self.is_split_into_words = is_split_into_words
+        self.max_seq_len = max_seq_len
+        self.pad_to_max_seq_len = pad_to_max_seq_len
+
+    @staticmethod
+    def _as_texts(x) -> List[str]:
+        if x is None:
+            return None
+        if isinstance(x, StringTensor):
+            return [str(s) for s in x.reshape([-1]).tolist()]
+        if isinstance(x, str):
+            return [x]
+        return [str(s) for s in x]
+
+    def forward(self, text, text_pair=None):
+        from ... import to_tensor
+
+        texts = self._as_texts(text)
+        pairs = self._as_texts(text_pair)
+        encoded = self.tokenizer.batch_encode(
+            texts, pairs, self.is_split_into_words, self.max_seq_len,
+            self.pad_to_max_seq_len)
+        pad_id = self.tokenizer.pad_token_id
+        batch_max = max((len(e["input_ids"]) for e in encoded), default=0)
+        n = len(encoded)
+        input_ids = np.full((n, batch_max), pad_id, dtype=np.int32)
+        token_type_ids = np.full((n, batch_max), pad_id, dtype=np.int32)
+        for i, e in enumerate(encoded):
+            L = len(e["input_ids"])
+            input_ids[i, :L] = e["input_ids"]
+            token_type_ids[i, :L] = e["token_type_ids"]
+        return to_tensor(input_ids), to_tensor(token_type_ids)
+
+    __call__ = forward

@@ -1,0 +1,12 @@
+"""paddle_tpu.strings — the string kernel surface as a python namespace.
+
+Reference: ``paddle/phi/kernels/strings/`` exposes these kernels at the C++
+level (``strings_empty``, ``strings_copy``, ``strings_lower``,
+``strings_upper``); here they are host functions over
+:class:`~paddle_tpu.core.string_tensor.StringTensor`.
+"""
+from .core.string_tensor import (StringTensor, copy, empty, empty_like,
+                                 lower, to_string_tensor, upper)
+
+__all__ = ["StringTensor", "to_string_tensor", "empty", "empty_like",
+           "copy", "lower", "upper"]

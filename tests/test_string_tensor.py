@@ -1,0 +1,209 @@
+"""StringTensor + strings kernels + FasterTokenizer.
+
+Reference contracts: paddle/phi/core/string_tensor.h (container),
+paddle/phi/kernels/strings/ (empty/copy/lower/upper with ASCII vs UTF-8
+converters), paddle/fluid/operators/string/faster_tokenizer_op.{h,cc}
+(BasicTokenizer/WordPieceTokenizer/BertTokenizer and the batch op).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+from paddle_tpu.core.string_tensor import StringTensor
+from paddle_tpu.incubate.nn import BertTokenizer, FasterTokenizer
+
+
+# ------------------------------------------------------------- container
+def test_container_meta_and_indexing():
+    st = strings.to_string_tensor([["ab", "cd", "ef"], ["gh", "ij", "kl"]])
+    assert st.shape == [2, 3]
+    assert st.numel() == 6
+    assert st.ndim == 2
+    assert st[0, 1] == "cd"
+    row = st[1]
+    assert isinstance(row, StringTensor)
+    assert row.tolist() == ["gh", "ij", "kl"]
+    st[0, 0] = "zz"
+    assert st.tolist()[0][0] == "zz"
+    assert st.place == "cpu"  # strings live on host, like the reference
+
+
+def test_container_scalar_bytes_reshape():
+    st = strings.to_string_tensor("hello")
+    assert st.shape == []
+    assert st.numel() == 1
+    stb = strings.to_string_tensor([b"abc", "def"])
+    assert stb.tolist() == ["abc", "def"]
+    r = stb.reshape([2, 1])
+    assert r.shape == [2, 1]
+
+
+def test_scalar_tensor_edges():
+    st = strings.to_string_tensor("Hello")
+    # 0-d case kernels re-box the scalar
+    low = strings.lower(st)
+    assert low.shape == [] and low.tolist() == "hello"
+    # like/empty preserve the scalar shape (numel 1, not 0)
+    like = strings.empty_like(st)
+    assert like.shape == [] and like.numel() == 1
+    # len/iter reject 0-d, matching dense-tensor semantics
+    with pytest.raises(TypeError):
+        len(st)
+    with pytest.raises(TypeError):
+        list(st)
+
+
+def test_ragged_nest_rejected():
+    with pytest.raises(ValueError):
+        strings.to_string_tensor([["a", "b"], ["c"]])
+
+
+def test_framework_level_export():
+    assert paddle.framework.StringTensor is StringTensor
+    st = paddle.framework.to_string_tensor(["x"])
+    assert st.tolist() == ["x"]
+
+
+# --------------------------------------------------------------- kernels
+def test_empty_and_copy():
+    e = strings.empty([2, 2])
+    assert e.tolist() == [["", ""], ["", ""]]
+    src = strings.to_string_tensor(["a", "b"])
+    c = strings.copy(src)
+    src[0] = "changed"
+    assert c.tolist() == ["a", "b"]  # deep copy of the buffer
+    dst = strings.empty([2])
+    dst.copy_(src)
+    assert dst.tolist() == ["changed", "b"]
+    assert strings.empty_like(src).shape == src.shape
+
+
+def test_lower_upper_ascii_mode():
+    # ASCII mode touches only A-Z/a-z, exactly AsciiToLower/AsciiToUpper
+    st = strings.to_string_tensor(["Hello World!", "ÀBÇ déf", "MiXeD123"])
+    low = strings.lower(st)  # use_utf8_encoding=False
+    up = strings.upper(st)
+    # non-ASCII cased letters (À, Ç, é) pass through untouched in ascii mode
+    assert low.tolist() == ["hello world!", "ÀbÇ déf", "mixed123"]
+    assert up.tolist() == ["HELLO WORLD!", "ÀBÇ DéF", "MIXED123"]
+
+
+def test_lower_upper_utf8_mode():
+    st = strings.to_string_tensor(["Hello", "ÀBÇ", "ΣΟΦΌΣ", "straße"])
+    low = st.lower(use_utf8_encoding=True)
+    up = st.upper(use_utf8_encoding=True)
+    assert low.tolist() == ["hello", "àbç", "σοφόσ", "straße"]
+    # 1:1 map: ß→SS is a multi-char expansion, stays ß (uint16 cases_map)
+    assert up.tolist() == ["HELLO", "ÀBÇ", "ΣΟΦΌΣ", "STRAßE"]
+
+
+def test_case_kernels_preserve_shape_and_empty():
+    st = strings.to_string_tensor([["Aa", "Bb"], ["Cc", ""]])
+    low = strings.lower(st)
+    assert low.shape == [2, 2]
+    assert low.tolist() == [["aa", "bb"], ["cc", ""]]
+    assert strings.lower(strings.empty([0])).numel() == 0
+
+
+# ---------------------------------------------------------- tokenization
+VOCAB = {w: i for i, w in enumerate(
+    ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "[MASK]",
+     "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over",
+     "lazy", "dog", "un", "##aff", "##able", "!", ",", "你", "好"])}
+
+
+def test_basic_tokenizer_splits():
+    from paddle_tpu.incubate.nn.faster_tokenizer import BasicTokenizer
+    bt = BasicTokenizer(do_lower_case=True)
+    assert bt.tokenize("The Quick, brown FOX!") == [
+        "the", "quick", ",", "brown", "fox", "!"]
+    # CJK chars become single tokens; control chars dropped
+    assert bt.tokenize("你好\x00world") == ["你", "好", "world"]
+    assert bt.tokenize("  \t\n ") == []
+
+
+def test_wordpiece_greedy_longest_match():
+    from paddle_tpu.incubate.nn.faster_tokenizer import WordPieceTokenizer
+    wp = WordPieceTokenizer(VOCAB)
+    assert wp.tokenize("jumped") == [VOCAB["jump"], VOCAB["##ed"]]
+    assert wp.tokenize("unaffable") == [
+        VOCAB["un"], VOCAB["##aff"], VOCAB["##able"]]
+    # unknown mid-piece → whole word is UNK (reference: return after UNK)
+    assert wp.tokenize("jumpxq") == [VOCAB["[UNK]"]]
+    # over-long word → UNK
+    assert wp.tokenize("a" * 200) == [VOCAB["[UNK]"]]
+
+
+def test_bert_encode_pair_and_truncate():
+    tok = BertTokenizer(VOCAB, do_lower_case=True)
+    enc = tok.encode("the quick fox", "the lazy dog")
+    ids = enc["input_ids"]
+    assert ids[0] == VOCAB["[CLS]"]
+    assert ids.count(VOCAB["[SEP]"]) == 2
+    assert enc["token_type_ids"] == [0] * 5 + [1] * 4
+    # truncation: longest-first pops from the longer sequence
+    enc2 = tok.encode("the quick brown fox", "dog", max_seq_len=7)
+    assert len(enc2["input_ids"]) == 7
+    assert enc2["input_ids"][-1] == VOCAB["[SEP]"]
+    # pad_to_max right-pads with pad id
+    enc3 = tok.encode("fox", max_seq_len=8, pad_to_max_seq_len=True)
+    assert len(enc3["input_ids"]) == 8
+    assert enc3["input_ids"][-1] == VOCAB["[PAD]"]
+
+
+def test_encode_max_seq_len_smaller_than_specials():
+    tok = BertTokenizer(VOCAB, do_lower_case=True)
+    # truncation would need to remove more than the content tokens; must
+    # reject (None), not crash on an empty pop
+    assert tok.encode("fox", max_seq_len=1) is None
+    enc = tok.encode("quick brown fox", "lazy dog", max_seq_len=3)
+    assert enc is None or len(enc["input_ids"]) <= 3
+
+
+def test_faster_tokenizer_layer_batch():
+    ft = FasterTokenizer(VOCAB, do_lower_case=True)
+    st = strings.to_string_tensor(["the quick fox", "jumped over the lazy dog !"])
+    input_ids, token_type_ids = ft(st)
+    assert paddle.is_tensor(input_ids) and paddle.is_tensor(token_type_ids)
+    ids = np.asarray(input_ids.numpy())
+    assert ids.dtype == np.int32
+    assert ids.shape == token_type_ids.numpy().shape
+    # row 0 is shorter → right-padded with [PAD]
+    assert ids[0, -1] == VOCAB["[PAD]"]
+    assert ids[0, 0] == VOCAB["[CLS]"]
+    # row 1: jumped → jump ##ed
+    row1 = list(ids[1])
+    assert VOCAB["jump"] in row1 and VOCAB["##ed"] in row1
+
+
+def test_faster_tokenizer_pair_batch_mismatch():
+    ft = FasterTokenizer(VOCAB)
+    with pytest.raises(ValueError):
+        ft(["a", "b"], ["only-one"])
+
+
+def test_tokenizer_feeds_jitted_model():
+    """The handoff point: host StringTensor → device ids → jitted embed."""
+    import jax
+    import jax.numpy as jnp
+
+    ft = FasterTokenizer(VOCAB, do_lower_case=True)
+    input_ids, _ = ft(["the quick brown fox", "the lazy dog"])
+    table = jnp.arange(len(VOCAB) * 4, dtype=jnp.float32).reshape(-1, 4)
+
+    @jax.jit
+    def embed(ids):
+        return table[ids].sum(axis=1)
+
+    out = embed(input_ids._value if hasattr(input_ids, "_value")
+                else np.asarray(input_ids.numpy()))
+    assert out.shape == (2, 4)
+
+
+def test_load_vocab(tmp_path):
+    from paddle_tpu.incubate.nn import load_vocab
+    p = tmp_path / "vocab.txt"
+    p.write_text("[PAD]\n[UNK]\nhello\nworld\n", encoding="utf-8")
+    v = load_vocab(str(p))
+    assert v == {"[PAD]": 0, "[UNK]": 1, "hello": 2, "world": 3}
