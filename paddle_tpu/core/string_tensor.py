@@ -156,6 +156,10 @@ class StringTensor:
                     and bool((self._data == other._data).all()))
         return NotImplemented
 
+    # value-equality above is a whole-tensor convenience; hashing stays
+    # identity-based (a mutable buffer can't hash by value)
+    __hash__ = object.__hash__
+
     def __repr__(self) -> str:
         return (f"StringTensor(shape={self.shape}, "
                 f"data={self._data.tolist()!r})")
@@ -180,7 +184,15 @@ def _as_object_array(data) -> np.ndarray:
     if isinstance(data, StringTensor):
         return data._data.copy()
     if isinstance(data, np.ndarray):
-        return data.astype(object)
+        arr = data.astype(object)
+        # numpy byte-string arrays survive astype(object) as bytes —
+        # normalize to str like the scalar/nested paths do
+        decode = np.frompyfunc(
+            lambda v: v.decode("utf-8") if isinstance(v, bytes) else str(v),
+            1, 1)
+        if arr.size:
+            arr = np.asarray(decode(arr), dtype=object).reshape(arr.shape)
+        return arr
     if isinstance(data, (str, bytes)):
         arr = np.empty((), dtype=object)
         arr[()] = data if isinstance(data, str) else data.decode("utf-8")

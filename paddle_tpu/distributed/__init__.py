@@ -24,6 +24,7 @@ from . import sharding
 from . import checkpoint
 from . import auto_tuner
 from . import rpc
+from . import ps
 from .auto_parallel.engine import Engine
 from .checkpoint import load_state_dict, save_state_dict
 from .fleet.mpu.mp_ops import split

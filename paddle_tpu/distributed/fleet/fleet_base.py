@@ -35,6 +35,7 @@ class Fleet:
         self._is_initialized = False
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._strategy: Optional[DistributedStrategy] = None
+        self._role_maker = None  # PS mode only
 
     # ------------------------------------------------------------------ init
     def init(self, role_maker=None, is_collective=True, strategy=None,
@@ -42,9 +43,75 @@ class Fleet:
         if strategy is None:
             strategy = DistributedStrategy()
         self._strategy = strategy
-        self._init_hybrid_parallel_env(strategy)
+        if role_maker is None and not is_collective:
+            # reference contract: init(is_collective=False) with no role
+            # maker resolves roles from the PADDLE_* env
+            from ..ps import PaddleCloudRoleMaker
+            role_maker = PaddleCloudRoleMaker()
+        ps_mode = (role_maker is not None
+                   and not getattr(role_maker, "_is_collective", True))
+        if ps_mode:
+            # parameter-server mode (reference fleet.py: non-collective
+            # role makers route to the PS runtime, the_one_ps)
+            from ..ps import init_from_role
+            self._role_maker = role_maker
+            init_from_role(role_maker)
+            if role_maker._is_worker():
+                # dense params still train on-chip SPMD; build the mesh
+                self._init_hybrid_parallel_env(strategy)
+        else:
+            self._init_hybrid_parallel_env(strategy)
         self._is_initialized = True
         return self
+
+    # ------------------------------------------------------------- PS mode
+    def _in_ps_mode(self) -> bool:
+        return self._role_maker is not None
+
+    def is_server(self) -> bool:
+        return self._in_ps_mode() and self._role_maker._is_server()
+
+    def is_worker(self) -> bool:
+        if self._in_ps_mode():
+            return self._role_maker._is_worker()
+        return True
+
+    def server_index(self) -> int:
+        return self._role_maker._server_index() if self._in_ps_mode() else -1
+
+    def server_num(self) -> int:
+        return self._role_maker._server_num() if self._in_ps_mode() else 0
+
+    def init_server(self, dirname: Optional[str] = None):
+        """Create tables (and optionally load a snapshot) before serving
+        (reference fleet.init_server)."""
+        from ..ps import _current_server
+        srv = _current_server()
+        if dirname:
+            srv._op_load(dirname)
+        return srv
+
+    def run_server(self):
+        """Serve until a worker calls stop (blocks; reference
+        fleet.run_server)."""
+        from ..ps import _current_server
+        _current_server().run()
+
+    def init_worker(self):
+        from ..ps import _current_client
+        return _current_client()
+
+    def stop_worker(self):
+        """Last-worker shutdown: worker 0 stops the servers (reference
+        fleet.stop_worker semantics). No-op outside PS mode (reference
+        training scripts call it unconditionally)."""
+        if not self._in_ps_mode():
+            return
+        from ..ps import _current_client, _reset
+        if self._role_maker._is_first_worker():
+            _current_client().stop_servers()
+        _reset()
+        self._role_maker = None
 
     def _init_hybrid_parallel_env(self, strategy):
         """reference fleet.py:599 — build topology + per-axis groups; here:
@@ -91,15 +158,25 @@ class Fleet:
         return self._hcg
 
     def worker_num(self) -> int:
+        if self._in_ps_mode():
+            return self._role_maker._worker_num()
         return jax.process_count()
 
     def worker_index(self) -> int:
+        if self._in_ps_mode():
+            return self._role_maker._worker_index()
         return jax.process_index()
 
     def is_first_worker(self) -> bool:
+        if self._in_ps_mode():
+            return self._role_maker._is_first_worker()
         return jax.process_index() == 0
 
-    def barrier_worker(self):
+    def barrier_worker(self, key: str = "worker"):
+        if self._in_ps_mode():
+            from ..ps import _current_client
+            _current_client().barrier(key, self._role_maker._worker_num())
+            return
         # SPMD programs are globally ordered; an explicit barrier only
         # matters multi-host, where jax's collectives already fence.
         pass

@@ -1,0 +1,88 @@
+"""paddle_tpu.distributed.ps — parameter-server mode.
+
+Reference: ``python/paddle/distributed/ps/the_one_ps.py`` (TheOnePS
+runtime: sparse/dense tables + brpc server/client + fleet lifecycle) and
+``paddle/fluid/distributed/ps/`` (the C++ service).
+
+TPU-native scope: the PS tier holds host-resident sparse embedding
+tables — the part of the model that outgrows chip HBM — behind an
+authenticated HTTP service; dense parameters keep training on-chip via
+SPMD (the heter-PS split). Workers pull the batch's unique rows, compute
+on the TPU, and push row gradients; the table's accessor (sum / sgd /
+adam / adagrad) applies updates server-side.
+
+Lifecycle (reference fleet PS contract)::
+
+    fleet.init(PaddleCloudRoleMaker())     # roles from the PADDLE_* env
+    if fleet.is_server():
+        fleet.init_server(); fleet.run_server()      # blocks
+    else:
+        fleet.init_worker()
+        emb = DistributedEmbedding(table_id=0, embedding_dim=64)
+        ...train: forward pulls rows, backward pushes grads...
+        fleet.stop_worker()
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .client import PsClient
+from .embedding import DistributedEmbedding, sparse_embedding_lookup
+from .role_maker import PaddleCloudRoleMaker, Role, UserDefinedRoleMaker
+from .server import PsServer
+from .table import ACCESSORS, DenseTable, SparseTable, make_accessor
+
+__all__ = ["PsServer", "PsClient", "SparseTable", "DenseTable",
+           "make_accessor", "ACCESSORS", "DistributedEmbedding",
+           "sparse_embedding_lookup", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker", "Role", "init_from_role",
+           "current_context"]
+
+_CTX = {"role_maker": None, "client": None, "server": None}
+
+
+def init_from_role(role_maker) -> None:
+    """Bind this process to its PS role (called by ``fleet.init``)."""
+    token = os.getenv("PADDLE_PS_TOKEN", "")
+    _CTX["role_maker"] = role_maker
+    if role_maker._is_server():
+        me = role_maker._get_pserver_endpoints()[role_maker._server_index()]
+        port = int(me.rsplit(":", 1)[1])
+        _CTX["server"] = PsServer(
+            server_index=role_maker._server_index(),
+            num_servers=role_maker._server_num(), token=token, port=port)
+    else:
+        _CTX["client"] = PsClient(
+            role_maker._get_pserver_endpoints(), token=token)
+
+
+def current_context() -> dict:
+    return dict(_CTX)
+
+
+def _current_client() -> PsClient:
+    c = _CTX["client"]
+    if c is None:
+        raise RuntimeError(
+            "no PS client bound — call fleet.init(role_maker) in PS mode "
+            "(or pass client= explicitly)")
+    return c
+
+
+def _current_server() -> PsServer:
+    s = _CTX["server"]
+    if s is None:
+        raise RuntimeError("this process holds no PS server role")
+    return s
+
+
+def _reset() -> None:
+    if _CTX["client"] is not None:
+        _CTX["client"].close()
+    if _CTX["server"] is not None:
+        try:
+            _CTX["server"].stop()
+        except Exception:
+            pass
+    _CTX.update(role_maker=None, client=None, server=None)

@@ -1,0 +1,208 @@
+"""Parameter-server client: routing, batching, dedup.
+
+Reference contract: ``paddle/fluid/distributed/ps/service/brpc_ps_client.cc``
+(PullSparse/PushSparse route each key to ``hash(key) % server_num`` and fan
+requests out per server; dense params are split into even chunks over
+servers) — the worker-side half of the_one_ps.
+
+The client owns the id→server routing so tables shard identically no
+matter which worker touches them, accumulates duplicate-id gradients
+before pushing (sum semantics, matching the sparse-grad merge the
+reference does in the communicator), and fans per-server requests out on
+a thread pool.
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import pickle
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["PsClient"]
+
+
+class PsClient:
+    def __init__(self, endpoints: Sequence[str], token: str = "",
+                 timeout: float = 60.0, connect_window: float = 30.0):
+        if not endpoints:
+            raise ValueError("PsClient needs at least one server endpoint")
+        self.endpoints = [e if "://" not in e else e.split("://", 1)[1]
+                          for e in endpoints]
+        self.token = token
+        self.timeout = timeout
+        self.connect_window = connect_window
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max(4, len(self.endpoints)))
+        self._dense_len: Dict[int, int] = {}
+        self._barrier_gen: Dict[str, int] = {}
+
+    @property
+    def num_servers(self) -> int:
+        return len(self.endpoints)
+
+    # ------------------------------------------------------------ plumbing
+    def _call(self, server: int, op: str, **kw):
+        payload = pickle.dumps((op, kw))
+        req = urllib.request.Request(
+            f"http://{self.endpoints[server]}/ps", data=payload,
+            method="POST", headers={"X-PS-Token": self.token})
+        # servers may come up after workers: retry connection refusals
+        # during startup (reference brpc client reconnect behavior)
+        # barrier responses arrive only when the last worker shows up —
+        # outlive the server-side barrier wait (120s), not self.timeout
+        http_timeout = self.timeout if op != "barrier" else max(
+            self.timeout, 150.0)
+        deadline = time.monotonic() + self.connect_window
+        while True:
+            try:
+                with urllib.request.urlopen(req, timeout=http_timeout) as r:
+                    status, value = pickle.loads(r.read())
+                break
+            except urllib.error.URLError as e:
+                if (isinstance(getattr(e, "reason", None), ConnectionError)
+                        and time.monotonic() < deadline):
+                    time.sleep(0.2)
+                    continue
+                raise
+        if status == "err":
+            raise value
+        return value
+
+    def _fanout(self, op: str, per_server_kw: Dict[int, dict]) -> Dict[int, object]:
+        futs = {s: self._pool.submit(self._call, s, op, **kw)
+                for s, kw in per_server_kw.items()}
+        return {s: f.result() for s, f in futs.items()}
+
+    def _route(self, ids: np.ndarray) -> np.ndarray:
+        # stable routing: id % num_servers (reference brpc client keying)
+        return (ids % self.num_servers).astype(np.int64)
+
+    # -------------------------------------------------------------- tables
+    def create_table(self, table_id: int, config: dict) -> None:
+        """Create the table on every server (idempotent). Dense tables are
+        chunked: each server is created with only its chunk's length."""
+        if config.get("type") == "dense":
+            self._dense_len[table_id] = int(config["length"])
+            chunks = self._dense_chunks(table_id)
+            per_server = {}
+            for s in range(self.num_servers):
+                if chunks[s].stop > chunks[s].start:
+                    cfg = dict(config)
+                    cfg["length"] = chunks[s].stop - chunks[s].start
+                    per_server[s] = {"table_id": table_id, "config": cfg}
+            self._fanout("create_table", per_server)
+            return
+        self._fanout("create_table",
+                     {s: {"table_id": table_id, "config": config}
+                      for s in range(self.num_servers)})
+
+    def table_size(self, table_id: int) -> int:
+        sizes = self._fanout("table_size",
+                             {s: {"table_id": table_id}
+                              for s in range(self.num_servers)})
+        return int(sum(sizes.values()))
+
+    # -------------------------------------------------------------- sparse
+    def pull_sparse(self, table_id: int, ids) -> np.ndarray:
+        """Values for ``ids`` (duplicates allowed), in input order."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        if not ids.size:
+            return np.zeros((0, 0), np.float32)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        shard = self._route(uniq)
+        per_server = {}
+        for s in range(self.num_servers):
+            mask = shard == s
+            if mask.any():
+                per_server[s] = {"table_id": table_id, "ids": uniq[mask]}
+        results = self._fanout("pull_sparse", per_server)
+        dim = next(iter(results.values())).shape[1]
+        out_uniq = np.empty((len(uniq), dim), np.float32)
+        for s, vals in results.items():
+            out_uniq[shard == s] = vals
+        return out_uniq[inverse]
+
+    def push_sparse(self, table_id: int, ids, grads) -> None:
+        """Push per-occurrence grads; duplicate ids are sum-merged here
+        so the server applies ONE optimizer step per row per push."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        grads = grads.reshape(len(ids), -1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), grads.shape[1]), np.float32)
+        np.add.at(merged, inverse, grads)
+        shard = self._route(uniq)
+        per_server = {}
+        for s in range(self.num_servers):
+            mask = shard == s
+            if mask.any():
+                per_server[s] = {"table_id": table_id, "ids": uniq[mask],
+                                 "grads": merged[mask]}
+        self._fanout("push_sparse", per_server)
+
+    # --------------------------------------------------------------- dense
+    def _dense_chunks(self, table_id: int) -> List[slice]:
+        n = self._dense_len[table_id]
+        per = -(-n // self.num_servers)
+        return [slice(s * per, min((s + 1) * per, n))
+                for s in range(self.num_servers)]
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        chunks = self._dense_chunks(table_id)
+        res = self._fanout("pull_dense",
+                           {s: {"table_id": table_id}
+                            for s in range(self.num_servers)
+                            if chunks[s].stop > chunks[s].start})
+        out = np.empty(self._dense_len[table_id], np.float32)
+        for s, v in res.items():
+            out[chunks[s]] = v
+        return out
+
+    def push_dense(self, table_id: int, grad: np.ndarray) -> None:
+        grad = np.asarray(grad, np.float32).reshape(-1)
+        chunks = self._dense_chunks(table_id)
+        self._fanout("push_dense",
+                     {s: {"table_id": table_id, "grad": grad[chunks[s]]}
+                      for s in range(self.num_servers)
+                      if chunks[s].stop > chunks[s].start})
+
+    def set_dense(self, table_id: int, value: np.ndarray) -> None:
+        value = np.asarray(value, np.float32).reshape(-1)
+        chunks = self._dense_chunks(table_id)
+        self._fanout("set_dense",
+                     {s: {"table_id": table_id, "value": value[chunks[s]]}
+                      for s in range(self.num_servers)
+                      if chunks[s].stop > chunks[s].start})
+
+    # ----------------------------------------------------------- lifecycle
+    def save(self, dirname: str) -> List[str]:
+        res = self._fanout("save", {s: {"dirname": dirname}
+                                    for s in range(self.num_servers)})
+        return [res[s] for s in sorted(res)]
+
+    def load(self, dirname: str) -> None:
+        self._fanout("load", {s: {"dirname": dirname}
+                              for s in range(self.num_servers)})
+
+    def barrier(self, key: str, world: int) -> None:
+        """Worker barrier through server 0 (reference BarrierTable).
+        A per-key generation counter makes the barrier reusable — all
+        workers call barriers in the same program order, so generations
+        align across processes."""
+        gen = self._barrier_gen.get(key, 0)
+        self._barrier_gen[key] = gen + 1
+        self._call(0, "barrier", key=f"{key}#{gen}", world=world)
+
+    def stop_servers(self) -> None:
+        for s in range(self.num_servers):
+            try:
+                self._call(s, "stop")
+            except Exception:
+                pass  # already down
+
+    def close(self):
+        self._pool.shutdown(wait=False)

@@ -1,0 +1,116 @@
+"""Distributed (PS-backed) sparse embedding.
+
+Reference contract: ``paddle.static.nn.sparse_embedding``
+(``python/paddle/static/nn/common.py:3691`` — an embedding whose table
+lives on the parameter servers and is pulled/pushed per batch) and the
+worker-side sparse path of the_one_ps.
+
+TPU-native split: the table is host/PS-resident (it is the part that
+doesn't fit chip HBM); each step pulls only the batch's unique rows,
+ships that small dense block to the device, and the *gather and all
+downstream compute stay on-chip and differentiable*. The backward hook
+pushes per-row gradients back to the PS, where the table's accessor
+(sgd/adam/adagrad/sum) applies the update — so the embedding optimizer
+runs server-side, exactly the reference's division of labor.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ...autograd.pylayer import PyLayer
+from ...nn.layer.layers import Layer
+
+__all__ = ["DistributedEmbedding", "sparse_embedding_lookup"]
+
+
+class _PsLookup(PyLayer):
+    """Device gather over pulled rows; backward pushes row grads to PS."""
+
+    @staticmethod
+    def forward(ctx, rows, owner, uniq, inverse, out_shape):
+        ctx.owner = owner
+        ctx.uniq = uniq
+        ctx.inverse = inverse
+        ctx.dim = rows.shape[-1]
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        gathered = jnp.take(rows._data, jnp.asarray(inverse), axis=0)
+        return Tensor(gathered.reshape(tuple(out_shape) + (ctx.dim,)))
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        g = np.asarray(grad_out.numpy(), np.float32).reshape(-1, ctx.dim)
+        # sum-merge duplicate ids → one row grad per unique id
+        merged = np.zeros((len(ctx.uniq), ctx.dim), np.float32)
+        np.add.at(merged, ctx.inverse, g)
+        owner = ctx.owner
+        if owner.trainable:
+            owner.client.push_sparse(owner.table_id, ctx.uniq, merged)
+        # grad wrt the pulled rows block (a leaf staging tensor)
+        return merged
+
+
+class DistributedEmbedding(Layer):
+    """Embedding whose rows live on parameter servers.
+
+    ``client`` is a :class:`~paddle_tpu.distributed.ps.client.PsClient`
+    (or is taken from the PS-mode fleet when omitted). The table is
+    created idempotently on first construction.
+    """
+
+    def __init__(self, table_id: int, embedding_dim: int,
+                 client=None, accessor: str = "sgd", lr: float = 0.01,
+                 initializer: str = "uniform", init_range: float = 0.01,
+                 trainable: bool = True, **hp):
+        super().__init__()
+        if client is None:
+            from . import _current_client
+            client = _current_client()
+        self.client = client
+        self.table_id = int(table_id)
+        self.embedding_dim = int(embedding_dim)
+        self.trainable = trainable
+        self.client.create_table(self.table_id, {
+            "type": "sparse", "dim": self.embedding_dim,
+            "accessor": accessor, "lr": lr, "initializer": initializer,
+            "init_range": init_range, **hp})
+
+    def forward(self, ids):
+        return sparse_embedding_lookup(
+            ids, self.client, self.table_id, self.embedding_dim,
+            trainable=self.trainable, owner=self)
+
+
+class _Owner:
+    """Ad-hoc owner for the functional entry point."""
+
+    def __init__(self, client, table_id, trainable):
+        self.client = client
+        self.table_id = table_id
+        self.trainable = trainable
+
+
+def sparse_embedding_lookup(ids, client, table_id: int, dim: int,
+                            trainable: bool = True, owner=None):
+    """Pull rows for ``ids`` from the PS and gather on device.
+
+    Differentiable: the backward pass pushes the per-row gradients to the
+    PS (where the table accessor applies the update) — there is no local
+    weight parameter.
+    """
+    from ... import to_tensor
+    from ...core.tensor import Tensor
+
+    if owner is None:
+        owner = _Owner(client, table_id, trainable)
+    ids_np = np.asarray(
+        ids.numpy() if isinstance(ids, Tensor) else ids).astype(np.int64)
+    flat = ids_np.reshape(-1)
+    uniq, inverse = np.unique(flat, return_inverse=True)
+    rows_np = client.pull_sparse(table_id, uniq)
+    rows = to_tensor(rows_np)
+    rows.stop_gradient = not trainable  # so the tape reaches our backward
+    return _PsLookup.apply(rows, owner, uniq, inverse, ids_np.shape)

@@ -1,0 +1,115 @@
+"""Role maker for parameter-server mode.
+
+Reference contract: ``python/paddle/distributed/fleet/base/role_maker.py``
+PaddleCloudRoleMaker (:849-1003) — roles resolved from the standard env:
+``TRAINING_ROLE`` (TRAINER | PSERVER), ``PADDLE_PSERVERS_IP_PORT_LIST``,
+``PADDLE_TRAINERS_NUM``, ``PADDLE_TRAINER_ID``, and for servers
+``POD_IP``/``PADDLE_PORT``.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """PS-mode role resolution from the reference's env contract."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._role: Optional[int] = None
+        self._current_id = 0
+        self._server_endpoints: List[str] = []
+        self._trainers_num = 0
+        if not is_collective:
+            self._ps_env()
+
+    def _ps_env(self):
+        eps = os.getenv("PADDLE_PSERVERS_IP_PORT_LIST")
+        if eps is None:
+            raise ValueError(
+                "Can not find PADDLE_PSERVERS_IP_PORT_LIST, please check "
+                "your environment.")
+        self._server_endpoints = [e.strip() for e in eps.split(",") if e]
+        trainers_num = os.getenv("PADDLE_TRAINERS_NUM")
+        if trainers_num is None:
+            raise ValueError(
+                "Can not find PADDLE_TRAINERS_NUM, please check your "
+                "environment.")
+        self._trainers_num = int(trainers_num)
+        role = os.getenv("TRAINING_ROLE")
+        if role not in ("TRAINER", "PSERVER"):
+            raise ValueError(
+                f"TRAINING_ROLE must be PSERVER or TRAINER, but got "
+                f"{role!r}, please check your environment.")
+        if role == "TRAINER":
+            self._role = Role.WORKER
+            cur = os.getenv("PADDLE_TRAINER_ID")
+            if cur is None:
+                raise ValueError(
+                    "Can not find PADDLE_TRAINER_ID, please check your "
+                    "environment.")
+            self._current_id = int(cur)
+        else:
+            self._role = Role.SERVER
+            ip = os.getenv("POD_IP")
+            port = os.getenv("PADDLE_PORT")
+            if ip is None or port is None:
+                raise ValueError(
+                    "Can not find POD_IP/PADDLE_PORT, please check your "
+                    "environment.")
+            me = f"{ip}:{port}"
+            if me not in self._server_endpoints:
+                raise ValueError(
+                    f"server endpoint {me} not in "
+                    f"PADDLE_PSERVERS_IP_PORT_LIST {self._server_endpoints}")
+            self._current_id = self._server_endpoints.index(me)
+
+    # ------------------------------------------------------------- queries
+    def _is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def _is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def _worker_index(self) -> int:
+        return self._current_id if self._is_worker() else -1
+
+    def _server_index(self) -> int:
+        return self._current_id if self._is_server() else -1
+
+    def _worker_num(self) -> int:
+        return self._trainers_num
+
+    def _server_num(self) -> int:
+        return len(self._server_endpoints)
+
+    def _get_pserver_endpoints(self) -> List[str]:
+        return list(self._server_endpoints)
+
+    def _is_first_worker(self) -> bool:
+        return self._is_worker() and self._current_id == 0
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Programmatic roles (reference UserDefinedRoleMaker): pass
+    ``current_id``, ``role`` (Role.WORKER/SERVER), ``worker_num``,
+    ``server_endpoints`` directly instead of reading env."""
+
+    def __init__(self, is_collective: bool = False, *, current_id: int,
+                 role: int, worker_num: int,
+                 server_endpoints: List[str], **kwargs):
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+        self._role = role
+        self._current_id = int(current_id)
+        self._server_endpoints = list(server_endpoints)
+        self._trainers_num = int(worker_num)

@@ -1,0 +1,217 @@
+"""Parameter-server process: tables behind an authenticated HTTP service.
+
+Reference contract: ``paddle/fluid/distributed/ps/service/brpc_ps_server.cc``
+(PsService RPC surface: PullSparse/PushSparse/PullDense/PushDense/
+SaveTable/LoadTable/Barrier/StopServer) and the server lifecycle of
+``python/paddle/distributed/ps/the_one_ps.py`` (``_init_server`` /
+``_run_server`` / ``_stop_server``).
+
+TPU-native: brpc is replaced by the repo's authenticated HTTP idiom (same
+trust model as ``distributed/rpc``: the job token is checked *before* any
+``pickle.loads``), and the server is pure host code — it never touches a
+chip, which is exactly the hardware split the PS tier exists for.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+
+__all__ = ["PsServer"]
+
+
+class _PsHandler(BaseHTTPRequestHandler):
+    server_version = "paddle-tpu-ps/1"
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def do_POST(self):
+        srv = self.server
+        if srv.token and self.headers.get("X-PS-Token") != srv.token:
+            self.send_response(403)
+            self.end_headers()
+            return
+        length = int(self.headers.get("Content-Length", 0))
+        payload = self.rfile.read(length)
+        try:
+            op, kwargs = pickle.loads(payload)
+            result = ("ok", srv.owner._handle(op, **kwargs))
+        except Exception as e:
+            try:
+                pickle.dumps(e)
+            except Exception:
+                e = RuntimeError(f"unpicklable PS error: {e!r}")
+            result = ("err", e)
+        body = pickle.dumps(result)
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class PsServer:
+    """One PS shard: holds its portion of every table.
+
+    ``table_configs``: {table_id: {"type": "sparse"|"dense", ...kwargs}}
+    may be given up front or created remotely by the client's
+    ``create_table`` (first worker wins; repeat creations with the same
+    config are idempotent).
+    """
+
+    def __init__(self, server_index: int, num_servers: int,
+                 token: str = "", port: int = 0, host: str = "0.0.0.0"):
+        self.server_index = int(server_index)
+        self.num_servers = int(num_servers)
+        self.token = token
+        self._tables: Dict[int, Union[SparseTable, DenseTable]] = {}
+        self._configs: Dict[int, dict] = {}
+        self._lock = threading.Lock()
+        self._barrier_events: Dict[str, threading.Event] = {}
+        self._barrier_counts: Dict[str, int] = {}
+        self._stop_event = threading.Event()
+        self._httpd = ThreadingHTTPServer((host, port), _PsHandler)
+        self._httpd.token = token
+        self._httpd.owner = self
+        self._thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------- lifecycle
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "PsServer":
+        """Serve in a daemon thread (in-process deployments and tests)."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def run(self):
+        """Serve on the calling thread until a stop request arrives
+        (reference ``fleet.run_server()`` blocks the server process)."""
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        self._stop_event.wait()
+
+    def stop(self):
+        self._stop_event.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # ----------------------------------------------------------- dispatch
+    def _handle(self, op: str, **kw):
+        fn = getattr(self, f"_op_{op}", None)
+        if fn is None:
+            raise ValueError(f"unknown PS op {op!r}")
+        return fn(**kw)
+
+    def _table(self, table_id: int):
+        try:
+            return self._tables[table_id]
+        except KeyError:
+            raise KeyError(
+                f"table {table_id} not created on server "
+                f"{self.server_index}; call create_table first")
+
+    # --------------------------------------------------------------- ops
+    def _op_create_table(self, table_id: int, config: dict):
+        with self._lock:
+            if table_id in self._tables:
+                if config != self._configs[table_id]:
+                    raise ValueError(
+                        f"table {table_id} already exists with a "
+                        f"different config {self._configs[table_id]}")
+                return False
+            cfg = dict(config)
+            kind = cfg.pop("type")
+            if kind == "sparse":
+                # per-server seed decorrelates shard initializers
+                cfg.setdefault("seed", 0)
+                cfg["seed"] = cfg["seed"] * self.num_servers \
+                    + self.server_index
+                self._tables[table_id] = SparseTable(**cfg)
+            elif kind == "dense":
+                self._tables[table_id] = DenseTable(**cfg)
+            else:
+                raise ValueError(f"unknown table type {kind!r}")
+            self._configs[table_id] = dict(config)
+            return True
+
+    def _op_pull_sparse(self, table_id: int, ids: np.ndarray):
+        return self._table(table_id).pull(ids)
+
+    def _op_push_sparse(self, table_id: int, ids: np.ndarray,
+                        grads: np.ndarray):
+        self._table(table_id).push(ids, grads)
+
+    def _op_pull_dense(self, table_id: int):
+        return self._table(table_id).pull()
+
+    def _op_push_dense(self, table_id: int, grad: np.ndarray):
+        self._table(table_id).push(grad)
+
+    def _op_set_dense(self, table_id: int, value: np.ndarray):
+        self._table(table_id).set(value)
+
+    def _op_table_size(self, table_id: int):
+        t = self._table(table_id)
+        return t.size if isinstance(t, SparseTable) else t.length
+
+    def _op_save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        path = os.path.join(
+            dirname, f"ps_shard_{self.server_index}.pkl")
+        with self._lock:
+            blob = {tid: {"config": self._configs[tid],
+                          "data": t.state_dict()}
+                    for tid, t in self._tables.items()}
+        with open(path, "wb") as f:
+            pickle.dump(blob, f, protocol=4)
+        return path
+
+    def _op_load(self, dirname: str):
+        path = os.path.join(
+            dirname, f"ps_shard_{self.server_index}.pkl")
+        with open(path, "rb") as f:
+            blob = pickle.load(f)
+        for tid, entry in blob.items():
+            if tid not in self._tables:
+                self._op_create_table(tid, entry["config"])
+            self._tables[tid].load_state_dict(entry["data"])
+        return sorted(blob)
+
+    def _op_barrier(self, key: str, world: int):
+        """Count-down barrier over workers (reference Barrier service)."""
+        with self._lock:
+            ev = self._barrier_events.setdefault(key, threading.Event())
+            self._barrier_counts[key] = self._barrier_counts.get(key, 0) + 1
+            if self._barrier_counts[key] >= world:
+                ev.set()
+        if not ev.wait(timeout=120):
+            raise TimeoutError(f"PS barrier {key!r} timed out")
+        return True
+
+    def _op_stop(self):
+        # unblock run(); the HTTP server itself is shut down by stop()
+        # from the main thread so the response can still be written
+        threading.Thread(target=self._delayed_stop, daemon=True).start()
+        return True
+
+    def _delayed_stop(self):
+        import time
+        time.sleep(0.1)  # let the stop response flush
+        self._stop_event.set()

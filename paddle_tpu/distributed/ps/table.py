@@ -1,0 +1,258 @@
+"""Parameter-server tables and accessors.
+
+Reference contract: ``paddle/fluid/distributed/ps/table/`` —
+``memory_sparse_table.cc`` (row-hash-sharded sparse tables with lazy row
+creation), ``memory_dense_table.cc`` (chunked dense params), and the
+optimizer accessors of ``the_one_ps.py`` CommonAccessor
+(``python/paddle/distributed/ps/the_one_ps.py:274`` — sum / sgd / adam /
+adagrad applied server-side per pushed gradient).
+
+TPU-native design: the PS tier holds the *host-resident sparse* parameters
+(embedding rows too large for chip HBM — the tier the reference's
+brpc PS exists for), while dense model parameters train on-chip via SPMD
+collectives. Tables store rows in growing numpy slabs with an id→slot
+index, so pull/push and the accessor update are vectorized host ops, not
+per-row python loops.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["SparseTable", "DenseTable", "make_accessor", "ACCESSORS"]
+
+
+# ------------------------------------------------------------- accessors
+class _Accessor:
+    """Server-side optimizer over a batch of rows (vectorized)."""
+
+    #: per-row state slabs this accessor needs: name -> init constant
+    states: Dict[str, float] = {}
+
+    def __init__(self, lr: float = 0.01, **hp):
+        self.lr = lr
+        self.hp = hp
+
+    def apply(self, value: np.ndarray, grad: np.ndarray,
+              state: Dict[str, np.ndarray], counts: np.ndarray) -> None:
+        raise NotImplementedError
+
+
+class SumAccessor(_Accessor):
+    """show/click style counters: value += grad (reference 'sum')."""
+
+    def apply(self, value, grad, state, counts):
+        value += grad
+
+
+class SGDAccessor(_Accessor):
+    def apply(self, value, grad, state, counts):
+        value -= self.lr * grad
+
+
+class AdaGradAccessor(_Accessor):
+    states = {"g2": 0.0}
+
+    def apply(self, value, grad, state, counts):
+        eps = self.hp.get("epsilon", 1e-6)
+        g2 = state["g2"]
+        g2 += grad * grad
+        value -= self.lr * grad / (np.sqrt(g2) + eps)
+
+
+class AdamAccessor(_Accessor):
+    states = {"m": 0.0, "v": 0.0, "t": 0.0}
+
+    def apply(self, value, grad, state, counts):
+        b1 = self.hp.get("beta1", 0.9)
+        b2 = self.hp.get("beta2", 0.999)
+        eps = self.hp.get("epsilon", 1e-8)
+        m, v, t = state["m"], state["v"], state["t"]
+        t += 1.0
+        m *= b1
+        m += (1 - b1) * grad
+        v *= b2
+        v += (1 - b2) * grad * grad
+        # t is a per-row step count broadcast over dim (column 0 is truth)
+        step = t[:, :1]
+        mhat = m / (1 - b1 ** step)
+        vhat = v / (1 - b2 ** step)
+        value -= self.lr * mhat / (np.sqrt(vhat) + eps)
+
+
+ACCESSORS = {"sum": SumAccessor, "sgd": SGDAccessor, "adam": AdamAccessor,
+             "adagrad": AdaGradAccessor}
+
+
+def make_accessor(name: str, lr: float = 0.01, **hp) -> _Accessor:
+    try:
+        return ACCESSORS[name](lr=lr, **hp)
+    except KeyError:
+        raise ValueError(
+            f"unknown accessor {name!r}; have {sorted(ACCESSORS)}")
+
+
+# ---------------------------------------------------------- sparse table
+class SparseTable:
+    """One server's shard of a row-hash-sharded sparse table.
+
+    Rows are created lazily on first pull (reference memory_sparse_table
+    entry semantics) using the table's initializer, and live in growing
+    numpy slabs addressed through an id→slot dict.
+    """
+
+    def __init__(self, dim: int, accessor: str = "sgd", lr: float = 0.01,
+                 initializer: str = "uniform", init_range: float = 0.01,
+                 seed: int = 0, **hp):
+        self.dim = int(dim)
+        self.accessor = make_accessor(accessor, lr=lr, **hp)
+        self.initializer = initializer
+        self.init_range = float(init_range)
+        self._rng = np.random.RandomState(seed)
+        self._slot: Dict[int, int] = {}
+        self._cap = 64
+        self._n = 0
+        self._value = np.zeros((self._cap, self.dim), np.float32)
+        self._state = {k: np.full((self._cap, self.dim), v, np.float32)
+                       for k, v in self.accessor.states.items()}
+        self._lock = threading.Lock()
+
+    def _grow(self, need: int):
+        while self._cap < need:
+            self._cap *= 2
+        old_v = self._value
+        self._value = np.zeros((self._cap, self.dim), np.float32)
+        self._value[:old_v.shape[0]] = old_v
+        for k, init in self.accessor.states.items():
+            old = self._state[k]
+            new = np.full((self._cap, self.dim), init, np.float32)
+            new[:old.shape[0]] = old
+            self._state[k] = new
+
+    def _init_rows(self, count: int) -> np.ndarray:
+        if self.initializer == "constant":
+            return np.full((count, self.dim), self.init_range, np.float32)
+        return self._rng.uniform(
+            -self.init_range, self.init_range,
+            (count, self.dim)).astype(np.float32)
+
+    def _slots(self, ids: np.ndarray, create: bool) -> np.ndarray:
+        out = np.empty(len(ids), np.int64)
+        for i, key in enumerate(ids):
+            key = int(key)
+            slot = self._slot.get(key)
+            if slot is None:
+                if not create:
+                    out[i] = -1
+                    continue
+                slot = self._n
+                self._n += 1
+                if self._n > self._cap:
+                    self._grow(self._n)
+                self._value[slot] = self._init_rows(1)[0]
+                self._slot[key] = slot
+            out[i] = slot
+        return out
+
+    # -------------------------------------------------------------- api
+    def pull(self, ids: np.ndarray) -> np.ndarray:
+        """Row values for ``ids`` (lazy-created)."""
+        with self._lock:
+            slots = self._slots(np.asarray(ids, np.int64), create=True)
+            return self._value[slots].copy()
+
+    def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Apply the accessor to the (already deduplicated) rows."""
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            slots = self._slots(ids, create=True)
+            value = self._value[slots]
+            state = {k: s[slots] for k, s in self._state.items()}
+            counts = np.ones(len(ids), np.float32)
+            self.accessor.apply(value, grads, state, counts)
+            self._value[slots] = value
+            for k, s in state.items():
+                self._state[k][slots] = s
+
+    @property
+    def size(self) -> int:
+        return len(self._slot)
+
+    # ------------------------------------------------------ persistence
+    def state_dict(self) -> dict:
+        with self._lock:
+            ids = np.fromiter(self._slot.keys(), np.int64,
+                              count=len(self._slot))
+            slots = np.fromiter(self._slot.values(), np.int64,
+                                count=len(self._slot))
+            return {
+                "kind": "sparse", "dim": self.dim, "ids": ids,
+                "value": self._value[slots].copy(),
+                "state": {k: s[slots].copy()
+                          for k, s in self._state.items()},
+            }
+
+    def load_state_dict(self, sd: dict) -> None:
+        with self._lock:
+            ids, value = sd["ids"], sd["value"]
+            n = len(ids)
+            self._slot = {int(k): i for i, k in enumerate(ids)}
+            self._n = n
+            self._cap = max(64, int(2 ** np.ceil(np.log2(max(n, 1)))))
+            self._value = np.zeros((self._cap, self.dim), np.float32)
+            self._value[:n] = value
+            self._state = {
+                k: np.full((self._cap, self.dim), init, np.float32)
+                for k, init in self.accessor.states.items()}
+            for k, arr in sd.get("state", {}).items():
+                if k in self._state:
+                    self._state[k][:n] = arr
+
+
+# ----------------------------------------------------------- dense table
+class DenseTable:
+    """One server's chunk of a dense parameter vector.
+
+    The client splits a flat dense param into even contiguous chunks over
+    servers (reference memory_dense_table fixed_len sharding); the server
+    applies the accessor elementwise on its chunk.
+    """
+
+    def __init__(self, length: int, accessor: str = "sgd", lr: float = 0.01,
+                 init_value: float = 0.0, **hp):
+        self.length = int(length)
+        self.accessor = make_accessor(accessor, lr=lr, **hp)
+        self._value = np.full((1, self.length), init_value, np.float32)
+        self._state = {k: np.full((1, self.length), v, np.float32)
+                       for k, v in self.accessor.states.items()}
+        self._lock = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._lock:
+            return self._value[0].copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        grad = np.asarray(grad, np.float32).reshape(1, -1)
+        with self._lock:
+            self.accessor.apply(self._value, grad, self._state,
+                                np.ones(1, np.float32))
+
+    def set(self, value: np.ndarray) -> None:
+        with self._lock:
+            self._value[0] = np.asarray(value, np.float32)
+
+    def state_dict(self) -> dict:
+        with self._lock:
+            return {"kind": "dense", "length": self.length,
+                    "value": self._value.copy(),
+                    "state": {k: v.copy() for k, v in self._state.items()}}
+
+    def load_state_dict(self, sd: dict) -> None:
+        with self._lock:
+            self._value = sd["value"].copy()
+            for k, arr in sd.get("state", {}).items():
+                if k in self._state:
+                    self._state[k] = arr.copy()

@@ -29,6 +29,14 @@ def test_container_meta_and_indexing():
     assert st.place == "cpu"  # strings live on host, like the reference
 
 
+def test_numpy_bytes_array_decodes_and_hash():
+    st = strings.to_string_tensor(np.array([b"ABC", b"def"]))
+    assert st.tolist() == ["ABC", "def"]
+    assert strings.lower(st).tolist() == ["abc", "def"]  # str, not bytes
+    # identity hash: usable as a dict key despite value __eq__
+    assert {st: 1}[st] == 1
+
+
 def test_container_scalar_bytes_reshape():
     st = strings.to_string_tensor("hello")
     assert st.shape == []
