@@ -105,6 +105,12 @@ define_flag("distributed_timeout_ms", 30 * 60 * 1000, "Collective watchdog timeo
 define_flag("stop_check_timeout", -1, "Seconds before a hung collective aborts the job.")
 define_flag("tpu_matmul_precision", "default", "default|high|highest matmul precision.")
 define_flag("use_pallas_kernels", True, "Use Pallas TPU kernels for hot ops when available.")
+define_flag("flash_min_seq_len", 1024,
+            "Shortest sequence routed to the Pallas flash-attention kernel; "
+            "below it XLA's fused dense attention is faster (measured on "
+            "v5e, BERT-base S=512: 117.2k tok/s XLA vs 114.2k Pallas — the "
+            "blocked online-softmax only pays once the attention matrix "
+            "stops fitting comfortably).")
 define_flag("eager_jit_cache", True, "Run steady-state eager ops through cached compiled lowerings.")
 define_flag("log_level", 0, "VLOG-style verbosity for framework logging.")
 define_flag("cudnn_deterministic", False, "Determinism facade (XLA is deterministic by default).")

@@ -23,9 +23,14 @@ def _t(x):
     return x if isinstance(x, Tensor) else as_tensor(x)
 
 
-def _use_pallas():
+def _use_pallas(seq_len=None):
     from ...core import flags
     if not flags.get_flag("use_pallas_kernels"):
+        return False
+    if seq_len is not None and seq_len < flags.get_flag("flash_min_seq_len"):
+        # measured crossover (see flag docstring): short sequences run
+        # faster through XLA's fused dense attention than the blocked
+        # Pallas kernel
         return False
     try:
         return jax.default_backend() in ("tpu", "axon")
@@ -61,7 +66,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         from ...core.generator import next_key
         drop_key = next_key()
 
-    if _use_pallas() and dropout == 0.0:
+    if _use_pallas(q.shape[1]) and dropout == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
 
         def f(qa, ka, va):
@@ -89,7 +94,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...core.generator import next_key
         drop_key = next_key()
 
-    if _use_pallas() and not has_mask and dropout_p == 0.0:
+    if _use_pallas(q.shape[1]) and not has_mask and dropout_p == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
 
         def f(qa, ka, va):
