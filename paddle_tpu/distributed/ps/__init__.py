@@ -28,13 +28,16 @@ import os
 from typing import Optional
 
 from .client import PsClient
-from .embedding import DistributedEmbedding, sparse_embedding_lookup
+from .embedding import (DistributedEmbedding, GeoDistributedEmbedding,
+                        sparse_embedding_lookup)
 from .role_maker import PaddleCloudRoleMaker, Role, UserDefinedRoleMaker
 from .server import PsServer
-from .table import ACCESSORS, DenseTable, SparseTable, make_accessor
+from .table import (ACCESSORS, DenseTable, GeoSparseTable, SparseTable,
+                    make_accessor)
 
-__all__ = ["PsServer", "PsClient", "SparseTable", "DenseTable",
-           "make_accessor", "ACCESSORS", "DistributedEmbedding",
+__all__ = ["PsServer", "PsClient", "SparseTable", "GeoSparseTable",
+           "DenseTable", "make_accessor", "ACCESSORS",
+           "DistributedEmbedding", "GeoDistributedEmbedding",
            "sparse_embedding_lookup", "PaddleCloudRoleMaker",
            "UserDefinedRoleMaker", "Role", "init_from_role",
            "current_context"]

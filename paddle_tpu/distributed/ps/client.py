@@ -144,6 +144,36 @@ class PsClient:
                                  "grads": merged[mask]}
         self._fanout("push_sparse", per_server)
 
+    # ----------------------------------------------------------------- geo
+    def push_geo(self, table_id: int, trainer_id: int, ids, deltas) -> None:
+        """Accumulate local-training deltas server-side (geo-SGD)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        deltas = np.asarray(deltas, np.float32).reshape(len(ids), -1)
+        uniq, inverse = np.unique(ids, return_inverse=True)
+        merged = np.zeros((len(uniq), deltas.shape[1]), np.float32)
+        np.add.at(merged, inverse, deltas)
+        shard = self._route(uniq)
+        per_server = {}
+        for s in range(self.num_servers):
+            mask = shard == s
+            if mask.any():
+                per_server[s] = {"table_id": table_id,
+                                 "trainer_id": trainer_id,
+                                 "ids": uniq[mask], "deltas": merged[mask]}
+        self._fanout("push_geo", per_server)
+
+    def pull_geo(self, table_id: int, trainer_id: int):
+        """Rows other trainers changed since this trainer's last pull."""
+        res = self._fanout("pull_geo",
+                           {s: {"table_id": table_id,
+                                "trainer_id": trainer_id}
+                            for s in range(self.num_servers)})
+        ids = np.concatenate([res[s][0] for s in sorted(res)])
+        vals = [res[s][1] for s in sorted(res) if res[s][1].size]
+        values = (np.concatenate(vals) if vals
+                  else np.zeros((0, 0), np.float32))
+        return ids, values
+
     # --------------------------------------------------------------- dense
     def _dense_chunks(self, table_id: int) -> List[slice]:
         n = self._dense_len[table_id]

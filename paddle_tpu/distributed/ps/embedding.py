@@ -84,6 +84,109 @@ class DistributedEmbedding(Layer):
             trainable=self.trainable, owner=self)
 
 
+class _GeoLookup(PyLayer):
+    """Gather over the LOCAL replica; backward trains locally and banks
+    the delta for the next geo sync."""
+
+    @staticmethod
+    def forward(ctx, rows, owner, uniq, inverse, out_shape):
+        ctx.owner = owner
+        ctx.uniq = uniq
+        ctx.inverse = inverse
+        ctx.dim = rows.shape[-1]
+        import jax.numpy as jnp
+
+        from ...core.tensor import Tensor
+        gathered = jnp.take(rows._data, jnp.asarray(inverse), axis=0)
+        return Tensor(gathered.reshape(tuple(out_shape) + (ctx.dim,)))
+
+    @staticmethod
+    def backward(ctx, grad_out):
+        g = np.asarray(grad_out.numpy(), np.float32).reshape(-1, ctx.dim)
+        merged = np.zeros((len(ctx.uniq), ctx.dim), np.float32)
+        np.add.at(merged, ctx.inverse, g)
+        owner = ctx.owner
+        owner._apply_local(ctx.uniq, merged)
+        return merged
+
+
+class GeoDistributedEmbedding(Layer):
+    """Geo-SGD embedding (reference GeoSparseTable protocol): train a
+    local replica with local SGD, push the accumulated deltas every
+    ``sync_steps`` backward passes, and absorb rows other trainers
+    changed (server-merged) on each sync.
+    """
+
+    def __init__(self, table_id: int, embedding_dim: int,
+                 trainer_id: int = 0, trainer_num: int = 1,
+                 client=None, lr: float = 0.01, sync_steps: int = 4,
+                 initializer: str = "uniform", init_range: float = 0.01):
+        super().__init__()
+        if client is None:
+            from . import _current_client
+            client = _current_client()
+        self.client = client
+        self.table_id = int(table_id)
+        self.embedding_dim = int(embedding_dim)
+        self.trainer_id = int(trainer_id)
+        self.lr = float(lr)
+        self.sync_steps = int(sync_steps)
+        self.trainable = True
+        self._local: dict = {}        # id -> np row (the local replica)
+        self._delta: dict = {}        # id -> accumulated delta since sync
+        self._steps_since_sync = 0
+        self.client.create_table(self.table_id, {
+            "type": "geo_sparse", "dim": self.embedding_dim,
+            "trainer_num": int(trainer_num), "lr": lr,
+            "initializer": initializer, "init_range": init_range})
+
+    # ----------------------------------------------------------- replica
+    def _ensure_local(self, uniq: np.ndarray) -> np.ndarray:
+        missing = [i for i in uniq.tolist() if i not in self._local]
+        if missing:
+            rows = self.client.pull_sparse(self.table_id, missing)
+            for i, r in zip(missing, rows):
+                self._local[i] = r.copy()
+        return np.stack([self._local[i] for i in uniq.tolist()])
+
+    def _apply_local(self, uniq: np.ndarray, grads: np.ndarray) -> None:
+        """Local SGD + delta banking (called from backward)."""
+        for i, g in zip(uniq.tolist(), grads):
+            d = -self.lr * g
+            self._local[i] = self._local[i] + d
+            self._delta[i] = self._delta.get(
+                i, np.zeros(self.embedding_dim, np.float32)) + d
+        self._steps_since_sync += 1
+        if self._steps_since_sync >= self.sync_steps:
+            self.sync()
+
+    def sync(self) -> None:
+        """Push banked deltas; absorb other trainers' merged rows."""
+        if self._delta:
+            ids = np.fromiter(self._delta.keys(), np.int64,
+                              count=len(self._delta))
+            deltas = np.stack([self._delta[i] for i in ids.tolist()])
+            self.client.push_geo(self.table_id, self.trainer_id, ids,
+                                 deltas)
+            self._delta.clear()
+        ids, values = self.client.pull_geo(self.table_id, self.trainer_id)
+        for i, v in zip(ids.tolist(), values):
+            self._local[i] = v.copy()
+        self._steps_since_sync = 0
+
+    def forward(self, ids):
+        from ... import to_tensor
+        from ...core.tensor import Tensor
+
+        ids_np = np.asarray(
+            ids.numpy() if isinstance(ids, Tensor) else ids).astype(np.int64)
+        flat = ids_np.reshape(-1)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows = to_tensor(self._ensure_local(uniq))
+        rows.stop_gradient = False
+        return _GeoLookup.apply(rows, self, uniq, inverse, ids_np.shape)
+
+
 class _Owner:
     """Ad-hoc owner for the functional entry point."""
 

@@ -21,7 +21,7 @@ from typing import Dict, Optional, Union
 
 import numpy as np
 
-from .table import DenseTable, SparseTable
+from .table import DenseTable, GeoSparseTable, SparseTable
 
 __all__ = ["PsServer"]
 
@@ -138,12 +138,14 @@ class PsServer:
                 return False
             cfg = dict(config)
             kind = cfg.pop("type")
-            if kind == "sparse":
+            if kind in ("sparse", "geo_sparse"):
                 # per-server seed decorrelates shard initializers
                 cfg.setdefault("seed", 0)
                 cfg["seed"] = cfg["seed"] * self.num_servers \
                     + self.server_index
-                self._tables[table_id] = SparseTable(**cfg)
+                table_cls = (GeoSparseTable if kind == "geo_sparse"
+                             else SparseTable)
+                self._tables[table_id] = table_cls(**cfg)
             elif kind == "dense":
                 self._tables[table_id] = DenseTable(**cfg)
             else:
@@ -157,6 +159,13 @@ class PsServer:
     def _op_push_sparse(self, table_id: int, ids: np.ndarray,
                         grads: np.ndarray):
         self._table(table_id).push(ids, grads)
+
+    def _op_push_geo(self, table_id: int, trainer_id: int,
+                     ids: np.ndarray, deltas: np.ndarray):
+        self._table(table_id).push_delta(trainer_id, ids, deltas)
+
+    def _op_pull_geo(self, table_id: int, trainer_id: int):
+        return self._table(table_id).pull_geo(trainer_id)
 
     def _op_pull_dense(self, table_id: int):
         return self._table(table_id).pull()

@@ -212,6 +212,49 @@ class SparseTable:
                     self._state[k][:n] = arr
 
 
+class GeoSparseTable(SparseTable):
+    """Geo-SGD sparse shard (reference ``memory_sparse_geo_table.cc`` +
+    ``depends/geo_recorder.h``).
+
+    Async-SGD protocol: each worker trains a *local* replica and applies
+    its own optimizer; the server only ACCUMULATES pushed deltas
+    (``PushSparse`` adds, it never runs an optimizer) and records which
+    rows each OTHER trainer has not yet seen. ``pull_geo(trainer_id)``
+    drains that trainer's dirty set, returning fresh row values to
+    overwrite the worker's local replica (``PullGeoParam``).
+    """
+
+    def __init__(self, dim: int, trainer_num: int = 1, lr: float = 0.01,
+                 initializer: str = "uniform", init_range: float = 0.01,
+                 seed: int = 0, **hp):
+        # accessor "sum": the server only merges deltas
+        super().__init__(dim, accessor="sum", lr=lr,
+                         initializer=initializer, init_range=init_range,
+                         seed=seed, **hp)
+        self.trainer_num = int(trainer_num)
+        self._dirty = [set() for _ in range(self.trainer_num)]
+
+    def push_delta(self, trainer_id: int, ids: np.ndarray,
+                   deltas: np.ndarray) -> None:
+        """value += delta; mark rows dirty for every other trainer."""
+        ids = np.asarray(ids, np.int64)
+        self.push(ids, deltas)  # sum accessor
+        with self._lock:
+            for t in range(self.trainer_num):
+                if t != trainer_id:
+                    self._dirty[t].update(int(i) for i in ids)
+
+    def pull_geo(self, trainer_id: int):
+        """Drain ``trainer_id``'s dirty rows → (ids, values)."""
+        with self._lock:
+            ids = np.fromiter(self._dirty[trainer_id], np.int64,
+                              count=len(self._dirty[trainer_id]))
+            self._dirty[trainer_id].clear()
+        if not ids.size:
+            return ids, np.zeros((0, self.dim), np.float32)
+        return ids, self.pull(ids)
+
+
 # ----------------------------------------------------------- dense table
 class DenseTable:
     """One server's chunk of a dense parameter vector.

@@ -306,6 +306,88 @@ def test_fleet_ps_lifecycle(monkeypatch):
     th.join(timeout=10)
 
 
+# ----------------------------------------------------------- geo-SGD
+def test_geo_sparse_table_dirty_tracking():
+    from paddle_tpu.distributed.ps import GeoSparseTable
+    t = GeoSparseTable(dim=2, trainer_num=3, initializer="constant",
+                       init_range=0.0)
+    t.pull([1, 2])  # materialize
+    t.push_delta(0, [1], np.array([[1.0, 1.0]], np.float32))
+    # trainer 0's own push doesn't dirty trainer 0
+    ids0, _ = t.pull_geo(0)
+    assert ids0.size == 0
+    ids1, vals1 = t.pull_geo(1)
+    assert ids1.tolist() == [1]
+    np.testing.assert_allclose(vals1, [[1.0, 1.0]])
+    # drained: second pull is empty
+    ids1b, _ = t.pull_geo(1)
+    assert ids1b.size == 0
+    # trainer 2 still has it pending
+    ids2, _ = t.pull_geo(2)
+    assert ids2.tolist() == [1]
+
+
+def test_geo_embedding_two_trainers_converge(cluster):
+    """Two geo trainers sharing the PS: after both sync, both local
+    replicas equal the server value = init + delta0 + delta1."""
+    from paddle_tpu.distributed.ps import GeoDistributedEmbedding
+    _, client = cluster
+    dim = 4
+    t0 = GeoDistributedEmbedding(11, dim, trainer_id=0, trainer_num=2,
+                                 client=client, lr=0.5, sync_steps=1,
+                                 initializer="constant", init_range=0.2)
+    t1 = GeoDistributedEmbedding(11, dim, trainer_id=1, trainer_num=2,
+                                 client=client, lr=0.5, sync_steps=10**9,
+                                 initializer="constant", init_range=0.2)
+    ids = paddle.to_tensor(np.array([3, 8], np.int64))  # both shards
+
+    # each trainer runs one local step: loss = sum(out) → grad 1 per elt
+    for tr in (t0, t1):
+        out = tr(ids)
+        out.sum().backward()
+    # t0 synced automatically (sync_steps=1); t1 syncs manually
+    t1.sync()
+    # server merged both deltas: 0.2 - 0.5 - 0.5 = -0.8
+    server_vals = client.pull_sparse(11, [3, 8])
+    np.testing.assert_allclose(server_vals, -0.8, atol=1e-6)
+    # t1 pushed then pulled: its replica is the merged value
+    np.testing.assert_allclose(np.stack([t1._local[3], t1._local[8]]),
+                               -0.8, atol=1e-6)
+    # t0 synced BEFORE t1 pushed → still has only its own step; the next
+    # sync absorbs t1's delta
+    np.testing.assert_allclose(t0._local[3], -0.3, atol=1e-6)
+    t0.sync()
+    np.testing.assert_allclose(t0._local[3], -0.8, atol=1e-6)
+
+
+def test_geo_embedding_trains_locally(cluster):
+    """Single geo trainer: local SGD converges and, after sync, the
+    server mirrors the local replica exactly."""
+    from paddle_tpu.distributed.ps import GeoDistributedEmbedding
+    _, client = cluster
+    emb = GeoDistributedEmbedding(12, 8, trainer_id=0, trainer_num=1,
+                                  client=client, lr=0.3, sync_steps=3)
+    lin = paddle.nn.Linear(8, 2)
+    opt = paddle.optimizer.SGD(parameters=lin.parameters(),
+                               learning_rate=0.3)
+    ids = paddle.to_tensor(np.array([[1, 5, 9], [2, 5, 7]], np.int64))
+    labels = paddle.to_tensor(np.array([0, 1], np.int64))
+    losses = []
+    for _ in range(18):
+        h = emb(ids).mean(axis=1)
+        loss = paddle.nn.functional.cross_entropy(lin(h), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < 0.6 * losses[0], losses
+    emb.sync()
+    all_ids = sorted(emb._local)
+    server_vals = client.pull_sparse(12, all_ids)
+    local_vals = np.stack([emb._local[i] for i in all_ids])
+    np.testing.assert_allclose(server_vals, local_vals, atol=1e-5)
+
+
 PS_SERVER_PROC = r"""
 import sys
 sys.path.insert(0, {repo!r})
