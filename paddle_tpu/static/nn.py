@@ -59,6 +59,50 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     return layer(input)
 
 
+_GEO_LAYERS = {}
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS-mode embedding lookup (reference static/nn/common.py:3691
+    ``sparse_embedding`` — the large-scale-sparse replacement for
+    ``embedding`` under the parameter-server runtime).
+
+    Requires PS mode (``fleet.init`` with a non-collective role maker);
+    the table lives on the parameter servers, keyed by a table id hashed
+    from the parameter name. ``size[0]`` (vocab rows) is advisory — PS
+    tables grow lazily (reference MemorySparseTable entry semantics);
+    ``table_class='MemorySparseGeoTable'`` selects the geo-SGD table.
+    ``entry``/``slot`` (CTR feature admission plumbing) are accepted for
+    signature parity and ignored, like ``is_sparse`` in ``embedding``.
+    """
+    import zlib
+
+    from ..distributed.ps import _current_client, sparse_embedding_lookup
+    from ..distributed.ps.embedding import GeoDistributedEmbedding
+
+    name = (param_attr if isinstance(param_attr, str)
+            else getattr(param_attr, "name", None)) or "sparse_embedding_0"
+    table_id = zlib.adler32(name.encode()) % (1 << 30)
+    client = _current_client()
+    dim = int(size[1])
+    if table_class == "MemorySparseGeoTable":
+        # geo replicas are stateful: successive calls on the same param
+        # name must share one local replica + delta bank
+        key = (id(client), table_id)
+        layer = _GEO_LAYERS.get(key)
+        if layer is None:
+            layer = GeoDistributedEmbedding(table_id, dim, client=client)
+            _GEO_LAYERS[key] = layer
+        layer.trainable = not is_test
+        return layer(input)
+    client.create_table(table_id, {"type": "sparse", "dim": dim,
+                                   "accessor": "sgd"})
+    return sparse_embedding_lookup(input, client, table_id, dim,
+                                   trainable=not is_test)
+
+
 def _act(out, activation):
     if activation is None:
         return out
@@ -256,6 +300,6 @@ class ExponentialMovingAverage:
         self._backup = None
 
 
-__all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm",
-           "instance_norm", "spectral_norm", "py_func",
+__all__ = ["fc", "embedding", "sparse_embedding", "conv2d", "batch_norm",
+           "layer_norm", "instance_norm", "spectral_norm", "py_func",
            "ExponentialMovingAverage"]

@@ -388,6 +388,46 @@ def test_geo_embedding_trains_locally(cluster):
     np.testing.assert_allclose(server_vals, local_vals, atol=1e-5)
 
 
+def test_static_nn_sparse_embedding(cluster):
+    """static.nn.sparse_embedding routes through the PS tier (reference
+    static/nn/common.py:3691), including the geo table_class."""
+    from paddle_tpu.distributed import ps as ps_mod
+    _, client = cluster
+    ps_mod._CTX["client"] = client  # bind as the PS-mode client
+    try:
+        import paddle_tpu.static as static
+        ids = paddle.to_tensor(np.array([[1, 2], [3, 1]], np.int64))
+        out = static.nn.sparse_embedding(
+            ids, [100, 6], param_attr="emb_a")
+        assert list(out.shape) == [2, 2, 6]
+        out.sum().backward()  # pushes grads to the PS (sgd accessor)
+        out2 = static.nn.sparse_embedding(
+            ids, [100, 6], param_attr="emb_a")
+        # same param name -> same table: values moved by the sgd step
+        assert not np.allclose(out.numpy(), out2.numpy())
+        # geo path shares one stateful replica across calls
+        g1 = static.nn.sparse_embedding(
+            ids, [100, 6], param_attr="emb_geo",
+            table_class="MemorySparseGeoTable")
+        g1.sum().backward()
+        g2 = static.nn.sparse_embedding(
+            ids, [100, 6], param_attr="emb_geo",
+            table_class="MemorySparseGeoTable")
+        assert not np.allclose(g1.numpy(), g2.numpy())
+        # is_test freezes the lookup: output carries no grad graph and
+        # repeated eval lookups see identical values
+        frozen = static.nn.sparse_embedding(
+            ids, [100, 6], param_attr="emb_a", is_test=True)
+        assert frozen.stop_gradient
+        after = static.nn.sparse_embedding(
+            ids, [100, 6], param_attr="emb_a", is_test=True)
+        np.testing.assert_allclose(frozen.numpy(), after.numpy())
+    finally:
+        ps_mod._CTX["client"] = None
+        from paddle_tpu.static.nn import _GEO_LAYERS
+        _GEO_LAYERS.clear()
+
+
 PS_SERVER_PROC = r"""
 import sys
 sys.path.insert(0, {repo!r})
