@@ -6,8 +6,12 @@ from .hybrid_parallel_util import (broadcast_dp_parameters,
                                    fused_allreduce_gradients)
 from ...utils.log_utils import get_logger, logger
 from ..recompute import recompute, recompute_sequential
+from .fs import (ExecuteError, FSFileExistsError, FSFileNotExistsError,
+                 FSShellCmdAborted, FSTimeOut, HDFSClient, LocalFS)
 
 __all__ = ["broadcast_dp_parameters", "broadcast_mp_parameters",
            "broadcast_sep_parameters", "broadcast_sharding_parameters",
            "broadcast_input_data", "fused_allreduce_gradients",
-           "get_logger", "logger", "recompute", "recompute_sequential"]
+           "get_logger", "logger", "recompute", "recompute_sequential",
+           "LocalFS", "HDFSClient", "ExecuteError", "FSFileExistsError",
+           "FSFileNotExistsError", "FSTimeOut", "FSShellCmdAborted"]

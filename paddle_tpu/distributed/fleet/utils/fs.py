@@ -1,0 +1,318 @@
+"""Filesystem clients for fleet checkpoints and data movement.
+
+Reference contract: ``python/paddle/distributed/fleet/utils/fs.py`` —
+``FS`` abstract surface (:52), ``LocalFS`` (:114, tuple ``ls_dir``, typed
+errors, mv overwrite semantics) and ``HDFSClient`` (:446, ``hadoop fs``
+shell with retries; exit code 134 → ``FSShellCmdAborted``; ``-ls`` lines
+parsed by the 8-column format).
+
+TPU-native note: checkpoints here are host files regardless of
+accelerator, so LocalFS is stdlib; HDFSClient wraps the hadoop CLI via
+``subprocess`` (mockable ``_run_cmd``) instead of the reference's
+``core.shell_execute_cmd`` C++ helper.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "ExecuteError",
+           "FSFileExistsError", "FSFileNotExistsError", "FSTimeOut",
+           "FSShellCmdAborted"]
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FSShellCmdAborted(ExecuteError):
+    pass
+
+
+class FS:
+    """Abstract filesystem surface (reference fs.py:52)."""
+
+    def ls_dir(self, fs_path) -> Tuple[List[str], List[str]]:
+        raise NotImplementedError
+
+    def is_file(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_dir(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def is_exist(self, fs_path) -> bool:
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self) -> bool:
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path) -> List[str]:
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None) -> str:
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local filesystem client (reference fs.py:114)."""
+
+    def ls_dir(self, fs_path):
+        """→ ([subdir, ...], [file, ...]); missing path → ([], [])."""
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for f in os.listdir(fs_path):
+            (dirs if os.path.isdir(os.path.join(fs_path, f))
+             else files).append(f)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        assert not os.path.isfile(fs_path), f"{fs_path} is already a file"
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        if os.path.isfile(fs_path):
+            os.remove(fs_path)
+        else:
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError
+        if overwrite and self.is_exist(dst_path):
+            self.delete(dst_path)
+        if self.is_exist(dst_path):
+            raise FSFileExistsError
+        return self.rename(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [f for f in os.listdir(fs_path)
+                if os.path.isdir(os.path.join(fs_path, f))]
+
+    # local "upload"/"download" are copies (reference LocalFS has no
+    # transfer step; these make LocalFS a drop-in for FS callers)
+    def upload(self, local_path, fs_path):
+        if os.path.isdir(local_path):
+            shutil.copytree(local_path, fs_path)
+        else:
+            shutil.copy2(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        self.upload(fs_path, local_path)
+
+    def cat(self, fs_path=None):
+        with open(fs_path, "r") as f:
+            return f.read().rstrip("\n")
+
+
+class HDFSClient(FS):
+    """``hadoop fs`` shell client (reference fs.py:446).
+
+    Commands run through ``_run_cmd`` with retries; exit code 134 raises
+    ``FSShellCmdAborted`` (the reference's aborted-shell contract). Tests
+    monkeypatch ``_shell`` — no hadoop needed.
+    """
+
+    def __init__(self, hadoop_home: str, configs: Optional[Dict] = None,
+                 time_out: int = 5 * 60 * 1000, sleep_inter: int = 1000):
+        pre = [f"{hadoop_home}/bin/hadoop", "fs"]
+        for k, v in (configs or {}).items():
+            pre.append(f"-D{k}={v}")
+        self._base_cmd = " ".join(pre)
+        self._time_out = time_out        # ms
+        self._sleep_inter = sleep_inter  # ms
+
+    # ------------------------------------------------------------ shell
+    def _shell(self, exe_cmd: str) -> Tuple[int, str]:
+        p = subprocess.run(exe_cmd, shell=True, capture_output=True,
+                           text=True, timeout=self._time_out / 1000.0)
+        return p.returncode, p.stdout + p.stderr
+
+    def _run_cmd(self, cmd: str, redirect_stderr: bool = False,
+                 retry_times: int = 5) -> Tuple[int, List[str]]:
+        exe_cmd = f"{self._base_cmd} -{cmd}"
+        ret, output = 0, ""
+        for _ in range(retry_times + 1):
+            ret, output = self._shell(exe_cmd)
+            if ret == 0:
+                break
+            time.sleep(self._sleep_inter / 1000.0)
+        if ret == 134:
+            raise FSShellCmdAborted(cmd)
+        return int(ret), output.splitlines()
+
+    # -------------------------------------------------------------- ops
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        return self._ls_dir(fs_path)
+
+    def _ls_dir(self, fs_path):
+        cmd = f"ls {fs_path}"
+        ret, lines = self._run_cmd(cmd)
+        if ret != 0:
+            raise ExecuteError(cmd)
+        dirs, files = [], []
+        for line in lines:
+            arr = line.split()
+            if len(arr) != 8:
+                continue  # header/summary lines
+            p = os.path.basename(arr[7])
+            (dirs if arr[0][0] == "d" else files).append(p)
+        return dirs, files
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return self.ls_dir(fs_path)[0]
+
+    def _test(self, flag: str, fs_path: str) -> bool:
+        ret, _ = self._run_cmd(f"test -{flag} {fs_path}", retry_times=1)
+        return ret == 0
+
+    def is_dir(self, fs_path):
+        return self._test("d", fs_path)
+
+    def is_file(self, fs_path):
+        return self._test("f", fs_path)
+
+    def is_exist(self, fs_path):
+        return self._test("e", fs_path)
+
+    def upload(self, local_path, fs_path, multi_processes=1,
+               overwrite=False):
+        if not os.path.exists(local_path):
+            raise FSFileNotExistsError(local_path)
+        if overwrite and self.is_exist(fs_path):
+            self.delete(fs_path)
+        ret, _ = self._run_cmd(f"put {local_path} {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"put {local_path} {fs_path}")
+
+    def download(self, fs_path, local_path, multi_processes=1,
+                 overwrite=False):
+        if not self.is_exist(fs_path):
+            raise FSFileNotExistsError(fs_path)
+        if overwrite and os.path.exists(local_path):
+            LocalFS().delete(local_path)
+        ret, _ = self._run_cmd(f"get {fs_path} {local_path}")
+        if ret != 0:
+            raise ExecuteError(f"get {fs_path} {local_path}")
+
+    def mkdirs(self, fs_path):
+        if self.is_exist(fs_path):
+            return
+        ret, _ = self._run_cmd(f"mkdir -p {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"mkdir -p {fs_path}")
+
+    def delete(self, fs_path):
+        if not self.is_exist(fs_path):
+            return
+        ret, _ = self._run_cmd(f"rm -r {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"rm -r {fs_path}")
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError
+        ret, _ = self._run_cmd(f"touchz {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"touchz {fs_path}")
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False,
+           test_exists=True):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        if test_exists:
+            if not self.is_exist(fs_src_path):
+                raise FSFileNotExistsError(fs_src_path)
+            if self.is_exist(fs_dst_path):
+                raise FSFileExistsError(fs_dst_path)
+        ret, _ = self._run_cmd(f"mv {fs_src_path} {fs_dst_path}")
+        if ret != 0:
+            raise ExecuteError(f"mv {fs_src_path} {fs_dst_path}")
+
+    def cat(self, fs_path=None):
+        if not self.is_file(fs_path):
+            return ""
+        ret, lines = self._run_cmd(f"cat {fs_path}")
+        if ret != 0:
+            raise ExecuteError(f"cat {fs_path}")
+        return "\n".join(lines)
+
+    def need_upload_download(self):
+        return True
+
+    def upload_dir(self, local_dir, dest_dir, overwrite=False):
+        self.upload(local_dir, dest_dir, overwrite=overwrite)

@@ -1,0 +1,139 @@
+"""fleet.utils.fs: LocalFS on a real tmp dir; HDFSClient against a faked
+hadoop shell (command construction + ls parsing + retry/abort contract).
+
+Reference: python/paddle/distributed/fleet/utils/fs.py (LocalFS :114,
+HDFSClient :446, exit 134 -> FSShellCmdAborted).
+"""
+import pytest
+
+from paddle_tpu.distributed.fleet.utils import (ExecuteError,
+                                                FSFileExistsError,
+                                                FSFileNotExistsError,
+                                                FSShellCmdAborted,
+                                                HDFSClient, LocalFS)
+
+
+class TestLocalFS:
+    def test_ls_and_list_dirs(self, tmp_path):
+        fs = LocalFS()
+        (tmp_path / "d1").mkdir()
+        (tmp_path / "d2").mkdir()
+        (tmp_path / "f1").write_text("x")
+        dirs, files = fs.ls_dir(str(tmp_path))
+        assert sorted(dirs) == ["d1", "d2"] and files == ["f1"]
+        assert sorted(fs.list_dirs(str(tmp_path))) == ["d1", "d2"]
+        assert fs.ls_dir(str(tmp_path / "missing")) == ([], [])
+
+    def test_touch_mv_delete(self, tmp_path):
+        fs = LocalFS()
+        src = str(tmp_path / "a")
+        dst = str(tmp_path / "b")
+        fs.touch(src)
+        with pytest.raises(FSFileExistsError):
+            fs.touch(src, exist_ok=False)
+        fs.mv(src, dst)
+        assert not fs.is_exist(src) and fs.is_file(dst)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv(src, dst)
+        fs.touch(src)
+        with pytest.raises(FSFileExistsError):
+            fs.mv(src, dst)  # dst exists, no overwrite
+        fs.mv(src, dst, overwrite=True)
+        assert fs.is_file(dst)
+        fs.delete(dst)
+        assert not fs.is_exist(dst)
+        fs.delete(dst)  # idempotent
+
+    def test_mkdirs_upload_cat(self, tmp_path):
+        fs = LocalFS()
+        d = str(tmp_path / "x" / "y")
+        fs.mkdirs(d)
+        assert fs.is_dir(d)
+        f = tmp_path / "src.txt"
+        f.write_text("hello\n")
+        fs.upload(str(f), str(tmp_path / "x" / "dst.txt"))
+        assert fs.cat(str(tmp_path / "x" / "dst.txt")) == "hello"
+        assert not fs.need_upload_download()
+        with pytest.raises(AssertionError):
+            fs.mkdirs(str(f))  # path is a file
+
+
+class _FakeHDFS(HDFSClient):
+    """HDFSClient with the shell replaced by an in-memory fake."""
+
+    def __init__(self, tree=None, fail_times=0, abort=False):
+        super().__init__("/opt/hadoop", {"fs.default.name": "hdfs://nn:54310"})
+        self.tree = tree or {}
+        self.calls = []
+        self.fail_times = fail_times
+        self.abort = abort
+
+    def _shell(self, exe_cmd):
+        self.calls.append(exe_cmd)
+        assert exe_cmd.startswith(
+            "/opt/hadoop/bin/hadoop fs -Dfs.default.name=hdfs://nn:54310 -")
+        cmd = exe_cmd.split(" -Dfs.default.name=hdfs://nn:54310 -", 1)[1]
+        if self.abort:
+            return 134, ""
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            return 1, "transient"
+        op, _, rest = cmd.partition(" ")
+        if op == "test":
+            flag, path = rest.split()
+            flag = flag.lstrip("-")
+            entry = self.tree.get(path)
+            ok = (entry is not None and
+                  (flag == "e" or (flag == "d") == (entry == "dir")))
+            return (0 if ok else 1), ""
+        if op == "ls":
+            lines = ["Found 3 items"]
+            for name, kind in self.tree.get(rest, {}).items() \
+                    if isinstance(self.tree.get(rest), dict) else []:
+                bits = "drwxr-xr-x" if kind == "dir" else "-rw-r--r--"
+                lines.append(f"{bits} 3 u g 0 2026-07-31 10:00 "
+                             f"{rest}/{name}")
+            return 0, "\n".join(lines)
+        return 0, ""
+
+
+class TestHDFSClient:
+    def test_command_construction_and_test_flags(self):
+        fs = _FakeHDFS(tree={"/a": "file", "/d": "dir"})
+        assert fs.is_file("/a") and not fs.is_dir("/a")
+        assert fs.is_dir("/d") and fs.is_exist("/d")
+        assert not fs.is_exist("/missing")
+        assert fs.calls[0].endswith("-test -f /a")
+        assert fs.need_upload_download()
+
+    def test_ls_parsing_skips_non_entry_lines(self):
+        # a dict value marks an existing directory whose -ls output has a
+        # "Found N items" header the 8-column parse must skip
+        fs = _FakeHDFS(tree={"/data": {"sub": "dir", "part-0": "file"}})
+        dirs, files = fs.ls_dir("/data")
+        assert dirs == ["sub"] and files == ["part-0"]
+
+    def test_retry_then_success(self):
+        fs = _FakeHDFS(tree={"/x": "file"}, fail_times=2)
+        fs._sleep_inter = 0
+        ret, _ = fs._run_cmd("put /l /x")
+        assert ret == 0
+        assert len(fs.calls) == 3  # 2 failures + 1 success
+
+    def test_abort_raises(self):
+        fs = _FakeHDFS(abort=True)
+        fs._sleep_inter = 0
+        with pytest.raises(FSShellCmdAborted):
+            fs._run_cmd("rm -r /x")
+
+    def test_upload_missing_local_raises(self, tmp_path):
+        fs = _FakeHDFS()
+        with pytest.raises(FSFileNotExistsError):
+            fs.upload(str(tmp_path / "nope"), "/dst")
+
+    def test_mv_contract(self):
+        fs = _FakeHDFS(tree={"/src": "file"})
+        fs.mv("/src", "/dst")
+        assert any(c.endswith("-mv /src /dst") for c in fs.calls)
+        with pytest.raises(FSFileNotExistsError):
+            fs.mv("/gone", "/dst2")
