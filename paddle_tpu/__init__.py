@@ -78,7 +78,7 @@ _LAZY_MODULES = {
     "profiler", "autograd", "incubate", "framework", "device", "static", "hapi",
     "distribution", "linalg", "fft", "signal", "sparse", "text", "onnx", "quantization",
     "models", "utils", "inference", "native", "audio", "geometric",
-    "strings",
+    "strings", "hub",
 }
 
 

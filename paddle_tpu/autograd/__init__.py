@@ -8,6 +8,7 @@ from ..core.dispatch import (enable_grad, no_grad, set_grad_enabled_ctx as
 from ..core.tensor import Tensor
 from .engine import AccumulationNode, GradNode, run_backward
 from .pylayer import PyLayer, PyLayerContext
+from .functional import Hessian, Jacobian, hessian, jacobian
 
 
 def is_grad_enabled() -> bool:

@@ -6,6 +6,7 @@ layers/mpu/, SP utils, sharding meta-optimizers, pipeline meta-parallel).
 TPU-native: every parallelism axis is a mesh axis; layers shard weights via
 NamedSharding and XLA inserts the collectives.
 """
+from . import utils
 from .base.distributed_strategy import DistributedStrategy
 from .base.topology import (CommunicateTopology, HybridCommunicateGroup,
                             ParallelMode, get_hybrid_communicate_group)

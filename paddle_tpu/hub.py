@@ -1,0 +1,5 @@
+"""paddle.hub — re-export of the hapi hub implementation (reference:
+python/paddle/hub.py delegating to hapi/hub.py)."""
+from .hapi.hub import help, list, load  # noqa: F401
+
+__all__ = ["list", "help", "load"]

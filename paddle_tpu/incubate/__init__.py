@@ -1,5 +1,5 @@
 """paddle.incubate surface: experimental APIs kept at reference import
 paths (reference: python/paddle/incubate/)."""
-from . import asp, nn  # noqa: F401
+from . import asp, autograd, nn  # noqa: F401
 
-__all__ = ["nn", "asp"]
+__all__ = ["nn", "asp", "autograd"]

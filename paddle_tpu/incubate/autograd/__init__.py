@@ -1,0 +1,117 @@
+"""paddle.incubate.autograd — functional autograd surface.
+
+Reference: ``python/paddle/incubate/autograd/functional.py`` (vjp :22,
+jvp :80, Jacobian :170, Hessian :257). The prim-rule machinery
+(primapi/primx) is subsumed by XLA: jax transforms ARE the primitive
+rewrite layer, so ``enable_prim`` is a no-op switch kept for import
+parity.
+"""
+from ...autograd import functional as _fn
+from ...autograd.functional import jvp, vjp
+from ...core.tensor import Tensor as _Tensor
+
+
+class Jacobian:
+    """Lazy Jacobian of ``func`` at ``xs`` (reference
+    incubate/autograd/functional.py Jacobian :170 — note the
+    *callable-first* signature, unlike paddle.autograd.jacobian which
+    takes computed tensors)."""
+
+    def __init__(self, func, xs, is_batched: bool = False):
+        xs_t = (xs,) if isinstance(xs, _Tensor) else tuple(xs)
+        saved = [x.stop_gradient for x in xs_t]
+        for x in xs_t:
+            x.stop_gradient = False
+        try:
+            # build the graph (and the inner object's grad passes) while
+            # inputs are unfrozen — ops recorded on frozen tensors don't
+            # link back to them; lazy ROW evaluation later is fine on
+            # frozen leaves (the graph already exists)
+            ys = func(*xs_t)
+            batch_axis = 0 if is_batched else None
+            self._inner = _fn.jacobian(ys, xs, batch_axis)
+        finally:
+            for x, s in zip(xs_t, saved):
+                x.stop_gradient = s
+
+    @property
+    def shape(self):
+        inner = self._inner
+        return (inner.shape if not isinstance(inner, tuple)
+                else tuple(j.shape for j in inner))
+
+    def __getitem__(self, idx):
+        inner = self._inner
+        if isinstance(inner, tuple):
+            # reference: multiple xs concatenate along the input axis
+            from ... import ops
+            parts = [j[:] for j in inner]
+            return ops.concat(parts, axis=-1)[idx]
+        return inner[idx]
+
+    def numpy(self):
+        import numpy as np
+        return np.asarray(self[:].numpy())
+
+
+class Hessian(Jacobian):
+    """Lazy Hessian of scalar-valued ``func`` at ``xs`` (reference
+    Hessian :257)."""
+
+    def __init__(self, func, xs, is_batched: bool = False):
+        xs_t = (xs,) if isinstance(xs, _Tensor) else tuple(xs)
+        saved = [x.stop_gradient for x in xs_t]
+        for x in xs_t:
+            x.stop_gradient = False
+        try:
+            ys = func(*xs_t)
+            batch_axis = 0 if is_batched else None
+            # the create_graph first-grad pass must run while inputs are
+            # unfrozen (see Jacobian.__init__)
+            self._inner = _fn.hessian(ys, xs, batch_axis)
+        finally:
+            for x, s in zip(xs_t, saved):
+                x.stop_gradient = s
+
+    @property
+    def shape(self):
+        inner = self._inner
+        if not isinstance(inner, tuple):
+            return inner.shape
+        # flattened block matrix: (sum_N, sum_N) (+ leading batch)
+        ns = [row[0].shape[-2] for row in inner]
+        total = sum(ns)
+        lead = inner[0][0].shape[:-2]
+        return tuple(lead) + (total, total)
+
+    def __getitem__(self, idx):
+        inner = self._inner
+        if isinstance(inner, tuple):
+            # reference: multiple xs flatten into one block matrix
+            from ... import ops
+            rows = [ops.concat([blk[:] for blk in row], axis=-1)
+                    for row in inner]
+            return ops.concat(rows, axis=-2)[idx]
+        return inner[idx]
+
+
+_prim_enabled = False
+
+
+def enable_prim():
+    """No-op (prim rewriting is XLA's job here); kept for parity."""
+    global _prim_enabled
+    _prim_enabled = True
+
+
+def disable_prim():
+    global _prim_enabled
+    _prim_enabled = False
+
+
+def prim_enabled():
+    return _prim_enabled
+
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "enable_prim",
+           "disable_prim", "prim_enabled"]

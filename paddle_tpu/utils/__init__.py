@@ -1,4 +1,4 @@
-from . import cpp_extension, custom_op
+from . import cpp_extension, custom_op, dlpack
 from .custom_op import register_custom_op
 
-__all__ = ["cpp_extension", "custom_op", "register_custom_op"]
+__all__ = ["cpp_extension", "custom_op", "register_custom_op", "dlpack"]

@@ -1,0 +1,41 @@
+"""paddle.utils.dlpack — zero-copy tensor interchange.
+
+Reference: ``python/paddle/utils/dlpack.py`` (``to_dlpack`` /
+``from_dlpack`` over the DLPack capsule protocol). TPU-native: jax
+arrays implement ``__dlpack__``, so exchange is direct — framework ↔
+numpy/torch/cupy without a host copy where the backing buffer allows it
+(device buffers export on-device; consumers that can't see the device
+get a host copy via numpy()).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack capsule (reference to_dlpack)."""
+    if not isinstance(x, Tensor):
+        raise TypeError(f"to_dlpack expects a paddle Tensor, got {type(x)}")
+    return x._data.__dlpack__()
+
+
+def from_dlpack(dlpack) -> Tensor:
+    """Import from a DLPack capsule OR any object with ``__dlpack__``
+    (torch/cupy/numpy arrays), reference from_dlpack."""
+    if hasattr(dlpack, "__dlpack__") or hasattr(dlpack, "shape"):
+        try:
+            arr = jnp.from_dlpack(dlpack)
+        except BufferError:
+            # readonly buffers (e.g. numpy views) can't signal readonly
+            # through DLPack — fall back to a copy
+            import numpy as np
+            arr = jnp.asarray(np.array(dlpack))
+    else:
+        # raw capsule: jax.dlpack consumes legacy capsules
+        from jax import dlpack as jdl
+        arr = jdl.from_dlpack(dlpack)
+    return Tensor(arr)

@@ -1,0 +1,220 @@
+"""Higher-order functional autograd (jacobian/hessian/jvp/vjp), dlpack
+interchange, and paddle.hub.
+
+Reference contracts: python/paddle/autograd/autograd.py (:450/:544),
+python/paddle/incubate/autograd/functional.py (:22/:80/:143),
+python/paddle/utils/dlpack.py, python/paddle/hapi/hub.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as iag
+from paddle_tpu.utils import dlpack
+
+
+def _x(vals):
+    t = paddle.to_tensor(np.asarray(vals, np.float32))
+    t.stop_gradient = False
+    return t
+
+
+class TestJacobian:
+    def test_diag_square(self):
+        x = _x([1.0, 2.0, 3.0])
+        J = paddle.autograd.jacobian(x * x, x)
+        assert J.shape == (3, 3)
+        np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                                   np.diag([2.0, 4.0, 6.0]), rtol=1e-6)
+
+    def test_full_matrix_vs_jax(self):
+        import jax
+        import jax.numpy as jnp
+        W = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+        x = _x(np.random.RandomState(1).randn(4))
+        y = paddle.matmul(paddle.to_tensor(W), x).tanh()
+        J = paddle.autograd.jacobian(y, x)
+        ref = jax.jacrev(lambda v: jnp.tanh(W @ v))(jnp.asarray(
+            x.numpy()))
+        np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def test_batched(self):
+        xb = _x(np.random.RandomState(2).randn(5, 3))
+        yb = xb * xb
+        J = paddle.autograd.jacobian(yb, xb, batch_axis=0)
+        assert J.shape == (5, 3, 3)
+        full = np.asarray(J[:].numpy())
+        for b in range(5):
+            np.testing.assert_allclose(
+                full[b], np.diag(2 * np.asarray(xb.numpy())[b]),
+                rtol=1e-5)
+
+    def test_tuple_nesting(self):
+        x = _x([1.0, 2.0])
+        z = _x([3.0])
+        Js = paddle.autograd.jacobian(x * x, (x, z))
+        assert isinstance(Js, tuple) and len(Js) == 2
+        np.testing.assert_allclose(np.asarray(Js[1][:].numpy()), 0.0)
+
+
+class TestHessian:
+    def test_cubic(self):
+        x = _x([1.0, 2.0])
+        s = (x * x * x).sum()
+        H = paddle.autograd.hessian(s, x)
+        np.testing.assert_allclose(np.asarray(H[:].numpy()),
+                                   np.diag([6.0, 12.0]), rtol=1e-6)
+
+    def test_cross_terms_vs_jax(self):
+        import jax
+        import jax.numpy as jnp
+        x = _x([0.5, -1.0, 2.0])
+        s = (x[0] * x[1] * x[2] + (x * x).sum())
+        H = paddle.autograd.hessian(s, x)
+        ref = jax.hessian(
+            lambda v: v[0] * v[1] * v[2] + (v * v).sum())(
+                jnp.asarray(x.numpy()))
+        np.testing.assert_allclose(np.asarray(H[:].numpy()),
+                                   np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+    def test_nonscalar_rejected(self):
+        x = _x([1.0, 2.0])
+        with pytest.raises(ValueError):
+            paddle.autograd.hessian(x * x, x)
+
+
+class TestVjpJvp:
+    def test_vjp(self):
+        xs = paddle.to_tensor(np.array([1.0, 3.0], np.float32))
+        v = paddle.to_tensor(np.array([2.0, 0.5], np.float32))
+        ys, g = iag.vjp(lambda a: a * a, xs, v)
+        np.testing.assert_allclose(ys.numpy(), [1.0, 9.0], rtol=1e-6)
+        np.testing.assert_allclose(g.numpy(), [4.0, 3.0], rtol=1e-6)
+
+    def test_jvp_equals_forward_mode(self):
+        import jax
+        import jax.numpy as jnp
+        xs = paddle.to_tensor(np.array([0.3, -1.2, 2.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.5, -2.0], np.float32))
+
+        def f(a):
+            return (a * a).sum() * a  # non-diagonal jacobian
+
+        _, jv = iag.jvp(f, xs, v)
+        _, ref = jax.jvp(
+            lambda a: (a * a).sum() * a,
+            (jnp.asarray(xs.numpy()),), (jnp.asarray(v.numpy()),))
+        np.testing.assert_allclose(jv.numpy(), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_incubate_jacobian_class_func_first(self):
+        # reference incubate signature: Jacobian(func, xs, is_batched)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        J = iag.Jacobian(lambda a: a * a, x)
+        np.testing.assert_allclose(np.asarray(J[:].numpy()),
+                                   np.diag([2.0, 4.0]), rtol=1e-6)
+        assert J.shape == (2, 2)
+
+    def test_incubate_hessian_class_multi_input_flattens(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        z = paddle.to_tensor(np.array([3.0], np.float32))
+
+        def f(a, b):
+            return (a * a).sum() + a.sum() * b.sum()
+
+        H = iag.Hessian(f, (x, z))
+        assert H.shape == (3, 3)
+        full = np.asarray(H[:].numpy())
+        expect = np.array([[2.0, 0.0, 1.0],
+                           [0.0, 2.0, 1.0],
+                           [1.0, 1.0, 0.0]], np.float32)
+        np.testing.assert_allclose(full, expect, rtol=1e-5, atol=1e-6)
+
+    def test_vjp_unused_input_zero_filled_and_flags_restored(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        z = paddle.to_tensor(np.array([5.0], np.float32))
+        assert x.stop_gradient and z.stop_gradient  # frozen going in
+        ys, grads = iag.vjp(lambda a, b: a * a, (x, z),
+                            paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(grads[0].numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(grads[1].numpy(), [0.0])  # not None
+        assert x.stop_gradient and z.stop_gradient  # restored
+
+    def test_hessian_tuple_xs_cross_blocks(self):
+        x = _x([1.0, 2.0])
+        z = _x([3.0])
+        s = (x * x).sum() + x.sum() * z.sum()
+        H = paddle.autograd.hessian(s, (x, z))
+        assert isinstance(H, tuple) and isinstance(H[0], tuple)
+        np.testing.assert_allclose(np.asarray(H[0][0][:].numpy()),
+                                   np.diag([2.0, 2.0]), rtol=1e-6)
+        # the cross-partial block d2s/dx dz = [1, 1]
+        np.testing.assert_allclose(
+            np.asarray(H[0][1][:].numpy()).reshape(-1), [1.0, 1.0],
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(H[1][0][:].numpy()).reshape(-1), [1.0, 1.0],
+            rtol=1e-6)
+
+    def test_single_row_getitem_lazy(self):
+        x = _x([1.0, 2.0, 3.0])
+        J = paddle.autograd.jacobian(x * x, x)
+        row = J[1]
+        np.testing.assert_allclose(row.numpy(), [0.0, 4.0, 0.0],
+                                   rtol=1e-6)
+        assert len(J._rows) == 1  # only the accessed row was computed
+
+
+class TestDlpack:
+    def test_roundtrip_numpy(self):
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        back = dlpack.from_dlpack(np.asarray(t.numpy()))
+        np.testing.assert_allclose(back.numpy(), t.numpy())
+
+    def test_torch_interop(self):
+        torch = pytest.importorskip("torch")
+        t = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        tt = torch.utils.dlpack.from_dlpack(dlpack.to_dlpack(t))
+        np.testing.assert_allclose(tt.numpy(), t.numpy())
+        back = dlpack.from_dlpack(torch.arange(4).float())
+        np.testing.assert_allclose(back.numpy(), [0, 1, 2, 3])
+
+    def test_type_error(self):
+        with pytest.raises(TypeError):
+            dlpack.to_dlpack(np.zeros(3))
+
+
+class TestHub:
+    @pytest.fixture()
+    def repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "dependencies = ['numpy']\n"
+            "def lenet(**kw):\n"
+            "    '''A LeNet entrypoint.'''\n"
+            "    import paddle_tpu as p\n"
+            "    return p.vision.models.LeNet(**kw)\n"
+            "def _private():\n    pass\n")
+        return str(tmp_path)
+
+    def test_list_help_load(self, repo):
+        assert paddle.hub.list(repo, source="local") == ["lenet"]
+        assert "LeNet" in paddle.hub.help(repo, "lenet", source="local")
+        m = paddle.hub.load(repo, "lenet", source="local")
+        assert type(m).__name__ == "LeNet"
+
+    def test_remote_sources_gated(self, repo):
+        with pytest.raises(RuntimeError, match="egress"):
+            paddle.hub.load("owner/repo", "m", source="github")
+        with pytest.raises(ValueError, match="Unknown source"):
+            paddle.hub.list(repo, source="ftp")
+
+    def test_missing_entry_and_dependency(self, repo, tmp_path):
+        with pytest.raises(RuntimeError, match="Cannot find callable"):
+            paddle.hub.load(repo, "nope", source="local")
+        bad = tmp_path / "bad"
+        bad.mkdir()
+        (bad / "hubconf.py").write_text(
+            "dependencies = ['definitely_not_a_module_xyz']\n")
+        with pytest.raises(RuntimeError, match="Missing dependencies"):
+            paddle.hub.list(str(bad), source="local")
