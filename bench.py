@@ -385,24 +385,28 @@ def _bench_serving(small):
                                 + new_tokens) // 32 * batch * 2),
             max_blocks_per_seq=64)
 
-    # warmup: compile prefill+decode programs once
+    # ONE engine per mode, reused across requests — fresh engines would
+    # re-jit their closures and the timings would measure compilation
     eng = engine(max_batch)
-    eng.add_request(prompts[0], max_new_tokens=4)
-    eng.run_to_completion()
+    e1 = engine(1)
+
+    # warmup: compile prefill+decode programs for both engines
+    for e in (eng, e1):
+        e.add_request(prompts[0], max_new_tokens=4)
+        e.run_to_completion()
 
     # continuous batching: one burst, all requests queued up front
-    eng = engine(max_batch)
     t0 = time.perf_counter()
     rids = [eng.add_request(p, max_new_tokens=new_tokens) for p in prompts]
     out = eng.run_to_completion()
     dt_batched = time.perf_counter() - t0
     total_new = sum(len(out[r]) for r in rids)
 
-    # single stream: same requests, one at a time (batching disabled)
+    # single stream: same requests through the single-slot engine, one
+    # at a time (no batching, no recompiles)
     t0 = time.perf_counter()
     single_total = 0
     for p in prompts:
-        e1 = engine(1)
         rid = e1.add_request(p, max_new_tokens=new_tokens)
         single_total += len(e1.run_to_completion()[rid])
     dt_single = time.perf_counter() - t0
