@@ -29,8 +29,16 @@ from .ops import *  # noqa: F401,F403
 from .ops import creation as _creation
 from .ops.creation import to_tensor
 from .autograd import backward, grad, is_grad_enabled, PyLayer
+from .batch import batch
 
 CUDAPlace = TPUPlace  # source-compat alias: accelerator place
+
+
+def flops(net, input_size=None, custom_ops=None, print_detail=False,
+          inputs=None):
+    from .hapi.dynamic_flops import flops as _flops
+    return _flops(net, input_size, custom_ops=custom_ops,
+                  print_detail=print_detail, inputs=inputs)
 
 
 def is_compiled_with_cuda():
@@ -78,7 +86,15 @@ _LAZY_MODULES = {
     "profiler", "autograd", "incubate", "framework", "device", "static", "hapi",
     "distribution", "linalg", "fft", "signal", "sparse", "text", "onnx", "quantization",
     "models", "utils", "inference", "native", "audio", "geometric",
-    "strings", "hub",
+    "strings", "hub", "regularizer", "version", "sysconfig",
+}
+
+#: top-level names resolved lazily from submodules (avoids importing
+#: hapi/nn at package import)
+_LAZY_ATTRS = {
+    "Model": ("paddle_tpu.hapi.model", "Model"),
+    "callbacks": ("paddle_tpu.hapi", "callbacks"),
+    "LazyGuard": ("paddle_tpu.nn.lazy_init", "LazyGuard"),
 }
 
 
@@ -87,4 +103,9 @@ def __getattr__(name):
         mod = importlib.import_module(f".{name}", __name__)
         globals()[name] = mod
         return mod
+    if name in _LAZY_ATTRS:
+        mod_name, attr = _LAZY_ATTRS[name]
+        value = getattr(importlib.import_module(mod_name), attr)
+        globals()[name] = value
+        return value
     raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")

@@ -1,0 +1,29 @@
+"""paddle.batch — legacy batched-reader combinator (reference:
+python/paddle/batch.py)."""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap an item reader into a mini-batch reader.
+
+    ``reader`` is a zero-arg callable returning an iterable; the result
+    is the same, yielding lists of ``batch_size`` items (final short
+    batch kept unless ``drop_last``).
+    """
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -5,8 +5,8 @@ Reference surface: python/paddle/distributed/__init__.py.
 from . import mesh
 from .mesh import build_mesh, get_mesh, set_mesh
 from .communication.group import (Group, destroy_process_group,
-                                  get_default_group, is_initialized,
-                                  new_group)
+                                  get_default_group, get_group,
+                                  is_initialized, new_group)
 from .communication.collective import (P2POp, ReduceOp, all_gather,
                                        all_gather_object, all_reduce,
                                        all_to_all, alltoall, alltoall_single,

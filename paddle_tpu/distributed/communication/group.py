@@ -54,6 +54,7 @@ class Group:
 
 _group_counter = itertools.count(1)
 _default_group: Optional[Group] = None
+_group_registry: dict = {}
 
 
 def get_default_group() -> Group:
@@ -64,35 +65,51 @@ def get_default_group() -> Group:
     return _default_group
 
 
+def get_group(id: int = 0) -> Group:
+    """Group instance by id (reference communication/group.py:199)."""
+    if id == 0:
+        return get_default_group()
+    try:
+        return _group_registry[id]
+    except KeyError:
+        raise ValueError(f"no group with id {id}; create it via new_group")
+
+
 def set_default_group(g: Group):
     global _default_group
     _default_group = g
+
+
+def _register(g: Group) -> Group:
+    _group_registry[g.id] = g
+    return g
 
 
 def new_group(ranks=None, backend=None, timeout=None, axes=None) -> Group:
     """Create a group. Preferred TPU form: ``new_group(axes=('dp',))``.
     Rank-list form maps onto the default mesh's flat device order."""
     if axes is not None:
-        return Group(tuple(axes) if not isinstance(axes, str) else (axes,),
-                     gid=next(_group_counter))
+        return _register(Group(
+            tuple(axes) if not isinstance(axes, str) else (axes,),
+            gid=next(_group_counter)))
     mesh = mesh_mod.get_mesh()
     n = mesh.devices.size
     if ranks is None or sorted(ranks) == list(range(n)):
-        return Group(tuple(mesh.axis_names), mesh=mesh,
-                     gid=next(_group_counter))
+        return _register(Group(tuple(mesh.axis_names), mesh=mesh,
+                               gid=next(_group_counter)))
     # Sub-axis group: find the mesh axis whose slices match the rank list.
-    flat = mesh.devices.reshape(-1)
     for ax_idx, ax in enumerate(mesh.axis_names):
         arr = np.arange(n).reshape(mesh.devices.shape)
         moved = np.moveaxis(arr, ax_idx, -1).reshape(-1, mesh.shape[ax])
         for row in moved:
             if sorted(ranks) == sorted(row.tolist()):
-                return Group((ax,), mesh=mesh, ranks=sorted(ranks),
-                             gid=next(_group_counter))
+                return _register(Group((ax,), mesh=mesh,
+                                       ranks=sorted(ranks),
+                                       gid=next(_group_counter)))
     # Fallback: treat as a group over all axes with explicit ranks (host
     # mediated paths may use the rank list).
-    return Group(tuple(mesh.axis_names), mesh=mesh, ranks=list(ranks),
-                 gid=next(_group_counter))
+    return _register(Group(tuple(mesh.axis_names), mesh=mesh,
+                           ranks=list(ranks), gid=next(_group_counter)))
 
 
 def is_initialized() -> bool:
@@ -102,3 +119,4 @@ def is_initialized() -> bool:
 def destroy_process_group(group=None):
     global _default_group
     _default_group = None
+    _group_registry.clear()

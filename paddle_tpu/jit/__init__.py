@@ -6,3 +6,4 @@ sot/ (reference python/paddle/jit/sot/).
 """
 from .api import to_static, not_to_static, in_capture_mode, ignore_module
 from .api import save, load, TranslatedLayer
+from .traced_layer import TracedLayer

@@ -4,6 +4,7 @@ Reference surface: python/paddle/nn/__init__.py.
 """
 from . import functional
 from . import initializer
+from . import quant
 from .parameter import Parameter, ParamAttr, create_parameter
 from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer

@@ -16,6 +16,7 @@ import numpy as np
 
 from ...core import dtype as dtypes
 from ...core.tensor import Tensor
+from ..lazy_init import has_outstanding, materialize_layer
 from ..parameter import Parameter, ParamAttr, create_parameter
 
 
@@ -321,6 +322,8 @@ class Layer:
         raise NotImplementedError
 
     def __call__(self, *inputs, **kwargs):
+        if has_outstanding():  # LazyGuard-deferred params: init now
+            materialize_layer(self)
         for hook in list(self._forward_pre_hooks.values()):
             result = hook(self, inputs)
             if result is not None:

@@ -69,8 +69,17 @@ def create_parameter(shape, dtype=dtypes.float32, attr=None, is_bias=False,
     if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
     dtype = dtypes.convert_dtype(dtype)
-    data = init(shape, dtype)
+    from . import lazy_init
+    if lazy_init.in_lazy_mode():
+        # defer: shape/dtype inspection works via ShapeDtypeStruct,
+        # compute waits for materialization (reference LazyGuard)
+        import jax
+        data = jax.ShapeDtypeStruct(tuple(shape), dtype)
+    else:
+        data = init(shape, dtype)
     p = Parameter(data, trainable=attr.trainable, name=attr.name)
+    if lazy_init.in_lazy_mode():
+        lazy_init._register(p, init, shape, dtype)
     p.optimize_attr = {"learning_rate": attr.learning_rate}
     p.regularizer = attr.regularizer
     p.need_clip = attr.need_clip

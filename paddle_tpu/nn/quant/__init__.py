@@ -1,0 +1,11 @@
+"""paddle.nn.quant — quantization layer/functional namespace (reference:
+python/paddle/nn/quant/ — quantized functional ops and layers; here they
+re-export the TPU-native quantization implementations)."""
+from ...quantization import (QAT, PTQ, QuantConfig, QuantedLinear,
+                             fake_quant, llm_int8_linear,
+                             weight_dequantize, weight_only_linear,
+                             weight_quantize)
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear", "fake_quant", "QuantConfig", "QuantedLinear",
+           "PTQ", "QAT"]

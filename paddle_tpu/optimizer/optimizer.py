@@ -106,7 +106,11 @@ class Optimizer:
         raise NotImplementedError
 
     def _wd_flag(self, p) -> float:
-        """Per-param weight-decay gate; subclasses override."""
+        """Per-param weight-decay gate; subclasses override. A param
+        carrying its own ParamAttr regularizer opts out of the
+        optimizer-level decay (reference priority rule)."""
+        if getattr(p, "regularizer", None) is not None:
+            return 0.0
         return 1.0
 
     def _tree_step(self, lr, step, params, grads, masters, states, lr_mults,
@@ -139,6 +143,22 @@ class Optimizer:
 
         for p in params:
             self._ensure_state(p)
+
+        # ParamAttr-level regularizers take priority over the optimizer's
+        # (reference regularizer.py): fold them here, per param; the
+        # optimizer-level decay is gated off for those params via _wd_flag
+        if any(getattr(p, "regularizer", None) is not None for p in params):
+            from ..regularizer import L1Decay
+            folded = []
+            for p, g in zip(params, grads):
+                reg = getattr(p, "regularizer", None)
+                if reg is not None:
+                    coeff = float(getattr(reg, "_coeff", 0.0))
+                    fold = (jnp.sign(p._data) if isinstance(reg, L1Decay)
+                            else p._data)
+                    g = Tensor(g._data + coeff * fold.astype(g._data.dtype))
+                folded.append(g)
+            grads = folded
 
         self._step_count += 1
         lr = jnp.asarray(self.get_lr(), dtype=jnp.float32)
@@ -223,11 +243,18 @@ class Optimizer:
                 self._master_weights[id(p)] = (
                     v._data if isinstance(v, Tensor) else jnp.asarray(v))
 
-    def _apply_decay(self, w, g):
-        """L2 regularization folded into the gradient (reference
-        regularizer.py L2Decay applied in optimizer)."""
+    def _apply_decay(self, w, g, wd_flag=1.0):
+        """Optimizer-level regularization folded into the gradient
+        (reference regularizer.py: L2Decay → g + coeff·w, L1Decay → g +
+        coeff·sign(w)). ``wd_flag`` is the per-param gate — 0.0 for
+        params carrying their own ParamAttr regularizer (which takes
+        priority and is folded in ``step``) or excluded by
+        apply_decay_param_fun."""
         if self._weight_decay:
-            return g + self._weight_decay * w
+            from ..regularizer import L1Decay
+            if isinstance(self.regularization, L1Decay):
+                return g + wd_flag * self._weight_decay * jnp.sign(w)
+            return g + wd_flag * self._weight_decay * w
         return g
 
 
@@ -243,7 +270,7 @@ Optimizer.minimize = _minimize
 
 class SGD(Optimizer):
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         return w - lr * g, state
 
 
@@ -259,7 +286,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         v = self._momentum * state["velocity"] + g
         if self._nesterov:
             new_w = w - lr * (g + self._momentum * v)
@@ -285,7 +312,7 @@ class Adam(Optimizer):
             self._state_names = self._state_names + ["moment2_max"]
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         b1, b2 = self._beta1, self._beta2
         t = step.astype(jnp.float32)
         m = b1 * state["moment1"] + (1 - b1) * g
@@ -316,6 +343,8 @@ class AdamW(Adam):
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _wd_flag(self, p):
+        if getattr(p, "regularizer", None) is not None:
+            return 0.0  # ParamAttr regularizer overrides decoupled wd
         if self._apply_decay_param_fun is not None:
             return 1.0 if self._apply_decay_param_fun(p.name) else 0.0
         return 1.0
@@ -348,7 +377,7 @@ class Adagrad(Optimizer):
                                         self._init_value)}
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         mom = state["moment"] + g * g
         return w - lr * g / (jnp.sqrt(mom) + self._epsilon), {"moment": mom}
 
@@ -367,7 +396,7 @@ class RMSProp(Optimizer):
         self._centered = centered
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         ms = self._rho * state["mean_square"] + (1 - self._rho) * g * g
         if self._centered:
             mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
@@ -391,7 +420,7 @@ class Adadelta(Optimizer):
         self._rho = rho
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * g * g
         update = (jnp.sqrt(state["avg_squared_update"] + self._epsilon)
                   / jnp.sqrt(asg + self._epsilon)) * g
@@ -412,7 +441,7 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         t = step.astype(jnp.float32)
         m = self._beta1 * state["moment"] + (1 - self._beta1) * g
         u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
@@ -455,7 +484,7 @@ class Lamb(Optimizer):
 
 class NAdam(Adam):
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         b1, b2 = self._beta1, self._beta2
         t = step.astype(jnp.float32)
         m = b1 * state["moment1"] + (1 - b1) * g
@@ -468,7 +497,7 @@ class NAdam(Adam):
 
 class RAdam(Adam):
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
-        g = self._apply_decay(w, g)
+        g = self._apply_decay(w, g, wd_flag)
         b1, b2 = self._beta1, self._beta2
         t = step.astype(jnp.float32)
         m = b1 * state["moment1"] + (1 - b1) * g

@@ -1,0 +1,35 @@
+"""paddle.regularizer — weight-decay regularizers.
+
+Reference: ``python/paddle/regularizer.py`` (``L1Decay`` :51, ``L2Decay``
+:169 — both applied by folding into the gradient inside the optimizer;
+ParamAttr-level regularizers take priority over the optimizer-level one).
+
+TPU-native: the fold happens inside the jitted optimizer step
+(``Optimizer._apply_decay``), so the decay term fuses into the update
+kernel instead of materializing a separate regularizer op graph.
+"""
+from __future__ import annotations
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    """Base (reference WeightDecayRegularizer)."""
+
+    def __init__(self, coeff: float = 0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self) -> float:
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|w|): gradient fold g + coeff * sign(w)."""
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += 0.5 * coeff * sum(w^2): gradient fold g + coeff * w."""

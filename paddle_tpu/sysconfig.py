@@ -1,0 +1,18 @@
+"""paddle.sysconfig — install paths for native extension builds
+(reference: python/paddle/sysconfig.py get_include/get_lib)."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of C headers shipped with the package (the native
+    runtime's sources double as the public header surface)."""
+    return os.path.join(_ROOT, "native", "src")
+
+
+def get_lib() -> str:
+    """Directory of built native libraries."""
+    return os.path.join(_ROOT, "native")

@@ -1,0 +1,36 @@
+"""paddle.version — version metadata (reference: python/paddle/version
+generated at build time; fields mirrored here for API parity)."""
+
+full_version = "3.0.0+tpu"
+major = "3"
+minor = "0"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+with_gpu = "OFF"
+with_tpu = "ON"
+cuda_version = "False"
+cudnn_version = "False"
+istaged = False
+
+
+def show():
+    """Print version info (reference version.show())."""
+    print(f"full_version: {full_version}")
+    print(f"major: {major}")
+    print(f"minor: {minor}")
+    print(f"patch: {patch}")
+    print(f"commit: {commit}")
+    print(f"with_tpu: {with_tpu}")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version
+
+
+def xpu():
+    return "False"

@@ -3,5 +3,7 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
+from .image import get_image_backend, image_load, set_image_backend
 
-__all__ = ["models", "ops", "transforms", "datasets"]
+__all__ = ["models", "ops", "transforms", "datasets",
+           "set_image_backend", "get_image_backend", "image_load"]

@@ -1,0 +1,218 @@
+"""API-tail surfaces: paddle.flops, paddle.batch, regularizer, Model /
+callbacks aliases, version/sysconfig, nn.quant, get_group, vision image
+backend, jit.TracedLayer, LazyGuard.
+
+Reference contracts: hapi/dynamic_flops.py, batch.py, regularizer.py,
+nn/initializer/lazy_init.py, base/dygraph/jit.py TracedLayer,
+communication/group.py get_group, vision/image.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ------------------------------------------------------------------ flops
+def test_flops_lenet_counts():
+    net = paddle.vision.models.LeNet()
+    total = paddle.flops(net, input_size=[1, 1, 28, 28])
+    assert total > 0
+    # conv1: 6 out-ch of 3x3x1 kernels on 28x28 output (padding=1)
+    # contributes 28*28*6*9 = 42336; total must exceed just that
+    assert total > 42_000
+
+
+def test_flops_custom_ops_and_detail(capsys):
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU())
+
+    def my_linear(m, x, y):
+        m._flops_ops += 999
+
+    total = paddle.flops(net, input_size=[2, 4],
+                         custom_ops={paddle.nn.Linear: my_linear},
+                         print_detail=True)
+    assert total == 999 + 2 * 8  # custom linear + relu elementwise
+    out = capsys.readouterr().out
+    assert "Total Flops" in out and "Linear" in out
+
+
+# ------------------------------------------------------------------ batch
+def test_batch_reader():
+    r = paddle.batch(lambda: iter(range(10)), batch_size=4)
+    assert list(r()) == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+    r2 = paddle.batch(lambda: iter(range(10)), batch_size=4,
+                      drop_last=True)
+    assert list(r2()) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    with pytest.raises(ValueError):
+        paddle.batch(lambda: iter([]), batch_size=0)
+
+
+# ------------------------------------------------------------ regularizer
+def test_l2_decay_in_optimizer():
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    w = paddle.to_tensor(np.array([2.0, -4.0], np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.SGD(parameters=[w], learning_rate=1.0,
+                               weight_decay=L2Decay(0.1))
+    (w * 0.0).sum().backward()  # zero loss grad; decay only
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [2.0 - 0.2, -4.0 + 0.4],
+                               rtol=1e-6)
+
+
+def test_l1_decay_uses_sign():
+    from paddle_tpu.regularizer import L1Decay
+    w = paddle.to_tensor(np.array([2.0, -4.0], np.float32))
+    w.stop_gradient = False
+    opt = paddle.optimizer.SGD(parameters=[w], learning_rate=1.0,
+                               weight_decay=L1Decay(0.1))
+    (w * 0.0).sum().backward()
+    opt.step()
+    # L1: w -= lr * coeff * sign(w) — magnitude-independent
+    np.testing.assert_allclose(w.numpy(), [1.9, -3.9], rtol=1e-6)
+
+
+def test_param_attr_regularizer_overrides_optimizer():
+    """ParamAttr-level regularizer takes priority (reference
+    regularizer.py contract)."""
+    from paddle_tpu.nn.parameter import ParamAttr, create_parameter
+    from paddle_tpu.regularizer import L1Decay, L2Decay
+    import paddle_tpu.nn.initializer as I
+
+    p = create_parameter([2], attr=ParamAttr(regularizer=L1Decay(0.5)),
+                         default_initializer=I.Constant(2.0))
+    q = create_parameter([2], default_initializer=I.Constant(2.0))
+    opt = paddle.optimizer.SGD(parameters=[p, q], learning_rate=1.0,
+                               weight_decay=L2Decay(0.1))
+    ((p + q) * 0.0).sum().backward()
+    opt.step()
+    # p: its own L1 (0.5 * sign(2)=0.5), NOT the optimizer L2
+    np.testing.assert_allclose(p.numpy(), [1.5, 1.5], rtol=1e-6)
+    # q: optimizer-level L2 (0.1 * 2.0)
+    np.testing.assert_allclose(q.numpy(), [1.8, 1.8], rtol=1e-6)
+
+
+def test_destroy_process_group_clears_registry():
+    from paddle_tpu.distributed import (destroy_process_group, get_group,
+                                        new_group)
+    g = new_group(axes=("dp",))
+    assert get_group(g.id) is g
+    destroy_process_group()
+    with pytest.raises(ValueError):
+        get_group(g.id)
+
+
+def test_abandoned_lazy_model_stops_taxing_calls():
+    from paddle_tpu.nn import lazy_init
+    with paddle.LazyGuard():
+        abandoned = paddle.nn.Linear(4, 4)
+    assert lazy_init.has_outstanding()
+    del abandoned
+    import gc
+    gc.collect()
+    # weakrefs released: the global gate is closed again
+    assert not lazy_init.has_outstanding()
+
+
+def test_traced_layer_fetch_filter(tmp_path):
+    class TwoOut(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(3, 3)
+
+        def forward(self, x):
+            y = self.lin(x)
+            return y, y * 2.0
+
+    net = TwoOut()
+    x = paddle.to_tensor(np.ones((1, 3), np.float32))
+    (out0, out1), traced = paddle.jit.TracedLayer.trace(net, [x])
+    path = str(tmp_path / "fetch1")
+    traced.save_inference_model(path, fetch=[1])
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(np.asarray(loaded(x).numpy()),
+                               np.asarray(out1.numpy()), rtol=1e-5)
+    with pytest.raises(NotImplementedError):
+        traced.save_inference_model(str(tmp_path / "feedx"), feed=[0])
+
+
+# ---------------------------------------------------------------- aliases
+def test_top_level_aliases():
+    assert paddle.Model is paddle.hapi.Model
+    assert paddle.callbacks.EarlyStopping is paddle.hapi.EarlyStopping
+    assert paddle.version.full_version
+    paddle.version.show()
+    import os
+    assert os.path.isdir(paddle.sysconfig.get_include())
+    assert paddle.nn.quant.weight_quantize is not None
+    from paddle_tpu.distributed import get_group, new_group
+    g = new_group(axes=("dp",))
+    assert get_group(g.id) is g
+    assert get_group(0).id == 0
+    with pytest.raises(ValueError):
+        get_group(999999)
+
+
+def test_vision_image_backend(tmp_path):
+    from paddle_tpu.vision import (get_image_backend, image_load,
+                                   set_image_backend)
+    assert get_image_backend() == "pil"
+    with pytest.raises(ValueError):
+        set_image_backend("bogus")
+    from PIL import Image
+    p = tmp_path / "img.png"
+    Image.fromarray(np.zeros((4, 5, 3), np.uint8)).save(p)
+    img = image_load(str(p))
+    assert img.size == (5, 4)
+    t = image_load(str(p), backend="tensor")
+    assert list(t.shape) == [4, 5, 3]
+
+
+# ------------------------------------------------------------ TracedLayer
+def test_traced_layer_trace_and_replay(tmp_path):
+    net = paddle.nn.Linear(4, 3)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 4)
+                         .astype(np.float32))
+    out, traced = paddle.jit.TracedLayer.trace(net, [x])
+    replay = traced([x])
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(replay.numpy()), rtol=1e-5)
+    path = str(tmp_path / "traced_model")
+    traced.save_inference_model(path)
+    loaded = paddle.jit.load(path)
+    np.testing.assert_allclose(np.asarray(loaded(x).numpy()),
+                               np.asarray(out.numpy()), rtol=1e-5)
+
+
+# -------------------------------------------------------------- LazyGuard
+def test_lazy_guard_defers_then_materializes():
+    from paddle_tpu.nn.lazy_init import has_outstanding
+
+    with paddle.LazyGuard():
+        net = paddle.nn.Linear(8, 16)
+    # deferred: shape/dtype visible, no device buffer yet
+    assert list(net.weight.shape) == [8, 16]
+    assert has_outstanding()
+    import jax
+    assert isinstance(net.weight._data, jax.ShapeDtypeStruct)
+    # first forward materializes
+    y = net(paddle.to_tensor(np.ones((2, 8), np.float32)))
+    assert not isinstance(net.weight._data, jax.ShapeDtypeStruct)
+    assert list(y.shape) == [2, 16]
+    # initializer really ran (xavier: nonzero weights, zero bias)
+    assert float(np.abs(np.asarray(net.weight.numpy())).sum()) > 0
+    np.testing.assert_allclose(np.asarray(net.bias.numpy()), 0.0)
+
+
+def test_lazy_guard_explicit_materialize():
+    from paddle_tpu.nn.lazy_init import materialize_layer
+    with paddle.LazyGuard():
+        net = paddle.nn.Sequential(paddle.nn.Linear(3, 3),
+                                   paddle.nn.Linear(3, 2))
+    n = materialize_layer(net)
+    assert n == 4  # 2 weights + 2 biases
+    assert materialize_layer(net) == 0  # idempotent
+    # normal (non-guard) construction is unaffected
+    net2 = paddle.nn.Linear(2, 2)
+    import jax
+    assert not isinstance(net2.weight._data, jax.ShapeDtypeStruct)
