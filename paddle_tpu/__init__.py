@@ -15,8 +15,18 @@ __version__ = "0.1.0"
 
 from .core import dtype as _dtype_mod
 from .core.dtype import (bfloat16, bool_ as bool8, complex64, complex128, float16,
-                         float32, float64, int8, int16, int32, int64, uint8)
+                         float32, float64, int8, int16, int32, int64, uint8,
+                         float8_e4m3fn, float8_e5m2, iinfo, finfo,
+                         get_default_dtype, set_default_dtype)
 from .core.tensor import Tensor, as_tensor, is_tensor
+
+import numpy as _np
+
+#: paddle.dtype / paddle.bool — our dtypes ARE numpy dtype instances, so
+#: the dtype "class" is np.dtype (isinstance(paddle.float32, paddle.dtype)
+#: holds, matching the reference contract)
+dtype = _np.dtype
+bool = bool8  # noqa: A001 - reference exports `paddle.bool`
 from .core.dispatch import no_grad, enable_grad, set_grad_enabled_ctx as set_grad_enabled
 from .core.generator import seed, get_rng_state, set_rng_state, Generator
 from .core.flags import get_flags, set_flags, define_flag
@@ -32,6 +42,50 @@ from .autograd import backward, grad, is_grad_enabled, PyLayer
 from .batch import batch
 
 CUDAPlace = TPUPlace  # source-compat alias: accelerator place
+CUDAPinnedPlace = CPUPlace  # pinned host memory: host-side here
+
+
+def shape(x):
+    """Shape of ``x`` as an int32 tensor (reference paddle.shape)."""
+    return to_tensor(_np.asarray(x.shape, _np.int32))
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor print formatting (reference set_printoptions); applies to
+    the numpy formatter Tensor.__repr__ uses."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op (reference disables C++ fault handlers; none here)."""
+
+
+def check_shape(shape_val, op_name="", expected_element_type=(int,)):
+    """Shape validation helper (reference base/data_feeder.py
+    check_shape: a shape is a list/tuple of ints or an int tensor)."""
+    if isinstance(shape_val, Tensor):
+        return
+    if not isinstance(shape_val, (list, tuple)):
+        raise TypeError(
+            f"{op_name}: shape must be list/tuple/Tensor, got "
+            f"{type(shape_val)}")
+    for item in shape_val:
+        if not isinstance(item, expected_element_type + (Tensor,)):
+            raise TypeError(
+                f"{op_name}: shape element must be int/Tensor, got "
+                f"{type(item)}")
 
 
 def flops(net, input_size=None, custom_ops=None, print_detail=False,
@@ -95,6 +149,13 @@ _LAZY_ATTRS = {
     "Model": ("paddle_tpu.hapi.model", "Model"),
     "callbacks": ("paddle_tpu.hapi", "callbacks"),
     "LazyGuard": ("paddle_tpu.nn.lazy_init", "LazyGuard"),
+    "ParamAttr": ("paddle_tpu.nn.parameter", "ParamAttr"),
+    "create_parameter": ("paddle_tpu.nn.parameter", "create_parameter"),
+    "DataParallel": ("paddle_tpu.distributed.parallel", "DataParallel"),
+    "get_cuda_rng_state": ("paddle_tpu.framework.random",
+                           "get_cuda_rng_state"),
+    "set_cuda_rng_state": ("paddle_tpu.framework.random",
+                           "set_cuda_rng_state"),
 }
 
 

@@ -44,9 +44,54 @@ _NAME_TO_DTYPE = {
     "fp64": float64,
 }
 
+# 8-bit floats (reference paddle.float8_e4m3fn / float8_e5m2; backed by
+# ml_dtypes, which jax ships)
+try:
+    import ml_dtypes as _ml
+
+    float8_e4m3fn = np.dtype(_ml.float8_e4m3fn)
+    float8_e5m2 = np.dtype(_ml.float8_e5m2)
+    _NAME_TO_DTYPE["float8_e4m3fn"] = float8_e4m3fn
+    _NAME_TO_DTYPE["float8_e5m2"] = float8_e5m2
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    float8_e4m3fn = float8_e5m2 = None
+
 FLOATING = {float16, bfloat16, float32, float64}
 INTEGER = {uint8, int8, int16, int32, int64}
 COMPLEX = {complex64, complex128}
+
+#: process-wide default float dtype (reference set_default_dtype)
+_DEFAULT_FLOAT = {"value": float32}
+
+
+def set_default_dtype(d) -> None:
+    """Default dtype for float-valued creation (reference
+    paddle.set_default_dtype; float16/bfloat16/float32/float64)."""
+    nd = convert_dtype(d)
+    if nd not in FLOATING:
+        raise TypeError(
+            f"set_default_dtype only supports float dtypes, got {d!r}")
+    _DEFAULT_FLOAT["value"] = nd
+
+
+def get_default_dtype() -> str:
+    return str(_DEFAULT_FLOAT["value"])
+
+
+def default_float_dtype() -> np.dtype:
+    return _DEFAULT_FLOAT["value"]
+
+
+def iinfo(d):
+    """Integer dtype limits (reference paddle.iinfo)."""
+    return np.iinfo(convert_dtype(d))
+
+
+def finfo(d):
+    """Float dtype limits (reference paddle.finfo); ml_dtypes covers
+    bfloat16/float8."""
+    import ml_dtypes
+    return ml_dtypes.finfo(convert_dtype(d))
 
 
 def convert_dtype(dtype) -> np.dtype:

@@ -249,10 +249,11 @@ def as_tensor(data, dtype=None, stop_gradient: bool = True) -> Tensor:
         return data
     d = dtypes.convert_dtype(dtype) if dtype is not None else None
     if isinstance(data, np.ndarray) and d is None and data.dtype == np.float64:
-        d = dtypes.float32  # paddle default: float data lands as fp32
+        # paddle default: float data lands as the default float dtype
+        d = dtypes.default_float_dtype()
     if isinstance(data, (bool, int, float, list, tuple)) and d is None:
         probe = np.asarray(data)
         if probe.dtype == np.float64:
-            d = dtypes.float32
+            d = dtypes.default_float_dtype()
     arr = jnp.asarray(data, dtype=d)
     return Tensor(arr, stop_gradient=stop_gradient)

@@ -20,6 +20,9 @@ from .manipulation import *  # noqa: F401,F403
 from .reduction import *   # noqa: F401,F403
 from .linalg import *      # noqa: F401,F403
 from .search import *      # noqa: F401,F403
+from . import inplace, tail  # noqa: E402  (need the base ops registered)
+from .inplace import *     # noqa: F401,F403
+from .tail import *        # noqa: F401,F403
 
 
 # ---------------------------------------------------------------------------

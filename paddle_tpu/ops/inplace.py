@@ -1,0 +1,124 @@
+"""In-place op variants (trailing-underscore API).
+
+Reference: the ``x.op_()`` / ``paddle.op_(x)`` in-place family generated
+alongside each op in the reference yaml (``paddle/phi/ops/yaml/ops.yaml``
+``inplace:`` entries). TPU-native semantics: XLA buffers are immutable,
+so "in-place" means the input tensor ADOPTS the result's buffer and grad
+linkage (the idiom of ``reshape_``/``squeeze_``) — downstream autograd
+continues from the op output exactly as the reference's inplace
+var-rewrite does, with donation making it allocation-free under jit.
+
+Random in-place fills (``normal_`` etc.) replace the payload with fresh
+draws and sever the grad link (an initializer, not a differentiable op),
+matching the reference's fill semantics.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, as_tensor
+from .registry import OPS, register
+
+#: in-place name -> base op name (base must be a registered op)
+INPLACE_OF = {
+    n + "_": n for n in """
+    addmm cumsum cumprod logit equal where cos tan logical_and less_than
+    floor_divide remainder floor_mod logical_or bitwise_and bitwise_or
+    bitwise_xor bitwise_not less_equal triu sin mod abs tril pow acos
+    expm1 sinh neg lgamma gammaincc gammainc square divide gammaln atan
+    gcd lcm cast greater_equal erf greater_than tanh transpose flatten
+    multiply logical_not scatter log log2 log10 trunc frac digamma
+    renorm nan_to_num index_add index_put ldexp i0 polygamma copysign
+    bitwise_left_shift bitwise_right_shift masked_fill masked_scatter
+    hypot sinc multigammaln index_fill""".split()
+}
+INPLACE_OF["t_"] = "t"
+
+__all__ = sorted(INPLACE_OF) + [
+    "normal_", "bernoulli_", "log_normal_", "cauchy_", "geometric_"]
+
+
+def _adopt(x: Tensor, out: Tensor) -> Tensor:
+    """x takes over out's buffer and autograd linkage."""
+    x._swap_payload(out._data)
+    x.grad_node = out.grad_node
+    x.output_index = getattr(out, "output_index", 0)
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def _make_inplace(name: str, base_name: str):
+    def fn(x, *args, **kwargs):
+        # lazy lookup: some bases register after this module imports
+        base = OPS[base_name].lowering
+        out = base(x, *args, **kwargs)
+        return _adopt(x, out)
+
+    fn.__name__ = name
+    fn.__doc__ = (f"In-place variant of ``{base_name}`` (payload swap + "
+                  f"grad-link adoption; reference yaml inplace entry).")
+    return register(name, category="inplace")(fn)
+
+
+for _n, _b in INPLACE_OF.items():
+    if _n not in OPS:
+        globals()[_n] = _make_inplace(_n, _b)
+
+
+# ------------------------------------------------------- random fills
+def _fill(x, sample) -> Tensor:
+    x = as_tensor(x)
+    x._swap_payload(sample.astype(x._data.dtype))
+    x.grad_node = None  # an initializer: the fill severs the tape
+    return x
+
+
+def _key():
+    from ..core.generator import next_key
+    return next_key()
+
+
+@register("normal_", category="inplace", differentiable=False)
+def normal_(x, mean=0.0, std=1.0, name=None):
+    """Fill ``x`` with N(mean, std²) draws (reference normal_)."""
+    import jax
+    x = as_tensor(x)
+    return _fill(x, jax.random.normal(_key(), x._data.shape) * std + mean)
+
+
+@register("bernoulli_", category="inplace", differentiable=False)
+def bernoulli_(x, p=0.5, name=None):
+    """Fill with Bernoulli(p) zeros/ones (reference bernoulli_)."""
+    import jax
+    x = as_tensor(x)
+    return _fill(x, jax.random.bernoulli(
+        _key(), p, x._data.shape).astype(jnp.float32))
+
+
+@register("log_normal_", category="inplace", differentiable=False)
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Fill with LogNormal(mean, std²): exp of a normal draw."""
+    import jax
+    x = as_tensor(x)
+    return _fill(x, jnp.exp(
+        jax.random.normal(_key(), x._data.shape) * std + mean))
+
+
+@register("cauchy_", category="inplace", differentiable=False)
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    """Fill with Cauchy(loc, scale) draws (reference cauchy_)."""
+    import jax
+    x = as_tensor(x)
+    return _fill(x, jax.random.cauchy(
+        _key(), x._data.shape) * scale + loc)
+
+
+@register("geometric_", category="inplace", differentiable=False)
+def geometric_(x, probs, name=None):
+    """Fill with Geometric(probs) draws (trial count of first success,
+    starting at 1 — reference geometric_)."""
+    import jax
+    x = as_tensor(x)
+    u = jax.random.uniform(
+        _key(), x._data.shape, minval=jnp.finfo(jnp.float32).tiny)
+    return _fill(x, jnp.ceil(jnp.log(u) / jnp.log1p(-probs)))

@@ -1,0 +1,392 @@
+"""Top-level op-surface tail: the remaining reference ``paddle.*``
+tensor functions.
+
+Reference parity: python/paddle/tensor/{math,manipulation,attribute,
+creation,random}.py entries present in the reference's top-level
+``__all__`` but previously absent here. Each is a jnp lowering through
+the standard dispatch pipeline (XLA fuses; autograd via lazy vjp).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.dtype import convert_dtype
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _u(name, jfn, x):
+    return dispatch.call(name, jfn, [_t(x)])
+
+
+# ------------------------------------------------------------ elementwise
+@_export
+@register("rad2deg", category="math")
+def rad2deg(x, name=None):
+    return _u("rad2deg", lambda a: a * (180.0 / _math.pi), x)
+
+
+@_export
+@register("deg2rad", category="math")
+def deg2rad(x, name=None):
+    return _u("deg2rad", lambda a: a * (_math.pi / 180.0), x)
+
+
+@_export
+@register("sinc", category="math")
+def sinc(x, name=None):
+    return _u("sinc", jnp.sinc, x)
+
+
+@_export
+@register("sgn", category="math")
+def sgn(x, name=None):
+    """sign for real dtypes; x/|x| (0 at 0) for complex (reference sgn)."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0.0 + 0.0j, a / jnp.where(
+                mag == 0, 1.0, mag))
+        return jnp.sign(a)
+    return _u("sgn", f, x)
+
+
+@_export
+@register("signbit", category="math", differentiable=False)
+def signbit(x, name=None):
+    return _u("signbit", jnp.signbit, x)
+
+
+@_export
+@register("frexp", category="math", differentiable=False)
+def frexp(x, name=None):
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+    return dispatch.call("frexp", f, [_t(x)])
+
+
+@_export
+@register("isneginf", category="math", differentiable=False)
+def isneginf(x, name=None):
+    return _u("isneginf", jnp.isneginf, x)
+
+
+@_export
+@register("isposinf", category="math", differentiable=False)
+def isposinf(x, name=None):
+    return _u("isposinf", jnp.isposinf, x)
+
+
+@_export
+@register("isreal", category="math", differentiable=False)
+def isreal(x, name=None):
+    return _u("isreal", jnp.isreal, x)
+
+
+@_export
+@register("multigammaln", category="math")
+def multigammaln(x, p, name=None):
+    from jax.scipy.special import multigammaln as _mg
+    return _u("multigammaln", lambda a: _mg(a, int(p)), x)
+
+
+# ------------------------------------------------------------- reductions
+@_export
+@register("cumulative_trapezoid", category="math")
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoid rule along ``axis`` (reference
+    cumulative_trapezoid: output is one shorter along the axis)."""
+    yt = _t(y)
+
+    def f(ya, *rest):
+        a = jnp.moveaxis(ya, axis, -1)
+        mids = (a[..., 1:] + a[..., :-1]) * 0.5
+        if rest:
+            xa = jnp.moveaxis(rest[0], axis, -1)
+            widths = jnp.diff(xa, axis=-1)
+        else:
+            widths = dx if dx is not None else 1.0
+        out = jnp.cumsum(mids * widths, axis=-1)
+        return jnp.moveaxis(out, -1, axis)
+
+    if x is not None:
+        return dispatch.call("cumulative_trapezoid", f, [yt, _t(x)])
+    return dispatch.call("cumulative_trapezoid", f, [yt])
+
+
+@_export
+@register("pdist", category="math")
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of row vectors (reference pdist:
+    upper-triangular part, row-major order)."""
+    def f(a):
+        n = a.shape[0]
+        iu, ju = np.triu_indices(n, k=1)  # static: only real pairs —
+        # no diagonal zeros whose sqrt'(0)=inf would NaN the vjp
+        diff = a[jnp.asarray(iu)] - a[jnp.asarray(ju)]
+        if p == 2.0:
+            return jnp.sqrt((diff * diff).sum(-1))
+        return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+    return _u("pdist", f, x)
+
+
+@_export
+@register("histogramdd", category="math", differentiable=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    """D-dimensional histogram (reference histogramdd) → (hist, edges)."""
+    sample = np.asarray(_t(x).numpy())
+    w = None if weights is None else np.asarray(_t(weights).numpy())
+    hist, edges = np.histogramdd(sample, bins=bins, range=ranges,
+                                 density=density, weights=w)
+    return (as_tensor(hist.astype(np.float32)),
+            [as_tensor(e.astype(np.float32)) for e in edges])
+
+
+# ----------------------------------------------------------- predicates
+@_export
+def is_complex(x):
+    return bool(jnp.issubdtype(_t(x)._data.dtype, jnp.complexfloating))
+
+
+@_export
+def is_integer(x):
+    return bool(jnp.issubdtype(_t(x)._data.dtype, jnp.integer))
+
+
+@_export
+def is_floating_point(x):
+    return bool(jnp.issubdtype(_t(x)._data.dtype, jnp.floating))
+
+
+@_export
+def is_empty(x, name=None):
+    """0-numel predicate, returned as a bool tensor (reference
+    is_empty)."""
+    return as_tensor(np.array(_t(x)._data.size == 0))
+
+
+@_export
+def broadcast_shape(x_shape, y_shape):
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@_export
+def tolist(x):
+    return np.asarray(_t(x).numpy()).tolist()
+
+
+# ------------------------------------------------------------ structure
+@_export
+@register("block_diag", category="manipulation")
+def block_diag(inputs, name=None):
+    from jax.scipy.linalg import block_diag as _bd
+    ts = [_t(i) for i in inputs]
+    return dispatch.call("block_diag", lambda *a: _bd(*a), ts)
+
+
+def _split_n(op_name, axis):
+    def fn(x, num_or_indices, name=None):
+        xt = _t(x)
+
+        def f(a):
+            ax = axis
+            if op_name == "hsplit" and a.ndim == 1:
+                ax = 0  # numpy/reference hsplit: 1-D splits axis 0
+            if ax >= a.ndim:
+                raise ValueError(f"{op_name} expects ndim > {ax}")
+            if isinstance(num_or_indices, int):
+                return tuple(jnp.split(a, num_or_indices, axis=ax))
+            return tuple(jnp.split(a, list(num_or_indices), axis=ax))
+        return dispatch.call(op_name, f, [xt])
+    fn.__name__ = op_name
+    fn.__doc__ = f"reference {op_name}: split along axis {axis}."
+    return _export(register(op_name, category="manipulation")(fn))
+
+
+hsplit = _split_n("hsplit", 1)
+vsplit = _split_n("vsplit", 0)
+dsplit = _split_n("dsplit", 2)
+
+
+def _stack_as(op_name, jfn):
+    def fn(x, name=None):
+        ts = [_t(i) for i in x]
+        return dispatch.call(op_name, lambda *a: jfn(a), ts)
+    fn.__name__ = op_name
+    fn.__doc__ = f"reference {op_name} (numpy-suite stacking)."
+    return _export(register(op_name, category="manipulation")(fn))
+
+
+hstack = _stack_as("hstack", jnp.hstack)
+vstack = _stack_as("vstack", jnp.vstack)
+dstack = _stack_as("dstack", jnp.dstack)
+column_stack = _stack_as("column_stack", jnp.column_stack)
+row_stack = _stack_as("row_stack", jnp.vstack)
+
+
+@_export
+@register("unflatten", category="manipulation")
+def unflatten(x, axis, shape, name=None):
+    xt = _t(x)
+
+    def f(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + [int(s) for s in shape] \
+            + list(a.shape[ax + 1:])
+        return jnp.reshape(a, new)
+    return dispatch.call("unflatten", f, [xt])
+
+
+@_export
+@register("as_strided", category="manipulation", differentiable=False)
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view as an explicit gather (XLA has no aliasing strides;
+    reference as_strided over contiguous storage)."""
+    xt = _t(x)
+
+    def f(a):
+        flat = a.reshape(-1)
+        if not shape:
+            return flat[offset]
+        grids = jnp.meshgrid(
+            *[jnp.arange(s) * st for s, st in zip(shape, stride)],
+            indexing="ij")
+        return flat[offset + sum(grids)]
+    return dispatch.call("as_strided", f, [xt])
+
+
+@_export
+@register("index_fill", category="manipulation")
+def index_fill(x, index, axis, value, name=None):
+    xt, it = _t(x), _t(index)
+
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return dispatch.call("index_fill", f, [xt, it],
+                         differentiable_mask=[True, False])
+
+
+@_export
+@register("diagonal_scatter", category="manipulation")
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    xt, yt = _t(x), _t(y)
+
+    def f(a, b):
+        n = min(a.shape[axis1], a.shape[axis2])
+        moved = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        k = b.shape[-1] if b.ndim else 1
+        if offset >= 0:
+            i = jnp.arange(k)
+            j = i + offset
+        else:
+            j = jnp.arange(k)
+            i = j - offset
+        bb = jnp.moveaxis(jnp.atleast_1d(b), -1, 0) if b.ndim else b
+        moved = moved.at[i, j].set(bb)
+        return jnp.moveaxis(moved, (0, 1), (axis1, axis2))
+    return dispatch.call("diagonal_scatter", f, [xt, yt])
+
+
+@_export
+@register("combinations", category="manipulation", differentiable=False)
+def combinations(x, r=2, with_replacement=False, name=None):
+    """All r-combinations of a 1-D tensor's elements (reference
+    combinations)."""
+    import itertools
+    xt = _t(x)
+    n = xt.shape[0]
+    it = (itertools.combinations_with_replacement(range(n), r)
+          if with_replacement else itertools.combinations(range(n), r))
+    idx = np.array(list(it), np.int32).reshape(-1, r)
+
+    def f(a):
+        return a[jnp.asarray(idx)]
+    return dispatch.call("combinations", f, [xt])
+
+
+@_export
+@register("scatter_nd", category="manipulation")
+def scatter_nd(index, updates, shape, name=None):
+    """Scatter ``updates`` into zeros of ``shape`` (reference
+    scatter_nd = scatter_nd_add onto zeros)."""
+    it, ut = _t(index), _t(updates)
+
+    def f(idx, upd):
+        out = jnp.zeros(tuple(int(s) for s in shape), upd.dtype)
+        return out.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
+    return dispatch.call("scatter_nd", f, [it, ut],
+                         differentiable_mask=[False, True])
+
+
+@_export
+@register("add_n", category="math")
+def add_n(inputs, name=None):
+    ts = [_t(i) for i in (inputs if isinstance(inputs, (list, tuple))
+                          else [inputs])]
+    return dispatch.call("add_n", lambda *a: sum(a[1:], a[0]), ts)
+
+
+@_export
+def reverse(x, axis, name=None):
+    """Legacy alias of flip (reference reverse → flip)."""
+    from .manipulation import flip
+    return flip(x, axis)
+
+
+# --------------------------------------------------------------- random
+@_export
+@register("binomial", category="random", differentiable=False)
+def binomial(count, prob, name=None):
+    """Binomial(count, prob) draws (reference binomial)."""
+    from ..core.generator import next_key
+    ct, pt = _t(count), _t(prob)
+    n = jnp.asarray(ct._data)
+    p = jnp.asarray(pt._data)
+    shape = jnp.broadcast_shapes(n.shape, p.shape)
+    draws = jax.random.binomial(
+        next_key(), n.astype(jnp.float32),
+        p.astype(jnp.float32), shape=shape)
+    return Tensor(draws.astype(jnp.int32))
+
+
+@_export
+@register("standard_gamma", category="random", differentiable=False)
+def standard_gamma(x, name=None):
+    """Gamma(alpha=x, scale=1) draws (reference standard_gamma)."""
+    from ..core.generator import next_key
+    xt = _t(x)
+    return Tensor(jax.random.gamma(next_key(),
+                                   jnp.asarray(xt._data,
+                                               jnp.float32)).astype(
+        xt._data.dtype if jnp.issubdtype(xt._data.dtype, jnp.floating)
+        else jnp.float32))
+
+
+@_export
+@register("log_normal", category="random", differentiable=False)
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    """LogNormal(mean, std²) draws of ``shape`` (reference log_normal)."""
+    from ..core.generator import next_key
+    shape = tuple(shape or ())
+    return Tensor(jnp.exp(
+        jax.random.normal(next_key(), shape) * std + mean))
