@@ -8,5 +8,6 @@ from . import quant
 from .parameter import Parameter, ParamAttr, create_parameter
 from .layer import *  # noqa: F401,F403
 from .layer.layers import Layer
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
 from .utils import clip_grad_norm_, clip_grad_value_, parameters_to_vector, vector_to_parameters

@@ -1,0 +1,251 @@
+"""Seq2seq decoding: Decoder, BeamSearchDecoder, dynamic_decode.
+
+Reference contract: ``python/paddle/nn/decode.py`` (Decoder :42 abstract
+initialize/step/finalize; BeamSearchDecoder :153 — beam expansion with
+the log-softmax + finished-beam masking of ``_beam_search_step``, state
+gathering by parent beam, gather_tree backtrace in finalize :630;
+``dynamic_decode`` :994 loops step() until all beams finish or
+``max_step_num``).
+
+TPU-native notes: the per-step math is jnp (one fused XLA program per
+step under the dispatch pipeline); the decode loop itself is host-driven
+exactly like the reference dygraph path. Beam bookkeeping follows the
+reference: finished beams may only extend with ``end_token`` (zero
+log-prob there, -1e9 elsewhere), lengths freeze once finished, and the
+final ids come from ``gather_tree`` over (predicted_ids, parent_ids).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+_KINF = 1e9
+
+
+class Decoder:
+    """Abstract decoder (reference decode.py:42)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+    @property
+    def tracks_own_finished(self) -> bool:
+        return False
+
+
+_State = namedtuple("BeamSearchState",
+                    ["cell_states", "log_probs", "finished", "lengths"])
+_Output = namedtuple("BeamSearchOutput",
+                     ["scores", "predicted_ids", "parent_ids"])
+
+
+def _map_structure(fn, structure):
+    if isinstance(structure, (list, tuple)):
+        out = [_map_structure(fn, s) for s in structure]
+        return type(structure)(out) if not hasattr(structure, "_fields") \
+            else type(structure)(*out)
+    if isinstance(structure, dict):
+        return {k: _map_structure(fn, v) for k, v in structure.items()}
+    return fn(structure)
+
+
+def _first_leaf(structure):
+    if isinstance(structure, (list, tuple)):
+        return _first_leaf(structure[0])
+    if isinstance(structure, dict):
+        return _first_leaf(next(iter(structure.values())))
+    return structure
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam search over an RNN-style cell (reference decode.py:153).
+
+    ``cell(inputs, states)`` → (outputs, next_states);
+    ``output_fn`` maps cell outputs to vocab logits; ``embedding_fn``
+    maps token ids to cell inputs.
+    """
+
+    OutputWrapper = _Output
+    StateWrapper = _State
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn: Optional[Callable] = None,
+                 output_fn: Optional[Callable] = None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    # ------------------------------------------------------------ helpers
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """[batch, ...] → [batch * beam, ...] (reference :241)."""
+        x = as_tensor(x)
+        a = x._data
+        tiled = jnp.repeat(a[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + a.shape[1:]))
+
+    def _expand_to_beam_size(self, x):
+        x = as_tensor(x)
+        a = x._data
+        return Tensor(jnp.repeat(a[:, None], self.beam_size, axis=1))
+
+    def _merge_batch_beams(self, x):
+        x = as_tensor(x)
+        a = x._data
+        return Tensor(a.reshape((-1,) + a.shape[2:]))
+
+    def _split_batch_beams(self, x):
+        x = as_tensor(x)
+        a = x._data
+        return Tensor(a.reshape((-1, self.beam_size) + a.shape[1:]))
+
+    @staticmethod
+    def _gather(x, indices, batch_size):
+        """Per-batch gather along the beam axis."""
+        a = as_tensor(x)._data
+        idx = as_tensor(indices)._data.astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            a, idx.reshape(idx.shape + (1,) * (a.ndim - 2)), axis=1))
+
+    # ---------------------------------------------------------- contract
+    def initialize(self, initial_cell_states):
+        state0 = _first_leaf(initial_cell_states)
+        batch = as_tensor(state0).shape[0]
+        self.batch_size = batch
+        cell_states = _map_structure(self._expand_to_beam_size,
+                                     initial_cell_states)
+        init_ids = Tensor(jnp.full((batch, self.beam_size),
+                                   self.start_token, jnp.int32))
+        log_probs = Tensor(jnp.tile(jnp.array(
+            [[0.0] + [-_KINF] * (self.beam_size - 1)], jnp.float32),
+            (batch, 1)))
+        finished = Tensor(jnp.zeros((batch, self.beam_size), bool))
+        lengths = Tensor(jnp.zeros((batch, self.beam_size), jnp.int32))
+        inputs = (self.embedding_fn(init_ids) if self.embedding_fn
+                  else init_ids)
+        return inputs, _State(cell_states, log_probs, finished,
+                              lengths), finished
+
+    def _beam_search_step(self, time, logits, next_cell_states, beam_state):
+        la = as_tensor(logits)._data.astype(jnp.float32)
+        vocab = la.shape[-1]
+        step_logp = jax.nn.log_softmax(la, axis=-1)
+        # finished beams: only end_token continues (reference _mask_probs)
+        noend = jnp.full((vocab,), -_KINF, jnp.float32).at[
+            self.end_token].set(0.0)
+        fin = beam_state.finished._data
+        step_logp = jnp.where(fin[..., None], noend, step_logp)
+
+        log_probs = step_logp + beam_state.log_probs._data[..., None]
+        flat = log_probs.reshape(-1, self.beam_size * vocab)
+        topk_scores, topk_idx = jax.lax.top_k(flat, self.beam_size)
+        beam_idx = Tensor(topk_idx // vocab)
+        token_idx = topk_idx % vocab
+
+        next_cell_states = _map_structure(
+            lambda x: self._gather(x, beam_idx, self.batch_size),
+            next_cell_states)
+        next_finished = self._gather(
+            beam_state.finished, beam_idx, self.batch_size)._data
+        next_lengths = self._gather(
+            beam_state.lengths, beam_idx, self.batch_size)._data
+        next_lengths = next_lengths + (~next_finished).astype(jnp.int32)
+        next_finished = next_finished | (token_idx == self.end_token)
+
+        output = _Output(Tensor(topk_scores), Tensor(token_idx),
+                         beam_idx)
+        state = _State(next_cell_states, Tensor(topk_scores),
+                       Tensor(next_finished), Tensor(next_lengths))
+        return output, state
+
+    def step(self, time, inputs, states, **kwargs):
+        merged_inputs = _map_structure(self._merge_batch_beams, inputs)
+        merged_cell = _map_structure(self._merge_batch_beams,
+                                     states.cell_states)
+        cell_out, next_cell = self.cell(merged_inputs, merged_cell,
+                                        **kwargs)
+        cell_out = _map_structure(self._split_batch_beams, cell_out)
+        next_cell = _map_structure(self._split_batch_beams, next_cell)
+        if self.output_fn is not None:
+            cell_out = self.output_fn(cell_out)
+        output, state = self._beam_search_step(
+            time, cell_out, next_cell, states)
+        sample_ids = output.predicted_ids
+        sample_ids.stop_gradient = True
+        next_inputs = (self.embedding_fn(sample_ids) if self.embedding_fn
+                       else sample_ids)
+        return output, state, next_inputs, state.finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from ..ops.search import gather_tree
+        predicted = gather_tree(outputs.predicted_ids,
+                                outputs.parent_ids)
+        return predicted, final_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Loop ``decoder.step`` until every beam finishes (reference
+    decode.py:994)."""
+    inputs, states, finished = decoder.initialize(inits)
+    step_outputs = []
+    t = 0
+    seq_lengths = None
+    while True:
+        output, next_states, next_inputs, next_finished = decoder.step(
+            as_tensor(np.array([t], np.int64)), inputs, states, **kwargs)
+        if not decoder.tracks_own_finished:
+            nf = Tensor(as_tensor(next_finished)._data
+                        | as_tensor(finished)._data)
+        else:
+            nf = as_tensor(next_finished)
+        if impute_finished:
+            # freeze states of finished beams (reference impute_finished)
+            next_states = _map_structure(
+                lambda new: new, next_states)
+        step_outputs.append(output)
+        inputs, states, finished = next_inputs, next_states, nf
+        t += 1
+        done = bool(np.asarray(finished._data).all())
+        if done or (max_step_num is not None and t > int(max_step_num)):
+            break
+
+    stacked = _Output(*[
+        Tensor(jnp.stack([as_tensor(getattr(o, f))._data
+                          for o in step_outputs]))
+        for f in _Output._fields])
+    seq_lengths = getattr(states, "lengths", None)
+    if hasattr(decoder, "finalize"):
+        try:
+            final_outputs, final_states = decoder.finalize(
+                stacked, states, seq_lengths)
+        except NotImplementedError:
+            final_outputs, final_states = stacked, states
+    else:
+        final_outputs, final_states = stacked, states
+
+    if not output_time_major:
+        final_outputs = _map_structure(
+            lambda x: Tensor(jnp.swapaxes(as_tensor(x)._data, 0, 1)),
+            final_outputs)
+    if return_length:
+        return final_outputs, final_states, seq_lengths
+    return final_outputs, final_states

@@ -18,3 +18,4 @@ from .transformer import (MultiHeadAttention, Transformer, TransformerDecoder,
                           TransformerEncoderLayer)
 from .rnn import (RNN, BiRNN, GRU, GRUCell, LSTM, LSTMCell, RNNCellBase,
                   SimpleRNN, SimpleRNNCell)
+from .tail import *        # noqa: F401,F403
