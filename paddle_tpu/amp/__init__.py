@@ -12,3 +12,18 @@ from . import debugging
 
 white_list = None
 black_list = None
+
+
+def is_float16_supported(device=None):
+    """reference amp.is_float16_supported: XLA computes fp16 on every
+    backend here (TPU prefers bf16 but supports fp16 compute)."""
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    """reference amp.is_bfloat16_supported: bf16 is the TPU-native
+    compute dtype."""
+    return True
+
+
+

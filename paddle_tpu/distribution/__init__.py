@@ -311,5 +311,7 @@ __all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
            "kl_divergence"]
 
 from .families import *  # noqa: E402,F401,F403
+from .lkj_cholesky import LKJCholesky  # noqa: E402
 from . import families as _families  # noqa: E402
 __all__ += _families.__all__
+__all__ += ["LKJCholesky"]

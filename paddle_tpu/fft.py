@@ -89,6 +89,48 @@ def ifftshift(x, axes=None, name=None):
                          lambda a: jnp.fft.ifftshift(a, axes=axes), [_t(x)])
 
 
+# Hermitian multi-dim transforms (reference hfft2/hfftn/ihfft2/ihfftn).
+# jnp has only the 1-D hermitian pair; the N-D versions follow the
+# standard identities hfftₙₒᵣₘ(a) = irfftᵢₙᵥ₋ₙₒᵣₘ(conj(a)) and
+# ihfftₙₒᵣₘ(a) = conj(rfftᵢₙᵥ₋ₙₒᵣₘ(a)) with backward↔forward swapped
+# (ortho is self-inverse) — the scaling argument scipy.fft uses.
+_INV_NORM = {"backward": "forward", "forward": "backward",
+             "ortho": "ortho"}
+
+
+def _mk_hfftn(opname, axes_default, two_d):
+    def op(x, s=None, axes=axes_default, norm="backward", name=None):
+        inv = _INV_NORM[_norm(norm) or "backward"]
+
+        def f(a):
+            ax = tuple(axes) if axes is not None else (
+                (-2, -1) if two_d else tuple(range(a.ndim)))
+            return jnp.fft.irfftn(jnp.conj(a), s=s, axes=ax, norm=inv)
+        return dispatch.call(opname, f, [_t(x)])
+    op.__name__ = opname
+    return op
+
+
+def _mk_ihfftn(opname, axes_default, two_d):
+    def op(x, s=None, axes=axes_default, norm="backward", name=None):
+        inv = _INV_NORM[_norm(norm) or "backward"]
+
+        def f(a):
+            ax = tuple(axes) if axes is not None else (
+                (-2, -1) if two_d else tuple(range(a.ndim)))
+            return jnp.conj(jnp.fft.rfftn(a, s=s, axes=ax, norm=inv))
+        return dispatch.call(opname, f, [_t(x)])
+    op.__name__ = opname
+    return op
+
+
+hfft2 = _mk_hfftn("hfft2", (-2, -1), True)
+ihfft2 = _mk_ihfftn("ihfft2", (-2, -1), True)
+hfftn = _mk_hfftn("hfftn", None, False)
+ihfftn = _mk_ihfftn("ihfftn", None, False)
+
+
 __all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2",
            "ifft2", "rfft2", "irfft2", "fftn", "ifftn", "rfftn", "irfftn",
+           "hfft2", "ihfft2", "hfftn", "ihfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]

@@ -406,3 +406,28 @@ class DetectionMAP:
 
 
 __all__ += ["DetectionMAP"]
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy as a scalar tensor (reference metric/metrics.py
+    accuracy :763): correct if the true label appears in the top-k
+    predictions."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import dispatch
+    from ..core.tensor import Tensor, as_tensor
+
+    it = input if isinstance(input, Tensor) else as_tensor(input)
+    lt = label if isinstance(label, Tensor) else as_tensor(label)
+
+    def f(a, y):
+        _, topk = jax.lax.top_k(a, k)
+        y = y.reshape(-1, 1).astype(topk.dtype)
+        hit = (topk == y).any(axis=1)
+        return hit.astype(jnp.float32).mean()
+    return dispatch.call("metric_accuracy", f, [it, lt],
+                         differentiable_mask=[False, False])
+
+
+__all__ += ["accuracy"]

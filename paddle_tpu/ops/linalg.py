@@ -373,3 +373,151 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
 
 
 __all__ += ["lu_unpack"]
+
+
+# ------------------------------------------------------------ linalg tail
+@register("inv", category="linalg")
+def inv(x, name=None):
+    """Alias of ``inverse`` (reference linalg.inv)."""
+    return inverse(x)
+
+
+@register("cholesky_inverse", category="linalg")
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (reference
+    cholesky_inverse): A⁻¹ via cho_solve against the identity."""
+    xt = _t(x)
+
+    def f(L):
+        from jax.scipy.linalg import cho_solve
+        eye = jnp.eye(L.shape[-1], dtype=L.dtype)
+        # cho_solve's flag is LOWER; paddle's is upper
+        return cho_solve((L, not upper), eye)
+    return dispatch.call("cholesky_inverse", f, [xt])
+
+
+@register("matrix_exp", category="linalg")
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference linalg.matrix_exp; XLA lowering of
+    jax.scipy.linalg.expm)."""
+    xt = _t(x)
+    from jax.scipy.linalg import expm
+    return dispatch.call("matrix_exp", expm, [xt])
+
+
+@register("vector_norm", category="linalg")
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    """reference linalg.vector_norm (p-norm over flattened or given
+    axes, incl. 0/inf/-inf)."""
+    xt = _t(x)
+
+    def f(a):
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        absa = jnp.abs(a)
+        if p == float("inf"):
+            return absa.max(axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return absa.min(axis=ax, keepdims=keepdim)
+        if p == 0:
+            return (a != 0).astype(a.dtype).sum(axis=ax, keepdims=keepdim)
+        return (absa ** p).sum(axis=ax, keepdims=keepdim) ** (1.0 / p)
+    return dispatch.call("vector_norm", f, [xt])
+
+
+@register("matrix_norm", category="linalg")
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    """reference linalg.matrix_norm: fro / nuc / ±1 / ±2 / ±inf over the
+    two matrix axes."""
+    xt = _t(x)
+    ax = tuple(axis)
+
+    def f(a):
+        moved = jnp.moveaxis(a, ax, (-2, -1))
+
+        def unkeep(val):
+            if keepdim:
+                for d in sorted((ax[0] % a.ndim, ax[1] % a.ndim)):
+                    val = jnp.expand_dims(val, d)
+            return val
+
+        if p == "fro":
+            out = jnp.sqrt((moved * moved).sum((-2, -1)))
+        elif p == "nuc":
+            out = jnp.linalg.svd(moved, compute_uv=False).sum(-1)
+        elif p in (1, -1):
+            colsum = jnp.abs(moved).sum(-2)
+            out = colsum.max(-1) if p == 1 else colsum.min(-1)
+        elif p in (float("inf"), float("-inf")):
+            rowsum = jnp.abs(moved).sum(-1)
+            out = rowsum.max(-1) if p > 0 else rowsum.min(-1)
+        elif p in (2, -2):
+            s = jnp.linalg.svd(moved, compute_uv=False)
+            out = s.max(-1) if p == 2 else s.min(-1)
+        else:
+            raise ValueError(f"unsupported matrix norm order {p!r}")
+        return unkeep(out)
+    return dispatch.call("matrix_norm", f, [xt])
+
+
+@register("cond", category="linalg")
+def cond(x, p=None, name=None):
+    """Condition number (reference linalg.cond; default 2-norm)."""
+    xt = _t(x)
+
+    def f(a):
+        if p in (None, 2, -2):
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return (s.max(-1) / s.min(-1) if p in (None, 2)
+                    else s.min(-1) / s.max(-1))
+        if p == "fro":
+            def fro(m):
+                return jnp.sqrt((m * m).sum((-2, -1)))
+            return fro(a) * fro(jnp.linalg.inv(a))
+        if p in (1, -1, float("inf"), float("-inf")):
+            def pnorm(m):
+                sums = jnp.abs(m).sum(-2 if abs(p) == 1 else -1)
+                return sums.max(-1) if p in (1, float("inf")) \
+                    else sums.min(-1)
+            return pnorm(a) * pnorm(jnp.linalg.inv(a))
+        raise ValueError(f"unsupported cond order {p!r}")
+    return dispatch.call("cond", f, [xt])
+
+
+@register("svd_lowrank", category="linalg", differentiable=False)
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference svd_lowrank; Halko et al.
+    subspace iteration, like pca_lowrank without centering)."""
+    xt = _t(x)
+    inputs = [xt] + ([_t(M)] if M is not None else [])
+
+    def f(a, *m):
+        if m:
+            a = a - m[0]
+        k = min(q, min(a.shape[-2:]))
+        from ..core.generator import next_key
+        omega = jax.random.normal(next_key(), a.shape[:-2]
+                                  + (a.shape[-1], k), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (jnp.swapaxes(a, -2, -1) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        B = jnp.swapaxes(Q, -2, -1) @ a
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, jnp.swapaxes(vh, -2, -1)
+    return dispatch.call("svd_lowrank", f, inputs)
+
+
+@register("ormqr", category="linalg")
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply by Q from a QR factorization (reference ormqr):
+    Q = householder_product(x, tau); result is Qy / Qᵀy / yQ / yQᵀ."""
+    Q = householder_product(x, tau)
+
+    def f(qa, ya):
+        q_ = jnp.swapaxes(qa, -2, -1) if transpose else qa
+        return q_ @ ya if left else ya @ q_
+    return dispatch.call("ormqr", f, [_t(Q), _t(y)])
+
+
+__all__ += ["inv", "cholesky_inverse", "matrix_exp", "vector_norm",
+            "matrix_norm", "cond", "svd_lowrank", "ormqr"]

@@ -2,3 +2,4 @@
 from . import lr
 from .optimizer import (Adadelta, Adagrad, Adam, Adamax, AdamW, Lamb, Momentum,
                         NAdam, Optimizer, RAdam, RMSProp, SGD)
+from .extras import ASGD, LBFGS, Rprop

@@ -1,0 +1,214 @@
+"""ASGD, Rprop, LBFGS.
+
+Reference contracts: ``python/paddle/optimizer/asgd.py`` (SAG averaged
+gradient: ring buffer of the last ``batch_num`` grads, update by the
+running average — :39 math block), ``python/paddle/optimizer/rprop.py``
+(sign-agreement step-size adaptation within ``learning_rate_range``,
+``etas`` shrink/grow), ``python/paddle/optimizer/lbfgs.py`` (torch-style
+closure API, two-loop recursion over ``history_size`` curvature pairs,
+optional strong-Wolfe line search).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dispatch
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["ASGD", "Rprop", "LBFGS"]
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference asgd.py:39):
+    ``d ← d − y_i + g; y_i ← g; x ← x − lr·d/min(m+1, n)``."""
+
+    _state_names = ["d", "ys", "m"]
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, multi_precision, name)
+        self._n = int(batch_num)
+
+    def _init_state(self, p):
+        w = self._fp32(p._data)
+        return {"d": jnp.zeros_like(w),
+                "ys": jnp.zeros((self._n,) + w.shape, w.dtype),
+                "m": jnp.zeros((), jnp.int32)}
+
+    def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
+        g = self._apply_decay(w, g, wd_flag)
+        d, ys, m = state["d"], state["ys"], state["m"]
+        idx = m % self._n
+        y_old = ys[idx]
+        d = d - y_old + g
+        ys = ys.at[idx].set(g)
+        denom = jnp.minimum(m + 1, self._n).astype(w.dtype)
+        new_w = w - lr * d / denom
+        return new_w, {"d": d, "ys": ys, "m": m + 1}
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference rprop.py): per-weight step sizes
+    grow by ``etas[1]`` on gradient sign agreement, shrink by
+    ``etas[0]`` on sign flips (and the flip step is skipped), clipped
+    to ``learning_rate_range``."""
+
+    _state_names = ["prev_grad", "step_size"]
+
+    def __init__(self, learning_rate=0.001,
+                 learning_rate_range=(1e-5, 50.0), parameters=None,
+                 etas=(0.5, 1.2), grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = map(float, learning_rate_range)
+        self._etam, self._etap = map(float, etas)
+
+    def _init_state(self, p):
+        w = self._fp32(p._data)
+        return {"prev_grad": jnp.zeros_like(w),
+                "step_size": jnp.full_like(w, float(self.get_lr()))}
+
+    def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
+        prev, size = state["prev_grad"], state["step_size"]
+        sign = jnp.sign(g * prev)
+        size = jnp.clip(
+            jnp.where(sign > 0, size * self._etap,
+                      jnp.where(sign < 0, size * self._etam, size)),
+            self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)   # skip flipped coords
+        new_w = w - jnp.sign(g_eff) * size
+        return new_w, {"prev_grad": g_eff, "step_size": size}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference lbfgs.py, torch-style closure
+    API): two-loop recursion over the last ``history_size`` (s, y)
+    pairs; ``line_search_fn='strong_wolfe'`` runs a cubic-interpolating
+    Wolfe search, otherwise the raw ``learning_rate`` scales the
+    direction."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, False, name)
+        self._max_iter = max_iter
+        self._max_eval = max_eval or max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = int(history_size)
+        self._line_search = line_search_fn
+        self._s: List[jnp.ndarray] = []
+        self._y: List[jnp.ndarray] = []
+
+    # ------------------------------------------------------- flat helpers
+    def _params(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _flat(self, arrays):
+        return jnp.concatenate([jnp.ravel(a.astype(jnp.float32))
+                                for a in arrays])
+
+    def _set_flat(self, vec):
+        off = 0
+        for p in self._params():
+            n = int(np.prod(p.shape)) if p.shape else 1
+            chunk = vec[off:off + n].reshape(p.shape).astype(p._data.dtype)
+            p._swap_payload(chunk)
+            off += n
+
+    def _eval(self, closure):
+        with dispatch.enable_grad():
+            loss = closure()
+            loss.backward()
+        grads = self._flat([
+            (p.grad._data if p.grad is not None
+             else jnp.zeros(p.shape, jnp.float32))
+            for p in self._params()])
+        self.clear_grad()
+        return float(loss.numpy()), grads
+
+    def _direction(self, g):
+        """Two-loop recursion over stored curvature pairs."""
+        q = -g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.vdot(y, s)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            q = q * (jnp.vdot(s, y) / jnp.vdot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return q
+
+    def _wolfe(self, closure, x0, d, f0, g0, lr):
+        """Backtracking + curvature (strong Wolfe) line search."""
+        c1, c2 = 1e-4, 0.9
+        dg0 = float(jnp.vdot(g0, d))
+        t = lr
+        for _ in range(20):
+            self._set_flat(x0 + t * d)
+            f, g = self._eval(closure)
+            if f > f0 + c1 * t * dg0:
+                t *= 0.5
+                continue
+            if abs(float(jnp.vdot(g, d))) > c2 * abs(dg0):
+                t *= 1.5  # curvature not yet satisfied: lengthen
+                continue
+            return t, f, g
+        self._set_flat(x0 + t * d)
+        f, g = self._eval(closure)
+        return t, f, g
+
+    def step(self, closure: Optional[Callable] = None):
+        if closure is None:
+            raise RuntimeError(
+                "LBFGS.step needs a closure re-evaluating the loss "
+                "(reference lbfgs.py contract)")
+        lr = float(self.get_lr())
+        f, g = self._eval(closure)
+        x = self._flat([p._data for p in self._params()])
+        evals = 1
+        for _ in range(self._max_iter):
+            if float(jnp.abs(g).max()) <= self._tol_grad:
+                break
+            d = self._direction(g)
+            if self._line_search == "strong_wolfe":
+                t, f_new, g_new = self._wolfe(closure, x, d, f, g, lr)
+                evals += 1
+            else:
+                t = lr
+                self._set_flat(x + t * d)
+                f_new, g_new = self._eval(closure)
+                evals += 1
+            x_new = x + t * d
+            s = x_new - x
+            ygrad = g_new - g
+            if float(jnp.vdot(s, ygrad)) > 1e-10:
+                self._s.append(s)
+                self._y.append(ygrad)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if float(jnp.abs(s).max()) <= self._tol_change \
+                    or abs(f_new - f) <= self._tol_change:
+                x, f, g = x_new, f_new, g_new
+                break
+            x, f, g = x_new, f_new, g_new
+            if evals >= self._max_eval:
+                break
+        self._set_flat(x)
+        self._post_step()
+        return Tensor(jnp.asarray(f, jnp.float32))

@@ -453,3 +453,54 @@ __all__ += ["sin", "tan", "asin", "atan", "sinh", "tanh", "asinh",
             "deg2rad", "rad2deg", "isnan", "pow", "cast", "subtract",
             "multiply", "divide", "mv", "masked_matmul", "addmm",
             "transpose", "sum", "coalesce", "is_same_shape", "reshape"]
+
+
+# ----------------------------------------------------------- surface tail
+def mask_as(x, mask, name=None):
+    """Select ``x``'s entries at ``mask``'s sparsity pattern (reference
+    sparse/binary.py mask_as): dense x + sparse mask → sparse with
+    mask's structure and x's values there."""
+    dense = x.to_dense() if isinstance(x, SparseCooTensor) else as_tensor(x)
+    if isinstance(mask, SparseCooTensor):
+        idx = mask.indices()._data               # [ndim, nnz]
+        vals = dense._data[tuple(idx)]
+        # constructor stores [nnz, ndim] (raw layout), so transpose
+        return SparseCooTensor(idx.T, Tensor(vals), dense.shape)
+    raise TypeError("mask_as expects a sparse COO mask")
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse tensor along ``axes`` (reference sparse slice):
+    filters nnz entries into the window and rebases indices."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.slice expects a SparseCooTensor")
+    idx = np.asarray(x.indices().numpy())
+    vals = np.asarray(x.values().numpy())
+    shape = list(x.shape)
+    keep = np.ones(idx.shape[1], bool)
+    new_shape = list(shape)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        st = int(st) % shape[ax] if st < 0 else min(int(st), shape[ax])
+        en = int(en) % shape[ax] if en < 0 else min(int(en), shape[ax])
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        new_shape[ax] = en - st
+    kept = idx[:, keep].copy()
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        st = int(st) % shape[ax] if st < 0 else min(int(st), shape[ax])
+        kept[ax] -= st
+    return sparse_coo_tensor(kept, vals[keep], new_shape)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over a sparse COO tensor (reference
+    sparse pca_lowrank): densify + the dense routine — the TPU has no
+    sparse MXU path, and q·niter matmuls on the densified matrix ARE
+    the efficient form at supported sizes."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+    dense = x.to_dense() if isinstance(x, SparseCooTensor) else as_tensor(x)
+    return _dense_pca(dense, q=q, center=center, niter=niter)
+
+
+__all__ += ["mask_as", "slice", "pca_lowrank"]

@@ -1,0 +1,214 @@
+"""Submodule tails: linalg, hermitian FFTs, ASGD/Rprop/LBFGS, sparse
+surface, metric.accuracy, amp capability checks, LKJCholesky.
+
+References: python/paddle/{linalg.py,fft.py}, optimizer/{asgd,rprop,
+lbfgs}.py, sparse/__init__.py, metric/metrics.py:763,
+distribution/lkj_cholesky.py. scipy/numpy/torch provide independent
+numerics.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+RNG = np.random.RandomState(3)
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestLinalgTail:
+    def setup_method(self):
+        x = RNG.randn(4, 4).astype(np.float32)
+        self.spd = x @ x.T + 4 * np.eye(4, dtype=np.float32)
+
+    def test_inv_and_cholesky_inverse(self):
+        ref = np.linalg.inv(self.spd)
+        np.testing.assert_allclose(
+            np.asarray(paddle.linalg.inv(_t(self.spd)).numpy()), ref,
+            rtol=1e-3, atol=1e-4)
+        chol = np.linalg.cholesky(self.spd)
+        np.testing.assert_allclose(
+            np.asarray(paddle.linalg.cholesky_inverse(_t(chol)).numpy()),
+            ref, rtol=1e-3, atol=1e-4)
+        # upper variant
+        np.testing.assert_allclose(
+            np.asarray(paddle.linalg.cholesky_inverse(
+                _t(chol.T.copy()), upper=True).numpy()),
+            ref, rtol=1e-3, atol=1e-4)
+
+    def test_matrix_exp(self):
+        import scipy.linalg as sla
+        a = RNG.randn(3, 3).astype(np.float32) * 0.3
+        np.testing.assert_allclose(
+            np.asarray(paddle.linalg.matrix_exp(_t(a)).numpy()),
+            sla.expm(a.astype(np.float64)), rtol=1e-4, atol=1e-5)
+
+    def test_norms_and_cond(self):
+        v = RNG.randn(3, 5).astype(np.float32)
+        np.testing.assert_allclose(
+            float(paddle.linalg.vector_norm(_t(v), p=3).numpy()),
+            np.sum(np.abs(v) ** 3) ** (1 / 3), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.linalg.vector_norm(
+                _t(v), p=float("inf")).numpy()),
+            np.abs(v).max(), rtol=1e-6)
+        for p in ("fro", "nuc", 1, np.inf):
+            np.testing.assert_allclose(
+                float(paddle.linalg.matrix_norm(_t(v), p=p).numpy()),
+                np.linalg.norm(v, p), rtol=1e-4)
+        for p in (None, 1, "fro"):
+            np.testing.assert_allclose(
+                float(paddle.linalg.cond(_t(self.spd), p=p).numpy()),
+                np.linalg.cond(self.spd, p=p or 2), rtol=1e-3)
+
+    def test_svd_lowrank_and_ormqr(self):
+        A = RNG.randn(8, 5).astype(np.float32)
+        s_ref = np.linalg.svd(A, compute_uv=False)
+        U, S, V = paddle.linalg.svd_lowrank(_t(A), q=5, niter=4)
+        np.testing.assert_allclose(np.sort(np.asarray(S.numpy()))[::-1],
+                                   s_ref, rtol=1e-3)
+        # ormqr: Q (from householder reflectors) applied to a matrix —
+        # columns keep their norms under the orthonormal-column Q
+        import scipy.linalg as sla
+        (h, tau), _ = sla.qr(A.astype(np.float64), mode="raw")
+        C = RNG.randn(5, 3).astype(np.float32)
+        ours = paddle.linalg.ormqr(
+            _t(np.tril(h, -1)[:, :5].astype(np.float32)),
+            _t(tau.astype(np.float32)), _t(C))
+        assert list(ours.shape) == [8, 3]
+        np.testing.assert_allclose(
+            np.linalg.norm(np.asarray(ours.numpy()), axis=0),
+            np.linalg.norm(C, axis=0), rtol=1e-3)
+
+
+class TestHermitianFFT:
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_hfft2_ihfft2_hfftn(self, norm):
+        import scipy.fft as sfft
+        a = (RNG.randn(4, 5) + 1j * RNG.randn(4, 5)).astype(np.complex64)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.hfft2(_t(a), norm=norm).numpy()),
+            sfft.hfft2(a.astype(np.complex128), norm=norm),
+            rtol=2e-4, atol=2e-4)
+        r = RNG.randn(4, 6).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.ihfft2(_t(r), norm=norm).numpy()),
+            sfft.ihfft2(r.astype(np.float64), norm=norm),
+            rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.hfftn(_t(a), norm=norm).numpy()),
+            sfft.hfftn(a.astype(np.complex128), norm=norm),
+            rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(
+            np.asarray(paddle.fft.ihfftn(_t(r), norm=norm).numpy()),
+            sfft.ihfftn(r.astype(np.float64), norm=norm),
+            rtol=2e-4, atol=2e-4)
+
+
+class TestOptimizerExtras:
+    def test_asgd_converges(self):
+        w = _t(np.array([3.0, -2.0], np.float32))
+        w.stop_gradient = False
+        opt = paddle.optimizer.ASGD(learning_rate=0.2, batch_num=3,
+                                    parameters=[w])
+        for _ in range(40):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 1e-2
+
+    def test_rprop_adapts_step_sizes(self):
+        w = _t(np.array([3.0, -2.0], np.float32))
+        w.stop_gradient = False
+        opt = paddle.optimizer.Rprop(learning_rate=0.1, parameters=[w])
+        for _ in range(40):
+            loss = (w * w).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.numpy()) < 1e-3
+
+    def test_lbfgs_rosenbrock(self):
+        xy = _t(np.array([-1.2, 1.0], np.float32))
+        xy.stop_gradient = False
+        opt = paddle.optimizer.LBFGS(
+            learning_rate=1.0, max_iter=80, history_size=10,
+            line_search_fn="strong_wolfe", parameters=[xy])
+
+        def closure():
+            a, b = xy[0], xy[1]
+            return (1 - a) ** 2 + 100.0 * (b - a * a) ** 2
+
+        opt.step(closure)
+        assert float(closure().numpy()) < 1e-4
+        np.testing.assert_allclose(xy.numpy(), [1.0, 1.0], atol=1e-2)
+
+
+class TestSparseMetricAmp:
+    def test_sparse_slice_mask_pca(self):
+        sp = paddle.sparse
+        st = sp.sparse_coo_tensor(
+            np.array([[0, 1, 2], [0, 1, 2]]),
+            np.array([1.0, 2.0, 3.0], np.float32), (3, 3))
+        sl = sp.slice(st, [0], [1], [3])
+        assert list(sl.shape) == [2, 3]
+        np.testing.assert_allclose(
+            np.asarray(sl.to_dense().numpy()), [[0, 2, 0], [0, 0, 3]])
+        dense = _t(np.arange(9, dtype=np.float32).reshape(3, 3))
+        masked = sp.mask_as(dense, st)
+        np.testing.assert_allclose(np.asarray(masked.to_dense().numpy()),
+                                   np.diag([0.0, 4.0, 8.0]))
+        U, S, V = sp.pca_lowrank(st, q=2)
+        assert S.shape[-1] == 2
+
+    def test_metric_accuracy(self):
+        pred = _t(np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1],
+                            [0.2, 0.3, 0.5]], np.float32))
+        lab = _t(np.array([[1], [0], [1]]))
+        # row 2 predicts argmax=2 (wrong at k=1) but label 1 is second
+        np.testing.assert_allclose(
+            float(paddle.metric.accuracy(pred, lab, k=1).numpy()),
+            2.0 / 3.0, rtol=1e-6)
+        np.testing.assert_allclose(
+            float(paddle.metric.accuracy(pred, lab, k=2).numpy()),
+            1.0, rtol=1e-6)
+
+    def test_amp_capability(self):
+        assert paddle.amp.is_bfloat16_supported()
+        assert paddle.amp.is_float16_supported()
+
+
+class TestLKJCholesky:
+    def test_samples_valid_and_unbiased(self):
+        for method in ("onion", "cvine"):
+            d = paddle.distribution.LKJCholesky(3, 1.5,
+                                                sample_method=method)
+            L = np.asarray(d.sample([1500]).numpy()).reshape(1500, 3, 3)
+            corr = L @ np.swapaxes(L, -1, -2)
+            np.testing.assert_allclose(
+                np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+            assert np.abs(np.triu(L, 1)).max() < 1e-6
+            # unbiased: mean off-diagonal correlation ~ 0
+            assert abs(corr[:, 1, 0].mean()) < 0.06, method
+            assert abs(corr[:, 2, 1].mean()) < 0.06, method
+
+    def test_log_prob_matches_torch(self):
+        torch = pytest.importorskip("torch")
+        td = torch.distributions.LKJCholesky(3, 1.5)
+        pd = paddle.distribution.LKJCholesky(3, 1.5)
+        Ls = td.sample((8,))
+        ours = np.asarray(
+            pd.log_prob(_t(Ls.numpy())).numpy()).squeeze()
+        np.testing.assert_allclose(ours, td.log_prob(Ls).numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            paddle.distribution.LKJCholesky(1)
+        with pytest.raises(ValueError):
+            paddle.distribution.LKJCholesky(3, -1.0)
+        with pytest.raises(ValueError):
+            paddle.distribution.LKJCholesky(3, 1.0, sample_method="x")
