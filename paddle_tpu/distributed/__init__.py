@@ -25,6 +25,9 @@ from . import checkpoint
 from . import auto_tuner
 from . import rpc
 from . import ps
+from . import io
+from . import launch
+from .tail import *  # noqa: F401,F403
 from .auto_parallel.engine import Engine
 from .checkpoint import load_state_dict, save_state_dict
 from .fleet.mpu.mp_ops import split

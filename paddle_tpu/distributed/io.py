@@ -1,0 +1,58 @@
+"""paddle.distributed.io — persistable save/load for distributed
+programs.
+
+Reference: python/paddle/distributed/io.py (save_persistables :392 /
+load_persistables :132 split dense vars and PS-side distributed vars;
+is_persistable :357). Here dense persistables ride the static save/load
+path and PS-resident tables save through the PS client when one is
+bound.
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable",
+           "load_inference_model_distributed"]
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def _ps_client_or_none():
+    from .ps import _CTX
+    return _CTX.get("client")
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Dense persistables → ``dirname/persistables.pdparams``; if a PS
+    client is bound, server-side tables snapshot into the same dir."""
+    from ..static import save as _static_save
+    from ..static.program import default_main_program
+    import os
+
+    os.makedirs(dirname, exist_ok=True)
+    program = main_program or default_main_program()
+    _static_save(program, os.path.join(dirname,
+                                       filename or "persistables"))
+    client = _ps_client_or_none()
+    if client is not None:
+        client.save(dirname)
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    from ..static import load as _static_load
+    from ..static.program import default_main_program
+    import os
+
+    program = main_program or default_main_program()
+    _static_load(program, os.path.join(dirname,
+                                       filename or "persistables"))
+    client = _ps_client_or_none()
+    if client is not None:
+        client.load(dirname)
+
+
+def load_inference_model_distributed(dirname, executor=None, **kwargs):
+    from ..static import load_inference_model
+    return load_inference_model(dirname, executor)

@@ -105,7 +105,7 @@ class SparseTable:
 
     def __init__(self, dim: int, accessor: str = "sgd", lr: float = 0.01,
                  initializer: str = "uniform", init_range: float = 0.01,
-                 seed: int = 0, **hp):
+                 seed: int = 0, entry=None, **hp):
         self.dim = int(dim)
         self.accessor = make_accessor(accessor, lr=lr, **hp)
         self.initializer = initializer
@@ -118,6 +118,11 @@ class SparseTable:
         self._state = {k: np.full((self._cap, self.dim), v, np.float32)
                        for k, v in self.accessor.states.items()}
         self._lock = threading.Lock()
+        # feature-admission policy (reference entry semantics: a row
+        # earns storage/optimizer state only once admitted — e.g.
+        # CountFilterEntry after k accesses); None admits immediately
+        self._entry = entry
+        self._access: Dict[int, int] = {}
 
     def _grow(self, need: int):
         while self._cap < need:
@@ -147,6 +152,13 @@ class SparseTable:
                 if not create:
                     out[i] = -1
                     continue
+                if self._entry is not None:
+                    count = self._access.get(key, 0) + 1
+                    self._access[key] = count
+                    if not self._entry.admits(count):
+                        out[i] = -1  # not yet admitted: no storage
+                        continue
+                    self._access.pop(key, None)
                 slot = self._n
                 self._n += 1
                 if self._n > self._cap:
@@ -158,17 +170,28 @@ class SparseTable:
 
     # -------------------------------------------------------------- api
     def pull(self, ids: np.ndarray) -> np.ndarray:
-        """Row values for ``ids`` (lazy-created)."""
+        """Row values for ``ids`` (lazy-created; unadmitted rows read
+        as zeros without earning storage)."""
         with self._lock:
             slots = self._slots(np.asarray(ids, np.int64), create=True)
-            return self._value[slots].copy()
+            out = self._value[np.maximum(slots, 0)].copy()
+            out[slots < 0] = 0.0
+            return out
 
     def push(self, ids: np.ndarray, grads: np.ndarray) -> None:
-        """Apply the accessor to the (already deduplicated) rows."""
+        """Apply the accessor to the (already deduplicated) rows;
+        pushes to unadmitted rows are dropped (entry contract)."""
         ids = np.asarray(ids, np.int64)
         grads = np.asarray(grads, np.float32)
         with self._lock:
             slots = self._slots(ids, create=True)
+            admitted = slots >= 0
+            if not admitted.all():
+                slots = slots[admitted]
+                grads = grads[admitted]
+                ids = ids[admitted]
+                if not len(ids):
+                    return
             value = self._value[slots]
             state = {k: s[slots] for k, s in self._state.items()}
             counts = np.ones(len(ids), np.float32)

