@@ -200,9 +200,9 @@ class HDFSClient(FS):
                  retry_times: int = 5) -> Tuple[int, List[str]]:
         exe_cmd = f"{self._base_cmd} -{cmd}"
         ret, output = 0, ""
-        for _ in range(retry_times + 1):
+        for attempt in range(retry_times + 1):
             ret, output = self._shell(exe_cmd)
-            if ret == 0:
+            if ret == 0 or attempt == retry_times:
                 break
             time.sleep(self._sleep_inter / 1000.0)
         if ret == 134:
@@ -235,7 +235,9 @@ class HDFSClient(FS):
         return self.ls_dir(fs_path)[0]
 
     def _test(self, flag: str, fs_path: str) -> bool:
-        ret, _ = self._run_cmd(f"test -{flag} {fs_path}", retry_times=1)
+        # 'hadoop fs -test' answers false via exit 1 — a result, not a
+        # transient failure, so no retries (each retry costs sleep_inter)
+        ret, _ = self._run_cmd(f"test -{flag} {fs_path}", retry_times=0)
         return ret == 0
 
     def is_dir(self, fs_path):

@@ -65,6 +65,12 @@ def _worker_env(args, local_rank: int, epoch: int = 0,
         "JAX_NUM_PROCESSES": str(world),
         "JAX_PROCESS_ID": str(rank),
     })
+    # PS/RPC transports refuse to run without a shared job token (their
+    # bodies are pickled). Single-node: mint one for the whole local group.
+    # Multi-node: it must come in via the environment (same value on every
+    # node) — the launcher only fills the gap it can fill safely.
+    if args.ps_token:
+        env.setdefault("PADDLE_PS_TOKEN", args.ps_token)
     return env
 
 
@@ -130,6 +136,13 @@ def launch(argv=None) -> int:
 
     args = _parse(argv)
     nnodes_min, nnodes_max = parse_nnodes(args.nnodes)
+    args.ps_token = os.environ.get("PADDLE_PS_TOKEN", "")
+    if not args.ps_token and nnodes_max == 1:
+        # single-node: mint one shared token for the local group. A
+        # per-launcher mint would NOT match across nodes, so multi-node
+        # jobs must bring the token via the environment.
+        import secrets
+        args.ps_token = secrets.token_hex(16)
     args.nnodes_now = nnodes_min
     kv = None
     manager = None

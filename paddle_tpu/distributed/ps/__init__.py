@@ -48,6 +48,15 @@ _CTX = {"role_maker": None, "client": None, "server": None}
 def init_from_role(role_maker) -> None:
     """Bind this process to its PS role (called by ``fleet.init``)."""
     token = os.getenv("PADDLE_PS_TOKEN", "")
+    if not token:
+        # The PS protocol pickles request bodies; a shared secret is
+        # mandatory. It must be distributed out-of-band (launch exports it
+        # to every rank) — a per-process random token would not match
+        # across the job, so refuse rather than mint here.
+        raise RuntimeError(
+            "PADDLE_PS_TOKEN is not set: the parameter-server transport "
+            "requires a shared job token (paddle.distributed.launch "
+            "exports one automatically; set it explicitly otherwise)")
     _CTX["role_maker"] = role_maker
     if role_maker._is_server():
         me = role_maker._get_pserver_endpoints()[role_maker._server_index()]

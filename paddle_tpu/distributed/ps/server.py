@@ -34,7 +34,7 @@ class _PsHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         srv = self.server
-        if srv.token and self.headers.get("X-PS-Token") != srv.token:
+        if self.headers.get("X-PS-Token") != srv.token:
             self.send_response(403)
             self.end_headers()
             return
@@ -69,6 +69,14 @@ class PsServer:
                  token: str = "", port: int = 0, host: str = "0.0.0.0"):
         self.server_index = int(server_index)
         self.num_servers = int(num_servers)
+        if not token:
+            # never run open: the handler pickle.loads request bodies, so an
+            # unauthenticated reachable port is arbitrary code execution.
+            # Mirror distributed/rpc: mint a random per-job token. Workers
+            # must receive it via PADDLE_PS_TOKEN; a blank-token client
+            # cannot talk to this server.
+            import secrets
+            token = secrets.token_hex(16)
         self.token = token
         self._tables: Dict[int, Union[SparseTable, DenseTable]] = {}
         self._configs: Dict[int, dict] = {}
@@ -209,6 +217,11 @@ class PsServer:
             ev = self._barrier_events.setdefault(key, threading.Event())
             self._barrier_counts[key] = self._barrier_counts.get(key, 0) + 1
             if self._barrier_counts[key] >= world:
+                # last arriver releases AND reclaims the entry — a
+                # long-lived server must not leak one dict slot per
+                # generation ('key#gen' keys are never reused)
+                self._barrier_counts.pop(key, None)
+                self._barrier_events.pop(key, None)
                 ev.set()
         if not ev.wait(timeout=120):
             raise TimeoutError(f"PS barrier {key!r} timed out")

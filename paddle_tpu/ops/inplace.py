@@ -22,7 +22,7 @@ from .registry import OPS, register
 #: in-place name -> base op name (base must be a registered op)
 INPLACE_OF = {
     n + "_": n for n in """
-    addmm cumsum cumprod logit equal where cos tan logical_and less_than
+    addmm cumsum cumprod logit equal cos tan logical_and less_than
     floor_divide remainder floor_mod logical_or bitwise_and bitwise_or
     bitwise_xor bitwise_not less_equal triu sin mod abs tril pow acos
     expm1 sinh neg lgamma gammaincc gammainc square divide gammaln atan

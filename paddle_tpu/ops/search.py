@@ -13,7 +13,8 @@ from ..core.tensor import Tensor, as_tensor
 from .registry import register
 
 __all__ = [
-    "argmax", "argmin", "argsort", "sort", "topk", "where", "nonzero",
+    "argmax", "argmin", "argsort", "sort", "topk", "where", "where_",
+    "nonzero",
     "searchsorted", "index_of_max", "kthvalue", "unique", "unique_consecutive",
     "masked_scatter", "bucketize", "isin",
 ]
@@ -87,10 +88,13 @@ def where(condition, x=None, y=None, name=None):
                          differentiable_mask=[False, True, True])
 
 
+@register("where_", category="inplace")
 def where_(condition, x, y, name=None):
+    """In-place ``where``: result adopts into ``x`` (the first *payload*
+    argument — NOT the condition; reference yaml ``inplace: (x -> out)``)."""
     out = where(condition, x, y)
-    x._swap_payload(out._data)
-    return x
+    from .inplace import _adopt
+    return _adopt(x, out)
 
 
 @register("nonzero", category="search", differentiable=False)

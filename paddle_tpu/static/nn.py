@@ -60,6 +60,7 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 
 
 _GEO_LAYERS = {}
+_SPARSE_EMB_AUTO = 0
 
 
 def sparse_embedding(input, size, padding_idx=None, is_test=False,
@@ -83,7 +84,13 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
     from ..distributed.ps.embedding import GeoDistributedEmbedding
 
     name = (param_attr if isinstance(param_attr, str)
-            else getattr(param_attr, "name", None)) or "sparse_embedding_0"
+            else getattr(param_attr, "name", None))
+    if not name:
+        # auto-name like the reference's unique_name.generate: two unnamed
+        # tables must NOT hash to one table id (silent weight sharing)
+        global _SPARSE_EMB_AUTO
+        name = f"sparse_embedding_{_SPARSE_EMB_AUTO}"
+        _SPARSE_EMB_AUTO += 1
     table_id = zlib.adler32(name.encode()) % (1 << 30)
     client = _current_client()
     dim = int(size[1])

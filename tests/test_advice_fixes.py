@@ -80,3 +80,82 @@ def test_to_static_batchnorm_training_updates_stats():
     # a second eager call must not crash on a leaked tracer
     bn.eval()
     bn(x)
+
+
+# ----------------------------------------------------------- round-5 ADVICE
+def test_where_inplace_adopts_into_x_not_condition():
+    """ADVICE r4 (medium): an auto-generated where_ adopted into the
+    CONDITION. The hand-written one must mutate x and leave cond alone."""
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([10.0, 20.0])
+    cond = paddle.to_tensor([True, False])
+    out = paddle.where_(cond, x, y)
+    assert out is x
+    np.testing.assert_allclose(x.numpy(), [1.0, 20.0])
+    assert cond.numpy().dtype == np.bool_
+    np.testing.assert_array_equal(cond.numpy(), [True, False])
+
+
+def test_ps_server_refuses_blank_token_requests():
+    """ADVICE r4 (high): tokenless deployments must not expose pickle
+    endpoints. A server constructed with token='' mints a random one, so
+    a blank-token client is rejected with 403."""
+    from paddle_tpu.distributed.ps import PsClient, PsServer
+    srv = PsServer(0, 1, token="").start()
+    try:
+        assert srv.token  # minted, not blank
+        bad = PsClient([srv.endpoint], token="")
+        with pytest.raises(Exception):
+            bad.create_table(0, {"type": "dense", "length": 2})
+        good = PsClient([srv.endpoint], token=srv.token)
+        good.create_table(0, {"type": "dense", "length": 2})
+    finally:
+        srv.stop()
+
+
+def test_ps_barrier_entries_reclaimed():
+    """ADVICE r4 (low): completed barrier generations must not leak."""
+    from paddle_tpu.distributed.ps import PsServer
+    srv = PsServer(0, 1, token="t").start()
+    try:
+        for gen in range(5):
+            srv._handle("barrier", key=f"k#{gen}", world=1)
+        assert not srv._barrier_counts and not srv._barrier_events
+    finally:
+        srv.stop()
+
+
+def test_hdfs_test_cmd_not_retried(monkeypatch):
+    """ADVICE r4 (low): 'hadoop fs -test' exit 1 is an answer, not a
+    transient failure — no retry sleeps, and no sleep after the last try."""
+    import time as _time
+    from paddle_tpu.distributed.fleet.utils.fs import HDFSClient
+    cli = HDFSClient("/opt/hadoop", sleep_inter=1000)
+    calls = []
+    monkeypatch.setattr(cli, "_shell", lambda cmd: (calls.append(cmd) or (1, "")))
+    slept = []
+    monkeypatch.setattr(_time, "sleep", lambda s: slept.append(s))
+    assert cli.is_exist("/no/such/path") is False
+    assert len(calls) == 1  # single probe
+    assert not slept        # and no sleeping at all
+    # non-test commands still retry, but never sleep after the final try
+    calls.clear()
+    ret, _ = cli._run_cmd("mkdir /x", retry_times=2)
+    assert ret == 1 and len(calls) == 3 and len(slept) == 2
+
+
+def test_sparse_embedding_unique_autonames():
+    """ADVICE r4 (low): two unnamed sparse_embedding calls must not hash
+    to the same PS table id."""
+    from paddle_tpu import static
+    import zlib
+    n0 = static.nn._SPARSE_EMB_AUTO
+    # call through the naming path only (no PS client bound -> expect the
+    # runtime error AFTER the name was minted)
+    ids = set()
+    for _ in range(2):
+        try:
+            static.nn.sparse_embedding(paddle.to_tensor([[0]]), [10, 4])
+        except RuntimeError:
+            pass
+    assert static.nn._SPARSE_EMB_AUTO == n0 + 2

@@ -587,6 +587,8 @@ SKIP = {
     # in-place variants: payload-swap wrappers over the swept base ops
     **{n: f"in-place alias of {b} (payload swap; base op swept)"
        for n, b in INPLACE_OF.items()},
+    "where_": "hand-written in-place where (adopts into x, not the "
+              "condition — see ADVICE r4); semantics in test_advice_fixes",
     **{n: "random in-place fill; seeded behavior in test_api_tail.py"
        for n in ("normal_", "bernoulli_", "log_normal_", "cauchy_",
                  "geometric_")},

@@ -540,6 +540,11 @@ def test_fleet_init_non_collective_env_and_stop_worker_noop(monkeypatch):
                  "PADDLE_TRAINERS_NUM": "1", "TRAINING_ROLE": "TRAINER",
                  "PADDLE_TRAINER_ID": "0"}.items():
         monkeypatch.setenv(k, v)
+    # the PS transport refuses to run tokenless (pickle on the wire)
+    monkeypatch.delenv("PADDLE_PS_TOKEN", raising=False)
+    with pytest.raises(RuntimeError, match="PADDLE_PS_TOKEN"):
+        Fleet().init(is_collective=False)
+    monkeypatch.setenv("PADDLE_PS_TOKEN", "env-tok")
     f2 = Fleet()
     f2.init(is_collective=False)
     assert f2.is_worker() and not f2.is_server()
