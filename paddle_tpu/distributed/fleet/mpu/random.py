@@ -55,9 +55,14 @@ class RNGStatesTracker:
             if name not in self._states:
                 # lazily derive a deterministic per-region seed from the
                 # current global seed (reference requires explicit add();
-                # lazy derivation keeps single-process tests seed-stable)
+                # lazy derivation keeps single-process tests seed-stable).
+                # Stable digest, NOT Python hash(): str hashing is
+                # randomized per process, which would give every process a
+                # different TP weight init in multi-process jobs.
+                import zlib
                 base = gen_mod.default_generator().seed()
-                self._states[name] = ((base ^ hash(name)) & 0x7FFFFFFF, 0)
+                tag = zlib.adler32(name.encode())
+                self._states[name] = ((base ^ tag) & 0x7FFFFFFF, 0)
             state = self._states[name]
         g = gen_mod.default_generator()
         orig = g.get_state()

@@ -74,15 +74,28 @@ def init_parallel_env(mesh_shape=None):
     global _initialized
     if _initialized:
         return ParallelEnv()
-    master = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
-    nnodes = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if master and nnodes > 1 and jax.process_count() == 1:
-        port = os.environ.get("MASTER_PORT", "8471")
-        coord = master if ":" in master else f"{master}:{port}"
+    # the launcher exports epoch-correct jax.distributed coordinates
+    # (JAX_COORDINATOR_ADDRESS moves with the elastic epoch); prefer them
+    # over the static PADDLE_MASTER the user may also have set
+    coord = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nnodes = int(os.environ.get("JAX_NUM_PROCESSES")
+                 or os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("JAX_PROCESS_ID")
+               or os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if not coord:
+        master = (os.environ.get("PADDLE_MASTER")
+                  or os.environ.get("MASTER_ADDR"))
+        if master:
+            port = os.environ.get("MASTER_PORT", "8471")
+            coord = master if ":" in master else f"{master}:{port}"
+    # must not probe jax.process_count() here: touching the backend before
+    # jax.distributed.initialize permanently forecloses multi-process init
+    # (is_initialized() reads the coordination client without it)
+    if coord and nnodes > 1 and not jax.distributed.is_initialized():
         jax.distributed.initialize(
             coordinator_address=coord,
             num_processes=nnodes,
-            process_id=int(os.environ.get("PADDLE_TRAINER_ID", "0")))
+            process_id=rank)
     mesh_mod.set_mesh(mesh_mod.build_mesh(mesh_shape))
     _initialized = True
     return ParallelEnv()

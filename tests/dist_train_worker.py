@@ -1,0 +1,82 @@
+"""Multi-process training worker for the TestDistBase-style parity harness.
+
+Runs under ``python -m paddle_tpu.distributed.launch`` (which exports the
+jax.distributed coordinates). Every rank builds the SAME model (seeded) and
+feeds the SAME deterministic global batch each step; the parallel wrappers
+shard it over the mesh. Losses are written per-rank for the harness to
+compare against the single-process baseline.
+
+Reference contract: test/legacy_test/test_dist_base.py:952 (TestDistBase
+forks trainer processes, trains the same model, compares multi-process loss
+to the single-process run) and the per-strategy launcher scripts under
+test/collective/fleet/ (e.g. dygraph_group_sharded_stage2.py,
+hybrid_parallel_pp_alexnet.py).
+
+Usage: dist_train_worker.py <strategy> <outdir>
+  strategy: single | dp | dp_sharding | dp_mp
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+STRATEGY = sys.argv[1]
+OUTDIR = sys.argv[2]
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.distributed.fleet as fleet_pkg  # noqa: E402
+from paddle_tpu.models import GPTConfig, GPTForCausalLM  # noqa: E402
+
+dist.init_parallel_env()
+world = jax.process_count()
+rank = jax.process_index()
+# degrees are over DEVICES: N processes x 1 device each, or 1 process
+# with an N-device virtual mesh — the parity the harness asserts is that
+# these two are the same program
+ndev = jax.device_count()
+
+strategy = fleet_pkg.DistributedStrategy()
+if STRATEGY == "dp_sharding":
+    strategy.hybrid_configs = {"dp_degree": ndev // 2,
+                               "sharding_degree": 2}
+elif STRATEGY == "dp_mp":
+    strategy.hybrid_configs = {"dp_degree": ndev // 2, "mp_degree": 2}
+fleet_pkg.fleet.init(is_collective=True, strategy=strategy)
+
+paddle.seed(1234)
+mp_deg = 2 if STRATEGY == "dp_mp" else 1
+cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
+                max_seq_len=16, use_flash_attention=False,
+                mp_degree=mp_deg)
+model = GPTForCausalLM(cfg)
+model = fleet_pkg.fleet.distributed_model(model)
+opt = fleet_pkg.fleet.distributed_optimizer(
+    paddle.optimizer.AdamW(learning_rate=1e-2,
+                           parameters=model.parameters()))
+
+GLOBAL_BATCH, SEQ, STEPS = 8, 16, 6
+rng = np.random.RandomState(0)  # identical stream on every rank
+fixed = rng.randint(0, cfg.vocab_size,
+                    (GLOBAL_BATCH, SEQ)).astype(np.int64)
+losses = []
+for step in range(STEPS):
+    # one fixed batch: the loss must DESCEND, so parity is a statement
+    # about the whole train step (fwd + bwd + optimizer), not noise
+    ids = paddle.to_tensor(fixed)
+    _, loss = model(ids, labels=ids)
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+    losses.append(float(loss.numpy()))
+
+assert all(np.isfinite(losses)), losses
+with open(os.path.join(OUTDIR, f"losses.{STRATEGY}.r{rank}.json"), "w") as f:
+    json.dump({"strategy": STRATEGY, "world": world, "rank": rank,
+               "losses": losses}, f)
+print(f"trained {STRATEGY} rank={rank}/{world} losses={losses}", flush=True)
