@@ -42,34 +42,43 @@ class Engine:
     # ----------------------------------------------------------- compile
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         """Build + cache the jitted SPMD step (reference engine.prepare
-        compiles the distributed program)."""
+        compiles the distributed program).
+
+        The update rule is the REAL optimizer package's functional core
+        (``Optimizer._tree_step``), traced into the SPMD program — every
+        optimizer in the suite works here, with one implementation, not a
+        private re-derivation. The learning rate enters as a traced
+        scalar, so LR schedulers tick without retracing.
+        """
+        from ...optimizer import SGD, Optimizer
+
         params = self._params
         model, loss_fn = self._model, self._loss
         opt = self._optimizer
+        if opt is None:
+            opt = SGD(learning_rate=1e-3, parameters=params)
+        if not isinstance(opt, Optimizer) or \
+                type(opt)._update is Optimizer._update:
+            raise TypeError(
+                f"Engine requires an optimizer with a functional update "
+                f"rule (Optimizer._update); {type(opt).__name__} steps "
+                f"imperatively (e.g. LBFGS line search) and cannot be "
+                f"compiled into one SPMD program")
+        self._opt = opt
 
-        opt_name = type(opt).__name__ if opt is not None else "SGD"
-        lr = getattr(opt, "_learning_rate", 1e-3)
-        if callable(lr):
-            lr = float(lr())
-        b1 = float(getattr(opt, "_beta1", 0.9))
-        b2 = float(getattr(opt, "_beta2", 0.999))
-        eps = float(getattr(opt, "_epsilon", 1e-8))
-        wd = float(getattr(opt, "_weight_decay", 0.0) or 0.0)
-        momentum = float(getattr(opt, "_momentum", 0.0) or 0.0)
-        use_adam = opt_name in ("Adam", "AdamW")
+        # static per-param attributes, resolved once at compile time
+        lr_mults = tuple(float(getattr(p, "optimize_attr", {})
+                               .get("learning_rate", 1.0)) for p in params)
+        wd_flags = tuple(opt._wd_flag(p) for p in params)
 
         def init_opt_state(param_arrays):
-            if use_adam:
-                return (jnp.asarray(0, jnp.int32),
-                        [jnp.zeros_like(p) for p in param_arrays],
-                        [jnp.zeros_like(p) for p in param_arrays])
-            if momentum:
-                return ([jnp.zeros_like(p) for p in param_arrays],)
-            return ()
+            states = [opt._init_state(p) for p in params]
+            masters = [None] * len(params)  # fp32 params: no master copies
+            return (jnp.asarray(0, jnp.int32), masters, states)
 
         self._init_opt_state = init_opt_state
 
-        def step(param_arrays, opt_state, x, y):
+        def step(param_arrays, opt_state, lr, x, y):
             def f(pa):
                 originals = [p._data for p in params]
                 for p, a in zip(params, pa):
@@ -82,30 +91,16 @@ class Engine:
                         p._data = o
 
             loss, grads = jax.value_and_grad(f)(param_arrays)
-            # functional update matching the Engine's optimizer class
-            if use_adam:
-                t, ms, vs = opt_state
-                t = t + 1
-                tf = t.astype(jnp.float32)
-                new_p, new_m, new_v = [], [], []
-                for p, g, m, v in zip(param_arrays, grads, ms, vs):
-                    m = b1 * m + (1 - b1) * g
-                    v = b2 * v + (1 - b2) * g * g
-                    m_hat = m / (1 - b1 ** tf)
-                    v_hat = v / (1 - b2 ** tf)
-                    if opt_name == "AdamW" and wd:
-                        p = p * (1 - lr * wd)
-                    new_p.append(p - lr * m_hat / (jnp.sqrt(v_hat) + eps))
-                    new_m.append(m)
-                    new_v.append(v)
-                return loss, new_p, (t, new_m, new_v)
-            if momentum:
-                (bufs,) = opt_state
-                new_b = [momentum * b + g for b, g in zip(bufs, grads)]
-                new_p = [p - lr * b for p, b in zip(param_arrays, new_b)]
-                return loss, new_p, (new_b,)
-            new_p = [p - lr * g for p, g in zip(param_arrays, grads)]
-            return loss, new_p, opt_state
+            t, masters, states = opt_state
+            t = t + 1
+            if opt._grad_clip is not None:
+                pairs = opt._grad_clip(
+                    [(p, Tensor(g)) for p, g in zip(params, grads)])
+                grads = [g._data for _, g in pairs]
+            new_p, new_m, new_st = opt._tree_step(
+                lr, t, param_arrays, grads, masters, states, lr_mults,
+                wd_flags)
+            return loss, new_p, (t, new_m, new_st)
 
         # no buffer donation: the arrays stay referenced by the live
         # Parameters until the end-of-fit writeback; donation would
@@ -147,9 +142,13 @@ class Engine:
             log_freq=10, verbose=0):
         if self._train_step is None:
             self.prepare()
+        from ...optimizer.lr import LRScheduler
+
         loader = self.dataloader(train_data, batch_size, shuffle=True)
         pa = [p._data for p in self._params]
         opt_state = self._init_opt_state(pa)
+        sched = getattr(self._opt, "_learning_rate", None)
+        sched = sched if isinstance(sched, LRScheduler) else None
         for epoch in range(epochs):
             losses = []
             for step_i, batch in enumerate(loader):
@@ -160,14 +159,25 @@ class Engine:
                                       else xs)
                 y = self._shard_batch(ys.numpy() if isinstance(ys, Tensor)
                                       else ys)
-                loss, pa, opt_state = self._train_step(pa, opt_state, x, y)
+                # lr is a traced INPUT: schedulers tick without retracing
+                lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+                loss, pa, opt_state = self._train_step(pa, opt_state, lr,
+                                                       x, y)
+                if sched is not None:
+                    sched.step()
                 losses.append(float(loss))
                 if verbose and step_i % log_freq == 0:
                     print(f"[engine] epoch {epoch} step {step_i} "
                           f"loss {losses[-1]:.4f}")
             self.history.append(float(np.mean(losses)))
-        for p, a in zip(self._params, pa):
+        # write the trained arrays AND accumulator states back into the
+        # eager optimizer, so a later opt.step()/state_dict() continues
+        # from where the Engine left off
+        t, _masters, states = opt_state
+        self._opt._step_count = int(t)
+        for p, a, st in zip(self._params, pa, states):
             p._data = a
+            self._opt._accumulators[id(p)] = st
         return self.history
 
     def evaluate(self, eval_data, batch_size=32, verbose=0):

@@ -207,6 +207,14 @@ class Optimizer:
     clear_gradients = clear_grad
 
     # ------------------------------------------------------------- save/load
+    def _state_to_checkpoint(self, name, v, p):
+        """Storage form -> checkpoint form (f32; quantized moments decode
+        so checkpoints stay portable across moment_dtype settings)."""
+        return v
+
+    def _state_from_checkpoint(self, name, arr, p):
+        return arr
+
     def state_dict(self):
         sd = {}
         for i, p in enumerate(self._parameter_list):
@@ -214,7 +222,8 @@ class Optimizer:
             if st is None:
                 continue
             for name, v in st.items():
-                sd[f"{p.name}_{name}"] = Tensor(v)
+                sd[f"{p.name}_{name}"] = Tensor(
+                    self._state_to_checkpoint(name, v, p))
             mw = self._master_weights.get(id(p))
             if mw is not None:
                 sd[f"{p.name}_master"] = Tensor(mw)
@@ -234,7 +243,8 @@ class Optimizer:
                 key = f"{p.name}_{name}"
                 if key in state_dict:
                     v = state_dict[key]
-                    st[name] = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    arr = v._data if isinstance(v, Tensor) else jnp.asarray(v)
+                    st[name] = self._state_from_checkpoint(name, arr, p)
             if st:
                 self._accumulators[id(p)] = st
             mkey = f"{p.name}_master"
@@ -295,37 +305,121 @@ class Momentum(Optimizer):
         return new_w, {"velocity": v}
 
 
+#: block length for int8 blockwise moment quantization (one f32 absmax
+#: scale per block; the bitsandbytes 8-bit-Adam layout, compiled by XLA)
+_MOMENT_BLOCK = 256
+
+
+def _moment_encode(x, dtype, nonneg=False):
+    """f32 moment -> storage form. int8: flatten, pad to blocks of
+    ``_MOMENT_BLOCK``, absmax-scale each block to int8. Non-negative
+    moments (Adam's v) quantize in sqrt space — squaring back on decode
+    preserves the small-variance entries that set the effective lr."""
+    if dtype is None:
+        return x
+    if dtype == "bfloat16":
+        return x.astype(jnp.bfloat16)
+    if nonneg:
+        x = jnp.sqrt(jnp.maximum(x, 0.0))
+    flat = x.reshape(-1)
+    pad = (-flat.size) % _MOMENT_BLOCK
+    fp = jnp.pad(flat, (0, pad)).reshape(-1, _MOMENT_BLOCK)
+    scale = jnp.max(jnp.abs(fp), axis=1, keepdims=True) / 127.0
+    q = jnp.round(fp / jnp.maximum(scale, 1e-30)) \
+        .clip(-127, 127).astype(jnp.int8)
+    return {"q": q, "s": scale.astype(jnp.float32)}
+
+
+def _moment_decode(st, shape, dtype, nonneg=False):
+    """Storage form -> f32 moment of ``shape``."""
+    if dtype is None:
+        return st
+    if dtype == "bfloat16":
+        return st.astype(jnp.float32)
+    flat = (st["q"].astype(jnp.float32) * st["s"]).reshape(-1)
+    size = int(np.prod(shape)) if shape else 1
+    out = flat[:size].reshape(shape)
+    if nonneg:
+        out = out * out
+    return out
+
+
 class Adam(Optimizer):
+    """``moment_dtype`` selects the optimizer-state precision (the HBM
+    knob that decides the largest model one chip trains): ``None`` = f32
+    (reference default), ``"bfloat16"`` = half-size moments,
+    ``"int8"`` = blockwise-quantized moments (~1 byte each + 1/256 f32
+    scales; the 8-bit-Adam recipe). The update math always runs f32."""
+
     _state_names = ["moment1", "moment2"]
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=False,
-                 use_multi_tensor=False, amsgrad=False, name=None):
+                 use_multi_tensor=False, amsgrad=False, moment_dtype=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+        if moment_dtype not in (None, "bfloat16", "int8"):
+            raise ValueError(
+                f"moment_dtype must be None, 'bfloat16' or 'int8', got "
+                f"{moment_dtype!r}")
+        if amsgrad and moment_dtype == "int8":
+            raise ValueError("amsgrad tracks a running max; int8 "
+                             "requantization would drift it — use "
+                             "moment_dtype='bfloat16' or None")
+        self._moment_dtype = moment_dtype
         if amsgrad:
             self._state_names = self._state_names + ["moment2_max"]
+
+    def _init_state(self, p):
+        if self._moment_dtype is None:
+            return super()._init_state(p)
+        zero = jnp.zeros(tuple(p._data.shape), jnp.float32)
+        return {name: _moment_encode(zero, self._moment_dtype,
+                                     nonneg=name.startswith("moment2"))
+                for name in self._state_names}
+
+    def _state_to_checkpoint(self, name, v, p):
+        if self._moment_dtype is None:
+            return v
+        return _moment_decode(v, tuple(p._data.shape), self._moment_dtype,
+                              nonneg=name.startswith("moment2"))
+
+    def _state_from_checkpoint(self, name, arr, p):
+        if self._moment_dtype is None:
+            return arr
+        return _moment_encode(arr.astype(jnp.float32), self._moment_dtype,
+                              nonneg=name.startswith("moment2"))
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
         g = self._apply_decay(w, g, wd_flag)
         b1, b2 = self._beta1, self._beta2
+        md = self._moment_dtype
         t = step.astype(jnp.float32)
-        m = b1 * state["moment1"] + (1 - b1) * g
-        v = b2 * state["moment2"] + (1 - b2) * g * g
+        shape = tuple(w.shape)
+        m = b1 * _moment_decode(state["moment1"], shape, md) + (1 - b1) * g
+        v = b2 * _moment_decode(state["moment2"], shape, md,
+                                nonneg=True) + (1 - b2) * g * g
         m_hat = m / (1 - b1 ** t)
         if self._amsgrad:
-            v_max = jnp.maximum(state["moment2_max"], v)
+            v_max = jnp.maximum(
+                _moment_decode(state["moment2_max"], shape, md,
+                               nonneg=True), v)
             v_hat = v_max / (1 - b2 ** t)
             new_w = w - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
-            return new_w, {"moment1": m, "moment2": v, "moment2_max": v_max}
+            return new_w, {"moment1": _moment_encode(m, md),
+                           "moment2": _moment_encode(v, md, nonneg=True),
+                           "moment2_max": _moment_encode(v_max, md,
+                                                         nonneg=True)}
         v_hat = v / (1 - b2 ** t)
         new_w = w - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
-        return new_w, {"moment1": m, "moment2": v}
+        return new_w, {"moment1": _moment_encode(m, md),
+                       "moment2": _moment_encode(v, md, nonneg=True)}
 
 
 class AdamW(Adam):
@@ -335,10 +429,11 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=False, amsgrad=False,
-                 name=None):
+                 moment_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision,
-                         amsgrad=amsgrad, name=name)
+                         amsgrad=amsgrad, moment_dtype=moment_dtype,
+                         name=name)
         self._wd_coeff = self._coeff(weight_decay)
         self._apply_decay_param_fun = apply_decay_param_fun
 
@@ -351,14 +446,18 @@ class AdamW(Adam):
 
     def _update(self, w, g, master, state, lr, lr_mult, step, wd_flag=1.0):
         b1, b2 = self._beta1, self._beta2
+        md = self._moment_dtype
         t = step.astype(jnp.float32)
-        m = b1 * state["moment1"] + (1 - b1) * g
-        v = b2 * state["moment2"] + (1 - b2) * g * g
+        shape = tuple(w.shape)
+        m = b1 * _moment_decode(state["moment1"], shape, md) + (1 - b1) * g
+        v = b2 * _moment_decode(state["moment2"], shape, md,
+                                nonneg=True) + (1 - b2) * g * g
         m_hat = m / (1 - b1 ** t)
         v_hat = v / (1 - b2 ** t)
         w = w * (1 - lr * self._wd_coeff * wd_flag)
         new_w = w - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
-        return new_w, {"moment1": m, "moment2": v}
+        return new_w, {"moment1": _moment_encode(m, md),
+                       "moment2": _moment_encode(v, md, nonneg=True)}
 
 
 class Adagrad(Optimizer):

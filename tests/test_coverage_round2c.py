@@ -124,10 +124,13 @@ class TestQuantization:
             optimizer=opt).prepare()
         pa = [p._data for p in engine._params]
         state = engine._init_opt_state(pa)
-        assert len(state) == 3       # (t, m, v) adam moments
+        assert len(state) == 3       # (t, masters, per-param state dicts)
+        assert all("moment1" in st or "m" in str(st.keys()).lower()
+                   or len(st) >= 2 for st in state[2])  # adam moments exist
         import jax.numpy as jnp
         x = jnp.zeros((4, 8)); y = jnp.zeros((4, 4))
-        loss, new_p, new_state = engine._train_step(pa, state, x, y)
+        lr = jnp.asarray(1e-2, jnp.float32)
+        loss, new_p, new_state = engine._train_step(pa, state, lr, x, y)
         assert int(new_state[0]) == 1
 
 
