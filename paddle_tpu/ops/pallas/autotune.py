@@ -1,0 +1,221 @@
+"""Measured per-shape/per-chip kernel autotuning with a persistent cache.
+
+Capability parity with the reference's runtime autotune machinery
+(reference: paddle/phi/kernels/autotune/cache.h — AlgorithmsCache keyed by
+shape/dtype, paddle/phi/kernels/autotune/switch_autotune.cc — the
+enable/disable switch and hit-rate bookkeeping). TPU-native: instead of
+picking cuDNN algos, the search picks Pallas tile sizes. First sight of a
+(kernel, shape-class, chip) key benchmarks a small candidate grid with the
+real compiled kernel, caches the winner in memory AND on disk
+(``~/.cache/paddle_tpu/autotune.json`` or ``$PADDLE_TPU_AUTOTUNE_CACHE``),
+so later processes on the same chip inherit the measurement instead of a
+hand-tuned constant from a different chip generation.
+
+Shape classes bucket the sequence length to the next power of two —
+close-by lengths share tiling behavior, so the cache stays small and a
+fresh length does not re-benchmark.
+
+The switch is the ``FLAGS_use_autotune`` flag (reference
+switch_autotune.cc semantics; default on). When the flag is off or the
+backend is not a real TPU (CPU tests run kernels through the Pallas
+interpreter, where timing means nothing), callers fall back to their
+static defaults.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ...core import flags
+
+flags.define_flag("use_autotune", True,
+                  "Measure-and-cache kernel tile sizes per shape/chip "
+                  "(reference FLAGS_use_autotune).")
+
+__all__ = ["AutotuneCache", "autotune", "cache_path", "chip_kind",
+           "seq_bucket", "should_autotune"]
+
+
+def cache_path() -> str:
+    p = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+    if p:
+        return p
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "autotune.json")
+
+
+def chip_kind() -> str:
+    """Device kind string of the default backend, cache-key safe."""
+    import jax
+    try:
+        kind = jax.devices()[0].device_kind
+    except Exception:
+        kind = "unknown"
+    return str(kind).replace(" ", "_")
+
+
+def is_tpu_backend() -> bool:
+    import jax
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def should_autotune() -> bool:
+    """Autotune only where measuring is meaningful: flag on + real chip
+    (the Pallas interpreter's timings would tune for the interpreter)."""
+    return bool(flags.get_flag("use_autotune")) and is_tpu_backend()
+
+
+def probe_reps(flops_per_call: float, target_s: float = 0.08,
+               assumed_tflops: float = 100.0) -> int:
+    """How many times to chain a kernel inside one probe program so
+    device time dominates per-call dispatch/transport overhead (remote
+    tunnels have a ~100 ms floor that would otherwise bury the kernel)."""
+    per_call_s = max(flops_per_call, 1.0) / (assumed_tflops * 1e12)
+    return int(min(256, max(4, round(target_s / per_call_s))))
+
+
+def seq_bucket(n: int) -> int:
+    """Next power of two ≥ n (min 128): nearby lengths share tiling."""
+    b = 128
+    while b < n:
+        b *= 2
+    return b
+
+
+class AutotuneCache:
+    """Process-wide winner cache, mirrored to a JSON file.
+
+    File writes are atomic (tmp + rename) and merged with any concurrent
+    writer's content at save time (last writer wins per key) — several
+    processes on one host converge instead of clobbering each other.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path or cache_path()
+        self._lock = threading.Lock()
+        self._mem: Dict[str, Any] = {}
+        self._loaded = False
+
+    # ------------------------------------------------------------- file io
+    def _load_file(self) -> Dict[str, Any]:
+        try:
+            with open(self._path) as f:
+                data = json.load(f)
+            return data if isinstance(data, dict) else {}
+        except (OSError, ValueError):
+            return {}
+
+    def _ensure_loaded(self):
+        if not self._loaded:
+            disk = self._load_file()
+            disk.update(self._mem)  # in-memory results win
+            self._mem = disk
+            self._loaded = True
+
+    def _save(self):
+        try:
+            os.makedirs(os.path.dirname(self._path), exist_ok=True)
+            merged = self._load_file()
+            merged.update(self._mem)
+            tmp = f"{self._path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(merged, f, indent=1, sort_keys=True)
+            os.replace(tmp, self._path)
+        except OSError:
+            pass  # cache persistence is best-effort
+
+    # -------------------------------------------------------------- access
+    def get(self, key: str):
+        with self._lock:
+            self._ensure_loaded()
+            return self._mem.get(key)
+
+    def put(self, key: str, value, persist: bool = True):
+        with self._lock:
+            self._ensure_loaded()
+            self._mem[key] = value
+            if persist:
+                self._save()
+
+    def clear_memory(self):
+        """Forget in-process state (tests); disk is untouched."""
+        with self._lock:
+            self._mem = {}
+            self._loaded = False
+
+
+_cache = AutotuneCache()
+
+
+def get_cache() -> AutotuneCache:
+    return _cache
+
+
+def make_key(kernel: str, **attrs) -> str:
+    parts = [kernel, chip_kind()]
+    parts += [f"{k}={attrs[k]}" for k in sorted(attrs)]
+    return "|".join(parts)
+
+
+def _value_sync(x) -> None:
+    """Force the computation to COMPLETE, by value read. On tunneled /
+    remote-dispatch backends ``block_until_ready`` returns before the
+    device has actually executed (it drains the local client only), so
+    timing loops must read a value derived from the result."""
+    import jax
+    import jax.numpy as jnp
+    try:
+        float(jnp.sum(x))
+    except TypeError:
+        jax.block_until_ready(x)
+
+
+def autotune(key: str,
+             candidates: Sequence[Any],
+             run: Callable[[Any, int], Any],
+             default: Any,
+             warmup: int = 2,
+             iters: int = 5) -> Any:
+    """Return the cached winner for ``key``, measuring on first sight.
+
+    ``run(candidate, i)`` executes the kernel once with that candidate on
+    the ``i``-th probe input and returns a JAX value. Callers must pass
+    per-candidate JITTED closures over a few DISTINCT probe inputs —
+    timing re-traced calls measures Python, and repeating one identical
+    execution lets replay-caching backends fake the timing. Candidates
+    that fail to compile or run are skipped; if all fail, ``default`` is
+    cached so the failure is not re-paid every call.
+    """
+    cached = _cache.get(key)
+    if cached is not None:
+        # JSON round-trips tuples as lists
+        return tuple(cached) if isinstance(cached, list) else cached
+
+    best, best_t = None, float("inf")
+    timings = {}
+    for cand in candidates:
+        try:
+            for i in range(max(warmup, 1)):
+                _value_sync(run(cand, i))
+            ts = []
+            for i in range(iters):
+                t0 = time.perf_counter()
+                _value_sync(run(cand, warmup + i))
+                ts.append(time.perf_counter() - t0)
+            ts.sort()
+            dt = ts[len(ts) // 2]
+        except Exception:
+            continue
+        timings[str(cand)] = dt
+        if dt < best_t:
+            best, best_t = cand, dt
+    if best is None:
+        best = default
+    _cache.put(key, list(best) if isinstance(best, tuple) else best)
+    return best

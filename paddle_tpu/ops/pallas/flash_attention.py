@@ -55,6 +55,106 @@ def _bwd_block_for(seq):
 #: run kernels in the Pallas interpreter (CPU testing of kernel code)
 INTERPRET = False
 
+# Candidate tile grids for the measured autotuner (ops/pallas/autotune.py).
+# Small on purpose: each candidate costs one Pallas compile at first sight
+# of a new (shape-class, chip) key; winners persist to disk.
+FWD_TILE_CANDIDATES = [(1024, 1024), (512, 512), (512, 1024), (1024, 512),
+                       (2048, 512)]
+BWD_TILE_CANDIDATES = [(512, 512), (1024, 1024), (256, 512), (512, 1024),
+                       (1024, 512)]
+
+
+def _tuned_blocks(kind, bh, s_q, s_k, d, dtype, causal, scale):
+    """Measured (block_q, block_k) for this shape class on this chip.
+
+    Falls back to the hand-tuned v5e constants when autotuning is off or
+    the backend is not a real TPU (reference
+    phi/kernels/autotune/switch_autotune.cc gate). Benchmarks run on
+    zeros at the BUCKETED sequence lengths (tile ranking is data- and
+    batch-mostly-independent; batch*heads is capped at 8 to keep the
+    probe cheap) — safe to call at trace time, since the probe inputs
+    are concrete.
+    """
+    from . import autotune as at
+
+    if INTERPRET or not at.should_autotune():
+        if kind == "fwd":
+            return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+        return _bwd_block_for(s_q), _bwd_block_for(s_k)
+
+    sq_b, sk_b = at.seq_bucket(s_q), at.seq_bucket(s_k)
+    key = at.make_key(f"flash_{kind}", sq=sq_b, sk=sk_b, d=d,
+                      dt=str(jnp.dtype(dtype)), causal=bool(causal))
+    cached = at.get_cache().get(key)
+    if cached is not None:
+        return tuple(cached)
+
+    bh_b = min(bh, 8)
+    # probe on noise, not zeros (constant-folding could skip real work),
+    # with several DISTINCT inputs cycled across timed iterations
+    # (replay-caching backends fake repeat-identical executions)
+    nvar = 3
+    qs, ks, vs = [], [], []
+    for i in range(nvar):
+        kp = jax.random.key(i)
+        qs.append(jax.random.normal(kp, (bh_b, sq_b, d)).astype(dtype))
+        ks.append(jax.random.normal(
+            jax.random.fold_in(kp, 1), (bh_b, sk_b, d)).astype(dtype))
+        vs.append(jax.random.normal(
+            jax.random.fold_in(kp, 2), (bh_b, sk_b, d)).astype(dtype))
+    # amortize per-call dispatch/transport under the kernel: chain K
+    # applications data-dependently inside ONE program (the kernel's
+    # q-shaped output feeds the next iteration), sized so device time
+    # dominates even a ~100 ms remote-dispatch floor
+    kernel_flops = 4.0 * bh_b * sq_b * sk_b * d * (0.5 if causal else 1.0)
+    reps = at.probe_reps(kernel_flops)
+    jitted = {}
+    if kind == "fwd":
+        candidates, default = FWD_TILE_CANDIDATES, (DEFAULT_BLOCK_Q,
+                                                    DEFAULT_BLOCK_K)
+
+        def run(c, i):
+            fn = jitted.get(c)
+            if fn is None:
+                kern = functools.partial(
+                    _flash_fwd_bhsd, causal=causal, scale=scale,
+                    block_q=c[0], block_k=c[1])
+
+                def chained(q0, k0, v0):
+                    return jax.lax.fori_loop(
+                        0, reps, lambda _, q: kern(q, k0, v0)[0], q0)
+
+                fn = jitted[c] = jax.jit(chained)
+            j = i % nvar
+            return fn(qs[j], ks[j], vs[j])
+    else:
+        candidates = BWD_TILE_CANDIDATES
+        default = (_bwd_block_for(s_q), _bwd_block_for(s_k))
+        fwd = jax.jit(functools.partial(
+            _flash_fwd_bhsd, causal=causal, scale=scale,
+            block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K))
+        outs, lses = zip(*(fwd(qs[j], ks[j], vs[j])
+                           for j in range(nvar)))
+
+        def run(c, i):
+            fn = jitted.get(c)
+            if fn is None:
+                kern = functools.partial(
+                    _flash_bwd_bhsd, causal=causal, scale=scale,
+                    block_q=c[0], block_k=c[1])
+
+                def chained(q0, k0, v0, o0, l0, g0):
+                    return jax.lax.fori_loop(
+                        0, reps,
+                        lambda _, q: kern(q, k0, v0, o0, l0, g0)[0], q0)
+
+                fn = jitted[c] = jax.jit(chained)
+            j = i % nvar
+            return fn(qs[j], ks[j], vs[j], outs[j], lses[j], outs[j])
+
+    return tuple(at.autotune(key, candidates, run, default,
+                             warmup=2, iters=5))
+
 
 def _causal_run(q_idx, kv_idx, block_q, block_k, offset):
     """Tile intersects the bottom-right-aligned causal region."""
@@ -349,11 +449,14 @@ def _flash_attention(q, k, v, causal, scale, block_q, block_k):
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
     b, s, h, d = q.shape
+    if block_q is None or block_k is None:
+        tq, tk = _tuned_blocks("fwd", b * h, s, k.shape[1], d, q.dtype,
+                               causal, scale)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     out, lse = _flash_fwd_bhsd(
         _bshd_to_bhsd(q), _bshd_to_bhsd(k), _bshd_to_bhsd(v),
-        causal=causal, scale=scale,
-        block_q=DEFAULT_BLOCK_Q if block_q is None else block_q,
-        block_k=DEFAULT_BLOCK_K if block_k is None else block_k)
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k)
     out_bshd = _bhsd_to_bshd(out, b, h)
     return out_bshd, (q, k, v, out_bshd, lse)
 
@@ -362,12 +465,15 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
     b, s, h, d = q.shape
     s_k = k.shape[1]
+    if block_q is None or block_k is None:
+        tq, tk = _tuned_blocks("bwd", b * h, s, s_k, d, q.dtype, causal,
+                               scale)
+        block_q = tq if block_q is None else block_q
+        block_k = tk if block_k is None else block_k
     dq, dk, dv = _flash_bwd_bhsd(
         _bshd_to_bhsd(q), _bshd_to_bhsd(k), _bshd_to_bhsd(v),
         _bshd_to_bhsd(out), lse, _bshd_to_bhsd(g),
-        causal=causal, scale=scale,
-        block_q=_bwd_block_for(s) if block_q is None else block_q,
-        block_k=_bwd_block_for(s_k) if block_k is None else block_k)
+        causal=causal, scale=scale, block_q=block_q, block_k=block_k)
     return (_bhsd_to_bshd(dq, b, h), _bhsd_to_bshd(dk, b, h),
             _bhsd_to_bshd(dv, b, h))
 

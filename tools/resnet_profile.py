@@ -1,0 +1,152 @@
+"""Where does the ResNet50 train step spend its time? (VERDICT r4 #2)
+
+Ablation-based profile on the real chip (a sampling profiler cannot see
+through the remote-dispatch tunnel): times the full train step, then
+variants that remove one cost at a time, plus achieved TF/s for the
+dominant conv shapes in isolation. Timing discipline: jitted closures,
+distinct inputs per iter, value-read syncs.
+
+Run: python tools/resnet_profile.py  (ambient TPU env)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BATCH = int(os.environ.get("PROFILE_BATCH", "256"))
+
+
+def timeit(fn, inputs, warmup=2, iters=5):
+    for i in range(warmup):
+        float(jnp.sum(fn(*inputs[i % len(inputs)])))
+    ts = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        float(jnp.sum(fn(*inputs[(warmup + i) % len(inputs)])))
+        ts.append(time.perf_counter() - t0)
+    ts.sort()
+    return ts[len(ts) // 2]
+
+
+def main():
+    import paddle_tpu as paddle
+    from paddle_tpu import amp
+    from paddle_tpu.vision.models import resnet50
+
+    print(f"backend={jax.default_backend()} batch={BATCH}")
+    paddle.seed(0)
+    model = resnet50()
+    params = [p for p in model.parameters() if not p.stop_gradient]
+    pa0 = [p._data for p in params]
+
+    xs = [jnp.asarray(np.random.RandomState(i).randn(
+        BATCH, 3, 224, 224).astype(np.float32)) for i in range(3)]
+    ys = [jnp.asarray(np.random.RandomState(100 + i).randint(
+        0, 1000, (BATCH,)).astype(np.int64)) for i in range(3)]
+
+    def loss_fn_of(amp_level, amp_on=True):
+        def loss_fn(pa, x, y):
+            originals = [p._data for p in params]
+            for p, a in zip(params, pa):
+                p._data = a
+            try:
+                if amp_on:
+                    with amp.auto_cast(level=amp_level, dtype="bfloat16"):
+                        out = model(paddle.Tensor(x))
+                else:
+                    out = model(paddle.Tensor(x))
+                import paddle_tpu.nn.functional as F
+                return F.cross_entropy(
+                    out, paddle.Tensor(y))._data.astype(jnp.float32)
+            finally:
+                for p, o in zip(params, originals):
+                    p._data = o
+        return loss_fn
+
+    rows = []
+
+    def add(name, fn, inputs):
+        dt = timeit(jax.jit(fn), inputs)
+        rows.append((name, dt))
+        print(f"{name:34}: {dt * 1e3:8.1f} ms")
+
+    lf = loss_fn_of("O1")
+    # full train step (fwd+bwd+SGD), the bench's shape
+    def step(pa, x, y):
+        loss, grads = jax.value_and_grad(lf)(pa, x, y)
+        return loss + jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g)) * 0 for g in grads]))
+
+    def step_full(pa, x, y):
+        loss, grads = jax.value_and_grad(lf)(pa, x, y)
+        new = [p - 0.1 * g for p, g in zip(pa, grads)]
+        return sum(jnp.sum(n) * 1e-12 for n in new) + loss
+
+    inputs = [(pa0, x, y) for x, y in zip(xs, ys)]
+    add("train step (fwd+bwd+sgd, O1)", step_full, inputs)
+    add("fwd+bwd only (O1)", step, inputs)
+    add("forward only (O1)", lf, inputs)
+    add("forward only (f32, no amp)", loss_fn_of("O1", amp_on=False),
+        inputs)
+
+    # BN ablation: eval-mode BN (running stats; no batch reductions)
+    model.eval()
+    add("forward only (O1, BN eval)", loss_fn_of("O1"), inputs)
+    model.train()
+
+    # isolated conv shapes (bf16): achieved TF/s on this chip's XLA conv
+    convs = [
+        ("stem 7x7s2 3->64 @224", (BATCH, 3, 224, 224), (64, 3, 7, 7), 2),
+        ("3x3 64->64 @56", (BATCH, 64, 56, 56), (64, 64, 3, 3), 1),
+        ("3x3 128->128 @28", (BATCH, 128, 28, 28), (128, 128, 3, 3), 1),
+        ("3x3 256->256 @14", (BATCH, 256, 14, 14), (256, 256, 3, 3), 1),
+        ("3x3 512->512 @7", (BATCH, 512, 7, 7), (512, 512, 3, 3), 1),
+        ("1x1 256->1024 @14", (BATCH, 256, 14, 14), (1024, 256, 1, 1), 1),
+    ]
+    for name, xshape, wshape, stride in convs:
+        x = jnp.asarray(np.random.RandomState(0).randn(*xshape),
+                        jnp.bfloat16)
+        w = jnp.asarray(np.random.RandomState(1).randn(*wshape) * 0.05,
+                        jnp.bfloat16)
+        dn = jax.lax.conv_dimension_numbers(
+            xshape, wshape, ("NCHW", "OIHW", "NCHW"))
+
+        def conv(x, w):
+            return jax.lax.conv_general_dilated(
+                x, w, (stride, stride), "SAME", dimension_numbers=dn)
+
+        # chain to amortize dispatch when spatial/channels allow it: use
+        # 3 distinct inputs instead (convs here are big enough to time)
+        cxs = [(x + i * jnp.bfloat16(0.001), w) for i in range(3)]
+        dt = timeit(jax.jit(conv), cxs)
+        out_sp = conv(x, w).shape
+        flops = 2 * np.prod(out_sp) * wshape[1] * wshape[2] * wshape[3]
+        print(f"  conv {name:22}: {dt*1e3:7.2f} ms  "
+              f"{flops/dt/1e12:6.1f} TF/s achieved")
+
+    # NHWC variant of one mid conv for layout comparison
+    x = jnp.asarray(np.random.RandomState(0).randn(BATCH, 28, 28, 128),
+                    jnp.bfloat16)
+    w = jnp.asarray(np.random.RandomState(1).randn(128, 128, 3, 3) * .05,
+                    jnp.bfloat16)
+    dn = jax.lax.conv_dimension_numbers(
+        x.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
+
+    def conv_nhwc(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=dn)
+
+    cxs = [(x + i * jnp.bfloat16(0.001), w) for i in range(3)]
+    dt = timeit(jax.jit(conv_nhwc), cxs)
+    flops = 2 * BATCH * 28 * 28 * 128 * 128 * 9
+    print(f"  conv 3x3 128->128 @28 NHWC   : {dt*1e3:7.2f} ms  "
+          f"{flops/dt/1e12:6.1f} TF/s achieved")
+
+
+if __name__ == "__main__":
+    main()
