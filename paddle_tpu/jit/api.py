@@ -97,6 +97,8 @@ class StaticFunction:
         # SOT partial-frame capture state: per-signature compiled-segment
         # caches + stats of the most recent SOT run (see jit/sot).
         self._sot_caches: dict = {}
+        #: per-signature frame journals for the steady-state bypass
+        self._sot_frames: dict = {}
         self.sot_stats: Optional[dict] = None
 
     @property
@@ -241,21 +243,90 @@ class StaticFunction:
             params[i]._swap_payload(arr)
         return _wrap(out)
 
+    def _frame_guard(self, fn):
+        """Frame-level guard string: the closure/default values the frame
+        itself can reach (the op-level fingerprints that the bypass skips
+        are derived from this state plus the journaled attrs)."""
+        from . import sot as sot_mod
+        g = sot_mod.fn_fingerprint(fn, depth=2)
+        owner = getattr(fn, "__self__", None)
+        if owner is not None:
+            g += "#" + sot_mod._const_repr(owner, 1)
+        return g
+
     def _run_sot(self, sig, fn, args, kwargs):
         """Partial-frame capture for a signature that cannot full-graph
         trace (reference jit/sot/translate.py contract): ops before each
         concretization point compile as one cached XLA subgraph, the break
-        runs eagerly, capture resumes after."""
+        runs eagerly, capture resumes after.
+
+        Steady state (reference symbolic/compile_cache.py guard-hit path):
+        once two consecutive replays journal the SAME segment DAG, later
+        calls check one frame-level guard and execute the stitched
+        compiled segments directly — zero per-op Python work. Any guard
+        miss or journal mismatch drops back to a recording replay.
+        """
+        import jax as _jax
+
+        from ..core.tensor import Tensor as _T
         from . import sot as sot_mod
         if sot_mod.active():
             # nested break inside an outer SOT capture: the outer segment
             # machinery already records these ops — just run the frame
             return fn(*args, **kwargs)
         cache = self._sot_caches.setdefault(sig, {})
-        cap = sot_mod.capture(cache)
+        state = self._sot_frames.setdefault(
+            sig, {"journal": None, "stable": False, "guard": None})
+
+        leaves, _ = _jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, _T))
+        input_arrays = [l._data for l in leaves if isinstance(l, _T)]
+        # raw ndarray / jax.Array args are re-materialized per call, so
+        # the journal cannot track their provenance (they would be frozen
+        # as first-call constants) — such frames stay on Python replay
+        trackable = all(isinstance(l, _T) or not _is_traced_leaf(l)
+                        for l in leaves)
+        params = self._params or []
+        guard = self._frame_guard(fn)
+
+        journal = state["journal"]
+        if (state["stable"] and journal is not None and journal.eligible
+                and state["guard"] == guard):
+            ok, packed, why = sot_mod.replay_frame(
+                journal, cache, input_arrays, params)
+            if ok:
+                treedef, out_leaves = packed
+                rebuilt = [
+                    _T(arr, stop_gradient=wrap[1]) if wrap is not None
+                    else arr
+                    for arr, wrap in out_leaves]
+                self.sot_stats = {"segments": len(journal.segments),
+                                  "compiled": 0, "bypassed": True}
+                return _jax.tree_util.tree_unflatten(treedef, rebuilt)
+            # guard missed: demote to recording replay
+            state["stable"] = False
+            state["journal"] = None
+
+        new_journal = sot_mod.FrameJournal()
+        if not trackable:
+            new_journal.mark_ineligible("non-Tensor array input")
+        cap = sot_mod.capture(cache, journal=new_journal,
+                              input_arrays=input_arrays, params=params)
         with cap:
             out = fn(*args, **kwargs)
+        out_leaves, out_treedef = _jax.tree_util.tree_flatten(
+            out, is_leaf=lambda x: isinstance(x, _T))
+        cap.finalize_journal(out_leaves, out_treedef)
+        prev = state["journal"]
+        state["stable"] = bool(
+            new_journal.eligible and prev is not None and prev.eligible
+            and state["guard"] == guard
+            and prev.structure_key() == new_journal.structure_key()
+            and new_journal.segments)
+        state["journal"] = new_journal if new_journal.eligible else None
+        state["guard"] = guard
         self.sot_stats = dict(cap.stats)
+        self.sot_stats["bypassed"] = False
         return out
 
     @property

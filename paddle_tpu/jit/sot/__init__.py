@@ -20,6 +20,21 @@ mid-frame ``numpy()`` sync therefore yields exactly two compiled subgraphs.
 Guards + cache: each flushed segment is keyed by (site index, op-sequence
 fingerprint, external input shapes/dtypes) — re-running the function with
 the same shapes reuses the compiled programs (the compile_cache.py role).
+
+Steady-state bypass (the compile_cache.py guard-hit fast path): while the
+frame replays, a ``FrameJournal`` records the segment DAG — each segment's
+cache key, where its external arrays came from (frame input / parameter /
+an earlier segment's output / captured constant), the scalar values Python
+read at the breaks, and how the frame's return value maps onto segment
+outputs. Two consecutive runs with the identical journal mark the frame
+STABLE; later calls skip Python entirely: one frame-level guard check
+(function closure fingerprint + input signature), then the stitched
+compiled segments execute directly with parameters re-read live and every
+break scalar value-guarded against the recording. Any guard miss falls
+back to Python replay and re-records. Frames whose outputs carry autograd
+nodes, whose break values are non-scalar and consumed by glue code, or
+whose glue mutates parameters mid-frame are ineligible (replay keeps full
+semantics there).
 """
 from __future__ import annotations
 
@@ -61,7 +76,11 @@ def _const_repr(v, depth: int) -> str:
         return "{" + ",".join(f"{k!r}:{_const_repr(x, depth - 1)}"
                               for k, x in items) + "}"
     if hasattr(v, "shape") and hasattr(v, "dtype"):
-        shape = tuple(getattr(v, "shape", ()))
+        try:
+            shape = tuple(v.shape)
+        except TypeError:
+            # ".shape" is a method, not array metadata (duck-type miss)
+            return f"<{type(v).__name__}>"
         size = int(np.prod(shape)) if shape else 1
         if size <= 1:
             # scalar arrays DO value-guard: a loss scale / step counter
@@ -74,18 +93,21 @@ def _const_repr(v, depth: int) -> str:
         # larger payloads guard shape/dtype only (cheap); value-captured
         # big arrays should be op INPUTS, not closure constants
         return f"<arr:{shape}:{v.dtype}>"
-    if callable(v):
+    import functools
+    if hasattr(v, "__code__") or isinstance(v, functools.partial):
         return fn_fingerprint(v, depth - 1)
-    # plain object: guard its primitive/scalar attributes one level deep
-    # (e.g. a GradScaler captured via ``self`` — its _scale must key the
-    # cache, or a post-overflow segment stale-hits the old scale)
+    # plain object (incl. callable objects like Layers): guard its
+    # primitive/scalar attributes one level deep (e.g. a GradScaler
+    # captured via ``self`` — its _scale must key the cache, or a
+    # post-overflow segment stale-hits the old scale)
     d = getattr(v, "__dict__", None)
     if d and depth > 0:
-        attrs = ",".join(
-            f"{k}:{_const_repr(x, 0)}" for k, x in
-            sorted(d.items())[:16]
-            if isinstance(x, _PRIM + (np.integer, np.floating, np.bool_))
-            or (hasattr(x, "shape") and hasattr(x, "dtype")))
+        guardable = [(k, x) for k, x in sorted(d.items())
+                     if isinstance(x, _PRIM + (np.integer, np.floating,
+                                               np.bool_))
+                     or (hasattr(x, "shape") and hasattr(x, "dtype"))]
+        attrs = ",".join(f"{k}:{_const_repr(x, 0)}"
+                         for k, x in guardable[:16])
         return f"<{type(v).__name__}:{attrs}>"
     return f"<{type(v).__name__}>"
 
@@ -301,8 +323,41 @@ class Segment:
         value_of = dict(zip(out_refs, results))
         for l in live:
             l._value = value_of[(l.node_id, l.out_idx)]
+        if self.owner.journal is not None:
+            self.owner._journal_segment(self, key, out_refs, results)
         self.owner.stats["segments"] += 1
         self.owner.site_idx += 1
+
+
+class FrameJournal:
+    """Record of one SOT replay: the frame's segment DAG + data flow.
+
+    ``segments``: list of dicts with
+      key        — the segment's compile-cache key
+      ext_srcs   — per ext array: ("in", i) frame tensor input,
+                   ("param", i) live parameter (re-read at bypass time),
+                   ("seg", s, (node, out)) earlier segment's output,
+                   ("const", array) value captured from glue code
+      out_refs   — the (node, out) pairs the segment materialized
+      guards     — {(node, out): float} scalar values Python read at the
+                   break (bypass re-checks them; a flip = control flow
+                   would differ = fall back to replay)
+    ``out_map``  — frame return value as (treedef, leaf descriptors)
+    ``eligible`` — False when bypass would be unsound for this frame
+    """
+
+    def __init__(self):
+        self.segments: List[dict] = []
+        self.out_map = None
+        self.eligible = True
+        self.reason = ""
+
+    def mark_ineligible(self, why: str):
+        self.eligible = False
+        self.reason = why
+
+    def structure_key(self):
+        return tuple(s["key"] for s in self.segments)
 
 
 class capture:
@@ -310,17 +365,114 @@ class capture:
 
     ``cache`` persists across invocations (per StaticFunction+signature);
     ``stats`` counts segments flushed / programs compiled for this run.
+    ``journal``: pass a FrameJournal plus the frame's input arrays and
+    parameters to record the segment DAG for the steady-state bypass.
     """
 
-    def __init__(self, cache: Optional[dict] = None):
+    def __init__(self, cache: Optional[dict] = None,
+                 journal: Optional[FrameJournal] = None,
+                 input_arrays: Sequence = (), params: Sequence = ()):
         self.cache = cache if cache is not None else {}
         self.stats = {"segments": 0, "compiled": 0}
         self.segment = Segment(self)
         self.site_idx = 0
+        self.journal = journal
+        if journal is not None:
+            self._src_of = {}
+            for i, a in enumerate(input_arrays):
+                self._src_of[id(a)] = ("in", i)
+            self._param_ids = {}
+            for i, p in enumerate(params):
+                d = getattr(p, "_data", None)
+                if d is not None:
+                    self._param_ids[id(d)] = i
+            self._params = list(params)
+            self._param_data0 = [getattr(p, "_data", None) for p in params]
 
     def _segment_closed(self, seg: Segment):
         if seg is self.segment:
             self.segment = Segment(self)
+
+    # ------------------------------------------------- journal recording
+    def _journal_segment(self, seg: "Segment", key, out_refs, results):
+        j = self.journal
+        if j is None or not j.eligible:
+            return
+        srcs = []
+        for a in seg.ext_arrays:
+            src = self._src_of.get(id(a))
+            if src is None:
+                pi = self._param_ids.get(id(a))
+                src = ("param", pi) if pi is not None else ("const", a)
+            srcs.append(src)
+        s_idx = len(j.segments)
+        j.segments.append({"key": key, "ext_srcs": srcs,
+                           "out_refs": list(out_refs), "guards": {}})
+        if not hasattr(self, "_out_values"):
+            self._out_values = {}
+        for ref, val in zip(out_refs, results):
+            # later segments that consume this output find it by id
+            self._src_of[id(val)] = ("seg", s_idx, tuple(ref))
+            self._out_values[(s_idx, tuple(ref))] = val
+
+    def finalize_journal(self, out_leaves: Sequence, treedef) -> None:
+        """Classify the frame's return leaves + decide break guards."""
+        j = self.journal
+        if j is None or not j.eligible:
+            return
+        # any parameter mutated mid-frame -> glue has side effects the
+        # bypass would not reproduce
+        for p, d0 in zip(self._params, self._param_data0):
+            if getattr(p, "_data", None) is not d0:
+                j.mark_ineligible("parameter mutated during frame")
+                return
+        leaf_descrs = []
+        for leaf in out_leaves:
+            if getattr(leaf, "grad_node", None) is not None:
+                j.mark_ineligible("output carries autograd state")
+                return
+            is_tensor = hasattr(leaf, "_data")
+            wrap = ("tensor", bool(getattr(leaf, "stop_gradient", True))) \
+                if is_tensor else None
+            payload = leaf._data if is_tensor else leaf
+            if type(payload) is LazyArray:
+                payload = payload.concrete()
+            src = self._src_of.get(id(payload))
+            if src is None:
+                leaf_descrs.append(("const", payload, wrap))
+            elif src[0] == "seg":
+                leaf_descrs.append(("seg", src[1], src[2], wrap))
+            else:
+                leaf_descrs.append((src[0], src[1], wrap))
+        j.out_map = (treedef, leaf_descrs)
+        # break guards: outputs of non-final segments that glue code read
+        # (i.e. NOT consumed as a later segment's ext input nor returned).
+        # Scalars are value-guarded; a non-scalar glue read is opaque to
+        # guarding, so the frame stays on Python replay.
+        consumed_by_later = set()
+        for srec in j.segments:
+            for src in srec["ext_srcs"]:
+                if src[0] == "seg":
+                    consumed_by_later.add((src[1], tuple(src[2])))
+        returned_refs = {(d[1], tuple(d[2])) for d in leaf_descrs
+                         if d[0] == "seg"}
+        out_values = getattr(self, "_out_values", {})
+        # EVERY segment's glue-read outputs need guards — including the
+        # final one: a frame can break, read a scalar, branch on it, and
+        # return without recording further ops
+        for s_idx, srec in enumerate(j.segments):
+            for ref in srec["out_refs"]:
+                r = (s_idx, tuple(ref))
+                if r in consumed_by_later or r in returned_refs:
+                    continue
+                val = out_values.get(r)
+                if val is None:
+                    continue
+                if getattr(val, "size", 0) != 1:
+                    j.mark_ineligible(
+                        "non-scalar break value read by glue code")
+                    return
+                srec["guards"][tuple(ref)] = float(np.asarray(val))
 
     def __enter__(self):
         if getattr(_tls, "capture", None) is not None:
@@ -333,6 +485,63 @@ class capture:
         if exc_type is None:
             self.segment.flush()
         return False
+
+
+def replay_frame(journal: FrameJournal, cache: dict, input_arrays: Sequence,
+                 params: Sequence):
+    """Steady-state fast path: execute the journal's stitched compiled
+    segments directly — no Python frame, no per-op recording, no
+    re-fingerprinting. Returns (ok, (treedef, leaves), why); ``ok=False``
+    means a guard missed or state moved and the caller must fall back to
+    a recording Python replay."""
+    env: dict = {}
+    for s_idx, srec in enumerate(journal.segments):
+        jitted = cache.get(srec["key"])
+        if jitted is None:
+            return False, None, "compiled segment evicted"
+        ext = []
+        for src in srec["ext_srcs"]:
+            kind = src[0]
+            if kind == "in":
+                ext.append(input_arrays[src[1]])
+            elif kind == "param":
+                if src[1] >= len(params):
+                    return False, None, "parameter list changed"
+                d = getattr(params[src[1]], "_data", None)
+                if d is None:
+                    return False, None, "parameter gone"
+                ext.append(d)
+            elif kind == "seg":
+                ext.append(env[(src[1], tuple(src[2]))])
+            else:  # const
+                ext.append(src[1])
+        results = jitted(ext)
+        for ref, val in zip(srec["out_refs"], results):
+            env[(s_idx, tuple(ref))] = val
+        for ref, expected in srec["guards"].items():
+            got = float(np.asarray(env[(s_idx, tuple(ref))]))
+            if got != expected:
+                # the scalar Python branched on at record time changed —
+                # glue control flow could differ; replay honestly
+                return False, None, "break value guard miss"
+    treedef, descrs = journal.out_map
+    leaves = []
+    for d in descrs:
+        kind = d[0]
+        if kind == "seg":
+            leaves.append((env[(d[1], tuple(d[2]))], d[3]))
+        elif kind == "in":
+            leaves.append((input_arrays[d[1]], d[2]))
+        elif kind == "param":
+            if d[1] >= len(params):
+                return False, None, "parameter list changed"
+            arr = getattr(params[d[1]], "_data", None)
+            if arr is None:
+                return False, None, "parameter gone"
+            leaves.append((arr, d[2]))
+        else:
+            leaves.append((d[1], d[2]))
+    return True, (treedef, leaves), ""
 
 
 def record_or_none(op_name: str, f: Callable, arrays: Sequence,

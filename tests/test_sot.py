@@ -91,13 +91,15 @@ class TestToStaticSot:
         ref = fn(x)
         np.testing.assert_allclose(np.asarray(out1.numpy()),
                                    np.asarray(ref.numpy()), atol=1e-6)
-        assert st.sot_stats == {"segments": 2, "compiled": 2}
+        assert st.sot_stats == {"segments": 2, "compiled": 2,
+                                "bypassed": False}
 
         # same shapes again: segments replay from the guarded cache
         out2 = st(x)
         np.testing.assert_allclose(np.asarray(out2.numpy()),
                                    np.asarray(ref.numpy()), atol=1e-6)
-        assert st.sot_stats == {"segments": 2, "compiled": 0}
+        assert st.sot_stats == {"segments": 2, "compiled": 0,
+                                "bypassed": False}
 
     def test_new_shape_recompiles_via_guards(self):
         fn, w = self._make()
@@ -108,9 +110,11 @@ class TestToStaticSot:
             st(x1)
         with pytest.warns(UserWarning):
             st(x2)                             # new signature, new break
-        assert st.sot_stats == {"segments": 2, "compiled": 2}
+        assert st.sot_stats == {"segments": 2, "compiled": 2,
+                                "bypassed": False}
         st(x2)
-        assert st.sot_stats == {"segments": 2, "compiled": 0}
+        assert st.sot_stats == {"segments": 2, "compiled": 0,
+                                "bypassed": False}
 
     def test_full_graph_signatures_unaffected(self):
         calls = []
@@ -161,3 +165,159 @@ class TestToStaticSot:
         loss2.backward()
         np.testing.assert_allclose(got, np.asarray(w.grad._data),
                                    atol=1e-6)
+
+
+class TestSteadyStateBypass:
+    """VERDICT r4 #4 (reference symbolic/compile_cache.py guard-hit path):
+    after two identical replays, a stable frame executes its stitched
+    compiled segments directly — no per-op Python recording."""
+
+    class _Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 8)
+            self.gain = 2.0
+
+        def forward(self, x):
+            s = float(paddle.ops.mean(x).numpy())   # input-only break
+            y = paddle.ops.tanh(self.fc(x))
+            if s > 1e9:                              # glue control flow
+                y = y * 0.0
+            return paddle.ops.exp(y) * self.gain
+
+    def _frozen_net(self):
+        paddle.seed(21)
+        net = self._Net()
+        for p in net.parameters():
+            p.stop_gradient = True   # grad-free: bypass-eligible
+        return net
+
+    def test_third_call_bypasses_python(self):
+        net = self._frozen_net()
+        st = paddle.jit.to_static(net.forward, full_graph=False)
+        x = paddle.to_tensor(
+            np.random.RandomState(2).randn(4, 8).astype(np.float32))
+        with pytest.warns(UserWarning):
+            ref = st(x)
+        assert st.sot_stats["bypassed"] is False
+        st(x)                                       # journal match -> stable
+        assert st.sot_stats["bypassed"] is False
+        out = st(x)                                 # steady state
+        assert st.sot_stats["bypassed"] is True
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref.numpy()), atol=1e-6)
+        # and the python frame really did not run: no new segments compile
+        assert st.sot_stats["compiled"] == 0
+
+    def test_bypass_reads_parameters_live(self):
+        net = self._frozen_net()
+        st = paddle.jit.to_static(net.forward, full_graph=False)
+        x = paddle.to_tensor(
+            np.random.RandomState(4).randn(4, 8).astype(np.float32))
+        with pytest.warns(UserWarning):
+            st(x)
+        st(x)
+        st(x)
+        assert st.sot_stats["bypassed"] is True
+        # update the weight; the journaled ("param", i) source must re-read
+        w = net.fc.weight
+        w._swap_payload(w._data * 0.5)
+        out = st(x)
+        assert st.sot_stats["bypassed"] is True     # no re-record needed
+        ref = net.forward(x)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref.numpy()), atol=1e-6)
+
+    def test_break_value_guard_falls_back(self):
+        net = self._frozen_net()
+        st = paddle.jit.to_static(net.forward, full_graph=False)
+        x1 = paddle.to_tensor(
+            np.random.RandomState(5).randn(4, 8).astype(np.float32))
+        with pytest.warns(UserWarning):
+            st(x1)
+        st(x1)
+        st(x1)
+        assert st.sot_stats["bypassed"] is True
+        # same shapes, different values: the break scalar changes, the
+        # guard must miss, and the frame replays honestly (correct result)
+        x2 = paddle.to_tensor(
+            np.random.RandomState(6).randn(4, 8).astype(np.float32))
+        out = st(x2)
+        assert st.sot_stats["bypassed"] is False
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(net.forward(x2).numpy()),
+                                   atol=1e-6)
+
+    def test_object_attr_guard_invalidates(self):
+        net = self._frozen_net()
+        st = paddle.jit.to_static(net.forward, full_graph=False)
+        x = paddle.to_tensor(
+            np.random.RandomState(7).randn(4, 8).astype(np.float32))
+        with pytest.warns(UserWarning):
+            st(x)
+        st(x)
+        st(x)
+        assert st.sot_stats["bypassed"] is True
+        net.gain = 3.0   # frame-level guard: owner attrs one level deep
+        out = st(x)
+        assert st.sot_stats["bypassed"] is False    # guard missed
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(net.forward(x).numpy()),
+                                   atol=1e-6)
+
+    def test_grad_frames_stay_on_replay(self):
+        paddle.seed(23)
+        net = self._Net()       # params require grad -> outputs carry tape
+        st = paddle.jit.to_static(net.forward, full_graph=False)
+        x = paddle.to_tensor(
+            np.random.RandomState(8).randn(4, 8).astype(np.float32))
+        with pytest.warns(UserWarning):
+            st(x)
+        st(x)
+        st(x)
+        assert st.sot_stats["bypassed"] is False    # ineligible, honest
+
+    def test_single_segment_branch_guarded(self):
+        """Code-review r5 finding: a frame that breaks, branches on the
+        scalar, and returns WITHOUT recording further ops must still
+        guard that scalar (the final segment's glue reads)."""
+        w = paddle.to_tensor(
+            np.random.RandomState(31).randn(8, 8).astype(np.float32) * 0.3)
+
+        def fn(x):
+            y = paddle.ops.tanh(paddle.ops.matmul(x, w))
+            s = float(paddle.ops.mean(y).numpy())
+            if s > 0:
+                return y
+            return y * 0.0
+
+        st = paddle.jit.to_static(fn, full_graph=False)
+        # an input with positive mean, twice -> stable
+        xp = paddle.to_tensor(np.full((2, 8), 0.5, np.float32))
+        with pytest.warns(UserWarning):
+            st(xp)
+        st(xp)
+        st(xp)
+        # negative-mean input: the branch must flip, not stale-replay
+        xn = paddle.to_tensor(np.full((2, 8), -0.5, np.float32))
+        out = st(xn)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(fn(xn).numpy()), atol=1e-6)
+
+    def test_ndarray_inputs_never_bypass(self):
+        """Code-review r5 finding: raw ndarray args are re-materialized
+        per call (untrackable provenance) — the frame must stay on
+        replay and keep answering with CURRENT values."""
+        net = self._frozen_net()
+        st = paddle.jit.to_static(net.forward, full_graph=False)
+        a = np.random.RandomState(8).randn(4, 8).astype(np.float32)
+        b = np.random.RandomState(9).randn(4, 8).astype(np.float32)
+        with pytest.warns(UserWarning):
+            st(a)
+        st(a)
+        out = st(b)   # would be f(a) under a buggy bypass
+        assert st.sot_stats["bypassed"] is False
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()),
+            np.asarray(net.forward(paddle.to_tensor(b)).numpy()),
+            atol=1e-6)
