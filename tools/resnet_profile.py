@@ -49,9 +49,12 @@ def main():
     ys = [jnp.asarray(np.random.RandomState(100 + i).randint(
         0, 1000, (BATCH,)).astype(np.int64)) for i in range(3)]
 
+    buffers = [b for _, b in model.named_buffers()]
+
     def loss_fn_of(amp_level, amp_on=True):
         def loss_fn(pa, x, y):
             originals = [p._data for p in params]
+            buf0 = [b._data for b in buffers]
             for p, a in zip(params, pa):
                 p._data = a
             try:
@@ -66,6 +69,10 @@ def main():
             finally:
                 for p, o in zip(params, originals):
                     p._data = o
+                # BN running stats mutate in train mode — restore so the
+                # traced values never leak out of the transform
+                for b, o in zip(buffers, buf0):
+                    b._data = o
         return loss_fn
 
     rows = []
