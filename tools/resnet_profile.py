@@ -1,10 +1,10 @@
 """Where does the ResNet50 train step spend its time? (VERDICT r4 #2)
 
 Ablation-based profile on the real chip (a sampling profiler cannot see
-through the remote-dispatch tunnel): times the full train step, then
-variants that remove one cost at a time, plus achieved TF/s for the
-dominant conv shapes in isolation. Timing discipline: jitted closures,
-distinct inputs per iter, value-read syncs.
+through the remote-dispatch tunnel). Every measurement chains ``REPS``
+iterations data-dependently inside ONE jitted program (scalar feedback:
+``x_next = x * (1 + 0*loss)``), so the ~120 ms per-call transport floor
+divides out; syncs are value reads.
 
 Run: python tools/resnet_profile.py  (ambient TPU env)
 """
@@ -19,9 +19,10 @@ import jax.numpy as jnp
 import numpy as np
 
 BATCH = int(os.environ.get("PROFILE_BATCH", "256"))
+REPS = int(os.environ.get("PROFILE_REPS", "4"))
 
 
-def timeit(fn, inputs, warmup=2, iters=5):
+def timeit(fn, inputs, warmup=2, iters=3):
     for i in range(warmup):
         float(jnp.sum(fn(*inputs[i % len(inputs)])))
     ts = []
@@ -38,10 +39,12 @@ def main():
     from paddle_tpu import amp
     from paddle_tpu.vision.models import resnet50
 
-    print(f"backend={jax.default_backend()} batch={BATCH}")
+    print(f"backend={jax.default_backend()} batch={BATCH} reps={REPS}",
+          flush=True)
     paddle.seed(0)
     model = resnet50()
     params = [p for p in model.parameters() if not p.stop_gradient]
+    buffers = [b for _, b in model.named_buffers()]
     pa0 = [p._data for p in params]
 
     xs = [jnp.asarray(np.random.RandomState(i).randn(
@@ -49,9 +52,7 @@ def main():
     ys = [jnp.asarray(np.random.RandomState(100 + i).randint(
         0, 1000, (BATCH,)).astype(np.int64)) for i in range(3)]
 
-    buffers = [b for _, b in model.named_buffers()]
-
-    def loss_fn_of(amp_level, amp_on=True):
+    def loss_fn_of(amp_on=True):
         def loss_fn(pa, x, y):
             originals = [p._data for p in params]
             buf0 = [b._data for b in buffers]
@@ -59,7 +60,7 @@ def main():
                 p._data = a
             try:
                 if amp_on:
-                    with amp.auto_cast(level=amp_level, dtype="bfloat16"):
+                    with amp.auto_cast(level="O1", dtype="bfloat16"):
                         out = model(paddle.Tensor(x))
                 else:
                     out = model(paddle.Tensor(x))
@@ -69,90 +70,85 @@ def main():
             finally:
                 for p, o in zip(params, originals):
                     p._data = o
-                # BN running stats mutate in train mode — restore so the
-                # traced values never leak out of the transform
                 for b, o in zip(buffers, buf0):
                     b._data = o
         return loss_fn
 
-    rows = []
-
-    def add(name, fn, inputs):
-        dt = timeit(jax.jit(fn), inputs)
-        rows.append((name, dt))
-        print(f"{name:34}: {dt * 1e3:8.1f} ms")
-
-    lf = loss_fn_of("O1")
-    # full train step (fwd+bwd+SGD), the bench's shape
-    def step(pa, x, y):
-        loss, grads = jax.value_and_grad(lf)(pa, x, y)
-        return loss + jnp.sum(jnp.stack(
-            [jnp.sum(jnp.abs(g)) * 0 for g in grads]))
-
-    def step_full(pa, x, y):
-        loss, grads = jax.value_and_grad(lf)(pa, x, y)
-        new = [p - 0.1 * g for p, g in zip(pa, grads)]
-        return sum(jnp.sum(n) * 1e-12 for n in new) + loss
+    def chained(per_iter):
+        """Chain REPS iterations: the scalar result scales next input."""
+        def f(pa, x, y):
+            def body(i, carry):
+                x, acc = carry
+                s = per_iter(pa, x, y)
+                return (x * (1.0 + 0.0 * s), acc + s)
+            _, acc = jax.lax.fori_loop(0, REPS, body,
+                                       (x, jnp.float32(0)))
+            return acc
+        return f
 
     inputs = [(pa0, x, y) for x, y in zip(xs, ys)]
-    add("train step (fwd+bwd+sgd, O1)", step_full, inputs)
-    add("fwd+bwd only (O1)", step, inputs)
-    add("forward only (O1)", lf, inputs)
-    add("forward only (f32, no amp)", loss_fn_of("O1", amp_on=False),
-        inputs)
 
-    # BN ablation: eval-mode BN (running stats; no batch reductions)
+    def add(name, per_iter):
+        dt = timeit(jax.jit(chained(per_iter)), inputs) / REPS
+        print(f"{name:34}: {dt * 1e3:8.1f} ms/iter", flush=True)
+        return dt
+
+    lf = loss_fn_of()
+
+    def fwd_bwd(pa, x, y):
+        loss, grads = jax.value_and_grad(lf)(pa, x, y)
+        return loss + sum(jnp.sum(g) * 1e-12 for g in grads)
+
+    def full_step(pa, x, y):
+        loss, grads = jax.value_and_grad(lf)(pa, x, y)
+        return loss + sum(jnp.sum(p - 0.1 * g) * 1e-12
+                          for p, g in zip(pa, grads))
+
+    t_step = add("train step (fwd+bwd+sgd, O1)", full_step)
+    add("fwd+bwd (O1)", fwd_bwd)
+    t_fwd = add("forward only (O1)", lf)
+    add("forward only (f32)", loss_fn_of(amp_on=False))
     model.eval()
-    add("forward only (O1, BN eval)", loss_fn_of("O1"), inputs)
+    add("forward only (O1, BN eval)", loss_fn_of())
     model.train()
 
-    # isolated conv shapes (bf16): achieved TF/s on this chip's XLA conv
+    flops_step = 3 * BATCH * 4.1e9 * 2 / 2  # ~2x fwd for bwd; fwd 4.1GF
+    print(f"-> step {t_step*1e3:.0f} ms = {BATCH/t_step:.0f} img/s; "
+          f"fwd fraction {t_fwd/t_step:.2f}", flush=True)
+
+    # isolated conv shapes (bf16, chained): achieved TF/s of XLA conv
     convs = [
-        ("stem 7x7s2 3->64 @224", (BATCH, 3, 224, 224), (64, 3, 7, 7), 2),
-        ("3x3 64->64 @56", (BATCH, 64, 56, 56), (64, 64, 3, 3), 1),
-        ("3x3 128->128 @28", (BATCH, 128, 28, 28), (128, 128, 3, 3), 1),
-        ("3x3 256->256 @14", (BATCH, 256, 14, 14), (256, 256, 3, 3), 1),
-        ("3x3 512->512 @7", (BATCH, 512, 7, 7), (512, 512, 3, 3), 1),
-        ("1x1 256->1024 @14", (BATCH, 256, 14, 14), (1024, 256, 1, 1), 1),
+        ("3x3 64->64 @56", (BATCH, 64, 56, 56), (64, 64, 3, 3)),
+        ("3x3 128->128 @28", (BATCH, 128, 28, 28), (128, 128, 3, 3)),
+        ("3x3 256->256 @14", (BATCH, 256, 14, 14), (256, 256, 3, 3)),
+        ("3x3 512->512 @7", (BATCH, 512, 7, 7), (512, 512, 3, 3)),
     ]
-    for name, xshape, wshape, stride in convs:
-        x = jnp.asarray(np.random.RandomState(0).randn(*xshape),
-                        jnp.bfloat16)
-        w = jnp.asarray(np.random.RandomState(1).randn(*wshape) * 0.05,
-                        jnp.bfloat16)
-        dn = jax.lax.conv_dimension_numbers(
-            xshape, wshape, ("NCHW", "OIHW", "NCHW"))
+    for name, xshape, wshape in convs:
+        for fmt in ("NCHW", "NHWC"):
+            if fmt == "NHWC":
+                xsh = (xshape[0], xshape[2], xshape[3], xshape[1])
+            else:
+                xsh = xshape
+            x = jnp.asarray(np.random.RandomState(0).randn(*xsh) * 0.1,
+                            jnp.bfloat16)
+            w = jnp.asarray(
+                np.random.RandomState(1).randn(*wshape) * 0.05,
+                jnp.bfloat16)
+            dn = jax.lax.conv_dimension_numbers(
+                xsh, wshape, (fmt, "OIHW", fmt))
 
-        def conv(x, w):
-            return jax.lax.conv_general_dilated(
-                x, w, (stride, stride), "SAME", dimension_numbers=dn)
+            def conv_chain(x, w):
+                def body(i, c):
+                    y = jax.lax.conv_general_dilated(
+                        c, w, (1, 1), "SAME", dimension_numbers=dn)
+                    return y * jnp.bfloat16(0.1)
+                return jax.lax.fori_loop(0, 16, body, x)
 
-        # chain to amortize dispatch when spatial/channels allow it: use
-        # 3 distinct inputs instead (convs here are big enough to time)
-        cxs = [(x + i * jnp.bfloat16(0.001), w) for i in range(3)]
-        dt = timeit(jax.jit(conv), cxs)
-        out_sp = conv(x, w).shape
-        flops = 2 * np.prod(out_sp) * wshape[1] * wshape[2] * wshape[3]
-        print(f"  conv {name:22}: {dt*1e3:7.2f} ms  "
-              f"{flops/dt/1e12:6.1f} TF/s achieved")
-
-    # NHWC variant of one mid conv for layout comparison
-    x = jnp.asarray(np.random.RandomState(0).randn(BATCH, 28, 28, 128),
-                    jnp.bfloat16)
-    w = jnp.asarray(np.random.RandomState(1).randn(128, 128, 3, 3) * .05,
-                    jnp.bfloat16)
-    dn = jax.lax.conv_dimension_numbers(
-        x.shape, w.shape, ("NHWC", "OIHW", "NHWC"))
-
-    def conv_nhwc(x, w):
-        return jax.lax.conv_general_dilated(
-            x, w, (1, 1), "SAME", dimension_numbers=dn)
-
-    cxs = [(x + i * jnp.bfloat16(0.001), w) for i in range(3)]
-    dt = timeit(jax.jit(conv_nhwc), cxs)
-    flops = 2 * BATCH * 28 * 28 * 128 * 128 * 9
-    print(f"  conv 3x3 128->128 @28 NHWC   : {dt*1e3:7.2f} ms  "
-          f"{flops/dt/1e12:6.1f} TF/s achieved")
+            cxs = [(x + jnp.bfloat16(0.001 * i), w) for i in range(3)]
+            dt = timeit(jax.jit(conv_chain), cxs) / 16
+            flops = 2 * np.prod(xshape) * wshape[0] * 9
+            print(f"  conv {name:18} {fmt}: {dt*1e3:7.2f} ms  "
+                  f"{flops/dt/1e12:6.1f} TF/s", flush=True)
 
 
 if __name__ == "__main__":
