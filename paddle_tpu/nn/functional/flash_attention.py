@@ -23,19 +23,93 @@ def _t(x):
     return x if isinstance(x, Tensor) else as_tensor(x)
 
 
-def _use_pallas(seq_len=None):
+def _use_pallas(seq_len=None, head_dim=None, dtype=None, causal=True):
     from ...core import flags
     if not flags.get_flag("use_pallas_kernels"):
         return False
-    if seq_len is not None and seq_len < flags.get_flag("flash_min_seq_len"):
+    try:
+        on_tpu = jax.default_backend() in ("tpu", "axon")
+    except Exception:
+        return False
+    if not on_tpu:
+        return False
+    if seq_len is None:
+        return True
+    # algorithm selection (the reference autotune cache's other job,
+    # phi/kernels/autotune/cache.h AlgorithmType): when the user has not
+    # pinned flash_min_seq_len, MEASURE XLA-dense vs Pallas-flash for
+    # this shape class once per chip and cache the winner
+    f = flags._registry.get("flash_min_seq_len")
+    if (f is not None and f.value == f.default and head_dim is not None):
+        from ...ops.pallas import autotune as at
+        if at.should_autotune():
+            return _tuned_attn_impl(seq_len, head_dim, dtype,
+                                    causal) == "pallas"
+    if seq_len < flags.get_flag("flash_min_seq_len"):
         # measured crossover (see flag docstring): short sequences run
         # faster through XLA's fused dense attention than the blocked
         # Pallas kernel
         return False
-    try:
-        return jax.default_backend() in ("tpu", "axon")
-    except Exception:
-        return False
+    return True
+
+
+def _tuned_attn_impl(seq_len, head_dim, dtype, causal):
+    """'pallas' or 'xla' for this (seq-bucket, head_dim, causal, dtype)
+    class, measured once per chip: one fwd+bwd attention step per
+    candidate, chained data-dependently so transport divides out. XLA
+    dense at long seq OOMs its (B,H,S,S) logits — the probe's failure
+    skips it, which picks pallas exactly where dense is infeasible."""
+    from ...ops.pallas import autotune as at
+
+    dt = jnp.dtype(dtype) if dtype is not None else jnp.float32
+    sb = at.seq_bucket(seq_len)
+    key = at.make_key("attn_impl", s=sb, d=int(head_dim),
+                      dt=str(dt), causal=bool(causal))
+    cached = at.get_cache().get(key)
+    if cached is not None:
+        return cached
+
+    B, H = 2, 8
+    qs, ks, vs = [], [], []
+    for i in range(3):
+        kp = jax.random.key(50 + i)
+        qs.append(jax.random.normal(
+            kp, (B, sb, H, head_dim)).astype(dt))
+        ks.append(jax.random.normal(
+            jax.random.fold_in(kp, 1), (B, sb, H, head_dim)).astype(dt))
+        vs.append(jax.random.normal(
+            jax.random.fold_in(kp, 2), (B, sb, H, head_dim)).astype(dt))
+    flops = 3 * 4.0 * B * H * sb * sb * head_dim * (0.5 if causal else 1)
+    reps = at.probe_reps(flops)
+    jitted = {}
+
+    def run(impl, i):
+        fn = jitted.get(impl)
+        if fn is None:
+            def one(q, k, v):
+                if impl == "pallas":
+                    from ...ops.pallas.flash_attention import \
+                        flash_attention_fwd
+                    out = flash_attention_fwd(q, k, v, causal=causal)
+                else:
+                    out = _sdpa_xla(q, k, v, causal=causal)
+                return jnp.mean(out.astype(jnp.float32))
+
+            def step(q, k, v):
+                def body(_, c):
+                    l, g = jax.value_and_grad(one)(c, k, v)
+                    # tiny NONZERO factor: a zero coefficient would let
+                    # XLA dead-code-eliminate the whole backward pass
+                    return c - g * jnp.asarray(1e-30, c.dtype)
+                return jax.lax.fori_loop(0, reps, body, q)
+
+            fn = jitted[impl] = jax.jit(step)
+        j = i % 3
+        return fn(qs[j], ks[j], vs[j])
+
+    default = "pallas" if seq_len >= 1024 else "xla"
+    return at.autotune(key, ["pallas", "xla"], run, default,
+                       warmup=2, iters=5)
 
 
 def _sdpa_xla(q, k, v, bias=None, causal=False, dropout_p=0.0, key=None,
@@ -66,7 +140,8 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
         from ...core.generator import next_key
         drop_key = next_key()
 
-    if _use_pallas(q.shape[1]) and dropout == 0.0:
+    if _use_pallas(q.shape[1], q.shape[-1], q.dtype,
+                   causal) and dropout == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
 
         def f(qa, ka, va):
@@ -94,7 +169,8 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         from ...core.generator import next_key
         drop_key = next_key()
 
-    if _use_pallas(q.shape[1]) and not has_mask and dropout_p == 0.0:
+    if _use_pallas(q.shape[1], q.shape[-1], q.dtype, is_causal) \
+            and not has_mask and dropout_p == 0.0:
         from ...ops.pallas.flash_attention import flash_attention_fwd
 
         def f(qa, ka, va):

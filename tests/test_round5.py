@@ -426,3 +426,38 @@ class TestQuantizedDeployment:
         pred.run()
         got = pred.get_output_handle("output_0").copy_to_cpu()
         np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_attention_impl_selection_gating(monkeypatch):
+    """Algorithm selection (XLA dense vs Pallas flash) consults the
+    autotuner only when the chip can be measured AND the user has not
+    pinned flash_min_seq_len; otherwise the flag crossover decides."""
+    import importlib
+
+    import jax.numpy as jnp
+    from paddle_tpu.core import flags
+    from paddle_tpu.ops.pallas import autotune as at
+    fa = importlib.import_module("paddle_tpu.nn.functional.flash_attention")
+
+    # CPU: autotune off -> flag path, no probe
+    assert not at.should_autotune()
+    called = []
+    monkeypatch.setattr(fa, "_tuned_attn_impl",
+                        lambda *a: called.append(a) or "pallas")
+    fa._use_pallas(2048, 64, jnp.bfloat16, True)
+    assert not called
+
+    # pretend we are on a measurable chip: the probe is consulted
+    monkeypatch.setattr(at, "should_autotune", lambda: True)
+    monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
+    assert fa._use_pallas(2048, 64, jnp.bfloat16, True) is True
+    assert called
+
+    # a user-pinned flash_min_seq_len overrides measurement entirely
+    called.clear()
+    flags.set_flags({"flash_min_seq_len": 4096})
+    try:
+        assert fa._use_pallas(2048, 64, jnp.bfloat16, True) is False
+        assert not called
+    finally:
+        flags.set_flags({"flash_min_seq_len": 1024})
