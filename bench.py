@@ -19,6 +19,14 @@ import os
 import sys
 import time
 
+# The ladder reports against PINNED, hand-validated kernel constants:
+# a first-sight autotune probe taken while the chip transport happens to
+# be degraded would cache a bad winner and silently change what this
+# benchmark measures. The autotuner is a user feature, validated
+# separately by tools/autotune_validate.py. BENCH_AUTOTUNE=1 opts in.
+if os.environ.get("BENCH_AUTOTUNE") != "1":
+    os.environ.setdefault("FLAGS_use_autotune", "0")
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -686,11 +694,21 @@ def main():
     # so a driver that keeps only the last line still records the ladder.
     rungs = {}
     for name in ("gpt2", "resnet50", "bert", "llama", "llama14"):
-        try:
-            r = benches[name](small)
-        except Exception as e:  # pragma: no cover - rung isolation
-            r = {"metric": name, "value": 0.0, "unit": "error",
-                 "vs_baseline": 0.0, "extra": {"error": repr(e)[:300]}}
+        r = None
+        for attempt in (1, 2):
+            try:
+                r = benches[name](small)
+                break
+            except Exception as e:  # pragma: no cover - rung isolation
+                # the remote-compile service 500s transiently; one clean
+                # retry (fresh caches) rides out a flaky window without
+                # masking a real failure
+                r = {"metric": name, "value": 0.0, "unit": "error",
+                     "vs_baseline": 0.0, "extra": {"error": repr(e)[:300]}}
+                import gc
+                gc.collect()
+                jax.clear_caches()
+                time.sleep(20)
         print(json.dumps(r))
         sys.stdout.flush()
         rungs[name] = r

@@ -36,11 +36,18 @@ def _use_pallas(seq_len=None, head_dim=None, dtype=None, causal=True):
     if seq_len is None:
         return True
     # algorithm selection (the reference autotune cache's other job,
-    # phi/kernels/autotune/cache.h AlgorithmType): when the user has not
-    # pinned flash_min_seq_len, MEASURE XLA-dense vs Pallas-flash for
-    # this shape class once per chip and cache the winner
+    # phi/kernels/autotune/cache.h AlgorithmType): when enabled and the
+    # user has not pinned flash_min_seq_len, MEASURE XLA-dense vs
+    # Pallas-flash for this shape class once per chip and cache the
+    # winner. OPT-IN (FLAGS_autotune_attn_impl): unlike tile tuning,
+    # a wrong winner here changes the ALGORITHM — a probe taken while
+    # the chip transport is degraded can flip a model to the slower
+    # path wholesale (observed: a flaky remote-compile window chose
+    # dense attention for a d=128 S=2048 model and halved its MFU).
     f = flags._registry.get("flash_min_seq_len")
-    if (f is not None and f.value == f.default and head_dim is not None):
+    if (flags.get_flag("autotune_attn_impl")
+            and f is not None and f.value == f.default
+            and head_dim is not None):
         from ...ops.pallas import autotune as at
         if at.should_autotune():
             return _tuned_attn_impl(seq_len, head_dim, dtype,

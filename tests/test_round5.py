@@ -447,11 +447,20 @@ def test_attention_impl_selection_gating(monkeypatch):
     fa._use_pallas(2048, 64, jnp.bfloat16, True)
     assert not called
 
-    # pretend we are on a measurable chip: the probe is consulted
+    # pretend we are on a measurable chip: still no probe until the
+    # ALGORITHM flag opts in (tile tuning has bounded downside,
+    # algorithm selection does not)
     monkeypatch.setattr(at, "should_autotune", lambda: True)
     monkeypatch.setattr(fa.jax, "default_backend", lambda: "tpu")
     assert fa._use_pallas(2048, 64, jnp.bfloat16, True) is True
-    assert called
+    assert not called
+    flags.set_flags({"autotune_attn_impl": True})
+    try:
+        assert fa._use_pallas(2048, 64, jnp.bfloat16, True) is True
+        assert called
+    finally:
+        flags.set_flags({"autotune_attn_impl": False})
+    flags.set_flags({"autotune_attn_impl": True})
 
     # a user-pinned flash_min_seq_len overrides measurement entirely
     called.clear()
@@ -460,4 +469,5 @@ def test_attention_impl_selection_gating(monkeypatch):
         assert fa._use_pallas(2048, 64, jnp.bfloat16, True) is False
         assert not called
     finally:
-        flags.set_flags({"flash_min_seq_len": 1024})
+        flags.set_flags({"flash_min_seq_len": 1024,
+                         "autotune_attn_impl": False})
