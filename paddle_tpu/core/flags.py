@@ -111,6 +111,14 @@ define_flag("flash_min_seq_len", 1024,
             "v5e, BERT-base S=512: 117.2k tok/s XLA vs 114.2k Pallas — the "
             "blocked online-softmax only pays once the attention matrix "
             "stops fitting comfortably).")
+define_flag("use_autotune", True,
+            "Measure-and-cache kernel tile sizes per shape/chip "
+            "(reference FLAGS_use_autotune).")
+define_flag("autotune_attn_impl", False,
+            "Also autotune the attention ALGORITHM (XLA dense vs Pallas "
+            "flash) per shape class. Opt-in: a probe taken on a degraded "
+            "transport can flip a model to the slow path wholesale; tile "
+            "tuning has bounded downside, algorithm selection does not.")
 define_flag("eager_jit_cache", True, "Run steady-state eager ops through cached compiled lowerings.")
 define_flag("log_level", 0, "VLOG-style verbosity for framework logging.")
 define_flag("cudnn_deterministic", False, "Determinism facade (XLA is deterministic by default).")

@@ -31,15 +31,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ...core import flags
 
-flags.define_flag("use_autotune", True,
-                  "Measure-and-cache kernel tile sizes per shape/chip "
-                  "(reference FLAGS_use_autotune).")
-flags.define_flag("autotune_attn_impl", False,
-                  "Also autotune the attention ALGORITHM (XLA dense vs "
-                  "Pallas flash) per shape class. Opt-in: a probe taken "
-                  "on a degraded transport can flip a model to the slow "
-                  "path wholesale; tile tuning has bounded downside, "
-                  "algorithm selection does not.")
+# flags use_autotune / autotune_attn_impl are defined in core/flags.py
+# (readers like nn/functional/flash_attention must not depend on this
+# module having been imported first)
 
 __all__ = ["AutotuneCache", "autotune", "cache_path", "chip_kind",
            "seq_bucket", "should_autotune"]
