@@ -13,7 +13,7 @@ test/collective/fleet/ (e.g. dygraph_group_sharded_stage2.py,
 hybrid_parallel_pp_alexnet.py).
 
 Usage: dist_train_worker.py <strategy> <outdir>
-  strategy: single | dp | dp_sharding | dp_mp
+  strategy: single | dp | dp_sharding | dp_mp | dp_pp | dp_sep
 """
 import json
 import os
@@ -47,33 +47,92 @@ if STRATEGY == "dp_sharding":
                                "sharding_degree": 2}
 elif STRATEGY == "dp_mp":
     strategy.hybrid_configs = {"dp_degree": ndev // 2, "mp_degree": 2}
+elif STRATEGY == "dp_pp":
+    strategy.hybrid_configs = {"dp_degree": ndev // 2, "pp_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "1F1B"}
+elif STRATEGY == "dp_sep":
+    strategy.hybrid_configs = {"dp_degree": ndev // 2, "sep_degree": 2}
 fleet_pkg.fleet.init(is_collective=True, strategy=strategy)
 
 paddle.seed(1234)
-mp_deg = 2 if STRATEGY == "dp_mp" else 1
-cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2, num_heads=2,
-                max_seq_len=16, use_flash_attention=False,
-                mp_degree=mp_deg)
-model = GPTForCausalLM(cfg)
-model = fleet_pkg.fleet.distributed_model(model)
-opt = fleet_pkg.fleet.distributed_optimizer(
-    paddle.optimizer.AdamW(learning_rate=1e-2,
-                           parameters=model.parameters()))
-
 GLOBAL_BATCH, SEQ, STEPS = 8, 16, 6
 rng = np.random.RandomState(0)  # identical stream on every rank
-fixed = rng.randint(0, cfg.vocab_size,
-                    (GLOBAL_BATCH, SEQ)).astype(np.int64)
 losses = []
-for step in range(STEPS):
-    # one fixed batch: the loss must DESCEND, so parity is a statement
-    # about the whole train step (fwd + bwd + optimizer), not noise
-    ids = paddle.to_tensor(fixed)
-    _, loss = model(ids, labels=ids)
-    loss.backward()
-    opt.step()
-    opt.clear_grad()
-    losses.append(float(loss.numpy()))
+
+if STRATEGY == "dp_pp":
+    # pipeline path: a 4-block MLP stack over pp=2 stages trained with
+    # fleet's train_batch (scan + ppermute SPMD pipeline, cross-process)
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet import LayerDesc, PipelineLayer
+
+    D = 16
+
+    class _Blk(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(D, D)
+
+        def forward(self, x):
+            return paddle.ops.tanh(self.fc(x))
+
+    pl = PipelineLayer(
+        layers=[LayerDesc(_Blk) for _ in range(4)], num_stages=2,
+        loss_fn=lambda o, y: paddle.ops.mean((o - y) ** 2))
+    ppm = fleet_pkg.fleet.distributed_model(pl)
+    opt = fleet_pkg.fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-2,
+                               parameters=pl.parameters()))
+    xb = paddle.to_tensor(rng.randn(GLOBAL_BATCH, D).astype(np.float32))
+    yb = paddle.to_tensor(
+        rng.randn(GLOBAL_BATCH, D).astype(np.float32) * 0.1)
+    for step in range(STEPS):
+        losses.append(float(ppm.train_batch((xb, yb), opt).numpy()))
+elif STRATEGY == "dp_sep":
+    # context-parallel path: ring flash attention over the sep axis
+    # (lax.scan + ppermute ring), trained cross-process
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.distributed.fleet import ring_flash_attention
+
+    mesh = mesh_mod.get_mesh()
+    wq = paddle.Tensor(jnp.eye(8, dtype=jnp.float32) * 0.5,
+                       stop_gradient=False)
+    xs_np = rng.randn(2, 32, 4, 8).astype(np.float32)
+    xs = paddle.Tensor(jax.device_put(
+        jnp.asarray(xs_np),
+        NamedSharding(mesh, P(None, "sep", None, None))))
+    for step in range(STEPS):
+        q = paddle.ops.matmul(xs, wq)
+        attn = ring_flash_attention(q, xs, xs, causal=True)
+        loss = paddle.ops.mean((attn - xs) ** 2)
+        loss.backward()
+        wq._swap_payload(wq._data - 2.0 * wq.grad._data)
+        wq.clear_grad()
+        losses.append(float(loss.numpy()))
+else:
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=16,
+                    use_flash_attention=False,
+                    mp_degree=2 if STRATEGY == "dp_mp" else 1)
+    model = GPTForCausalLM(cfg)
+    model = fleet_pkg.fleet.distributed_model(model)
+    opt = fleet_pkg.fleet.distributed_optimizer(
+        paddle.optimizer.AdamW(learning_rate=1e-2,
+                               parameters=model.parameters()))
+    fixed = rng.randint(0, cfg.vocab_size,
+                        (GLOBAL_BATCH, SEQ)).astype(np.int64)
+    for step in range(STEPS):
+        # one fixed batch: the loss must DESCEND, so parity is a
+        # statement about the whole train step (fwd + bwd + optimizer)
+        ids = paddle.to_tensor(fixed)
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
 
 assert all(np.isfinite(losses)), losses
 with open(os.path.join(OUTDIR, f"losses.{STRATEGY}.r{rank}.json"), "w") as f:

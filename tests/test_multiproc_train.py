@@ -7,11 +7,18 @@ workhorse distributed test (test/legacy_test/test_dist_base.py:952
 TestDistBase._run_cluster: fork trainers, train, compare losses against
 the single-process run; strategy scripts under test/collective/fleet/).
 
-Every strategy goes through the REAL user path: ``paddle.distributed.launch``
-spawns workers -> ``init_parallel_env`` (jax.distributed over Gloo CPU) ->
-``fleet.init`` -> ``fleet.distributed_model`` -> ``fleet.distributed_optimizer``
--> 6 train steps on one fixed batch (the loss must descend, so parity is a
-statement about fwd+bwd+update, not about noise).
+Every strategy goes through the real launcher + ``init_parallel_env``
+(jax.distributed over Gloo CPU) + ``fleet.init``, then trains 6 steps on
+fixed data (the loss must descend, so parity is a statement about
+fwd+bwd+update, not about noise). Per-strategy training paths:
+
+* dp / dp_sharding / dp_mp — ``fleet.distributed_model`` ->
+  ``fleet.distributed_optimizer`` -> eager loss.backward()/opt.step()
+* dp_pp — ``fleet.distributed_model`` (PipelineParallel) ->
+  ``fleet.distributed_optimizer`` -> ``train_batch`` (SPMD 1F1B)
+* dp_sep — ``ring_flash_attention`` over the sep axis with a hand-rolled
+  SGD step (the fleet wrappers carry no sep-specific model logic; the
+  axis' cross-process claim is the ring collective's fwd+bwd itself)
 
 This harness caught a real bug on its first run: TP weight init used
 Python's per-process-randomized ``hash()`` in the RNG tracker's lazy seed
@@ -119,15 +126,22 @@ def test_multiproc_training_loss_parity(baseline, strategy, nproc,
                 f"single-process baseline")
 
 
-def test_multiproc_tp_matches_single_process_virtual_mesh(tmp_path):
-    """DP2 x MP2 across 4 real processes == the same 4-device mesh inside
-    one process. (TP init legitimately differs from the mp=1 model — its
-    weights draw from the model-parallel RNG stream — so the parity
-    target is the identical topology, single-controller.)"""
-    ref = _run_single(tmp_path / "virt", "dp_mp", virtual_devices=4)
-    losses = _run_cluster(tmp_path, "dp_mp", 4)
-    assert losses[-1] < losses[0] - 0.5, f"dp_mp did not train: {losses}"
+@pytest.mark.parametrize("strategy,min_drop", [
+    ("dp_mp", 0.5),     # tensor parallel (TP init differs from mp=1)
+    ("dp_pp", 0.05),    # SPMD 1F1B pipeline via fleet train_batch
+    ("dp_sep", 0.1),    # ring flash attention over the sep axis
+])
+def test_multiproc_axis_matches_single_process_virtual_mesh(
+        strategy, min_drop, tmp_path):
+    """Each remaining mesh axis across 4 real processes == the same
+    4-device mesh inside one process. Together with the dp/dp_sharding
+    cases above, ALL FIVE axes (dp, sharding, mp, pp, sep) are proven
+    cross-process."""
+    ref = _run_single(tmp_path / "virt", strategy, virtual_devices=4)
+    losses = _run_cluster(tmp_path, strategy, 4)
+    assert losses[-1] < losses[0] - min_drop, \
+        f"{strategy} did not train: {losses}"
     np.testing.assert_allclose(
         losses, ref, rtol=2e-4, atol=2e-4,
-        err_msg="dp_mp across 4 processes diverged from the same mesh "
-                "in one process")
+        err_msg=f"{strategy} across 4 processes diverged from the same "
+                f"mesh in one process")
