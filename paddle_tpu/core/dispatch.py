@@ -45,7 +45,48 @@ def _tls():
         _state.amp_dtype = dtypes.bfloat16
         _state.amp_custom_white = set()
         _state.amp_custom_black = set()
+        _state.branch_trace = None
+        _state.quiet = False
     return _state
+
+
+# ---------------------------------------------------------------------------
+# Branch tracing (control-flow ops). While a branch trace is installed,
+# ``call`` does not execute ops at all: it hands them to the trace, which
+# evaluates shapes abstractly and records which external Tensors the branch
+# reads (ops/control_flow.py builds lax.cond/while_loop/switch lowerings
+# from that). Saved/restored as a stack so nested control flow works.
+# ---------------------------------------------------------------------------
+def enter_branch_trace(bt):
+    s = _tls()
+    prev = s.branch_trace
+    s.branch_trace = bt
+    return prev
+
+
+def exit_branch_trace(prev):
+    _tls().branch_trace = prev
+
+
+def in_branch_trace() -> bool:
+    return _tls().branch_trace is not None
+
+
+class quiet_scope:
+    """Suppress dispatch side channels (profiler taps, Program recorder,
+    export tracers, nan/benchmark sweeps) for ops dispatched inside a
+    control-flow lowering: the enclosing construct is recorded as ONE op,
+    so its internals must not leak tracer-held tensors into recorders."""
+
+    def __enter__(self):
+        s = _tls()
+        self._prev = s.quiet
+        s.quiet = True
+        return self
+
+    def __exit__(self, *exc):
+        _tls().quiet = self._prev
+        return False
 
 
 def grad_enabled() -> bool:
@@ -420,6 +461,11 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
     global _sot
     attrs = attrs or {}
     s = _tls()
+    if s.branch_trace is not None:
+        # control-flow branch discovery: nothing executes — the trace
+        # records the op abstractly (shapes via jax.eval_shape) and logs
+        # which external Tensors the branch reads
+        return s.branch_trace.run_op(op_name, fn, tensor_inputs, attrs)
     if GradNode is None:
         _bind_engine()
 
@@ -518,24 +564,26 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
             t.output_index = i
         out_tensors.append(t)
 
-    if _hot_flags["check_nan_inf"]:
-        _check_nan_inf(op_name, out_list)
-    if _hot_flags["benchmark"]:
-        for o in out_list:
-            if isinstance(o, jax.Array):
-                jax.block_until_ready(o)
-    for hook in _op_hooks:
-        hook(op_name, tensor_inputs, out_tensors, attrs)
-    for hook in _recorder_hooks():
-        # recorder taps (static.Program capture) additionally receive the
-        # attr-bound lowering so the op can be replayed on new payloads
-        hook(op_name, f, tensor_inputs, out_tensors)
-    if _export_hooks:
-        merged = dict(attrs)
-        if export_attrs:
-            merged.update(export_attrs)
-        for hook in _export_hooks:
-            hook(op_name, tensor_inputs, out_tensors, merged)
+    if not s.quiet:
+        if _hot_flags["check_nan_inf"]:
+            _check_nan_inf(op_name, out_list)
+        if _hot_flags["benchmark"]:
+            for o in out_list:
+                if isinstance(o, jax.Array):
+                    jax.block_until_ready(o)
+        for hook in _op_hooks:
+            hook(op_name, tensor_inputs, out_tensors, attrs)
+        for hook in _recorder_hooks():
+            # recorder taps (static.Program capture) additionally receive
+            # the attr-bound lowering so the op can be replayed on new
+            # payloads
+            hook(op_name, f, tensor_inputs, out_tensors)
+        if _export_hooks:
+            merged = dict(attrs)
+            if export_attrs:
+                merged.update(export_attrs)
+            for hook in _export_hooks:
+                hook(op_name, tensor_inputs, out_tensors, merged)
 
     if single:
         return out_tensors[0]

@@ -70,6 +70,17 @@ class Engine:
         lr_mults = tuple(float(getattr(p, "optimize_attr", {})
                                .get("learning_rate", 1.0)) for p in params)
         wd_flags = tuple(opt._wd_flag(p) for p in params)
+        # ParamAttr-level regularizers take priority over the
+        # optimizer-level decay (which _wd_flag already gates off for
+        # these params) and must fold into the traced grads exactly as
+        # eager Optimizer.step folds them — dropping them here silently
+        # diverges Engine training from eager training
+        from ...regularizer import L1Decay
+        reg_terms = tuple(
+            (isinstance(p.regularizer, L1Decay),
+             float(getattr(p.regularizer, "_coeff", 0.0)))
+            if getattr(p, "regularizer", None) is not None else None
+            for p in params)
 
         def init_opt_state(param_arrays):
             states = [opt._init_state(p) for p in params]
@@ -97,6 +108,13 @@ class Engine:
                 pairs = opt._grad_clip(
                     [(p, Tensor(g)) for p, g in zip(params, grads)])
                 grads = [g._data for _, g in pairs]
+            if any(rt is not None for rt in reg_terms):
+                # same fold order as eager step: clip first, then the
+                # per-param regularizer term
+                grads = [
+                    g if rt is None else
+                    g + rt[1] * (jnp.sign(w) if rt[0] else w).astype(g.dtype)
+                    for g, rt, w in zip(grads, reg_terms, param_arrays)]
             new_p, new_m, new_st = opt._tree_step(
                 lr, t, param_arrays, grads, masters, states, lr_mults,
                 wd_flags)

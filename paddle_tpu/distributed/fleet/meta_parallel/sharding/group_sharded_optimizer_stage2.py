@@ -22,6 +22,14 @@ class GroupShardedOptimizerStage2(DygraphShardingOptimizer):
         super().__init__(optimizer,
                          axis=kwargs.get("axis", "sharding"))
         self._offload = offload
+        if offload:
+            import warnings
+            warnings.warn(
+                "GroupShardedOptimizerStage2(offload=True): host-memory "
+                "offload of optimizer states is not implemented on this "
+                "backend — states stay in device memory (sharded over "
+                "the sharding axis). Training proceeds WITHOUT offload.",
+                stacklevel=2)
         # tag every trainable param so backward stores grads sharded
         for p in self._parameter_list:
             sh = self._state_sharding(p)

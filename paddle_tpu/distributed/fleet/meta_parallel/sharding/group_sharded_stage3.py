@@ -36,6 +36,15 @@ class GroupShardedStage3(_MeshInputWrapper):
         self._degree = int(mesh.shape[axis])
         self._mesh = mesh
         self._optim = optimizer
+        self._offload = offload
+        if offload:
+            import warnings
+            warnings.warn(
+                "GroupShardedStage3(offload=True): host-memory offload of "
+                "param shards is not implemented on this backend — shards "
+                "stay in device memory (each device stores 1/N of every "
+                "param). Training proceeds WITHOUT offload.",
+                stacklevel=2)
         self._param_shardings = {}
         self._shard_parameters()
 

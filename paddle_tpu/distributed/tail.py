@@ -120,16 +120,32 @@ class DistAttr:
 
 # ----------------------------------------------------------------- gloo
 def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
-    """Subsumed: the single-controller runtime has no separate gloo
-    ring; jax.distributed.initialize (launch module) fences startup."""
+    """Join the host sync channel (reference gloo ring init). The
+    single-controller runtime has no separate gloo ring: once the
+    parallel env is up (the launch module brings it up in every worker)
+    this fences startup like the ring rendezvous would; before init it
+    is a no-op — it must NOT force ``init_parallel_env()`` itself, which
+    would lock the default mesh and silently discard a later
+    ``init_parallel_env(mesh_shape=...)`` topology choice."""
+    gloo_barrier()
 
 
 def gloo_barrier():
-    """Subsumed by SPMD program ordering (see gloo_init_parallel_env)."""
+    """Host barrier. Once the parallel env is up this is the REAL
+    ``paddle.distributed.barrier`` (an all-reduce fence); before init it
+    stays a no-op — there is nothing to synchronize against and the
+    reference errors only on an uninitialized gloo ring."""
+    from . import parallel
+    if parallel.is_initialized():
+        from .communication.collective import barrier
+        barrier()
 
 
 def gloo_release():
-    """Subsumed (see gloo_init_parallel_env)."""
+    """Release the host sync channel: fence once so in-flight rank-0
+    writes land, then drop back to program ordering (there is no gloo
+    context to free)."""
+    gloo_barrier()
 
 
 # ------------------------------------------------------ PS entry policies

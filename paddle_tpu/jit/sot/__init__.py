@@ -82,6 +82,13 @@ def _const_repr(v, depth: int) -> str:
             # ".shape" is a method, not array metadata (duck-type miss)
             return f"<{type(v).__name__}>"
         size = int(np.prod(shape)) if shape else 1
+        payload = getattr(v, "_data", v)
+        if isinstance(payload, LazyArray) and payload._value is None:
+            # pending segment node captured in a lowering closure (the
+            # control-flow ops close over branch Tensors): reading its
+            # value here would flush the segment MID-RECORD. Its value
+            # dependence flows through op inputs, so shape/dtype guard.
+            return f"<arr:{shape}:{v.dtype}:lazy>"
         if size <= 1:
             # scalar arrays DO value-guard: a loss scale / step counter
             # baked into a lowering must invalidate on change (the sync

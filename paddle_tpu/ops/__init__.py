@@ -202,6 +202,10 @@ _attach_methods()
 # ---------------------------------------------------------------------------
 def _register_all():
     from .registry import register_module
+    # control-flow ops self-register via @register decorators (their
+    # reference yaml names: conditional_block / while); imported here so
+    # the registry is complete at paddle_tpu import time
+    from . import control_flow  # noqa: F401
     register_module(math, "math")
     register_module(creation, "creation")
     register_module(manipulation, "manipulation")

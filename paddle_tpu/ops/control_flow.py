@@ -1,0 +1,583 @@
+"""In-graph data-dependent control flow: cond / while_loop / case / switch_case.
+
+Capability parity with the reference's control-flow layer (reference:
+python/paddle/static/nn/control_flow.py — ``cond``:1487 builds
+``conditional_block`` ops + ``select_input``, ``while_loop``:682 builds the
+``while`` op; dy2static transformers rewrite Python ``if``/``while`` onto
+them). TPU-native design: each construct is ONE first-class op dispatched
+through ``core.dispatch.call`` whose lowering is the matching ``lax``
+primitive (``lax.cond`` / ``lax.while_loop`` / ``lax.switch``) — a
+tensor-dependent branch compiles INTO the XLA program instead of syncing a
+scalar to host and splitting the captured program.
+
+Execution modes, decided per call:
+
+* **eager** — the predicate payload is concrete and no capture is active:
+  read the predicate on host and run ONLY the chosen branch directly, so
+  the autograd tape threads through the taken branch exactly as in
+  reference dygraph mode.
+* **captured** — under ``jit.to_static`` tracing, SOT segment capture, or
+  ``static.Program`` recording (or when building a nested branch), the
+  branch callables are first traced ABSTRACTLY: a dispatch-level
+  ``BranchTrace`` intercepts every op, evaluates shapes via
+  ``jax.eval_shape`` (nothing executes), and records which external
+  Tensors the branch reads plus the output pytree. The whole construct
+  then dispatches as ONE op with operands = predicate/carry + every
+  captured Tensor, so AMP casting, the autograd tape (``jax.vjp`` of the
+  lowering — ``lax.cond``/``lax.switch`` are reverse-differentiable),
+  SOT's segment journal and ``static.Program`` recording all see a single
+  op. ``lax.while_loop`` is not reverse-differentiable (unbounded trip
+  count), so ``while_loop`` outputs are forward-only under capture —
+  exactly JAX's contract; the eager path still differentiates through the
+  unrolled tape.
+
+Branch callables must be pure tensor programs (ops on Tensors; no host
+reads of traced values). Tensors they close over become operands
+automatically, which is what makes gradients flow to parameters used
+inside a branch.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core import dispatch
+from ..core.tensor import Tensor, as_tensor
+from .registry import register
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+_sot = None  # lazily bound (core<->jit import cycle, same as dispatch)
+
+
+def _sot_mod():
+    global _sot
+    if _sot is None:
+        from ..jit import sot as sot_module
+        _sot = sot_module
+    return _sot
+
+
+# ---------------------------------------------------------------------------
+# Branch discovery: abstract evaluation of a branch callable
+# ---------------------------------------------------------------------------
+class _AbstractPayload:
+    """Placeholder payload carried by Tensors during branch discovery.
+
+    Holds only the abstract value; any attempt to read data fails with a
+    pointer at nested control flow (the branch is being traced, there is
+    no value)."""
+
+    __slots__ = ("aval",)
+
+    def __init__(self, aval: jax.ShapeDtypeStruct):
+        self.aval = aval
+
+    @property
+    def shape(self):
+        return tuple(self.aval.shape)
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError(
+            "cannot read the value of a tensor inside a control-flow "
+            "branch under capture: the branch is traced abstractly. Use "
+            "nested static.nn.cond/while_loop for data-dependent Python "
+            "control flow inside a branch.")
+
+    def __repr__(self):
+        return f"_AbstractPayload(shape={self.shape}, dtype={self.dtype})"
+
+
+def _payload_aval(d) -> jax.ShapeDtypeStruct:
+    if isinstance(d, _AbstractPayload):
+        return d.aval
+    if isinstance(d, jax.ShapeDtypeStruct):
+        return d
+    return jax.ShapeDtypeStruct(tuple(d.shape), np.dtype(d.dtype))
+
+
+class BranchTrace:
+    """Dispatch interceptor active while a branch callable is discovered.
+
+    Ops do not execute: outputs are Tensors over ``_AbstractPayload``
+    (shapes from ``jax.eval_shape``). Tensors read by the branch that this
+    trace did not itself produce are recorded, in first-read order — they
+    become the operands of the enclosing control-flow op."""
+
+    def __init__(self):
+        self.reads: List[Tensor] = []
+        self._read_ids = set()
+        self._produced = set()  # id(payload) of outputs of THIS trace
+
+    def run_op(self, op_name: str, fn: Callable,
+               tensor_inputs: Sequence[Tensor], attrs: dict):
+        for t in tensor_inputs:
+            if (id(t._data) not in self._produced
+                    and id(t) not in self._read_ids):
+                self._read_ids.add(id(t))
+                self.reads.append(t)
+        f = (lambda *xs: fn(*xs, **attrs)) if attrs else fn
+        avals = [_payload_aval(t._data) for t in tensor_inputs]
+        # suspend this trace while shape-evaluating: a NESTED control-flow
+        # lowering re-enters dispatch from inside eval_shape, and its
+        # inner ops must execute as a real jax trace, not be intercepted
+        prev = dispatch.enter_branch_trace(None)
+        try:
+            out = jax.eval_shape(f, *avals)
+        except Exception as e:
+            raise RuntimeError(
+                f"op '{op_name}' inside a control-flow branch cannot be "
+                f"traced abstractly ({type(e).__name__}: {e}); branches "
+                f"under capture must be shape-static tensor programs"
+            ) from e
+        finally:
+            dispatch.exit_branch_trace(prev)
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        ts = []
+        for av in outs:
+            payload = _AbstractPayload(av)
+            self._produced.add(id(payload))
+            ts.append(Tensor(payload))
+        return ts if multi else ts[0]
+
+
+def _tensor_leaves(out, where: str):
+    """Flatten a branch output pytree to its Tensor leaves; reject
+    non-Tensor leaves with a clear error (reference cond raises similarly
+    for non-Variable outputs)."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    for l in leaves:
+        if not isinstance(l, Tensor):
+            raise TypeError(
+                f"{where} must return Tensors (or nested lists/tuples/"
+                f"dicts of Tensors, or None); got a {type(l).__name__} "
+                f"leaf")
+    return leaves, treedef
+
+
+def _trace_branch(fn: Callable, args=()):
+    """Abstractly run ``fn(*args)`` under a BranchTrace. Returns
+    (leaves, treedef, avals, reads)."""
+    bt = BranchTrace()
+    for a in args:
+        # arguments are placeholders this trace owns, never "reads"
+        bt._produced.add(id(a._data))
+    prev = dispatch.enter_branch_trace(bt)
+    try:
+        with dispatch.no_grad():
+            out = fn(*args)
+    finally:
+        dispatch.exit_branch_trace(prev)
+    leaves, treedef = _tensor_leaves(out, fn.__name__
+                                     if hasattr(fn, "__name__") else "branch")
+    # a branch may return an external Tensor WITHOUT dispatching any op on
+    # it (pure pass-through, e.g. cond(p, lambda: x, lambda: y)): no run_op
+    # ever saw it, so record it as a read here — otherwise it bakes into
+    # the lowering closure as a constant (stale on replay, no gradient)
+    for l in leaves:
+        if (id(l._data) not in bt._produced
+                and id(l) not in bt._read_ids):
+            bt._read_ids.add(id(l))
+            bt.reads.append(l)
+    avals = [_payload_aval(l._data) for l in leaves]
+    return leaves, treedef, avals, bt.reads
+
+
+def _dedup_tensors(*groups) -> List[Tensor]:
+    seen, out = set(), []
+    for g in groups:
+        for t in g:
+            if id(t) not in seen:
+                seen.add(id(t))
+                out.append(t)
+    return out
+
+
+def _check_same_structure(defs_avals, what: str):
+    """All branches must produce one structure with matching avals
+    (lax.cond/switch require it; the reference raises the same way)."""
+    (def0, avals0) = defs_avals[0]
+    for i, (d, avals) in enumerate(defs_avals[1:], start=1):
+        if d != def0:
+            raise ValueError(
+                f"{what}: branch 0 and branch {i} return different "
+                f"structures ({def0} vs {d})")
+        for j, (a0, a) in enumerate(zip(avals0, avals)):
+            if (tuple(a0.shape) != tuple(a.shape)
+                    or np.dtype(a0.dtype) != np.dtype(a.dtype)):
+                raise ValueError(
+                    f"{what}: output {j} differs between branch 0 "
+                    f"({tuple(a0.shape)}, {a0.dtype}) and branch {i} "
+                    f"({tuple(a.shape)}, {a.dtype}); branches must "
+                    f"return matching shapes/dtypes")
+
+
+class _rebound:
+    """Temporarily swap the payloads of ``tensors`` to ``arrays`` (the
+    lowering's argument tracers) while a branch body is traced — the same
+    rebinding idiom jit.api's jit_target uses for parameters."""
+
+    def __init__(self, tensors: Sequence[Tensor], arrays: Sequence):
+        self._tensors = tensors
+        self._arrays = arrays
+
+    def __enter__(self):
+        self._saved = [t._data for t in self._tensors]
+        for t, a in zip(self._tensors, self._arrays):
+            t._data = a
+        return self
+
+    def __exit__(self, *exc):
+        for t, d in zip(self._tensors, self._saved):
+            t._data = d
+        return False
+
+
+def _coerce(arr, aval):
+    """Match a branch output to its discovered aval dtype (weak-typed
+    literals inside a branch must not make lax reject the pair)."""
+    want = np.dtype(aval.dtype)
+    if np.dtype(arr.dtype) != want or getattr(arr, "weak_type", False):
+        arr = lax.convert_element_type(arr, want)
+    return arr
+
+
+def _captured(*tensors) -> bool:
+    """True when the construct must lower to lax (any capture machinery
+    active or any payload abstract); False = plain eager evaluation."""
+    if dispatch.in_branch_trace():
+        return True
+    sot = _sot_mod()
+    if sot.active():
+        return True
+    if dispatch._recorder_hooks():
+        return True
+    from ..jit import api as jit_api
+    if jit_api.in_capture_mode():
+        # under to_static tracing the PREDICATE may be a tracer even when
+        # every loop var / operand is concrete (e.g. the trip bound is a
+        # traced arg) — the construct must still lower to lax
+        return True
+    for t in tensors:
+        d = t._data
+        if isinstance(d, (jax.core.Tracer, _AbstractPayload)) \
+                or type(d) is sot.LazyArray:
+            return True
+    return False
+
+
+def _scalar_pred(t: Tensor, what: str) -> Tensor:
+    t = as_tensor(t)
+    size = int(np.prod(t._data.shape)) if t._data.shape else 1
+    if size != 1:
+        raise ValueError(
+            f"{what} must be a scalar (one-element) tensor, got shape "
+            f"{tuple(t._data.shape)}")
+    return t
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+@register("conditional_block", category="control_flow")
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` if ``pred`` else ``false_fn()`` (reference
+    static/nn/control_flow.py:1487 ``cond``). Under capture both branches
+    compile into ONE program via ``lax.cond`` — no host sync; gradients
+    flow through whichever branch executes (``jax.vjp`` of the lowering).
+    Branch callables take no arguments and may close over any Tensors in
+    scope; both must return the same structure of Tensors."""
+    pred = _scalar_pred(pred, "cond(pred)")
+    if not _captured(pred):
+        taken = true_fn if bool(pred) else false_fn
+        return taken() if taken is not None else None
+
+    if true_fn is None or false_fn is None:
+        raise ValueError(
+            "cond under capture requires both true_fn and false_fn "
+            "(a one-sided cond has no graph form)")
+    _t_leaves, t_def, t_avals, t_reads = _trace_branch(true_fn)
+    _f_leaves, f_def, f_avals, f_reads = _trace_branch(false_fn)
+    _check_same_structure([(t_def, t_avals), (f_def, f_avals)], "cond")
+    ext = _dedup_tensors(t_reads, f_reads)
+    lowering = _make_select_lowering([true_fn, false_fn], ext, t_avals,
+                                     n_branches=2)
+    outs = dispatch.call(
+        "conditional_block", lowering, [pred] + ext, multi_output=True,
+        differentiable_mask=[False] + [True] * len(ext),
+        export_attrs={"n_outputs": len(t_avals)})
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return jax.tree_util.tree_unflatten(t_def, list(outs))
+
+
+def _make_select_lowering(branch_fns, ext, out_avals, n_branches,
+                          keys=None, default_pos=None):
+    """Shared cond/switch lowering: selector scalar + captured operands ->
+    lax.cond (2 branches, boolean) or lax.switch (N branches, position
+    computed from the key table)."""
+
+    def lowering(sel_arr, *ext_arrays):
+        def mk(user_fn):
+            def branch():
+                # quiet: inner ops must not hit recorders/hooks — the
+                # enclosing construct is already recorded as ONE op.
+                # no_grad: the op-level GradNode (jax.vjp of this
+                # lowering) owns the gradient; inner tape entries would
+                # be dead weight.
+                with dispatch.quiet_scope(), dispatch.no_grad(), \
+                        _rebound(ext, ext_arrays):
+                    out = user_fn()
+                    # read payloads INSIDE the rebind scope: a branch may
+                    # return a captured tensor as-is (identity), whose
+                    # payload reverts on exit
+                    leaves, _ = _tensor_leaves(out, "branch")
+                    arrs = [l._data for l in leaves]
+                return tuple(_coerce(a, av)
+                             for a, av in zip(arrs, out_avals))
+            return branch
+
+        sel = jnp.reshape(sel_arr, ())
+        if keys is None:
+            pb = sel if sel.dtype == jnp.bool_ else (sel != 0)
+            return lax.cond(pb, mk(branch_fns[0]), mk(branch_fns[1]))
+        keys_arr = jnp.asarray(keys, dtype=jnp.int32)
+        matches = keys_arr == sel.astype(jnp.int32)
+        pos = jnp.where(jnp.any(matches), jnp.argmax(matches),
+                        jnp.int32(default_pos))
+        return lax.switch(pos, [mk(f) for f in branch_fns])
+
+    return lowering
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+@register("while_loop", category="control_flow", differentiable=False)
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat ``body(*loop_vars)`` while ``cond(*loop_vars)`` is true
+    (reference static/nn/control_flow.py:682 ``while_loop``).
+    ``loop_vars`` is a non-empty list/tuple whose (possibly nested)
+    Tensor leaves are the carried state; ``body`` must return the same
+    structure with matching shapes/dtypes. Under capture the loop lowers
+    to ``lax.while_loop`` — one XLA program, the canonical greedy-decode
+    shape. Captured outputs are forward-only (JAX cannot
+    reverse-differentiate an unbounded loop); the eager path
+    differentiates through the unrolled tape as in reference dygraph."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("while_loop: loop_vars must be a non-empty "
+                        "list/tuple")
+    was_list = isinstance(loop_vars, list)
+    raw_leaves, carry_def = jax.tree_util.tree_flatten(
+        tuple(loop_vars), is_leaf=lambda x: isinstance(x, Tensor))
+    leaves = [l if isinstance(l, Tensor) else as_tensor(l)
+              for l in raw_leaves]
+
+    def _unflatten(ls):
+        vars_ = jax.tree_util.tree_unflatten(carry_def, list(ls))
+        return list(vars_) if was_list else vars_
+
+    def _norm_out(out):
+        # body may return a list where loop_vars was a tuple (or vice
+        # versa), and a single-var loop may return the var bare rather
+        # than wrapped — the reference accepts these; only nesting must
+        # match
+        if isinstance(out, list):
+            out = tuple(out)
+        if len(loop_vars) == 1 and not (isinstance(out, tuple)
+                                        and len(out) == 1):
+            out = (out,)
+        return out
+
+    def _body_flat(out, where):
+        out_leaves, out_def = _tensor_leaves(_norm_out(out), where)
+        if out_def != carry_def:
+            raise ValueError(
+                f"while_loop: body returned a different structure than "
+                f"loop_vars ({out_def} vs {carry_def})")
+        return out_leaves
+
+    if not _captured(*leaves):
+        vars_ = tuple(jax.tree_util.tree_unflatten(carry_def, leaves))
+        while True:
+            keep = cond(*vars_)
+            keep_leaves, _ = _tensor_leaves(keep, "while_loop cond")
+            if len(keep_leaves) != 1:
+                raise ValueError("while_loop: cond must return one "
+                                 "scalar boolean tensor")
+            if not bool(keep_leaves[0]):
+                break
+            out_leaves = _body_flat(body(*vars_), "while_loop body")
+            vars_ = tuple(jax.tree_util.tree_unflatten(carry_def,
+                                                       out_leaves))
+        return _unflatten(jax.tree_util.tree_flatten(
+            vars_, is_leaf=lambda x: isinstance(x, Tensor))[0])
+
+    carry_avals = [_payload_aval(l._data) for l in leaves]
+    n_carry = len(leaves)
+
+    ph_c = [Tensor(_AbstractPayload(av)) for av in carry_avals]
+    c_leaves, _c_def, c_avals, c_reads = _trace_branch(
+        lambda *ps: cond(*jax.tree_util.tree_unflatten(carry_def,
+                                                       list(ps))), ph_c)
+    if len(c_leaves) != 1 or int(np.prod(c_avals[0].shape)) != 1:
+        raise ValueError(
+            "while_loop: cond must return one scalar boolean tensor, got "
+            f"{[tuple(a.shape) for a in c_avals]}")
+    def _norm_body(*ps):
+        return _norm_out(body(*jax.tree_util.tree_unflatten(carry_def,
+                                                            list(ps))))
+
+    ph_b = [Tensor(_AbstractPayload(av)) for av in carry_avals]
+    _b_leaves, b_def, b_avals, b_reads = _trace_branch(_norm_body, ph_b)
+    if b_def != carry_def:
+        raise ValueError(
+            f"while_loop: body returned a different structure than "
+            f"loop_vars ({b_def} vs {carry_def})")
+    for j, (a0, a) in enumerate(zip(carry_avals, b_avals)):
+        if (tuple(a0.shape) != tuple(a.shape)
+                or np.dtype(a0.dtype) != np.dtype(a.dtype)):
+            raise ValueError(
+                f"while_loop: carried value {j} changes from "
+                f"({tuple(a0.shape)}, {a0.dtype}) to ({tuple(a.shape)}, "
+                f"{a.dtype}) across one iteration; the loop-carried "
+                f"state must be shape/dtype invariant")
+    ext = _dedup_tensors(c_reads, b_reads)
+
+    def lowering(*arrays):
+        carry0 = tuple(arrays[:n_carry])
+        ext_arrays = arrays[n_carry:]
+
+        def wrap(carry):
+            ts = [Tensor(a) for a in carry]
+            return jax.tree_util.tree_unflatten(carry_def, ts)
+
+        def cond_f(carry):
+            with dispatch.quiet_scope(), dispatch.no_grad(), \
+                    _rebound(ext, ext_arrays):
+                out = cond(*wrap(carry))
+                # payload read must stay inside the rebind scope (see mk)
+                k = jnp.reshape(
+                    _tensor_leaves(out, "while_loop cond")[0][0]._data, ())
+            return k if k.dtype == jnp.bool_ else (k != 0)
+
+        def body_f(carry):
+            with dispatch.quiet_scope(), dispatch.no_grad(), \
+                    _rebound(ext, ext_arrays):
+                out = _norm_out(body(*wrap(carry)))
+                leaves_, _ = _tensor_leaves(out, "while_loop body")
+                arrs = [l._data for l in leaves_]
+            return tuple(_coerce(a, av)
+                         for a, av in zip(arrs, carry_avals))
+
+        return lax.while_loop(cond_f, body_f, carry0)
+
+    outs = dispatch.call(
+        "while_loop", lowering, leaves + ext, multi_output=True,
+        differentiable_mask=[False] * (n_carry + len(ext)),
+        export_attrs={"n_carry": n_carry})
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return _unflatten(list(outs)[:n_carry])
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+@register("case", category="control_flow")
+def case(pred_fn_pairs, default=None, name=None):
+    """First-true-wins chain of (scalar bool Tensor, callable) pairs
+    (reference static/nn/control_flow.py ``case``). When no predicate is
+    true the ``default`` callable runs; with ``default=None`` the last
+    pair's callable plays that role (reference contract). Built as nested
+    ``cond`` ops, so every mode (eager / to_static / SOT / Program
+    capture) follows cond's."""
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("case: pred_fn_pairs must be a non-empty "
+                        "list/tuple of (pred, fn) pairs")
+    pairs = []
+    for p in pred_fn_pairs:
+        if not isinstance(p, (list, tuple)) or len(p) != 2 \
+                or not callable(p[1]):
+            raise TypeError("case: each entry must be a (scalar bool "
+                            "Tensor, callable) pair")
+        pairs.append((p[0], p[1]))
+    if default is None:
+        pairs, default = pairs[:-1], pairs[-1][1]
+    if not pairs:
+        return default()
+
+    def chain(i):
+        if i == len(pairs):
+            return default()
+        p, f = pairs[i]
+        return cond(p, f, lambda: chain(i + 1))
+
+    return chain(0)
+
+
+@register("switch_case", category="control_flow")
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Run the branch whose integer key equals ``branch_index``
+    (reference static/nn/control_flow.py ``switch_case``). ``branch_fns``
+    is a list of callables (keys 0..n-1) or of (int key, callable) pairs;
+    an unmatched index runs ``default``, or the callable with the largest
+    key when ``default`` is None (reference contract). Under capture the
+    whole table lowers to one ``lax.switch``."""
+    if isinstance(branch_fns, (list, tuple)) and branch_fns \
+            and callable(branch_fns[0]):
+        items = list(enumerate(branch_fns))
+    else:
+        items = [(int(k), f) for k, f in branch_fns]
+    keys = [k for k, _ in items]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"switch_case: duplicate branch keys {keys}")
+    items.sort(key=lambda kv: kv[0])
+    idx_t = _scalar_pred(branch_index, "switch_case(branch_index)")
+
+    if not _captured(idx_t):
+        i = int(idx_t)
+        for k, f in items:
+            if k == i:
+                return f()
+        return default() if default is not None else items[-1][1]()
+
+    fns = [f for _, f in items]
+    if default is not None:
+        fns.append(default)
+        default_pos = len(fns) - 1
+    else:
+        default_pos = len(fns) - 1  # largest key's callable
+    traced = [_trace_branch(f) for f in fns]
+    _check_same_structure([(td, av) for _l, td, av, _r in traced],
+                          "switch_case")
+    out_def, out_avals = traced[0][1], traced[0][2]
+    ext = _dedup_tensors(*[r for _l, _td, _av, r in traced])
+    lowering = _make_select_lowering(
+        fns, ext, out_avals, n_branches=len(fns),
+        keys=[k for k, _ in items], default_pos=default_pos)
+    outs = dispatch.call(
+        "switch_case", lowering, [idx_t] + ext, multi_output=True,
+        differentiable_mask=[False] + [True] * len(ext),
+        export_attrs={"n_branches": len(fns)})
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    return jax.tree_util.tree_unflatten(out_def, list(outs))

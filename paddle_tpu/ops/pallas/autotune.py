@@ -58,9 +58,12 @@ def chip_kind() -> str:
 
 
 def is_tpu_backend() -> bool:
+    """True only for backends whose Pallas timings are meaningful tile
+    probes. Positive list, not "not cpu": a GPU (or any other) backend
+    must not run TPU tile probes and cache their winners."""
     import jax
     try:
-        return jax.default_backend() not in ("cpu",)
+        return jax.default_backend() in ("tpu", "axon")
     except Exception:
         return False
 
@@ -88,12 +91,23 @@ def seq_bucket(n: int) -> int:
     return b
 
 
+#: bump when the measurement methodology or entry layout changes — every
+#: entry stamped with an older schema is treated as absent and re-measured
+#: (a winner tuned under old methodology must not survive the upgrade)
+SCHEMA_VERSION = 2
+
+
 class AutotuneCache:
     """Process-wide winner cache, mirrored to a JSON file.
 
     File writes are atomic (tmp + rename) and merged with any concurrent
     writer's content at save time (last writer wins per key) — several
     processes on one host converge instead of clobbering each other.
+
+    Entries are stamped ``{"schema": SCHEMA_VERSION, "stamp": epoch_s,
+    "value": winner}``; ``get`` unwraps the stamp and returns ``None``
+    for entries from another schema (including pre-stamp bare values),
+    so stale winners invalidate instead of silently persisting.
     """
 
     def __init__(self, path: Optional[str] = None):
@@ -134,12 +148,19 @@ class AutotuneCache:
     def get(self, key: str):
         with self._lock:
             self._ensure_loaded()
-            return self._mem.get(key)
+            ent = self._mem.get(key)
+        if isinstance(ent, dict) and "schema" in ent:
+            if ent.get("schema") != SCHEMA_VERSION:
+                return None  # stamped under another methodology: stale
+            return ent.get("value")
+        # pre-stamp bare value (or absent): treat as stale either way
+        return None
 
     def put(self, key: str, value, persist: bool = True):
         with self._lock:
             self._ensure_loaded()
-            self._mem[key] = value
+            self._mem[key] = {"schema": SCHEMA_VERSION,
+                              "stamp": time.time(), "value": value}
             if persist:
                 self._save()
 
@@ -215,6 +236,12 @@ def autotune(key: str,
         timings[str(cand)] = dt
         if dt < best_t:
             best, best_t = cand, dt
+    if flags.get_flag("log_level") >= 1:
+        import logging
+        ranked = ", ".join(f"{c}={t * 1e3:.3f}ms" for c, t in
+                           sorted(timings.items(), key=lambda kv: kv[1]))
+        logging.getLogger("paddle_tpu.autotune").info(
+            "autotune %s: %s", key, ranked or "no candidate survived")
     if best is None:
         best = default
     _cache.put(key, list(best) if isinstance(best, tuple) else best)

@@ -1,0 +1,22 @@
+"""static.nn — build-time layers + in-graph control flow.
+
+Package mirrors the reference layout (python/paddle/static/nn/):
+``common`` holds the construct-then-execute layer helpers, ``control_flow``
+the data-dependent ``cond`` / ``while_loop`` / ``case`` / ``switch_case``
+ops that lower to ``lax`` and compile INTO the captured program.
+"""
+from __future__ import annotations
+
+from . import common, control_flow  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .common import __all__ as _common_all
+from .control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+__all__ = list(_common_all) + ["cond", "while_loop", "case", "switch_case"]
+
+
+def __getattr__(name):
+    # the pre-package module exposed its private state (_SPARSE_EMB_AUTO
+    # counter, _GEO_LAYERS registry, ...) as static.nn attributes; keep
+    # that surface by forwarding unknown reads to the live common module
+    return getattr(common, name)

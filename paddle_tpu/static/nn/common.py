@@ -2,7 +2,7 @@
 (reference python/paddle/static/nn/common.py fc, embedding)."""
 from __future__ import annotations
 
-from .. import nn as _nn
+from ... import nn as _nn
 
 
 def fc(x, size, num_flatten_dims=1, activation=None, name=None,
@@ -23,7 +23,7 @@ def fc(x, size, num_flatten_dims=1, activation=None, name=None,
         x.shape) - 1 else x
     out = layer(h)
     if activation == "relu":
-        from ..nn import functional as F
+        from ...nn import functional as F
         out = F.relu(out)
     elif activation == "tanh":
         out = out.tanh()
@@ -44,7 +44,7 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 
     import numpy as _np
 
-    from ..core import dtype as dtypes
+    from ...core import dtype as dtypes
     want = dtypes.convert_dtype(str(dtype).replace("paddle.", ""))
     if _np.dtype(want) == _np.float64 and not jax.config.jax_enable_x64:
         # jax silently truncates f64->f32 without x64 mode; a wrong-dtype
@@ -80,8 +80,8 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
     """
     import zlib
 
-    from ..distributed.ps import _current_client, sparse_embedding_lookup
-    from ..distributed.ps.embedding import GeoDistributedEmbedding
+    from ...distributed.ps import _current_client, sparse_embedding_lookup
+    from ...distributed.ps.embedding import GeoDistributedEmbedding
 
     name = (param_attr if isinstance(param_attr, str)
             else getattr(param_attr, "name", None))
@@ -113,7 +113,7 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
 def _act(out, activation):
     if activation is None:
         return out
-    from ..nn import functional as F
+    from ...nn import functional as F
     fn = getattr(F, activation, None)
     if fn is None:
         raise ValueError(f"unsupported activation {activation!r}")
@@ -195,8 +195,8 @@ def py_func(func, x, out, backward_func=None,
     import jax.numpy as jnp
     import numpy as _np
 
-    from ..core import dispatch as _dispatch
-    from ..core.tensor import Tensor as _T
+    from ...core import dispatch as _dispatch
+    from ...core.tensor import Tensor as _T
 
     xs = x if isinstance(x, (list, tuple)) else [x]
     xs = [_T(v) if not isinstance(v, _T) else v for v in xs]

@@ -1,0 +1,16 @@
+"""static.nn control-flow surface (reference
+python/paddle/static/nn/control_flow.py — cond:1487, while_loop:682,
+case, switch_case).
+
+The implementation lives in ``paddle_tpu.ops.control_flow`` so the ops
+register with ``ops/registry.py`` at ``import paddle_tpu`` time (the op
+sweep and parity audit read the registry); this module is the documented
+public surface, matching the reference's file layout. See the
+implementation module's docstring for the eager/captured execution
+contract.
+"""
+from __future__ import annotations
+
+from ...ops.control_flow import case, cond, switch_case, while_loop
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]

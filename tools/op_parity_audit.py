@@ -31,6 +31,9 @@ ALIASES = {
     "unpool": "max_unpool2d", "unpool3d": "max_unpool3d",
     "warprnnt": "rnnt_loss", "graph_sample_neighbors": "sample_neighbors",
     "graph_reindex": "reindex_graph",
+    # in-graph control flow (static/nn/control_flow.py): the reference's
+    # `while` op is our while_loop; conditional_block registers same-name
+    "while": "while_loop",
 }
 
 # reference ops that are CUDA/infra-specific and have no TPU-user surface:
@@ -87,6 +90,11 @@ SUBSUMED = {
     "assign_pos": "fleet.MoELayer", "limit_by_capacity": "fleet.MoELayer",
     "prune_gate_by_capacity": "fleet.MoELayer",
     "random_routing": "fleet.MoELayer",
+    # control-flow program plumbing: branch-output merge ops have no
+    # separate surface — the cond/switch_case op boundary IS the merge
+    # (lax.cond/lax.switch return the selected branch's outputs)
+    "select_input": "static.nn.cond (lax.cond output merge)",
+    "select_output": "static.nn.cond (lax.cond output merge)",
     # program/IR plumbing ops with no eager surface
     "data": "jit/to_static", "full_int_array": "jit/to_static",
     "assign_out_": "jit/to_static", "increment": "ops.increment",
