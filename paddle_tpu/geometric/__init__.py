@@ -70,14 +70,20 @@ def segment_sum(data, segment_ids, name=None):
 
 
 def segment_mean(data, segment_ids, name=None):
+    """Mean of ``data`` rows per segment id (jax segment ops; reference
+    paddle.geometric.segment_mean)."""
     return _segment(data, segment_ids, "mean", "segment_mean")
 
 
 def segment_min(data, segment_ids, name=None):
+    """Min of ``data`` rows per segment id (reference
+    paddle.geometric.segment_min)."""
     return _segment(data, segment_ids, "min", "segment_min")
 
 
 def segment_max(data, segment_ids, name=None):
+    """Max of ``data`` rows per segment id (reference
+    paddle.geometric.segment_max)."""
     return _segment(data, segment_ids, "max", "segment_max")
 
 

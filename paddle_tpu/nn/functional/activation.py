@@ -17,69 +17,86 @@ def _t(x):
     return x if isinstance(x, Tensor) else as_tensor(x)
 
 
-def _unary(name, f):
+def _unary(name, f, doc):
     def op(x, name=None):
         return dispatch.call(name_, f, [_t(x)])
     name_ = name
     op.__name__ = name
+    op.__doc__ = f"{doc} (reference paddle.nn.functional.{name})."
     return op
 
 
-relu = _unary("relu", lambda a: jnp.maximum(a, 0))
-relu6 = _unary("relu6", lambda a: jnp.clip(a, 0, 6))
-sigmoid = _unary("sigmoid", jax.nn.sigmoid)
-tanh = _unary("tanh", jnp.tanh)
-silu = _unary("silu", jax.nn.silu)
+relu = _unary("relu", lambda a: jnp.maximum(a, 0), "max(x, 0)")
+relu6 = _unary("relu6", lambda a: jnp.clip(a, 0, 6), "min(max(x, 0), 6)")
+sigmoid = _unary("sigmoid", jax.nn.sigmoid, "1 / (1 + exp(-x))")
+tanh = _unary("tanh", jnp.tanh, "Hyperbolic tangent")
+silu = _unary("silu", jax.nn.silu, "x * sigmoid(x) — SiLU/swish")
 swish = silu
-mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)))
-softsign = _unary("softsign", jax.nn.soft_sign)
-tanhshrink = _unary("tanhshrink", lambda a: a - jnp.tanh(a))
-log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid)
+mish = _unary("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)),
+              "x * tanh(softplus(x))")
+softsign = _unary("softsign", jax.nn.soft_sign, "x / (1 + |x|)")
+tanhshrink = _unary("tanhshrink", lambda a: a - jnp.tanh(a), "x - tanh(x)")
+log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid,
+                     "log(sigmoid(x)), computed stably")
 
 
 def gelu(x, approximate=False, name=None):
+    """Gaussian error linear unit, exact or tanh approximation (reference
+    gelu)."""
     return dispatch.call("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
                          [_t(x)])
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
+    """x if x >= 0 else negative_slope * x (reference leaky_relu)."""
     return dispatch.call(
         "leaky_relu", lambda a: jnp.where(a >= 0, a, negative_slope * a), [_t(x)])
 
 
 def elu(x, alpha=1.0, name=None):
+    """x if x > 0 else alpha * (exp(x) - 1) (reference elu)."""
     return dispatch.call("elu", lambda a: jax.nn.elu(a, alpha=alpha), [_t(x)])
 
 
 def celu(x, alpha=1.0, name=None):
+    """Continuously differentiable ELU: max(0, x) + min(0,
+    alpha*(exp(x/alpha)-1))."""
     return dispatch.call("celu", lambda a: jax.nn.celu(a, alpha=alpha), [_t(x)])
 
 
 def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    """Self-normalizing ELU with fixed scale/alpha (reference selu)."""
     return dispatch.call(
         "selu",
         lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), [_t(x)])
 
 
 def hardswish(x, name=None):
+    """x * relu6(x + 3) / 6 — cheap swish approximation (reference hardswish).
+    """
     return dispatch.call("hardswish", lambda a: a * jnp.clip(a + 3, 0, 6) / 6, [_t(x)])
 
 
 def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    """Piecewise-linear sigmoid approximation (reference hardsigmoid)."""
     return dispatch.call(
         "hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0, 1), [_t(x)])
 
 
 def hardtanh(x, min=-1.0, max=1.0, name=None):
+    """Clip x to [min, max] (reference hardtanh)."""
     return dispatch.call("hardtanh", lambda a: jnp.clip(a, min, max), [_t(x)])
 
 
 def hardshrink(x, threshold=0.5, name=None):
+    """x where |x| > threshold else 0 (reference hardshrink)."""
     return dispatch.call(
         "hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), [_t(x)])
 
 
 def softshrink(x, threshold=0.5, name=None):
+    """Shrink x toward 0 by threshold, 0 inside the band (reference
+    softshrink)."""
     return dispatch.call(
         "softshrink",
         lambda a: jnp.where(a > threshold, a - threshold,
@@ -87,6 +104,7 @@ def softshrink(x, threshold=0.5, name=None):
 
 
 def softplus(x, beta=1.0, threshold=20.0, name=None):
+    """log(1 + exp(beta*x)) / beta with linear tail (reference softplus)."""
     def f(a):
         scaled = beta * a
         return jnp.where(scaled > threshold, a, jax.nn.softplus(scaled) / beta)
@@ -94,6 +112,8 @@ def softplus(x, beta=1.0, threshold=20.0, name=None):
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
+    """Leaky relu with LEARNED per-channel slope ``weight`` (reference prelu).
+    """
     x, w = _t(x), _t(weight)
 
     def f(a, wa):
@@ -109,6 +129,8 @@ def prelu(x, weight, data_format="NCHW", name=None):
 
 
 def rrelu(x, lower=0.125, upper=1.0 / 3, training=False, name=None):
+    """Randomized leaky relu: slope sampled in [lower, upper] at train time
+    (reference rrelu)."""
     from ...core.generator import next_key
     x = _t(x)
     if training:
@@ -127,6 +149,8 @@ def rrelu(x, lower=0.125, upper=1.0 / 3, training=False, name=None):
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
+    """exp(x)/sum(exp(x)) along ``axis``, max-subtracted for stability
+    (reference softmax)."""
     x = _t(x)
 
     def f(a):
@@ -138,6 +162,7 @@ def softmax(x, axis=-1, dtype=None, name=None):
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
+    """x - logsumexp(x) along ``axis`` (reference log_softmax)."""
     x = _t(x)
 
     def f(a):
@@ -149,6 +174,8 @@ def log_softmax(x, axis=-1, dtype=None, name=None):
 
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    """Differentiable categorical relaxation; straight-through when hard=True
+    (reference gumbel_softmax)."""
     from ...core.generator import next_key
     x = _t(x)
     key = next_key()
@@ -167,6 +194,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
 
 
 def maxout(x, groups, axis=1, name=None):
+    """Max over ``groups`` channel partitions (reference maxout)."""
     x = _t(x)
 
     def f(a):
@@ -179,6 +207,8 @@ def maxout(x, groups, axis=1, name=None):
 
 
 def glu(x, axis=-1, name=None):
+    """Gated linear unit: a * sigmoid(b) over a channel split (reference glu).
+    """
     x = _t(x)
 
     def f(a):
@@ -188,6 +218,7 @@ def glu(x, axis=-1, name=None):
 
 
 def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    """x where x > threshold else value (reference thresholded_relu)."""
     return dispatch.call(
         "thresholded_relu", lambda a: jnp.where(a > threshold, a, value), [_t(x)])
 

@@ -36,6 +36,8 @@ def linear(x, weight, bias=None, name=None):
 
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             name=None):
+    """Zero elements with probability p at train time, rescaling survivors
+    (reference dropout)."""
     x = _t(x)
     if not training or p == 0:
         if mode == "downscale_in_infer" and not training:
@@ -59,16 +61,20 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    """Channel-wise dropout over NCHW feature maps (reference dropout2d)."""
     ax = [0, 1] if data_format == "NCHW" else [0, 3]
     return dropout(x, p=p, axis=ax, training=training)
 
 
 def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Channel-wise dropout over NCDHW feature maps (reference dropout3d)."""
     ax = [0, 1] if data_format == "NCDHW" else [0, 4]
     return dropout(x, p=p, axis=ax, training=training)
 
 
 def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout: dropped units take the negative saturation
+    value (reference alpha_dropout)."""
     x = _t(x)
     if not training or p == 0:
         return x
@@ -86,6 +92,8 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Row gather from ``weight`` by integer ids, optional padding_idx zero-
+    grad (reference embedding)."""
     x, w = _t(x), _t(weight)
 
     def f(ids, table):
@@ -136,12 +144,15 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 
 def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad the two spatial dims of NCHW input (reference zeropad2d)."""
     return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
                 align_corners=False, align_mode=0, data_format="NCHW",
                 name=None):
+    """Resize spatial dims by nearest/bilinear/bicubic/area/trilinear
+    (reference interpolate)."""
     x = _t(x)
     channel_last = data_format in ("NHWC", "NWC", "NDHWC")
     nd = x.ndim - 2
@@ -192,6 +203,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
 
 def upsample(x, size=None, scale_factor=None, mode="nearest",
              align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    """Alias of interpolate (reference upsample)."""
     return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
                        data_format)
 
@@ -220,6 +232,8 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
          name=None):
+    """Combine sliding local blocks back into a spatial tensor — inverse of
+    unfold (reference fold)."""
     x = _t(x)
     out = output_sizes if isinstance(output_sizes, (list, tuple)) else [output_sizes] * 2
     k = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
@@ -249,6 +263,8 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
 
 
 def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    """Rearrange (C*r^2, H, W) -> (C, H*r, W*r) for sub-pixel conv (reference
+    pixel_shuffle)."""
     x = _t(x)
     r = upscale_factor
 
@@ -266,6 +282,7 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
 
 
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    """Inverse of pixel_shuffle (reference pixel_unshuffle)."""
     x = _t(x)
     r = downscale_factor
 
@@ -283,6 +300,8 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
 
 
 def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Interleave channel groups, ShuffleNet-style (reference channel_shuffle).
+    """
     x = _t(x)
 
     def f(a):
@@ -298,6 +317,8 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
 
 
 def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    """Dot product of L2-normalized inputs along ``axis`` (reference
+    cosine_similarity)."""
     def f(a, b):
         num = jnp.sum(a * b, axis=axis)
         den = jnp.maximum(
@@ -307,6 +328,7 @@ def cosine_similarity(x1, x2, axis=1, eps=1e-8):
 
 
 def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear form x1^T W x2 + b per output channel (reference bilinear)."""
     inputs = [_t(x1), _t(x2), _t(weight)]
     if bias is not None:
         inputs.append(_t(bias))
@@ -320,6 +342,8 @@ def bilinear(x1, x2, weight, bias=None, name=None):
 
 
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    """Blend one-hot labels toward uniform (or prior_dist) by epsilon
+    (reference label_smooth)."""
     label = _t(label)
     inputs = [label]
     if prior_dist is not None:
@@ -355,6 +379,7 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
                 "sequence_mask(maxlen=None) needs concrete lengths; pass "
                 "an explicit maxlen under jit/to_static (shapes must be "
                 "static)")
+        # tpulint: disable=TPU103 — maxlen becomes an output SHAPE; guarded by the Tracer check above
         maxlen = int(jnp.max(t._data))
 
     def f(l):

@@ -80,6 +80,8 @@ def _conv_nd(x, w, bias, stride, padding, dilation, groups, nd, channel_last,
 
 def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCL", name=None):
+    """1D convolution via lax.conv_general_dilated, NCL layout (reference
+    conv1d)."""
     return _conv_nd(_t(x), _t(weight), _t(bias) if bias is not None else None,
                     stride, padding, dilation, groups, 1,
                     data_format == "NLC", "conv1d")
@@ -87,6 +89,8 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCHW", name=None):
+    """2D convolution via lax.conv_general_dilated, NCHW layout, groups
+    supported (reference conv2d)."""
     return _conv_nd(_t(x), _t(weight), _t(bias) if bias is not None else None,
                     stride, padding, dilation, groups, 2,
                     data_format == "NHWC", "conv2d")
@@ -94,6 +98,8 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NCDHW", name=None):
+    """3D convolution via lax.conv_general_dilated, NCDHW layout (reference
+    conv3d)."""
     return _conv_nd(_t(x), _t(weight), _t(bias) if bias is not None else None,
                     stride, padding, dilation, groups, 3,
                     data_format == "NDHWC", "conv3d")
@@ -165,6 +171,8 @@ def _conv_transpose_nd(x, w, bias, stride, padding, output_padding, dilation,
 def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1, output_size=None,
                      data_format="NCL", name=None):
+    """1D transposed (fractionally-strided) convolution (reference
+    conv1d_transpose)."""
     return _conv_transpose_nd(_t(x), _t(weight),
                               _t(bias) if bias is not None else None,
                               stride, padding, output_padding, dilation, groups,
@@ -175,6 +183,8 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1, output_size=None,
                      data_format="NCHW", name=None):
+    """2D transposed convolution via lhs dilation (reference conv2d_transpose).
+    """
     return _conv_transpose_nd(_t(x), _t(weight),
                               _t(bias) if bias is not None else None,
                               stride, padding, output_padding, dilation, groups,
@@ -185,6 +195,8 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, groups=1, dilation=1, output_size=None,
                      data_format="NCDHW", name=None):
+    """3D transposed convolution via lhs dilation (reference conv3d_transpose).
+    """
     return _conv_transpose_nd(_t(x), _t(weight),
                               _t(bias) if bias is not None else None,
                               stride, padding, output_padding, dilation, groups,

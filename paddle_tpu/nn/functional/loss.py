@@ -119,6 +119,8 @@ def _hard_label_reduce(nll, valid, w, has_w, safe, reduction):
 def softmax_with_cross_entropy(logits, label, soft_label=False,
                                ignore_index=-100, numeric_stable_mode=True,
                                return_softmax=False, axis=-1):
+    """Fused softmax + cross entropy on logits (reference
+    softmax_with_cross_entropy)."""
     loss = cross_entropy(logits, label, soft_label=soft_label,
                          ignore_index=ignore_index, reduction="none", axis=axis)
     from .activation import softmax as _softmax
@@ -130,6 +132,8 @@ def softmax_with_cross_entropy(logits, label, soft_label=False,
 
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
+    """Negative log likelihood over log-probabilities with hard labels
+    (reference nll_loss)."""
     input, label = _t(input), _t(label)
     inputs = [input, label]
     has_w = weight is not None
@@ -154,6 +158,7 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
 
 
 def mse_loss(input, label, reduction="mean", name=None):
+    """Mean squared error (reference mse_loss)."""
     return dispatch.call(
         "mse_loss",
         lambda a, b: _reduce((a - b.astype(a.dtype)) ** 2, reduction),
@@ -161,6 +166,7 @@ def mse_loss(input, label, reduction="mean", name=None):
 
 
 def l1_loss(input, label, reduction="mean", name=None):
+    """Mean absolute error (reference l1_loss)."""
     return dispatch.call(
         "l1_loss",
         lambda a, b: _reduce(jnp.abs(a - b.astype(a.dtype)), reduction),
@@ -168,6 +174,7 @@ def l1_loss(input, label, reduction="mean", name=None):
 
 
 def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    """Huber-style L1 smoothed below delta (reference smooth_l1_loss)."""
     def f(a, b):
         d = a - b.astype(a.dtype)
         ad = jnp.abs(d)
@@ -178,6 +185,8 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
 
 def binary_cross_entropy(input, label, weight=None, reduction="mean",
                          name=None):
+    """BCE over probabilities with optional weight (reference
+    binary_cross_entropy)."""
     inputs = [_t(input), _t(label)]
     has_w = weight is not None
     if has_w:
@@ -196,6 +205,8 @@ def binary_cross_entropy(input, label, weight=None, reduction="mean",
 def binary_cross_entropy_with_logits(logit, label, weight=None,
                                      reduction="mean", pos_weight=None,
                                      name=None):
+    """Numerically stable BCE straight from logits (reference
+    binary_cross_entropy_with_logits)."""
     inputs = [_t(logit), _t(label)]
     has_w = weight is not None
     has_pw = pos_weight is not None
@@ -224,6 +235,8 @@ def binary_cross_entropy_with_logits(logit, label, weight=None,
 
 
 def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    """KL divergence sum(target * (log(target) - input)) with input log-probs
+    (reference kl_div)."""
     def f(lp, t):
         if log_target:
             val = jnp.exp(t) * (t - lp)
@@ -239,6 +252,7 @@ def kl_div(input, label, reduction="mean", log_target=False, name=None):
 
 def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
                         name=None):
+    """max(0, -label*(x1-x2) + margin) (reference margin_ranking_loss)."""
     def f(a, b, y):
         return _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
     return dispatch.call("margin_ranking_loss", f,
@@ -247,6 +261,8 @@ def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
 
 
 def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    """Hinge on dissimilar pairs, identity on similar (reference
+    hinge_embedding_loss)."""
     def f(a, y):
         val = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
         return _reduce(val, reduction)
@@ -256,6 +272,8 @@ def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
 
 def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
                           name=None):
+    """1 - cos for similar pairs, relu(cos - margin) for dissimilar (reference
+    cosine_embedding_loss)."""
     def f(a, b, y):
         cos = (jnp.sum(a * b, axis=-1)
                / jnp.maximum(jnp.linalg.norm(a, axis=-1)
@@ -269,6 +287,8 @@ def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
 
 def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
                         epsilon=1e-6, swap=False, reduction="mean", name=None):
+    """max(0, d(a,p) - d(a,n) + margin) over a p-norm metric (reference
+    triplet_margin_loss)."""
     def f(a, pos, neg):
         def dist(u, v):
             return jnp.sum(jnp.abs(u - v + epsilon) ** p, axis=-1) ** (1.0 / p)
@@ -283,6 +303,8 @@ def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
 
 def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
                        reduction="sum", name=None):
+    """Focal-modulated BCE with logits for class imbalance (reference
+    sigmoid_focal_loss)."""
     inputs = [_t(logit), _t(label)]
     if normalizer is not None:
         inputs.append(_t(normalizer))
@@ -302,6 +324,8 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 
 def square_error_cost(input, label):
+    """Elementwise (input - label)^2, unreduced (reference square_error_cost).
+    """
     return dispatch.call("square_error_cost",
                          lambda a, b: (a - b) ** 2, [_t(input), _t(label)])
 
@@ -415,94 +439,140 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     """
     inp, lab = _t(input), _t(label)
     w = _t(weight)
-    tensors = [inp, w]
-    if bias is not None:
-        bias = _t(bias)
-        tensors.append(bias)
-    lab_np = np.asarray(lab._data).astype(np.int64).ravel()
+    tensors = [inp, w, lab]
+    diff_mask = [True, True, False]
+    has_bias = bias is not None
+    if has_bias:
+        tensors.append(_t(bias))
+        diff_mask.append(True)
+    has_table = path_table is not None
+    if has_table:
+        tensors += [_t(path_table), _t(path_code)]
+        diff_mask += [False, False]
     K = num_classes
-    if path_table is None:
-        # build (B, D) node ids + codes on host (labels are data)
-        depth = max(int(np.ceil(np.log2(max(K, 2)))), 1)
-        nodes = np.zeros((lab_np.shape[0], depth), np.int64)
-        codes = np.zeros((lab_np.shape[0], depth), np.float32)
-        valid = np.zeros((lab_np.shape[0], depth), np.float32)
-        for b, c in enumerate(lab_np):
-            i = int(c) + K - 1
-            d = 0
-            while i > 0 and d < depth:
-                parent = (i - 1) // 2
-                nodes[b, d] = parent
-                codes[b, d] = 1.0 if i == 2 * parent + 1 else 0.0
-                valid[b, d] = 1.0
-                i = parent
-                d += 1
-    else:
-        nodes = np.asarray(_t(path_table)._data).astype(np.int64)
-        codes = np.asarray(_t(path_code)._data).astype(np.float32)
-        valid = (nodes >= 0).astype(np.float32)
-        nodes = np.maximum(nodes, 0)
+    depth = max(int(np.ceil(np.log2(max(K, 2)))), 1)  # static: K is python
 
-    def f(x, wt, *rest):
-        bv = rest[0] if bias is not None else None
+    def f(x, wt, lab_, *rest):
+        bv = rest[0] if has_bias else None
+        if has_table:
+            nodes = rest[-2].astype(jnp.int64)
+            codes = rest[-1].astype(jnp.float32)
+            valid = (nodes >= 0).astype(jnp.float32)
+            nodes = jnp.maximum(nodes, 0)
+        else:
+            # default complete binary tree: walk leaf -> root; the tree
+            # depth is static so the walk unrolls to `depth` vectorized
+            # steps — labels stay on device (the seed built these tables
+            # with a host loop over label values, graph-breaking capture)
+            i = lab_.reshape(-1).astype(jnp.int64) + (K - 1)
+            nd, cd, vd = [], [], []
+            for _ in range(depth):
+                parent = (i - 1) // 2
+                live = i > 0
+                nd.append(jnp.where(live, parent, 0))
+                cd.append(jnp.where(live & (i == 2 * parent + 1), 1.0, 0.0))
+                vd.append(live.astype(jnp.float32))
+                i = jnp.where(live, parent, 0)
+            nodes = jnp.stack(nd, axis=1)
+            codes = jnp.stack(cd, axis=1)
+            valid = jnp.stack(vd, axis=1)
         wsel = wt[nodes]                      # (B, D, F)
         logits = jnp.einsum("bdf,bf->bd", wsel, x)
         if bv is not None:
             logits = logits + bv.reshape(-1)[nodes]
-        c = jnp.asarray(codes)
-        v = jnp.asarray(valid)
         # BCE with logits against the path code, masked by path validity
-        per = (jnp.maximum(logits, 0) - logits * c
-               + jnp.log1p(jnp.exp(-jnp.abs(logits)))) * v
+        per = (jnp.maximum(logits, 0) - logits * codes
+               + jnp.log1p(jnp.exp(-jnp.abs(logits)))) * valid
         return per.sum(axis=1, keepdims=True)
 
-    return dispatch.call("hsigmoid_loss", f, tensors)
+    return dispatch.call("hsigmoid_loss", f, tensors,
+                         differentiable_mask=diff_mask)
 
 
 def edit_distance(input, label, normalized=True, ignored_tokens=None,
                   input_length=None, label_length=None, name=None):
     """Levenshtein distance per batch row (reference
     python/paddle/nn/functional/loss.py edit_distance,
-    phi/kernels/impl/edit_distance_kernel_impl.h). Host DP — the op is a
-    metric over integer id sequences, not a training-path kernel.
+    phi/kernels/impl/edit_distance_kernel_impl.h). In-graph DP: the classic
+    serial recurrence dp[c] = min(e[c], dp[c-1]+1) unrolls to
+    dp[c] = c + min_{k<=c}(e[k]-k), a prefix-min (lax.cummin) — so each DP
+    row is one vectorized step and the whole metric is a vmapped fori_loop
+    XLA compiles into the caller's program (the seed version pulled the
+    operands to the host and graph-broke to_static capture; tpulint TPU1xx).
 
     Returns (distance (B,1) float, sequence_num (1,) int).
     """
-    a = np.asarray(_t(input)._data)
-    b = np.asarray(_t(label)._data)
-    il = (np.asarray(_t(input_length)._data).ravel()
-          if input_length is not None else
-          np.full(a.shape[0], a.shape[1], np.int64))
-    ll = (np.asarray(_t(label_length)._data).ravel()
-          if label_length is not None else
-          np.full(b.shape[0], b.shape[1], np.int64))
-    ign = set(ignored_tokens or ())
-    out = np.zeros((a.shape[0], 1), np.float32)
-    for i in range(a.shape[0]):
-        s1 = [t for t in a[i, :il[i]].tolist() if t not in ign]
-        s2 = [t for t in b[i, :ll[i]].tolist() if t not in ign]
-        m, n = len(s1), len(s2)
-        dp = np.arange(n + 1, dtype=np.int64)
-        for r in range(1, m + 1):
-            prev = dp.copy()
-            dp[0] = r
-            for c in range(1, n + 1):
-                dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
-                            prev[c - 1] + (s1[r - 1] != s2[c - 1]))
-        d = float(dp[n])
+    it, lt = _t(input), _t(label)
+    m_pad, n_pad = int(it.shape[1]), int(lt.shape[1])
+    ign = tuple(sorted(set(ignored_tokens or ())))
+    tensors = [it, lt]
+    has_il, has_ll = input_length is not None, label_length is not None
+    if has_il:
+        tensors.append(_t(input_length))
+    if has_ll:
+        tensors.append(_t(label_length))
+
+    def f(a, b, *rest):
+        il = rest[0].reshape(-1) if has_il else jnp.full(
+            (a.shape[0],), m_pad, jnp.int32)
+        ll = rest[-1].reshape(-1) if has_ll else jnp.full(
+            (b.shape[0],), n_pad, jnp.int32)
+
+        def compact(seq, length, width):
+            # drop ignored tokens in-graph: stable-sort valid entries to
+            # the front, padding the tail with -1 (matches no real token)
+            keep = jnp.arange(width)[None, :] < length[:, None].astype(
+                jnp.int32)
+            for tok in ign:
+                keep &= seq != tok
+            order = jnp.argsort(~keep, axis=1, stable=True)
+            packed = jnp.where(jnp.take_along_axis(keep, order, axis=1),
+                               jnp.take_along_axis(seq, order, axis=1), -1)
+            return packed, keep.sum(axis=1)
+
+        s1, m_eff = compact(a, il, m_pad)
+        s2, n_eff = compact(b, ll, n_pad)
+
+        def row_distance(x, y, m, n):
+            cols = jnp.arange(n_pad + 1, dtype=jnp.int32)
+
+            def step(r, carry):
+                prev, best = carry
+                cost = (x[r - 1] != y).astype(jnp.int32)
+                # e[c] = min(delete, substitute); insert handled below
+                e = jnp.minimum(prev[1:] + 1, prev[:-1] + cost)
+                g = jnp.concatenate([jnp.full((1,), r, jnp.int32), e])
+                dp = jax.lax.cummin(g - cols) + cols
+                best = jnp.where(r == m, dp[n], best)
+                return dp, best
+
+            dp0 = cols
+            best0 = jnp.where(m == 0, dp0[n], 0)
+            _, best = jax.lax.fori_loop(1, m_pad + 1, step, (dp0, best0))
+            return best
+
+        dist = jax.vmap(row_distance)(s1, s2, m_eff, n_eff).astype(
+            jnp.float32)
         if normalized:
-            d = d / max(n, 1)
-        out[i, 0] = d
-    return (Tensor(jnp.asarray(out)),
-            Tensor(jnp.asarray([a.shape[0]], dtype=jnp.int32)))
+            dist = dist / jnp.maximum(n_eff, 1).astype(jnp.float32)
+        return dist.reshape(-1, 1), jnp.full((1,), a.shape[0], jnp.int32)
+
+    return dispatch.call("edit_distance", f, tensors, multi_output=True,
+                         differentiable_mask=[False] * len(tensors),
+                         export_attrs={"normalized": normalized,
+                                       "ignored_tokens": ign})
 
 
 def ctc_align(input, input_length=None, blank=0, padding_value=0, name=None):
     """CTC greedy alignment: merge repeats then drop blanks
     (reference ctc_align op, phi/kernels/cpu/ctc_align_kernel.cc).
-    input: (B, T) argmax token ids."""
-    a = np.asarray(_t(input)._data)
-    il = (np.asarray(_t(input_length)._data).ravel()
+    input: (B, T) argmax token ids.
+
+    Deliberately host-side: the output WIDTH is data-dependent (longest
+    de-blanked row), which XLA's static shapes cannot express — a decode
+    utility, never on the training path."""
+    a = np.asarray(_t(input)._data)  # tpulint: disable=TPU104 — dynamic output shape forces host decode
+    il = (np.asarray(_t(input_length)._data).ravel()  # tpulint: disable=TPU104 — same host decode path
           if input_length is not None else
           np.full(a.shape[0], a.shape[1], np.int64))
     rows, lens = [], []
@@ -510,7 +580,7 @@ def ctc_align(input, input_length=None, blank=0, padding_value=0, name=None):
         seq = a[i, :il[i]]
         prev = None
         out = []
-        for tkn in seq.tolist():
+        for tkn in seq.tolist():  # tpulint: disable=TPU102 — host decode, see docstring
             if tkn != prev and tkn != blank:
                 out.append(tkn)
             prev = tkn
@@ -535,15 +605,15 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
     hand-written backward, no warp-rnnt CUDA.
     """
     lg, lb = _t(logits), _t(labels)
-    tl = np.asarray(_t(logit_lengths)._data).ravel()
-    ul = np.asarray(_t(label_lengths)._data).ravel()
-    lab_np = np.asarray(lb._data).astype(np.int64)
+    tlt, ult = _t(logit_lengths), _t(label_lengths)
 
-    def f_all(lp):
+    def f_all(lp, lab_in, tl_in, ul_in):
         B, T, U1, V = lp.shape
+        tl = tl_in.reshape(-1)
+        ul = ul_in.reshape(-1)
         logp = jax.nn.log_softmax(lp, axis=-1)
         blank_lp = logp[..., blank]
-        lab = jnp.asarray(lab_np)
+        lab = lab_in.astype(jnp.int64)
         emit_lp = jnp.take_along_axis(
             logp[:, :, :U1 - 1, :], lab[:, None, :, None], axis=-1)[..., 0]
         if fastemit_lambda:
@@ -553,7 +623,7 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
             emit_lp = emit_lp + fastemit_lambda * (
                 emit_lp - jax.lax.stop_gradient(emit_lp))
         NEG = -1e30
-        tmask = jnp.arange(T)[None, :] < jnp.asarray(tl)[:, None]
+        tmask = jnp.arange(T)[None, :] < tl[:, None]
         alpha0 = jnp.concatenate(
             [jnp.zeros((B, 1)), jnp.cumsum(blank_lp[:, :-1, 0], axis=1)],
             axis=1)
@@ -573,8 +643,8 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
             au = jnp.where(tmask, au, NEG)
             rows.append(au)
         A = jnp.stack(rows, axis=2)                     # (B, T, U1)
-        tb = jnp.asarray(tl) - 1
-        ub = jnp.asarray(ul)
+        tb = tl - 1
+        ub = ul
         binx = jnp.arange(B)
         ll = A[binx, tb, ub] + blank_lp[binx, tb, ub]
         loss = -ll
@@ -584,7 +654,8 @@ def rnnt_loss(logits, labels, logit_lengths, label_lengths, blank=0,
             return jnp.sum(loss)
         return loss
 
-    return dispatch.call("rnnt_loss", f_all, [lg])
+    return dispatch.call("rnnt_loss", f_all, [lg, lb, tlt, ult],
+                         differentiable_mask=[True, False, False, False])
 
 
 __all__ += ['hsigmoid_loss', 'edit_distance', 'ctc_align', 'rnnt_loss']

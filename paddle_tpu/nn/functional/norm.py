@@ -20,6 +20,8 @@ def _t(x):
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
                name=None):
+    """Normalize over trailing normalized_shape dims with affine scale/shift
+    (reference layer_norm)."""
     x = _t(x)
     if isinstance(normalized_shape, int):
         normalized_shape = [normalized_shape]
@@ -51,6 +53,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
              name=None):
+    """x / rms(x) * weight — LayerNorm without mean-centering (reference
+    rms_norm)."""
     x = _t(x)
     axis = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
     axes = tuple(range(axis, x.ndim))
@@ -141,8 +145,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     ea = None
     if dispatch._export_hooks:
         ea = {"epsilon": epsilon, "ch_axis": ch_axis, "has_w": has_w,
-              "has_b": has_b, "mean": np.asarray(rm, np.float32),
-              "var": np.asarray(rv, np.float32)}
+              # tpulint: disable=TPU104 — ONNX export attrs are a host interchange boundary
+              "mean": np.asarray(rm, np.float32),
+              "var": np.asarray(rv, np.float32),  # tpulint: disable=TPU104 — same export boundary
+              "has_b": has_b}
     return dispatch.call("batch_norm", f, inputs, export_attrs=ea)
 
 
@@ -161,6 +167,7 @@ def _affine(y, wb, has_w, has_b, ch_axis, ndim):
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
                data_format="NCHW", name=None):
+    """Normalize channels in ``num_groups`` groups (reference group_norm)."""
     x = _t(x)
     channel_last = data_format in ("NHWC", "NLC", "NDHWC")
     inputs = [x]
@@ -201,6 +208,8 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
                   data_format="NCHW", name=None):
+    """Per-sample, per-channel spatial normalization (reference instance_norm).
+    """
     x = _t(x)
     inputs = [x]
     has_w = weight is not None
@@ -229,6 +238,7 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    """Lp-normalize along ``axis`` with epsilon floor (reference normalize)."""
     x = _t(x)
 
     def f(a):
@@ -242,6 +252,8 @@ def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
 
 def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
                         data_format="NCHW", name=None):
+    """AlexNet-style cross-channel response normalization (reference
+    local_response_norm)."""
     x = _t(x)
 
     def f(a):

@@ -87,6 +87,7 @@ def _pool_nd(x, ksize, stride, padding, nd, channel_last, mode,
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, name=None):
+    """1D average pooling, NCL (reference avg_pool1d)."""
     return _pool_nd(_t(x), kernel_size, stride, padding, 1, False, "avg",
                     exclusive, ceil_mode, "avg_pool1d")
 
@@ -94,6 +95,7 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
+    """2D average pooling, NCHW (reference avg_pool2d)."""
     if divisor_override is not None:
         t = _pool_nd(_t(x), kernel_size, stride, padding, 2,
                      data_format == "NHWC", "avg", False, ceil_mode,
@@ -108,6 +110,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
+    """3D average pooling, NCDHW (reference avg_pool3d)."""
     return _pool_nd(_t(x), kernel_size, stride, padding, 3,
                     data_format == "NDHWC", "avg", exclusive, ceil_mode,
                     "avg_pool3d")
@@ -115,6 +118,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
+    """1D max pooling, NCL; optional argmax indices (reference max_pool1d)."""
     out = _pool_nd(_t(x), kernel_size, stride, padding, 1, False, "max",
                    ceil_mode=ceil_mode, op_name="max_pool1d")
     if return_mask:
@@ -124,6 +128,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    """2D max pooling, NCHW; optional argmax indices (reference max_pool2d)."""
     out = _pool_nd(_t(x), kernel_size, stride, padding, 2,
                    data_format == "NHWC", "max", ceil_mode=ceil_mode,
                    op_name="max_pool2d")
@@ -135,6 +140,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    """3D max pooling, NCDHW (reference max_pool3d)."""
     out = _pool_nd(_t(x), kernel_size, stride, padding, 3,
                    data_format == "NDHWC", "max", ceil_mode=ceil_mode,
                    op_name="max_pool3d")
@@ -242,33 +248,40 @@ def _adaptive_pool_nd(x, output_size, nd, channel_last, mode, op_name):
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
+    """Average pool to a target output length (reference adaptive_avg_pool1d).
+    """
     return _adaptive_pool_nd(_t(x), output_size, 1, False, "avg",
                              "adaptive_avg_pool1d")
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    """Average pool to a target (H, W) (reference adaptive_avg_pool2d)."""
     return _adaptive_pool_nd(_t(x), output_size, 2, data_format == "NHWC",
                              "avg", "adaptive_avg_pool2d")
 
 
 def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """Average pool to a target (D, H, W) (reference adaptive_avg_pool3d)."""
     return _adaptive_pool_nd(_t(x), output_size, 3, data_format == "NDHWC",
                              "avg", "adaptive_avg_pool3d")
 
 
 def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    """Max pool to a target output length (reference adaptive_max_pool1d)."""
     out = _adaptive_pool_nd(_t(x), output_size, 1, False, "max",
                             "adaptive_max_pool1d")
     return (out, None) if return_mask else out
 
 
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    """Max pool to a target (H, W) (reference adaptive_max_pool2d)."""
     out = _adaptive_pool_nd(_t(x), output_size, 2, False, "max",
                             "adaptive_max_pool2d")
     return (out, None) if return_mask else out
 
 
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    """Max pool to a target (D, H, W) (reference adaptive_max_pool3d)."""
     out = _adaptive_pool_nd(_t(x), output_size, 3, False, "max",
                             "adaptive_max_pool3d")
     return (out, None) if return_mask else out
@@ -308,18 +321,24 @@ def _max_unpool_nd(x, indices, kernel_size, stride, padding, nd,
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCL", output_size=None, name=None):
+    """Scatter pooled values back to argmax positions, 1D (reference
+    max_unpool1d)."""
     return _max_unpool_nd(x, indices, kernel_size, stride, padding, 1,
                           output_size, "max_unpool1d")
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
+    """Scatter pooled values back to argmax positions, 2D (reference
+    max_unpool2d)."""
     return _max_unpool_nd(x, indices, kernel_size, stride, padding, 2,
                           output_size, "max_unpool2d")
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCDHW", output_size=None, name=None):
+    """Scatter pooled values back to argmax positions, 3D (reference
+    max_unpool3d)."""
     return _max_unpool_nd(x, indices, kernel_size, stride, padding, 3,
                           output_size, "max_unpool3d")
 
@@ -348,6 +367,7 @@ def _fractional_max_pool_nd(x, output_size, kernel_size, random_u, nd,
     if random_u is None:
         from ...core.generator import default_generator
         import jax as _jax
+        # tpulint: disable=TPU103 — u picks the pooling GRID (static shapes); must be a host scalar
         u = float(_jax.random.uniform(default_generator().next_key(), ()))
     else:
         u = float(random_u)
@@ -390,6 +410,8 @@ def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
 
 def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
                           return_mask=False, name=None):
+    """Max pool over pseudo-random fractional intervals, 3D (reference
+    fractional_max_pool3d)."""
     return _fractional_max_pool_nd(x, output_size, kernel_size, random_u, 3,
                                    return_mask, "fractional_max_pool3d")
 
@@ -419,12 +441,14 @@ def _lp_pool(x, norm_type, kernel_size, stride, padding, nd, ceil_mode,
 
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCL", name=None):
+    """Lp-norm pooling, 1D (reference lp_pool1d)."""
     return _lp_pool(x, norm_type, kernel_size, stride, padding, 1, ceil_mode,
                     data_format, "lp_pool1d")
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCHW", name=None):
+    """Lp-norm pooling, 2D (reference lp_pool2d)."""
     return _lp_pool(x, norm_type, kernel_size, stride, padding, 2, ceil_mode,
                     data_format, "lp_pool2d")
 

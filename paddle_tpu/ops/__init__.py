@@ -216,10 +216,16 @@ def _register_all():
     from ..nn.functional import (activation as _act, common as _common,
                                  conv as _conv, loss as _loss, norm as _norm,
                                  pooling as _pool)
-    for mod, cat in ((_act, "activation"), (_common, "nn_common"),
-                     (_conv, "conv"), (_loss, "loss"), (_norm, "norm"),
-                     (_pool, "pooling")):
-        register_module(mod, cat)
+    # explicit skips: these names are deliberately ALSO defined at the
+    # nn.functional level (paddle has both paddle.sigmoid and
+    # paddle.nn.functional.sigmoid); the ops-level registration above is
+    # the OpDef of record — tpulint TPU304 rejects silent shadowing
+    for mod, cat, skip in ((_act, "activation", ("sigmoid", "tanh")),
+                           (_common, "nn_common",
+                            ("one_hot", "pad", "unfold")),
+                           (_conv, "conv", ()), (_loss, "loss", ()),
+                           (_norm, "norm", ()), (_pool, "pooling", ())):
+        register_module(mod, cat, skip=skip)
     from ..nn.functional import flash_attention as _fa
     register_module(_fa, "attention")
     from ..nn.functional import vision as _vis

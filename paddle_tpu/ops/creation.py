@@ -26,6 +26,8 @@ __all__ = [
 
 
 def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """Construct a Tensor from python/numpy data with optional dtype (reference
+    paddle.to_tensor)."""
     return as_tensor(data, dtype=dtype, stop_gradient=stop_gradient)
 
 
@@ -39,16 +41,20 @@ def _shape(shape):
 
 @register("zeros", category="creation", differentiable=False)
 def zeros(shape, dtype=None, name=None):
+    """All-zeros tensor of ``shape`` (reference paddle.zeros)."""
     return Tensor(jnp.zeros(_shape(shape), dtype=convert_dtype(dtype) or float32))
 
 
 @register("ones", category="creation", differentiable=False)
 def ones(shape, dtype=None, name=None):
+    """All-ones tensor of ``shape`` (reference paddle.ones)."""
     return Tensor(jnp.ones(_shape(shape), dtype=convert_dtype(dtype) or float32))
 
 
 @register("full", category="creation", differentiable=False)
 def full(shape, fill_value, dtype=None, name=None):
+    """Tensor of ``shape`` filled with ``fill_value`` (reference paddle.full).
+    """
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
     d = convert_dtype(dtype)
@@ -58,30 +64,39 @@ def full(shape, fill_value, dtype=None, name=None):
 
 
 def zeros_like(x, dtype=None, name=None):
+    """Zeros with the shape/dtype of ``x`` (reference paddle.zeros_like)."""
     return Tensor(jnp.zeros_like(x._data if isinstance(x, Tensor) else x,
                                  dtype=convert_dtype(dtype)))
 
 
 def ones_like(x, dtype=None, name=None):
+    """Ones with the shape/dtype of ``x`` (reference paddle.ones_like)."""
     return Tensor(jnp.ones_like(x._data if isinstance(x, Tensor) else x,
                                 dtype=convert_dtype(dtype)))
 
 
 def full_like(x, fill_value, dtype=None, name=None):
+    """``fill_value`` broadcast to the shape/dtype of ``x`` (reference
+    paddle.full_like)."""
     return Tensor(jnp.full_like(x._data if isinstance(x, Tensor) else x, fill_value,
                                 dtype=convert_dtype(dtype)))
 
 
 def empty(shape, dtype=None, name=None):
+    """Uninitialized-contract tensor of ``shape`` (zero-filled on XLA)
+    (reference paddle.empty)."""
     return zeros(shape, dtype)
 
 
 def empty_like(x, dtype=None, name=None):
+    """empty() with the shape/dtype of ``x`` (reference paddle.empty_like)."""
     return zeros_like(x, dtype)
 
 
 @register("arange", category="creation", differentiable=False)
 def arange(start=0, end=None, step=1, dtype=None, name=None):
+    """Evenly spaced values in [start, end) with ``step`` (reference
+    paddle.arange)."""
     def _v(v):
         return v.item() if isinstance(v, Tensor) else v
     start, end, step = _v(start), _v(end), _v(step)
@@ -94,6 +109,8 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
 
 
 def linspace(start, stop, num, dtype=None, name=None):
+    """``num`` evenly spaced points in [start, stop] (reference
+    paddle.linspace)."""
     def _v(v):
         return v.item() if isinstance(v, Tensor) else v
     return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
@@ -101,6 +118,8 @@ def linspace(start, stop, num, dtype=None, name=None):
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    """``num`` log-spaced points between base**start and base**stop (reference
+    paddle.logspace)."""
     def _v(v):
         return v.item() if isinstance(v, Tensor) else v
     return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
@@ -108,11 +127,14 @@ def logspace(start, stop, num, base=10.0, dtype=None, name=None):
 
 
 def eye(num_rows, num_columns=None, dtype=None, name=None):
+    """Identity matrix, optionally rectangular (reference paddle.eye)."""
     return Tensor(jnp.eye(num_rows, num_columns, dtype=convert_dtype(dtype) or float32))
 
 
 @register("diag", category="creation")
 def diag(x, offset=0, padding_value=0, name=None):
+    """Build a diagonal matrix from a vector, or extract a diagonal (reference
+    paddle.diag)."""
     xt = as_tensor(x)
     def f(a):
         if a.ndim == 1:
@@ -126,21 +148,29 @@ def diag(x, offset=0, padding_value=0, name=None):
 
 
 def diagflat(x, offset=0, name=None):
+    """Flatten input then build a diagonal matrix (reference paddle.diagflat).
+    """
     xt = as_tensor(x)
     return dispatch.call("diagflat", lambda a: jnp.diagflat(a, k=offset), [xt])
 
 
 @register("tril", category="creation")
 def tril(x, diagonal=0, name=None):
+    """Lower-triangular part, zeroing above ``diagonal`` (reference
+    paddle.tril)."""
     return dispatch.call("tril", lambda a: jnp.tril(a, k=diagonal), [as_tensor(x)])
 
 
 @register("triu", category="creation")
 def triu(x, diagonal=0, name=None):
+    """Upper-triangular part, zeroing below ``diagonal`` (reference
+    paddle.triu)."""
     return dispatch.call("triu", lambda a: jnp.triu(a, k=diagonal), [as_tensor(x)])
 
 
 def meshgrid(*args, **kwargs):
+    """Coordinate grids from 1D tensors, cartesian indexing (reference
+    paddle.meshgrid)."""
     if len(args) == 1 and isinstance(args[0], (list, tuple)):
         args = args[0]
     ts = [as_tensor(a) for a in args]
@@ -149,11 +179,15 @@ def meshgrid(*args, **kwargs):
 
 
 def tril_indices(row, col, offset=0, dtype="int64"):
+    """Row/col indices of the lower triangle of an (m, n) grid (reference
+    paddle.tril_indices)."""
     r, c = np.tril_indices(row, offset, col)
     return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64"):
+    """Row/col indices of the upper triangle of an (m, n) grid (reference
+    paddle.triu_indices)."""
     r, c = np.triu_indices(row, offset, col if col is not None else row)
     return Tensor(jnp.asarray(np.stack([r, c]), dtype=convert_dtype(dtype)))
 
@@ -161,17 +195,22 @@ def triu_indices(row, col=None, offset=0, dtype="int64"):
 # ------------------------------------------------------------------- random
 @register("uniform", category="random", differentiable=False)
 def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    """Sample U[min, max) of ``shape`` from the global generator (reference
+    paddle.uniform)."""
     key = next_key() if seed == 0 else jax.random.key(seed)
     d = convert_dtype(dtype)
     return Tensor(jax.random.uniform(key, _shape(shape), dtype=d, minval=min, maxval=max))
 
 
 def rand(shape, dtype=None, name=None):
+    """Sample U[0, 1) of ``shape`` (reference paddle.rand)."""
     return uniform(shape, dtype or "float32", 0.0, 1.0)
 
 
 @register("gaussian", category="random", differentiable=False)
 def normal(mean=0.0, std=1.0, shape=None, name=None):
+    """Sample N(mean, std) of ``shape`` (reference paddle.normal; registered as
+    gaussian too)."""
     if isinstance(mean, Tensor) or isinstance(std, Tensor):
         m = as_tensor(mean) if not isinstance(mean, Tensor) else mean
         s = as_tensor(std) if not isinstance(std, Tensor) else std
@@ -186,16 +225,19 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 
 def randn(shape, dtype=None, name=None):
+    """Sample N(0, 1) of ``shape`` (reference paddle.randn)."""
     key = next_key()
     return Tensor(jax.random.normal(key, _shape(shape), dtype=convert_dtype(dtype) or float32))
 
 
 def standard_normal(shape, dtype=None, name=None):
+    """Sample N(0, 1) of ``shape`` (reference paddle.standard_normal)."""
     return randn(shape, dtype)
 
 
 @register("randint", category="random", differentiable=False)
 def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    """Uniform random integers in [low, high) (reference paddle.randint)."""
     if high is None:
         low, high = 0, low
     return Tensor(jax.random.randint(next_key(), _shape(shape), low, high,
@@ -203,15 +245,19 @@ def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
 
 
 def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """randint with the shape of ``x`` (reference paddle.randint_like)."""
     xt = as_tensor(x)
     return randint(low, high, tuple(xt.shape), dtype or xt.dtype)
 
 
 def randperm(n, dtype="int64", name=None):
+    """Random permutation of [0, n) (reference paddle.randperm)."""
     return Tensor(jax.random.permutation(next_key(), n).astype(convert_dtype(dtype)))
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
+    """Sample category indices from unnormalized row weights (reference
+    paddle.multinomial)."""
     xt = as_tensor(x)
     key = next_key()
     def f(p):
@@ -227,6 +273,8 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
 
 
 def bernoulli(x, name=None):
+    """Sample {0,1} with per-element probability ``x`` (reference
+    paddle.bernoulli)."""
     xt = as_tensor(x)
     key = next_key()
     return dispatch.call("bernoulli",
@@ -234,6 +282,8 @@ def bernoulli(x, name=None):
 
 
 def poisson(x, name=None):
+    """Sample Poisson with per-element rate ``x`` (reference paddle.poisson).
+    """
     xt = as_tensor(x)
     key = next_key()
     return dispatch.call("poisson",
@@ -241,6 +291,8 @@ def poisson(x, name=None):
 
 
 def exponential_(x, lam=1.0, name=None):
+    """In-place exponential(lam) resample of ``x`` (reference
+    Tensor.exponential_)."""
     key = next_key()
     new = jax.random.exponential(key, tuple(x.shape), dtype=x._data.dtype) / lam
     x._swap_payload(new)
@@ -249,16 +301,21 @@ def exponential_(x, lam=1.0, name=None):
 
 @register("one_hot", category="creation", differentiable=False)
 def one_hot(x, num_classes, name=None):
+    """Expand int labels to one-hot vectors of ``num_classes`` (reference
+    paddle.nn.functional.one_hot)."""
     return dispatch.call("one_hot",
                          lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32),
                          [as_tensor(x)])
 
 
 def clone(x, name=None):
+    """Copy preserving autograd history (reference paddle.clone)."""
     return dispatch.call("clone", lambda a: a + 0, [as_tensor(x)])
 
 
 def assign(x, output=None):
+    """Copy input values into a (new or provided) tensor (reference
+    paddle.assign)."""
     xt = as_tensor(x)
     out = dispatch.call("assign", lambda a: a + 0, [xt])
     if output is not None:
@@ -268,10 +325,13 @@ def assign(x, output=None):
 
 
 def complex(real, imag, name=None):
+    """Build complex tensor from real and imaginary parts (reference
+    paddle.complex)."""
     return dispatch.call("complex", jax.lax.complex, [as_tensor(real), as_tensor(imag)])
 
 
 def polar(abs, angle, name=None):
+    """abs * exp(i*angle) complex tensor (reference paddle.polar)."""
     return dispatch.call("polar",
                          lambda r, t: jax.lax.complex(r * jnp.cos(t), r * jnp.sin(t)),
                          [as_tensor(abs), as_tensor(angle)])

@@ -34,6 +34,8 @@ def _precision():
 
 @register("matmul", category="linalg")
 def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Batched matrix product with broadcasting and transpose flags; MXU-native
+    (reference paddle.matmul)."""
     prec = _precision()
     def f(a, b):
         if transpose_x:
@@ -45,12 +47,14 @@ def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
 
 
 def mm(x, y, name=None):
+    """Non-broadcasting matrix multiply (reference paddle.mm)."""
     return matmul(x, y)
 
 
 def bmm(x, y, name=None):
     # read the flag OUTSIDE the lowering: a flag read inside would be
     # baked into the eager-jit cache's compiled program and go stale
+    """Batched 3D matrix multiply (reference paddle.bmm)."""
     prec = _precision()
     return dispatch.call("bmm",
                          lambda a, b: jnp.matmul(a, b, precision=prec),
@@ -59,22 +63,27 @@ def bmm(x, y, name=None):
 
 @register("dot", category="linalg")
 def dot(x, y, name=None):
+    """1D/2D-batch dot product over the last axis (reference paddle.dot)."""
     return dispatch.call("dot", lambda a, b: jnp.sum(a * b, axis=-1), [_t(x), _t(y)])
 
 
 def inner(x, y, name=None):
+    """Inner product over trailing dims (reference paddle.inner)."""
     return dispatch.call("inner", jnp.inner, [_t(x), _t(y)])
 
 
 def outer(x, y, name=None):
+    """Outer product of flattened inputs (reference paddle.outer)."""
     return dispatch.call("outer", lambda a, b: jnp.outer(a, b), [_t(x), _t(y)])
 
 
 def mv(x, vec, name=None):
+    """Matrix-vector product (reference paddle.mv)."""
     return dispatch.call("mv", lambda a, v: jnp.matmul(a, v), [_t(x), _t(vec)])
 
 
 def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (reference paddle.addmm)."""
     prec = _precision()
     return dispatch.call("addmm",
                          lambda i, a, b: beta * i + alpha * jnp.matmul(
@@ -84,6 +93,7 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 
 @register("einsum", category="linalg")
 def einsum(equation, *operands):
+    """Einstein summation over named subscripts (reference paddle.einsum)."""
     ts = [_t(o) for o in operands]
     prec = _precision()
     return dispatch.call("einsum",
@@ -92,6 +102,7 @@ def einsum(equation, *operands):
 
 
 def t(x, name=None):
+    """Transpose a 0/1/2-D tensor (reference paddle.t)."""
     xt = _t(x)
     if xt.ndim < 2:
         return xt
@@ -99,11 +110,15 @@ def t(x, name=None):
 
 
 def matrix_transpose(x, name=None):
+    """Swap the trailing two dims (reference paddle.linalg.matrix_transpose).
+    """
     return dispatch.call("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2), [_t(x)])
 
 
 @register("p_norm", category="linalg")
 def norm(x, p=None, axis=None, keepdim=False, name=None):
+    """Matrix/vector norm: fro, nuc, p-norms, along optional axis (reference
+    paddle.linalg.norm; p_norm alias)."""
     xt = _t(x)
     def f(a):
         if p is None or p == "fro":
@@ -134,16 +149,20 @@ def _ax(axis):
 
 
 def dist(x, y, p=2, name=None):
+    """p-norm of (x - y) (reference paddle.dist)."""
     return norm(dispatch.call("sub", jnp.subtract, [_t(x), _t(y)]), p=p)
 
 
 def cross(x, y, axis=9, name=None):
+    """3-element cross product along ``axis`` (reference paddle.cross)."""
     xt = _t(x)
     ax = axis if axis != 9 else next(i for i, s in enumerate(xt.shape) if s == 3)
     return dispatch.call("cross", lambda a, b: jnp.cross(a, b, axis=ax), [xt, _t(y)])
 
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    """Fixed-bin histogram counts over [min, max] (reference paddle.histogram).
+    """
     xt = _t(input)
     arr = np.asarray(xt._data)
     lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
@@ -153,6 +172,8 @@ def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=No
 
 
 def bincount(x, weights=None, minlength=0, name=None):
+    """Count occurrences of each non-negative int, optional weights (reference
+    paddle.bincount)."""
     xt = _t(x)
     n = builtins_max(int(np.asarray(xt._data).max(initial=-1)) + 1, minlength)
     if weights is not None:
@@ -168,10 +189,13 @@ builtins_max = builtins.max
 
 
 def matrix_power(x, n, name=None):
+    """Integer matrix power via repeated squaring; negative uses inverse
+    (reference paddle.linalg.matrix_power)."""
     return dispatch.call("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), [_t(x)])
 
 
 def cholesky(x, upper=False, name=None):
+    """Cholesky factor of an SPD matrix (reference paddle.linalg.cholesky)."""
     def f(a):
         l = jnp.linalg.cholesky(a)
         return jnp.swapaxes(l, -1, -2) if upper else l
@@ -179,6 +203,8 @@ def cholesky(x, upper=False, name=None):
 
 
 def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A x = b given A's Cholesky factor (reference
+    paddle.linalg.cholesky_solve)."""
     def f(b, l):
         lo = jnp.swapaxes(l, -1, -2) if upper else l
         z = jax.scipy.linalg.solve_triangular(lo, b, lower=True)
@@ -187,19 +213,24 @@ def cholesky_solve(x, y, upper=False, name=None):
 
 
 def inverse(x, name=None):
+    """Matrix inverse (reference paddle.inverse)."""
     return dispatch.call("inverse", jnp.linalg.inv, [_t(x)])
 
 
 def det(x, name=None):
+    """Determinant of square matrices (reference paddle.linalg.det)."""
     return dispatch.call("det", jnp.linalg.det, [_t(x)])
 
 
 def slogdet(x, name=None):
+    """(sign, log|det|) of square matrices (reference paddle.linalg.slogdet).
+    """
     outs = dispatch.call("slogdet", lambda a: tuple(jnp.linalg.slogdet(a)), [_t(x)])
     return outs
 
 
 def svd(x, full_matrices=False, name=None):
+    """Singular value decomposition U, S, Vh (reference paddle.linalg.svd)."""
     outs = dispatch.call("svd",
                          lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
                          [_t(x)])
@@ -207,11 +238,13 @@ def svd(x, full_matrices=False, name=None):
 
 
 def qr(x, mode="reduced", name=None):
+    """QR decomposition, reduced or complete (reference paddle.linalg.qr)."""
     outs = dispatch.call("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), [_t(x)])
     return outs
 
 
 def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization with pivots (reference paddle.linalg.lu)."""
     xt = _t(x)
     lu_, piv = jax.scipy.linalg.lu_factor(xt._data)
     outs = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
@@ -221,31 +254,40 @@ def lu(x, pivot=True, get_infos=False, name=None):
 
 
 def eig(x, name=None):
+    """Eigenpairs of a general matrix (host LAPACK path: XLA has no general
+    eig) (reference paddle.linalg.eig)."""
     arr = np.asarray(_t(x)._data)  # CPU fallback: general eig not on TPU
     w, v = np.linalg.eig(arr)
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
 
 
 def eigh(x, UPLO="L", name=None):
+    """Eigenpairs of a hermitian matrix (reference paddle.linalg.eigh)."""
     outs = dispatch.call("eigh",
                          lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), [_t(x)])
     return outs
 
 
 def eigvals(x, name=None):
+    """Eigenvalues of a general matrix (host LAPACK path) (reference
+    paddle.linalg.eigvals)."""
     arr = np.asarray(_t(x)._data)
     return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
 
 
 def eigvalsh(x, UPLO="L", name=None):
+    """Eigenvalues of a hermitian matrix (reference paddle.linalg.eigvalsh)."""
     return dispatch.call("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), [_t(x)])
 
 
 def solve(x, y, name=None):
+    """Solve the linear system A x = b (reference paddle.linalg.solve)."""
     return dispatch.call("solve", jnp.linalg.solve, [_t(x), _t(y)])
 
 
 def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    """Solve with a triangular coefficient matrix (reference
+    paddle.linalg.triangular_solve)."""
     def f(a, b):
         a2 = jnp.swapaxes(a, -1, -2) if transpose else a
         return jax.scipy.linalg.solve_triangular(
@@ -254,35 +296,45 @@ def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, nam
 
 
 def lstsq(x, y, rcond=None, driver=None, name=None):
+    """Least-squares solution to A x = b (reference paddle.linalg.lstsq)."""
     outs = jnp.linalg.lstsq(_t(x)._data, _t(y)._data, rcond=rcond)
     return tuple(Tensor(o) for o in outs)
 
 
 def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    """Moore-Penrose pseudo-inverse via SVD (reference paddle.linalg.pinv)."""
     return dispatch.call("pinv",
                          lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), [_t(x)])
 
 
 def matrix_rank(x, tol=None, hermitian=False, name=None):
+    """Rank from singular values above tolerance (reference
+    paddle.linalg.matrix_rank)."""
     return dispatch.call("matrix_rank",
                          lambda a: jnp.linalg.matrix_rank(a, rtol=tol), [_t(x)])
 
 
 def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    """Covariance matrix of row/column observations (reference
+    paddle.linalg.cov)."""
     return dispatch.call("cov",
                          lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), [_t(x)])
 
 
 def corrcoef(x, rowvar=True, name=None):
+    """Pearson correlation matrix (reference paddle.linalg.corrcoef)."""
     return dispatch.call("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), [_t(x)])
 
 
 def multi_dot(tensors, name=None):
+    """Chained matrix product with optimal association order (reference
+    paddle.linalg.multi_dot)."""
     ts = [_t(v) for v in tensors]
     return dispatch.call("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), ts)
 
 
 def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    """Pairwise p-norm distances between row sets (reference paddle.cdist)."""
     def f(a, b):
         diff = a[..., :, None, :] - b[..., None, :, :]
         if p == 2.0:
@@ -292,11 +344,14 @@ def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=
 
 
 def vander(x, n=None, increasing=False, name=None):
+    """Vandermonde matrix of a vector (reference paddle.vander)."""
     return dispatch.call("vander",
                          lambda a: jnp.vander(a, N=n, increasing=increasing), [_t(x)])
 
 
 def householder_product(x, tau, name=None):
+    """Accumulate Householder reflectors into Q (reference
+    paddle.linalg.householder_product)."""
     def f(a, t_):
         m, n = a.shape[-2], a.shape[-1]
         q = jnp.eye(m, dtype=a.dtype)
@@ -311,16 +366,20 @@ def householder_product(x, tau, name=None):
 
 
 def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    """Sum of a diagonal, with offset (reference paddle.trace)."""
     return dispatch.call("trace",
                          lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
                          [_t(x)])
 
 
 def rank(x):
+    """Number of dimensions of the tensor (reference paddle.rank)."""
     return Tensor(jnp.asarray(_t(x).ndim, dtype=jnp.int32))
 
 
 def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Truncated PCA via randomized low-rank SVD (reference
+    paddle.linalg.pca_lowrank)."""
     xt = _t(x)
     qq = q or builtins_max(1, min(6, *xt.shape[-2:]))
     def f(a):

@@ -42,11 +42,14 @@ def _norm_shape(shape):
 
 @register("reshape", category="manipulation")
 def reshape(x, shape, name=None):
+    """View with a new shape, one dim inferrable as -1 (reference
+    paddle.reshape)."""
     shape = _norm_shape(shape)
     return dispatch.call("reshape", lambda a: jnp.reshape(a, shape), [_t(x)])
 
 
 def reshape_(x, shape, name=None):
+    """In-place reshape: swaps the payload view (reference paddle.reshape_)."""
     out = reshape(x, shape)
     x._swap_payload(out._data)
     x.grad_node, x.output_index, x.stop_gradient = out.grad_node, out.output_index, out.stop_gradient
@@ -54,6 +57,8 @@ def reshape_(x, shape, name=None):
 
 
 def view(x, shape_or_dtype, name=None):
+    """Reinterpret shape (or dtype) without copy semantics (reference
+    paddle.view)."""
     if isinstance(shape_or_dtype, (list, tuple)):
         return reshape(x, shape_or_dtype)
     d = convert_dtype(shape_or_dtype)
@@ -61,11 +66,14 @@ def view(x, shape_or_dtype, name=None):
 
 
 def view_as(x, other, name=None):
+    """view() to the shape of ``other`` (reference paddle.view_as)."""
     return reshape(x, other.shape)
 
 
 @register("flatten", category="manipulation")
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    """Collapse dims [start_axis, stop_axis] into one (reference
+    paddle.flatten)."""
     xt = _t(x)
     nd = xt.ndim
     s = start_axis % nd if nd else 0
@@ -81,6 +89,7 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
 
 @register("squeeze", category="manipulation")
 def squeeze(x, axis=None, name=None):
+    """Drop size-1 dims, all or listed (reference paddle.squeeze)."""
     xt = _t(x)
     if axis is None:
         ax = None
@@ -91,6 +100,7 @@ def squeeze(x, axis=None, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
+    """In-place squeeze (reference paddle.squeeze_)."""
     out = squeeze(x, axis)
     x._swap_payload(out._data)
     x.grad_node, x.output_index = out.grad_node, out.output_index
@@ -99,12 +109,14 @@ def squeeze_(x, axis=None, name=None):
 
 @register("unsqueeze", category="manipulation")
 def unsqueeze(x, axis, name=None):
+    """Insert size-1 dims at ``axis`` (reference paddle.unsqueeze)."""
     axes = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
     axes = tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axes)
     return dispatch.call("unsqueeze", lambda a: jnp.expand_dims(a, axes), [_t(x)])
 
 
 def unsqueeze_(x, axis, name=None):
+    """In-place unsqueeze (reference paddle.unsqueeze_)."""
     out = unsqueeze(x, axis)
     x._swap_payload(out._data)
     x.grad_node, x.output_index = out.grad_node, out.output_index
@@ -113,6 +125,7 @@ def unsqueeze_(x, axis, name=None):
 
 @register("transpose", category="manipulation")
 def transpose(x, perm=None, name=None):
+    """Permute dims by ``perm`` (reference paddle.transpose)."""
     xt = _t(x)
     if perm is None:
         perm = tuple(reversed(range(xt.ndim)))
@@ -121,15 +134,19 @@ def transpose(x, perm=None, name=None):
 
 
 def moveaxis(x, source, destination, name=None):
+    """Move dims from source to destination positions (reference
+    paddle.moveaxis)."""
     return dispatch.call("moveaxis", lambda a: jnp.moveaxis(a, source, destination), [_t(x)])
 
 
 def swapaxes(x, axis0, axis1, name=None):
+    """Exchange two dims (reference paddle.swapaxes)."""
     return dispatch.call("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), [_t(x)])
 
 
 @register("concat", category="manipulation")
 def concat(x: Sequence, axis=0, name=None):
+    """Join tensors along an existing axis (reference paddle.concat)."""
     ts = [_t(v) for v in x]
     if isinstance(axis, Tensor):
         axis = int(axis.item())
@@ -138,11 +155,14 @@ def concat(x: Sequence, axis=0, name=None):
 
 @register("stack", category="manipulation")
 def stack(x: Sequence, axis=0, name=None):
+    """Join tensors along a NEW axis (reference paddle.stack)."""
     ts = [_t(v) for v in x]
     return dispatch.call("stack", lambda *xs: jnp.stack(xs, axis=axis), ts)
 
 
 def unstack(x, axis=0, num=None, name=None):
+    """Split along ``axis`` into that dim's tensors (reference paddle.unstack).
+    """
     xt = _t(x)
     n = num or xt.shape[axis]
     outs = dispatch.call(
@@ -154,6 +174,8 @@ def unstack(x, axis=0, num=None, name=None):
 
 @register("split", category="manipulation")
 def split(x, num_or_sections, axis=0, name=None):
+    """Split into sections (count or sizes) along ``axis`` (reference
+    paddle.split)."""
     xt = _t(x)
     if isinstance(axis, Tensor):
         axis = int(axis.item())
@@ -173,6 +195,8 @@ def split(x, num_or_sections, axis=0, name=None):
 
 
 def tensor_split(x, num_or_indices, axis=0, name=None):
+    """Split into n parts allowing uneven tails (reference
+    paddle.tensor_split)."""
     xt = _t(x)
     outs = dispatch.call("tensor_split",
                          lambda a: tuple(jnp.array_split(a, num_or_indices, axis=axis)), [xt])
@@ -180,21 +204,28 @@ def tensor_split(x, num_or_indices, axis=0, name=None):
 
 
 def chunk(x, chunks, axis=0, name=None):
+    """Split into ``chunks`` equal parts along ``axis`` (reference
+    paddle.chunk)."""
     return split(x, chunks, axis)
 
 
 def unbind(x, axis=0, name=None):
+    """Remove ``axis`` and return its slices (reference paddle.unbind)."""
     return unstack(x, axis)
 
 
 @register("tile", category="manipulation")
 def tile(x, repeat_times, name=None):
+    """Repeat the whole tensor per-dim ``repeat_times`` (reference
+    paddle.tile)."""
     reps = _norm_shape(repeat_times)
     return dispatch.call("tile", lambda a: jnp.tile(a, reps), [_t(x)])
 
 
 @register("expand", category="manipulation")
 def expand(x, shape, name=None):
+    """Broadcast size-1 dims up to ``shape`` without copying semantics
+    (reference paddle.expand)."""
     xt = _t(x)
     shape = list(_norm_shape(shape))
     cur = [1] * (len(shape) - xt.ndim) + list(xt.shape)
@@ -203,14 +234,18 @@ def expand(x, shape, name=None):
 
 
 def expand_as(x, y, name=None):
+    """Broadcast ``x`` to the shape of ``y`` (reference paddle.expand_as)."""
     return expand(x, y.shape)
 
 
 def broadcast_to(x, shape, name=None):
+    """Broadcast to an explicit ``shape`` (reference paddle.broadcast_to)."""
     return expand(x, shape)
 
 
 def broadcast_tensors(inputs, name=None):
+    """Broadcast a list of tensors to their common shape (reference
+    paddle.broadcast_tensors)."""
     ts = [_t(v) for v in inputs]
     outs = dispatch.call("broadcast_tensors",
                          lambda *xs: tuple(jnp.broadcast_arrays(*xs)), ts)
@@ -219,20 +254,26 @@ def broadcast_tensors(inputs, name=None):
 
 @register("flip", category="manipulation")
 def flip(x, axis, name=None):
+    """Reverse order along listed axes (reference paddle.flip)."""
     ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
     return dispatch.call("flip", lambda a: jnp.flip(a, axis=ax), [_t(x)])
 
 
 def rot90(x, k=1, axes=(0, 1), name=None):
+    """Rotate in the plane of two axes by k*90 degrees (reference
+    paddle.rot90)."""
     return dispatch.call("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), [_t(x)])
 
 
 @register("roll", category="manipulation")
 def roll(x, shifts, axis=None, name=None):
+    """Circularly shift elements along axes (reference paddle.roll)."""
     return dispatch.call("roll", lambda a: jnp.roll(a, shifts, axis=axis), [_t(x)])
 
 
 def repeat_interleave(x, repeats, axis=None, name=None):
+    """Repeat each element ``repeats`` times along ``axis`` (reference
+    paddle.repeat_interleave)."""
     if isinstance(repeats, Tensor):
         reps = np.asarray(repeats._data)
         return dispatch.call("repeat_interleave",
@@ -244,6 +285,8 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 # ----------------------------------------------------------- gather/scatter
 @register("gather", category="indexing")
 def gather(x, index, axis=0, name=None):
+    """Select rows of ``x`` by 1D ``index`` along ``axis`` (reference
+    paddle.gather)."""
     if isinstance(axis, Tensor):
         axis = int(axis.item())
     return dispatch.call("gather", lambda a, i: jnp.take(a, i.astype(jnp.int32), axis=axis),
@@ -252,6 +295,7 @@ def gather(x, index, axis=0, name=None):
 
 @register("gather_nd", category="indexing")
 def gather_nd(x, index, name=None):
+    """Gather slices by multi-dim index tuples (reference paddle.gather_nd)."""
     def f(a, idx):
         idx = idx.astype(jnp.int32)
         k = idx.shape[-1]
@@ -263,6 +307,8 @@ def gather_nd(x, index, name=None):
 
 @register("scatter", category="indexing")
 def scatter(x, index, updates, overwrite=True, name=None):
+    """Write ``updates`` rows into ``x`` at ``index`` (overwrite or add)
+    (reference paddle.scatter)."""
     def f(a, idx, upd):
         idx = idx.astype(jnp.int32).reshape(-1)
         if overwrite:
@@ -275,6 +321,8 @@ def scatter(x, index, updates, overwrite=True, name=None):
 
 @register("scatter_nd_add", category="indexing")
 def scatter_nd_add(x, index, updates, name=None):
+    """Add ``updates`` into zeros/x at multi-dim indices (reference
+    paddle.scatter_nd_add)."""
     def f(a, idx, upd):
         idx = idx.astype(jnp.int32)
         return a.at[tuple(jnp.moveaxis(idx, -1, 0))].add(upd)
@@ -288,12 +336,16 @@ def scatter_nd(index, updates, shape, name=None):
 
 
 def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    """Gather values along an axis by same-rank index (reference
+    paddle.take_along_axis)."""
     return dispatch.call("take_along_axis",
                          lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=axis),
                          [_t(arr), _t(indices)], differentiable_mask=[True, False])
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    """Scatter values along an axis by index (assign/add/mul reduce) (reference
+    paddle.put_along_axis)."""
     def f(a, i, v):
         i = i.astype(jnp.int32)
         v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
@@ -316,10 +368,14 @@ def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
 
 @register("index_select", category="indexing")
 def index_select(x, index, axis=0, name=None):
+    """Select entries along ``axis`` by 1D index (reference
+    paddle.index_select)."""
     return gather(x, index, axis)
 
 
 def index_sample(x, index, name=None):
+    """Per-row gather: out[i, j] = x[i, index[i, j]] (reference
+    paddle.index_sample)."""
     return dispatch.call(
         "index_sample",
         lambda a, i: jnp.take_along_axis(a, i.astype(jnp.int32), axis=1),
@@ -327,6 +383,8 @@ def index_sample(x, index, name=None):
 
 
 def index_add(x, index, axis, value, name=None):
+    """Add ``value`` rows at ``index`` along ``axis`` (reference
+    paddle.index_add)."""
     def f(a, i, v):
         i = i.astype(jnp.int32)
         a_m = jnp.moveaxis(a, axis, 0)
@@ -338,6 +396,8 @@ def index_add(x, index, axis, value, name=None):
 
 
 def index_put(x, indices, value, accumulate=False, name=None):
+    """Scatter values at a tuple of index tensors (reference paddle.index_put).
+    """
     idx_ts = [_t(i) for i in indices]
     def f(a, v, *idx):
         idx = tuple(i.astype(jnp.int32) if np.issubdtype(np.dtype(i.dtype), np.integer)
@@ -350,6 +410,8 @@ def index_put(x, indices, value, accumulate=False, name=None):
 
 
 def take(x, index, mode="raise", name=None):
+    """Gather from the FLATTENED tensor by integer index, with mode (reference
+    paddle.take)."""
     return dispatch.call("take",
                          lambda a, i: jnp.take(a.reshape(-1), i.astype(jnp.int32),
                                                mode="clip" if mode == "clip" else "wrap"),
@@ -360,12 +422,16 @@ def take(x, index, mode="raise", name=None):
 def masked_select(x, mask, name=None):
     # Dynamic output size — host-side (not jit-capturable; reference kernel is
     # likewise dynamic). Returns a 1-D tensor of the selected elements.
+    """1D tensor of elements where mask is True (host path: dynamic output
+    shape) (reference paddle.masked_select)."""
     xt, mt = _t(x), _t(mask)
     data = np.asarray(xt._data)[np.asarray(mt._data).astype(bool)]
     return Tensor(jnp.asarray(data))
 
 
 def masked_fill(x, mask, value, name=None):
+    """Set elements where mask is True to ``value`` (reference
+    paddle.masked_fill)."""
     v = value.item() if isinstance(value, Tensor) else value
     return dispatch.call("masked_fill",
                          lambda a, m: jnp.where(m.astype(bool), jnp.asarray(v, dtype=a.dtype), a),
@@ -379,6 +445,7 @@ builtins_slice = builtins.slice
 
 @register("slice", category="manipulation")
 def slice(x, axes, starts, ends, name=None):
+    """Extract [starts, ends) along ``axes`` (reference paddle.slice)."""
     xt = _t(x)
     sl = [builtins_slice(None)] * xt.ndim
     for ax, st, en in zip(axes, starts, ends):
@@ -390,6 +457,8 @@ def slice(x, axes, starts, ends, name=None):
 
 
 def strided_slice(x, axes, starts, ends, strides, name=None):
+    """Slice with explicit strides per axis (reference paddle.strided_slice).
+    """
     xt = _t(x)
     sl = [builtins_slice(None)] * xt.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
@@ -399,6 +468,7 @@ def strided_slice(x, axes, starts, ends, strides, name=None):
 
 
 def crop(x, shape=None, offsets=None, name=None):
+    """Crop a box of ``shape`` at ``offsets`` (reference paddle.crop)."""
     xt = _t(x)
     shape = _norm_shape(shape)
     offsets = _norm_shape(offsets) if offsets is not None else (0,) * xt.ndim
@@ -408,6 +478,8 @@ def crop(x, shape=None, offsets=None, name=None):
 
 
 def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    """Write ``value`` into a strided slice (reference paddle.slice_scatter).
+    """
     def f(a, v):
         sl = [builtins_slice(None)] * a.ndim
         for ax, st, en, sd in zip(axes, starts, ends, strides):
@@ -417,6 +489,8 @@ def slice_scatter(x, value, axes, starts, ends, strides, name=None):
 
 
 def select_scatter(x, value, axis, index, name=None):
+    """Write ``values`` into one index of ``axis`` (reference
+    paddle.select_scatter)."""
     def f(a, v):
         sl = [builtins_slice(None)] * a.ndim
         sl[axis] = index
@@ -426,6 +500,8 @@ def select_scatter(x, value, axis, index, name=None):
 
 @register("pad", category="manipulation")
 def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """Pad by widths with constant/reflect/replicate/circular modes (reference
+    paddle.nn.functional.pad)."""
     xt = _t(x)
     pad = _norm_shape(pad)
     nd = xt.ndim
@@ -447,10 +523,13 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
 
 
 def numel(x, name=None):
+    """Scalar tensor holding the element count (reference paddle.numel)."""
     return Tensor(jnp.asarray(_t(x).size, dtype=jnp.int64))
 
 
 def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Remap global ids to shard-local ids, ignore_value elsewhere (reference
+    paddle.shard_index)."""
     def f(a):
         size = index_num // nshards
         shard = a // size
@@ -460,12 +539,16 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 
 
 def as_real(x, name=None):
+    """View complex as trailing [real, imag] float pairs (reference
+    paddle.as_real)."""
     def f(a):
         return jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1)
     return dispatch.call("as_real", f, [_t(x)])
 
 
 def as_complex(x, name=None):
+    """View trailing [real, imag] float pairs as complex (reference
+    paddle.as_complex)."""
     return dispatch.call("as_complex",
                          lambda a: jax.lax.complex(a[..., 0], a[..., 1]), [_t(x)])
 
@@ -487,32 +570,41 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
 
 
 def tensordot(x, y, axes=2, name=None):
+    """Generalized dot contracting the listed axes (reference
+    paddle.tensordot)."""
     return dispatch.call("tensordot", lambda a, b: jnp.tensordot(a, b, axes=axes),
                          [_t(x), _t(y)])
 
 
 def atleast_1d(*inputs, name=None):
+    """Promote inputs to at least 1 dim (reference paddle.atleast_1d)."""
     outs = [dispatch.call("atleast_1d", jnp.atleast_1d, [_t(v)]) for v in inputs]
     return outs[0] if len(outs) == 1 else outs
 
 
 def atleast_2d(*inputs, name=None):
+    """Promote inputs to at least 2 dims (reference paddle.atleast_2d)."""
     outs = [dispatch.call("atleast_2d", jnp.atleast_2d, [_t(v)]) for v in inputs]
     return outs[0] if len(outs) == 1 else outs
 
 
 def atleast_3d(*inputs, name=None):
+    """Promote inputs to at least 3 dims (reference paddle.atleast_3d)."""
     outs = [dispatch.call("atleast_3d", jnp.atleast_3d, [_t(v)]) for v in inputs]
     return outs[0] if len(outs) == 1 else outs
 
 
 def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    """Extract a diagonal between two axes with offset (reference
+    paddle.diagonal)."""
     return dispatch.call("diagonal",
                          lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2),
                          [_t(x)])
 
 
 def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    """Embed the last dim as diagonals of new trailing 2D planes (reference
+    paddle.diag_embed)."""
     def f(a):
         out = jnp.zeros(a.shape + (a.shape[-1],), dtype=a.dtype)
         idx = jnp.arange(a.shape[-1])
@@ -523,4 +615,5 @@ def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
 
 
 def kron(x, y, name=None):
+    """Kronecker product (reference paddle.kron)."""
     return dispatch.call("kron", jnp.kron, [_t(x), _t(y)])

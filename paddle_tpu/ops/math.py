@@ -43,11 +43,15 @@ def _unary(name, jfn, x, **attrs):
 
 
 def _make_binary(name, jfn, aliases=()):
-    @register(name, category="math")
     def op(x, y, name_=None):
         return _binary(name, jfn, x, y)
     op.__name__ = name
     op.__qualname__ = name
+    jdoc = getattr(jfn, "__name__", str(jfn))
+    op.__doc__ = (f"Elementwise ``{name}(x, y)`` with numpy broadcasting "
+                  f"(jnp.{jdoc} lowering, XLA-fused; reference "
+                  f"paddle.{name}).")
+    register(name, category="math")(op)
     _export(op)
     g = globals()
     g[name] = op
@@ -58,11 +62,14 @@ def _make_binary(name, jfn, aliases=()):
 
 
 def _make_unary(name, jfn, aliases=(), differentiable=True):
-    @register(name, category="math", differentiable=differentiable)
     def op(x, name_=None):
         return _unary(name, jfn, x)
     op.__name__ = name
     op.__qualname__ = name
+    jdoc = getattr(jfn, "__name__", str(jfn))
+    op.__doc__ = (f"Elementwise ``{name}(x)`` (jnp.{jdoc} lowering, "
+                  f"XLA-fused; reference paddle.{name}).")
+    register(name, category="math", differentiable=differentiable)(op)
     _export(op)
     g = globals()
     g[name] = op
@@ -177,6 +184,7 @@ def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
 @register("clip", category="math")
 @_export
 def clip(x, min=None, max=None, name=None):
+    """Clamp to [min, max]; tensor bounds allowed (reference paddle.clip)."""
     if isinstance(min, Tensor) or isinstance(max, Tensor):
         mins = min if isinstance(min, Tensor) else _t(min if min is not None else -np.inf)
         maxs = max if isinstance(max, Tensor) else _t(max if max is not None else np.inf)
@@ -187,6 +195,7 @@ def clip(x, min=None, max=None, name=None):
 @register("lerp", category="math")
 @_export
 def lerp(x, y, weight, name=None):
+    """x + weight * (y - x) (reference paddle.lerp)."""
     if isinstance(weight, Tensor):
         return dispatch.call("lerp", lambda a, b, w: a + w * (b - a), [_t(x), _t(y), weight])
     return dispatch.call("lerp", lambda a, b: a + weight * (b - a), [_t(x), _t(y)])
@@ -195,12 +204,15 @@ def lerp(x, y, weight, name=None):
 @register("stanh", category="math")
 @_export
 def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """scale_b * tanh(scale_a * x) (reference paddle.stanh)."""
     return dispatch.call("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), [_t(x)])
 
 
 @register("multiplex", category="math")
 @_export
 def multiplex(inputs, index, name=None):
+    """Per-row select from a list of tensors by ``index`` (reference
+    paddle.multiplex)."""
     ts = [_t(i) for i in inputs] + [_t(index)]
     def f(*args):
         *xs, idx = args
@@ -214,6 +226,8 @@ def multiplex(inputs, index, name=None):
 @register("isclose", category="math", differentiable=False)
 @_export
 def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    """Elementwise |x-y| <= atol + rtol*|y| with NaN handling (reference
+    paddle.isclose)."""
     return dispatch.call("isclose",
                          lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
                          [_t(x), _t(y)])
@@ -222,6 +236,7 @@ def isclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
 @register("allclose", category="math", differentiable=False)
 @_export
 def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
+    """Scalar: all elements isclose (reference paddle.allclose)."""
     return dispatch.call("allclose",
                          lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
                          [_t(x), _t(y)])
@@ -230,12 +245,16 @@ def allclose(x, y, rtol=1e-5, atol=1e-8, equal_nan=False, name=None):
 @register("equal_all", category="math", differentiable=False)
 @_export
 def equal_all(x, y, name=None):
+    """Scalar: exact elementwise equality of whole tensors (reference
+    paddle.equal_all)."""
     return dispatch.call("equal_all", lambda a, b: jnp.array_equal(a, b), [_t(x), _t(y)])
 
 
 @register("nan_to_num", category="math")
 @_export
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    """Replace NaN/inf with finite substitutes (reference paddle.nan_to_num).
+    """
     return dispatch.call("nan_to_num",
                          lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf),
                          [_t(x)])
@@ -244,6 +263,8 @@ def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
 @register("trapezoid", category="math")
 @_export
 def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal-rule integral along ``axis`` (reference paddle.trapezoid).
+    """
     if x is not None:
         return dispatch.call("trapezoid",
                              lambda yy, xx: jax.scipy.integrate.trapezoid(yy, xx, axis=axis),
@@ -256,6 +277,8 @@ def trapezoid(y, x=None, dx=None, axis=-1, name=None):
 @register("diff", category="math")
 @_export
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    """n-th forward difference along ``axis`` with prepend/append (reference
+    paddle.diff)."""
     ins = [_t(x)]
     def f(a, *rest):
         pre = rest[0] if prepend is not None else None
@@ -271,6 +294,8 @@ def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
 @register("cast", category="math")
 @_export
 def cast(x, dtype):
+    """Convert to ``dtype``; vjp casts cotangents back (reference paddle.cast).
+    """
     from ..core.dtype import convert_dtype
     d = convert_dtype(dtype)
     xt = _t(x)
@@ -292,22 +317,14 @@ def polygamma(x, n, name=None):
                          lambda a: jsp.polygamma(n, a), [_t(x)])
 
 
-def i0(x, name=None):
-    import jax.scipy.special as jsp
-    return dispatch.call("i0", jsp.i0, [_t(x)])
-
-
 def i0e(x, name=None):
+    """Exponentially scaled modified Bessel I0 (reference paddle.i0e)."""
     import jax.scipy.special as jsp
     return dispatch.call("i0e", jsp.i0e, [_t(x)])
 
 
-def i1(x, name=None):
-    import jax.scipy.special as jsp
-    return dispatch.call("i1", jsp.i1, [_t(x)])
-
-
 def i1e(x, name=None):
+    """Exponentially scaled modified Bessel I1 (reference paddle.i1e)."""
     import jax.scipy.special as jsp
     return dispatch.call("i1e", jsp.i1e, [_t(x)])
 
@@ -357,10 +374,6 @@ def fill_diagonal(x, value, offset=0, wrap=False, name=None):
         i = jnp.arange(n)
         return a.at[..., i - offset, i].set(value)
     return dispatch.call("fill_diagonal", f, [_t(x)])
-
-
-def logaddexp(x, y, name=None):
-    return dispatch.call("logaddexp", jnp.logaddexp, [_t(x), _t(y)])
 
 
 def gammainc(x, y, name=None):
@@ -421,6 +434,7 @@ def reduce_as(x, target, name=None):
     return dispatch.call("reduce_as", f, [x])
 
 
-__all__ += ["gammaln", "polygamma", "i0", "i0e", "i1", "i1e",
-            "increment", "renorm", "fill_diagonal", "logaddexp",
+# i0 / i1 / logaddexp are factory-registered above (_make_unary/_make_binary)
+__all__ += ["gammaln", "polygamma", "i0e", "i1e",
+            "increment", "renorm", "fill_diagonal",
             "gammainc", "gammaincc", "fill_diagonal_tensor", "reduce_as"]

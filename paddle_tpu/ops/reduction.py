@@ -36,7 +36,6 @@ def _axis(axis):
 
 
 def _make_reduce(name, jfn, differentiable=True):
-    @register(name, category="reduction", differentiable=differentiable)
     def op(x, axis=None, keepdim=False, name_=None, dtype=None):
         ax = _axis(axis)
         d = convert_dtype(dtype)
@@ -46,6 +45,10 @@ def _make_reduce(name, jfn, differentiable=True):
         return dispatch.call(name, f, [_t(x)])
     op.__name__ = name
     op.__qualname__ = name
+    op.__doc__ = (f"Reduce ``{name}`` over ``axis`` (all axes when None), "
+                  f"optional keepdim/dtype (jnp.{jfn.__name__} lowering; "
+                  f"reference paddle.{name}).")
+    register(name, category="reduction", differentiable=differentiable)(op)
     globals()[name] = op
     return op
 
@@ -65,6 +68,8 @@ _make_reduce("nanmean", jnp.nanmean)
 
 @register("logsumexp", category="reduction")
 def logsumexp(x, axis=None, keepdim=False, name=None):
+    """log(sum(exp(x))) along axis, max-shifted for stability (reference
+    paddle.logsumexp)."""
     ax = _axis(axis)
     return dispatch.call("logsumexp",
                          lambda a: jax.scipy.special.logsumexp(a, axis=ax, keepdims=keepdim),
@@ -73,17 +78,21 @@ def logsumexp(x, axis=None, keepdim=False, name=None):
 
 @register("median", category="reduction")
 def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    """Median along axis (average of middle pair for even counts) (reference
+    paddle.median)."""
     ax = _axis(axis)
     return dispatch.call("median", lambda a: jnp.median(a, axis=ax, keepdims=keepdim), [_t(x)])
 
 
 def nanmedian(x, axis=None, keepdim=False, name=None):
+    """Median ignoring NaNs (reference paddle.nanmedian)."""
     ax = _axis(axis)
     return dispatch.call("nanmedian",
                          lambda a: jnp.nanmedian(a, axis=ax, keepdims=keepdim), [_t(x)])
 
 
 def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    """Linear-interpolated quantiles along axis (reference paddle.quantile)."""
     ax = _axis(axis)
     return dispatch.call(
         "quantile",
@@ -92,6 +101,7 @@ def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
 
 
 def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    """Quantiles ignoring NaNs (reference paddle.nanquantile)."""
     ax = _axis(axis)
     return dispatch.call(
         "nanquantile",
@@ -100,6 +110,7 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 @register("std", category="reduction")
 def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    """Standard deviation with ddof=unbiased (reference paddle.std)."""
     ax = _axis(axis)
     return dispatch.call("std",
                          lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
@@ -108,6 +119,7 @@ def std(x, axis=None, unbiased=True, keepdim=False, name=None):
 
 @register("var", category="reduction")
 def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    """Variance with ddof=unbiased (reference paddle.var)."""
     ax = _axis(axis)
     return dispatch.call("var",
                          lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
@@ -116,6 +128,7 @@ def var(x, axis=None, unbiased=True, keepdim=False, name=None):
 
 @register("cumsum", category="reduction")
 def cumsum(x, axis=None, dtype=None, name=None):
+    """Inclusive cumulative sum along axis (reference paddle.cumsum)."""
     d = convert_dtype(dtype)
     def f(a):
         if axis is None:
@@ -127,12 +140,15 @@ def cumsum(x, axis=None, dtype=None, name=None):
 
 @register("cumprod", category="reduction")
 def cumprod(x, dim=None, dtype=None, name=None):
+    """Inclusive cumulative product along ``dim`` (reference paddle.cumprod).
+    """
     d = convert_dtype(dtype)
     return dispatch.call("cumprod",
                          lambda a: jnp.cumprod(a, axis=_axis(dim), dtype=d), [_t(x)])
 
 
 def cummax(x, axis=None, dtype="int64", name=None):
+    """Running maximum and its indices along axis (reference paddle.cummax)."""
     ax = _axis(axis)
     def f(a):
         if ax is None:
@@ -151,6 +167,7 @@ def cummax(x, axis=None, dtype="int64", name=None):
 
 
 def cummin(x, axis=None, dtype="int64", name=None):
+    """Running minimum and its indices along axis (reference paddle.cummin)."""
     ax = _axis(axis)
     def f(a):
         axis_ = 0 if ax is None else ax
@@ -166,6 +183,8 @@ def cummin(x, axis=None, dtype="int64", name=None):
 
 
 def logcumsumexp(x, axis=None, dtype=None, name=None):
+    """Numerically stable cumulative logsumexp (reference paddle.logcumsumexp).
+    """
     ax = _axis(axis)
     def f(a):
         if ax is None:
@@ -178,6 +197,8 @@ def logcumsumexp(x, axis=None, dtype=None, name=None):
 
 
 def count_nonzero(x, axis=None, keepdim=False, name=None):
+    """Number of non-zero elements along axis (reference paddle.count_nonzero).
+    """
     ax = _axis(axis)
     return dispatch.call("count_nonzero",
                          lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim).astype(jnp.int64),
@@ -185,6 +206,7 @@ def count_nonzero(x, axis=None, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value and index along axis (reference paddle.mode)."""
     ax = _axis(axis)
     def f(a):
         sorted_ = jnp.sort(a, axis=ax)

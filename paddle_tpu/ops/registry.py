@@ -27,6 +27,23 @@ class OpDef:
 
 OPS: Dict[str, OpDef] = {}
 
+#: canonical op categories — tools/tpulint (TPU302) rejects registrations
+#: outside this set so the category axis stays a closed vocabulary the
+#: parity audit and docs tooling can pivot on
+KNOWN_CATEGORIES = frozenset({
+    "activation", "attention", "control_flow", "conv", "creation",
+    "custom",  # runtime user ops via utils.custom_op.register_custom_op
+    "geometric", "indexing", "inplace", "linalg", "loss", "manipulation",
+    "math", "misc", "nn_common", "norm", "pooling", "quantization",
+    "random", "reduction", "search", "signal", "vision",
+})
+
+#: (module_name, op_name) pairs register_module() skipped because a
+#: DIFFERENT callable was already registered under the name — surfaced by
+#: tools/tpulint (TPU304) so bulk registration can never silently shadow or
+#: be shadowed by a decorator registration
+SHADOWED: list = []
+
 
 def register(name: str, category: str = "misc", differentiable: bool = True,
              inplace_variant: Optional[str] = None, tags=()):
@@ -50,13 +67,18 @@ def register_module(module, category: str, *, skip=()):
     names = getattr(module, "__all__", None)
     if names is None:
         names = [n for n in vars(module) if not n.startswith("_")]
+    mod_name = getattr(module, "__name__", str(module))
     for n in names:
-        if n in skip or n in OPS:
+        if n in skip:
             continue
         fn = getattr(module, n, None)
         if fn is None or not callable(fn) or inspect.isclass(fn):
             continue
         if getattr(fn, "__module__", "").startswith(("jax", "numpy")):
+            continue
+        if n in OPS:
+            if OPS[n].lowering is not fn:
+                SHADOWED.append((mod_name, n))
             continue
         OPS[n] = OpDef(name=n, category=category, lowering=fn,
                        doc=(fn.__doc__ or ""))

@@ -26,6 +26,7 @@ def _t(x):
 
 @register("argmax", category="search", differentiable=False)
 def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    """Index of the maximum along ``axis`` (reference paddle.argmax)."""
     d = convert_dtype(dtype)
     def f(a):
         if axis is None:
@@ -38,6 +39,7 @@ def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
 
 @register("argmin", category="search", differentiable=False)
 def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    """Index of the minimum along ``axis`` (reference paddle.argmin)."""
     d = convert_dtype(dtype)
     def f(a):
         if axis is None:
@@ -49,6 +51,7 @@ def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
 
 @register("argsort", category="search", differentiable=False)
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    """Indices that sort along ``axis`` (reference paddle.argsort)."""
     def f(a):
         idx = jnp.argsort(a, axis=axis, stable=True, descending=descending)
         return idx.astype(jnp.int64)
@@ -57,6 +60,7 @@ def argsort(x, axis=-1, descending=False, stable=False, name=None):
 
 @register("sort", category="search")
 def sort(x, axis=-1, descending=False, stable=False, name=None):
+    """Sorted values along ``axis`` (reference paddle.sort)."""
     return dispatch.call("sort",
                          lambda a: jnp.sort(a, axis=axis, stable=True, descending=descending),
                          [_t(x)])
@@ -64,6 +68,8 @@ def sort(x, axis=-1, descending=False, stable=False, name=None):
 
 @register("top_k", category="search")
 def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    """Largest/smallest k values and indices along axis (reference paddle.topk;
+    top_k alias)."""
     if isinstance(k, Tensor):
         k = int(k.item())
     def f(a):
@@ -81,6 +87,8 @@ def topk(x, k, axis=None, largest=True, sorted=True, name=None):
 
 @register("where", category="search")
 def where(condition, x=None, y=None, name=None):
+    """Select x where condition else y; 1-arg form returns nonzero coords
+    (reference paddle.where)."""
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
     return dispatch.call("where", lambda c, a, b: jnp.where(c.astype(bool), a, b),
@@ -99,6 +107,8 @@ def where_(condition, x, y, name=None):
 
 @register("nonzero", category="search", differentiable=False)
 def nonzero(x, as_tuple=False, name=None):
+    """Coordinates of non-zero elements (host path: dynamic output shape)
+    (reference paddle.nonzero)."""
     arr = np.asarray(_t(x)._data)  # dynamic output shape -> host
     nz = np.nonzero(arr)
     if as_tuple:
@@ -107,6 +117,8 @@ def nonzero(x, as_tuple=False, name=None):
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    """Insertion positions into a sorted sequence (reference
+    paddle.searchsorted)."""
     d = jnp.int32 if out_int32 else jnp.int64
     return dispatch.call(
         "searchsorted",
@@ -115,10 +127,14 @@ def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=Non
 
 
 def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """Bucket index of each element against sorted 1D edges (reference
+    paddle.bucketize)."""
     return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
 
 
 def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    """k-th smallest value and index along ``axis`` (reference
+    paddle.kthvalue)."""
     def f(a):
         ax = axis % a.ndim
         moved = jnp.moveaxis(a, ax, -1)
@@ -134,6 +150,8 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 @register("unique", category="search", differentiable=False)
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, dtype="int64", name=None):
+    """Sorted distinct values, optional index/inverse/counts (host path:
+    dynamic shape) (reference paddle.unique)."""
     arr = np.asarray(_t(x)._data)  # dynamic output shape -> host
     res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
@@ -146,6 +164,8 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
 
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
                        dtype="int64", name=None):
+    """Collapse equal runs, optional inverse/counts (host path: dynamic shape)
+    (reference paddle.unique_consecutive)."""
     arr = np.asarray(_t(x)._data)
     if axis is None:
         arr = arr.reshape(-1)
@@ -170,6 +190,8 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
 
 
 def masked_scatter(x, mask, value, name=None):
+    """Fill True mask positions from ``value``'s elements in order (reference
+    paddle.masked_scatter)."""
     xt, mt, vt = _t(x), _t(mask), _t(value)
     m = np.asarray(mt._data).astype(bool)
     def f(a, v):
@@ -182,12 +204,14 @@ def masked_scatter(x, mask, value, name=None):
 
 
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    """Elementwise membership of x in test_x (reference paddle.isin)."""
     return dispatch.call("isin",
                          lambda a, b: jnp.isin(a, b, invert=invert),
                          [_t(x), _t(test_x)])
 
 
 def index_of_max(x):
+    """Flat index of the overall maximum (helper behind argmax surfaces)."""
     return argmax(x)
 
 

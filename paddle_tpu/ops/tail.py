@@ -39,18 +39,21 @@ def _u(name, jfn, x):
 @_export
 @register("rad2deg", category="math")
 def rad2deg(x, name=None):
+    """Radians to degrees (reference paddle.rad2deg)."""
     return _u("rad2deg", lambda a: a * (180.0 / _math.pi), x)
 
 
 @_export
 @register("deg2rad", category="math")
 def deg2rad(x, name=None):
+    """Degrees to radians (reference paddle.deg2rad)."""
     return _u("deg2rad", lambda a: a * (_math.pi / 180.0), x)
 
 
 @_export
 @register("sinc", category="math")
 def sinc(x, name=None):
+    """sin(pi x)/(pi x), 1 at 0 (reference paddle.sinc)."""
     return _u("sinc", jnp.sinc, x)
 
 
@@ -70,12 +73,16 @@ def sgn(x, name=None):
 @_export
 @register("signbit", category="math", differentiable=False)
 def signbit(x, name=None):
+    """True where the sign bit is set, including -0.0 (reference
+    paddle.signbit)."""
     return _u("signbit", jnp.signbit, x)
 
 
 @_export
 @register("frexp", category="math", differentiable=False)
 def frexp(x, name=None):
+    """Decompose into mantissa in [0.5, 1) and int exponent (reference
+    paddle.frexp)."""
     def f(a):
         m, e = jnp.frexp(a)
         return m, e.astype(a.dtype)
@@ -85,24 +92,28 @@ def frexp(x, name=None):
 @_export
 @register("isneginf", category="math", differentiable=False)
 def isneginf(x, name=None):
+    """True at -inf entries (reference paddle.isneginf)."""
     return _u("isneginf", jnp.isneginf, x)
 
 
 @_export
 @register("isposinf", category="math", differentiable=False)
 def isposinf(x, name=None):
+    """True at +inf entries (reference paddle.isposinf)."""
     return _u("isposinf", jnp.isposinf, x)
 
 
 @_export
 @register("isreal", category="math", differentiable=False)
 def isreal(x, name=None):
+    """True where imaginary part is zero (reference paddle.isreal)."""
     return _u("isreal", jnp.isreal, x)
 
 
 @_export
 @register("multigammaln", category="math")
 def multigammaln(x, p, name=None):
+    """Log multivariate gamma of order p (reference paddle.multigammaln)."""
     from jax.scipy.special import multigammaln as _mg
     return _u("multigammaln", lambda a: _mg(a, int(p)), x)
 
@@ -197,6 +208,8 @@ def tolist(x):
 @_export
 @register("block_diag", category="manipulation")
 def block_diag(inputs, name=None):
+    """Block-diagonal matrix from a list of matrices (reference
+    paddle.block_diag)."""
     from jax.scipy.linalg import block_diag as _bd
     ts = [_t(i) for i in inputs]
     return dispatch.call("block_diag", lambda *a: _bd(*a), ts)
@@ -245,6 +258,7 @@ row_stack = _stack_as("row_stack", jnp.vstack)
 @_export
 @register("unflatten", category="manipulation")
 def unflatten(x, axis, shape, name=None):
+    """Split one dim into the given ``shape`` (reference paddle.unflatten)."""
     xt = _t(x)
 
     def f(a):
@@ -276,6 +290,8 @@ def as_strided(x, shape, stride, offset=0, name=None):
 @_export
 @register("index_fill", category="manipulation")
 def index_fill(x, index, axis, value, name=None):
+    """Set whole index positions along ``axis`` to ``value`` (reference
+    paddle.index_fill)."""
     xt, it = _t(x), _t(index)
 
     def f(a, idx):
@@ -289,6 +305,8 @@ def index_fill(x, index, axis, value, name=None):
 @_export
 @register("diagonal_scatter", category="manipulation")
 def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write values onto a diagonal of the input (reference
+    paddle.diagonal_scatter)."""
     xt, yt = _t(x), _t(y)
 
     def f(a, b):
@@ -341,12 +359,14 @@ def scatter_nd(index, updates, shape, name=None):
 @_export
 @register("add_n", category="math")
 def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (reference paddle.add_n)."""
     ts = [_t(i) for i in (inputs if isinstance(inputs, (list, tuple))
                           else [inputs])]
     return dispatch.call("add_n", lambda *a: sum(a[1:], a[0]), ts)
 
 
 @_export
+@register("reverse", category="manipulation")
 def reverse(x, axis, name=None):
     """Legacy alias of flip (reference reverse → flip)."""
     from .manipulation import flip

@@ -42,6 +42,8 @@ def weight_quantize(x, algo: str = "abs_max", bits: int = 8):
 
 
 def weight_dequantize(q, scale):
+    """Dequantize int8/int4 weights back to float using per-channel scales
+    (reference weight_dequantize)."""
     def f(qa, s):
         return qa.astype(s.dtype) * s[None, :]
     return dispatch.call("weight_dequantize", f, [_t(q), _t(scale)])

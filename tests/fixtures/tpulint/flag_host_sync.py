@@ -1,0 +1,57 @@
+"""Must-flag corpus for pass 1 (TPU1xx trace-safety).
+
+Every line carrying an ``# expect: CODE`` marker must be flagged with
+exactly those codes; every other line must stay clean.
+"""
+import numpy as np
+
+from paddle_tpu.core import dispatch
+from paddle_tpu.core.tensor import Tensor, as_tensor
+
+
+def leaky_mean(x):
+    t = as_tensor(x)
+    m = t.mean()
+    return float(m)  # expect: TPU103
+
+
+def numpy_roundtrip(t: Tensor):
+    host = np.asarray(t._data)  # expect: TPU104
+    return host
+
+
+def scalarize(t: Tensor):
+    a = t.numpy()  # expect: TPU101
+    b = t.item()  # expect: TPU102
+    c = t.tolist()  # expect: TPU102
+    return a, b, c
+
+
+def tensor_branch(x, y):
+    t = as_tensor(x)
+    if t.sum() > 0:  # expect: TPU105
+        return y
+    while t.any():  # expect: TPU106
+        t = t - 1
+    return t
+
+
+def lowering_host_math(x):
+    # f is handed to dispatch.call, so its parameters are tracers: host
+    # constructs inside it break the one-XLA-program guarantee
+    def f(a, b):
+        s = np.sqrt(a)  # expect: TPU104
+        if b.sum() > 0:  # expect: TPU105
+            return s
+        return int(s[0])  # expect: TPU103
+
+    return dispatch.call("bad_op", f, [x, x])
+
+
+def host_dp(t: Tensor):
+    # the loss.py edit_distance shape: tensor data pulled through numpy,
+    # then consumed as a python scalar several statements later
+    a = np.asarray(t._data)  # expect: TPU104
+    dp = np.arange(4)
+    dp[1] = dp[0] + (a[0] != a[1])
+    return float(dp[3])  # expect: TPU103

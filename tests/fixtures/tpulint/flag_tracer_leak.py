@@ -1,0 +1,29 @@
+"""Must-flag corpus for pass 2 (TPU2xx tracer-leak)."""
+from paddle_tpu.core.tensor import Tensor, as_tensor
+
+_CACHE = {}
+_LAST = None
+
+
+def stash_global(x):
+    global _LAST
+    t = as_tensor(x)
+    _LAST = t  # expect: TPU201
+    return t
+
+
+def stash_container(x):
+    t = as_tensor(x)
+    _CACHE["last"] = t  # expect: TPU201
+    return t
+
+
+def bad_default(x, acc=[]):  # expect: TPU202
+    acc.append(x)
+    return acc
+
+
+def tensor_key(t: Tensor):
+    local = {}
+    local[t] = 1  # expect: TPU203
+    return local

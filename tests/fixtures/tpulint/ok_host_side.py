@@ -1,0 +1,67 @@
+"""Must-NOT-flag corpus: legitimately host-side framework idioms.
+
+Modeled on core/dispatch.py internals (quiet_scope / branch-trace
+bookkeeping), static-metadata checks, and plain-numpy host math — none of
+which touch live tensor values, so tpulint must stay silent here.
+"""
+import threading
+
+import numpy as np
+
+from paddle_tpu.core.tensor import Tensor, as_tensor
+
+_state = threading.local()
+
+
+def enter_branch_trace(bt):
+    # control-flow capture bookkeeping swaps a python object, never tensor
+    # data (mirrors core/dispatch.py enter_branch_trace)
+    prev = getattr(_state, "branch_trace", None)
+    _state.branch_trace = bt
+    return prev
+
+
+class quiet_scope:
+    def __enter__(self):
+        self._prev = getattr(_state, "quiet", False)
+        _state.quiet = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.quiet = self._prev
+        return False
+
+
+def static_metadata(t: Tensor):
+    # shape/dtype/ndim are trace-static attributes, not tensor values
+    if t.ndim > 2 or t.shape[0] == 0:
+        return str(t.dtype)
+    return "ok"
+
+
+def none_check(t):
+    x = as_tensor(t)
+    if x is None:
+        return 0
+    return x
+
+
+def host_math(values):
+    # plain numpy over host data — no tensor anywhere in the dataflow
+    arr = np.asarray(values)
+    return float(np.sqrt(arr).sum())
+
+
+def metadata_keyed_cache(t: Tensor, cache):
+    # caching keyed on STATIC metadata is the sanctioned pattern; the
+    # container holding tensors does not make membership data-dependent
+    key = (tuple(t.shape), str(t.dtype))
+    if key in cache:
+        return cache[key]
+    cache[key] = 1
+    return 1
+
+
+def suppressed_sync(t: Tensor):
+    # an explicit, justified host boundary is opt-out-able per line
+    return t.numpy()  # tpulint: disable=TPU101 — documented host API

@@ -250,6 +250,8 @@ rec("unsqueeze", [sym(3, 4)], attrs={"axis": 1},
 rec("flatten", [sym(2, 3, 4)], ref=lambda x: x.reshape(2 * 3 * 4))
 rec("flip", [sym(3, 4)], attrs={"axis": 0},
     ref=lambda x, **kw: np.flip(x, 0))
+rec("reverse", [sym(3, 4)], attrs={"axis": 0},
+    ref=lambda x, **kw: np.flip(x, 0))
 rec("roll", [sym(3, 4)], attrs={"shifts": 1},
     ref=lambda x, **kw: np.roll(x, 1))
 rec("rot90", [sym(3, 4)], ref=np.rot90)

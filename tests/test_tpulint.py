@@ -1,0 +1,227 @@
+"""tpulint self-tests: fixture corpus, seeded violations, baseline gate,
+registry pass, and the in-graph edit_distance fix the analyzer motivated.
+
+Tier-1 (fast, not slow): the repo must lint clean against the checked-in
+baseline, and any seeded host-sync / tracer-leak / registry violation must
+fail the gate.
+"""
+import json
+import os
+import re
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from tools.tpulint import cli, registry_check, trace_safety, tracer_leak  # noqa: E402
+from tools.tpulint.core import (SourceFile, diff_against_baseline,  # noqa: E402
+                                load_baseline, save_baseline)
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "tpulint")
+BASELINE = os.path.join(REPO, "tools", "tpulint", "baseline.json")
+_EXPECT_RE = re.compile(r"#\s*expect:\s*([A-Z0-9,\s]+?)\s*$")
+
+
+def _lint_file(path):
+    sf = SourceFile(path, os.path.relpath(path, REPO))
+    trace_safety.run(sf)
+    tracer_leak.run(sf)
+    return sf.findings
+
+
+def _expected_by_line(path):
+    out = {}
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            m = _EXPECT_RE.search(line)
+            if m:
+                out[i] = sorted(c.strip() for c in m.group(1).split(",")
+                                if c.strip())
+    return out
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("name", ["flag_host_sync.py",
+                                      "flag_tracer_leak.py"])
+    def test_must_flag(self, name):
+        path = os.path.join(FIXTURES, name)
+        expected = _expected_by_line(path)
+        assert expected, f"fixture {name} has no expect markers"
+        got = {}
+        for f in _lint_file(path):
+            got.setdefault(f.line, []).append(f.code)
+        got = {k: sorted(v) for k, v in got.items()}
+        assert got == expected
+
+    def test_must_not_flag(self):
+        # quiet_scope / branch-trace style internals, static-metadata
+        # branching, plain-numpy host math: all clean
+        findings = _lint_file(os.path.join(FIXTURES, "ok_host_side.py"))
+        assert findings == []
+
+    def test_every_tpu1xx_and_2xx_code_exercised(self):
+        seen = set()
+        for name in ("flag_host_sync.py", "flag_tracer_leak.py"):
+            for codes in _expected_by_line(
+                    os.path.join(FIXTURES, name)).values():
+                seen.update(codes)
+        assert {"TPU101", "TPU102", "TPU103", "TPU104", "TPU105", "TPU106",
+                "TPU201", "TPU202", "TPU203"} <= seen
+
+
+class TestSeededViolations:
+    def _seed(self, tmp_path, body):
+        p = tmp_path / "seeded.py"
+        p.write_text("from paddle_tpu.core.tensor import Tensor, "
+                     "as_tensor\nimport numpy as np\n" + body)
+        return str(p)
+
+    def test_seeded_host_sync_fails_gate(self, tmp_path):
+        p = self._seed(tmp_path,
+                       "def f(x):\n    return float(as_tensor(x))\n")
+        assert cli.main([p, "--no-registry", "-q"]) == 1
+
+    def test_seeded_tracer_leak_fails_gate(self, tmp_path):
+        p = self._seed(tmp_path, "_G = {}\n\ndef f(x):\n"
+                       "    _G['t'] = as_tensor(x)\n")
+        assert cli.main([p, "--no-registry", "-q"]) == 1
+
+    def test_suppression_comment_quiets_gate(self, tmp_path):
+        p = self._seed(
+            tmp_path, "def f(x):\n    return float(as_tensor(x))"
+            "  # tpulint: disable=TPU103 — test boundary\n")
+        assert cli.main([p, "--no-registry", "-q"]) == 0
+
+    def test_update_baseline_roundtrip(self, tmp_path):
+        p = self._seed(tmp_path,
+                       "def f(x):\n    return float(as_tensor(x))\n")
+        bl = str(tmp_path / "bl.json")
+        assert cli.main([p, "--no-registry", "-q",
+                         "--baseline", bl, "--update-baseline"]) == 0
+        # frozen debt passes ...
+        assert cli.main([p, "--no-registry", "-q", "--baseline", bl]) == 0
+        # ... but NEW debt still fails
+        with open(p, "a") as f:
+            f.write("\ndef g(x):\n    return int(as_tensor(x))\n")
+        assert cli.main([p, "--no-registry", "-q", "--baseline", bl]) == 1
+
+    def test_seeded_registry_violation(self):
+        from paddle_tpu.ops.registry import OPS, OpDef
+        name = "_tpulint_seeded_bad_op"
+        OPS[name] = OpDef(name=name, category="not_a_category",
+                          lowering=lambda x: x, doc="",
+                          inplace_variant="_tpulint_missing_")
+        try:
+            codes = {f.code for f in registry_check.run()
+                     if f.line_text == f"op:{name}"}
+            assert {"TPU301", "TPU302", "TPU303"} <= codes
+        finally:
+            del OPS[name]
+
+
+class TestRepoGate:
+    """The tier-1 gate: the tree must be clean vs the frozen baseline."""
+
+    def test_repo_clean_against_baseline(self):
+        findings = cli.collect_findings([os.path.join(REPO, "paddle_tpu")])
+        new = diff_against_baseline(findings, load_baseline(BASELINE))
+        assert new == [], "\n".join(f.render() for f in new[:25])
+
+    def test_registry_debt_is_zero(self):
+        # satellite: docs/categories backfilled — TPU3xx ships with an
+        # EMPTY baseline, so every registry finding is a hard failure
+        regs = [f for f in registry_check.run()]
+        assert regs == [], "\n".join(f.render() for f in regs[:25])
+        with open(BASELINE) as f:
+            frozen = json.load(f)["findings"]
+        assert not any("|TPU3" in k for k in frozen)
+
+    def test_cli_module_entrypoint(self):
+        # `python -m tools.tpulint` is the documented workflow
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--list-codes"],
+            capture_output=True, text=True, cwd=REPO)
+        assert r.returncode == 0 and "TPU101" in r.stdout
+
+    def test_audit_reuses_tpulint_loader(self):
+        from tools import op_parity_audit
+        assert op_parity_audit.our_ops.__module__ != "tools.op_parity_audit" \
+            or "load_registry" in op_parity_audit.our_ops.__code__.co_names
+
+
+class TestEditDistanceInGraph:
+    """The burn-down headliner: loss.py edit_distance computes the DP
+    in-graph (vmapped wavefront over lax.cummin), so to_static captures it
+    with NO graph break — the seed version np.asarray'd the inputs."""
+
+    def _ref(self, a, b, ign=(), normalized=False):
+        out = []
+        for s1, s2 in zip(a, b):
+            s1 = [t for t in s1 if t not in ign]
+            s2 = [t for t in s2 if t not in ign]
+            m, n = len(s1), len(s2)
+            dp = list(range(n + 1))
+            for r in range(1, m + 1):
+                prev, dp = dp, [r] + [0] * n
+                for c in range(1, n + 1):
+                    dp[c] = min(prev[c] + 1, dp[c - 1] + 1,
+                                prev[c - 1] + (s1[r - 1] != s2[c - 1]))
+            d = dp[n] / max(n, 1) if normalized else dp[n]
+            out.append(d)
+        return np.asarray(out, np.float32).reshape(-1, 1)
+
+    def test_eager_matches_reference(self):
+        import paddle_tpu as paddle
+        F = paddle.nn.functional
+        a = paddle.to_tensor([[1, 2, 3, 4], [5, 5, 5, 5]])
+        b = paddle.to_tensor([[1, 9, 3, 4], [5, 6, 7, 8]])
+        d, n = F.edit_distance(a, b, normalized=False)
+        np.testing.assert_allclose(d.numpy(),
+                                   self._ref([[1, 2, 3, 4], [5, 5, 5, 5]],
+                                             [[1, 9, 3, 4], [5, 6, 7, 8]]))
+        assert int(n.numpy()[0]) == 2
+
+    def test_lengths_ignored_tokens_normalized(self):
+        import paddle_tpu as paddle
+        F = paddle.nn.functional
+        a_np = [[1, 2, 0, 7], [3, 3, 1, 2]]
+        b_np = [[1, 3, 0, 0], [3, 1, 2, 9]]
+        il, ll = [3, 4], [4, 3]
+        d, _ = F.edit_distance(
+            paddle.to_tensor(a_np), paddle.to_tensor(b_np), normalized=True,
+            ignored_tokens=[0], input_length=paddle.to_tensor(il),
+            label_length=paddle.to_tensor(ll))
+        ref = self._ref([r[:l] for r, l in zip(a_np, il)],
+                        [r[:l] for r, l in zip(b_np, ll)],
+                        ign=(0,), normalized=True)
+        np.testing.assert_allclose(d.numpy(), ref, rtol=1e-6)
+
+    def test_to_static_parity_no_graph_break(self):
+        import paddle_tpu as paddle
+        F = paddle.nn.functional
+
+        def f(a, b):
+            d, _ = F.edit_distance(a, b, normalized=True)
+            return d
+
+        st = paddle.jit.to_static(f, full_graph=True)
+        a = paddle.to_tensor([[1, 2, 3], [4, 5, 6]])
+        b = paddle.to_tensor([[1, 3, 3], [9, 9, 9]])
+        np.testing.assert_allclose(st(a, b).numpy(), f(a, b).numpy())
+        assert st.graph_break_reason is None
+
+    def test_tpulint_no_longer_flags_edit_distance(self):
+        import inspect
+        from paddle_tpu.nn.functional import loss as loss_mod
+        src_path = inspect.getsourcefile(loss_mod)
+        lines, start = inspect.getsourcelines(loss_mod.edit_distance)
+        sf = SourceFile(src_path, "paddle_tpu/nn/functional/loss.py")
+        trace_safety.run(sf)
+        hits = [f for f in sf.findings
+                if start <= f.line < start + len(lines)]
+        assert hits == [], "\n".join(f.render() for f in hits)

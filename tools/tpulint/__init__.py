@@ -1,0 +1,20 @@
+"""tpulint — framework-aware static analysis for paddle_tpu.
+
+Three passes, mirroring the bug classes a jax-graft tracing framework is
+uniquely exposed to (see ISSUE 2 / README "tpulint"):
+
+- TPU1xx  trace-safety: host syncs (``.numpy()``/``.item()``/``float()``/
+  ``np.*`` on tensor-derived values, ``if``/``while`` on tensor predicates)
+  that silently graph-break ``to_static``/SOT capture.
+- TPU2xx  tracer-leak: tensor values escaping into module globals, mutable
+  default arguments, or caches keyed on tensors — the classic leaked-tracer
+  bug class.
+- TPU3xx  registry consistency: every ``OpDef`` documented and categorised,
+  ``inplace_variant`` targets registered, bulk ``register_module`` calls not
+  shadowing decorator registrations, and the registry reconciling with
+  ``ops/__init__`` exports and the parity-audit alias table.
+
+Run:  python -m tools.tpulint [paths] --baseline tools/tpulint/baseline.json
+"""
+from .core import Finding, load_baseline, diff_against_baseline  # noqa: F401
+from .registry_check import load_registry  # noqa: F401

@@ -1,0 +1,9 @@
+import sys
+
+from .cli import main
+
+try:
+    sys.exit(main())
+except BrokenPipeError:
+    # output piped into head/grep that exited early — not an error
+    sys.exit(0)

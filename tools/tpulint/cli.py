@@ -1,0 +1,100 @@
+"""tpulint command line.
+
+    python -m tools.tpulint [paths...]
+        --baseline tools/tpulint/baseline.json   gate against frozen debt
+        --update-baseline                        refreeze current findings
+        --no-registry                            skip the TPU3xx import pass
+        --select TPU1xx,TPU203                   restrict emitted codes
+        --list-codes                             print the code table
+
+Exit status: 0 clean (vs baseline if given), 1 new findings, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+from . import registry_check, trace_safety, tracer_leak
+from .core import (CODES, Finding, SourceFile, diff_against_baseline,
+                   iter_python_files, load_baseline, save_baseline)
+
+REPO = registry_check.REPO
+
+
+def _match_select(code: str, select: List[str]) -> bool:
+    return any(code == s or (s.endswith("xx") and code.startswith(s[:4]))
+               for s in select)
+
+
+def collect_findings(paths: List[str], with_registry: bool = True,
+                     select: List[str] = ()) -> List[Finding]:
+    findings: List[Finding] = []
+    for abspath, rel in iter_python_files(paths, REPO):
+        sf = SourceFile(abspath, rel)
+        trace_safety.run(sf)
+        tracer_leak.run(sf)
+        findings.extend(sf.findings)
+    if with_registry:
+        findings.extend(registry_check.run())
+    if select:
+        findings = [f for f in findings if _match_select(f.code, select)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.code))
+    return findings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description="framework-aware static analysis "
+        "(trace-safety / tracer-leak / op-registry consistency)")
+    ap.add_argument("paths", nargs="*",
+                    default=[os.path.join(REPO, "paddle_tpu")])
+    ap.add_argument("--baseline", help="frozen-debt file; findings it "
+                    "covers do not fail the run")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite --baseline from the current findings")
+    ap.add_argument("--no-registry", action="store_true",
+                    help="AST passes only (no paddle_tpu import)")
+    ap.add_argument("--select", default="",
+                    help="comma-separated codes/families, e.g. TPU1xx,TPU203")
+    ap.add_argument("--list-codes", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="summary line only")
+    args = ap.parse_args(argv)
+
+    if args.list_codes:
+        for code, meaning in sorted(CODES.items()):
+            print(f"{code}  {meaning}")
+        return 0
+    if args.update_baseline and not args.baseline:
+        ap.error("--update-baseline requires --baseline")
+
+    select = [s.strip() for s in args.select.split(",") if s.strip()]
+    findings = collect_findings(args.paths,
+                                with_registry=not args.no_registry,
+                                select=select)
+
+    if args.update_baseline:
+        save_baseline(args.baseline, findings)
+        print(f"baseline: froze {len(findings)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    new = findings
+    frozen = 0
+    if args.baseline:
+        baseline = load_baseline(args.baseline)
+        new = diff_against_baseline(findings, baseline)
+        frozen = len(findings) - len(new)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+    tail = f" ({frozen} frozen in baseline)" if args.baseline else ""
+    print(f"tpulint: {len(new)} new finding(s), {len(findings)} total{tail}")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

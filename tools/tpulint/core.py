@@ -1,0 +1,183 @@
+"""tpulint core: findings, suppression comments, and the CI baseline.
+
+The baseline keys findings on (path, code, normalized source line) rather
+than line numbers, so unrelated edits above a frozen finding do not unfreeze
+it. ``--update-baseline`` regenerates the file; the gate fails only on
+findings NOT covered by the checked-in counts (new debt), never on fixed
+ones (the update workflow shrinks the file).
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+BASELINE_VERSION = 1
+
+#: every code the analyzer can emit, with one-line meaning (also --list-codes)
+CODES = {
+    "TPU100": "file does not parse (syntax error)",
+    "TPU101": ".numpy() on a tensor — host materialization",
+    "TPU102": ".item()/.tolist() on a tensor — host materialization",
+    "TPU103": "float()/int()/bool() applied to a tensor-derived value",
+    "TPU104": "np.* call on a tensor-derived value (use jnp)",
+    "TPU105": "`if` predicated on a tensor value (use static.nn.cond)",
+    "TPU106": "`while` predicated on a tensor value (use static.nn.while_loop)",
+    "TPU201": "tensor value stored into a module-level global/container",
+    "TPU202": "mutable default argument (tracer-retention vector)",
+    "TPU203": "container subscripted/keyed by a tensor value",
+    "TPU301": "OpDef has an empty doc",
+    "TPU302": "OpDef category not in registry.KNOWN_CATEGORIES",
+    "TPU303": "inplace_variant names an unregistered op",
+    "TPU304": "register_module bulk registration shadowed by an earlier one",
+    "TPU305": "ops/__init__ public export neither registered nor allowlisted",
+    "TPU306": "op_parity_audit alias target is not a registered op",
+}
+
+
+@dataclass
+class Finding:
+    path: str          # repo-relative, forward slashes
+    line: int          # 1-based; 0 for registry-level findings
+    col: int
+    code: str
+    message: str
+    fixit: str = ""
+    #: normalized source-line text (or a synthetic ``op:<name>`` key for
+    #: registry findings) — the line-drift-stable part of the baseline key
+    line_text: str = ""
+
+    def key(self) -> str:
+        return f"{self.path}|{self.code}|{self.line_text}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}:{self.col}"
+        out = f"{loc}: {self.code} {self.message}"
+        if self.fixit:
+            out += f"\n    fix: {self.fixit}"
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Suppression comments:  # tpulint: disable=TPU101,TPU2xx
+#   inline  -> suppresses that line; on a line of its own -> suppresses the
+#   NEXT line.             # tpulint: skip-file  (whole module, first 5 lines)
+# A trailing justification after the codes is encouraged and ignored.
+# ---------------------------------------------------------------------------
+_DISABLE_RE = re.compile(
+    r"#\s*tpulint:\s*disable=((?:TPU\w+|all)(?:\s*,\s*(?:TPU\w+|all))*)")
+_SKIP_FILE_RE = re.compile(r"#\s*tpulint:\s*skip-file")
+
+
+def _norm_line(text: str) -> str:
+    """Whitespace-collapsed line text used in baseline keys."""
+    return " ".join(text.split())
+
+
+class SourceFile:
+    """One analyzed file: source, per-line suppressions, finding sink."""
+
+    def __init__(self, path: str, rel: str, text: Optional[str] = None):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.skip = any(_SKIP_FILE_RE.search(l) for l in self.lines[:5])
+        self._disabled: Dict[int, set] = {}
+        for i, l in enumerate(self.lines, 1):
+            m = _DISABLE_RE.search(l)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                target = i + 1 if l.lstrip().startswith("#") else i
+                self._disabled.setdefault(target, set()).update(codes)
+        self.findings: List[Finding] = []
+
+    def suppressed(self, line: int, code: str) -> bool:
+        codes = self._disabled.get(line)
+        if not codes:
+            return False
+        # TPU1xx-style wildcards match a whole pass family
+        fam = code[:4] + "xx"
+        return "all" in codes or code in codes or fam in codes
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return _norm_line(self.lines[line - 1])
+        return ""
+
+    def add(self, line: int, col: int, code: str, message: str,
+            fixit: str = "", line_text: Optional[str] = None):
+        if self.skip or self.suppressed(line, code):
+            return
+        self.findings.append(Finding(
+            self.rel, line, col, code, message, fixit,
+            line_text if line_text is not None else self.line_text(line)))
+
+
+def iter_python_files(paths: List[str], repo_root: str) -> List[Tuple[str, str]]:
+    """Expand files/dirs into (abs_path, repo_relative) python sources."""
+    out = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d not in ("__pycache__", ".git"))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    seen, uniq = set(), []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            rel = os.path.relpath(p, repo_root)
+            uniq.append((p, rel.replace(os.sep, "/")))
+    return uniq
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+def baseline_counts(findings: List[Finding]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        counts[f.key()] = counts.get(f.key(), 0) + 1
+    return counts
+
+
+def save_baseline(path: str, findings: List[Finding]):
+    data = {"version": BASELINE_VERSION,
+            "total": len(findings),
+            "findings": dict(sorted(baseline_counts(findings).items()))}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"unsupported baseline version in {path}")
+    return dict(data["findings"])
+
+
+def diff_against_baseline(findings: List[Finding],
+                          baseline: Dict[str, int]) -> List[Finding]:
+    """Findings not covered by the baseline counts (the CI failures)."""
+    budget = dict(baseline)
+    new = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(f)
+    return new

@@ -1,0 +1,18 @@
+"""Pass 1 — trace-safety (TPU1xx).
+
+Host-sync constructs that silently graph-break ``to_static``/SOT/program
+capture: tensor materialization (``.numpy()``/``.item()``/``float()``),
+``np.*`` applied to tensor-derived data, and python control flow predicated
+on tensor values. All detection lives in the shared taint engine; this
+module owns the code family.
+"""
+from __future__ import annotations
+
+from .core import SourceFile
+from .taint import analyze_file
+
+CODES = {"TPU101", "TPU102", "TPU103", "TPU104", "TPU105", "TPU106"}
+
+
+def run(sf: SourceFile):
+    analyze_file(sf, CODES)

@@ -1,0 +1,19 @@
+"""Pass 2 — tracer-leak (TPU2xx).
+
+Tensor/tracer values escaping the trace's lifetime: stores into module-level
+globals or containers (TPU201), mutable default arguments (TPU202), and
+caches keyed on tensor values (TPU203). A leaked tracer keeps an entire
+traced computation alive and explodes the next trace with
+``UnexpectedTracerError`` far from the leak site — flagging the store site
+is the whole point of doing this statically.
+"""
+from __future__ import annotations
+
+from .core import SourceFile
+from .taint import analyze_file
+
+CODES = {"TPU201", "TPU202", "TPU203"}
+
+
+def run(sf: SourceFile):
+    analyze_file(sf, CODES)
