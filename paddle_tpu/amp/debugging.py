@@ -42,7 +42,7 @@ _op_stats = Counter()
 _collecting = False
 
 
-def _stats_hook(op_name, inputs, outputs, attrs):
+def _stats_hook(op_name, inputs, outputs, attrs, duration=0.0):
     if _collecting:
         dt = outputs[0].dtype if outputs else None
         _op_stats[f"{op_name}:{dt}"] += 1

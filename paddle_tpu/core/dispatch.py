@@ -12,6 +12,7 @@ is what program capture traces through.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 import jax
@@ -21,6 +22,10 @@ import numpy as np
 from . import dtype as dtypes
 from . import flags
 from .tensor import Tensor
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
+
+_perf_counter = time.perf_counter  # patchable seam for overhead tests
 
 # Filled in lazily to break the core<->autograd import cycle (the autograd
 # package re-exports dispatch's grad-mode contexts).
@@ -216,13 +221,33 @@ def _amp_cast_inputs(op_name: str, arrays: List):
 # sync by flag observers instead of registry lookups per call.
 _hot_flags = {"check_nan_inf": flags.get_flag("check_nan_inf"),
               "benchmark": flags.get_flag("benchmark"),
-              "eager_jit_cache": flags.get_flag("eager_jit_cache")}
+              "eager_jit_cache": flags.get_flag("eager_jit_cache"),
+              "enable_metrics": flags.get_flag("enable_metrics")}
 flags.on_change("check_nan_inf",
                 lambda v: _hot_flags.__setitem__("check_nan_inf", v))
 flags.on_change("benchmark",
                 lambda v: _hot_flags.__setitem__("benchmark", v))
 flags.on_change("eager_jit_cache",
                 lambda v: _hot_flags.__setitem__("eager_jit_cache", v))
+flags.on_change("enable_metrics",
+                lambda v: _hot_flags.__setitem__("enable_metrics", v))
+
+# Dispatch telemetry instruments (collection is gated per event by
+# FLAGS_enable_metrics; declaring them here is one-time import cost).
+_m_op_latency = _metrics.histogram(
+    "paddle_tpu_dispatch_op_latency_seconds",
+    "Host wall time per eager op dispatch (lowering + tape + side "
+    "channels).", labelnames=("op",))
+_m_eager_jit = _metrics.counter(
+    "paddle_tpu_eager_jit_cache_total",
+    "Eager compiled-lowering cache events: hit = compiled fast path, "
+    "miss = first sight of a key, warmup = eager run below the jit "
+    "threshold, compile = jitted entry installed, uncacheable = closure "
+    "not exactly keyable, bypass = known-uncacheable key.",
+    labelnames=("event",))
+_m_hook_overhead = _metrics.histogram(
+    "paddle_tpu_dispatch_hook_seconds",
+    "Host time spent inside op/recorder/export hooks per dispatch.")
 
 _op_hooks: List[Callable] = []  # profiler / debugging taps
 _recorder_tls = threading.local()  # program capture is per-thread: a
@@ -263,13 +288,41 @@ def unregister_export_hook(fn):
 
 
 def register_op_hook(fn):
-    _op_hooks.append(fn)
+    """Register a per-op tap called as ``fn(op_name, inputs, outputs,
+    attrs, duration_s)``. Legacy 4-positional hooks are adapted so older
+    taps keep working without seeing the latency argument."""
+    import inspect
+    target = fn
+    try:
+        params = inspect.signature(fn).parameters.values()
+        positional = [p for p in params
+                      if p.kind in (p.POSITIONAL_ONLY,
+                                    p.POSITIONAL_OR_KEYWORD)]
+        has_var = any(p.kind == p.VAR_POSITIONAL for p in params)
+        if not has_var and len(positional) == 4:
+            def target(op, ins, outs, attrs, dur, __fn=fn):
+                return __fn(op, ins, outs, attrs)
+            # stack, not a single slot: double register + double
+            # unregister of the same legacy hook must stay symmetric
+            _hook_adapters.setdefault(fn, []).append(target)
+    except (TypeError, ValueError):
+        pass
+    _op_hooks.append(target)
     return fn
 
 
+_hook_adapters: Dict[Callable, List[Callable]] = {}
+
+
 def unregister_op_hook(fn):
+    adapters = _hook_adapters.get(fn)
+    target = fn
+    if adapters:
+        target = adapters.pop()
+        if not adapters:
+            del _hook_adapters[fn]
     try:
-        _op_hooks.remove(fn)
+        _op_hooks.remove(target)
     except ValueError:
         pass
 
@@ -409,25 +462,38 @@ def _jit_cached_call(op_name: str, f: Callable, arrays):
     installs the jitted entry; later calls hit jax.jit's C++ fast path —
     jit's own aval cache handles shape/dtype polymorphism under one
     entry."""
+    metered = _hot_flags["enable_metrics"]
     key0 = _closure_cache_key(f)
     if key0 is None:
+        if metered:
+            _m_eager_jit.inc(event="uncacheable")
         return f(*arrays)
     key = (op_name, key0)
     ent = _eager_jit_cache.get(key)
     if ent is False:
+        if metered:
+            _m_eager_jit.inc(event="bypass")
         return f(*arrays)
     if ent is None or isinstance(ent, int):
         outs = f(*arrays)
         if ent is None:
+            if metered:
+                _m_eager_jit.inc(event="miss")
             if len(_eager_jit_cache) >= _EAGER_JIT_MAX:
                 _eager_jit_cache.pop(next(iter(_eager_jit_cache)))
             _eager_jit_cache[key] = (1 if _all_jax_arrays(outs)
                                      else False)
         elif ent + 1 >= _JIT_AFTER:
+            if metered:
+                _m_eager_jit.inc(event="compile")
             _eager_jit_cache[key] = jax.jit(f)
         else:
+            if metered:
+                _m_eager_jit.inc(event="warmup")
             _eager_jit_cache[key] = ent + 1
         return outs
+    if metered:
+        _m_eager_jit.inc(event="hit")
     return ent(*arrays)
 
 
@@ -468,6 +534,12 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
         return s.branch_trace.run_op(op_name, fn, tensor_inputs, attrs)
     if GradNode is None:
         _bind_engine()
+
+    # Telemetry gate: one list truthiness + two dict lookups when every
+    # channel is off — the disabled path never reads the clock.
+    timed = (bool(_op_hooks) or _hot_flags["enable_metrics"]
+             or _trace._active["on"]) and not s.quiet
+    t0 = _perf_counter() if timed else 0.0
 
     arrays = [t._data for t in tensor_inputs]
     if _sot is not None and not _sot.active():
@@ -571,9 +643,22 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
             for o in out_list:
                 if isinstance(o, jax.Array):
                     jax.block_until_ready(o)
+        dur = 0.0
+        if timed:
+            # a channel that flipped on mid-call reports from the NEXT op
+            # (t0 predates the flip, so its span/metric would be garbage)
+            dur = _perf_counter() - t0
+            if _hot_flags["enable_metrics"]:
+                _m_op_latency.observe(dur, op=op_name)
+            if _trace._active["on"]:
+                _trace.add_complete(op_name, "dispatch", t0, t0 + dur)
+        rec_hooks = _recorder_hooks()
+        th0 = _perf_counter() if (
+            timed and _hot_flags["enable_metrics"]
+            and (_op_hooks or rec_hooks or _export_hooks)) else 0.0
         for hook in _op_hooks:
-            hook(op_name, tensor_inputs, out_tensors, attrs)
-        for hook in _recorder_hooks():
+            hook(op_name, tensor_inputs, out_tensors, attrs, dur)
+        for hook in rec_hooks:
             # recorder taps (static.Program capture) additionally receive
             # the attr-bound lowering so the op can be replayed on new
             # payloads
@@ -584,6 +669,8 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
                 merged.update(export_attrs)
             for hook in _export_hooks:
                 hook(op_name, tensor_inputs, out_tensors, merged)
+        if th0:
+            _m_hook_overhead.observe(_perf_counter() - th0)
 
     if single:
         return out_tensors[0]

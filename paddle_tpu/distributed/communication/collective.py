@@ -38,10 +38,47 @@ def shard_map(f, mesh, in_specs, out_specs):
         return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
                               out_specs=out_specs, check_rep=False)
 
+import time as _time
+
 from ...core import dispatch
 from ...core.tensor import Tensor, as_tensor
+from ...observability import metrics as _metrics
+from ...observability import trace as _trace
 from .. import mesh as mesh_mod
 from .group import Group, get_default_group
+
+# Collective telemetry (gated by FLAGS_enable_metrics / an active
+# profiler trace session; off = one dict lookup per collective)
+_m_coll_calls = _metrics.counter(
+    "paddle_tpu_collective_calls_total",
+    "Collective invocations per primitive.", labelnames=("op",))
+_m_coll_bytes = _metrics.counter(
+    "paddle_tpu_collective_bytes_total",
+    "Input payload bytes handed to each collective primitive.",
+    labelnames=("op",))
+_m_coll_latency = _metrics.histogram(
+    "paddle_tpu_collective_latency_seconds",
+    "Host wall time per collective call (build/cache lookup + dispatch; "
+    "completion only when the caller synchronizes).", labelnames=("op",))
+
+
+def _coll_begin():
+    if _metrics.enabled() or _trace.active():
+        return _time.perf_counter()
+    return None
+
+
+def _coll_end(name: str, payload, t0):
+    if t0 is None:
+        return
+    t1 = _time.perf_counter()
+    nbytes = int(getattr(payload, "nbytes", 0) or 0)
+    if _metrics.enabled():
+        _m_coll_calls.inc(op=name)
+        _m_coll_bytes.inc(nbytes, op=name)
+        _m_coll_latency.observe(t1 - t0, op=name)
+    _trace.add_complete(f"collective:{name}", "collective", t0, t1,
+                        {"bytes": nbytes})
 
 
 class ReduceOp:
@@ -115,10 +152,12 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place sum (or max/min/prod/avg) across the group's axes."""
     g = _group(group)
     t = _t(tensor)
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(t._data, g.mesh)
     fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec, op)
     out = fn(arr)
     t._swap_payload(out)
+    _coll_end("all_reduce", arr, t0)
     return t
 
 
@@ -154,9 +193,11 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     all_gather.py)."""
     g = _group(group)
     t = _t(tensor)
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(t._data, g.mesh)
     fn = _build_all_gather(_mesh_key(g.mesh), g.axes, spec)
     stacked = fn(arr)                      # (nranks, *global_shape_local)
+    _coll_end("all_gather", arr, t0)
     n = stacked.shape[0]
     if tensor_list is None:
         tensor_list = []
@@ -216,9 +257,11 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         raise ValueError(
             f"reduce_scatter dim 0 ({src._data.shape[0]}) must divide the "
             f"group size ({g.nranks})")
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(src._data, g.mesh)
     fn = _build_reduce_scatter(_mesh_key(g.mesh), g.axes, spec, op)
     out = fn(arr)
+    _coll_end("reduce_scatter", arr, t0)
     if tensor is not None:
         _t(tensor)._swap_payload(out)
         return tensor
@@ -244,9 +287,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     src_local = g.get_group_rank(src)
     if src_local < 0:
         src_local = src
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(t._data, g.mesh)
     fn = _build_broadcast(_mesh_key(g.mesh), g.axes, spec, src_local)
     t._swap_payload(fn(arr))
+    _coll_end("broadcast", arr, t0)
     return t
 
 
@@ -307,6 +352,7 @@ def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
         from ...ops import manipulation
         source = manipulation.concat([_t(s) for s in source], axis=0)
     source = _t(source) if source is not None else _t(tensor)
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(source._data, g.mesh)
     src_local = g.get_group_rank(src)
     if src_local < 0:
@@ -314,6 +360,7 @@ def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
     fn = _build_scatter(_mesh_key(g.mesh), g.axes, spec, src_local)
     out = fn(arr)
     _t(tensor)._swap_payload(out)
+    _coll_end("scatter", arr, t0)
     return tensor
 
 
@@ -336,9 +383,11 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _group(group)
     from ...ops import manipulation
     stacked = manipulation.stack([_t(x) for x in in_tensor_list], axis=0)
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(stacked._data, g.mesh)
     fn = _build_all_to_all(_mesh_key(g.mesh), g.axes, spec)
     out = fn(arr)
+    _coll_end("all_to_all", arr, t0)
     if out_tensor_list is None:
         out_tensor_list = []
     del out_tensor_list[:]
@@ -367,12 +416,14 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             raise ValueError(
                 f"{label}={list(sizes)} must have one entry per rank ({n}) "
                 f"and sum to dim 0 ({t._data.shape[0]})")
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(t._data, g.mesh)
     reshaped = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
     fn = _build_all_to_all(_mesh_key(g.mesh), g.axes,
                            P(*([None] + list(spec))))
     out = fn(reshaped)
     out = out.reshape((-1,) + out.shape[2:])
+    _coll_end("all_to_all_single", arr, t0)
     if out_tensor is not None:
         _t(out_tensor)._swap_payload(out)
         return out_tensor
@@ -381,9 +432,14 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 def barrier(group=None):
     g = _group(group)
-    tok = Tensor(jnp.zeros(()))
-    all_reduce(tok, group=g)
-    tok.block_until_ready()
+    t0 = _coll_begin()
+    # token reduction built directly (not via all_reduce) so the barrier
+    # records ONE metric sample instead of also inflating all_reduce's
+    tok = jnp.zeros(())
+    arr, spec = _ensure_on_mesh(tok, g.mesh)
+    fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec, ReduceOp.SUM)
+    jax.block_until_ready(fn(arr))
+    _coll_end("barrier", arr, t0)
 
 
 # --------------------------------------------------------------------- p2p
@@ -441,11 +497,13 @@ def batch_isend_irecv(p2p_op_list):
     perm = tuple((int(getattr(op, "src_rank", i)), int(op.peer))
                  for i, op in enumerate(sends))
     t = sends[0].tensor
+    t0 = _coll_begin()
     arr, spec = _ensure_on_mesh(t._data, g.mesh)
     fn = _build_ppermute(_mesh_key(g.mesh), g.axes, spec, perm)
     out = fn(arr)
     for op in recvs:
         op.tensor._swap_payload(out)
+    _coll_end("batch_isend_irecv", arr, t0)
     return []
 
 

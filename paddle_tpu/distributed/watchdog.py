@@ -54,7 +54,7 @@ class Watchdog:
     def start(self):
         from ..core import dispatch
 
-        def hook(op_name, inputs, outputs, attrs):
+        def hook(op_name, inputs, outputs, attrs, duration=0.0):
             self.heartbeat()
         self._hook = hook
         dispatch.register_op_hook(hook)

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from typing import Any, Callable, Optional
 
 import jax
@@ -26,8 +27,34 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import metrics as _metrics
+from ..observability import trace as _trace
 
 _capture = threading.local()
+
+# to_static compile telemetry (collection gated by FLAGS_enable_metrics)
+_m_compile = _metrics.counter(
+    "paddle_tpu_to_static_compile_total",
+    "to_static program builds: initial = first signature of a "
+    "StaticFunction, retrace = additional signature.",
+    labelnames=("kind",))
+_m_compile_time = _metrics.histogram(
+    "paddle_tpu_to_static_compile_seconds",
+    "Wall time of the first call for a new to_static signature (trace + "
+    "XLA compile + first run).", labelnames=("kind",))
+_m_retrace_reason = _metrics.counter(
+    "paddle_tpu_to_static_retrace_total",
+    "Why a new signature retraced: new_input_shapes, new_static_args, or "
+    "new_structure.", labelnames=("reason",))
+_m_graph_break = _metrics.counter(
+    "paddle_tpu_graph_break_total",
+    "to_static full-graph trace failures that fell back to SOT "
+    "partial-frame capture, labeled by the tracer error class.",
+    labelnames=("reason",))
+_m_sot_frame = _metrics.counter(
+    "paddle_tpu_sot_frame_total",
+    "SOT frame executions: bypass = stitched compiled segments (no "
+    "Python), replay = recording Python replay.", labelnames=("mode",))
 
 
 def in_capture_mode() -> bool:
@@ -100,6 +127,9 @@ class StaticFunction:
         #: per-signature frame journals for the steady-state bypass
         self._sot_frames: dict = {}
         self.sot_stats: Optional[dict] = None
+        #: signatures already dispatched — a new one means trace+compile
+        #: (telemetry only; jax's jit cache is the source of truth)
+        self._seen_sigs: set = set()
 
     @property
     def graph_break_reason(self):
@@ -207,9 +237,25 @@ class StaticFunction:
                tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
         if sig in self._graph_breaks:
             return self._run_sot(sig, fn, args, kwargs)
+        is_new_sig = sig not in self._seen_sigs
+        if is_new_sig:  # tpulint: disable=TPU105 — branches on input SHAPES (the dispatch signature), not tensor values
+            self._record_new_sig(sig)
         try:
-            out, mutated = self._jitted([p._data for p in params], arrays,
-                                        treedef, statics)
+            if is_new_sig:  # tpulint: disable=TPU105 — same shape-only branch
+                # first call of a new signature pays trace + XLA compile;
+                # time it as the compile cost (per-subsystem span + metric)
+                kind = "initial" if len(self._seen_sigs) == 1 else "retrace"
+                with _trace.span(f"to_static_compile:{self.__name__}",
+                                 "compile"):
+                    c0 = time.perf_counter()
+                    out, mutated = self._jitted(
+                        [p._data for p in params], arrays, treedef, statics)
+                if _metrics.enabled():
+                    _m_compile_time.observe(time.perf_counter() - c0,
+                                            kind=kind)
+            else:
+                out, mutated = self._jitted(
+                    [p._data for p in params], arrays, treedef, statics)
         except (jax.errors.TracerBoolConversionError,
                 jax.errors.TracerArrayConversionError,
                 jax.errors.TracerIntegerConversionError,
@@ -221,6 +267,8 @@ class StaticFunction:
             # is the whole function, so this SIGNATURE runs eagerly —
             # other signatures keep their compiled programs.
             reason = f"{type(e).__name__}: {str(e).splitlines()[0]}"
+            if _metrics.enabled():
+                _m_graph_break.inc(reason=type(e).__name__)
             if self._full_graph:
                 raise
             if len(self._graph_breaks) >= self._graph_breaks_max:
@@ -242,6 +290,25 @@ class StaticFunction:
         for i, arr in mutated.items():
             params[i]._swap_payload(arr)
         return _wrap(out)
+
+    def _record_new_sig(self, sig):
+        """Telemetry for a signature's first dispatch: initial build vs
+        retrace, with the retrace classified against prior signatures."""
+        treedef, statics, shapes = sig
+        if _metrics.enabled():
+            if not self._seen_sigs:
+                _m_compile.inc(kind="initial")
+            else:
+                _m_compile.inc(kind="retrace")
+                reason = "new_structure"
+                for ptd, pst, _psh in self._seen_sigs:
+                    if ptd == treedef and pst == statics:
+                        reason = "new_input_shapes"
+                        break
+                    if ptd == treedef:
+                        reason = "new_static_args"
+                _m_retrace_reason.inc(reason=reason)
+        self._seen_sigs.add(sig)
 
     def _frame_guard(self, fn):
         """Frame-level guard string: the closure/default values the frame
@@ -302,6 +369,8 @@ class StaticFunction:
                     for arr, wrap in out_leaves]
                 self.sot_stats = {"segments": len(journal.segments),
                                   "compiled": 0, "bypassed": True}
+                if _metrics.enabled():
+                    _m_sot_frame.inc(mode="bypass")
                 return _jax.tree_util.tree_unflatten(treedef, rebuilt)
             # guard missed: demote to recording replay
             state["stable"] = False
@@ -327,6 +396,8 @@ class StaticFunction:
         state["guard"] = guard
         self.sot_stats = dict(cap.stats)
         self.sot_stats["bypassed"] = False
+        if _metrics.enabled():
+            _m_sot_frame.inc(mode="replay")
         return out
 
     @property

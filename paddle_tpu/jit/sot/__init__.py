@@ -42,11 +42,26 @@ import threading
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 import weakref
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...observability import metrics as _metrics
+from ...observability import trace as _trace
+
 _tls = threading.local()
+
+# SOT segment-cache telemetry (gated by FLAGS_enable_metrics)
+_m_segment_cache = _metrics.counter(
+    "paddle_tpu_sot_segment_cache_total",
+    "SOT compiled-segment cache events at flush: hit = cached XLA "
+    "program reused, miss = segment compiled fresh.",
+    labelnames=("event",))
+_m_segment_compile_time = _metrics.histogram(
+    "paddle_tpu_sot_segment_compile_seconds",
+    "Wall time to compile + first-run one flushed SOT segment.")
 
 #: bound on compiled segments per (StaticFunction, signature) cache —
 #: long-running shape-diverse workloads must not grow XLA executables
@@ -308,7 +323,11 @@ class Segment:
             # hot segment first and thrash recompiles
             self.owner.cache.pop(key)
             self.owner.cache[key] = jitted
+            if _metrics.enabled():
+                _m_segment_cache.inc(event="hit")
         if jitted is None:
+            if _metrics.enabled():
+                _m_segment_cache.inc(event="miss")
             nodes = self.nodes
 
             def seg_fn(ext):
@@ -326,7 +345,16 @@ class Segment:
                 self.owner.cache.pop(next(iter(self.owner.cache)))
             self.owner.cache[key] = jitted
             self.owner.stats["compiled"] += 1
-        results = jitted(self.ext_arrays)
+            # XLA compiles on the first execution — time it as the
+            # segment's compile cost
+            with _trace.span(f"sot_segment_compile:site{self.owner.site_idx}",
+                             "compile", {"ops": len(self.nodes)}):
+                c0 = time.perf_counter()
+                results = jitted(self.ext_arrays)
+            if _metrics.enabled():
+                _m_segment_compile_time.observe(time.perf_counter() - c0)
+        else:
+            results = jitted(self.ext_arrays)
         value_of = dict(zip(out_refs, results))
         for l in live:
             l._value = value_of[(l.node_id, l.out_idx)]

@@ -1,0 +1,75 @@
+"""paddle_tpu.observability — framework-wide telemetry.
+
+Two always-compiled-out-when-disabled primitives:
+
+- :mod:`.metrics` — a registry of labeled counters/gauges/histograms
+  (``FLAGS_enable_metrics`` gates collection at dict-lookup cost) with
+  Prometheus text + JSON export. Instrumented subsystems: eager dispatch
+  (per-op host latency, eager-jit cache), to_static/SOT (compiles,
+  retraces, graph breaks, segment cache), pallas autotune (cache hit/miss,
+  winner timings), distributed collectives (calls, bytes, latency), the
+  profiler step timer (steps/sec, examples/sec), and a live device-memory
+  callback gauge.
+- :mod:`.trace` — a span buffer active while a ``profiler.Profiler``
+  session records; ``export_chrome_tracing`` merges spans from all layers
+  into one chrome trace.
+
+CLI: ``python -m paddle_tpu.observability`` (or ``tools/metrics_dump.py``)
+prints the Prometheus/JSON snapshot of the current process or of a file
+written via ``PADDLE_TPU_METRICS_DUMP=/path FLAGS_enable_metrics=1``.
+"""
+from __future__ import annotations
+
+import os
+
+from . import metrics, trace
+from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
+                      enabled, render_prometheus)
+
+__all__ = ["metrics", "trace", "REGISTRY", "MetricsRegistry", "Counter",
+           "Gauge", "Histogram", "enabled", "render_prometheus",
+           "device_live_bytes", "snapshot", "to_prometheus"]
+
+snapshot = REGISTRY.snapshot
+to_prometheus = REGISTRY.to_prometheus
+
+
+def device_live_bytes() -> float:
+    """Bytes held by live device arrays (jax.live_arrays) — evaluated at
+    snapshot/export time only, never on the hot path."""
+    try:
+        import jax
+        return float(sum(int(getattr(a, "nbytes", 0) or 0)
+                         for a in jax.live_arrays()))
+    except Exception:
+        return 0.0
+
+
+metrics.gauge(
+    "paddle_tpu_device_live_bytes",
+    "Bytes referenced by live device arrays (jax.live_arrays), read at "
+    "snapshot time.").set_function(device_live_bytes)
+
+
+def _install_exit_dump():
+    """PADDLE_TPU_METRICS_DUMP=/path: write the JSON snapshot at process
+    exit so `python -m paddle_tpu.observability --input /path` can render
+    it offline."""
+    path = os.environ.get("PADDLE_TPU_METRICS_DUMP")
+    if not path:
+        return
+
+    import atexit
+    import json
+
+    def _dump():
+        try:
+            with open(path, "w") as f:
+                json.dump(REGISTRY.snapshot(), f, indent=1, sort_keys=True)
+        except OSError:
+            pass
+
+    atexit.register(_dump)
+
+
+_install_exit_dump()

@@ -1,0 +1,50 @@
+"""``python -m paddle_tpu.observability`` — print the metrics snapshot.
+
+    python -m paddle_tpu.observability                  # live registry, prom
+    python -m paddle_tpu.observability --format json
+    python -m paddle_tpu.observability --input /tmp/metrics.json
+
+Without ``--input`` the snapshot is of THIS process's registry (mostly the
+callback gauges, e.g. device memory, unless run embedded); with ``--input``
+it renders a snapshot written by ``PADDLE_TPU_METRICS_DUMP=/path`` from an
+instrumented run. Exit status 0 unless the input file is unreadable.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="paddle_tpu.observability",
+        description="print the framework metrics snapshot")
+    ap.add_argument("--format", choices=("prom", "json"), default="prom",
+                    help="output format (default: Prometheus text)")
+    ap.add_argument("--input", help="render a saved JSON snapshot file "
+                    "instead of this process's registry")
+    args = ap.parse_args(argv)
+
+    if args.input:
+        try:
+            with open(args.input) as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"cannot read snapshot {args.input!r}: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        from . import REGISTRY
+        snap = REGISTRY.snapshot()
+
+    if args.format == "json":
+        print(json.dumps(snap, indent=1, sort_keys=True))
+    else:
+        from .metrics import render_prometheus
+        sys.stdout.write(render_prometheus(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

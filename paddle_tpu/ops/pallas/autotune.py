@@ -30,10 +30,25 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ...core import flags
+from ...observability import metrics as _metrics
+from ...observability import trace as _trace
 
 # flags use_autotune / autotune_attn_impl are defined in core/flags.py
 # (readers like nn/functional/flash_attention must not depend on this
 # module having been imported first)
+
+# Autotune telemetry (gated by FLAGS_enable_metrics)
+_m_at_cache = _metrics.counter(
+    "paddle_tpu_autotune_cache_total",
+    "Autotune winner-cache lookups: hit = cached winner served, miss = "
+    "candidate grid measured.", labelnames=("event",))
+_m_at_probe_time = _metrics.histogram(
+    "paddle_tpu_autotune_measure_seconds",
+    "Wall time of one full candidate-grid measurement (all probes).")
+_m_at_winner = _metrics.gauge(
+    "paddle_tpu_autotune_winner_seconds",
+    "Median per-call latency of the winning candidate, per cache key.",
+    labelnames=("key",))
 
 __all__ = ["AutotuneCache", "autotune", "cache_path", "chip_kind",
            "seq_bucket", "should_autotune"]
@@ -215,27 +230,38 @@ def autotune(key: str,
     """
     cached = _cache.get(key)
     if cached is not None:
+        if _metrics.enabled():
+            _m_at_cache.inc(event="hit")
         # JSON round-trips tuples as lists
         return tuple(cached) if isinstance(cached, list) else cached
 
+    if _metrics.enabled():
+        _m_at_cache.inc(event="miss")
+    measure_t0 = time.perf_counter()
     best, best_t = None, float("inf")
     timings = {}
-    for cand in candidates:
-        try:
-            for i in range(max(warmup, 1)):
-                _value_sync(run(cand, i))
-            ts = []
-            for i in range(iters):
-                t0 = time.perf_counter()
-                _value_sync(run(cand, warmup + i))
-                ts.append(time.perf_counter() - t0)
-            ts.sort()
-            dt = ts[len(ts) // 2]
-        except Exception:
-            continue
-        timings[str(cand)] = dt
-        if dt < best_t:
-            best, best_t = cand, dt
+    with _trace.span(f"autotune:{key}", "autotune",
+                     {"candidates": len(candidates)}):
+        for cand in candidates:
+            try:
+                for i in range(max(warmup, 1)):
+                    _value_sync(run(cand, i))
+                ts = []
+                for i in range(iters):
+                    t0 = time.perf_counter()
+                    _value_sync(run(cand, warmup + i))
+                    ts.append(time.perf_counter() - t0)
+                ts.sort()
+                dt = ts[len(ts) // 2]
+            except Exception:
+                continue
+            timings[str(cand)] = dt
+            if dt < best_t:
+                best, best_t = cand, dt
+    if _metrics.enabled():
+        _m_at_probe_time.observe(time.perf_counter() - measure_t0)
+        if best is not None:
+            _m_at_winner.set(best_t, key=key)
     if flags.get_flag("log_level") >= 1:
         import logging
         ranked = ", ".join(f"{c}={t * 1e3:.3f}ms" for c, t in

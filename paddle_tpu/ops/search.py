@@ -109,11 +109,11 @@ def where_(condition, x, y, name=None):
 def nonzero(x, as_tuple=False, name=None):
     """Coordinates of non-zero elements (host path: dynamic output shape)
     (reference paddle.nonzero)."""
-    arr = np.asarray(_t(x)._data)  # dynamic output shape -> host
-    nz = np.nonzero(arr)
+    arr = np.asarray(_t(x)._data)  # tpulint: disable=TPU104 — count of nonzeros IS the output shape; host by design
+    nz = np.nonzero(arr)  # tpulint: disable=TPU104 — same dynamic-shape host path
     if as_tuple:
         return tuple(Tensor(jnp.asarray(v.astype(np.int64))) for v in nz)
-    return Tensor(jnp.asarray(np.stack(nz, axis=-1).astype(np.int64)))
+    return Tensor(jnp.asarray(np.stack(nz, axis=-1).astype(np.int64)))  # tpulint: disable=TPU104 — dynamic-shape result re-enters device here
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
@@ -152,8 +152,8 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, dtype="int64", name=None):
     """Sorted distinct values, optional index/inverse/counts (host path:
     dynamic shape) (reference paddle.unique)."""
-    arr = np.asarray(_t(x)._data)  # dynamic output shape -> host
-    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+    arr = np.asarray(_t(x)._data)  # tpulint: disable=TPU104 — number of distinct values IS the output shape; host by design
+    res = np.unique(arr, return_index=return_index, return_inverse=return_inverse,  # tpulint: disable=TPU104 — same dynamic-shape host path
                     return_counts=return_counts, axis=axis)
     if not isinstance(res, tuple):
         return Tensor(jnp.asarray(res))
@@ -166,41 +166,59 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
                        dtype="int64", name=None):
     """Collapse equal runs, optional inverse/counts (host path: dynamic shape)
     (reference paddle.unique_consecutive)."""
-    arr = np.asarray(_t(x)._data)
+    # run-collapse output length is data-dependent (number of distinct
+    # runs) — host by design, like the reference CPU kernel
+    arr = np.asarray(_t(x)._data)  # tpulint: disable=TPU104 — dynamic output shape; host by design
     if axis is None:
         arr = arr.reshape(-1)
         ax = 0
     else:
         ax = axis
     sel = np.ones(arr.shape[ax], dtype=bool)
-    moved = np.moveaxis(arr, ax, 0)
+    moved = np.moveaxis(arr, ax, 0)  # tpulint: disable=TPU104 — host path continues
     if moved.shape[0] > 1:
-        neq = np.any((moved[1:] != moved[:-1]).reshape(moved.shape[0] - 1, -1), axis=1)
+        neq = np.any((moved[1:] != moved[:-1]).reshape(moved.shape[0] - 1, -1), axis=1)  # tpulint: disable=TPU104 — host path continues
         sel[1:] = neq
-    out = np.moveaxis(moved[sel], 0, ax)
+    out = np.moveaxis(moved[sel], 0, ax)  # tpulint: disable=TPU104 — boolean-mask select = the dynamic shape
     outs = [Tensor(jnp.asarray(out))]
     if return_inverse:
-        inv = np.cumsum(sel) - 1
+        inv = np.cumsum(sel) - 1  # tpulint: disable=TPU104 — host path continues
         outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
     if return_counts:
-        idx = np.flatnonzero(sel)
-        counts = np.diff(np.append(idx, arr.shape[ax]))
+        idx = np.flatnonzero(sel)  # tpulint: disable=TPU104 — dynamic run count
+        counts = np.diff(np.append(idx, arr.shape[ax]))  # tpulint: disable=TPU104 — host path continues
         outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
     return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def masked_scatter(x, mask, value, name=None):
     """Fill True mask positions from ``value``'s elements in order (reference
-    paddle.masked_scatter)."""
+    paddle.masked_scatter). In-graph: the k-th True position (in flat
+    order) takes value element k via an exclusive running count of the
+    mask — static shapes throughout, so the op traces/compiles cleanly.
+    Eager calls keep the reference's size check (value must cover every
+    True slot); under tracing the check is skipped (data-dependent)."""
     xt, mt, vt = _t(x), _t(mask), _t(value)
-    m = np.asarray(mt._data).astype(bool)
-    def f(a, v):
-        flat_v = v.reshape(-1)[: int(m.sum())]
-        out = np.asarray(a).copy()
-        out[np.broadcast_to(m, out.shape)] = np.asarray(flat_v)
-        return jnp.asarray(out)
-    out_arr = f(xt._data, vt._data)
-    return Tensor(out_arr)
+    mp = mt._data
+    if isinstance(mp, (jax.Array, np.ndarray)) \
+            and not isinstance(mp, jax.core.Tracer):
+        mb = jnp.broadcast_to(mp.astype(bool), xt.shape)
+        needed = int(jnp.sum(mb))  # tpulint: disable=TPU1xx — eager-only validation, unreachable under tracing (Tracer guard above)
+        have = int(np.prod(vt.shape)) if vt.shape else 1
+        if have < needed:
+            raise ValueError(
+                f"masked_scatter needs value with >= {needed} elements "
+                f"(number of True mask positions), got {have}")
+
+    def f(a, m, v):
+        mb = jnp.broadcast_to(m.astype(bool), a.shape).reshape(-1)
+        take = jnp.cumsum(mb) - 1           # value index per True slot
+        vflat = v.reshape(-1)
+        gathered = jnp.take(vflat, jnp.clip(take, 0, vflat.shape[0] - 1))
+        return jnp.where(mb, gathered, a.reshape(-1)).reshape(a.shape)
+
+    return dispatch.call("masked_scatter", f, [xt, mt, vt],
+                         differentiable_mask=[True, False, True])
 
 
 def isin(x, test_x, assume_unique=False, invert=False, name=None):
@@ -304,17 +322,19 @@ def class_center_sample(label, num_classes, num_samples, group=None,
     unique set), like the reference's CPU path.
     """
     lt = _t(label)
-    lab = np.asarray(lt._data).astype(np.int64).ravel()
-    pos = np.unique(lab)
+    # the positive-class set is data-dependent (reference runs this on the
+    # CPU too) — host by design
+    lab = np.asarray(lt._data).astype(np.int64).ravel()  # tpulint: disable=TPU104 — dynamic class set; host by design
+    pos = np.unique(lab)  # tpulint: disable=TPU104 — same host sampling path
     if pos.shape[0] >= num_samples:
         sampled = pos
     else:
         from ..core.generator import default_generator
         key = default_generator().next_key()
-        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
-        perm = np.asarray(jax.random.permutation(key, neg_pool.shape[0]))
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)  # tpulint: disable=TPU104 — same host sampling path
+        perm = np.asarray(jax.random.permutation(key, neg_pool.shape[0]))  # tpulint: disable=TPU104 — same host sampling path
         extra = neg_pool[perm[:num_samples - pos.shape[0]]]
-        sampled = np.sort(np.concatenate([pos, extra]))
+        sampled = np.sort(np.concatenate([pos, extra]))  # tpulint: disable=TPU104 — same host sampling path
     remap = -np.ones(num_classes, dtype=np.int64)
     remap[sampled] = np.arange(sampled.shape[0])
     return (Tensor(jnp.asarray(remap[lab].reshape(lt.shape))),

@@ -1,0 +1,523 @@
+"""Observability stack tests: metrics registry, exporters, dispatch/jit/
+collective instrumentation, the rebuilt profiler (real host latency,
+scheduler boundaries, merged chrome trace), and the zero-overhead-when-off
+guarantee the tier-1 suite enforces.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, profiler
+from paddle_tpu.observability import REGISTRY, metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def _metrics_hygiene():
+    """Each test starts with a zeroed registry and the flag OFF, and
+    leaves no collection enabled behind."""
+    paddle.set_flags({"FLAGS_enable_metrics": False})
+    REGISTRY.reset()
+    trace.deactivate()
+    trace.clear()
+    yield
+    paddle.set_flags({"FLAGS_enable_metrics": False})
+    REGISTRY.reset()
+    trace.deactivate()
+    trace.clear()
+
+
+def _enable():
+    paddle.set_flags({"FLAGS_enable_metrics": True})
+
+
+# ---------------------------------------------------------------------------
+# metrics registry
+# ---------------------------------------------------------------------------
+class TestRegistry:
+    def test_counter_labels_and_prometheus(self):
+        _enable()
+        c = metrics.counter("test_requests_total", "help text",
+                            labelnames=("code",))
+        c.inc(code="200")
+        c.inc(2, code="500")
+        assert c.value(code="200") == 1
+        assert c.value(code="500") == 2
+        text = REGISTRY.to_prometheus()
+        assert '# TYPE test_requests_total counter' in text
+        assert 'test_requests_total{code="200"} 1' in text
+        assert 'test_requests_total{code="500"} 2' in text
+
+    def test_histogram_buckets_cumulative(self):
+        _enable()
+        h = metrics.histogram("test_lat_seconds", buckets=(0.01, 0.1, 1.0))
+        for v in (0.005, 0.05, 0.5, 5.0):
+            h.observe(v)
+        assert h.count() == 4
+        assert h.sum() == pytest.approx(5.555)
+        text = REGISTRY.to_prometheus()
+        assert 'test_lat_seconds_bucket{le="0.01"} 1' in text
+        assert 'test_lat_seconds_bucket{le="0.1"} 2' in text
+        assert 'test_lat_seconds_bucket{le="1.0"} 3' in text
+        assert 'test_lat_seconds_bucket{le="+Inf"} 4' in text
+        assert 'test_lat_seconds_count 4' in text
+
+    def test_gauge_callback_evaluated_at_snapshot(self):
+        g = metrics.gauge("test_cb_gauge")
+        box = {"v": 7.0}
+        g.set_function(lambda: box["v"])
+        snap = REGISTRY.snapshot()
+        assert snap["test_cb_gauge"]["series"][0]["value"] == 7.0
+        box["v"] = 9.0
+        assert REGISTRY.snapshot()["test_cb_gauge"]["series"][0]["value"] == 9.0
+
+    def test_get_or_create_and_kind_conflict(self):
+        c1 = metrics.counter("test_same_name")
+        assert metrics.counter("test_same_name") is c1
+        with pytest.raises(TypeError):
+            metrics.gauge("test_same_name")
+
+    def test_device_live_bytes_gauge_present(self):
+        snap = REGISTRY.snapshot()
+        assert "paddle_tpu_device_live_bytes" in snap
+        assert snap["paddle_tpu_device_live_bytes"]["series"][0]["value"] >= 0
+
+    def test_reset_zeroes_but_keeps_instruments(self):
+        _enable()
+        c = metrics.counter("test_reset_total")
+        c.inc()
+        REGISTRY.reset()
+        assert c.total() == 0
+        assert REGISTRY.get("test_reset_total") is c
+
+    def test_prometheus_escapes_label_values(self):
+        _enable()
+        c = metrics.counter("test_escape_total", labelnames=("key",))
+        c.inc(key='tile("8,128")|b\\s\nx')
+        text = REGISTRY.to_prometheus()
+        assert r'key="tile(\"8,128\")|b\\s\nx"' in text
+
+    def test_snapshot_roundtrips_through_json(self):
+        _enable()
+        metrics.counter("test_json_total", labelnames=("k",)).inc(k="a")
+        snap = json.loads(json.dumps(REGISTRY.snapshot()))
+        text = metrics.render_prometheus(snap)
+        assert 'test_json_total{k="a"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# disabled = compiled out
+# ---------------------------------------------------------------------------
+class TestDisabledIsFree:
+    def test_zero_collection_when_flag_off(self):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        for _ in range(5):
+            _ = x @ x + x
+        snap = REGISTRY.snapshot()
+        for name, m in snap.items():
+            if m["kind"] == "counter":
+                assert all(s["value"] == 0 for s in m["series"]), name
+            elif m["kind"] == "histogram":
+                assert all(s["value"]["count"] == 0
+                           for s in m["series"]), name
+        # no framework counter/histogram series should even exist
+        assert not any(m["kind"] in ("counter", "histogram")
+                       for m in snap.values())
+
+    def test_dispatch_never_reads_clock_when_off(self, monkeypatch):
+        """The ~zero-overhead guarantee, deterministically: with metrics
+        off, no hooks, and no trace session, dispatch must not touch the
+        telemetry clock at all."""
+        from paddle_tpu.core import dispatch
+        assert not dispatch._op_hooks, "leaked op hook from another test"
+        calls = {"n": 0}
+        real = time.perf_counter
+
+        def counting():
+            calls["n"] += 1
+            return real()
+
+        monkeypatch.setattr(dispatch, "_perf_counter", counting)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        _ = x + x
+        assert calls["n"] == 0
+        _enable()
+        _ = x + x
+        assert calls["n"] > 0
+
+    def test_instrument_calls_are_noops_when_off(self):
+        c = metrics.counter("test_off_total")
+        c.inc()
+        h = metrics.histogram("test_off_seconds")
+        h.observe(1.0)
+        assert c.total() == 0 and h.total_count() == 0
+
+
+# ---------------------------------------------------------------------------
+# dispatch + eager-jit instrumentation
+# ---------------------------------------------------------------------------
+class TestDispatchMetrics:
+    def test_op_latency_collected_per_op(self):
+        _enable()
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        for _ in range(3):
+            _ = x * x
+        h = REGISTRY.get("paddle_tpu_dispatch_op_latency_seconds")
+        assert h.count(op="multiply") == 3
+        assert h.sum(op="multiply") > 0
+
+    def test_eager_jit_cache_events(self):
+        _enable()
+        x = paddle.to_tensor(np.ones((3, 3), np.float32))
+        for _ in range(8):
+            _ = x + x
+        c = REGISTRY.get("paddle_tpu_eager_jit_cache_total")
+        assert c.total() >= 8  # every dispatch classified
+
+
+# ---------------------------------------------------------------------------
+# to_static / SOT instrumentation
+# ---------------------------------------------------------------------------
+class TestCompileMetrics:
+    def test_compile_initial_and_retrace(self):
+        _enable()
+
+        @paddle.jit.to_static
+        def f(a):
+            return a * 2 + 1
+
+        f(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        f(paddle.to_tensor(np.ones((2, 2), np.float32)))   # cached
+        f(paddle.to_tensor(np.ones((4, 4), np.float32)))   # retrace
+        c = REGISTRY.get("paddle_tpu_to_static_compile_total")
+        assert c.value(kind="initial") == 1
+        assert c.value(kind="retrace") == 1
+        r = REGISTRY.get("paddle_tpu_to_static_retrace_total")
+        assert r.value(reason="new_input_shapes") == 1
+        t = REGISTRY.get("paddle_tpu_to_static_compile_seconds")
+        assert t.count(kind="initial") == 1
+        assert t.count(kind="retrace") == 1
+        assert t.sum(kind="initial") > 0
+
+    def test_graph_break_reason_counter(self):
+        _enable()
+
+        @paddle.jit.to_static
+        def g(a):
+            if float(a.sum()) > 0:     # host sync -> graph break
+                return a + 1
+            return a - 1
+
+        with pytest.warns(UserWarning):
+            g(paddle.to_tensor(np.ones((2, 2), np.float32)))
+        c = REGISTRY.get("paddle_tpu_graph_break_total")
+        assert c.total() >= 1
+        sot = REGISTRY.get("paddle_tpu_sot_frame_total")
+        assert sot.value(mode="replay") >= 1
+
+
+# ---------------------------------------------------------------------------
+# collectives
+# ---------------------------------------------------------------------------
+class TestCollectiveMetrics:
+    def test_all_reduce_counts_bytes_and_latency(self):
+        import paddle_tpu.distributed as dist
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+        _enable()
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        dist.all_reduce(x)
+        dist.all_reduce(x)
+        calls = REGISTRY.get("paddle_tpu_collective_calls_total")
+        byts = REGISTRY.get("paddle_tpu_collective_bytes_total")
+        lat = REGISTRY.get("paddle_tpu_collective_latency_seconds")
+        assert calls.value(op="all_reduce") == 2
+        assert byts.value(op="all_reduce") == 2 * 4 * 4 * 4  # fp32 bytes
+        assert lat.count(op="all_reduce") == 2
+
+    def test_barrier_records_once_not_as_all_reduce(self):
+        import paddle_tpu.distributed as dist
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+        _enable()
+        dist.barrier()
+        calls = REGISTRY.get("paddle_tpu_collective_calls_total")
+        assert calls.value(op="barrier") == 1
+        assert calls.value(op="all_reduce") == 0
+
+
+# ---------------------------------------------------------------------------
+# autotune
+# ---------------------------------------------------------------------------
+class TestAutotuneMetrics:
+    def test_cache_hit_miss_and_winner(self, tmp_path):
+        from paddle_tpu.ops.pallas import autotune as at
+        _enable()
+        cache = at.AutotuneCache(str(tmp_path / "at.json"))
+        orig = at._cache
+        at._cache = cache
+        try:
+            key = "test_kernel|unit"
+            run = lambda cand, i: np.float32(cand)
+            win = at.autotune(key, [1, 2], run, default=1, warmup=1, iters=1)
+            assert win in (1, 2)
+            at.autotune(key, [1, 2], run, default=1)     # served from cache
+        finally:
+            at._cache = orig
+        c = REGISTRY.get("paddle_tpu_autotune_cache_total")
+        assert c.value(event="miss") == 1
+        assert c.value(event="hit") == 1
+        g = REGISTRY.get("paddle_tpu_autotune_winner_seconds")
+        assert g.value(key=key) >= 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler boundaries (satellite)
+# ---------------------------------------------------------------------------
+class TestMakeScheduler:
+    def test_skip_first_shifts_cycle(self):
+        from paddle_tpu.profiler import ProfilerState as S, make_scheduler
+        sch = make_scheduler(closed=1, ready=1, record=1, repeat=1,
+                             skip_first=3)
+        assert [sch(i) for i in range(3)] == [S.CLOSED] * 3
+        assert sch(3) == S.CLOSED
+        assert sch(4) == S.READY
+        assert sch(5) == S.RECORD_AND_RETURN
+
+    def test_record_and_return_at_cycle_end(self):
+        from paddle_tpu.profiler import ProfilerState as S, make_scheduler
+        sch = make_scheduler(closed=0, ready=0, record=3, repeat=0)
+        assert [sch(i) for i in range(6)] == [
+            S.RECORD, S.RECORD, S.RECORD_AND_RETURN,
+            S.RECORD, S.RECORD, S.RECORD_AND_RETURN]
+
+    def test_repeat_closes_after_n_cycles(self):
+        from paddle_tpu.profiler import ProfilerState as S, make_scheduler
+        sch = make_scheduler(closed=1, ready=0, record=1, repeat=2)
+        assert sch(0) == S.CLOSED and sch(1) == S.RECORD_AND_RETURN
+        assert sch(2) == S.CLOSED and sch(3) == S.RECORD_AND_RETURN
+        for i in range(4, 10):
+            assert sch(i) == S.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# profiler
+# ---------------------------------------------------------------------------
+class TestProfilerLatency:
+    def test_summary_reports_real_host_time(self, capsys):
+        net = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with profiler.Profiler(timer_only=True) as p:
+            for _ in range(3):
+                net(x)
+                p.step()
+        stats = p.op_stats()
+        name = "linear" if "linear" in stats else "matmul"
+        assert stats[name]["calls"] >= 3
+        assert stats[name]["total_s"] > 0          # the fixed latency bug
+        assert stats[name]["max_s"] > 0
+        counts = p.summary(sorted_by="time")
+        out = capsys.readouterr().out
+        assert "total(ms)" in out and "avg(ms)" in out
+        assert counts[name] >= 3
+
+    def test_summary_sort_orders(self):
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with profiler.Profiler(timer_only=True) as p:
+            _ = x + x
+            _ = x * x
+            _ = x * x
+        from paddle_tpu.profiler import SortedKeys
+        by_calls = list(p.summary(sorted_by=SortedKeys.Calls))
+        assert by_calls[0] == "multiply"
+        with pytest.raises(ValueError):
+            p.summary(sorted_by="bogus")
+
+    def test_session_state_reset_on_restart(self):
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        _ = x + x
+        with profiler.RecordEvent("first_session"):
+            pass
+        p.stop()
+        assert p._op_stats and p._events
+        p.start()   # re-entry: previous session must not leak through
+        assert not p._op_stats and not p._events and p._step == 0
+        _ = x * x
+        p.stop()
+        assert "add" not in p._op_stats
+        assert all(name != "first_session" for name, _, _ in p._events)
+
+    def test_step_timer_and_metrics(self):
+        _enable()
+        with profiler.Profiler(timer_only=True) as p:
+            for _ in range(3):
+                time.sleep(0.002)
+                p.step(num_samples=16)
+        assert len(p._step_times) == 3
+        info = p.step_info()
+        assert "steps/sec" in info and "steps: 3" in info
+        assert REGISTRY.get("paddle_tpu_train_steps_total").total() == 3
+        assert REGISTRY.get("paddle_tpu_steps_per_second").value() > 0
+        assert REGISTRY.get("paddle_tpu_examples_per_second").value() > 0
+
+    def test_hook_unregistered_after_stop(self):
+        from paddle_tpu.core import dispatch
+        before = len(dispatch._op_hooks)
+        with profiler.Profiler(timer_only=True):
+            pass
+        assert len(dispatch._op_hooks) == before
+
+    def test_step_info_examples_per_sec_uses_num_samples(self):
+        with profiler.Profiler(timer_only=True) as p:
+            for _ in range(2):
+                time.sleep(0.002)
+                p.step(num_samples=100)
+        info = p.step_info()
+        ips = float(info.split("steps/sec: ")[1].split()[0])
+        eps = float(info.split("examples/sec: ")[1].split()[0])
+        assert eps == pytest.approx(100 * ips, rel=0.05)
+
+    def test_legacy_hook_double_register_unregister_symmetric(self):
+        from paddle_tpu.core import dispatch
+        before = len(dispatch._op_hooks)
+        seen = []
+
+        def legacy(op, ins, outs, attrs):   # 4-arg form
+            seen.append(op)
+
+        dispatch.register_op_hook(legacy)
+        dispatch.register_op_hook(legacy)
+        x = paddle.to_tensor(np.ones((2, 2), np.float32))
+        _ = x + x
+        assert seen.count("add") == 2       # both registrations fire
+        dispatch.unregister_op_hook(legacy)
+        dispatch.unregister_op_hook(legacy)
+        assert len(dispatch._op_hooks) == before
+        assert legacy not in dispatch._hook_adapters
+
+
+class TestChromeExport:
+    def test_merged_trace_valid_and_monotonic(self, tmp_path):
+        net = nn.Linear(8, 8)
+        x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+        with profiler.Profiler(
+                on_trace_ready=profiler.export_chrome_tracing(
+                    str(tmp_path), worker_name="t0")) as p:
+            with profiler.RecordEvent("fwd"):
+                net(x)
+            p.step()
+        assert p.trace_path and os.path.exists(p.trace_path)
+        with open(p.trace_path) as f:
+            doc = json.load(f)            # valid JSON
+        evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert evs, "no complete events exported"
+        ts = [e["ts"] for e in evs]
+        assert ts == sorted(ts)                       # monotonic ts
+        assert all(isinstance(e["ts"], int) for e in evs)
+        assert all(e["dur"] >= 0 for e in evs)
+        cats = {e.get("cat") for e in evs}
+        assert "dispatch" in cats                     # per-op spans
+        assert "user" in cats                         # RecordEvent range
+        names = {e["name"] for e in evs}
+        assert "fwd" in names
+        # a user range must export exactly once (not via _events AND the
+        # span buffer)
+        assert sum(1 for e in evs if e["name"] == "fwd") == 1
+
+    def test_span_overflow_marked_in_trace(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(trace, "MAX_EVENTS", 4)
+        with pytest.warns(UserWarning, match="span buffer overflowed"):
+            with profiler.Profiler(
+                    on_trace_ready=profiler.export_chrome_tracing(
+                        str(tmp_path))) as p:
+                x = paddle.to_tensor(np.ones((2, 2), np.float32))
+                for _ in range(10):
+                    _ = x + x
+                p.step()
+        assert p._spans_dropped > 0
+        with open(p.trace_path) as f:
+            doc = json.load(f)
+        marker = [e for e in doc["traceEvents"]
+                  if e["name"] == "spans_dropped"]
+        assert marker and marker[0]["args"]["count"] == p._spans_dropped
+
+    def test_compile_and_collective_spans_in_one_timeline(self, tmp_path):
+        import paddle_tpu.distributed as dist
+        dist.set_mesh(dist.build_mesh({"dp": 8}))
+
+        @paddle.jit.to_static
+        def step(a):
+            return a * 2.0
+
+        with profiler.Profiler(
+                on_trace_ready=profiler.export_chrome_tracing(
+                    str(tmp_path))) as p:
+            y = step(paddle.to_tensor(np.ones((64, 8), np.float32)))
+            dist.all_reduce(y)
+            p.step()
+        with open(p.trace_path) as f:
+            doc = json.load(f)
+        cats = {e.get("cat") for e in doc["traceEvents"] if e["ph"] == "X"}
+        assert "compile" in cats
+        assert "collective" in cats
+
+
+# ---------------------------------------------------------------------------
+# CLI (satellite)
+# ---------------------------------------------------------------------------
+class TestCLI:
+    def test_dump_live_prometheus(self, capsys):
+        from paddle_tpu.observability.__main__ import main
+        assert main([]) == 0
+        out = capsys.readouterr().out
+        assert "paddle_tpu_device_live_bytes" in out
+
+    def test_dump_snapshot_file_json_and_prom(self, tmp_path, capsys):
+        _enable()
+        metrics.counter("test_cli_total", "from a run",
+                        labelnames=("op",)).inc(op="x")
+        snap_file = tmp_path / "snap.json"
+        snap_file.write_text(json.dumps(REGISTRY.snapshot()))
+        from paddle_tpu.observability.__main__ import main
+        assert main(["--input", str(snap_file)]) == 0
+        assert 'test_cli_total{op="x"} 1' in capsys.readouterr().out
+        assert main(["--input", str(snap_file), "--format", "json"]) == 0
+        assert "test_cli_total" in capsys.readouterr().out
+        assert main(["--input", str(tmp_path / "missing.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# acceptance: one short training loop, everything at once
+# ---------------------------------------------------------------------------
+class TestEndToEnd:
+    def test_training_loop_full_telemetry(self, tmp_path):
+        _enable()
+        net = nn.Linear(16, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters())
+        with profiler.Profiler(
+                on_trace_ready=profiler.export_chrome_tracing(
+                    str(tmp_path))) as p:
+            for _ in range(3):
+                x = paddle.to_tensor(
+                    np.random.randn(8, 16).astype(np.float32))
+                loss = (net(x) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                p.step(num_samples=8)
+        # per-op host latency in summary
+        stats = p.op_stats()
+        assert any(v["total_s"] > 0 for v in stats.values())
+        # chrome trace merges dispatch spans
+        with open(p.trace_path) as f:
+            cats = {e.get("cat") for e in json.load(f)["traceEvents"]}
+        assert "dispatch" in cats
+        # metrics snapshot: dispatch latency + step throughput
+        snap = REGISTRY.snapshot()
+        assert "paddle_tpu_dispatch_op_latency_seconds" in snap
+        assert "paddle_tpu_train_steps_total" in snap
+        assert REGISTRY.get("paddle_tpu_steps_per_second").value() > 0

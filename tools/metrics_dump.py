@@ -1,0 +1,18 @@
+"""Print the paddle_tpu metrics snapshot (Prometheus text or JSON).
+
+Thin wrapper over ``python -m paddle_tpu.observability``:
+
+    python tools/metrics_dump.py                       # live registry
+    python tools/metrics_dump.py --format json
+    python tools/metrics_dump.py --input /tmp/metrics.json
+
+Pair with ``FLAGS_enable_metrics=1 PADDLE_TPU_METRICS_DUMP=/tmp/metrics.json``
+on any training/serving run to capture a snapshot at exit, then render it
+here offline.
+"""
+import sys
+
+from paddle_tpu.observability.__main__ import main
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
