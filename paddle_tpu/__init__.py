@@ -136,7 +136,7 @@ def summary(net, input_size=None, dtypes=None, input=None):
 
 
 _LAZY_MODULES = {
-    "nn", "optimizer", "amp", "io", "jit", "distributed", "vision", "metric",
+    "nn", "optimizer", "amp", "io", "jit", "distributed", "vision", "metric", "fault",
     "profiler", "observability", "autograd", "incubate", "framework", "device", "static", "hapi",
     "distribution", "linalg", "fft", "signal", "sparse", "text", "onnx", "quantization",
     "models", "utils", "inference", "native", "audio", "geometric",

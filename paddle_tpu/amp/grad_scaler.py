@@ -12,6 +12,11 @@ import numpy as np
 
 from ..core import dispatch
 from ..core.tensor import Tensor
+from ..observability import metrics as _metrics
+
+_m_found_inf = _metrics.counter(
+    "paddle_tpu_amp_found_inf_total",
+    "Optimizer steps skipped because unscaled grads contained inf/nan.")
 
 
 class GradScaler:
@@ -48,14 +53,19 @@ class GradScaler:
         if not self._enable or self._unscaled:
             return
         inv = 1.0 / self._scale
-        found = False
+        # per-leaf scalar any() reductions stay on device; ONE stacked
+        # reduction and ONE host transfer decide the whole step (the old
+        # path synced the host once per gradient leaf)
+        flags = []
         for p in optimizer._parameter_list:
             if p.grad is None:
                 continue
             g = p.grad._data.astype(jnp.float32) * inv
-            if bool(jnp.any(~jnp.isfinite(g))):
-                found = True
+            flags.append(jnp.any(~jnp.isfinite(g)))
             p.grad._swap_payload(g.astype(p.grad._data.dtype))
+        found = bool(jnp.any(jnp.stack(flags))) if flags else False  # tpulint: disable=TPU103 — THE one host sync: step/skip is a host-side control decision
+        if found:
+            _m_found_inf.inc()
         self._found_inf = found
         self._unscaled = True
 

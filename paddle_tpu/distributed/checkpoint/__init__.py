@@ -1,4 +1,6 @@
-from .save_state_dict import save_state_dict
+from .save_state_dict import (AsyncSaveHandle, save_state_dict,
+                              wait_async_save)
 from .load_state_dict import load_state_dict
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "AsyncSaveHandle"]

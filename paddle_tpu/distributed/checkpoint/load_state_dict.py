@@ -8,11 +8,17 @@ reassembled into the global value and placed with the *target* tensor's
 NamedSharding via ``jax.device_put`` — the reshard is the placement; XLA
 moves only the bytes each device needs. Works across mesh-shape changes
 (save on {dp:8}, load on {dp:4, mp:2}).
+
+Integrity: v2 metadata carries a CRC32 per chunk; every chunk is verified
+as it is read and a mismatch raises
+:class:`~paddle_tpu.framework.io.CheckpointCorruptError` naming the chunk
+(v1 metadata without checksums still loads).
 """
 from __future__ import annotations
 
 import json
 import os
+import zlib
 from typing import Dict
 
 import jax
@@ -20,8 +26,21 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...core.tensor import Tensor
+from ...framework.io import CheckpointCorruptError
 
 _METADATA = "metadata.json"
+
+
+def _read_metadata(path: str) -> Dict[str, dict]:
+    mpath = os.path.join(path, _METADATA)
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            mpath, "metadata", f"undecodable metadata.json: {e}") from e
+    # v2 wraps the tensor table under "state"; v1 is the flat table
+    return doc["state"] if isinstance(doc, dict) and "state" in doc else doc
 
 
 def _assemble(entry: dict, files: Dict[str, "np.lib.npyio.NpzFile"],
@@ -35,7 +54,18 @@ def _assemble(entry: dict, files: Dict[str, "np.lib.npyio.NpzFile"],
         fname = chunk["file"]
         if fname not in files:
             files[fname] = np.load(os.path.join(path, fname))
-        data = files[fname][chunk["key"]]
+        try:
+            data = files[fname][chunk["key"]]
+        except KeyError as e:
+            raise CheckpointCorruptError(
+                os.path.join(path, fname), f"chunk {chunk['key']!r}",
+                "missing from shard file — torn or mismatched save") from e
+        want_crc = chunk.get("crc32")
+        if want_crc is not None and \
+                zlib.crc32(np.ascontiguousarray(data)) != want_crc:
+            raise CheckpointCorruptError(
+                os.path.join(path, fname), f"chunk {chunk['key']!r}",
+                "checksum mismatch")
         idx = tuple(slice(o, o + l) for o, l in
                     zip(chunk["offsets"], chunk["lengths"]))
         out[idx] = data
@@ -54,11 +84,10 @@ def load_state_dict(state_dict: Dict, path: str, process_group=None,
     """Fill ``state_dict``'s tensors in place from the checkpoint at
     ``path``; each tensor keeps its CURRENT sharding (the target
     distribution), which may differ from the one it was saved with."""
-    with open(os.path.join(path, _METADATA)) as f:
-        meta = json.load(f)
+    meta = _read_metadata(path)
     files: Dict[str, object] = {}
     for name, value in state_dict.items():
-        if name not in meta:
+        if name not in meta:  # tpulint: disable=TPU105 — `name` is a state_dict KEY string, not a tensor
             raise KeyError(f"checkpoint at {path!r} has no tensor {name!r}")
         entry = meta[name]
         global_np = _assemble(entry, files, path)

@@ -42,10 +42,22 @@ import time as _time
 
 from ...core import dispatch
 from ...core.tensor import Tensor, as_tensor
+from ...fault import inject as _inject
+from ...fault.retry import RetryPolicy, retry as _retry
 from ...observability import metrics as _metrics
 from ...observability import trace as _trace
 from .. import mesh as mesh_mod
 from .group import Group, get_default_group
+
+#: retry schedule for the host-side object collectives — these ride the
+#: coordination channel (gRPC/pickle), where a stuck peer produces a
+#: TimeoutError that a bounded backoff normally rides out
+_OBJ_COLL_POLICY = RetryPolicy(max_attempts=4, base_delay=0.01,
+                               max_delay=0.1, jitter=0.0,
+                               retry_on=(TimeoutError, OSError))
+
+#: most recent completed collective, for watchdog hang diagnostics
+LAST_COLLECTIVE = {"op": None, "t": 0.0}
 
 # Collective telemetry (gated by FLAGS_enable_metrics / an active
 # profiler trace session; off = one dict lookup per collective)
@@ -69,8 +81,12 @@ def _coll_begin():
 
 
 def _coll_end(name: str, payload, t0):
+    LAST_COLLECTIVE["op"] = name     # one dict write; no clock read
     if t0 is None:
         return
+    # timestamp (for hang-age reporting) only when telemetry is already
+    # paying for clocks — the disabled path stays at its documented cost
+    LAST_COLLECTIVE["t"] = _time.monotonic()
     t1 = _time.perf_counter()
     nbytes = int(getattr(payload, "nbytes", 0) or 0)
     if _metrics.enabled():
@@ -210,10 +226,19 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 def all_gather_object(object_list, obj, group=None):
     """Host-side object gather. Single-controller: every 'rank' holds the
     same object, so this replicates (reference all_gather_object is a
-    pickle-over-NCCL convenience)."""
+    pickle-over-NCCL convenience). Guarded by the ``collective.timeout``
+    fault point and retried with backoff — the host object channel is the
+    part of a collective that an unhealthy peer can actually stall."""
     g = _group(group)
+
+    def attempt():
+        _inject.check("collective.timeout", exc=TimeoutError)
+        return [obj] * g.nranks
+
+    gathered = _retry(attempt, policy=_OBJ_COLL_POLICY,
+                      site="all_gather_object")
     del object_list[:]
-    object_list.extend([obj] * g.nranks)
+    object_list.extend(gathered)
     return object_list
 
 
@@ -306,15 +331,22 @@ def broadcast_object_list(object_list, src=0, group=None):
 
     import numpy as np
 
-    for i, obj in enumerate(object_list):
-        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
-        n = Tensor(jnp.asarray([payload.size], jnp.int32))
-        broadcast(n, src=src, group=group)
-        t = Tensor(jnp.asarray(payload))
-        broadcast(t, src=src, group=group)
-        object_list[i] = pickle.loads(
-            np.asarray(t._data, dtype=np.uint8).tobytes())
-    return object_list
+    def attempt():
+        # idempotent: re-running after a mid-list failure re-broadcasts
+        # the same values into the same slots
+        _inject.check("collective.timeout", exc=TimeoutError)
+        for i, obj in enumerate(object_list):
+            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+            n = Tensor(jnp.asarray([payload.size], jnp.int32))
+            broadcast(n, src=src, group=group)
+            t = Tensor(jnp.asarray(payload))
+            broadcast(t, src=src, group=group)
+            object_list[i] = pickle.loads(
+                np.asarray(t._data, dtype=np.uint8).tobytes())
+        return object_list
+
+    return _retry(attempt, policy=_OBJ_COLL_POLICY,
+                  site="broadcast_object_list")
 
 
 @functools.lru_cache(maxsize=512)

@@ -34,6 +34,8 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self._hook = None
         self.hang_count = 0
+        self.last_op: Optional[str] = None
+        self.last_op_t = 0.0
 
     # ------------------------------------------------------------- progress
     def heartbeat(self):
@@ -55,6 +57,8 @@ class Watchdog:
         from ..core import dispatch
 
         def hook(op_name, inputs, outputs, attrs, duration=0.0):
+            self.last_op = op_name
+            self.last_op_t = time.monotonic()
             self.heartbeat()
         self._hook = hook
         dispatch.register_op_hook(hook)
@@ -71,6 +75,10 @@ class Watchdog:
                         f"[watchdog] no progress for >{self.timeout}s with "
                         f"work in flight — dumping thread stacks\n")
                     faulthandler.dump_traceback(file=sys.stderr)
+                    try:
+                        self.dump_diagnostics()
+                    except Exception:
+                        pass   # diagnostics must never mask the hang
                     if self.on_hang is not None:
                         try:
                             self.on_hang(self)
@@ -85,6 +93,51 @@ class Watchdog:
                                         name="paddle_tpu_watchdog")
         self._thread.start()
         return self
+
+    def dump_diagnostics(self, file=None):
+        """Post-mortem context for a hang, written BEFORE the abort
+        handler runs: last dispatched op, last completed collective, the
+        observability span-buffer tail, and a metrics snapshot (step
+        counters, collective calls, cache rates — whatever is enabled).
+        A hang report without this is a stack dump with no timeline."""
+        import json
+
+        out = file or sys.stderr
+        now = time.monotonic()
+        out.write("[watchdog] ---- hang diagnostics ----\n")
+        if self.last_op is not None:
+            out.write(f"[watchdog] last op: {self.last_op!r} "
+                      f"({now - self.last_op_t:.1f}s ago)\n")
+        else:
+            out.write("[watchdog] last op: <none dispatched>\n")
+        try:
+            from .communication.collective import LAST_COLLECTIVE
+            if LAST_COLLECTIVE["op"] is not None:
+                age = (f"{now - LAST_COLLECTIVE['t']:.1f}s ago"
+                       if LAST_COLLECTIVE["t"] else "age unknown — "
+                       "telemetry off")
+                out.write(
+                    f"[watchdog] last collective: "
+                    f"{LAST_COLLECTIVE['op']!r} ({age})\n")
+            else:
+                out.write("[watchdog] last collective: <none>\n")
+        except Exception:
+            pass
+        try:
+            from ..observability import REGISTRY, trace
+            spans = trace.tail(50)
+            out.write(f"[watchdog] span buffer tail "
+                      f"({len(spans)} spans):\n")
+            for name, cat, t0, t1, tid, args in spans:
+                out.write(f"[watchdog]   {cat}:{name} "
+                          f"dur={t1 - t0:.6f}s tid={tid}\n")
+            snap = REGISTRY.snapshot()
+            out.write("[watchdog] metrics snapshot: "
+                      + json.dumps(snap, sort_keys=True, default=str)
+                      + "\n")
+        except Exception as e:
+            out.write(f"[watchdog] observability dump failed: {e}\n")
+        out.write("[watchdog] ---- end diagnostics ----\n")
 
     def stop(self):
         self._stop.set()

@@ -1,0 +1,46 @@
+"""paddle_tpu.fault — the reliability layer for training.
+
+Three pieces, built to be provable:
+
+- **Atomic + verified checkpoints** — ``framework.io`` saves via
+  temp-file → fsync → rename with a checksummed v2 footer;
+  :class:`CheckpointManager` adds rotation (``keep_n``), a manifest of
+  completed saves, and ``restore()`` that falls back past a corrupt or
+  partial checkpoint to the last verifiable one.
+- **Retry/backoff** — :func:`retry` with exponential backoff, jitter and
+  a deadline, used by checkpoint I/O and the host-side object
+  collectives; exhaustion re-raises the original error.
+- **Deterministic fault injection** — :mod:`.inject` names failure
+  points (``io.write_truncate_after_bytes``, ``io.rename_fail``,
+  ``collective.timeout``, ``grads.nan_at_step``) that production code
+  guards at near-zero cost and tests arm to prove every recovery path
+  end-to-end.
+
+``CheckpointManager`` and the train-state helpers resolve lazily because
+they sit above ``framework.io``, which itself guards its writes with
+:mod:`.inject` (the package must be importable from below).
+"""
+from __future__ import annotations
+
+import importlib
+
+from . import inject
+from .inject import InjectedFault
+from .retry import RetryPolicy, retry
+
+__all__ = ["inject", "InjectedFault", "RetryPolicy", "retry",
+           "CheckpointManager", "auto_resume", "capture_train_state",
+           "restore_train_state"]
+
+_LAZY = {"CheckpointManager", "auto_resume", "capture_train_state",
+         "restore_train_state"}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        mod = importlib.import_module(".checkpoint_manager", __name__)
+        for n in _LAZY:
+            globals()[n] = getattr(mod, n)
+        return globals()[name]
+    raise AttributeError(
+        f"module 'paddle_tpu.fault' has no attribute {name!r}")

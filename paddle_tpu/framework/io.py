@@ -1,4 +1,4 @@
-"""Single-process save/load.
+"""Single-process save/load — atomic, verified checkpoints.
 
 Reference: python/paddle/framework/io.py (save:743 / load:985 — the
 reference chunks large pickles to dodge the 4 GB single-bytes limits of
@@ -10,54 +10,120 @@ materializes a second copy in memory and no pickle frame approaches the
 4 GB limits regardless of protocol. bfloat16 arrays round-trip natively
 (ml_dtypes numpy dtype).
 
-Layout: ``magic | u64 pickle_len | pickle | raw segments… | footer
-pickle | u64 footer_off`` — the footer maps placeholder index ->
-(offset, nbytes, dtype, shape). Legacy plain-pickle files (round-2
-checkpoints) still load.
+Durability contract (format v2):
+
+- **Atomic publish** — ``save`` writes to a same-directory temp file,
+  flushes + fsyncs it, then ``os.replace``\\ s onto the destination and
+  fsyncs the directory. A crash at ANY instant leaves the destination
+  either absent or holding the complete previous checkpoint — never a
+  torn file.
+- **Verified load** — the v2 footer carries a CRC32 per raw segment, a
+  CRC32 of the pickle blob, and a whole-blob digest over everything
+  before the footer; ``load(path, verify=True)`` (the default) detects
+  truncation and bit-rot with a :class:`CheckpointCorruptError` naming
+  the offending section (``header`` / ``pickle`` / ``segment i ('key')``
+  / ``footer`` / ``trailer``).
+
+Layout (v2): ``magic2 | u64 pickle_len | pickle | raw segments… | footer
+pickle | u64 footer_off | u64 footer_len | u32 footer_crc | end-magic``.
+The footer maps placeholder index -> (offset, nbytes, dtype, shape, crc)
+plus the key path of each segment for precise corruption reports. Legacy
+v1 (``PTCKPT01``) and round-2 plain-pickle files still load (with
+structural bounds validation instead of checksums — v1 carries none).
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import pickle
 import struct
+import time
+import zlib
 
 import jax.numpy as jnp
 import numpy as np
 
 from ..core import dtype as dtypes
 from ..core.tensor import Tensor
+from ..fault import inject as _inject
+from ..observability import metrics as _metrics
 
 _BF16_TAG = "__bf16__"
 _EXT_TAG = "__ext_seg__"
-_MAGIC = b"PTCKPT01"
+_MAGIC = b"PTCKPT01"            # legacy v1: no checksums
+_MAGIC2 = b"PTCKPT02"           # v2: per-segment CRC32 + whole-blob digest
+_END_MAGIC = b"PTCKEND2"
+_TRAILER = struct.Struct("<QQI")  # footer_off, footer_len, footer_crc
 _SEG_THRESHOLD = 1 << 20        # arrays >= 1 MB stream as raw segments
 _CHUNK = 64 << 20               # 64 MB write/read granularity
+
+_m_save_seconds = _metrics.histogram(
+    "paddle_tpu_ckpt_save_seconds", "Wall time of framework.io.save.")
+_m_save_bytes = _metrics.counter(
+    "paddle_tpu_ckpt_save_bytes_total", "Bytes written by framework.io.save.")
+_m_load_seconds = _metrics.histogram(
+    "paddle_tpu_ckpt_load_seconds", "Wall time of framework.io.load.")
+_m_corruption = _metrics.counter(
+    "paddle_tpu_ckpt_corruption_detected_total",
+    "Checkpoint loads rejected by integrity checking, per section.",
+    labelnames=("section",))
+
+
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed structural or checksum validation. ``section``
+    names the damaged region precisely enough to tell truncation (trailer/
+    segment bounds) from bit-rot (checksum mismatch)."""
+
+    def __init__(self, path, section, detail):
+        self.path = str(path)
+        self.section = section
+        self.detail = detail
+        super().__init__(
+            f"corrupt checkpoint {str(path)!r}: {section}: {detail}")
+
+    def __reduce__(self):
+        # Exception.__reduce__ would replay args=(message,) into the
+        # 3-arg __init__ and break crossing process boundaries
+        return (type(self), (self.path, self.section, self.detail))
+
+
+def _corrupt(path, section, detail) -> CheckpointCorruptError:
+    """Count the detection and build the error (metric lives at the
+    raise site, not in the constructor, so unpickling a propagated error
+    never double-counts)."""
+    _m_corruption.inc(section=section.split(" ")[0])
+    return CheckpointCorruptError(path, section, detail)
 
 
 def _to_numpy(arr) -> np.ndarray:
     return np.asarray(arr)
 
 
-def _pack(obj, segments):
+def _pack(obj, segments, names, prefix=""):
     if isinstance(obj, Tensor):
         obj = obj._data
         # fall through: payloads serialize as arrays, tagged for rehydrate
         arr = _to_numpy(obj)
         if arr.nbytes >= _SEG_THRESHOLD:
             segments.append(arr)
+            names.append(prefix or f"<segment {len(segments) - 1}>")
             return {_EXT_TAG: len(segments) - 1, "tensor": True}
         return {"__tensor__": True, "data": arr}
     if isinstance(obj, (jnp.ndarray, np.ndarray)) and not np.isscalar(obj):
         arr = _to_numpy(obj)
         if arr.nbytes >= _SEG_THRESHOLD:
             segments.append(arr)
+            names.append(prefix or f"<segment {len(segments) - 1}>")
             return {_EXT_TAG: len(segments) - 1, "tensor": False}
         return arr
     if isinstance(obj, dict):
-        return {k: _pack(v, segments) for k, v in obj.items()}
+        return {k: _pack(v, segments, names,
+                         f"{prefix}.{k}" if prefix else str(k))
+                for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):
         t = type(obj)
-        return t(_pack(v, segments) for v in obj)
+        return t(_pack(v, segments, names, f"{prefix}[{i}]")
+                 for i, v in enumerate(obj))
     return obj
 
 
@@ -86,29 +152,127 @@ def _unpack(obj, seg_arrays):
     return obj
 
 
-def _write_segment(f, arr: np.ndarray) -> tuple:
-    offset = f.tell()
+class _CheckedWriter:
+    """Write-through wrapper that maintains the whole-blob digest and a
+    resettable per-region CRC, and honors the
+    ``io.write_truncate_after_bytes`` fault point: once the armed byte
+    budget is exhausted the writer persists only the prefix that fits and
+    raises — the torn temp file this leaves behind is exactly what a crash
+    or full disk produces, which the atomic-publish path must survive."""
+
+    def __init__(self, f):
+        self._f = f
+        self.digest = 0
+        self.region_crc = 0
+        self.written = 0
+        params = _inject.peek("io.write_truncate_after_bytes")
+        self._truncate_after = None if params is None else \
+            int(params.get("after_bytes", 0))
+
+    def begin_region(self):
+        self.region_crc = 0
+
+    def write(self, data):
+        data = memoryview(data)
+        if self._truncate_after is not None and \
+                self.written + len(data) > self._truncate_after:
+            keep = max(self._truncate_after - self.written, 0)
+            if keep:
+                self._f.write(data[:keep])
+                self.written += keep
+            self._f.flush()
+            _inject.fire("io.write_truncate_after_bytes")
+            raise _inject.InjectedFault(
+                "io.write_truncate_after_bytes",
+                f"write truncated after {self.written} bytes")
+        self._f.write(data)
+        self.digest = zlib.crc32(data, self.digest)
+        self.region_crc = zlib.crc32(data, self.region_crc)
+        self.written += len(data)
+
+    def tell(self):
+        return self._f.tell()
+
+
+def _write_segment(w: _CheckedWriter, arr: np.ndarray) -> tuple:
+    offset = w.tell()
+    w.begin_region()
     view = memoryview(np.ascontiguousarray(arr).reshape(-1).view(np.uint8))
     for pos in range(0, len(view), _CHUNK):
-        f.write(view[pos:pos + _CHUNK])
-    return (offset, arr.nbytes, str(arr.dtype), tuple(arr.shape))
+        w.write(view[pos:pos + _CHUNK])
+    if not len(view):
+        w.write(b"")
+    return (offset, arr.nbytes, str(arr.dtype), tuple(arr.shape),
+            w.region_crc)
 
 
-def _read_segment(f, offset, nbytes, dtype, shape) -> np.ndarray:
+def _read_segment(f, offset, nbytes, dtype, shape, want_crc=True):
+    """Read one raw segment; returns (array, crc32-of-bytes or 0 when
+    ``want_crc`` is off — verify=False must not pay for checksums)."""
     out = np.empty(int(np.prod(shape)) if shape else 1, np.dtype(dtype))
     buf = out.view(np.uint8).reshape(-1)
     f.seek(offset)
     pos = 0
+    crc = 0
     while pos < nbytes:
         n = f.readinto(memoryview(buf)[pos:pos + _CHUNK])
         if not n:
             raise EOFError(f"truncated checkpoint segment at {offset}")
+        if want_crc:
+            crc = zlib.crc32(memoryview(buf)[pos:pos + n], crc)
         pos += n
-    return out.reshape(shape)
+    return out.reshape(shape), crc
+
+
+def _fsync_dir(dirname):
+    """Durably record the rename in the directory (POSIX crash-consistency
+    contract); best-effort on platforms without directory fds."""
+    try:
+        fd = os.open(dirname or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_replace(tmp: str, dst: str):
+    """The shared publish step of every atomic write in the framework:
+    ``io.rename_fail`` guard → ``os.replace`` → directory fsync. Using
+    one helper keeps the durability and fault-injection behavior uniform
+    across framework.io, the distributed checkpoint, and the manager
+    manifest."""
+    _inject.check("io.rename_fail", exc=OSError)
+    os.replace(tmp, dst)
+    _fsync_dir(os.path.dirname(dst))
+
+
+@contextlib.contextmanager
+def atomic_file(dst: str, tmp_suffix: str = ""):
+    """Yield a same-directory temp path; on clean exit publish it onto
+    ``dst`` via :func:`atomic_replace`, on ANY error unlink it and
+    re-raise. The caller writes + fsyncs the temp file inside the block
+    (``tmp_suffix`` accommodates writers that dictate an extension, e.g.
+    ``np.savez``)."""
+    tmp = f"{dst}.tmp.{os.getpid()}{tmp_suffix}"
+    try:
+        yield tmp
+        atomic_replace(tmp, dst)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def save(obj, path, protocol=4, **configs):
-    """Persist ``obj`` (state_dict / nested containers / Tensors).
+    """Persist ``obj`` (state_dict / nested containers / Tensors)
+    atomically: temp file → flush/fsync → ``os.replace`` → directory
+    fsync. The destination never holds a torn checkpoint.
 
     ``protocol`` is pinned to the 2..5 range (reference io.py contract);
     large arrays bypass pickle entirely, so any allowed protocol handles
@@ -118,40 +282,202 @@ def save(obj, path, protocol=4, **configs):
         raise ValueError(
             f"pickle protocol must be in [2, {pickle.HIGHEST_PROTOCOL}], "
             f"got {protocol}")
+    path = str(path)
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    segments = []
-    packed = _pack(obj, segments)
+    segments, names = [], []
+    packed = _pack(obj, segments, names)
     blob = pickle.dumps(packed, protocol=int(protocol))
-    with open(path, "wb") as f:
-        f.write(_MAGIC)
-        f.write(struct.pack("<Q", len(blob)))
-        f.write(blob)
-        index = [_write_segment(f, arr) for arr in segments]
-        footer = pickle.dumps(index, protocol=int(protocol))
-        footer_off = f.tell()
-        f.write(footer)
-        f.write(struct.pack("<Q", footer_off))
+    t0 = time.perf_counter()
+    with atomic_file(path) as tmp:
+        with open(tmp, "wb") as raw:
+            w = _CheckedWriter(raw)
+            w.write(_MAGIC2)
+            w.write(struct.pack("<Q", len(blob)))
+            w.write(blob)
+            pickle_crc = zlib.crc32(blob)
+            index = [_write_segment(w, arr) for arr in segments]
+            footer = pickle.dumps(
+                {"format": 2, "index": index, "seg_names": names,
+                 "pickle_crc": pickle_crc, "digest": w.digest},
+                protocol=int(protocol))
+            footer_off = w.tell()
+            w.write(footer)
+            w.write(_TRAILER.pack(footer_off, len(footer),
+                                  zlib.crc32(footer)))
+            w.write(_END_MAGIC)
+            total = w.written
+            raw.flush()
+            _inject.check("io.fsync_fail", exc=OSError)
+            os.fsync(raw.fileno())
+    _m_save_seconds.observe(time.perf_counter() - t0)
+    _m_save_bytes.inc(total)
 
 
-def load(path, **configs):
+def load(path, verify=True, **configs):
+    """Load a checkpoint. ``verify=True`` (default) checks the v2 footer
+    CRC, the pickle-blob CRC, every segment CRC, and the whole-blob
+    digest, raising :class:`CheckpointCorruptError` that names the
+    damaged section. Structural bounds are validated in every mode and
+    for every format, so truncated files fail with a clear error instead
+    of ``struct.error``/``EOFError``."""
+    path = str(path)
+    t0 = time.perf_counter()
     with open(path, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            # legacy round-2 format: one plain pickle
-            f.seek(0)
-            return _unpack_legacy(pickle.load(f))
-        (blob_len,) = struct.unpack("<Q", f.read(8))
-        packed = pickle.loads(f.read(blob_len))
-        f.seek(-8, os.SEEK_END)
-        (footer_off,) = struct.unpack("<Q", f.read(8))
-        f.seek(footer_off)
-        end = f.seek(0, os.SEEK_END) - 8
-        f.seek(footer_off)
-        index = pickle.loads(f.read(end - footer_off))
-        seg_arrays = [_read_segment(f, *entry) for entry in index]
-        return _unpack(packed, seg_arrays)
+        size = os.fstat(f.fileno()).st_size
+        magic = f.read(len(_MAGIC2))
+        if magic == _MAGIC2:
+            out = _load_v2(f, size, path, verify)
+        elif magic == _MAGIC:
+            out = _load_v1(f, size, path)
+        else:
+            out = _load_legacy(f, size, path)
+    _m_load_seconds.observe(time.perf_counter() - t0)
+    return out
+
+
+def _load_v2(f, size, path, verify):
+    header_len = len(_MAGIC2) + 8
+    trailer_len = _TRAILER.size + len(_END_MAGIC)
+    if size < header_len + trailer_len:
+        raise _corrupt(
+            path, "trailer", f"file is {size} bytes — truncated below the "
+            f"minimum v2 layout ({header_len + trailer_len} bytes)")
+    (blob_len,) = struct.unpack("<Q", f.read(8))
+    if header_len + blob_len > size - trailer_len:
+        raise _corrupt(
+            path, "pickle", f"pickle length {blob_len} exceeds file bounds "
+            f"(file is {size} bytes) — truncated or corrupt header")
+    blob = f.read(blob_len)
+    f.seek(size - trailer_len)
+    trailer = f.read(_TRAILER.size)
+    if f.read(len(_END_MAGIC)) != _END_MAGIC:
+        raise _corrupt(
+            path, "trailer", "end marker missing — file truncated "
+            "mid-write or trailing bytes corrupted")
+    footer_off, footer_len, footer_crc = _TRAILER.unpack(trailer)
+    if footer_off < header_len + blob_len or \
+            footer_off + footer_len != size - trailer_len:
+        raise _corrupt(
+            path, "footer", f"footer bounds (offset={footer_off}, "
+            f"length={footer_len}) inconsistent with file size {size}")
+    f.seek(footer_off)
+    footer_bytes = f.read(footer_len)
+    if zlib.crc32(footer_bytes) != footer_crc:
+        raise _corrupt(path, "footer", "checksum mismatch")
+    try:
+        meta = pickle.loads(footer_bytes)
+        index = meta["index"]
+        seg_names = meta.get("seg_names", [])
+    except Exception as e:
+        raise _corrupt(
+            path, "footer", f"undecodable footer: {e}") from e
+    if verify and zlib.crc32(blob) != meta["pickle_crc"]:
+        raise _corrupt(path, "pickle", "checksum mismatch")
+    try:
+        packed = pickle.loads(blob)
+    except Exception as e:
+        raise _corrupt(
+            path, "pickle", f"undecodable pickle blob: {e}") from e
+    digest = zlib.crc32(blob, zlib.crc32(
+        _MAGIC2 + struct.pack("<Q", blob_len))) if verify else 0
+    seg_arrays = []
+    for i, entry in enumerate(index):
+        offset, nbytes, dtype, shape, crc = entry
+        name = seg_names[i] if i < len(seg_names) else f"<segment {i}>"
+        label = f"segment {i} ({name!r})"
+        if offset + nbytes > footer_off:
+            raise _corrupt(
+                path, label, f"segment bounds (offset={offset}, "
+                f"nbytes={nbytes}) overrun the data region — truncated "
+                "or corrupt footer")
+        try:
+            arr, got_crc = _read_segment(f, offset, nbytes, dtype, shape,
+                                         want_crc=verify)
+        except (EOFError, OSError, ValueError) as e:
+            raise _corrupt(
+                path, label, f"unreadable segment: {e}") from e
+        if verify:
+            if got_crc != crc:
+                raise _corrupt(path, label, "checksum mismatch")
+            if arr.size:
+                digest = zlib.crc32(arr.reshape(-1).view(np.uint8), digest)
+        seg_arrays.append(arr)
+    if verify and digest != meta["digest"]:
+        raise _corrupt(
+            path, "digest", "whole-blob digest mismatch — data region "
+            "altered outside any segment")
+    return _unpack(packed, seg_arrays)
+
+
+def _load_v1(f, size, path):
+    """Legacy v1 (no checksums): structural bounds validation so a
+    truncated file raises a clear corruption error instead of a confusing
+    ``struct.error``/``EOFError``."""
+    header_len = len(_MAGIC) + 8
+    if size < header_len + 8:
+        raise _corrupt(
+            path, "header", f"file is {size} bytes — truncated below the "
+            f"minimum v1 layout ({header_len + 8} bytes)")
+    (blob_len,) = struct.unpack("<Q", f.read(8))
+    if header_len + blob_len > size - 8:
+        raise _corrupt(
+            path, "pickle", f"pickle length {blob_len} exceeds file bounds "
+            f"(file is {size} bytes) — truncated or corrupt header")
+    blob = f.read(blob_len)
+    try:
+        packed = pickle.loads(blob)
+    except Exception as e:
+        raise _corrupt(
+            path, "pickle", f"undecodable pickle blob: {e}") from e
+    f.seek(size - 8)
+    (footer_off,) = struct.unpack("<Q", f.read(8))
+    if not header_len + blob_len <= footer_off <= size - 8:
+        raise _corrupt(
+            path, "footer", f"footer offset {footer_off} out of bounds "
+            f"(file is {size} bytes) — truncated or corrupt trailer")
+    f.seek(footer_off)
+    try:
+        index = pickle.loads(f.read(size - 8 - footer_off))
+    except Exception as e:
+        raise _corrupt(
+            path, "footer", f"undecodable footer: {e}") from e
+    seg_arrays = []
+    for i, entry in enumerate(index):
+        offset, nbytes, dtype, shape = entry
+        if offset + nbytes > footer_off:
+            raise _corrupt(
+                path, f"segment {i}", f"segment bounds (offset={offset}, "
+                f"nbytes={nbytes}) overrun the data region")
+        try:
+            arr, _ = _read_segment(f, offset, nbytes, dtype, shape,
+                                   want_crc=False)   # v1 has no checksums
+        except (EOFError, OSError, ValueError) as e:
+            raise _corrupt(
+                path, f"segment {i}", f"unreadable segment: {e}") from e
+        seg_arrays.append(arr)
+    return _unpack(packed, seg_arrays)
+
+
+def _load_legacy(f, size, path):
+    # no magic: round-2 plain-pickle — but a v2 file whose header magic
+    # was bit-flipped still carries the end marker; report THAT as
+    # corruption, not as an unpicklable legacy file
+    if size >= len(_END_MAGIC):
+        f.seek(size - len(_END_MAGIC))
+        if f.read(len(_END_MAGIC)) == _END_MAGIC:
+            raise _corrupt(
+                path, "header", "magic bytes corrupted (v2 end marker "
+                "present but header does not match)")
+    f.seek(0)
+    try:
+        obj = pickle.load(f)
+    except Exception as e:
+        raise _corrupt(
+            path, "header", f"not a paddle_tpu checkpoint and not a "
+            f"legacy pickle: {e}") from e
+    return _unpack_legacy(obj)
 
 
 def _unpack_legacy(obj):

@@ -10,11 +10,19 @@ composition.
 """
 from __future__ import annotations
 
+import math
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..fault import inject as _inject
+from ..observability import metrics as _metrics
+
+_m_skipped = _metrics.counter(
+    "paddle_tpu_train_nonfinite_skipped_total",
+    "Optimizer steps skipped because the loss went non-finite "
+    "(graceful degradation instead of poisoning the weights).")
 
 
 def _to_list(x):
@@ -32,6 +40,11 @@ class Model:
         self._loss = None
         self._metrics: List = []
         self.stop_training = False
+        #: monotonically increasing train-batch counter; persisted by
+        #: manager-mode ModelCheckpoint and restored by fit(resume=...)
+        self._global_step = 0
+        #: count of steps skipped on non-finite loss (this run)
+        self._nonfinite_steps = 0
 
     # ------------------------------------------------------------- prepare
     def prepare(self, optimizer=None, loss=None, metrics=None,
@@ -53,13 +66,24 @@ class Model:
         self.network.train()
         outputs = self.network(*_to_list(inputs))
         loss = self._compute_loss(outputs, labels)
+        if _inject.fire("grads.nan_at_step",
+                        step=self._global_step) is not None:
+            loss = loss * float("nan")   # deterministic divergence for tests
         loss.backward()
+        loss_val = float(loss.numpy())
         if update and self._optimizer is not None:
-            self._optimizer.step()
+            if math.isfinite(loss_val):
+                self._optimizer.step()
+            else:
+                # graceful degradation: a non-finite loss means the grads
+                # are poison — drop them and keep the weights intact
+                # rather than stepping the run into NaN
+                self._nonfinite_steps += 1
+                _m_skipped.inc()
             self._optimizer.clear_grad()
+        self._global_step += 1
         metrics = self._update_metrics(outputs, labels)
-        return ([float(loss.numpy())], metrics) if metrics else \
-            [float(loss.numpy())]
+        return ([loss_val], metrics) if metrics else [loss_val]
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
@@ -88,7 +112,12 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
+            callbacks=None, resume=None):
+        """``resume``: a :class:`paddle_tpu.fault.CheckpointManager` —
+        restores model/optimizer (+ GradScaler, when a manager-mode
+        ModelCheckpoint callback carries one) from the newest verifiable
+        checkpoint and fast-forwards the epoch/step counters, skipping
+        past a corrupt latest checkpoint automatically."""
         from ..io import DataLoader
         from .callbacks import CallbackList
         loader = train_data
@@ -101,15 +130,22 @@ class Model:
         cbks.set_params({"epochs": epochs, "batch_size": batch_size,
                          "verbose": verbose, "save_dir": save_dir,
                          "metrics": [m.name() for m in self._metrics]})
+        start_epoch, skip_steps = 0, 0
+        if resume is not None:
+            start_epoch, skip_steps = self._auto_resume(resume,
+                                                        cbks.callbacks,
+                                                        verbose)
         self.stop_training = False
         history = []
         cbks.on_train_begin()
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             cbks.on_epoch_begin(epoch)
             for m in self._metrics:
                 m.reset()
             losses = []
             for step, batch in enumerate(loader):
+                if epoch == start_epoch and step < skip_steps:
+                    continue   # step-granular resume: already trained
                 cbks.on_train_batch_begin(step)
                 batch = _to_list(batch)
                 xs, ys = batch[:-1], batch[-1:]
@@ -124,8 +160,13 @@ class Model:
                         msg += f" {m.name()}={v}"
                     print(msg)
                 cbks.on_train_batch_end(step, {"loss": loss})
-            epoch_logs = {"loss": float(np.mean(losses))}
-            history.append(epoch_logs["loss"])
+            if losses:
+                epoch_logs = {"loss": float(np.mean(losses))}
+                history.append(epoch_logs["loss"])
+            else:
+                # resume skipped the whole epoch: no new training, so no
+                # loss to report (np.mean([]) would hand callbacks a NaN)
+                epoch_logs = {}
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 eval_res = self.evaluate(eval_data, batch_size=batch_size,
                                          verbose=verbose,
@@ -144,6 +185,33 @@ class Model:
                 break
         cbks.on_train_end({"loss": history[-1] if history else None})
         return history
+
+    def _auto_resume(self, manager, callbacks, verbose):
+        """Restore train state from ``manager`` and translate its meta
+        into (start_epoch, steps-to-skip in that epoch)."""
+        from ..fault import auto_resume
+        scaler = None
+        for c in callbacks:
+            scaler = getattr(c, "scaler", None) or scaler
+        meta = auto_resume(manager, network=self.network,
+                           optimizer=self._optimizer, scaler=scaler)
+        if meta is None:
+            return 0, 0
+        self._global_step = int(meta.get("step", 0))
+        epoch = meta.get("epoch")
+        if epoch is None:
+            return 0, 0
+        if meta.get("epoch_complete", True):
+            start_epoch, skip_steps = int(epoch) + 1, 0
+        else:
+            start_epoch = int(epoch)
+            skip_steps = int(meta.get("step_in_epoch", -1)) + 1
+        if verbose:
+            print(f"[resume] restored step {self._global_step} "
+                  f"(epoch {start_epoch}, skipping {skip_steps} "
+                  f"completed steps; fallback depth "
+                  f"{manager.last_fallback_depth})")
+        return start_epoch, skip_steps
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):

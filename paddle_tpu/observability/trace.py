@@ -104,5 +104,14 @@ def drain() -> List[Tuple[str, str, float, float, int, Optional[dict]]]:
     return out
 
 
+def tail(n: int = 100) -> List[Tuple[str, str, float, float, int,
+                                     Optional[dict]]]:
+    """Newest ``n`` spans WITHOUT clearing the buffer — hang/crash
+    diagnostics (the watchdog dumps this post-mortem; the profiler's
+    export still sees everything)."""
+    with _lock:
+        return list(_events[-n:]) if n else []
+
+
 def dropped() -> int:
     return _dropped["n"]

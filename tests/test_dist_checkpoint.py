@@ -62,7 +62,9 @@ def test_chunked_files_on_disk(tmp_path, restore_mesh):
     dist.save_state_dict({"w": _sharded_tensor(w, mesh, P("dp"))},
                          str(tmp_path))
     with open(os.path.join(str(tmp_path), "metadata.json")) as f:
-        meta = json.load(f)
+        doc = json.load(f)
+    meta = doc["state"]   # round-9 v2 metadata wraps the tensor table
+    assert doc["version"] == 2
     # 8 distinct slices of rows, one per dp shard
     assert len(meta["w"]["chunks"]) == 8
     assert meta["w"]["shape"] == [8, 4]

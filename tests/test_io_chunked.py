@@ -23,7 +23,7 @@ class TestChunkedFormat:
         path = str(tmp_path / "chunked.pdparams")
         fio.save(state, path)
         with open(path, "rb") as f:
-            assert f.read(8) == fio._MAGIC
+            assert f.read(8) == fio._MAGIC2   # round-9 verified format
         out = fio.load(path)
         np.testing.assert_array_equal(np.asarray(out["w"]._data),
                                       np.asarray(big_f32._data))
