@@ -425,6 +425,67 @@ def _bench_llama14(small):
     }
 
 
+def _bench_compile_cache(small):
+    """Cold-start vs warm-start compile wall time through the persistent
+    compilation cache (BENCH_MODEL=compile_cache; paddle_tpu/compile/).
+
+    Cold = first call of a fresh StaticFunction with an empty cache
+    (trace + lower + XLA compile + publish). Warm = first call of another
+    fresh StaticFunction over the SAME program with the populated cache
+    (deserialize the executable — the path a warmed serving replica's
+    first request takes). vs_baseline is the cold/warm speedup.
+    """
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.jit.api import to_static
+
+    tmp = tempfile.mkdtemp(prefix="pcc_bench_")
+    paddle.set_flags({"FLAGS_compile_cache": True,
+                      "FLAGS_compile_cache_dir": tmp})
+    try:
+        d = 256 if small else 1024
+        paddle.seed(0)
+
+        class _Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.a = nn.Linear(d, d)
+                self.b = nn.Linear(d, d)
+
+            def forward(self, x):
+                return paddle.ops.tanh(self.b(paddle.ops.tanh(self.a(x))))
+
+        net = _Net()
+        x = paddle.to_tensor(np.random.randn(8, d).astype(np.float32))
+
+        def first_call_seconds():
+            sf = to_static(net.forward, full_graph=True)
+            t0 = time.perf_counter()
+            out = sf(x)
+            jax.block_until_ready(out._data)
+            return time.perf_counter() - t0
+
+        cold = first_call_seconds()   # miss: trace+lower+compile+publish
+        warm = first_call_seconds()   # hit: deserialize the executable
+    finally:
+        paddle.set_flags({"FLAGS_compile_cache": False,
+                          "FLAGS_compile_cache_dir": ""})
+        shutil.rmtree(tmp, ignore_errors=True)
+    speedup = cold / max(warm, 1e-9)
+    return {
+        "metric": "compile_cache_warm_speedup",
+        "value": round(speedup, 3),
+        "unit": "x_cold_start",
+        "vs_baseline": round(speedup, 3),
+        "extra": {"cold_start_s": round(cold, 4),
+                  "warm_start_s": round(warm, 4),
+                  "hidden": d, "host": jax.default_backend()},
+    }
+
+
 def _bench_serving(small):
     """Continuous-batching serving throughput (BENCH_MODEL=serving).
 
@@ -683,7 +744,8 @@ def main():
                "bert": _bench_bert, "llama": _bench_llama,
                "llama14": _bench_llama14,
                "dispatch": _bench_dispatch, "pipeline": _bench_pipeline,
-               "serving": _bench_serving}
+               "serving": _bench_serving,
+               "compile_cache": _bench_compile_cache}
     which = os.environ.get("BENCH_MODEL", "all")
     if which != "all":
         print(json.dumps(benches[which](small)))
@@ -719,6 +781,19 @@ def main():
         gc.collect()
         jax.clear_caches()
 
+    # cold-vs-warm compile wall time rides along in every default run
+    # (its own JSON line + a summary-extra entry) so the cache win shows
+    # up in the round's BENCH_*.json perf trajectory — it does NOT join
+    # the train-ladder geomean (different metric class)
+    try:
+        cc = benches["compile_cache"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        cc = {"metric": "compile_cache_warm_speedup", "value": 0.0,
+              "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(cc))
+    sys.stdout.flush()
+
     errors = [name for name, r in rungs.items() if r["unit"] == "error"]
     ratios = [r["vs_baseline"] for name, r in rungs.items()
               if r["unit"] != "error"]
@@ -732,10 +807,16 @@ def main():
         "unit": "x_baseline_geomean",
         "vs_baseline": round(geomean, 4),
         "errors": errors,
-        "extra": {name: {"value": r["value"], "unit": r["unit"],
-                         "vs_baseline": r["vs_baseline"],
-                         "mfu": r.get("extra", {}).get("mfu")}
-                  for name, r in rungs.items()},
+        "extra": {**{name: {"value": r["value"], "unit": r["unit"],
+                            "vs_baseline": r["vs_baseline"],
+                            "mfu": r.get("extra", {}).get("mfu")}
+                     for name, r in rungs.items()},
+                  "compile_cache": {
+                      "value": cc["value"], "unit": cc["unit"],
+                      "cold_start_s": cc.get("extra", {}).get(
+                          "cold_start_s"),
+                      "warm_start_s": cc.get("extra", {}).get(
+                          "warm_start_s")}},
     }))
 
 

@@ -1,0 +1,76 @@
+"""CLI for the persistent compilation cache.
+
+    python -m paddle_tpu.compile warm <manifest.jsonl>   precompile all
+        recorded signatures into the cache (run before traffic arrives)
+    python -m paddle_tpu.compile inspect                 list entries
+    python -m paddle_tpu.compile prune [--max-mb N]      enforce budget
+    python -m paddle_tpu.compile clear                   drop everything
+
+Exit status: 0 on success; ``warm`` exits 1 when every record failed
+(a fleet bootstrap that warmed nothing should fail loudly).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.compile",
+        description="persistent compilation cache tools")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    ap_warm = sub.add_parser("warm", help="precompile a shape-signature "
+                             "manifest into the cache")
+    ap_warm.add_argument("manifest")
+    ap_warm.add_argument("--cache-dir", default="",
+                         help="override FLAGS_compile_cache_dir")
+    sub.add_parser("inspect", help="list cache entries")
+    ap_prune = sub.add_parser("prune", help="enforce the LRU size budget")
+    ap_prune.add_argument("--max-mb", type=int, default=None,
+                          help="budget override (default: "
+                          "FLAGS_compile_cache_size_mb)")
+    sub.add_parser("clear", help="remove every cache entry")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.core import flags
+    if getattr(args, "cache_dir", ""):
+        flags.set_flags({"FLAGS_compile_cache_dir": args.cache_dir})
+    from paddle_tpu import compile as pcc
+
+    cache = pcc.get_cache()
+    if args.cmd == "warm":
+        flags.set_flags({"FLAGS_compile_cache": True})
+        summary = pcc.warm(args.manifest)
+        print(json.dumps(summary, indent=2))
+        return 0 if (summary["warmed"] or not summary["failed"]) else 1
+    if args.cmd == "inspect":
+        entries = cache.entries()
+        total = sum(e["bytes"] for e in entries)
+        for e in entries:
+            meta = cache.entry_meta(e["key"]) or {}
+            print(f"{e['key'][:16]}  {e['bytes']:>10d} B  "
+                  f"used {time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(e['used']))}  "
+                  f"site={meta.get('site', '?')} tier={meta.get('tier', '?')}  "
+                  f"{meta.get('label', '')}")
+        print(f"{len(entries)} entries, {total / (1 << 20):.2f} MB "
+              f"(budget {cache.size_limit_bytes() / (1 << 20):.0f} MB) "
+              f"in {cache.directory}")
+        return 0
+    if args.cmd == "prune":
+        limit = None if args.max_mb is None else args.max_mb * (1 << 20)
+        n = cache.enforce_budget(limit)
+        print(f"evicted {n} entries; "
+              f"{cache.total_bytes() / (1 << 20):.2f} MB live")
+        return 0
+    if args.cmd == "clear":
+        n = cache.clear()
+        print(f"removed {n} entries from {cache.directory}")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

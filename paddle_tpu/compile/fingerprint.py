@@ -1,0 +1,122 @@
+"""Cache-key fingerprints for the persistent compilation cache.
+
+A cache entry is only reusable when EVERYTHING that shaped the compiled
+program is identical: the program content (a function fingerprint + the
+dispatch signature for ``to_static``/SOT, the serialized-StableHLO digest
+for saved artifacts), the toolchain (jax/jaxlib versions), the target
+(backend platform, device kind, device count — a v5e executable must
+never be fed to a v4, nor a 1-chip program to an 8-chip mesh), the
+compile options, and the FLAGS that alter lowering (matmul precision,
+Pallas kernel selection, flash-attention thresholds). All of it is folded
+into one hex sha256; two processes on identical machines derive identical
+keys, which is what makes the cache shareable across a serving fleet
+(cf. the Pathways emphasis on amortizing compilation fleet-wide).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import types
+from typing import Any, Dict, Sequence
+
+import jax
+
+from ..core import flags
+
+#: flags that change what XLA receives — part of every cache key. Keep in
+#: sync with the lowering sites that read them.
+LOWERING_FLAGS = (
+    "tpu_matmul_precision",
+    "use_pallas_kernels",
+    "flash_min_seq_len",
+    "cudnn_deterministic",
+)
+
+_env_cache: Dict[str, Any] = {}
+
+
+def env_fingerprint() -> Dict[str, Any]:
+    """The toolchain + topology part of every key (computed once — none
+    of it can change inside a process). Includes the framework's own
+    version so upgrading paddle_tpu (whose op lowerings feed every
+    program) invalidates entries wholesale."""
+    if not _env_cache:
+        import jaxlib
+
+        try:
+            from .. import __version__ as fw_version
+        except ImportError:
+            fw_version = "?"
+        devices = jax.devices()
+        _env_cache.update({
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "paddle_tpu": str(fw_version),
+            "platform": devices[0].platform,
+            "device_kind": getattr(devices[0], "device_kind", ""),
+            "device_count": jax.device_count(),
+        })
+    out = dict(_env_cache)
+    out["flags"] = {name: flags.get_flag(name) for name in LOWERING_FLAGS}
+    return out
+
+
+def code_fingerprint(fn) -> str:
+    """Content hash of a function's code object — bytecode, names, and
+    constants, recursing into nested code objects. File/line-based
+    fingerprints stale-hit when a body is edited in place; the
+    persistent cache must key on what the function DOES. (Callables the
+    entry function merely calls are not folded in — the entry hash plus
+    closure guards plus the framework version in :func:`env_fingerprint`
+    cover the common edit paths; clear the cache after deeper surgery.)
+    """
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        call = getattr(fn, "__call__", None)
+        code = getattr(call, "__code__", None)
+    if code is None:
+        return f"<no-code:{type(fn).__name__}>"
+    h = hashlib.sha256()
+
+    def fold(c):
+        h.update(c.co_code)
+        h.update(repr(c.co_names).encode())
+        h.update(repr(c.co_varnames).encode())
+        for const in c.co_consts:
+            if isinstance(const, types.CodeType):
+                fold(const)
+            else:
+                h.update(repr(const).encode())
+
+    fold(code)
+    return h.hexdigest()
+
+
+def _canon(obj) -> str:
+    """Deterministic string form of a key part (sorted-key JSON when
+    possible, repr otherwise — reprs here are stable strings built by the
+    callers, never raw object reprs with addresses)."""
+    try:
+        return json.dumps(obj, sort_keys=True, default=repr)
+    except (TypeError, ValueError):
+        return repr(obj)
+
+
+def key_of(kind: str, *parts) -> str:
+    """Hex sha256 over (kind, env, parts) — the entry's file name."""
+    h = hashlib.sha256()
+    h.update(kind.encode())
+    h.update(_canon(env_fingerprint()).encode())
+    for p in parts:
+        h.update(b"\x00")
+        h.update(_canon(p).encode())
+    return h.hexdigest()
+
+
+def aval_sig(arrays: Sequence) -> list:
+    """JSON-able [[shape, dtype], ...] for arrays / ShapeDtypeStructs."""
+    return [[list(getattr(a, "shape", ())), str(a.dtype)] for a in arrays]
+
+
+def blob_digest(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()

@@ -1,0 +1,176 @@
+"""AOT warmup — record shape signatures during a run, precompile later.
+
+A serving replica should never pay trace+lower+compile on its first
+request. The flow:
+
+1. A recording run (CI, a canary, a previous replica) sets
+   ``FLAGS_compile_cache_manifest=/path/sigs.jsonl``; every ``to_static``
+   signature and every loaded-artifact call appends one JSON line
+   describing *what was compiled* (import target or artifact path +
+   input shapes/dtypes).
+2. ``python -m paddle_tpu.compile warm sigs.jsonl`` (or
+   :func:`warm`) replays the manifest with abstract values only — no
+   data, no device traffic — publishing every compiled program into the
+   persistent cache.
+3. The replica starts with ``FLAGS_compile_cache=1``; its first dispatch
+   of every recorded signature is a cache hit.
+
+Records whose target cannot be re-imported (lambdas, closures, bound
+methods of ad-hoc objects) are recorded with ``"target": null`` and
+reported as skipped by ``warm`` — the manifest is an honest inventory,
+not a promise.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import os
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..core import flags
+
+__all__ = ["record_to_static", "record_artifact", "warm",
+           "manifest_path", "read_manifest"]
+
+# FLAGS_compile_cache_manifest is registered in core/flags.py
+
+_lock = threading.Lock()
+_written: set = set()
+
+
+def manifest_path() -> str:
+    return str(flags.get_flag("compile_cache_manifest") or "")
+
+
+def _append(record: dict) -> None:
+    path = manifest_path()
+    if not path:
+        return
+    line = json.dumps(record, sort_keys=True)
+    with _lock:
+        if (path, line) in _written:
+            return
+        _written.add((path, line))
+        try:
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            with open(path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+
+
+def _import_target(fn) -> Optional[str]:
+    """``module:qualname`` when ``fn`` is faithfully re-importable, else
+    None. Dotted qualnames (staticmethods, class-attribute functions)
+    resolve fine via the attribute walk in ``_resolve``; bound methods
+    do NOT — re-importing yields the bare function without the instance
+    whose parameters keyed the original compile — nor do closures."""
+    mod = getattr(fn, "__module__", None)
+    qual = getattr(fn, "__qualname__", None)
+    if not mod or not qual or "<locals>" in qual:
+        return None
+    if getattr(fn, "__self__", None) is not None:
+        return None
+    return f"{mod}:{qual}"
+
+
+def record_to_static(fn, arrays: Sequence) -> None:
+    """Record one to_static dispatch signature (cheap no-op when the
+    manifest flag is unset)."""
+    if not manifest_path():
+        return
+    from .fingerprint import aval_sig
+    _append({"kind": "to_static", "target": _import_target(fn),
+             "name": getattr(fn, "__qualname__", str(fn)),
+             "arrays": aval_sig(arrays)})
+
+
+def record_artifact(path: str, arrays: Sequence) -> None:
+    """Record one loaded-artifact (TranslatedLayer / Predictor) call."""
+    if not manifest_path():
+        return
+    from .fingerprint import aval_sig
+    _append({"kind": "artifact", "path": str(path),
+             "arrays": aval_sig(arrays)})
+
+
+def read_manifest(path: str) -> List[dict]:
+    out, seen = [], set()
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line in seen:
+                continue
+            seen.add(line)
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict):
+                out.append(rec)
+    return out
+
+
+def _avals(sig: Sequence) -> list:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    out = []
+    for shape, dtype in sig:
+        dt = jnp.bfloat16 if str(dtype) == "bfloat16" else np.dtype(dtype)
+        out.append(jax.ShapeDtypeStruct(tuple(shape), dt))
+    return out
+
+
+def warm(manifest: str,
+         resolver: Optional[Callable[[dict], Optional[object]]] = None
+         ) -> Dict[str, list]:
+    """Precompile every signature in ``manifest`` into the persistent
+    cache. ``resolver`` may map a record to a callable/Layer for targets
+    the default import logic cannot reach. Returns
+    ``{"warmed": [...], "skipped": [...], "failed": [...]}`` — warming is
+    best-effort by design: a record that no longer resolves must not
+    block the rest of the fleet's warmup."""
+    from ..jit import api as jit_api
+
+    summary: Dict[str, list] = {"warmed": [], "skipped": [], "failed": []}
+    for rec in read_manifest(manifest):
+        label = rec.get("target") or rec.get("path") or rec.get("name", "?")
+        try:
+            avals = _avals(rec.get("arrays", []))
+            target = resolver(rec) if resolver is not None else None
+            if target is None:
+                target = _resolve(rec, jit_api)
+            if target is None:
+                summary["skipped"].append(label)
+                continue
+            if not isinstance(target, (jit_api.StaticFunction,
+                                       jit_api.TranslatedLayer)):
+                target = jit_api.to_static(target, full_graph=True)
+            target.precompile(avals)
+            summary["warmed"].append(label)
+        except Exception as e:
+            summary["failed"].append(f"{label}: {type(e).__name__}: {e}")
+    return summary
+
+
+def _resolve(rec: dict, jit_api):
+    kind = rec.get("kind")
+    if kind == "artifact":
+        loaded = jit_api.load(rec["path"])
+        return loaded if isinstance(loaded, jit_api.TranslatedLayer) \
+            else None
+    if kind == "to_static":
+        target = rec.get("target")
+        if not target or ":" not in target:
+            return None
+        mod_name, attr = target.split(":", 1)
+        obj = importlib.import_module(mod_name)
+        for part in attr.split("."):
+            obj = getattr(obj, part)
+        return obj
+    return None

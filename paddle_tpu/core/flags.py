@@ -123,3 +123,15 @@ define_flag("eager_jit_cache", True, "Run steady-state eager ops through cached 
 define_flag("log_level", 0, "VLOG-style verbosity for framework logging.")
 define_flag("cudnn_deterministic", False, "Determinism facade (XLA is deterministic by default).")
 define_flag("max_inplace_grad_add", 0, "Grad accumulation chunking facade.")
+# Persistent compilation cache (paddle_tpu/compile/) — registered here so
+# set_flags works before the compile package is first imported.
+define_flag("compile_cache", False,
+            "Enable the persistent on-disk compilation cache.")
+define_flag("compile_cache_dir", "",
+            "Cache directory; empty = $PADDLE_TPU_COMPILE_CACHE_DIR or "
+            "~/.cache/paddle_tpu/pcc.")
+define_flag("compile_cache_size_mb", 512,
+            "LRU size budget for the persistent compilation cache (MB).")
+define_flag("compile_cache_manifest", "",
+            "Shape-signature manifest (JSONL) recording path for AOT "
+            "warmup; empty = off.")

@@ -23,6 +23,10 @@ guard). The registered points:
                                     ``TimeoutError`` (stuck peer)
 ``grads.nan_at_step``               the training loop poisons the loss with
                                     NaN at global step ``step``
+``pcc.write_truncate_after_bytes``  the compilation-cache entry writer stops
+                                    mid-file after ``after_bytes`` bytes
+                                    (torn cache publish); params:
+                                    ``after_bytes``
 ==================================  =========================================
 """
 from __future__ import annotations
@@ -51,6 +55,7 @@ POINTS = frozenset({
     "io.fsync_fail",
     "collective.timeout",
     "grads.nan_at_step",
+    "pcc.write_truncate_after_bytes",
 })
 
 _lock = threading.Lock()

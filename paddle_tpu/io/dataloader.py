@@ -14,6 +14,7 @@ import itertools
 import multiprocessing as mp
 import queue as queue_mod
 import threading
+import weakref
 from dataclasses import dataclass
 from typing import Optional
 
@@ -125,6 +126,26 @@ class _IterableDatasetIter:
         return self._loader._to_output(self._collate(batch))
 
 
+def _shutdown_workers(workers, index_queues):
+    """Join/terminate worker processes (idempotent). Module-level so a
+    ``weakref.finalize`` can run it at iterator GC AND interpreter exit
+    without keeping the iterator alive — an exception in the consumer
+    loop must not leave orphaned worker processes behind."""
+    for q in index_queues:
+        try:
+            q.put_nowait(None)
+        except Exception:
+            pass
+    for w in workers:
+        try:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+                w.join(timeout=2)
+        except Exception:
+            pass
+
+
 class _MultiProcessIter:
     def __init__(self, loader):
         self._loader = loader
@@ -144,6 +165,12 @@ class _MultiProcessIter:
                 daemon=True)
             w.start()
             self._workers.append(w)
+        # guaranteed cleanup: fires when the iterator is garbage
+        # collected (incl. after a consumer-loop exception dropped the
+        # last reference) and, via finalize's atexit hook, at interpreter
+        # exit — whichever comes first
+        self._finalizer = weakref.finalize(
+            self, _shutdown_workers, self._workers, self._index_queues)
         self._send_idx = 0
         self._rcvd_idx = 0
         self._reorder = {}
@@ -200,15 +227,7 @@ class _MultiProcessIter:
         if self._shutdown:
             return
         self._shutdown = True
-        for q in self._index_queues:
-            try:
-                q.put(None)
-            except Exception:
-                pass
-        for w in self._workers:
-            w.join(timeout=2)
-            if w.is_alive():
-                w.terminate()
+        self._finalizer()
 
     def __del__(self):
         self._teardown()

@@ -110,6 +110,9 @@ class StaticFunction:
         functools.update_wrapper(self, fn)
         self._jitted = None
         self._params = None
+        #: per-signature AOT runners — deserialized persistent-cache hits
+        #: and locally AOT-compiled programs (persistent cache path)
+        self._aot_sigs: dict = {}
         # SOT-style graph-break state (reference sot/translate.py: on
         # untraceable code, fall back and record why). full_graph=True
         # makes a break an error, like the reference's full_graph flag.
@@ -200,45 +203,25 @@ class StaticFunction:
         # call with a different layer (new static leaf -> retrace) rebinds
         # tracers onto THAT call's params rather than the first call's.
         self._params = params
-        if self._jitted is None:
-            outer = self
-
-            def jit_target(param_arrays, array_leaves, treedef, statics):
-                params = outer._params
-                static_map = dict(statics)
-                it = iter(array_leaves)
-                full = [static_map[i] if i in static_map else next(it)
-                        for i in range(treedef.num_leaves)]
-                a, k = jax.tree_util.tree_unflatten(treedef, full)
-                with _CaptureScope():
-                    originals = []
-                    for p, d in zip(params, param_arrays):
-                        originals.append((p, p._data))
-                        p._data = d
-                    try:
-                        args_t = _wrap(a)
-                        kwargs_t = _wrap(k)
-                        out = fn(*args_t, **kwargs_t)
-                        # Thread in-place updates (BatchNorm running stats
-                        # via set_value) out of the trace so the caller can
-                        # write them back.
-                        mutated = {i: p._data
-                                   for i, (p, d) in enumerate(
-                                       zip(params, param_arrays))
-                                   if p._data is not d}
-                        return _unwrap(out), mutated
-                    finally:
-                        for p, d in originals:
-                            p._data = d
-
-            self._jitted = jax.jit(jit_target,
-                                   static_argnums=(2, 3))
+        self._build_jitted(fn)
         sig = (treedef, statics,
                tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
         if sig in self._graph_breaks:
             return self._run_sot(sig, fn, args, kwargs)
         is_new_sig = sig not in self._seen_sigs
-        if is_new_sig:  # tpulint: disable=TPU105 — branches on input SHAPES (the dispatch signature), not tensor values
+        runner = self._aot_sigs.get(sig)
+        if runner is None and is_new_sig:  # tpulint: disable=TPU105 — branches on input SHAPES (the dispatch signature), not tensor values
+            # persistent compilation cache: an already-seen signature
+            # (this machine or a warmed fleet peer) skips trace+compile
+            runner = self._pcc_load(sig, params)
+            self._pcc_record_manifest(arrays)
+        if runner is not None:
+            self._seen_sigs.add(sig)   # known signature, nothing compiled
+            out, mutated = runner([p._data for p in params], arrays)
+            for i, arr in mutated.items():
+                params[int(i)]._swap_payload(arr)
+            return _wrap(out)
+        if is_new_sig:  # tpulint: disable=TPU105 — same shape-only branch
             self._record_new_sig(sig)
         try:
             if is_new_sig:  # tpulint: disable=TPU105 — same shape-only branch
@@ -248,8 +231,8 @@ class StaticFunction:
                 with _trace.span(f"to_static_compile:{self.__name__}",
                                  "compile"):
                     c0 = time.perf_counter()
-                    out, mutated = self._jitted(
-                        [p._data for p in params], arrays, treedef, statics)
+                    out, mutated = self._dispatch_new_sig(
+                        sig, params, arrays, treedef, statics)
                 if _metrics.enabled():
                     _m_compile_time.observe(time.perf_counter() - c0,
                                             kind=kind)
@@ -288,8 +271,183 @@ class StaticFunction:
                 stacklevel=2)
             return self._run_sot(sig, fn, args, kwargs)
         for i, arr in mutated.items():
-            params[i]._swap_payload(arr)
+            params[int(i)]._swap_payload(arr)
         return _wrap(out)
+
+    def _build_jitted(self, fn):
+        if self._jitted is not None:
+            return
+        outer = self
+
+        def jit_target(param_arrays, array_leaves, treedef, statics):
+            params = outer._params
+            static_map = dict(statics)
+            it = iter(array_leaves)
+            full = [static_map[i] if i in static_map else next(it)
+                    for i in range(treedef.num_leaves)]
+            a, k = jax.tree_util.tree_unflatten(treedef, full)
+            with _CaptureScope():
+                originals = []
+                for p, d in zip(params, param_arrays):
+                    originals.append((p, p._data))
+                    p._data = d
+                try:
+                    args_t = _wrap(a)
+                    kwargs_t = _wrap(k)
+                    out = fn(*args_t, **kwargs_t)
+                    # Thread in-place updates (BatchNorm running stats
+                    # via set_value) out of the trace so the caller can
+                    # write them back. String keys: the mutated dict
+                    # crosses jax.export serialization, which only
+                    # accepts string dict keys in pytrees.
+                    mutated = {str(i): p._data
+                               for i, (p, d) in enumerate(
+                                   zip(params, param_arrays))
+                               if p._data is not d}
+                    return _unwrap(out), mutated
+                finally:
+                    for p, d in originals:
+                        p._data = d
+
+        self._jitted = jax.jit(jit_target, static_argnums=(2, 3))
+
+    # ------------------------------------------------ persistent cache
+    def _pcc_key(self, sig, params):
+        """Cache key for one dispatch signature: function identity +
+        closure/owner guards + the full signature + param avals, folded
+        with the toolchain/topology/FLAGS fingerprint (compile/)."""
+        from .. import compile as pcc
+        from . import sot as sot_mod
+        treedef, statics, shapes = sig
+        fn = self._dygraph_fn
+        return pcc.key_of(
+            "to_static",
+            f"{getattr(fn, '__module__', '')}:"
+            f"{getattr(fn, '__qualname__', '')}",
+            # code CONTENT, not file:line — editing the body in place
+            # must invalidate the entry, not stale-hit it
+            pcc.code_fingerprint(fn),
+            self._frame_guard(fn),
+            repr(treedef),
+            [[i, sot_mod._const_repr(v, 2)] for i, v in statics],
+            [list(map(list, shapes))],
+            pcc.aval_sig([p._data for p in params]))
+
+    def _pcc_load(self, sig, params):
+        """Look the signature up in the persistent cache; a hit returns a
+        runner (params, arrays) -> (out, mutated) and skips trace+compile
+        entirely. Any cache-layer problem is a miss, never an error."""
+        try:
+            from .. import compile as pcc
+            if not pcc.enabled():
+                return None
+            got = pcc.get_cache().get(self._pcc_key(sig, params),
+                                      site="to_static")
+            if got is None:
+                return None
+            meta, payload = got
+            runner = pcc.aot.load_runner(meta.get("tier", ""), payload)
+            if runner is None:
+                return None
+            pcc.record_time_saved(meta.get("compile_seconds", 0.0))
+            self._aot_sigs[sig] = runner
+            return runner
+        except Exception:
+            return None
+
+    def _pcc_record_manifest(self, arrays):
+        try:
+            from .. import compile as pcc
+            pcc.record_to_static(self._dygraph_fn, arrays)
+        except Exception:
+            pass
+
+    def _dispatch_new_sig(self, sig, params, arrays, treedef, statics):
+        """First dispatch of a signature. With the persistent cache off,
+        the plain jit path; with it on, AOT lower+compile so the
+        executable can be serialized and published for other processes."""
+        param_arrays = [p._data for p in params]
+        try:
+            from .. import compile as pcc
+            use_pcc = pcc.enabled()
+        except Exception:
+            use_pcc = False
+        if not use_pcc:
+            return self._jitted(param_arrays, arrays, treedef, statics)
+        runner = self._pcc_store(sig, params, arrays, treedef, statics)
+        return runner(param_arrays, arrays)
+
+    def _pcc_store(self, sig, params, arrays, treedef, statics):
+        """AOT-compile one signature, publish it, return its runner.
+        ``arrays`` may be abstract (ShapeDtypeStructs) — the warmup path
+        compiles and publishes without executing anything."""
+        from .. import compile as pcc
+        param_arrays = [p._data for p in params]
+        c0 = time.perf_counter()
+        compiled = self._jitted.lower(param_arrays, arrays, treedef,
+                                      statics).compile()
+        compile_seconds = time.perf_counter() - c0
+
+        def runner(pa, ar, _c=compiled):
+            return _c(pa, ar)
+
+        self._aot_sigs[sig] = runner
+        try:
+            ser = pcc.aot.serialize_compiled(compiled)
+            if ser is None:
+                # backend cannot serialize executables: fall back to the
+                # exported-StableHLO tier (a hit still skips trace+lower)
+                from jax import export as jax_export
+                p_avals = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                           for a in param_arrays]
+                a_avals = [jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+                           for a in arrays]
+                exported = jax_export.export(self._jitted)(
+                    p_avals, a_avals, treedef, statics)
+                ser = pcc.aot.serialize_exported(exported)
+            if ser is not None:
+                tier, payload = ser
+                pcc.get_cache().put(
+                    self._pcc_key(sig, params), payload,
+                    {"site": "to_static", "tier": tier,
+                     "label": getattr(self, "__name__", ""),
+                     "compile_seconds": compile_seconds})
+        except Exception:
+            pass
+        return runner
+
+    def precompile(self, input_spec=None):
+        """AOT warmup: compile (and publish to the persistent cache) the
+        signature described by ``input_spec`` — a list of InputSpec /
+        Tensors / (shape, dtype)-shaped arrays — WITHOUT executing it.
+        All entries must have concrete shapes; serving warmup runs over
+        the recorded shape manifest, not symbolic dims."""
+        specs = list(input_spec if input_spec is not None
+                     else self._input_spec or [])
+        if not specs:
+            raise ValueError(
+                "precompile needs input_spec (InputSpec/Tensor/array "
+                "examples) to describe the signature")
+        avals = _example_arrays(specs)
+        if any(not all(isinstance(d, int) for d in a.shape)
+               for a in avals):
+            raise ValueError(
+                "precompile needs concrete shapes (no -1 dims) — warm "
+                "from a recorded shape-signature manifest")
+        params = self._collect_params(())
+        self._params = params
+        self._build_jitted(self._dygraph_fn)
+        leaves_tree = jax.tree_util.tree_structure(
+            (tuple(avals), {}))
+        sig = (leaves_tree, (),
+               tuple((tuple(a.shape), str(a.dtype)) for a in avals))
+        if sig in self._aot_sigs:
+            return
+        if self._pcc_load(sig, params) is not None:
+            self._seen_sigs.add(sig)
+            return
+        self._pcc_store(sig, params, avals, leaves_tree, ())
+        self._seen_sigs.add(sig)
 
     def _record_new_sig(self, sig):
         """Telemetry for a signature's first dispatch: initial build vs
@@ -520,18 +678,39 @@ def save(layer, path, input_spec=None, **configs):
         if was_training and hasattr(layer, "train"):
             layer.train()
 
+    import jaxlib
+
     with open(path + ".pdmodel", "wb") as f:
-        pickle.dump({"format": "paddle_tpu.jit/1",
+        # version-stamped v2 blob: load() turns a deserialize failure on
+        # a version-skewed artifact into a clear ArtifactVersionError
+        pickle.dump({"format": "paddle_tpu.jit/2",
                      "n_inputs": len(list(input_spec)),
-                     "stablehlo": exported.serialize()}, f)
+                     "stablehlo": exported.serialize(),
+                     "jax_version": jax.__version__,
+                     "jaxlib_version": jaxlib.__version__,
+                     "platform": jax.devices()[0].platform}, f)
     _save(state, path + ".pdparams")
+
+
+class ArtifactVersionError(RuntimeError):
+    """A ``jit.save`` artifact was produced by an incompatible toolchain
+    (jax/jaxlib/backend skew). Raised by ``jit.load`` instead of an
+    opaque deserialize failure; the fix is re-exporting the artifact
+    with the current toolchain."""
 
 
 class TranslatedLayer:
     """A loaded program: callable without the original model class
-    (reference: python/paddle/jit/translated_layer.py TranslatedLayer)."""
+    (reference: python/paddle/jit/translated_layer.py TranslatedLayer).
 
-    def __init__(self, exported, state, n_inputs: int = 1):
+    With ``FLAGS_compile_cache=1`` each input-shape signature is AOT
+    compiled once and the executable published to the persistent cache
+    keyed by the artifact's serialized-StableHLO digest — a warmed
+    serving replica's first request deserializes instead of compiling."""
+
+    def __init__(self, exported, state, n_inputs: int = 1,
+                 program_digest: Optional[str] = None,
+                 artifact_path: Optional[str] = None):
         self._exported = exported
         self._state = state
         self.n_inputs = n_inputs
@@ -539,14 +718,76 @@ class TranslatedLayer:
             k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
             for k, v in state.items()}
         self.training = False
+        self._program_digest = program_digest
+        self._artifact_path = artifact_path
+        self._aot: dict = {}
 
     def __call__(self, *inputs):
         arrays = [i._data if isinstance(i, Tensor) else jnp.asarray(i)
                   for i in inputs]
+        # only the CACHE machinery is guarded — once a runner exists it
+        # executes unguarded, so a genuine runtime failure (OOM, shape
+        # error) surfaces once instead of being swallowed and re-run
+        runner = None
+        try:
+            from .. import compile as pcc
+            if self._artifact_path:
+                pcc.record_artifact(self._artifact_path, arrays)
+            if pcc.enabled() and self._program_digest:
+                runner = self._runner_for(arrays, pcc)
+        except Exception:
+            runner = None
+        if runner is not None:
+            return _wrap(runner(self._param_arrays, *arrays))
         out = self._exported.call(self._param_arrays, *arrays)
         return _wrap(out)
 
     forward = __call__
+
+    # ------------------------------------------------ persistent cache
+    def _runner_for(self, arrays, pcc):
+        """Per-shape-signature compiled program: persistent-cache hit or
+        AOT compile + publish (content-addressed by the artifact's
+        StableHLO digest + input avals + toolchain/topology)."""
+        avsig = tuple((tuple(a.shape), str(a.dtype)) for a in arrays)
+        runner = self._aot.get(avsig)
+        if runner is not None:
+            return runner
+        key = pcc.key_of("artifact", self._program_digest,
+                         [list(map(list, avsig))])
+        got = pcc.get_cache().get(key, site="artifact")
+        if got is not None:
+            meta, payload = got
+            runner = pcc.aot.load_runner(meta.get("tier", ""), payload)
+            if runner is not None:
+                pcc.record_time_saved(meta.get("compile_seconds", 0.0))
+                self._aot[avsig] = runner
+                return runner
+        c0 = time.perf_counter()
+        compiled = jax.jit(self._exported.call).lower(
+            self._param_arrays, *arrays).compile()
+        compile_seconds = time.perf_counter() - c0
+
+        def runner(pa, *ar, _c=compiled):
+            return _c(pa, *ar)
+
+        self._aot[avsig] = runner
+        ser = pcc.aot.serialize_compiled(compiled)
+        if ser is not None:
+            tier, payload = ser
+            pcc.get_cache().put(
+                key, payload,
+                {"site": "artifact", "tier": tier,
+                 "label": self._artifact_path or "",
+                 "compile_seconds": compile_seconds})
+        return runner
+
+    def precompile(self, input_spec):
+        """AOT warmup: compile + publish this artifact's program for the
+        given input shapes without executing it."""
+        from .. import compile as pcc
+        avals = _example_arrays(list(input_spec))
+        self._runner_for(avals, pcc)
 
     def state_dict(self):
         return dict(self._state)
@@ -586,6 +827,33 @@ def load(path, **configs):
         return state
     with open(model_file, "rb") as f:
         blob = pickle.load(f)
-    exported = jax_export.deserialize(blob["stablehlo"])
+    fmt = str(blob.get("format", ""))
+    if not fmt.startswith("paddle_tpu.jit/"):
+        raise ArtifactVersionError(
+            f"{model_file!r} is not a paddle_tpu.jit artifact "
+            f"(format={fmt!r}) — re-export it with jit.save")
+    try:
+        exported = jax_export.deserialize(blob["stablehlo"])
+    except Exception as e:
+        import jaxlib
+        saved_jax = blob.get("jax_version")
+        saved_jaxlib = blob.get("jaxlib_version")
+        if (saved_jax, saved_jaxlib) != (jax.__version__,
+                                         jaxlib.__version__):
+            raise ArtifactVersionError(
+                f"cannot load {model_file!r}: artifact was exported with "
+                f"jax {saved_jax or '<unstamped v1 artifact>'} / jaxlib "
+                f"{saved_jaxlib or '?'} on "
+                f"{blob.get('platform', '?')}, this runtime is jax "
+                f"{jax.__version__} / jaxlib {jaxlib.__version__}. "
+                f"Re-export the artifact with jit.save on the current "
+                f"toolchain.") from e
+        raise
+    try:
+        import hashlib
+        digest = hashlib.sha256(bytes(blob["stablehlo"])).hexdigest()
+    except Exception:
+        digest = None
     return TranslatedLayer(exported, state,
-                           n_inputs=int(blob.get("n_inputs", 1)))
+                           n_inputs=int(blob.get("n_inputs", 1)),
+                           program_digest=digest, artifact_path=path)

@@ -340,19 +340,33 @@ class Segment:
                                else [o])
                 return [env[i][j] for i, j in out_refs]
 
-            jitted = jax.jit(seg_fn)
-            if len(self.owner.cache) >= SEGMENT_CACHE_MAX:
-                self.owner.cache.pop(next(iter(self.owner.cache)))
-            self.owner.cache[key] = jitted
-            self.owner.stats["compiled"] += 1
-            # XLA compiles on the first execution — time it as the
-            # segment's compile cost
-            with _trace.span(f"sot_segment_compile:site{self.owner.site_idx}",
-                             "compile", {"ops": len(self.nodes)}):
-                c0 = time.perf_counter()
+            # persistent compilation cache: a segment already compiled by
+            # another process (same ops/shapes/toolchain) deserializes
+            # instead of recompiling
+            jitted = _pcc_lookup(key)
+            if jitted is not None:
+                if len(self.owner.cache) >= SEGMENT_CACHE_MAX:
+                    self.owner.cache.pop(next(iter(self.owner.cache)))
+                self.owner.cache[key] = jitted
                 results = jitted(self.ext_arrays)
-            if _metrics.enabled():
-                _m_segment_compile_time.observe(time.perf_counter() - c0)
+            else:
+                jitted, publish = _pcc_compile(seg_fn, self.ext_arrays)
+                if len(self.owner.cache) >= SEGMENT_CACHE_MAX:
+                    self.owner.cache.pop(next(iter(self.owner.cache)))
+                self.owner.cache[key] = jitted
+                self.owner.stats["compiled"] += 1
+                # XLA compiles on the first execution — time it as the
+                # segment's compile cost
+                with _trace.span(
+                        f"sot_segment_compile:site{self.owner.site_idx}",
+                        "compile", {"ops": len(self.nodes)}):
+                    c0 = time.perf_counter()
+                    results = jitted(self.ext_arrays)
+                seg_seconds = time.perf_counter() - c0
+                if _metrics.enabled():
+                    _m_segment_compile_time.observe(seg_seconds)
+                if publish is not None:
+                    publish(key, seg_seconds)
         else:
             results = jitted(self.ext_arrays)
         value_of = dict(zip(out_refs, results))
@@ -577,6 +591,75 @@ def replay_frame(journal: FrameJournal, cache: dict, input_arrays: Sequence,
         else:
             leaves.append((d[1], d[2]))
     return True, (treedef, leaves), ""
+
+
+def _pcc_key(key) -> str:
+    """Persistent-cache key for one segment: the in-memory cache key
+    (site index + op-sequence fingerprint + ext shapes/dtypes + out
+    refs) is already a stable, content-describing tuple of strings and
+    ints — fold its repr with the toolchain/topology fingerprint."""
+    from ... import compile as pcc
+    return pcc.key_of("sot", repr(key))
+
+
+def _pcc_lookup(key):
+    """Deserialize a persistently-cached segment program, or None. The
+    runner takes the ext-array list like the jitted seg_fn. Failures of
+    any kind are a miss — the segment simply recompiles."""
+    try:
+        from ... import compile as pcc
+        if not pcc.enabled():
+            return None
+        got = pcc.get_cache().get(_pcc_key(key), site="sot")
+        if got is None:
+            return None
+        meta, payload = got
+        runner = pcc.aot.load_runner(meta.get("tier", ""), payload)
+        if runner is None:
+            return None
+        pcc.record_time_saved(meta.get("compile_seconds", 0.0))
+        return lambda ext, _r=runner: _r([jnp.asarray(e) for e in ext])
+    except Exception:
+        return None
+
+
+def _pcc_compile(seg_fn, ext_arrays):
+    """Build the segment's compiled program. With the persistent cache
+    off: plain ``jax.jit`` (zero behavior change). With it on: AOT
+    lower+compile so the executable handle can be serialized; returns
+    ``(runner, publish)`` where ``publish(key, seconds)`` writes the
+    entry once the caller has timed the compile."""
+    try:
+        from ... import compile as pcc
+        use_pcc = pcc.enabled()
+    except Exception:
+        use_pcc = False
+    if not use_pcc:
+        return jax.jit(seg_fn), None
+    try:
+        # normalize ext leaves exactly as the runners do at call time, so
+        # the compiled avals (incl. weak types) match on every call
+        conv = [jnp.asarray(e) for e in ext_arrays]
+        compiled = jax.jit(seg_fn).lower(conv).compile()
+    except Exception:
+        return jax.jit(seg_fn), None
+
+    def runner(ext, _c=compiled):
+        return _c([jnp.asarray(e) for e in ext])
+
+    def publish(key, seconds, _c=compiled):
+        try:
+            ser = pcc.aot.serialize_compiled(_c)
+            if ser is not None:
+                tier, payload = ser
+                pcc.get_cache().put(
+                    _pcc_key(key), payload,
+                    {"site": "sot", "tier": tier,
+                     "compile_seconds": float(seconds)})
+        except Exception:
+            pass
+
+    return runner, publish
 
 
 def record_or_none(op_name: str, f: Callable, arrays: Sequence,

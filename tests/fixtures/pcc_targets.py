@@ -1,0 +1,28 @@
+"""Importable compile targets for the persistent-compilation-cache tests.
+
+Lives in a module (not a test body) so the function fingerprint and
+``module:qualname`` warm target resolve identically in the pytest
+process and in subprocesses — the cross-process cache-hit proof depends
+on both deriving the same content key.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def affine_fn(x, y):
+    return paddle.ops.matmul(x, y) + 1.0
+
+
+def breaking_fn(x):
+    """Graph-breaks mid-function (SOT segment-cache exercise)."""
+    y = paddle.ops.matmul(x, x)
+    n = float(y.numpy().sum())   # concretization -> segment flush
+    scale = 1.0 if n >= 0 else 2.0
+    return y * scale + 1.0
+
+
+def example_inputs():
+    x = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(4, 8) / 32)
+    y = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(8, 3) / 24)
+    return x, y

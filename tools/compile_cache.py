@@ -1,0 +1,15 @@
+"""Operator tool for the persistent compilation cache.
+
+    python tools/compile_cache.py inspect
+    python tools/compile_cache.py prune [--max-mb N]
+    python tools/compile_cache.py clear
+    python tools/compile_cache.py warm <manifest.jsonl>
+
+Thin wrapper over ``python -m paddle_tpu.compile`` so fleet tooling has
+one stable entry point next to the other tools/ scripts.
+"""
+import sys
+
+if __name__ == "__main__":
+    from paddle_tpu.compile.__main__ import main
+    sys.exit(main(sys.argv[1:]))
