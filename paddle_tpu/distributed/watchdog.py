@@ -34,6 +34,7 @@ class Watchdog:
         self._thread: Optional[threading.Thread] = None
         self._hook = None
         self.hang_count = 0
+        self.unbalanced_end_count = 0
         self.last_op: Optional[str] = None
         self.last_op_t = 0.0
 
@@ -49,7 +50,15 @@ class Watchdog:
 
     def end_work(self):
         with self._lock:
-            self._in_flight = max(self._in_flight - 1, 0)
+            if self._in_flight == 0:
+                # unbalanced end_work (double-finally, crashed begin):
+                # clamping silently would be fine once, but letting the
+                # counter go negative would make a later begin_work read
+                # as "no work in flight" and blind the hang detector —
+                # count it so the imbalance is visible in diagnostics
+                self.unbalanced_end_count += 1
+            else:
+                self._in_flight -= 1
             self._last_progress = time.monotonic()
 
     # ------------------------------------------------------------ lifecycle
@@ -110,6 +119,10 @@ class Watchdog:
                       f"({now - self.last_op_t:.1f}s ago)\n")
         else:
             out.write("[watchdog] last op: <none dispatched>\n")
+        if self.unbalanced_end_count:
+            out.write(f"[watchdog] WARNING: {self.unbalanced_end_count} "
+                      f"unbalanced end_work() call(s) — begin/end "
+                      f"bracketing is broken somewhere\n")
         try:
             from .communication.collective import LAST_COLLECTIVE
             if LAST_COLLECTIVE["op"] is not None:

@@ -27,6 +27,20 @@ guard). The registered points:
                                     mid-file after ``after_bytes`` bytes
                                     (torn cache publish); params:
                                     ``after_bytes``
+``serving.tick_stall``              the serving engine tick blocks for
+                                    ``seconds`` before doing any work (a
+                                    wedged device transfer / compile) —
+                                    exercises the watchdog → DEGRADED path;
+                                    params: ``seconds``
+``serving.admission_oom``           admission-time block allocation is forced
+                                    to fail as if another slot raced it to
+                                    the last KV blocks — exercises the
+                                    requeue-not-raise path
+``serving.crash_at_tick``           an unexpected exception is raised inside
+                                    the engine tick whose ordinal equals
+                                    ``tick`` — exercises the fail-in-flight
+                                    + degrade + keep-serving path; params:
+                                    ``tick``
 ==================================  =========================================
 """
 from __future__ import annotations
@@ -56,6 +70,9 @@ POINTS = frozenset({
     "collective.timeout",
     "grads.nan_at_step",
     "pcc.write_truncate_after_bytes",
+    "serving.tick_stall",
+    "serving.admission_oom",
+    "serving.crash_at_tick",
 })
 
 _lock = threading.Lock()

@@ -35,6 +35,11 @@ def _t(x):
 
 def _segment(data, segment_ids, mode, op_name):
     data, seg = _t(data), _t(segment_ids)
+    # jax.ops.segment_* need a STATIC num_segments (it is the output
+    # shape); the reference API derives it from the data, so this one
+    # host read is the designed boundary — the reduction itself stays
+    # on device through dispatch.call below.
+    # tpulint: disable=TPU103,TPU104 static num_segments requires host max
     n_seg = int(np.asarray(seg._data).max()) + 1 if seg.size else 0
 
     def f(d, s):
@@ -178,24 +183,30 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     Returns (reindex_src, reindex_dst, out_nodes) where out_nodes is
     [x, unique new neighbors] and reindex_* are edges in local ids.
     Reference: python/paddle/geometric/reindex.py reindex_graph,
-    phi/kernels/gpu/graph_reindex_kernel.cu. Host-side: output shape is
-    data-dependent (sampler pipeline, not the training graph).
+    phi/kernels/gpu/graph_reindex_kernel.cu. Host-side BY DESIGN: the
+    output node set's size and first-occurrence order are data-dependent
+    (an in-graph jnp.unique(size=...) would sort, breaking reference
+    order parity), and the op sits in the sampler pipeline next to the
+    dataloader, never inside the training graph — same split as the
+    reference's CPU reindex kernel. tpulint suppressions below mark that
+    designed host boundary.
     """
-    xs = np.asarray(_t(x)._data).ravel()
-    nb = np.asarray(_t(neighbors)._data).ravel()
-    cnt = np.asarray(_t(count)._data).ravel()
+    xs = np.asarray(_t(x)._data).ravel()        # tpulint: disable=TPU104 host sampler op
+    nb = np.asarray(_t(neighbors)._data).ravel()  # tpulint: disable=TPU104 host sampler op
+    cnt = np.asarray(_t(count)._data).ravel()   # tpulint: disable=TPU104 host sampler op
     mapping = {}
     out_nodes = []
-    for v in xs.tolist():
+    for v in xs.tolist():                       # tpulint: disable=TPU102 first-occurrence order is host logic
         if v not in mapping:
             mapping[v] = len(out_nodes)
             out_nodes.append(v)
-    for v in nb.tolist():
+    for v in nb.tolist():                       # tpulint: disable=TPU102 first-occurrence order is host logic
         if v not in mapping:
             mapping[v] = len(out_nodes)
             out_nodes.append(v)
+    # tpulint: disable=TPU102 dict lookup per edge is host logic
     reindex_src = np.asarray([mapping[v] for v in nb.tolist()], np.int64)
-    dst = np.repeat(np.arange(xs.shape[0]), cnt)
+    dst = np.repeat(np.arange(xs.shape[0]), cnt)  # tpulint: disable=TPU104 ragged repeat, host sampler op
     reindex_dst = dst.astype(np.int64)
     return (Tensor(jnp.asarray(reindex_src.astype(np.int32))),
             Tensor(jnp.asarray(reindex_dst.astype(np.int32))),
@@ -205,23 +216,27 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
 def reindex_heter_graph(x, neighbors, count, value_buffer=None,
                         index_buffer=None, name=None):
     """Heterogeneous variant: neighbors/count are lists per edge type
-    (reference reindex.py reindex_heter_graph)."""
-    xs = np.asarray(_t(x)._data).ravel()
+    (reference reindex.py reindex_heter_graph). Host-side by design for
+    the same reasons as :func:`reindex_graph` (data-dependent output
+    shape + first-occurrence order, sampler pipeline)."""
+    xs = np.asarray(_t(x)._data).ravel()        # tpulint: disable=TPU104 host sampler op
     mapping = {}
     out_nodes = []
-    for v in xs.tolist():
+    for v in xs.tolist():                       # tpulint: disable=TPU102 first-occurrence order is host logic
         if v not in mapping:
             mapping[v] = len(out_nodes)
             out_nodes.append(v)
     srcs, dsts = [], []
     for nb_t, cnt_t in zip(neighbors, count):
-        nb = np.asarray(_t(nb_t)._data).ravel()
-        cnt = np.asarray(_t(cnt_t)._data).ravel()
-        for v in nb.tolist():
+        nb = np.asarray(_t(nb_t)._data).ravel()   # tpulint: disable=TPU104 host sampler op
+        cnt = np.asarray(_t(cnt_t)._data).ravel()  # tpulint: disable=TPU104 host sampler op
+        for v in nb.tolist():                   # tpulint: disable=TPU102 first-occurrence order is host logic
             if v not in mapping:
                 mapping[v] = len(out_nodes)
                 out_nodes.append(v)
+        # tpulint: disable=TPU102 dict lookup per edge is host logic
         srcs.append(np.asarray([mapping[v] for v in nb.tolist()], np.int64))
+        # tpulint: disable=TPU104 ragged repeat, host sampler op
         dsts.append(np.repeat(np.arange(xs.shape[0]), cnt).astype(np.int64))
     return (Tensor(jnp.asarray(np.concatenate(srcs).astype(np.int32))),
             Tensor(jnp.asarray(np.concatenate(dsts).astype(np.int32))),
@@ -238,22 +253,26 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
                      return_eids=False, perm_buffer=None, name=None):
     """Uniformly sample up to ``sample_size`` in-neighbors per node from
     CSC storage (reference python/paddle/geometric/sampling/neighbors.py,
-    phi/kernels/gpu/graph_sample_neighbors_kernel.cu). Host-side sampler.
+    phi/kernels/gpu/graph_sample_neighbors_kernel.cu). Host-side sampler
+    BY DESIGN: per-node degrees make every output ragged
+    (data-dependent shapes) and the op feeds the dataloader, mirroring
+    the reference's CPU sampling kernel — suppressions below mark the
+    designed host boundary.
     """
     from ..core.generator import default_generator
-    nodes = np.asarray(_t(input_nodes)._data).ravel()
-    rownp = np.asarray(_t(row)._data).ravel()
-    spans = _csr_neighbors(np.asarray(_t(colptr)._data), nodes)
-    eid_np = (np.asarray(_t(eids)._data).ravel()
+    nodes = np.asarray(_t(input_nodes)._data).ravel()  # tpulint: disable=TPU104 host sampler op
+    rownp = np.asarray(_t(row)._data).ravel()   # tpulint: disable=TPU104 host sampler op
+    spans = _csr_neighbors(np.asarray(_t(colptr)._data), nodes)  # tpulint: disable=TPU104 host sampler op
+    eid_np = (np.asarray(_t(eids)._data).ravel()  # tpulint: disable=TPU104 host sampler op
               if eids is not None else None)
     key = default_generator().next_key()
     rng = np.random.RandomState(
-        int(jax.random.randint(key, (), 0, 2**31 - 1)))
+        int(jax.random.randint(key, (), 0, 2**31 - 1)))  # tpulint: disable=TPU103 seed the host RNG once
     out, cnt, oeids = [], [], []
     for lo, hi in spans:
         deg = hi - lo
-        if sample_size < 0 or deg <= sample_size:
-            pick = np.arange(lo, hi)
+        if sample_size < 0 or deg <= sample_size:  # tpulint: disable=TPU105 ragged per-node branch, host sampler
+            pick = np.arange(lo, hi)            # tpulint: disable=TPU104 host sampler op
         else:
             pick = lo + rng.choice(deg, size=sample_size, replace=False)
         out.append(rownp[pick])
@@ -275,22 +294,23 @@ def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
                               name=None):
     """Weighted (without-replacement) neighbor sampling — probability
     proportional to edge weight (reference weighted_sample_neighbors,
-    phi/kernels/gpu/weighted_sample_neighbors_kernel.cu)."""
+    phi/kernels/gpu/weighted_sample_neighbors_kernel.cu). Host-side
+    sampler by design — see :func:`sample_neighbors`."""
     from ..core.generator import default_generator
-    nodes = np.asarray(_t(input_nodes)._data).ravel()
-    rownp = np.asarray(_t(row)._data).ravel()
-    wnp = np.asarray(_t(edge_weight)._data).ravel().astype(np.float64)
-    spans = _csr_neighbors(np.asarray(_t(colptr)._data), nodes)
-    eid_np = (np.asarray(_t(eids)._data).ravel()
+    nodes = np.asarray(_t(input_nodes)._data).ravel()  # tpulint: disable=TPU104 host sampler op
+    rownp = np.asarray(_t(row)._data).ravel()   # tpulint: disable=TPU104 host sampler op
+    wnp = np.asarray(_t(edge_weight)._data).ravel().astype(np.float64)  # tpulint: disable=TPU104 host sampler op
+    spans = _csr_neighbors(np.asarray(_t(colptr)._data), nodes)  # tpulint: disable=TPU104 host sampler op
+    eid_np = (np.asarray(_t(eids)._data).ravel()  # tpulint: disable=TPU104 host sampler op
               if eids is not None else None)
     key = default_generator().next_key()
     rng = np.random.RandomState(
-        int(jax.random.randint(key, (), 0, 2**31 - 1)))
+        int(jax.random.randint(key, (), 0, 2**31 - 1)))  # tpulint: disable=TPU103 seed the host RNG once
     out, cnt, oeids = [], [], []
     for lo, hi in spans:
         deg = hi - lo
-        if sample_size < 0 or deg <= sample_size:
-            pick = np.arange(lo, hi)
+        if sample_size < 0 or deg <= sample_size:  # tpulint: disable=TPU105 ragged per-node branch, host sampler
+            pick = np.arange(lo, hi)            # tpulint: disable=TPU104 host sampler op
         else:
             w = wnp[lo:hi]
             p = w / w.sum() if w.sum() > 0 else None

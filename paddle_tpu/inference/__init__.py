@@ -125,9 +125,13 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
+from .resilience import (Overloaded, ReplicaLifecycle,  # noqa: E402
+                         ReplicaState, RequestOutcome, RequestStatus,
+                         ResilienceConfig)
 from .serving import (BlockManager, GPTPagedEngine,  # noqa: E402
                       LlamaPagedEngine, PagedEngine, Request)
 
 __all__ = ["Config", "Predictor", "create_predictor", "BlockManager",
            "PagedEngine", "LlamaPagedEngine", "GPTPagedEngine",
-           "Request"]
+           "Request", "Overloaded", "ReplicaLifecycle", "ReplicaState",
+           "RequestOutcome", "RequestStatus", "ResilienceConfig"]

@@ -22,10 +22,22 @@ free list, and admission/eviction is plain Python between ticks:
 Greedy sampling v1; numerics are locked to the training models by
 token-parity tests against ``LlamaForCausalLM.generate`` and a
 full-recompute GPT greedy loop.
+
+Resilience contract (see ``inference/resilience.py`` and README "Serving
+resilience"): the tick loop never raises — overload, deadline expiry,
+memory races and injected faults become per-request terminal statuses
+(``FINISHED/SHED/DEADLINE_MISSED/CANCELLED/FAILED``) recorded in
+``engine.outcomes``; submitters see :class:`Overloaded` backpressure from
+the bounded queue; the replica walks an explicit lifecycle
+(``STARTING→WARMING→READY→DEGRADED→DRAINING→STOPPED``) with ``drain()``
+and health/readiness probes, and a stalled tick flips it DEGRADED via the
+attached watchdog.
 """
 from __future__ import annotations
 
 import math
+import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -34,9 +46,13 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from .resilience import (Overloaded, ReplicaLifecycle, ReplicaState,
+                         RequestOutcome, RequestStatus, ResilienceConfig)
+from . import resilience as _res
 
 __all__ = ["BlockManager", "Request", "PagedEngine", "LlamaPagedEngine",
-           "GPTPagedEngine"]
+           "GPTPagedEngine", "Overloaded", "RequestStatus", "ReplicaState",
+           "ResilienceConfig", "RequestOutcome"]
 
 
 class BlockManager:
@@ -71,6 +87,15 @@ class Request:
     temperature: float = 0.0          # 0 = greedy
     top_p: float = 1.0
     generated: List[int] = field(default_factory=list)
+    # --- resilience bookkeeping (engine-managed) ---
+    status: str = RequestStatus.QUEUED
+    detail: str = ""                  # terminal reason for non-FINISHED
+    submit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    finish_t: Optional[float] = None
+    token_times: List[float] = field(default_factory=list)
+    ttft_deadline_s: Optional[float] = None   # submit → first token
+    deadline_s: Optional[float] = None        # submit → completion
 
     @property
     def seq_len(self) -> int:
@@ -236,6 +261,58 @@ def _tuned_decode_block_size(cfg, nkv, max_batch, max_blocks_per_seq,
                            warmup=2, iters=5))
 
 
+#: model -> {arch name: jitted tick fn} — shared across engines of one
+#: model (entries die with the model; see PagedEngine.__init__)
+_PAGED_JIT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _sample_tokens(logits, temps, top_ps, key):
+    """Per-slot greedy / temperature / nucleus sampling — the same
+    kernel as ops.top_p_sampling (shared helper), keyed per tick so
+    the program is reusable across calls."""
+    from ..ops.search import nucleus_sample_ids
+    greedy = jnp.argmax(logits, axis=-1)
+    safe_t = jnp.maximum(temps, 1e-6)[:, None]
+    probs = jax.nn.softmax(logits / safe_t, axis=-1)
+    sampled = nucleus_sample_ids(probs, top_ps, key)[:, 0]
+    return jnp.where(temps > 0, sampled, greedy)
+
+
+def _paged_forward(arch, params, param_arrays, kcs, vcs, tokens, seq_lens,
+                   tables, temps, top_ps, key):
+    """One chunk for a (B, T) token batch; returns (next-token ids, new
+    caches). Traced under jit. A module-level function (arch + params
+    pre-bound via functools.partial) so the shared jit cache holds only
+    the model's small adapter/parameter objects — NEVER an engine
+    instance, whose paged K/V arrays are the largest allocation in the
+    process."""
+    import paddle_tpu.nn.functional as F
+
+    originals = [p._data for p in params]
+    for p, a in zip(params, param_arrays):
+        p._data = a
+    try:
+        B, T = tokens.shape
+        start = seq_lens - T
+        sl_t = Tensor(seq_lens)
+        tb_t = Tensor(tables)
+
+        def attend(li, q, k, v):
+            out, nkc, nvc = F.block_multihead_attention(
+                q, Tensor(kcs[li]), Tensor(vcs[li]), tb_t, sl_t,
+                new_k=k, new_v=v, causal=True)
+            kcs[li] = nkc._data
+            vcs[li] = nvc._data
+            return out
+
+        logits = arch.forward_chunk(tokens, start, attend)
+        nxt = _sample_tokens(logits._data[:, -1, :], temps, top_ps, key)
+        return nxt.astype(jnp.int32), kcs, vcs
+    finally:
+        for p, o in zip(params, originals):
+            p._data = o
+
+
 class PagedEngine:
     """Continuous-batching engine for causal LMs (paged KV caches)."""
 
@@ -243,7 +320,8 @@ class PagedEngine:
                  block_size: Optional[int] = 16,
                  num_blocks: int = 256, max_blocks_per_seq: int = 32,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 kv_dtype=None):
+                 kv_dtype=None,
+                 resilience: Optional[ResilienceConfig] = None):
         self.model = model
         self.arch = _pick_arch(model)
         self.cfg = model.cfg
@@ -274,9 +352,10 @@ class PagedEngine:
                  if jnp.issubdtype(p._data.dtype, jnp.floating)),
                 jnp.float32)
         self.kv_dtype = jnp.dtype(kv_dtype)
-        self.kc = [jnp.zeros((num_blocks, block_size, nkv, self.head_dim),
-                             self.kv_dtype) for _ in range(cfg.num_layers)]
-        self.vc = [jnp.zeros_like(self.kc[0])
+        self._kv_shape = (num_blocks, block_size, nkv, self.head_dim)
+        self.kc = [jnp.zeros(self._kv_shape, self.kv_dtype)
+                   for _ in range(cfg.num_layers)]
+        self.vc = [jnp.zeros(self._kv_shape, self.kv_dtype)
                    for _ in range(cfg.num_layers)]
 
         self.tables = np.zeros((max_batch, max_blocks_per_seq), np.int32)
@@ -287,15 +366,47 @@ class PagedEngine:
         self.queue: List[Request] = []
         self.rejected: Dict[int, str] = {}
         self._params = [p for p in model.parameters()]
-        # one jit wrapper: jax.jit itself specializes per (B, T) shape
-        self._fn = jax.jit(self._forward, donate_argnums=(1, 2))
+        # one jit wrapper: jax.jit itself specializes per (B, T) shape.
+        # Engines over the SAME model share it — _paged_forward reads
+        # only the model's Parameter objects (identical across engines)
+        # and takes caches/tables/tokens as arguments, so a second
+        # replica (or the single-stream baseline in bench.py) reuses
+        # compiled programs instead of re-tracing identical ones. The
+        # cache lives in a weak side table, NOT on the model: jitted
+        # callables hold locks and must not ride through deepcopy/pickle
+        # of the model.
+        import functools
+        cache = _PAGED_JIT_CACHE.setdefault(model, {})
+        arch_key = type(self.arch).__name__
+        fn = cache.get(arch_key)
+        if fn is None:
+            fn = cache[arch_key] = jax.jit(
+                functools.partial(_paged_forward, self.arch,
+                                  tuple(self._params)),
+                donate_argnums=(1, 2))
+        self._fn = fn
         self._key = jax.random.key(seed)
         self._done: List[Request] = []
         self._rid = 0
+        # --- resilience state ---
+        self.resilience = resilience or ResilienceConfig()
+        self._clock = time.monotonic      # seam for deterministic tests
+        self.lifecycle = ReplicaLifecycle(clock=self._clock)
+        #: terminal outcome per request (drained by ``drain_outcomes``;
+        #: long-running callers should drain it alongside step())
+        self.outcomes: Dict[int, RequestOutcome] = {}
+        self._ticks = 0
+        self.tick_failures = 0
+        self._watchdog = None
+        # finished results produced while warmup() owned the step loop —
+        # re-delivered by the next step()/run_to_completion
+        self._spillover: Dict[int, List[int]] = {}
 
     # ---------------------------------------------------------------- API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
-                    temperature: float = 0.0, top_p: float = 1.0) -> int:
+                    temperature: float = 0.0, top_p: float = 1.0,
+                    ttft_deadline_s: Optional[float] = None,
+                    deadline_s: Optional[float] = None) -> int:
         prompt = [int(t) for t in prompt_ids]
         if not prompt:
             raise ValueError("add_request: prompt must be non-empty")
@@ -313,10 +424,41 @@ class PagedEngine:
                 f"add_request: prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds the model's position table "
                 f"({max_pos})")
+        # ---- admission control (backpressure is an exception the
+        # SUBMITTER handles; everything after acceptance is a status) ----
+        if not self.lifecycle.admitting():
+            raise Overloaded(
+                f"replica is {self.lifecycle.state}: not accepting "
+                f"requests")
+        rcfg = self.resilience
+        if len(self.queue) >= rcfg.max_queue:
+            raise Overloaded(
+                f"admission queue full ({rcfg.max_queue} queued); retry "
+                f"on another replica")
         self._rid += 1
-        self.queue.append(Request(self._rid, prompt, max_new_tokens,
-                                  temperature=temperature, top_p=top_p))
-        return self._rid
+        req = Request(self._rid, prompt, max_new_tokens,
+                      temperature=temperature, top_p=top_p)
+        req.submit_t = self._clock()
+        req.ttft_deadline_s = (ttft_deadline_s if ttft_deadline_s
+                               is not None
+                               else rcfg.default_ttft_deadline_s)
+        req.deadline_s = (deadline_s if deadline_s is not None
+                          else rcfg.default_deadline_s)
+        need_total = self._blocks_needed(len(prompt) + max_new_tokens)
+        if (need_total > self.max_blocks_per_seq
+                or need_total > self._total_usable):
+            # can NEVER fit this replica's geometry: terminal FAILED at
+            # submit time (round 3 raised MemoryError from
+            # run_to_completion after other requests already ran)
+            reason = (f"needs {need_total} blocks (max_blocks_per_seq="
+                      f"{self.max_blocks_per_seq}, usable="
+                      f"{self._total_usable})")
+            self.rejected[req.rid] = reason
+            self._finish_request(req, RequestStatus.FAILED, detail=reason)
+            return req.rid
+        self.queue.append(req)
+        _res.M_QUEUE_DEPTH.set(len(self.queue))
+        return req.rid
 
     @property
     def num_active(self) -> int:
@@ -326,49 +468,6 @@ class PagedEngine:
         return bool(self.queue) or self.num_active > 0
 
     # ----------------------------------------------------------- compute
-    def _forward(self, param_arrays, kcs, vcs, tokens, seq_lens, tables,
-                 temps, top_ps, key):
-        """One chunk for a (B, T) token batch; returns (next-token ids,
-        new caches). Traced under jit."""
-        import paddle_tpu.nn.functional as F
-
-        params = self._params
-        originals = [p._data for p in params]
-        for p, a in zip(params, param_arrays):
-            p._data = a
-        try:
-            B, T = tokens.shape
-            start = seq_lens - T
-            sl_t = Tensor(seq_lens)
-            tb_t = Tensor(tables)
-
-            def attend(li, q, k, v):
-                out, nkc, nvc = F.block_multihead_attention(
-                    q, Tensor(kcs[li]), Tensor(vcs[li]), tb_t, sl_t,
-                    new_k=k, new_v=v, causal=True)
-                kcs[li] = nkc._data
-                vcs[li] = nvc._data
-                return out
-
-            logits = self.arch.forward_chunk(tokens, start, attend)
-            nxt = self._sample(logits._data[:, -1, :], temps, top_ps, key)
-            return nxt.astype(jnp.int32), kcs, vcs
-        finally:
-            for p, o in zip(params, originals):
-                p._data = o
-
-    @staticmethod
-    def _sample(logits, temps, top_ps, key):
-        """Per-slot greedy / temperature / nucleus sampling — the same
-        kernel as ops.top_p_sampling (shared helper), keyed per tick so
-        the program is reusable across calls."""
-        from ..ops.search import nucleus_sample_ids
-        greedy = jnp.argmax(logits, axis=-1)
-        safe_t = jnp.maximum(temps, 1e-6)[:, None]
-        probs = jax.nn.softmax(logits / safe_t, axis=-1)
-        sampled = nucleus_sample_ids(probs, top_ps, key)[:, 0]
-        return jnp.where(temps > 0, sampled, greedy)
-
     def _run_chunk(self, tokens_np, seq_lens_np, tables_np,
                    temps_np, top_ps_np):
         self._key, sub = jax.random.split(self._key)
@@ -411,26 +510,14 @@ class PagedEngine:
         return True
 
     def _admit(self):
+        from ..fault import inject as _inject
+
         admitted = []
         for slot in range(self.max_batch):
             if not self.queue or self.slots[slot] is not None:
                 continue
             req = self.queue[0]
             prefix_len = len(req.prompt) + len(req.generated)
-            need_total = self._blocks_needed(
-                len(req.prompt) + req.max_new_tokens)
-            if (need_total > self.max_blocks_per_seq
-                    or need_total > self._total_usable):
-                # reject WITHOUT raising mid-step: completed results from
-                # other requests must never be lost to one bad request.
-                # Callers read eng.rejected; run_to_completion raises
-                # AFTER everything else finished.
-                self.queue.pop(0)
-                self.rejected[req.rid] = (
-                    f"needs {need_total} blocks (max_blocks_per_seq="
-                    f"{self.max_blocks_per_seq}, usable="
-                    f"{self._total_usable})")
-                continue
             if (self._blocks_needed(prefix_len + 1)
                     > self.bm.available):
                 break  # head-of-line blocks until memory frees
@@ -440,8 +527,18 @@ class PagedEngine:
             self.slot_blocks[slot] = []
             # allocate the prefix blocks NOW so the next admission's
             # availability check sees the reduced pool
-            if not self._ensure_blocks(slot, prefix_len):
-                raise MemoryError("admission raced cache exhaustion")
+            raced = _inject.fire("serving.admission_oom") is not None
+            if raced or not self._ensure_blocks(slot, prefix_len):
+                # admission raced cache exhaustion (a concurrent slot's
+                # growth won the last blocks between the availability
+                # check and the allocate): un-admit and retry next tick
+                # — round 3 raised MemoryError here and killed the
+                # engine with every in-flight decode
+                self._release_slot(slot)
+                self.queue.insert(0, req)
+                break
+            req.status = RequestStatus.RUNNING
+            _res.M_ADMITTED.inc()
             admitted.append(slot)
         if admitted:
             self._prefill_batch(admitted)
@@ -488,12 +585,14 @@ class PagedEngine:
             for slot in involved:
                 if j == chunks_of[slot] - 1:
                     nxt_of[slot] = int(nxt[slot])
+        now = self._clock()
         for slot in slots:
             req = self.slots[slot]
             self.seq_lens[slot] = len(req.prompt) + len(req.generated)
             tok = nxt_of[slot]
             req.generated.append(tok)
             self.last_token[slot] = tok
+            self._record_token(req, now)
             self._maybe_finish(slot)
 
 
@@ -502,13 +601,50 @@ class PagedEngine:
         for later re-admission (its generated prefix re-prefills then —
         vLLM-style recompute preemption)."""
         req = self.slots[slot]
+        self._release_slot(slot)
+        req.status = RequestStatus.QUEUED
+        _res.M_EVICTIONS.inc()
+        self.queue.append(req)
+
+    def _release_slot(self, slot: int):
+        """Return a slot's KV blocks to the free list and reset its lane
+        in the batch state (idle lanes point at the trash block)."""
         self.slots[slot] = None
         self.bm.release(self.slot_blocks[slot])
         self.slot_blocks[slot] = []
         self.tables[slot, :] = 0
         self.seq_lens[slot] = 1
         self.last_token[slot] = 0
-        self.queue.append(req)
+
+    def _finish_request(self, req: Request, status: str,
+                        detail: str = ""):
+        """Move ``req`` to a terminal status and record its outcome. The
+        caller must already have released any slot/blocks it held."""
+        req.status = status
+        req.detail = detail
+        req.finish_t = self._clock()
+        _res.M_REQUESTS.inc(outcome=status)
+        if status == RequestStatus.SHED:
+            _res.M_SHED.inc()
+        elif status == RequestStatus.DEADLINE_MISSED:
+            _res.M_DEADLINE_MISSED.inc()
+        self.outcomes[req.rid] = RequestOutcome(
+            rid=req.rid, status=status, detail=detail,
+            tokens=list(req.generated), submit_t=req.submit_t,
+            first_token_t=req.first_token_t, finish_t=req.finish_t,
+            token_times=list(req.token_times))
+        if status == RequestStatus.FINISHED:
+            self._done.append(req)
+
+    def _record_token(self, req: Request, now: float):
+        """TTFT / inter-token latency bookkeeping for one new token."""
+        if req.first_token_t is None:
+            req.first_token_t = now
+            if req.submit_t is not None:
+                _res.M_TTFT.observe(now - req.submit_t)
+        elif req.token_times:
+            _res.M_ITL.observe(now - req.token_times[-1])
+        req.token_times.append(now)
 
     def _maybe_finish(self, slot: int):
         req = self.slots[slot]
@@ -517,73 +653,224 @@ class PagedEngine:
         last = req.generated[-1] if req.generated else None
         if (len(req.generated) >= req.max_new_tokens
                 or (self.eos_id is not None and last == self.eos_id)):
-            self._done.append(req)
-            self.slots[slot] = None
-            self.bm.release(self.slot_blocks[slot])
-            self.slot_blocks[slot] = []
-            self.tables[slot, :] = 0
-            self.seq_lens[slot] = 1
-            self.last_token[slot] = 0
+            self._release_slot(slot)
+            self._finish_request(req, RequestStatus.FINISHED)
 
+    # ------------------------------------------------- deadlines/overload
+    def _deadline_expired(self, req: Request, now: float) -> Optional[str]:
+        """Reason string when ``req`` is past a deadline, else None."""
+        if req.submit_t is None:
+            return None
+        waited = now - req.submit_t
+        if req.deadline_s is not None and waited > req.deadline_s:
+            return (f"total deadline {req.deadline_s}s expired after "
+                    f"{waited:.3f}s ({len(req.generated)} tokens)")
+        if (req.first_token_t is None and req.ttft_deadline_s is not None
+                and waited > req.ttft_deadline_s):
+            return (f"TTFT deadline {req.ttft_deadline_s}s expired after "
+                    f"{waited:.3f}s with no first token")
+        return None
+
+    def _expire_deadlines(self):
+        """Cancel queued AND in-flight requests whose TTFT/total deadline
+        has passed; in-flight cancellations reclaim their KV blocks."""
+        now = self._clock()
+        kept = []
+        for req in self.queue:
+            why = self._deadline_expired(req, now)
+            if why is None:
+                kept.append(req)
+            else:
+                self._finish_request(req, RequestStatus.DEADLINE_MISSED,
+                                     detail=why)
+        self.queue = kept
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            why = self._deadline_expired(req, now)
+            if why is not None:
+                self._release_slot(slot)
+                self._finish_request(req, RequestStatus.DEADLINE_MISSED,
+                                     detail=why)
+
+    def _shed_overload(self):
+        """Past the queue high-water mark, shed the NEWEST queued
+        requests (they would wait longest; the oldest are closest to a
+        slot) down to the mark. Preempted requests carrying generated
+        tokens are spared — shedding them would discard paid-for
+        prefill/decode compute (the queue stays bounded by max_queue
+        regardless)."""
+        hw = self.resilience.queue_high_water
+        if hw is None or len(self.queue) <= hw:
+            return
+        excess = len(self.queue) - hw
+        kept_rev: List[Request] = []
+        for req in reversed(self.queue):          # newest first
+            if excess > 0 and not req.generated:
+                excess -= 1
+                self._finish_request(
+                    req, RequestStatus.SHED,
+                    detail=f"queue past high-water mark ({hw})")
+            else:
+                kept_rev.append(req)
+        self.queue = kept_rev[::-1]
+
+    def _eviction_key(self, slot: int):
+        """Preemption victim ordering: most deadline slack first (no
+        deadline = infinite slack), youngest rid as tie-break — evicting
+        the request closest to its deadline would turn one preemption
+        into a deadline miss."""
+        req = self.slots[slot]
+        if req.deadline_s is not None and req.submit_t is not None:
+            dl = req.submit_t + req.deadline_s
+        else:
+            dl = float("inf")
+        return (dl, req.rid)
+
+    # ------------------------------------------------------------- ticks
     def step(self) -> Dict[int, List[int]]:
-        """One engine tick: admit + prefill queued requests, then a single
-        batched decode step for every active slot. Returns {rid:
-        generated_tokens} for requests that finished this tick."""
-        self._admit()
-        active = [i for i, s in enumerate(self.slots) if s is not None]
-        if active:
-            seq = self.seq_lens.copy()
-            skipped = []
-            for i in active:
-                # the cache holds seq_len-1 positions; the token being fed
-                # (the newest sample) lands at position seq_len-1, so the
-                # total INCLUDING it is exactly req.seq_len
-                seq[i] = self.slots[i].seq_len
-                if not self._ensure_blocks(i, int(seq[i])):
-                    # OOM: skip this slot's tick. Sentinel 0 — with seq=1
-                    # the op would write the token's K/V into position 0
-                    # of the slot's first REAL block, corrupting the
-                    # cached prompt; seq=0 puts the write at pos -1,
-                    # which the kernel drops and fully masks.
-                    seq[i] = 0
-                    skipped.append(i)
-            if skipped and len(skipped) == len(active):
-                # every active slot is memory-stalled: nobody can finish
-                # to free blocks, so this would livelock. Preempt the
-                # youngest request (vLLM recompute-preemption policy) and
-                # retry next tick with its blocks available.
-                victim = max(skipped, key=lambda i: self.slots[i].rid)
-                self._evict(victim)
-                return self._drain_done()
-            tokens = self.last_token[:, None].astype(np.int32)
-            temps = np.zeros((self.max_batch,), np.float32)
-            top_ps = np.ones((self.max_batch,), np.float32)
-            for i in active:
-                temps[i] = self.slots[i].temperature
-                top_ps[i] = self.slots[i].top_p
-            nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps)
-            for i in active:
-                if seq[i] == 0:
-                    continue
-                req = self.slots[i]
-                req.generated.append(int(nxt[i]))
-                self.seq_lens[i] = int(seq[i])   # cached positions now
-                self.last_token[i] = int(nxt[i])
-                self._maybe_finish(i)
+        """One engine tick: expire deadlines, shed overload, admit +
+        prefill queued requests, then a single batched decode step for
+        every active slot. Returns {rid: generated_tokens} for requests
+        that finished this tick.
+
+        Never raises from scheduling, memory pressure, or injected
+        faults: an internal tick failure marks the in-flight requests
+        FAILED, reclaims their KV blocks, and flips the replica
+        DEGRADED — the engine keeps serving."""
+        from ..observability import trace
+
+        wd = self._watchdog
+        if wd is not None:
+            wd.begin_work()
+        self._ticks += 1
+        t0 = time.perf_counter()
+        try:
+            with trace.span("serving.tick", "serving",
+                            args={"tick": self._ticks}):
+                try:
+                    self._tick()
+                    if self.lifecycle.state == ReplicaState.STARTING:
+                        self.lifecycle.to(ReplicaState.READY, "serving")
+                except Exception as e:
+                    self._on_tick_failure(e)
+        finally:
+            if wd is not None:
+                wd.end_work()
+            _res.M_TICK_SECONDS.observe(time.perf_counter() - t0)
+            _res.M_QUEUE_DEPTH.set(len(self.queue))
+            _res.M_KV_BLOCKS.set(self._total_usable - self.bm.available)
         return self._drain_done()
+
+    def _tick(self):
+        from ..fault import inject as _inject
+
+        stall = _inject.fire("serving.tick_stall")
+        if stall is not None:
+            # a wedged device transfer/compile: the tick thread blocks,
+            # no heartbeat reaches the watchdog
+            time.sleep(float(stall.get("seconds", 0.1)))
+        if _inject.fire("serving.crash_at_tick",
+                        tick=self._ticks) is not None:
+            raise _inject.InjectedFault(
+                "serving.crash_at_tick",
+                f"injected crash at tick {self._ticks}")
+        self._expire_deadlines()
+        # admit BEFORE shedding: a burst hitting an idle replica flows
+        # into free decode slots first; only what capacity could not
+        # absorb this tick counts against the high-water mark
+        self._admit()
+        self._shed_overload()
+        self._decode_active()
+
+    def _decode_active(self):
+        active = [i for i, s in enumerate(self.slots) if s is not None]
+        if not active:
+            return
+        seq = self.seq_lens.copy()
+        skipped = []
+        for i in active:
+            # the cache holds seq_len-1 positions; the token being fed
+            # (the newest sample) lands at position seq_len-1, so the
+            # total INCLUDING it is exactly req.seq_len
+            seq[i] = self.slots[i].seq_len
+            if not self._ensure_blocks(i, int(seq[i])):
+                # OOM: skip this slot's tick. Sentinel 0 — with seq=1
+                # the op would write the token's K/V into position 0
+                # of the slot's first REAL block, corrupting the
+                # cached prompt; seq=0 puts the write at pos -1,
+                # which the kernel drops and fully masks.
+                seq[i] = 0
+                skipped.append(i)
+        if skipped and len(skipped) == len(active):
+            # every active slot is memory-stalled: nobody can finish
+            # to free blocks, so this would livelock. Preempt the slot
+            # with the most deadline slack (vLLM recompute-preemption,
+            # deadline-aware) and retry next tick with its blocks free.
+            victim = max(skipped, key=self._eviction_key)
+            self._evict(victim)
+            return
+        tokens = self.last_token[:, None].astype(np.int32)
+        temps = np.zeros((self.max_batch,), np.float32)
+        top_ps = np.ones((self.max_batch,), np.float32)
+        for i in active:
+            temps[i] = self.slots[i].temperature
+            top_ps[i] = self.slots[i].top_p
+        nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps)
+        now = self._clock()
+        for i in active:
+            if seq[i] == 0:
+                continue
+            req = self.slots[i]
+            req.generated.append(int(nxt[i]))
+            self.seq_lens[i] = int(seq[i])   # cached positions now
+            self.last_token[i] = int(nxt[i])
+            self._record_token(req, now)
+            self._maybe_finish(i)
+
+    def _on_tick_failure(self, exc: BaseException):
+        """Contain an unexpected tick error: the in-flight requests are
+        FAILED (their KV state is suspect), their blocks reclaimed, and
+        the replica degrades — it keeps serving new requests, but the
+        readiness probe goes red so the balancer backs off."""
+        _res.M_TICK_FAILURES.inc()
+        self.tick_failures += 1
+        detail = f"tick {self._ticks} failed: {exc!r}"
+        for slot, req in enumerate(self.slots):
+            if req is None:
+                continue
+            try:
+                self._release_slot(slot)
+            except Exception:
+                self.slots[slot] = None   # never mask the containment
+            self._finish_request(req, RequestStatus.FAILED, detail=detail)
+        # the decode call DONATES kc/vc: a crash inside the executable
+        # may have invalidated those buffers with the new ones never
+        # assigned. Reallocate fresh pages — every slot was discarded
+        # above, so later admissions re-prefill from their prompts; a
+        # stale-buffer engine would otherwise fail every future tick
+        # while still admitting.
+        self.kc = [jnp.zeros(self._kv_shape, self.kv_dtype)
+                   for _ in range(self.cfg.num_layers)]
+        self.vc = [jnp.zeros(self._kv_shape, self.kv_dtype)
+                   for _ in range(self.cfg.num_layers)]
+        self.lifecycle.degrade(detail)
 
     def _drain_done(self) -> Dict[int, List[int]]:
         """Hand completed requests to the caller and DROP them — a
         long-running server must not retain every request ever served."""
-        out = {req.rid: req.generated for req in self._done}
+        out = dict(self._spillover)   # client traffic served mid-warmup
+        self._spillover.clear()
+        out.update((req.rid, req.generated) for req in self._done)
         self._done.clear()
         return out
 
     def run_to_completion(self, max_ticks: int = 10_000):
-        """Drain the queue; returns {rid: generated_tokens}. If any
-        request was rejected as never-fitting, raises MemoryError AFTER
-        all servable requests completed (their results stay retrievable
-        via step()/self.rejected for callers that need partial output)."""
+        """Tick until no work remains; returns {rid: generated_tokens}
+        for FINISHED requests. Requests that ended SHED / DEADLINE_MISSED
+        / CANCELLED / FAILED are absent here — read ``self.outcomes``
+        (or ``drain_outcomes()``) for their terminal records; never-
+        fitting submissions also appear in ``self.rejected``."""
         out: Dict[int, List[int]] = {}
         ticks = 0
         while self.has_work():
@@ -591,19 +878,163 @@ class PagedEngine:
             ticks += 1
             if ticks > max_ticks:
                 raise RuntimeError("serving engine did not converge")
-        if self.rejected:
-            detail = "; ".join(f"request {rid}: {why}"
-                               for rid, why in self.rejected.items())
-            rejected = dict(self.rejected)
-            self.rejected.clear()
-            err = MemoryError(f"rejected never-fitting request(s): "
-                              f"{detail}")
-            # completed generations must survive the raise — callers that
-            # catch can still read every successful result
-            err.results = out
-            err.rejected = rejected
-            raise err
         return out
+
+    # ------------------------------------------------ replica operations
+    def request_status(self, rid: int) -> Optional[str]:
+        """Current status of a submitted request (terminal statuses stay
+        readable until ``drain_outcomes`` pops them); None = unknown."""
+        oc = self.outcomes.get(rid)
+        if oc is not None:
+            return oc.status
+        for req in self.queue:
+            if req.rid == rid:
+                return req.status
+        for req in self.slots:
+            if req is not None and req.rid == rid:
+                return req.status
+        return None
+
+    def drain_outcomes(self) -> Dict[int, RequestOutcome]:
+        """Hand terminal outcomes to the caller and drop them (same
+        retention contract as ``_drain_done``: a long-running replica
+        must not retain every request ever served)."""
+        out, self.outcomes = self.outcomes, {}
+        for rid in out:          # rejected mirrors submit-time FAILED
+            self.rejected.pop(rid, None)
+        return out
+
+    def cancel(self, rid: int, reason: str = "cancelled by caller") -> bool:
+        """Cancel a queued or in-flight request; its KV blocks return to
+        the free list immediately. False if ``rid`` is not live."""
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                self.queue.pop(i)
+                self._finish_request(req, RequestStatus.CANCELLED,
+                                     detail=reason)
+                return True
+        for slot, req in enumerate(self.slots):
+            if req is not None and req.rid == rid:
+                self._release_slot(slot)
+                self._finish_request(req, RequestStatus.CANCELLED,
+                                     detail=reason)
+                return True
+        return False
+
+    def warmup(self, prompt_len: Optional[int] = None,
+               max_new_tokens: int = 2) -> "PagedEngine":
+        """Compile the steady-state programs (full prefill chunk + the
+        batched decode step) before real traffic:
+        STARTING→WARMING→READY. Idempotent on a READY replica.
+
+        Traffic that arrived before READY (admission is open from
+        STARTING — those requests wait for exactly these compiles) is
+        served alongside the synthetic warmup request; its results are
+        re-delivered by the next ``step()``/``run_to_completion``."""
+        if self.lifecycle.state == ReplicaState.READY:
+            return self
+        self.lifecycle.to(ReplicaState.WARMING, "warmup")
+        n = prompt_len if prompt_len is not None else self.block_size
+        rid = self.add_request([1] * max(1, n),
+                               max_new_tokens=max_new_tokens)
+        # the synthetic request is operator work: no SLO deadlines
+        # (expiring it mid-compile would block READY), and it jumps to
+        # the queue head so a pre-READY client burst can neither starve
+        # nor shed it
+        for i, req in enumerate(self.queue):
+            if req.rid == rid:
+                req.ttft_deadline_s = req.deadline_s = None
+                self.queue.insert(0, self.queue.pop(i))
+                break
+        while self.outcomes.get(rid) is None and self.has_work():
+            res = self.step()
+            res.pop(rid, None)          # warmup is not traffic
+            self._spillover.update(res)
+        oc = self.outcomes.pop(rid, None)
+        if oc is None or oc.status != RequestStatus.FINISHED:
+            # stay in WARMING (still admits): READY would advertise a
+            # replica whose steady-state programs never compiled
+            raise RuntimeError(
+                f"warmup request ended "
+                f"{oc.status if oc else '<missing>'}: "
+                f"{oc.detail if oc else ''}")
+        self.lifecycle.to(ReplicaState.READY, "warmup complete")
+        return self
+
+    def drain(self, max_ticks: int = 10_000) -> Dict[int, List[int]]:
+        """Graceful shutdown: stop admission, finish in-flight decodes,
+        then STOP. Queued requests that never got a slot are CANCELLED
+        (their clients retry on another replica); running requests
+        decode to completion. Returns {rid: tokens} finished during the
+        drain."""
+        if self.lifecycle.state == ReplicaState.STOPPED:
+            return {}
+        self.lifecycle.to(ReplicaState.DRAINING, "drain()")
+        for req in self.queue:
+            self._finish_request(req, RequestStatus.CANCELLED,
+                                 detail="drained before admission")
+        self.queue = []
+        out: Dict[int, List[int]] = {}
+        ticks = 0
+        # loop on has_work(), not num_active: livelock preemption can
+        # bounce an in-flight request back through the queue mid-drain,
+        # and it still must reach a terminal status
+        while self.has_work():
+            out.update(self.step())
+            ticks += 1
+            if ticks > max_ticks:
+                # fail whatever is still live rather than spin forever
+                for req in self.queue:
+                    self._finish_request(req, RequestStatus.FAILED,
+                                         detail="drain did not converge")
+                self.queue = []
+                for slot, req in enumerate(self.slots):
+                    if req is not None:
+                        self._release_slot(slot)
+                        self._finish_request(
+                            req, RequestStatus.FAILED,
+                            detail="drain did not converge")
+                break
+        self.lifecycle.to(ReplicaState.STOPPED, "drained")
+        _res.M_QUEUE_DEPTH.set(0)
+        _res.M_KV_BLOCKS.set(self._total_usable - self.bm.available)
+        return out
+
+    def recover(self, reason: str = "operator recover"):
+        """DEGRADED → READY once the operator (or an orchestrator health
+        check) has decided the stall/crash cause is gone."""
+        self.lifecycle.to(ReplicaState.READY, reason)
+
+    def attach_watchdog(self, watchdog) -> "PagedEngine":
+        """Wire a :class:`~paddle_tpu.distributed.watchdog.Watchdog`
+        into the tick loop: every tick brackets begin_work/end_work (so
+        an idle engine stays quiet), and a tick stalled past the
+        watchdog timeout flips this replica DEGRADED while the watchdog
+        dumps thread stacks + the span-buffer tail."""
+        self._watchdog = watchdog
+        prev = watchdog.on_hang
+
+        def _on_hang(wd):
+            self.lifecycle.degrade(
+                f"tick stalled > {wd.timeout}s (watchdog)")
+            if prev is not None:
+                prev(wd)
+
+        watchdog.on_hang = _on_hang
+        return self
+
+    def health(self) -> dict:
+        """Liveness/readiness probe payload (what an HTTP /healthz in
+        front of this replica returns)."""
+        lc = self.lifecycle
+        return {"state": lc.state, "ready": lc.ready(),
+                "live": lc.live(),
+                "queue_depth": len(self.queue),
+                "active": self.num_active,
+                "kv_blocks_free": self.bm.available,
+                "kv_blocks_total": self._total_usable,
+                "ticks": self._ticks,
+                "tick_failures": self.tick_failures}
 
 
 # Backward-compatible names: the generic engine picks the adapter itself.

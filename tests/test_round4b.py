@@ -193,18 +193,21 @@ class TestServingUpgrades:
         got = {tuple(p): batched[i + 1] for i, p in enumerate(prompts)}
         assert got == {tuple(p): singles[tuple(p)] for p in prompts}
 
-    def test_run_to_completion_attaches_results(self):
-        from paddle_tpu.inference.serving import PagedEngine
+    def test_run_to_completion_with_never_fitting_request(self):
+        # round 11: never-fitting requests are a terminal FAILED status
+        # at submit time (no MemoryError out of the serving loop); the
+        # servable request's results are returned normally
+        from paddle_tpu.inference.serving import PagedEngine, RequestStatus
         m = self._tiny_llama()
         eng = PagedEngine(m, max_batch=2, block_size=4, num_blocks=8,
                           max_blocks_per_seq=4)
         ok = eng.add_request([1, 2, 3], max_new_tokens=2)
-        eng.add_request(list(range(1, 40)), max_new_tokens=8)  # never fits
-        with pytest.raises(MemoryError) as ei:
-            eng.run_to_completion()
-        assert ok in ei.value.results
-        assert len(ei.value.results[ok]) == 2
-        assert ei.value.rejected
+        bad = eng.add_request(list(range(1, 40)), max_new_tokens=8)
+        assert eng.outcomes[bad].status == RequestStatus.FAILED
+        assert eng.rejected[bad]
+        out = eng.run_to_completion()
+        assert len(out[ok]) == 2
+        assert bad not in out
 
     def test_gpt_position_overflow_rejected_at_add(self):
         from paddle_tpu.inference.serving import PagedEngine

@@ -13,12 +13,21 @@ from paddle_tpu.inference import BlockManager, LlamaPagedEngine
 from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
 
+_MODEL_CACHE = {}
+
+
 def _tiny_model():
-    paddle.seed(7)
-    cfg = LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=128,
-                      num_layers=2, num_heads=4, max_seq_len=128,
-                      use_flash_attention=False)
-    return LlamaForCausalLM(cfg)
+    # one shared instance: weights are seeded identically every call and
+    # no test mutates them, while engines over one model share compiled
+    # tick programs (serving._PAGED_JIT_CACHE) — this suite is decode
+    # parity, not compile timing
+    if "m" not in _MODEL_CACHE:
+        paddle.seed(7)
+        cfg = LlamaConfig(vocab_size=97, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          max_seq_len=128, use_flash_attention=False)
+        _MODEL_CACHE["m"] = LlamaForCausalLM(cfg)
+    return _MODEL_CACHE["m"]
 
 
 def _ref_greedy(model, prompt, n_new):
@@ -107,18 +116,24 @@ class TestPagedEngineParity:
         assert out[r2] == _ref_greedy(model, p2, 6)
         assert eng.bm.available == 4          # everything released
 
-    def test_memory_exhaustion_raises_clearly(self):
+    def test_never_fitting_request_fails_at_submit(self):
+        """A request that can never fit this replica's geometry is a
+        terminal FAILED status at submit time — nothing raises, no other
+        request's results are at risk, and the engine keeps serving."""
+        from paddle_tpu.inference import RequestStatus
         model = _tiny_model()
         eng = LlamaPagedEngine(model, max_batch=1, block_size=4,
                                num_blocks=4, max_blocks_per_seq=2)
-        eng.add_request(list(range(1, 30)), max_new_tokens=4)
-        with pytest.raises(MemoryError):
-            eng.run_to_completion()
-        # the rejected request was dequeued: serving continues for others
+        bad = eng.add_request(list(range(1, 30)), max_new_tokens=4)
+        assert eng.request_status(bad) == RequestStatus.FAILED
+        assert bad in eng.rejected and "blocks" in eng.rejected[bad]
+        assert "blocks" in eng.outcomes[bad].detail
+        # the rejected request never entered the queue
         assert not eng.queue
         rid = eng.add_request([1, 2, 3], max_new_tokens=2)
         out = eng.run_to_completion()
         assert len(out[rid]) == 2
+        assert bad not in out
 
     def test_request_validation(self):
         model = _tiny_model()

@@ -1,0 +1,531 @@
+"""Serving-tier resilience drills.
+
+Contract under test (ISSUE 6 / README "Serving resilience"): no injected
+fault — tick stall, admission OOM race, crash-at-tick — and no overload
+condition — deadline expiry, queue shedding, drain — raises out of
+``PagedEngine.step()`` or leaks a KV block; every submitted request ends
+in exactly one terminal status (FINISHED / SHED / DEADLINE_MISSED /
+CANCELLED / FAILED), and the replica lifecycle + watchdog wiring turn a
+stalled or crashed tick into a DEGRADED (not dead) replica.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.watchdog import Watchdog
+from paddle_tpu.fault import inject
+from paddle_tpu.inference import (Overloaded, PagedEngine, ReplicaState,
+                                  RequestStatus, ResilienceConfig)
+from paddle_tpu.inference.resilience import TERMINAL_STATUSES
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import REGISTRY
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, max_seq_len=256,
+                      use_flash_attention=False)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    inject.disarm_all()
+    yield
+    inject.disarm_all()
+    paddle.set_flags({"FLAGS_enable_metrics": False})
+
+
+def make_engine(model, *, max_batch=2, block_size=4, num_blocks=32,
+                max_blocks_per_seq=16, **res_kw):
+    res = ResilienceConfig(**res_kw) if res_kw else None
+    return PagedEngine(model, max_batch=max_batch, block_size=block_size,
+                       num_blocks=num_blocks,
+                       max_blocks_per_seq=max_blocks_per_seq,
+                       resilience=res)
+
+
+def prompt(seed, n=5):
+    rng = np.random.RandomState(seed)
+    return [int(t) for t in rng.randint(1, 97, size=n)]
+
+
+def assert_quiesced(eng, rids):
+    """The drill invariant: every submitted request terminal, no KV block
+    leaked, no slot occupied, queue empty."""
+    for rid in rids:
+        oc = eng.outcomes.get(rid)
+        assert oc is not None, f"request {rid} has no terminal outcome"
+        assert oc.status in TERMINAL_STATUSES, (rid, oc.status)
+    assert not eng.queue
+    assert all(s is None for s in eng.slots)
+    assert eng.bm.available == eng._total_usable, "leaked KV blocks"
+
+
+class FakeClock:
+    """Deterministic deadline clock (engine + lifecycle seam)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def install(self, eng):
+        eng._clock = self
+        eng.lifecycle._clock = self
+        return self
+
+
+# ---------------------------------------------------------------- drills
+class TestAdmissionRace:
+    def test_admission_oom_requeues_instead_of_raising(self, model):
+        eng = make_engine(model)
+        r1 = eng.add_request(prompt(0), max_new_tokens=4)
+        r2 = eng.add_request(prompt(1), max_new_tokens=4)
+        with inject.armed("serving.admission_oom"):
+            out = eng.step()          # must absorb the race, not raise
+        assert isinstance(out, dict)
+        # the raced request went back to the queue head, not to FAILED
+        assert eng.request_status(r1) in (RequestStatus.QUEUED,
+                                          RequestStatus.RUNNING)
+        out = eng.run_to_completion()
+        assert set(out) == {r1, r2}
+        assert len(out[r1]) == 4 and len(out[r2]) == 4
+        assert_quiesced(eng, [r1, r2])
+        assert eng.lifecycle.state == ReplicaState.READY
+
+    def test_real_head_of_line_memory_stall_still_completes(self, model):
+        # tight pool: head-of-line waits for blocks, nobody raises
+        eng = make_engine(model, max_batch=2, num_blocks=5,
+                          max_blocks_per_seq=4)
+        rids = [eng.add_request(prompt(i, 4), max_new_tokens=6)
+                for i in range(3)]
+        out = eng.run_to_completion(max_ticks=300)
+        assert all(len(out[r]) == 6 for r in rids)
+        assert_quiesced(eng, rids)
+
+
+class TestCrashAtTick:
+    def test_crash_fails_in_flight_degrades_and_keeps_serving(self, model):
+        eng = make_engine(model)
+        r1 = eng.add_request(prompt(2), max_new_tokens=8)
+        r2 = eng.add_request(prompt(3), max_new_tokens=8)
+        eng.step()                                    # tick 1: admitted
+        with inject.armed("serving.crash_at_tick", tick=2):
+            out = eng.step()                          # tick 2: crashes
+        assert out == {}                              # nothing raised
+        assert eng.outcomes[r1].status == RequestStatus.FAILED
+        assert eng.outcomes[r2].status == RequestStatus.FAILED
+        assert "crash" in eng.outcomes[r1].detail
+        assert eng.lifecycle.state == ReplicaState.DEGRADED
+        assert eng.tick_failures == 1
+        assert_quiesced(eng, [r1, r2])
+        # a DEGRADED replica still serves (readiness is the router's cue)
+        assert eng.health()["ready"] is False
+        r3 = eng.add_request(prompt(4), max_new_tokens=3)
+        out = eng.run_to_completion()
+        assert len(out[r3]) == 3
+        eng.recover()
+        assert eng.lifecycle.state == ReplicaState.READY
+        assert eng.health()["ready"] is True
+
+
+class TestCrashAtFirstTick:
+    def test_crash_before_first_success_still_degrades(self, model):
+        """degrade() must work from STARTING: a replica crash-looping on
+        its very first tick cannot stay probed as STARTING forever."""
+        eng = make_engine(model)
+        rid = eng.add_request(prompt(70), max_new_tokens=2)
+        with inject.armed("serving.crash_at_tick", tick=1):
+            out = eng.step()
+        assert out == {}
+        assert eng.lifecycle.state == ReplicaState.DEGRADED
+        # the crash hit before admission: the request is still safely
+        # queued and a degraded replica keeps serving it
+        assert eng.request_status(rid) == RequestStatus.QUEUED
+        out = eng.run_to_completion()
+        assert len(out[rid]) == 2
+        assert_quiesced(eng, [rid])
+
+    def test_kv_caches_reallocated_after_crash(self, model):
+        """The decode call donates kc/vc — after an absorbed tick crash
+        the engine must run on FRESH cache pages, never the possibly-
+        invalidated donated buffers."""
+        eng = make_engine(model)
+        r1 = eng.add_request(prompt(71), max_new_tokens=4)
+        eng.step()
+        assert any(bool(a.any()) for a in eng.kc)   # prefill wrote pages
+        with inject.armed("serving.crash_at_tick"):
+            eng.step()
+        # fresh zero pages, correct geometry
+        assert all(not bool(a.any()) for a in eng.kc + eng.vc)
+        assert all(a.shape == eng._kv_shape for a in eng.kc)
+        # and the fresh pages actually serve traffic
+        r2 = eng.add_request(prompt(72), max_new_tokens=3)
+        assert len(eng.run_to_completion()[r2]) == 3
+        assert_quiesced(eng, [r1, r2])
+
+
+class TestDeadlines:
+    def test_ttft_deadline_expires_in_queue(self, model):
+        eng = make_engine(model, max_batch=1)
+        clock = FakeClock().install(eng)
+        busy = eng.add_request(prompt(5), max_new_tokens=6)
+        eng.step()                     # busy owns the only slot
+        late = eng.add_request(prompt(6), max_new_tokens=6,
+                               ttft_deadline_s=5.0)
+        clock.t = 6.0                  # past the TTFT deadline, no token
+        eng.step()
+        oc = eng.outcomes[late]
+        assert oc.status == RequestStatus.DEADLINE_MISSED
+        assert "TTFT" in oc.detail
+        assert oc.tokens == []
+        out = eng.run_to_completion()
+        assert len(out[busy]) == 6
+        assert_quiesced(eng, [busy, late])
+
+    def test_total_deadline_cancels_mid_flight_and_reclaims_blocks(
+            self, model):
+        eng = make_engine(model, max_batch=1)
+        clock = FakeClock().install(eng)
+        rid = eng.add_request(prompt(7), max_new_tokens=50,
+                              deadline_s=10.0)
+        eng.step()
+        eng.step()
+        assert eng.request_status(rid) == RequestStatus.RUNNING
+        blocks_held = eng._total_usable - eng.bm.available
+        assert blocks_held > 0
+        clock.t = 11.0                 # expire mid-flight
+        eng.step()
+        oc = eng.outcomes[rid]
+        assert oc.status == RequestStatus.DEADLINE_MISSED
+        assert "total deadline" in oc.detail
+        assert 0 < len(oc.tokens) < 50          # partial output recorded
+        assert_quiesced(eng, [rid])
+
+    def test_default_deadlines_from_config(self, model):
+        eng = make_engine(model, max_batch=1, default_deadline_s=10.0)
+        clock = FakeClock().install(eng)
+        rid = eng.add_request(prompt(8), max_new_tokens=50)
+        eng.step()
+        clock.t = 11.0
+        eng.step()
+        assert eng.outcomes[rid].status == RequestStatus.DEADLINE_MISSED
+
+
+class TestOverload:
+    def test_bounded_queue_raises_overloaded(self, model):
+        eng = make_engine(model, max_batch=1, max_queue=2)
+        eng.add_request(prompt(9), max_new_tokens=4)
+        eng.add_request(prompt(10), max_new_tokens=4)
+        with pytest.raises(Overloaded, match="queue full"):
+            eng.add_request(prompt(11), max_new_tokens=4)
+        out = eng.run_to_completion()
+        assert len(out) == 2
+
+    def test_shed_past_high_water(self, model):
+        eng = make_engine(model, max_batch=1, max_queue=16,
+                          queue_high_water=2)
+        first = eng.add_request(prompt(12), max_new_tokens=4)
+        eng.step()                     # first request takes the slot
+        queued = [eng.add_request(prompt(13 + i), max_new_tokens=4)
+                  for i in range(4)]
+        eng.step()                     # shed sweep: newest past mark go
+        shed = [r for r in queued
+                if eng.request_status(r) == RequestStatus.SHED]
+        assert len(shed) == 2
+        assert shed == queued[2:]      # newest shed, oldest kept
+        for r in shed:
+            assert "high-water" in eng.outcomes[r].detail
+        out = eng.run_to_completion()
+        assert set(out) == {first, *queued[:2]}
+        assert_quiesced(eng, [first, *queued])
+
+
+    def test_shed_spares_preempted_partial_work(self, model):
+        """A recompute-preempted request (carrying generated tokens)
+        sitting newest in the queue is spared by the shed sweep — its
+        prefill/decode compute is already paid for."""
+        eng = make_engine(model, max_batch=1, max_queue=16,
+                          queue_high_water=1)
+        first = eng.add_request(prompt(50), max_new_tokens=4)
+        eng.step()
+        a = eng.add_request(prompt(51), max_new_tokens=4)
+        b = eng.add_request(prompt(52), max_new_tokens=4)   # newest
+        eng.queue[-1].generated.append(7)   # simulate preempted progress
+        eng.step()
+        assert eng.request_status(a) == RequestStatus.SHED
+        assert eng.request_status(b) != RequestStatus.SHED
+        out = eng.run_to_completion()
+        assert first in out and b in out
+        assert_quiesced(eng, [first, a, b])
+
+    def test_burst_at_idle_replica_fills_slots_before_shedding(
+            self, model):
+        """Admission runs before the shed sweep: free decode slots
+        absorb a burst; only the unabsorbable excess is shed."""
+        eng = make_engine(model, max_batch=4, max_queue=16,
+                          queue_high_water=1)
+        rids = [eng.add_request(prompt(55 + i), max_new_tokens=2)
+                for i in range(5)]
+        out = eng.step()  # 4 into slots, 1 queued == high water: no shed
+        statuses = [eng.request_status(r) for r in rids]
+        assert RequestStatus.SHED not in statuses
+        out.update(eng.run_to_completion())
+        assert set(out) == set(rids)
+        assert_quiesced(eng, rids)
+
+    def test_drain_outcomes_drops_rejected_mirror(self, model):
+        eng = make_engine(model, max_batch=1, num_blocks=4,
+                          max_blocks_per_seq=2)
+        bad = eng.add_request(list(range(1, 30)), max_new_tokens=4)
+        assert bad in eng.rejected
+        out = eng.drain_outcomes()
+        assert out[bad].status == RequestStatus.FAILED
+        assert bad not in eng.rejected      # retention contract
+
+
+class TestLifecycle:
+    def test_warmup_walks_starting_warming_ready(self, model):
+        eng = make_engine(model)
+        assert eng.lifecycle.state == ReplicaState.STARTING
+        assert eng.health()["ready"] is False
+        eng.warmup()
+        assert eng.lifecycle.state == ReplicaState.READY
+        assert eng.health()["ready"] is True
+        # warmup traffic left no residue
+        assert not eng.outcomes and not eng._done
+        assert eng.bm.available == eng._total_usable
+        states = [s for _, s, _ in eng.lifecycle.history]
+        assert states == [ReplicaState.WARMING, ReplicaState.READY]
+
+    def test_warmup_with_pre_ready_traffic(self, model):
+        """Requests may queue from STARTING (they wait for exactly the
+        warmup compiles); warmup() serves them alongside its synthetic
+        request and their results surface on the next engine call."""
+        eng = make_engine(model)
+        early = eng.add_request(prompt(73), max_new_tokens=3)
+        eng.warmup()
+        assert eng.lifecycle.state == ReplicaState.READY
+        out = eng.run_to_completion()
+        assert len(out[early]) == 3
+        assert eng.outcomes[early].status == RequestStatus.FINISHED
+        assert_quiesced(eng, [early])
+
+    def test_warmup_ignores_default_deadlines(self, model):
+        """The synthetic warmup request must not inherit the config's
+        SLO deadlines — expiring it mid-compile would flip READY with
+        the decode program never built."""
+        eng = make_engine(model, max_batch=1,
+                          default_ttft_deadline_s=1e-9,
+                          default_deadline_s=1e-9)
+        eng.warmup()
+        assert eng.lifecycle.state == ReplicaState.READY
+        assert not eng.outcomes
+
+    def test_first_step_flips_starting_to_ready(self, model):
+        eng = make_engine(model)
+        eng.add_request(prompt(20), max_new_tokens=2)
+        eng.step()
+        assert eng.lifecycle.state == ReplicaState.READY
+
+    def test_drain_finishes_in_flight_cancels_queued_stops(self, model):
+        eng = make_engine(model, max_batch=1)
+        running = eng.add_request(prompt(21), max_new_tokens=6)
+        eng.step()
+        queued = [eng.add_request(prompt(22 + i), max_new_tokens=6)
+                  for i in range(2)]
+        out = eng.drain()
+        assert len(out[running]) == 6            # in-flight completed
+        for r in queued:
+            oc = eng.outcomes[r]
+            assert oc.status == RequestStatus.CANCELLED
+            assert "drained" in oc.detail
+        assert eng.lifecycle.state == ReplicaState.STOPPED
+        assert eng.health()["live"] is False
+        with pytest.raises(Overloaded, match="STOPPED"):
+            eng.add_request(prompt(30))
+        assert_quiesced(eng, [running, *queued])
+        assert eng.drain() == {}                 # idempotent
+
+    def test_drain_under_memory_pressure_terminates_everyone(self, model):
+        """Livelock preemption mid-drain bounces a request back through
+        the queue — drain must still carry it to a terminal status, not
+        strand it QUEUED in a STOPPED replica."""
+        eng = make_engine(model, max_batch=2, num_blocks=5,
+                          max_blocks_per_seq=4)
+        r1 = eng.add_request(prompt(60, 4), max_new_tokens=6)
+        r2 = eng.add_request(prompt(61, 4), max_new_tokens=6)
+        eng.step()                     # both decoding, pool nearly full
+        out = eng.drain(max_ticks=300)
+        for r in (r1, r2):
+            st = eng.outcomes[r].status
+            assert st in TERMINAL_STATUSES, (r, st)
+        # the preempted request finished its decode during the drain
+        assert sorted(out) == [r1, r2]
+        assert eng.lifecycle.state == ReplicaState.STOPPED
+        assert_quiesced(eng, [r1, r2])
+
+    def test_cancel_queued_and_running(self, model):
+        eng = make_engine(model, max_batch=1)
+        running = eng.add_request(prompt(31), max_new_tokens=20)
+        eng.step()
+        queued = eng.add_request(prompt(32), max_new_tokens=4)
+        assert eng.cancel(queued)
+        assert eng.outcomes[queued].status == RequestStatus.CANCELLED
+        assert eng.cancel(running)
+        assert eng.outcomes[running].status == RequestStatus.CANCELLED
+        assert eng.bm.available == eng._total_usable   # blocks reclaimed
+        assert not eng.cancel(999)
+        assert_quiesced(eng, [running, queued])
+
+    def test_invalid_transition_rejected(self, model):
+        eng = make_engine(model)
+        eng.drain()
+        with pytest.raises(RuntimeError, match="invalid replica"):
+            eng.lifecycle.to(ReplicaState.READY)
+
+
+class TestDeadlineAwareEviction:
+    def test_preemption_picks_most_slack_victim(self, model):
+        """Livelock preemption: the victim is the request with the most
+        deadline slack (no deadline beats any deadline) — NOT simply the
+        youngest rid."""
+        eng = make_engine(model, max_batch=2, num_blocks=5,
+                          max_blocks_per_seq=4)
+        # r1 (older) has NO deadline; r2 (younger) has a deadline. The
+        # old youngest-rid policy would evict r2 and risk its deadline;
+        # deadline-aware ordering must evict r1.
+        r1 = eng.add_request(prompt(33, 4), max_new_tokens=6)
+        r2 = eng.add_request(prompt(34, 4), max_new_tokens=6,
+                             deadline_s=3600.0)
+        evicted = None
+        for _ in range(50):
+            eng.step()
+            if eng.queue:                       # someone got preempted
+                evicted = eng.queue[0].rid
+                break
+        assert evicted == r1
+        out = eng.run_to_completion(max_ticks=300)
+        assert len(out[r1]) == 6 and len(out[r2]) == 6
+        assert_quiesced(eng, [r1, r2])
+
+
+class TestWatchdogWiring:
+    def test_heartbeat_quiet_then_stall_degrades(self, model):
+        """Satellite regression: normal serving ticks keep the watchdog
+        quiet; a stalled tick fires on_hang and flips DEGRADED."""
+        eng = make_engine(model)
+        # compile the steady-state programs FIRST: a cold first tick is
+        # seconds of XLA compile, which a 0.15s watchdog rightly calls a
+        # stall (production replicas warm before taking traffic)
+        eng.warmup(prompt_len=5, max_new_tokens=6)
+        hangs = []
+        wd = Watchdog(timeout=0.15, poll_interval=0.03,
+                      on_hang=lambda w: hangs.append(w.timeout)).start()
+        try:
+            eng.attach_watchdog(wd)
+            rid = eng.add_request(prompt(35), max_new_tokens=6)
+            out = eng.run_to_completion()
+            assert len(out[rid]) == 6
+            time.sleep(0.25)           # idle engine: no work in flight
+            assert wd.hang_count == 0 and not hangs
+            assert eng.lifecycle.state == ReplicaState.READY
+
+            r2 = eng.add_request(prompt(36), max_new_tokens=2)
+            with inject.armed("serving.tick_stall", seconds=0.5):
+                out = eng.step()       # stalls inside the tick, no raise
+            assert wd.hang_count >= 1
+            assert hangs               # user callback still chained
+            assert eng.lifecycle.state == ReplicaState.DEGRADED
+            # the stalled request was not lost — it completes
+            out.update(eng.run_to_completion())
+            assert len(out[r2]) == 2
+            assert_quiesced(eng, [r2])
+        finally:
+            wd.stop()
+
+    def test_end_work_underflow_guard(self):
+        wd = Watchdog(timeout=60.0)
+        wd.end_work()                  # unbalanced: must not underflow
+        assert wd._in_flight == 0
+        assert wd.unbalanced_end_count == 1
+        wd.begin_work()
+        assert wd._in_flight == 1
+        wd.end_work()
+        assert wd._in_flight == 0
+        assert wd.unbalanced_end_count == 1
+
+
+class TestMetricsAndLoadgen:
+    def test_serving_metrics_recorded(self, model):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        REGISTRY.reset()
+        eng = make_engine(model, max_batch=1, max_queue=16,
+                          queue_high_water=1)
+        rids = [eng.add_request(prompt(40 + i), max_new_tokens=3)
+                for i in range(4)]
+        eng.run_to_completion()
+        assert REGISTRY.get("paddle_tpu_serving_admitted").total() >= 1
+        assert REGISTRY.get("paddle_tpu_serving_shed").total() >= 1
+        assert REGISTRY.get("paddle_tpu_serving_ttft_seconds"
+                            ).total_count() >= 1
+        assert REGISTRY.get("paddle_tpu_serving_itl_seconds"
+                            ).total_count() >= 1
+        assert REGISTRY.get("paddle_tpu_serving_tick_seconds"
+                            ).total_count() >= 1
+        assert REGISTRY.get("paddle_tpu_serving_kv_blocks_in_use"
+                            ).value() == 0.0
+        by_outcome = REGISTRY.get("paddle_tpu_serving_requests")
+        assert by_outcome.value(outcome="FINISHED") >= 1
+        assert by_outcome.value(outcome="SHED") >= 1
+        state = REGISTRY.get("paddle_tpu_serving_replica_state")
+        assert state.value() == ReplicaState.ORDER.index(
+            ReplicaState.READY)
+        assert_quiesced(eng, rids)
+
+    def test_loadgen_open_loop_report(self, model):
+        from tools.loadgen import poisson_arrivals, run_load
+        arr = poisson_arrivals(100.0, 20, seed=3)
+        assert len(arr) == 20 and np.all(np.diff(arr) > 0)
+        assert np.allclose(arr, poisson_arrivals(100.0, 20, seed=3))
+
+        eng = make_engine(model, max_batch=2, num_blocks=64,
+                          max_queue=64, queue_high_water=32)
+        eng.warmup()
+        report = run_load(eng, offered_rps=200.0, n_requests=10,
+                          prompt_len_range=(3, 8), max_new_tokens=4,
+                          seed=5)
+        assert report["submitted"] + report["overloaded"] == 10
+        assert report["finished"] >= 1
+        assert report["goodput_tokens_per_sec"] > 0
+        assert report["p50_ttft_s"] > 0 and report["p99_ttft_s"] > 0
+        assert report["p50_itl_s"] > 0
+        total = sum(report["outcomes"].values())
+        assert total == report["submitted"]
+        # run_load drained the outcomes; engine is clean
+        assert not eng.outcomes
+        assert eng.bm.available == eng._total_usable
+        eng.drain()
+        assert eng.lifecycle.state == ReplicaState.STOPPED
+
+    def test_loadgen_with_deadlines_accounts_every_request(self, model):
+        from tools.loadgen import run_load
+        eng = make_engine(model, max_batch=1, max_queue=4,
+                          queue_high_water=2)
+        report = run_load(eng, offered_rps=500.0, n_requests=12,
+                          prompt_len_range=(3, 6), max_new_tokens=6,
+                          ttft_deadline_s=0.05, deadline_s=0.2, seed=9)
+        # under 500 rps on one slot something must give — but every
+        # submitted request is accounted for in a terminal outcome
+        assert sum(report["outcomes"].values()) == report["submitted"]
+        assert (report["shed"] + report["deadline_missed"]
+                + report["overloaded"] + report["finished"]
+                + report["failed"]) >= 12 - report["submitted"] \
+            + report["submitted"]
+        assert eng.bm.available == eng._total_usable

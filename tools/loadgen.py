@@ -1,0 +1,197 @@
+"""Open-loop Poisson load harness for the paged serving engine.
+
+Open-loop means arrivals are driven by a Poisson process fixed up front —
+the generator does NOT wait for completions before submitting (a
+closed-loop harness hides overload by self-throttling; see the
+coordinated-omission literature). The engine is ticked between arrivals;
+every submitted request ends in a terminal status, and the report
+aggregates the SLO view of the run:
+
+* p50/p99 TTFT (submit → first token) and inter-token latency,
+* goodput (tokens/s from FINISHED requests) vs offered load,
+* shed / deadline-missed / failed / cancelled counts and submit-time
+  ``Overloaded`` backpressure rejections.
+
+Library: ``run_load(engine, offered_rps=..., n_requests=...)`` → dict.
+CLI (tiny CPU-sized Llama, sweeps offered load, one JSON line per point):
+
+    python tools/loadgen.py --rates 4,16,64 --requests 32
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+
+def _percentile(xs: List[float], q: float) -> Optional[float]:
+    if not xs:
+        return None
+    return float(np.percentile(np.asarray(xs, np.float64), q))
+
+
+def poisson_arrivals(offered_rps: float, n: int, seed: int = 0):
+    """Cumulative arrival times (seconds from start) of a Poisson process
+    with rate ``offered_rps`` — exponential inter-arrivals, seeded."""
+    if offered_rps <= 0:
+        raise ValueError("offered_rps must be > 0")
+    rng = np.random.RandomState(seed)
+    return np.cumsum(rng.exponential(1.0 / offered_rps, size=n))
+
+
+def run_load(engine, *, offered_rps: float, n_requests: int,
+             vocab_size: int = 97,
+             prompt_len_range=(4, 24), max_new_tokens: int = 8,
+             ttft_deadline_s: Optional[float] = None,
+             deadline_s: Optional[float] = None,
+             seed: int = 0,
+             make_prompt: Optional[Callable[[np.random.RandomState, int],
+                                            List[int]]] = None,
+             clock: Callable[[], float] = time.monotonic,
+             max_wall_s: float = 300.0) -> dict:
+    """Drive ``engine`` with an open-loop Poisson arrival stream and
+    return the latency/goodput/outcome report (JSON-able dict).
+
+    The engine is ticked whenever it has work; between arrivals with an
+    idle engine the harness sleeps in small slices so arrival timing
+    stays honest. ``max_wall_s`` is a harness-level backstop (an engine
+    bug must fail the drill, not hang it)."""
+    from paddle_tpu.inference import Overloaded
+
+    rng = np.random.RandomState(seed)
+    arrivals = poisson_arrivals(offered_rps, n_requests, seed=seed)
+    lo, hi = prompt_len_range
+    if make_prompt is None:
+        def make_prompt(r, i):
+            return [int(t) for t in
+                    r.randint(1, vocab_size, size=int(r.randint(lo, hi + 1)))]
+    prompts = [make_prompt(rng, i) for i in range(n_requests)]
+
+    start = clock()
+    real_start = time.monotonic()
+    rids: List[int] = []
+    overloaded = 0
+    i = 0
+    while i < n_requests or engine.has_work():
+        now = clock() - start
+        # the backstop runs on REAL time: an injected non-advancing
+        # clock must still fail the drill rather than hang it
+        if time.monotonic() - real_start > max_wall_s:
+            raise RuntimeError(
+                f"loadgen exceeded max_wall_s={max_wall_s} with "
+                f"{n_requests - i} arrivals pending")
+        while i < n_requests and arrivals[i] <= now:
+            try:
+                rids.append(engine.add_request(
+                    prompts[i], max_new_tokens=max_new_tokens,
+                    ttft_deadline_s=ttft_deadline_s,
+                    deadline_s=deadline_s))
+            except Overloaded:
+                overloaded += 1
+            i += 1
+        if engine.has_work():
+            engine.step()
+        elif i < n_requests:
+            time.sleep(min(max(arrivals[i] - now, 0.0), 0.005))
+    wall = clock() - start
+
+    outcomes = engine.drain_outcomes()
+    missing = [r for r in rids if r not in outcomes]
+    if missing:
+        raise RuntimeError(
+            f"loadgen invariant violated: {len(missing)} submitted "
+            f"request(s) have no terminal outcome: {missing[:5]}")
+
+    by_status: Dict[str, int] = {}
+    ttfts: List[float] = []
+    itls: List[float] = []
+    good_tokens = 0
+    for rid in rids:
+        oc = outcomes[rid]
+        by_status[oc.status] = by_status.get(oc.status, 0) + 1
+        if oc.ttft is not None:
+            ttfts.append(oc.ttft)
+        itls.extend(oc.itls)
+        if oc.status == "FINISHED":
+            good_tokens += len(oc.tokens)
+
+    finished = by_status.get("FINISHED", 0)
+    return {
+        "offered_rps": float(offered_rps),
+        "achieved_arrival_rps": round(n_requests / max(wall, 1e-9), 3),
+        "n_requests": int(n_requests),
+        "submitted": len(rids),
+        "overloaded": int(overloaded),
+        "outcomes": by_status,
+        "shed": by_status.get("SHED", 0),
+        "deadline_missed": by_status.get("DEADLINE_MISSED", 0),
+        "failed": by_status.get("FAILED", 0),
+        "cancelled": by_status.get("CANCELLED", 0),
+        "finished": finished,
+        "goodput_tokens_per_sec": round(good_tokens / max(wall, 1e-9), 2),
+        "goodput_requests_per_sec": round(finished / max(wall, 1e-9), 3),
+        "p50_ttft_s": _percentile(ttfts, 50),
+        "p99_ttft_s": _percentile(ttfts, 99),
+        "p50_itl_s": _percentile(itls, 50),
+        "p99_itl_s": _percentile(itls, 99),
+        "wall_s": round(wall, 3),
+    }
+
+
+def _tiny_engine(max_batch=4, max_queue=32, high_water=None, seed=7):
+    """CPU-sized Llama replica for CLI runs and drills (per-request
+    deadlines are passed through run_load, not the engine defaults)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import PagedEngine, ResilienceConfig
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(seed)
+    cfg = LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, max_seq_len=256,
+                      use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    rcfg = ResilienceConfig(max_queue=max_queue,
+                            queue_high_water=high_water)
+    return PagedEngine(model, max_batch=max_batch, block_size=8,
+                       num_blocks=128, max_blocks_per_seq=16,
+                       resilience=rcfg)
+
+
+def main(argv=None):
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--rates", default="4,16,64",
+                    help="comma-separated offered loads (requests/s)")
+    ap.add_argument("--requests", type=int, default=32)
+    ap.add_argument("--max-new-tokens", type=int, default=8)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--max-queue", type=int, default=32)
+    ap.add_argument("--high-water", type=int, default=None)
+    ap.add_argument("--ttft-deadline-s", type=float, default=None)
+    ap.add_argument("--deadline-s", type=float, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    for rate in [float(r) for r in args.rates.split(",") if r]:
+        eng = _tiny_engine(max_batch=args.max_batch,
+                           max_queue=args.max_queue,
+                           high_water=args.high_water)
+        eng.warmup()
+        report = run_load(
+            eng, offered_rps=rate, n_requests=args.requests,
+            max_new_tokens=args.max_new_tokens,
+            ttft_deadline_s=args.ttft_deadline_s,
+            deadline_s=args.deadline_s, seed=args.seed)
+        eng.drain()
+        print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    main()
