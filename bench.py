@@ -32,29 +32,10 @@ import jax.numpy as jnp
 import numpy as np
 
 
-# Peak dense bf16 FLOPs/s per chip (public spec sheets).
-PEAK_FLOPS = {
-    "TPU v2": 45e12,
-    "TPU v3": 123e12,
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,
-    "TPU v6e": 918e12,
-    "TPU7x": 2307e12,
-}
-
-
 def chip_peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "")
-    for name, peak in PEAK_FLOPS.items():
-        if kind.lower().startswith(name.lower()):
-            return peak
-    if device.platform == "tpu":
-        return 275e12
-    return 1e12  # CPU fallback so the math stays finite
+    # canonical spec table lives with the roofline layer
+    from paddle_tpu.observability.perf import chip_peak_flops as _cpf
+    return _cpf(device)
 
 
 def _run_train_bench(model, params, make_inputs, loss_of, iters,
@@ -152,7 +133,36 @@ def _run_train_bench(model, params, make_inputs, loss_of, iters,
     loss_end = float(loss)  # chained state: forces every iter to execute
     dt = (time.perf_counter() - t0) / iters
     n_params = sum(int(np.prod(m.shape)) for m in master)
-    return dt, loss0, loss_end, n_params
+
+    # attribution pass: two SYNCED steps under the span tracer (the timed
+    # loop above stays async — per-step sync would change what it
+    # measures). step_t keeps advancing, so the axon tunnel cannot serve
+    # these as replays of the timed iterations.
+    attribution = None
+    try:
+        from paddle_tpu.observability import perf as _perf
+
+        state = {"s": (live, master, m_state, v_state), "i": 0}
+
+        def attr_step():
+            i, (lv, ms, m_s, v_s) = state["i"], state["s"]
+            state["i"] += 1
+            loss, *new = jitted(lv, ms, m_s, v_s,
+                                jnp.asarray(2 + iters + i, jnp.int32),
+                                *batches[1 + (i % iters)])
+            state["s"] = tuple(new)
+            return loss
+
+        att = _perf.step_attribution(attr_step, iters=2, warmup=0,
+                                     name="train_step")["total"]
+        attribution = {k: round(att[k], 4) for k in
+                       ("compute_frac", "collective_frac", "host_frac",
+                        "idle_frac")}
+        attribution["synced_step_s"] = round(att["step_s"]
+                                             / max(att["n_steps"], 1), 4)
+    except Exception:
+        pass
+    return dt, loss0, loss_end, n_params, attribution
 
 
 def _env_int(name, default):
@@ -196,7 +206,7 @@ def _bench_gpt(small):
         _, loss = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
         return loss
 
-    dt, loss0, loss_end, n_params = _run_train_bench(
+    dt, loss0, loss_end, n_params, attribution = _run_train_bench(
         model, params, make_inputs, loss_of, iters)
     tokens_per_sec = batch * seq / dt
     flops_per_token = 6 * n_params + \
@@ -213,6 +223,7 @@ def _bench_gpt(small):
                   "params": n_params,
                   "device": str(getattr(jax.devices()[0], "device_kind",
                                         jax.default_backend())),
+                  "attribution": attribution,
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -239,7 +250,7 @@ def _bench_resnet50(small):
         logits = model(paddle.Tensor(x))
         return F.cross_entropy(logits, paddle.Tensor(y))
 
-    dt, loss0, loss_end, n_params = _run_train_bench(
+    dt, loss0, loss_end, n_params, attribution = _run_train_bench(
         model, params, make_inputs, loss_of, iters, bf16_weights=False)
     imgs_per_sec = batch / dt
     # chip-relative utilization bar, consistent with the token rungs'
@@ -258,6 +269,7 @@ def _bench_resnet50(small):
         "extra": {"step_time_s": round(dt, 4), "params": n_params,
                   "batch": batch, "mfu": round(util, 4),
                   "a100_ref_util": round(a100_util, 4),
+                  "attribution": attribution,
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -301,7 +313,7 @@ def _bench_bert(small):
                            masked_lm_labels=paddle.Tensor(ids))
         return loss
 
-    dt, loss0, loss_end, n_params = _run_train_bench(
+    dt, loss0, loss_end, n_params, attribution = _run_train_bench(
         model, params, make_inputs, loss_of, iters)
     tokens_per_sec = batch * seq / dt
     flops_per_token = 6 * n_params + \
@@ -314,7 +326,8 @@ def _bench_bert(small):
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
-                  "params": n_params, "loss_first": round(loss0, 3),
+                  "params": n_params, "attribution": attribution,
+                  "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
 
@@ -349,7 +362,7 @@ def _bench_llama(small):
         _, loss = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
         return loss
 
-    dt, loss0, loss_end, n_params = _run_train_bench(
+    dt, loss0, loss_end, n_params, attribution = _run_train_bench(
         model, params, make_inputs, loss_of, iters)
     tokens_per_sec = batch * seq / dt
     flops_per_token = 6 * n_params + \
@@ -362,7 +375,8 @@ def _bench_llama(small):
         "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
-                  "params": n_params, "loss_first": round(loss0, 3),
+                  "params": n_params, "attribution": attribution,
+                  "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
 
@@ -405,7 +419,7 @@ def _bench_llama14(small):
         _, loss = model(paddle.Tensor(ids), labels=paddle.Tensor(ids))
         return loss
 
-    dt, loss0, loss_end, n_params = _run_train_bench(
+    dt, loss0, loss_end, n_params, attribution = _run_train_bench(
         model, params, make_inputs, loss_of, iters,
         moment_dtype=moment_dtype)
     tokens_per_sec = batch * seq / dt
@@ -420,6 +434,7 @@ def _bench_llama14(small):
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
                   "params": n_params, "moment_dtype": moment_dtype,
+                  "attribution": attribution,
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -912,7 +927,9 @@ def main():
         "errors": errors,
         "extra": {**{name: {"value": r["value"], "unit": r["unit"],
                             "vs_baseline": r["vs_baseline"],
-                            "mfu": r.get("extra", {}).get("mfu")}
+                            "mfu": r.get("extra", {}).get("mfu"),
+                            "attribution": r.get("extra", {}).get(
+                                "attribution")}
                      for name, r in rungs.items()},
                   "compile_cache": {
                       "value": cc["value"], "unit": cc["unit"],

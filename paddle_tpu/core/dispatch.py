@@ -222,7 +222,8 @@ def _amp_cast_inputs(op_name: str, arrays: List):
 _hot_flags = {"check_nan_inf": flags.get_flag("check_nan_inf"),
               "benchmark": flags.get_flag("benchmark"),
               "eager_jit_cache": flags.get_flag("eager_jit_cache"),
-              "enable_metrics": flags.get_flag("enable_metrics")}
+              "enable_metrics": flags.get_flag("enable_metrics"),
+              "perf_op_cost": flags.get_flag("perf_op_cost")}
 flags.on_change("check_nan_inf",
                 lambda v: _hot_flags.__setitem__("check_nan_inf", v))
 flags.on_change("benchmark",
@@ -231,6 +232,8 @@ flags.on_change("eager_jit_cache",
                 lambda v: _hot_flags.__setitem__("eager_jit_cache", v))
 flags.on_change("enable_metrics",
                 lambda v: _hot_flags.__setitem__("enable_metrics", v))
+flags.on_change("perf_op_cost",
+                lambda v: _hot_flags.__setitem__("perf_op_cost", v))
 
 # Dispatch telemetry instruments (collection is gated per event by
 # FLAGS_enable_metrics; declaring them here is one-time import cost).
@@ -248,6 +251,37 @@ _m_eager_jit = _metrics.counter(
 _m_hook_overhead = _metrics.histogram(
     "paddle_tpu_dispatch_hook_seconds",
     "Host time spent inside op/recorder/export hooks per dispatch.")
+_m_op_flops = _metrics.counter(
+    "paddle_tpu_perf_op_flops_total",
+    "Modeled FLOPs dispatched per op (analytical cost model; "
+    "FLAGS_perf_op_cost).", labelnames=("op",))
+_m_op_bytes = _metrics.counter(
+    "paddle_tpu_perf_op_bytes_total",
+    "Modeled minimal HBM bytes moved per op (analytical cost model; "
+    "FLAGS_perf_op_cost).", labelnames=("op",))
+
+_costmodel = None  # bound on first perf_op_cost dispatch (lazy: the perf
+# package imports the op registry, which must finish loading first)
+
+
+def _accumulate_op_cost(op_name, arrays, attrs, out_list):
+    """Fold the modeled per-op FLOPs/bytes into the perf counters —
+    FLAGS_perf_op_cost sites only (one cost_fn call per dispatch)."""
+    global _costmodel
+    try:
+        if _costmodel is None:
+            from ..observability.perf import costmodel as _cm
+            _costmodel = _cm
+        c = _costmodel.cost_of(
+            op_name,
+            [tuple(getattr(a, "shape", ())) for a in arrays],
+            [getattr(a, "dtype", None) for a in arrays], attrs,
+            [tuple(getattr(o, "shape", ())) for o in out_list])
+        if c is not None:
+            _m_op_flops.inc(c.flops, op=op_name)
+            _m_op_bytes.inc(c.bytes, op=op_name)
+    except Exception:
+        pass
 
 _op_hooks: List[Callable] = []  # profiler / debugging taps
 _recorder_tls = threading.local()  # program capture is per-thread: a
@@ -650,6 +684,8 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
             dur = _perf_counter() - t0
             if _hot_flags["enable_metrics"]:
                 _m_op_latency.observe(dur, op=op_name)
+                if _hot_flags["perf_op_cost"]:
+                    _accumulate_op_cost(op_name, arrays, attrs, out_list)
             if _trace._active["on"]:
                 _trace.add_complete(op_name, "dispatch", t0, t0 + dur)
         rec_hooks = _recorder_hooks()

@@ -135,3 +135,13 @@ define_flag("compile_cache_size_mb", 512,
 define_flag("compile_cache_manifest", "",
             "Shape-signature manifest (JSONL) recording path for AOT "
             "warmup; empty = off.")
+# Performance attribution (paddle_tpu/observability/perf/) — registered
+# here so the dispatch hot-path mirror can read them at import time.
+define_flag("perf_capture", False,
+            "Capture XLA cost_analysis()/memory_analysis() of compiled "
+            "programs (to_static signatures, SOT segments) into the perf "
+            "registry for roofline reporting.")
+define_flag("perf_op_cost", False,
+            "Accumulate the analytical cost model's per-op FLOPs/bytes "
+            "into paddle_tpu_perf_op_* metrics at eager dispatch "
+            "(requires FLAGS_enable_metrics).")

@@ -401,6 +401,11 @@ class PagedEngine:
         # finished results produced while warmup() owned the step loop —
         # re-delivered by the next step()/run_to_completion
         self._spillover: Dict[int, List[int]] = {}
+        # HBM attribution: KV pages report under the "kv_cache" tag (the
+        # getter re-reads kc/vc, which donation replaces every tick)
+        from ..observability.perf import memory as _perf_memory
+        _perf_memory.register_object("kv_cache", self,
+                                     lambda e: (e.kc, e.vc))
 
     # ---------------------------------------------------------------- API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -469,7 +474,9 @@ class PagedEngine:
 
     # ----------------------------------------------------------- compute
     def _run_chunk(self, tokens_np, seq_lens_np, tables_np,
-                   temps_np, top_ps_np):
+                   temps_np, top_ps_np, phase: str = "decode"):
+        from ..observability import trace as _otrace
+
         self._key, sub = jax.random.split(self._key)
         # serving always runs eval-mode (dropout off); restore the
         # caller's training flag afterwards — the engine must not mutate
@@ -477,6 +484,7 @@ class PagedEngine:
         was_training = getattr(self.model, "training", False)
         if was_training:
             self.model.eval()
+        t0 = time.perf_counter() if _otrace._active["on"] else 0.0
         try:
             nxt, self.kc, self.vc = self._fn(
                 [p._data for p in self._params], self.kc, self.vc,
@@ -484,10 +492,19 @@ class PagedEngine:
                 jnp.asarray(tables_np),
                 jnp.asarray(temps_np, jnp.float32),
                 jnp.asarray(top_ps_np, jnp.float32), sub)
+            # np.asarray blocks until the program finishes, so this span
+            # covers the chunk's actual device execution — the per-tick
+            # prefill-vs-decode attribution loadgen/bench report
+            out = np.asarray(nxt)  # tpulint: disable=TPU104 — host boundary by design: sampled token ids feed python-side scheduling
         finally:
             if was_training:
                 self.model.train()
-        return np.asarray(nxt)
+        if t0:
+            _otrace.add_complete(f"serving.{phase}", "device", t0,
+                                 time.perf_counter(),
+                                 {"phase": phase,
+                                  "batch": int(len(seq_lens_np))})
+        return out
 
     # -------------------------------------------------------- scheduling
     def _blocks_needed(self, length: int) -> int:
@@ -581,7 +598,8 @@ class PagedEngine:
                 temps[slot] = req.temperature
                 top_ps[slot] = req.top_p
                 involved.append(slot)
-            nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps)
+            nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps,
+                                  phase="prefill")
             for slot in involved:
                 if j == chunks_of[slot] - 1:
                     nxt_of[slot] = int(nxt[slot])
@@ -816,7 +834,8 @@ class PagedEngine:
         for i in active:
             temps[i] = self.slots[i].temperature
             top_ps[i] = self.slots[i].top_p
-        nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps)
+        nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps,
+                              phase="decode")
         now = self._clock()
         for i in active:
             if seq[i] == 0:

@@ -195,9 +195,10 @@ class StaticFunction:
         leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
         arrays = [l._data if isinstance(l, Tensor) else jnp.asarray(l)
-                  for l in leaves if _is_traced_leaf(l)]
+                  for l in leaves if _is_traced_leaf(l)]  # tpulint: disable=TPU105 — filters on leaf TYPE (isinstance), never a tensor value
         statics = tuple((i, l) for i, l in enumerate(leaves)
-                        if not _is_traced_leaf(l))
+                        if not _is_traced_leaf(l))  # tpulint: disable=TPU105 — same type-level partition
+
 
         # The live param binding: jit_target reads this at trace time, so a
         # call with a different layer (new static leaf -> retrace) rebinds
@@ -206,7 +207,7 @@ class StaticFunction:
         self._build_jitted(fn)
         sig = (treedef, statics,
                tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
-        if sig in self._graph_breaks:
+        if sig in self._graph_breaks:  # tpulint: disable=TPU105 — sig holds treedef/statics/SHAPES (dispatch key), no tensor values
             return self._run_sot(sig, fn, args, kwargs)
         is_new_sig = sig not in self._seen_sigs
         runner = self._aot_sigs.get(sig)
@@ -219,7 +220,7 @@ class StaticFunction:
             self._seen_sigs.add(sig)   # known signature, nothing compiled
             out, mutated = runner([p._data for p in params], arrays)
             for i, arr in mutated.items():
-                params[int(i)]._swap_payload(arr)
+                params[int(i)]._swap_payload(arr)  # tpulint: disable=TPU103 — i is the mutated-dict's STRING key (param index), not tensor data
             return _wrap(out)
         if is_new_sig:  # tpulint: disable=TPU105 — same shape-only branch
             self._record_new_sig(sig)
@@ -271,7 +272,7 @@ class StaticFunction:
                 stacklevel=2)
             return self._run_sot(sig, fn, args, kwargs)
         for i, arr in mutated.items():
-            params[int(i)]._swap_payload(arr)
+            params[int(i)]._swap_payload(arr)  # tpulint: disable=TPU103 — same string-key int() as the runner path
         return _wrap(out)
 
     def _build_jitted(self, fn):
@@ -362,10 +363,34 @@ class StaticFunction:
         except Exception:
             pass
 
+    def _aot_compile(self, sig, param_arrays, arrays, treedef, statics):
+        """Shared AOT path: lower+compile one signature, capture its
+        cost/memory analysis when FLAGS_perf_capture is on, install the
+        per-signature runner. Returns (runner, compiled, seconds)."""
+        from ..observability import perf as _perf
+
+        c0 = time.perf_counter()
+        compiled = self._jitted.lower(param_arrays, arrays, treedef,
+                                      statics).compile()
+        compile_seconds = time.perf_counter() - c0
+        if _perf.capture_enabled():
+            _perf.record_compiled(
+                "to_static", getattr(self, "__name__", "<fn>"), compiled)
+
+        def runner(pa, ar, _c=compiled):
+            return _c(pa, ar)
+
+        self._aot_sigs[sig] = runner
+        return runner, compiled, compile_seconds
+
     def _dispatch_new_sig(self, sig, params, arrays, treedef, statics):
         """First dispatch of a signature. With the persistent cache off,
         the plain jit path; with it on, AOT lower+compile so the
-        executable can be serialized and published for other processes."""
+        executable can be serialized and published for other processes.
+        With FLAGS_perf_capture on, the AOT route is taken either way so
+        the compiled program's cost/memory analysis can be captured."""
+        from ..observability import perf as _perf
+
         param_arrays = [p._data for p in params]
         try:
             from .. import compile as pcc
@@ -373,6 +398,10 @@ class StaticFunction:
         except Exception:
             use_pcc = False
         if not use_pcc:
+            if _perf.capture_enabled():
+                runner, _c, _s = self._aot_compile(
+                    sig, param_arrays, arrays, treedef, statics)
+                return runner(param_arrays, arrays)
             return self._jitted(param_arrays, arrays, treedef, statics)
         runner = self._pcc_store(sig, params, arrays, treedef, statics)
         return runner(param_arrays, arrays)
@@ -383,15 +412,8 @@ class StaticFunction:
         compiles and publishes without executing anything."""
         from .. import compile as pcc
         param_arrays = [p._data for p in params]
-        c0 = time.perf_counter()
-        compiled = self._jitted.lower(param_arrays, arrays, treedef,
-                                      statics).compile()
-        compile_seconds = time.perf_counter() - c0
-
-        def runner(pa, ar, _c=compiled):
-            return _c(pa, ar)
-
-        self._aot_sigs[sig] = runner
+        runner, compiled, compile_seconds = self._aot_compile(
+            sig, param_arrays, arrays, treedef, statics)
         try:
             ser = pcc.aot.serialize_compiled(compiled)
             if ser is None:
@@ -441,7 +463,7 @@ class StaticFunction:
             (tuple(avals), {}))
         sig = (leaves_tree, (),
                tuple((tuple(a.shape), str(a.dtype)) for a in avals))
-        if sig in self._aot_sigs:
+        if sig in self._aot_sigs:  # tpulint: disable=TPU105 — precompile sig is (treedef, shapes) over ABSTRACT avals
             return
         if self._pcc_load(sig, params) is not None:
             self._seen_sigs.add(sig)
@@ -519,7 +541,7 @@ class StaticFunction:
                 and state["guard"] == guard):
             ok, packed, why = sot_mod.replay_frame(
                 journal, cache, input_arrays, params)
-            if ok:
+            if ok:  # tpulint: disable=TPU105 — ok is replay_frame's python bool (guard-hit status), not a tensor
                 treedef, out_leaves = packed
                 rebuilt = [
                     _T(arr, stop_gradient=wrap[1]) if wrap is not None
@@ -535,7 +557,7 @@ class StaticFunction:
             state["journal"] = None
 
         new_journal = sot_mod.FrameJournal()
-        if not trackable:
+        if not trackable:  # tpulint: disable=TPU105 — trackable comes from isinstance checks over leaf types
             new_journal.mark_ineligible("non-Tensor array input")
         cap = sot_mod.capture(cache, journal=new_journal,
                               input_arrays=input_arrays, params=params)

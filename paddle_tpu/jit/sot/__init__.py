@@ -350,7 +350,10 @@ class Segment:
                 self.owner.cache[key] = jitted
                 results = jitted(self.ext_arrays)
             else:
-                jitted, publish = _pcc_compile(seg_fn, self.ext_arrays)
+                jitted, publish = _pcc_compile(
+                    seg_fn, self.ext_arrays,
+                    label=f"site{self.owner.site_idx}"
+                          f"_ops{len(self.nodes)}")
                 if len(self.owner.cache) >= SEGMENT_CACHE_MAX:
                     self.owner.cache.pop(next(iter(self.owner.cache)))
                 self.owner.cache[key] = jitted
@@ -623,18 +626,21 @@ def _pcc_lookup(key):
         return None
 
 
-def _pcc_compile(seg_fn, ext_arrays):
+def _pcc_compile(seg_fn, ext_arrays, label: str = "segment"):
     """Build the segment's compiled program. With the persistent cache
     off: plain ``jax.jit`` (zero behavior change). With it on: AOT
     lower+compile so the executable handle can be serialized; returns
     ``(runner, publish)`` where ``publish(key, seconds)`` writes the
     entry once the caller has timed the compile."""
+    from ...observability import perf as _perf
+
     try:
         from ... import compile as pcc
         use_pcc = pcc.enabled()
     except Exception:
         use_pcc = False
-    if not use_pcc:
+    perf_capture = _perf.capture_enabled()
+    if not use_pcc and not perf_capture:
         return jax.jit(seg_fn), None
     try:
         # normalize ext leaves exactly as the runners do at call time, so
@@ -643,9 +649,15 @@ def _pcc_compile(seg_fn, ext_arrays):
         compiled = jax.jit(seg_fn).lower(conv).compile()
     except Exception:
         return jax.jit(seg_fn), None
+    if perf_capture:
+        _perf.record_compiled("sot", label, compiled)
 
     def runner(ext, _c=compiled):
         return _c([jnp.asarray(e) for e in ext])
+
+    if not use_pcc:
+        # perf-capture-only AOT: nothing to publish without the cache
+        return runner, None
 
     def publish(key, seconds, _c=compiled):
         try:

@@ -51,6 +51,10 @@ class Parameter(Tensor):
         self.regularizer = regularizer
         self.need_clip = need_clip
         self.is_distributed = False
+        # HBM attribution: the perf memory census reports this buffer
+        # (and its .grad) under the "params"/"grads" tags
+        from ..observability.perf import memory as _perf_memory
+        _perf_memory.track_parameter(self)
 
     def __repr__(self):
         return "Parameter containing:\n" + super().__repr__()

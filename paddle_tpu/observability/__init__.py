@@ -51,10 +51,42 @@ metrics.gauge(
     "snapshot time.").set_function(device_live_bytes)
 
 
+# The pid that first imported this module owns the bare dump path; it is
+# published through the ENVIRONMENT so both fork- and spawn-started
+# children (which re-import the module and would otherwise see their own
+# pid as the installer) recognize they are not the primary process.
+_PRIMARY_PID_ENV = "PADDLE_TPU_METRICS_PRIMARY_PID"
+os.environ.setdefault(_PRIMARY_PID_ENV, str(os.getpid()))
+
+
+def _dump_path(path: str) -> str:
+    """Process-unique dump path: multi-process runs (distributed workers,
+    fork/spawn dataloader workers) each get their own file instead of
+    last-writer-wins on one. The primary process keeps ``path`` verbatim
+    (back-compat with the README workflow); an explicit
+    ``PADDLE_TPU_METRICS_SUFFIX`` always wins."""
+    suffix = os.environ.get("PADDLE_TPU_METRICS_SUFFIX")
+    if suffix is not None:
+        return f"{path}.{suffix}"
+    parts = []
+    for var in ("PADDLE_TRAINER_ID", "RANK"):
+        v = os.environ.get(var)
+        if v is not None and v.strip().isdigit() and int(v) > 0:
+            parts.append(f"rank{int(v)}")
+            break
+    if os.environ.get(_PRIMARY_PID_ENV) != str(os.getpid()):
+        # non-primary process (fork/spawn worker): pid disambiguates
+        # even under an inherited rank env — rank N's dataloader workers
+        # must not clobber rank N's own file
+        parts.append(f"pid{os.getpid()}")
+    return ".".join([path] + parts)
+
+
 def _install_exit_dump():
     """PADDLE_TPU_METRICS_DUMP=/path: write the JSON snapshot at process
     exit so `python -m paddle_tpu.observability --input /path` can render
-    it offline."""
+    it offline. The path gains a process-unique suffix (.rankN / .pidN)
+    in non-primary processes — see _dump_path."""
     path = os.environ.get("PADDLE_TPU_METRICS_DUMP")
     if not path:
         return
@@ -64,7 +96,13 @@ def _install_exit_dump():
 
     def _dump():
         try:
-            with open(path, "w") as f:
+            # attributed HBM census rides into the snapshot's gauges
+            from .perf import memory as _perf_memory
+            _perf_memory.refresh_metrics()
+        except Exception:
+            pass
+        try:
+            with open(_dump_path(path), "w") as f:
                 json.dump(REGISTRY.snapshot(), f, indent=1, sort_keys=True)
         except OSError:
             pass

@@ -36,6 +36,12 @@ def main(argv=None) -> int:
             return 1
     else:
         from . import REGISTRY
+        try:
+            # attributed HBM gauges are census-time: refresh before dump
+            from .perf import memory as _perf_memory
+            _perf_memory.refresh_metrics()
+        except Exception:
+            pass
         snap = REGISTRY.snapshot()
 
     if args.format == "json":

@@ -1,0 +1,96 @@
+"""paddle_tpu.observability.perf — device-time performance attribution.
+
+The layer that turns round-8 host telemetry into actionable performance
+truth (reference analogue: the profiler subsystem's device-event +
+memory-profiling half; XLA lineage: ``Compiled.cost_analysis()`` /
+``memory_analysis()``):
+
+- :mod:`.costmodel` — analytical per-op-class FLOPs/bytes formulas
+  attached to the op registry (``OpDef.cost_fn``), cross-checkable
+  against XLA's own cost analysis.
+- :mod:`.device` — ``block_until_ready``-bracketed timed sections,
+  compiled-program cost/memory capture at to_static/SOT compile time
+  (``FLAGS_perf_capture``), and the step-time attribution pass that
+  decomposes each step into compute / collective / host / idle.
+- :mod:`.memory` — live-HBM census attributed as params / grads /
+  optimizer state / KV cache / activations via holder providers, with
+  per-phase high-water tracking (``paddle_tpu_hbm_*`` metrics).
+
+Reporting rides in ``tools/perf_report.py`` (roofline table + attribution
+breakdown) and ``tools/perf_gate.py`` (bench-vs-frozen-baseline CI gate);
+``bench.py`` records MFU + attribution columns on every ladder run. See
+PERF.md for the methodology.
+"""
+from __future__ import annotations
+
+from . import costmodel, device, memory
+from .costmodel import (OpCost, attach_cost_models, collective_cost,
+                        cost_of, xla_cost)
+from .device import (attribute, capture_enabled, compiled_programs,
+                     measure, record_compiled, step_attribution,
+                     timed_section)
+from .memory import census, high_water, update_high_water
+
+__all__ = ["costmodel", "device", "memory", "OpCost", "cost_of",
+           "attach_cost_models", "collective_cost", "xla_cost",
+           "attribute", "capture_enabled", "compiled_programs", "measure",
+           "record_compiled", "step_attribution", "timed_section",
+           "census", "high_water", "update_high_water", "PEAK_FLOPS",
+           "PEAK_HBM_BW", "chip_peak_flops", "chip_peak_bw"]
+
+#: peak dense bf16 FLOPs/s per chip (public spec sheets) — the roofline's
+#: compute ceiling; bench.py's MFU math delegates here
+PEAK_FLOPS = {
+    "TPU v2": 45e12,
+    "TPU v3": 123e12,
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU7x": 2307e12,
+}
+
+#: peak HBM bandwidth (bytes/s) per chip — public spec sheets; the
+#: roofline's second ceiling
+PEAK_HBM_BW = {
+    "TPU v2": 700e9,
+    "TPU v3": 900e9,
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,
+    "TPU v6e": 1640e9,
+    "TPU7x": 7400e9,
+}
+
+
+def _chip_lookup(table, device_obj, tpu_default, cpu_default) -> float:
+    try:
+        import jax
+
+        d = device_obj or jax.devices()[0]
+    except Exception:
+        return cpu_default
+    kind = getattr(d, "device_kind", "")
+    for name, v in table.items():
+        if kind.lower().startswith(name.lower()):
+            return v
+    return (tpu_default if getattr(d, "platform", "") == "tpu"
+            else cpu_default)
+
+
+def chip_peak_flops(device_obj=None) -> float:
+    """Peak dense bf16 FLOPs/s of the chip (CPU fallback 1 TF/s so the
+    MFU math stays finite on dev hosts)."""
+    return _chip_lookup(PEAK_FLOPS, device_obj, 275e12, 1e12)
+
+
+def chip_peak_bw(device_obj=None) -> float:
+    """Peak HBM bytes/s of the chip (CPU fallback ~100 GB/s DDR so the
+    roofline math stays finite on dev hosts)."""
+    return _chip_lookup(PEAK_HBM_BW, device_obj, 1228e9, 100e9)

@@ -1,0 +1,228 @@
+"""HBM memory profiler — live-buffer census with attribution tags.
+
+Extends the round-8 ``jax.live_arrays()`` gauge from one number into an
+attributed breakdown: params / grads / optimizer state / KV cache /
+activations (reference analogue: the memory profiling half of the paper's
+profiler layer). Attribution is *holder-based*: framework subsystems that
+own long-lived device buffers register a provider (a callable yielding
+their current arrays) at allocation time — ``nn.Parameter`` registers
+every live parameter, ``optimizer.Optimizer`` its accumulator dict, the
+serving engine its KV pages. A census walks providers first, then counts
+every live array nobody claimed as ``activations`` (transient forward /
+autograd values). Providers are weakly bound, so a dropped engine or
+optimizer unregisters itself by dying.
+
+High-water marks are tracked per *phase* (train_step / prefill / decode /
+…): ``update_high_water(phase)`` runs a census and keeps the per-phase
+max, exported as the ``paddle_tpu_hbm_*`` metric family.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Callable, Dict, Iterable, List, Optional
+
+from .. import metrics as _metrics
+
+__all__ = ["register_provider", "register_object", "census",
+           "update_high_water", "high_water", "reset_high_water",
+           "refresh_metrics", "TAGS"]
+
+#: the closed tag vocabulary (census() keys; "activations" is the
+#: unclaimed remainder, "other_tagged" guards against future tags)
+TAGS = ("params", "grads", "optimizer_state", "kv_cache", "activations")
+
+_lock = threading.Lock()
+#: provider id -> (tag, callable returning an iterable of arrays)
+_providers: Dict[int, tuple] = {}
+_next_id = [0]
+
+_high_water: Dict[str, float] = {}
+_high_water_by_tag: Dict[tuple, float] = {}
+
+M_HBM_LIVE = _metrics.gauge(
+    "paddle_tpu_hbm_live_bytes",
+    "Live device bytes by attribution tag (census-time).",
+    labelnames=("tag",))
+M_HBM_HIGH_WATER = _metrics.gauge(
+    "paddle_tpu_hbm_high_water_bytes",
+    "Max census total observed per phase (update_high_water sites).",
+    labelnames=("phase",))
+
+
+def register_provider(tag: str, fn: Callable[[], Iterable]) -> int:
+    """Register a census provider: ``fn()`` yields the arrays (or
+    Tensors) currently owned under ``tag``. Returns a handle for
+    ``unregister_provider``."""
+    with _lock:
+        pid = _next_id[0]
+        _next_id[0] += 1
+        _providers[pid] = (tag, fn)
+    return pid
+
+
+def unregister_provider(pid: int):
+    with _lock:
+        _providers.pop(pid, None)
+
+
+def register_object(tag: str, obj, getter: Callable) -> int:
+    """Weakly-bound provider: ``getter(obj)`` yields the arrays while
+    ``obj`` is alive; the provider dies (and auto-unregisters) with the
+    object — an engine or optimizer must not be pinned by its own
+    telemetry."""
+    ref = weakref.ref(obj)
+
+    def fn():
+        o = ref()
+        return getter(o) if o is not None else ()
+
+    pid = register_provider(tag, fn)
+    try:
+        weakref.finalize(obj, unregister_provider, pid)
+    except TypeError:
+        pass
+    return pid
+
+
+def _array_of(x):
+    """Unwrap Tensor/Parameter payloads to the device array."""
+    return getattr(x, "_data", x)
+
+
+def _nbytes(a) -> int:
+    return int(getattr(a, "nbytes", 0) or 0)
+
+
+def _iter_leaves(xs):
+    """Flatten provider output: arrays, Tensors, and nested
+    tuples/lists/dicts of them (optimizer accumulators hold encoded
+    moment pytrees)."""
+    import types
+
+    stack = [xs]
+    while stack:
+        x = stack.pop()
+        if x is None:
+            continue
+        if isinstance(x, dict):
+            stack.extend(x.values())
+        elif isinstance(x, (list, tuple, set, frozenset,
+                            types.GeneratorType)):
+            stack.extend(x)
+        else:
+            yield _array_of(x)
+
+
+def census(include_unclaimed: bool = True,
+           refresh_metrics: bool = False) -> Dict[str, float]:
+    """Bytes of live device buffers by attribution tag. Unique by buffer
+    identity — a parameter aliased by two providers counts once, for the
+    first tag that claims it (provider registration order). With
+    ``include_unclaimed`` the live-array walk adds everything no provider
+    claimed as ``activations``."""
+    with _lock:
+        providers = list(_providers.values())
+    out: Dict[str, float] = {t: 0.0 for t in TAGS}
+    claimed: Dict[int, str] = {}
+    for tag, fn in providers:
+        try:
+            leaves = list(_iter_leaves(fn()))
+        except Exception:
+            continue
+        for a in leaves:
+            aid = id(a)
+            # census reads buffer METADATA only (identity + nbytes) —
+            # a host-side observability walk by design; tensor values
+            # are never materialized
+            if aid in claimed or not _nbytes(a):  # tpulint: disable=TPU105 — branches on id()/nbytes metadata, not tensor values
+                continue
+            claimed[aid] = tag
+            out[tag] = out.get(tag, 0.0) + _nbytes(a)  # tpulint: disable=TPU203 — 'claimed' keys on id() ints (buffer identity), not tensors
+    if include_unclaimed:
+        try:
+            import jax
+
+            for a in jax.live_arrays():
+                if id(a) not in claimed:  # tpulint: disable=TPU105 — same metadata-only membership test
+                    out["activations"] += _nbytes(a)
+        except Exception:
+            pass
+    out["total"] = sum(v for k, v in out.items() if k != "total")  # tpulint: disable=TPU105 — k is a tag STRING; v floats came from nbytes metadata
+    if refresh_metrics and _metrics.enabled():
+        for tag, v in out.items():
+            if tag != "total":  # tpulint: disable=TPU105 — tag string comparison, no tensors in this module
+                M_HBM_LIVE.set(v, tag=tag)
+    return out
+
+
+def update_high_water(phase: str = "default") -> Dict[str, float]:
+    """Census + per-phase high-water update. Call at the peak-pressure
+    points of a phase (end of prefill chunk, inside a train step, …);
+    the max total per phase is what the metric family exports."""
+    c = census(refresh_metrics=True)
+    with _lock:
+        if c["total"] >= _high_water.get(phase, -1.0):
+            _high_water[phase] = c["total"]
+            for tag in TAGS:
+                _high_water_by_tag[(phase, tag)] = c.get(tag, 0.0)
+        hw = _high_water[phase]
+    if _metrics.enabled():
+        M_HBM_HIGH_WATER.set(hw, phase=phase)
+    return c
+
+
+def high_water(phase: Optional[str] = None):
+    """Per-phase high-water totals, or one phase's
+    ``{"total":…, tags…}`` breakdown snapshot."""
+    with _lock:
+        if phase is None:
+            return dict(_high_water)
+        out = {"total": _high_water.get(phase, 0.0)}
+        for tag in TAGS:
+            out[tag] = _high_water_by_tag.get((phase, tag), 0.0)
+        return out
+
+
+def reset_high_water():
+    with _lock:
+        _high_water.clear()
+        _high_water_by_tag.clear()
+
+
+def refresh_metrics() -> Dict[str, float]:
+    """Census with the paddle_tpu_hbm_live_bytes gauges updated —
+    snapshot/export call sites (metrics dump CLI, atexit dump) use this
+    so a saved snapshot carries the attributed breakdown."""
+    return census(refresh_metrics=True)
+
+
+# ----------------------------------------------------------------- params
+# Parameters register through a process-wide WeakSet (allocation site:
+# nn/parameter.py). Grads ride the same walk — a parameter's .grad is
+# optimizer-visible state worth attributing separately.
+_live_params: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def track_parameter(p):
+    """Called by nn.Parameter.__init__ — O(1), no census cost."""
+    try:
+        _live_params.add(p)
+    except TypeError:
+        pass
+
+
+def _params_arrays():
+    for p in list(_live_params):
+        yield getattr(p, "_data", None)
+
+
+def _grads_arrays():
+    for p in list(_live_params):
+        g = getattr(p, "_grad", None)
+        if g is not None:
+            yield _array_of(g)
+
+
+register_provider("params", _params_arrays)
+register_provider("grads", _grads_arrays)

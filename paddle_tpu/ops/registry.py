@@ -23,6 +23,12 @@ class OpDef:
     inplace_variant: Optional[str] = None
     doc: str = ""
     tags: tuple = field(default_factory=tuple)
+    #: analytical cost model ``cost_fn(input_shapes, input_dtypes, attrs,
+    #: output_shapes) -> observability.perf.costmodel.OpCost`` — attached
+    #: by costmodel.attach_cost_models() (per-op-class formulas) or by a
+    #: register(..., cost_fn=...) site; None = no model (the perf layer
+    #: falls back to a category-generic estimate)
+    cost_fn: Optional[Callable] = None
 
 
 OPS: Dict[str, OpDef] = {}
@@ -46,14 +52,15 @@ SHADOWED: list = []
 
 
 def register(name: str, category: str = "misc", differentiable: bool = True,
-             inplace_variant: Optional[str] = None, tags=()):
+             inplace_variant: Optional[str] = None, tags=(), cost_fn=None):
     """Decorator registering a user-facing op function."""
 
     def deco(fn):
         OPS[name] = OpDef(name=name, category=category, lowering=fn,
                           differentiable=differentiable,
                           inplace_variant=inplace_variant,
-                          doc=(fn.__doc__ or ""), tags=tuple(tags))
+                          doc=(fn.__doc__ or ""), tags=tuple(tags),
+                          cost_fn=cost_fn)
         return fn
 
     return deco

@@ -50,6 +50,13 @@ class Optimizer:
         self._master_weights: Dict[int, jnp.ndarray] = {}
         self._step_count = 0
         self._jit_step = jax.jit(self._tree_step)
+        # HBM attribution: moments + master weights report under the
+        # "optimizer_state" tag (weakly bound — telemetry must not pin a
+        # dropped optimizer's state in memory)
+        from ..observability.perf import memory as _perf_memory
+        _perf_memory.register_object(
+            "optimizer_state", self,
+            lambda o: (o._accumulators, o._master_weights))
 
     @staticmethod
     def _coeff(wd):

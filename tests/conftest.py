@@ -31,3 +31,8 @@ def _seed():
     import paddle_tpu as paddle
     paddle.seed(2024)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 run (-m 'not slow')")

@@ -45,7 +45,7 @@ def main():
 
     results = {}
     for flag in (False, True):
-        dt, loss0, loss_end, n_params = _run_train_bench(
+        dt, loss0, loss_end, n_params, _attr = _run_train_bench(
             model, params, make_inputs, loss_of, iters,
             bf16_weights=flag)
         tok_s = batch * seq / dt

@@ -49,15 +49,30 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
              make_prompt: Optional[Callable[[np.random.RandomState, int],
                                             List[int]]] = None,
              clock: Callable[[], float] = time.monotonic,
-             max_wall_s: float = 300.0) -> dict:
+             max_wall_s: float = 300.0,
+             attribution: bool = True) -> dict:
     """Drive ``engine`` with an open-loop Poisson arrival stream and
     return the latency/goodput/outcome report (JSON-able dict).
 
     The engine is ticked whenever it has work; between arrivals with an
     idle engine the harness sleeps in small slices so arrival timing
     stays honest. ``max_wall_s`` is a harness-level backstop (an engine
-    bug must fail the drill, not hang it)."""
+    bug must fail the drill, not hang it).
+
+    With ``attribution`` (default) the run collects the engine's
+    per-tick device spans (``serving.prefill`` / ``serving.decode``, each
+    bracketed by the blocking result read) and reports device-time
+    attribution: prefill vs decode compute seconds and shares, plus
+    device time per tick — the SLO view of *where* the chip's time went,
+    not just wall-clock TTFT/ITL. Skipped when a profiler recording
+    already owns the span buffer."""
     from paddle_tpu.inference import Overloaded
+    from paddle_tpu.observability import trace as _trace
+
+    own_trace = attribution and not _trace.active()
+    if own_trace:
+        _trace.clear()
+        _trace.activate()
 
     rng = np.random.RandomState(seed)
     arrivals = poisson_arrivals(offered_rps, n_requests, seed=seed)
@@ -73,28 +88,62 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
     rids: List[int] = []
     overloaded = 0
     i = 0
-    while i < n_requests or engine.has_work():
-        now = clock() - start
-        # the backstop runs on REAL time: an injected non-advancing
-        # clock must still fail the drill rather than hang it
-        if time.monotonic() - real_start > max_wall_s:
-            raise RuntimeError(
-                f"loadgen exceeded max_wall_s={max_wall_s} with "
-                f"{n_requests - i} arrivals pending")
-        while i < n_requests and arrivals[i] <= now:
-            try:
-                rids.append(engine.add_request(
-                    prompts[i], max_new_tokens=max_new_tokens,
-                    ttft_deadline_s=ttft_deadline_s,
-                    deadline_s=deadline_s))
-            except Overloaded:
-                overloaded += 1
-            i += 1
-        if engine.has_work():
-            engine.step()
-        elif i < n_requests:
-            time.sleep(min(max(arrivals[i] - now, 0.0), 0.005))
+    try:
+        while i < n_requests or engine.has_work():
+            now = clock() - start
+            # the backstop runs on REAL time: an injected non-advancing
+            # clock must still fail the drill rather than hang it
+            if time.monotonic() - real_start > max_wall_s:
+                raise RuntimeError(
+                    f"loadgen exceeded max_wall_s={max_wall_s} with "
+                    f"{n_requests - i} arrivals pending")
+            while i < n_requests and arrivals[i] <= now:
+                try:
+                    rids.append(engine.add_request(
+                        prompts[i], max_new_tokens=max_new_tokens,
+                        ttft_deadline_s=ttft_deadline_s,
+                        deadline_s=deadline_s))
+                except Overloaded:
+                    overloaded += 1
+                i += 1
+            if engine.has_work():
+                engine.step()
+            elif i < n_requests:
+                time.sleep(min(max(arrivals[i] - now, 0.0), 0.005))
+    finally:
+        # a failed drill must not leave the global span buffer recording
+        if own_trace:
+            _trace.deactivate()
     wall = clock() - start
+    # span timestamps are perf_counter seconds — utilization must divide
+    # by REAL elapsed time, not an injected drill clock
+    real_wall = time.monotonic() - real_start
+
+    device = None
+    if own_trace:
+        spans = _trace.drain()
+        ticks = sum(1 for _n, cat, *_ in spans if cat == "serving")
+        phase_s = {"prefill": 0.0, "decode": 0.0}
+        for name, cat, t0, t1, _tid, _args in spans:
+            if cat == "device" and name.startswith("serving."):
+                phase = name.split(".", 1)[1]
+                if phase in phase_s:
+                    phase_s[phase] += t1 - t0
+        dev_total = phase_s["prefill"] + phase_s["decode"]
+        device = {
+            "ticks": ticks,
+            "prefill_compute_s": round(phase_s["prefill"], 4),
+            "decode_compute_s": round(phase_s["decode"], 4),
+            "device_s": round(dev_total, 4),
+            "prefill_compute_share": round(
+                phase_s["prefill"] / dev_total, 4) if dev_total else None,
+            "decode_compute_share": round(
+                phase_s["decode"] / dev_total, 4) if dev_total else None,
+            "device_s_per_tick": round(dev_total / ticks, 6) if ticks
+            else None,
+            "device_util_of_wall": round(dev_total / real_wall, 4)
+            if real_wall > 0 else None,
+        }
 
     outcomes = engine.drain_outcomes()
     missing = [r for r in rids if r not in outcomes]
@@ -136,6 +185,7 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
         "p50_itl_s": _percentile(itls, 50),
         "p99_itl_s": _percentile(itls, 99),
         "wall_s": round(wall, 3),
+        "device_attribution": device,
     }
 
 

@@ -1,0 +1,252 @@
+"""Perf regression gate — bench run vs frozen baseline, with teeth.
+
+Compares a ``bench.py`` JSON output against a frozen baseline
+(``tools/perf_baseline.json``) with per-rung tolerances and exits
+non-zero on regression — the CI gate every future perf PR is judged
+against.
+
+Inputs are tolerant of how bench output gets captured: a raw JSON-lines
+stream (one ``{"metric": …}`` object per line), a driver wrapper dict
+with the stream in a ``"tail"`` field (the BENCH_r*.json shape), or a
+JSON list of rung dicts.
+
+Workflows::
+
+    # gate a candidate run (exit 1 on regression / malformed run)
+    python tools/perf_gate.py candidate.json
+
+    # freeze a new baseline after an INTENTIONAL perf change — run the
+    # ladder on the target chip, eyeball the rungs, then:
+    python tools/perf_gate.py --freeze candidate.json
+    #   (writes tools/perf_baseline.json; commit it with the PR that
+    #    changed performance, and say why in the PR body)
+
+    # schema-only: structural validation without timing assertions (what
+    # tier-1 runs on CPU — a CPU host must not judge TPU ratios)
+    python tools/perf_gate.py --schema-only candidate.json
+
+Per-rung tolerance lives in the baseline entry (``min_ratio``, default
+0.90): a candidate regresses when value_ratio < min_ratio for
+higher-is-better units, or 1/ratio < min_ratio for lower-is-better
+units (``us/op``). Rungs that errored in the candidate always fail;
+rungs missing from the candidate fail unless ``--allow-missing``.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "perf_baseline.json")
+DEFAULT_MIN_RATIO = 0.90
+
+#: units where a SMALLER value is better
+_LOWER_IS_BETTER_UNITS = ("us/op", "us", "ms", "s", "seconds")
+
+#: keys every bench rung must carry (the schema contract bench.py emits
+#: and the driver archives)
+_RUNG_KEYS = ("metric", "value", "unit", "vs_baseline")
+
+__all__ = ["parse_bench_output", "validate_schema", "gate", "freeze",
+           "main", "DEFAULT_BASELINE"]
+
+
+def parse_bench_output(text: str) -> Dict[str, dict]:
+    """{metric: rung dict} out of bench output in any captured shape."""
+    text = text.strip()
+    records: List[dict] = []
+    if text.startswith("{") or text.startswith("["):
+        try:
+            blob = json.loads(text)
+        except ValueError:
+            blob = None
+        if isinstance(blob, list):
+            records = [r for r in blob if isinstance(r, dict)]
+        elif isinstance(blob, dict) and "metric" in blob:
+            records = [blob]
+        elif isinstance(blob, dict) and isinstance(blob.get("tail"), str):
+            return parse_bench_output(blob["tail"])
+    if not records:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                r = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(r, dict) and "metric" in r:
+                records.append(r)
+    out = {}
+    for r in records:
+        out[str(r["metric"])] = r       # last wins (rung then summary)
+    return out
+
+
+def validate_schema(rungs: Dict[str, dict]) -> List[str]:
+    """Structural problems of a parsed bench run (empty list = valid)."""
+    problems = []
+    if not rungs:
+        return ["no bench rungs found in input"]
+    for name, r in rungs.items():
+        for k in _RUNG_KEYS:
+            if k not in r:
+                problems.append(f"{name}: missing key {k!r}")
+        v = r.get("value")
+        if not isinstance(v, (int, float)):
+            problems.append(f"{name}: value is {type(v).__name__}, "
+                            f"not a number")
+        if r.get("unit") == "error":
+            problems.append(
+                f"{name}: errored rung "
+                f"({r.get('extra', {}).get('error', '?')})")
+    return problems
+
+
+def _direction(unit: str) -> str:
+    return ("lower" if str(unit).lower() in _LOWER_IS_BETTER_UNITS
+            else "higher")
+
+
+def gate(candidate: Dict[str, dict], baseline: dict,
+         allow_missing: bool = False) -> dict:
+    """Compare candidate rungs against the frozen baseline. Returns
+    ``{"pass": bool, "checks": [...], "schema_problems": [...]}`` —
+    check entries carry metric/base/candidate/ratio/min_ratio/status."""
+    schema = validate_schema(candidate)
+    checks = []
+    ok = True
+    for metric, base in baseline.get("rungs", {}).items():
+        entry = {"metric": metric, "baseline": base.get("value"),
+                 "min_ratio": float(base.get(
+                     "min_ratio", baseline.get("default_min_ratio",
+                                               DEFAULT_MIN_RATIO)))}
+        cand = candidate.get(metric)
+        if cand is None:
+            entry.update(status="missing" if allow_missing else "fail",
+                         reason="rung absent from candidate run")
+            if not allow_missing:
+                ok = False
+            checks.append(entry)
+            continue
+        if cand.get("unit") == "error":
+            entry.update(status="fail", reason="candidate rung errored")
+            ok = False
+            checks.append(entry)
+            continue
+        if not isinstance(cand.get("value"), (int, float)) or \
+                not isinstance(base.get("value"), (int, float)):
+            # malformed rung on either side (null value from a
+            # partially-failed run or a hand-edited baseline): a clean
+            # per-rung failure, not a gate traceback
+            bad = ("candidate" if not isinstance(
+                cand.get("value"), (int, float)) else "baseline")
+            entry.update(status="fail",
+                         reason=f"{bad} value is not a number")
+            ok = False
+            checks.append(entry)
+            continue
+        bval = float(base.get("value", 0.0))
+        cval = float(cand.get("value", 0.0))
+        direction = base.get("direction") or _direction(base.get("unit"))
+        if bval <= 0:
+            ratio = 1.0 if cval >= bval else 0.0
+        elif direction == "lower":
+            ratio = bval / cval if cval > 0 else 0.0
+        else:
+            ratio = cval / bval
+        entry.update(candidate=cval, ratio=round(ratio, 4),
+                     direction=direction)
+        if ratio < entry["min_ratio"]:
+            entry.update(status="fail",
+                         reason=f"regressed: ratio {ratio:.4f} < "
+                                f"min_ratio {entry['min_ratio']}")
+            ok = False
+        else:
+            entry["status"] = "pass"
+        checks.append(entry)
+    if schema:
+        ok = False
+    return {"pass": ok, "checks": checks, "schema_problems": schema}
+
+
+def freeze(candidate: Dict[str, dict],
+           min_ratio: float = DEFAULT_MIN_RATIO,
+           note: str = "") -> dict:
+    """Baseline dict from a candidate run (the ``--freeze`` workflow).
+    Errored rungs are left out — a baseline must not encode a broken
+    rung as the bar."""
+    rungs = {}
+    device = None
+    for metric, r in candidate.items():
+        if r.get("unit") == "error":
+            continue
+        if not isinstance(r.get("value"), (int, float)):
+            continue        # a null value must never become the bar
+        rungs[metric] = {"value": r.get("value"), "unit": r.get("unit"),
+                         "direction": _direction(r.get("unit")),
+                         "min_ratio": min_ratio}
+        device = device or r.get("extra", {}).get("device")
+    return {"format": "paddle_tpu.perf_baseline/1",
+            "device": device, "note": note,
+            "default_min_ratio": min_ratio, "rungs": rungs}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("candidate", help="bench output (JSON lines, driver "
+                    "wrapper, or list); '-' = stdin")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--freeze", action="store_true",
+                    help="write the baseline from this candidate run "
+                    "instead of gating")
+    ap.add_argument("--min-ratio", type=float, default=DEFAULT_MIN_RATIO,
+                    help="per-rung tolerance recorded at freeze time")
+    ap.add_argument("--note", default="", help="why the baseline moved "
+                    "(recorded in the frozen file)")
+    ap.add_argument("--schema-only", action="store_true",
+                    help="validate structure only, no ratio checks")
+    ap.add_argument("--allow-missing", action="store_true",
+                    help="baseline rungs absent from the candidate warn "
+                    "instead of fail")
+    args = ap.parse_args(argv)
+
+    text = (sys.stdin.read() if args.candidate == "-"
+            else open(args.candidate).read())
+    candidate = parse_bench_output(text)
+
+    if args.freeze:
+        base = freeze(candidate, min_ratio=args.min_ratio, note=args.note)
+        if not base["rungs"]:
+            print("refusing to freeze: no healthy rungs in candidate",
+                  file=sys.stderr)
+            return 1
+        with open(args.baseline, "w") as f:
+            json.dump(base, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"froze {len(base['rungs'])} rung(s) -> {args.baseline}")
+        return 0
+
+    if args.schema_only:
+        problems = validate_schema(candidate)
+        print(json.dumps({"pass": not problems,
+                          "schema_problems": problems}, indent=1))
+        return 1 if problems else 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"cannot read baseline {args.baseline!r}: {e} — freeze one "
+              f"first (--freeze)", file=sys.stderr)
+        return 1
+    result = gate(candidate, baseline, allow_missing=args.allow_missing)
+    print(json.dumps(result, indent=1))
+    return 0 if result["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
