@@ -695,10 +695,11 @@ def call(op_name: str, fn: Callable, tensor_inputs: Sequence[Tensor],
         for hook in _op_hooks:
             hook(op_name, tensor_inputs, out_tensors, attrs, dur)
         for hook in rec_hooks:
-            # recorder taps (static.Program capture) additionally receive
-            # the attr-bound lowering so the op can be replayed on new
-            # payloads
-            hook(op_name, f, tensor_inputs, out_tensors)
+            # recorder taps (static.Program capture, spmd propagation)
+            # additionally receive the attr-bound lowering so the op can
+            # be replayed on new payloads, plus the semantic attrs the
+            # sharding rules key on (axis/transpose/keepdim/...)
+            hook(op_name, f, tensor_inputs, out_tensors, attrs)
         if _export_hooks:
             merged = dict(attrs)
             if export_attrs:

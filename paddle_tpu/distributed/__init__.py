@@ -21,6 +21,7 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
                             shard_layer, shard_optimizer, shard_tensor)
 from . import fleet
 from . import sharding
+from . import spmd
 from . import checkpoint
 from . import auto_tuner
 from . import rpc

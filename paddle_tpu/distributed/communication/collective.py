@@ -21,22 +21,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-try:
-    from jax import shard_map as _shard_map_raw
-except ImportError:  # older jax
-    from jax.experimental.shard_map import shard_map as _shard_map_raw
+from ..shard_map_compat import shard_map as _shard_map_compat
 
 
 def shard_map(f, mesh, in_specs, out_specs):
     """shard_map with the static replication checker off — collective
     outputs (all_gather/broadcast) are replicated in ways the checker can't
-    infer."""
-    try:
-        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_vma=False)
-    except TypeError:
-        return _shard_map_raw(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=False)
+    infer. Version portability lives in distributed.shard_map_compat."""
+    return _shard_map_compat(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check=False)
 
 import time as _time
 
@@ -336,13 +329,13 @@ def broadcast_object_list(object_list, src=0, group=None):
         # the same values into the same slots
         _inject.check("collective.timeout", exc=TimeoutError)
         for i, obj in enumerate(object_list):
-            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+            payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()  # tpulint: disable=TPU104 — object collective: the payload is a pickled PYTHON object, host by design
             n = Tensor(jnp.asarray([payload.size], jnp.int32))
             broadcast(n, src=src, group=group)
             t = Tensor(jnp.asarray(payload))
             broadcast(t, src=src, group=group)
             object_list[i] = pickle.loads(
-                np.asarray(t._data, dtype=np.uint8).tobytes())
+                np.asarray(t._data, dtype=np.uint8).tobytes())  # tpulint: disable=TPU104 — object collective deserialization: host unpickle is the documented contract
         return object_list
 
     return _retry(attempt, policy=_OBJ_COLL_POLICY,

@@ -39,6 +39,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ...shard_map_compat import (replicate_for_manual as _replicate,
+                                 shard_map as _shard_map)
+
 
 def schedule_block_ticks(schedule: str, m: int, S: int, K: int) -> int:
     """Total per-rank block-unit ticks the compiled schedule executes.
@@ -167,11 +170,13 @@ def spmd_pipeline_interleaved(block_fn: Callable, stacked: Sequence, xs, *,
             jnp.where(idx == S - 1, out, jnp.zeros_like(out)), "pp")
         return out, jax.lax.psum(n_active, "pp")
 
-    out, n_active = jax.shard_map(
+    chunked = [_replicate(a, mesh) for a in chunked]
+    out, n_active = _shard_map(
         body, mesh=mesh,
         in_specs=([P("pp")] * len(chunked), P()),
         out_specs=(P(), P()),
-        axis_names=frozenset({"pp"}), check_vma=False)(chunked, xs)
+        axis_names=frozenset({"pp"}), check=False)(chunked,
+                                                   _replicate(xs, mesh))
     if return_stats:
         return out, {"active_block_ticks": n_active,
                      "total_block_slots": T * S}
@@ -304,11 +309,13 @@ def spmd_pipeline_zb(block_fn: Callable, stacked: Sequence, xs, *,
         out_local = pipe(local_outer, xs)
         return jax.lax.psum(out_local, "pp")
 
-    out = jax.shard_map(
+    staged = [_replicate(a, mesh) for a in staged]
+    out = _shard_map(
         lambda st, xs: body(st, xs), mesh=mesh,
         in_specs=([P("pp")] * len(staged), P()),
         out_specs=P(),
-        axis_names=frozenset({"pp"}), check_vma=False)(staged, xs)
+        axis_names=frozenset({"pp"}), check=False)(staged,
+                                                   _replicate(xs, mesh))
     return out
 
 
@@ -331,11 +338,24 @@ def _buffer_dtype(dtypes):
     return jnp.float32
 
 
+def _pad_tail(vec, size):
+    """Right-pad a 1-D vector with zeros to ``size`` via concatenate —
+    NOT jnp.pad: on the current jax/XLA lineage a pad op (even
+    zero-width) feeding a manual shard_map region on a multi-axis mesh
+    makes the SPMD partitioner mis-assign the region's inputs, silently
+    corrupting the pipeline (reproduced in tests/test_pipeline_schedules
+    on the dp×pp virtual mesh; concatenate partitions correctly)."""
+    if size <= vec.shape[0]:
+        return vec
+    return jnp.concatenate(
+        [vec, jnp.zeros((size - vec.shape[0],), vec.dtype)])
+
+
 def _flatten_pack(arrays, size, buf_dtype=jnp.float32):
     flat = (jnp.concatenate([jnp.ravel(a).astype(buf_dtype)
                              for a in arrays])
             if arrays else jnp.zeros((0,), buf_dtype))
-    return jnp.pad(flat, (0, size - flat.shape[0]))
+    return _pad_tail(flat, size)
 
 def _unpack(flat, shapes, dtypes):
     outs, off = [], 0
@@ -402,7 +422,7 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
             x = flat_x[:n_in].reshape(in_aval.shape).astype(in_aval.dtype)
             y = fn(params, x)
             yf = jnp.ravel(y).astype(act_dtype)
-            return jnp.pad(yf, (0, Amax - yf.shape[0]))
+            return _pad_tail(yf, Amax)
         return run
 
     branches = [_branch(s) for s in range(S)]
@@ -413,9 +433,11 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
     def body(packed_local, xs):
         local = packed_local[0]
         idx = jax.lax.axis_index("pp")
-        xs_flat = jnp.pad(
-            xs.reshape(m, -1).astype(act_dtype),
-            ((0, 0), (0, Amax - in_size)))
+        xs2 = xs.reshape(m, -1).astype(act_dtype)
+        if Amax > in_size:  # _pad_tail, 2-D: jnp.pad corrupts shard_map
+            xs2 = jnp.concatenate(
+                [xs2, jnp.zeros((m, Amax - in_size), act_dtype)], axis=1)
+        xs_flat = xs2
         state = jnp.zeros((Amax,), act_dtype)
         out = jnp.zeros((m, Amax), act_dtype)
 
@@ -437,10 +459,11 @@ def spmd_pipeline_hetero(stage_fns: List[Callable],
         return jax.lax.psum(
             jnp.where(idx == S - 1, out, jnp.zeros_like(out)), "pp")
 
-    out_flat = jax.shard_map(
+    out_flat = _shard_map(
         body, mesh=mesh,
         in_specs=(P("pp"), P()),
         out_specs=P(),
-        axis_names=frozenset({"pp"}), check_vma=False)(packed, xs)
+        axis_names=frozenset({"pp"}), check=False)(
+            _replicate(packed, mesh), _replicate(xs, mesh))
     out = out_flat[:, :out_size].reshape((m,) + tuple(out_aval.shape))
     return out.astype(out_aval.dtype)

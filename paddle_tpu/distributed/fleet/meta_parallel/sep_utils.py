@@ -32,6 +32,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ....core import dispatch
 from ....core.tensor import Tensor
 from ... import mesh as mesh_mod
+from ...shard_map_compat import pvary as _pvary, shard_map as _shard_map
 
 NEG_INF = -1e30
 
@@ -145,10 +146,10 @@ def _ring_body(qa, ka, va, *, sep, scale, causal, local_seq,
     b, sq, h, d = qa.shape
     # mark the accumulators device-varying over the ring axis so the scan
     # carry type is stable under vma checking
-    m0 = jax.lax.pvary(jnp.full((b, sq, h, 1), NEG_INF, jnp.float32),
-                       axis_name)
-    l0 = jax.lax.pvary(jnp.zeros((b, sq, h, 1), jnp.float32), axis_name)
-    acc0 = jax.lax.pvary(jnp.zeros((b, sq, h, d), jnp.float32), axis_name)
+    m0 = _pvary(jnp.full((b, sq, h, 1), NEG_INF, jnp.float32),
+                axis_name)
+    l0 = _pvary(jnp.zeros((b, sq, h, 1), jnp.float32), axis_name)
+    acc0 = _pvary(jnp.zeros((b, sq, h, d), jnp.float32), axis_name)
     (k_f, v_f, m, l, acc), _ = jax.lax.scan(
         step, (ka, va, m0, l0, acc0), jnp.arange(sep))
     out = acc / jnp.maximum(l, 1e-30)
@@ -179,10 +180,10 @@ def ring_flash_attention(q, k, v, causal=False, scale=None,
     seq_spec = P(None, axis, None, None)
 
     def f(qa, ka, va):
-        sm = jax.shard_map(body, mesh=mesh,
-                           in_specs=(seq_spec, seq_spec, seq_spec),
-                           out_specs=seq_spec,
-                           axis_names=frozenset({axis}), check_vma=True)
+        sm = _shard_map(body, mesh=mesh,
+                        in_specs=(seq_spec, seq_spec, seq_spec),
+                        out_specs=seq_spec,
+                        axis_names=frozenset({axis}), check=True)
         return sm(qa, ka, va)
 
     return dispatch.call("ring_flash_attention", f, [q, k, v])

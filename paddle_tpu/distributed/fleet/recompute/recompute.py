@@ -108,7 +108,7 @@ def recompute(function, *args, **kwargs):
                    for arr in ctx.saved_arrays]
             full = list(args)
             for i, c in zip(tensor_idx, ins):
-                full[i] = c
+                full[i] = c  # tpulint: disable=TPU203 — 'full' is the replay call's LOCAL arg list (i is a positional index, not a tensor key); it never outlives the backward
             try:
                 with dispatch.enable_grad():
                     outs = function(*full, **kwargs)

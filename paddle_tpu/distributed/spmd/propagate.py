@@ -1,0 +1,407 @@
+"""Whole-program sharding propagation.
+
+Two entry modes over one rule engine (:mod:`.rules`):
+
+* **Offline** — ``propagate_program`` walks a recorded
+  ``static.Program`` op-list IR and produces a :class:`ShardingPlan`;
+  ``ShardedProgram`` replays the program with
+  ``jax.lax.with_sharding_constraint`` inserted at every rule boundary
+  (inputs re-pinned per the rules' resolved constraints, outputs
+  annotated), compiled as ONE ``jax.jit`` program over the mesh.
+* **Online** — :class:`trace_scope` registers a dispatch recorder hook
+  for the duration of a ``to_static``/Engine trace: as each op
+  dispatches (payloads are tracers), its rule fires and the output
+  tracers are re-annotated in place. Forward order over the dynamic op
+  stream is exactly the static op list's order, so both modes compute
+  the same specs.
+
+Fallback semantics: an op with no rule (neither named, nor category)
+propagates *replicated* outputs — downstream rules see no sharding to
+extend — and counts into ``paddle_tpu_spmd_fallback_total`` with a
+once-per-op-name warning. No constraint is inserted for it (pinning an
+unknown op's output replicated could force a gather the partitioner
+never needed).
+"""
+from __future__ import annotations
+
+import threading
+import warnings
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ...observability import metrics as _metrics
+from . import rules as R
+
+__all__ = ["ShardingPlan", "propagate_program", "shard_program",
+           "ShardedProgram", "trace_scope", "param_spec_of"]
+
+_m_fallback = _metrics.counter(
+    "paddle_tpu_spmd_fallback_total",
+    "Ops the sharding propagator could not rule on (replicate-and-warn "
+    "fallback).", labelnames=("op",))
+_m_annotated = _metrics.counter(
+    "paddle_tpu_spmd_annotated_total",
+    "Op outputs annotated with a propagated sharding constraint.",
+    labelnames=("op",))
+
+_warned_ops = set()
+_warn_lock = threading.Lock()
+
+
+def _warn_fallback(op_name: str):
+    if _metrics.enabled():
+        _m_fallback.inc(op=op_name)
+    with _warn_lock:
+        if op_name in _warned_ops:
+            return
+        _warned_ops.add(op_name)
+    warnings.warn(
+        f"spmd: no sharding rule for op {op_name!r} — its outputs "
+        f"propagate as replicated. Register one via "
+        f"ops.registry.register(..., spmd_rule=...) or extend "
+        f"distributed.spmd.rules.SPMD_RULES.", stacklevel=3)
+
+
+@dataclass
+class OpAnnotation:
+    """Resolved shardings for one op in the plan."""
+
+    op_name: str
+    tier: str                      # rule | category-fallback | replicate-warn
+    in_specs: List[Optional[tuple]]
+    out_specs: List[Optional[tuple]]
+
+
+@dataclass
+class ShardingPlan:
+    """Propagation result over an op list: per-op annotations + stats."""
+
+    mesh: object
+    annotations: List[OpAnnotation] = field(default_factory=list)
+    env: Dict[int, tuple] = field(default_factory=dict)
+    fallback_ops: Dict[str, int] = field(default_factory=dict)
+    # meet-rule conflicts are counted in the
+    # paddle_tpu_spmd_conflicts_total metric (rules.meet), not per plan
+
+    @property
+    def annotated_ops(self) -> int:
+        return sum(1 for a in self.annotations
+                   if any(not R.is_trivial(s) for s in a.out_specs))
+
+    def summary(self) -> dict:
+        return {"ops": len(self.annotations),
+                "annotated": self.annotated_ops,
+                "fallback": dict(self.fallback_ops),
+                "tiers": {t: sum(1 for a in self.annotations
+                                 if a.tier == t)
+                          for t in ("rule", "category-fallback",
+                                    "replicate-warn")}}
+
+
+def _apply_rule(op_name, in_specs, in_shapes, attrs, out_shapes):
+    """Run the op's rule; returns (result, tier). Fallback and rule
+    exceptions both produce replicated outputs."""
+    rule, tier = R.rule_for(op_name)
+    if rule is None:
+        _warn_fallback(op_name)
+        return R.SpmdResult(
+            out_specs=[(None,) * len(s) for s in out_shapes]), tier
+    try:
+        res = rule(list(in_specs), list(map(tuple, in_shapes)),
+                   dict(attrs or {}), list(map(tuple, out_shapes)))
+    except Exception:
+        # a rule that cannot digest an exotic shape must never sink the
+        # program — degrade to replicated for this op only
+        res = R.SpmdResult(out_specs=[(None,) * len(s)
+                                      for s in out_shapes])
+    outs = list(res.out_specs)
+    while len(outs) < len(out_shapes):
+        outs.append((None,) * len(out_shapes[len(outs)]))
+    res.out_specs = [R.normalize(s, len(out_shapes[i]))
+                     for i, s in enumerate(outs)]
+    ins = list(res.in_specs) + [None] * (len(in_specs)
+                                         - len(res.in_specs))
+    res.in_specs = [None if s is None else R.normalize(s, len(in_shapes[i]))
+                    for i, s in enumerate(ins)]
+    return res, tier
+
+
+# --------------------------------------------------------------------------
+# Offline: static.Program pass
+# --------------------------------------------------------------------------
+def propagate_program(program, mesh, in_specs: Dict[str, object],
+                      param_specs=None) -> ShardingPlan:
+    """Forward-propagate shardings through a recorded Program.
+
+    ``in_specs`` maps feed names to PartitionSpecs; ``param_specs`` is
+    an optional ``fn(tensor) -> spec`` for the program's captured
+    parameters (default: the tensor's own ``.placements``-derived spec,
+    else replicated)."""
+    plan = ShardingPlan(mesh=mesh)
+    env = plan.env
+    for name, vid in program.feed_vars.items():
+        shape = program._feed_shapes.get(name, ())
+        env[vid] = R.normalize(in_specs.get(name), len(shape))
+    for vid, t in program._captured.items():
+        spec = param_spec_of(t, param_specs)
+        env[vid] = R.normalize(spec, len(t.shape))
+    for op in program.global_block().ops:
+        in_shapes = op.in_shapes or tuple(() for _ in op.in_ids)
+        out_shapes = op.out_shapes or tuple(() for _ in op.out_ids)
+        ins = [env.get(i, (None,) * len(s))
+               for i, s in zip(op.in_ids, in_shapes)]
+        res, tier = _apply_rule(op.name, ins, in_shapes, op.attrs,
+                                out_shapes)
+        if tier == "replicate-warn":
+            plan.fallback_ops[op.name] = \
+                plan.fallback_ops.get(op.name, 0) + 1
+        for oid, spec in zip(op.out_ids, res.out_specs):
+            env[oid] = spec
+        plan.annotations.append(OpAnnotation(
+            op.name, tier, res.in_specs, res.out_specs))
+    return plan
+
+
+def param_spec_of(t, param_specs=None):
+    """Spec for a parameter/captured tensor: explicit fn > the
+    ``_spmd_spec`` stamp (set by spmd.shard_params) > placements
+    attribute (set by shard_tensor/shard_layer) > the payload's own
+    NamedSharding > replicated."""
+    if param_specs is not None:
+        spec = param_specs(t)
+        if spec is not None:
+            return spec
+    stamped = getattr(t, "_spmd_spec", None)
+    if stamped is not None:
+        return stamped
+    pm = getattr(t, "process_mesh", None)
+    placements = getattr(t, "placements", None)
+    if pm is not None and placements is not None:
+        from ..auto_parallel.api import _placements_to_spec
+        return _placements_to_spec(placements, len(t.shape), pm)
+    sharding = getattr(getattr(t, "_data", None), "sharding", None)
+    if sharding is not None and hasattr(sharding, "spec"):
+        return sharding.spec
+    return None
+
+
+class ShardedProgram:
+    """A Program + ShardingPlan, executable as one SPMD ``jax.jit``
+    program: feeds are device_put per their specs, every planned
+    boundary becomes a ``with_sharding_constraint``."""
+
+    def __init__(self, program, mesh, plan: ShardingPlan,
+                 in_specs: Dict[str, object]):
+        self.program = program
+        self.mesh = mesh
+        self.plan = plan
+        self.in_specs = dict(in_specs)
+        self._jit_cache: Dict[tuple, object] = {}
+
+    def _sharding(self, spec):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, R.to_pspec(spec))
+
+    def _constrain(self, arr, spec):
+        if spec is None or R.is_trivial(spec):
+            return arr
+        try:
+            return jax.lax.with_sharding_constraint(
+                arr, self._sharding(spec))
+        except Exception:
+            return arr
+
+    def run(self, feed: Dict[str, np.ndarray], fetch_ids: List[int]):
+        import jax.numpy as jnp
+        prog = self.program
+        names = sorted(prog.feed_vars)
+        missing = [n for n in names if n not in feed]
+        if missing:
+            raise KeyError(f"missing feeds: {missing}")
+        arrays = []
+        for n in names:
+            a = jnp.asarray(feed[n])
+            declared = prog._feed_dtypes.get(n)
+            if declared and str(a.dtype) != declared:
+                a = a.astype(np.dtype(declared))
+            spec = self.plan.env.get(prog.feed_vars[n])
+            if spec is not None and not R.is_trivial(spec):
+                a = jax.device_put(a, self._sharding(spec))
+            arrays.append(a)
+        sig = (tuple((n, a.shape, str(a.dtype))
+                     for n, a in zip(names, arrays)), tuple(fetch_ids),
+               tuple(prog._captured.keys()))
+        if sig not in self._jit_cache:
+            feed_ids = [prog.feed_vars[n] for n in names]
+            cap_ids = list(prog._captured.keys())
+
+            def compiled(feed_arrays, cap_arrays):
+                env = dict(zip(feed_ids, feed_arrays))
+                env.update(zip(cap_ids, cap_arrays))
+                for op, ann in zip(prog.global_block().ops,
+                                   self.plan.annotations):
+                    args = []
+                    for i, ispec in zip(
+                            op.in_ids,
+                            ann.in_specs + [None] * len(op.in_ids)):
+                        v = env[i]
+                        if ispec is not None:
+                            v = self._constrain(v, ispec)
+                        args.append(v)
+                    out = op.fn(*args)
+                    outs = (list(out) if isinstance(out, (tuple, list))
+                            else [out])
+                    for oid, val, ospec in zip(op.out_ids, outs,
+                                               ann.out_specs):
+                        env[oid] = self._constrain(val, ospec)
+                return [env[i] for i in fetch_ids]
+
+            self._jit_cache[sig] = jax.jit(compiled)
+        # captured params enter at their planned placement
+        cap_arrays = []
+        for vid, t in prog._captured.items():
+            a = t._data
+            spec = self.plan.env.get(vid)
+            if spec is not None and not R.is_trivial(spec) \
+                    and not isinstance(a, jax.core.Tracer):
+                a = jax.device_put(a, self._sharding(spec))
+            cap_arrays.append(a)
+        outs = self._jit_cache[sig](arrays, cap_arrays)
+        return [np.asarray(o) for o in outs]
+
+
+def shard_program(program, mesh, in_specs: Dict[str, object],
+                  param_specs=None) -> ShardedProgram:
+    """Plan + bind: returns a :class:`ShardedProgram` whose ``run``
+    executes the recorded program fully sharded over ``mesh``.
+
+    ``in_specs``: feed name -> PartitionSpec. ``param_specs``: optional
+    ``fn(tensor) -> spec`` for captured parameters."""
+    from ...ops import registry  # ensure registry import side effects
+    R.attach_spmd_rules()
+    plan = propagate_program(program, mesh, in_specs, param_specs)
+    return ShardedProgram(program, mesh, plan, in_specs)
+
+
+# --------------------------------------------------------------------------
+# Online: dispatch-time propagation during a to_static / Engine trace
+# --------------------------------------------------------------------------
+class trace_scope:
+    """Propagate + annotate while a traced function runs.
+
+    Registers a dispatch recorder hook; every dispatched op's rule maps
+    the tracked input specs to output specs, and sharded outputs are
+    re-annotated in place (``t._data = with_sharding_constraint(...)``)
+    so the constraint lands inside the jaxpr being traced. Seed inputs
+    and parameters with :meth:`seed` (which also pins the seeded
+    tensor's payload).
+
+    Stats (after exit): ``.stats`` = ops/annotated/fallback/tier dict.
+    """
+
+    def __init__(self, mesh, annotate: bool = True):
+        self.mesh = mesh
+        self.annotate = annotate
+        self.env: Dict[int, tuple] = {}
+        self.keepalive: List[object] = []  # id-stability for env keys
+        self.stats: Dict[str, object] = {
+            "ops": 0, "annotated": 0, "fallback": {},
+            "tiers": {"rule": 0, "category-fallback": 0,
+                      "replicate-warn": 0}}
+        R.attach_spmd_rules()
+
+    # -- seeding -----------------------------------------------------------
+    def _sharding(self, spec):
+        from jax.sharding import NamedSharding
+        return NamedSharding(self.mesh, R.to_pspec(spec))
+
+    def seed(self, tensor, spec, constrain: bool = True):
+        """Declare a tensor's sharding (inputs/params) and pin it."""
+        norm = R.normalize(spec, len(tensor.shape))
+        self.env[id(tensor)] = norm
+        self.keepalive.append(tensor)
+        if constrain and not R.is_trivial(norm):
+            try:
+                tensor._data = jax.lax.with_sharding_constraint(
+                    tensor._data, self._sharding(norm))
+            except Exception:
+                pass
+        return tensor
+
+    def seed_tree(self, obj, spec_tree):
+        """Seed the Tensor leaves of ``obj``. ``spec_tree`` is either a
+        single PartitionSpec (broadcast over every leaf) or a list/tuple
+        of per-leaf entries, each None or a PartitionSpec. A bare
+        PartitionSpec is ATOMIC — it subclasses tuple, so the per-leaf
+        test must check element types, not just the container type."""
+        from jax.sharding import PartitionSpec
+
+        from ...core.tensor import Tensor
+        leaves, _ = jax.tree_util.tree_flatten(
+            obj, is_leaf=lambda x: isinstance(x, Tensor))
+        t_leaves = [l for l in leaves if isinstance(l, Tensor)]
+        if spec_tree is None:
+            specs = [None] * len(t_leaves)
+        elif (not isinstance(spec_tree, PartitionSpec)  # tpulint: disable=TPU105 — spec_tree holds PartitionSpecs and t_leaves is only len()-counted: host metadata, no tensor values
+              and isinstance(spec_tree, (list, tuple))
+              and all(s is None or isinstance(s, PartitionSpec)
+                      for s in spec_tree)):
+            # per-leaf list: a count mismatch is a misconfiguration —
+            # silently broadcasting the LIST as one spec would produce
+            # duplicate-axis garbage whose constraint failure is
+            # swallowed, training fully replicated with no diagnostic
+            if len(spec_tree) != len(t_leaves):
+                raise ValueError(
+                    f"in_specs has {len(spec_tree)} entries but the "
+                    f"traced call has {len(t_leaves)} Tensor inputs — "
+                    f"pass one spec per Tensor leaf (None for "
+                    f"replicated) or a single PartitionSpec to "
+                    f"broadcast")
+            specs = list(spec_tree)
+        else:
+            specs = [spec_tree] * len(t_leaves)
+        for t, s in zip(t_leaves, specs):
+            self.seed(t, s)
+
+    # -- the hook ----------------------------------------------------------
+    def _hook(self, op_name, f, tensor_inputs, out_tensors, attrs=None):
+        in_shapes = [tuple(t.shape) for t in tensor_inputs]
+        out_shapes = [tuple(t.shape) for t in out_tensors]
+        ins = [self.env.get(id(t), (None,) * len(s))
+               for t, s in zip(tensor_inputs, in_shapes)]
+        res, tier = _apply_rule(op_name, ins, in_shapes, attrs,
+                                out_shapes)
+        st = self.stats
+        st["ops"] += 1
+        st["tiers"][tier] = st["tiers"].get(tier, 0) + 1
+        if tier == "replicate-warn":
+            st["fallback"][op_name] = st["fallback"].get(op_name, 0) + 1
+        annotated = False
+        for t, spec in zip(out_tensors, res.out_specs):
+            self.env[id(t)] = spec
+            self.keepalive.append(t)
+            if self.annotate and not R.is_trivial(spec):
+                try:
+                    t._data = jax.lax.with_sharding_constraint(
+                        t._data, self._sharding(spec))
+                    annotated = True
+                except Exception:
+                    pass
+        if annotated:
+            st["annotated"] += 1
+            if _metrics.enabled():
+                _m_annotated.inc(op=op_name)
+
+    def __enter__(self):
+        from ...core import dispatch
+        dispatch.register_recorder_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ...core import dispatch
+        dispatch.unregister_recorder_hook(self._hook)
+        self.keepalive.clear()
+        return False

@@ -347,4 +347,4 @@ def unshard_dtensor(dist_tensor):
     arr = t._data
     # re-placing on a replicated sharding materializes the full value
     gathered = jax.device_get(arr)
-    return Tensor(np.asarray(gathered))
+    return Tensor(np.asarray(gathered))  # tpulint: disable=TPU104 — get_full_tensor materializes the gathered value on the host by contract

@@ -614,9 +614,19 @@ class Multinomial(Distribution):
         n = self.total_count
 
         def f(p):
-            return jax.random.multinomial(
-                key, jnp.asarray(float(n), p.dtype), p,
-                shape=shape + p.shape).astype(p.dtype)
+            if hasattr(jax.random, "multinomial"):
+                return jax.random.multinomial(
+                    key, jnp.asarray(float(n), p.dtype), p,
+                    shape=shape + p.shape).astype(p.dtype)
+            # older jax: n categorical draws + one-hot count per bucket
+            # is the same distribution (batch dims broadcast over the
+            # leading sample axis)
+            draws = jax.random.categorical(
+                key, jnp.log(jnp.clip(p, 1e-38, None)),
+                shape=(int(n),) + shape + p.shape[:-1])
+            counts = jax.nn.one_hot(draws, p.shape[-1],
+                                    dtype=p.dtype).sum(axis=0)
+            return counts
 
         return _call("multi_sample", f, [self.probs], no_grad=True)
 

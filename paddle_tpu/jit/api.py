@@ -104,12 +104,26 @@ class StaticFunction:
     the jit cache — the guard role of the reference's SOT guards."""
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
-                 backend=None, full_graph=True):
+                 backend=None, full_graph=True, mesh=None, in_specs=None,
+                 param_specs=None):
         self._dygraph_fn = fn
         self._input_spec = input_spec
         functools.update_wrapper(self, fn)
         self._jitted = None
         self._params = None
+        # SPMD auto-sharding (distributed.spmd): when a mesh is given,
+        # the trace runs under a propagation scope — inputs seed from
+        # in_specs, params from their shard_params/_spmd_spec stamps
+        # (or the param_specs callable), and every dispatched op's rule
+        # annotates its outputs, so ONE fully-sharded XLA program comes
+        # out of jit.
+        if mesh is not None and hasattr(mesh, "jax_mesh"):
+            mesh = mesh.jax_mesh()  # ProcessMesh -> jax Mesh
+        self._spmd_mesh = mesh
+        self._spmd_in_specs = in_specs
+        self._spmd_param_specs = param_specs
+        #: propagation stats of the most recent traced signature
+        self.spmd_stats: Optional[dict] = None
         #: per-signature AOT runners — deserialized persistent-cache hits
         #: and locally AOT-compiled programs (persistent cache path)
         self._aot_sigs: dict = {}
@@ -295,7 +309,11 @@ class StaticFunction:
                 try:
                     args_t = _wrap(a)
                     kwargs_t = _wrap(k)
-                    out = fn(*args_t, **kwargs_t)
+                    if outer._spmd_mesh is not None:
+                        out = outer._spmd_traced_call(fn, args_t,
+                                                      kwargs_t, params)
+                    else:
+                        out = fn(*args_t, **kwargs_t)
                     # Thread in-place updates (BatchNorm running stats
                     # via set_value) out of the trace so the caller can
                     # write them back. String keys: the mutated dict
@@ -311,6 +329,45 @@ class StaticFunction:
                         p._data = d
 
         self._jitted = jax.jit(jit_target, static_argnums=(2, 3))
+
+    def _spmd_traced_call(self, fn, args_t, kwargs_t, params):
+        """Run the traced body under a sharding-propagation scope
+        (distributed.spmd.trace_scope): seed params + inputs, let every
+        op's spmd_rule annotate its outputs inside the jaxpr."""
+        from ..distributed import spmd as spmd_mod
+
+        sc = spmd_mod.trace_scope(self._spmd_mesh)
+        with sc:
+            for p in params:
+                spec = spmd_mod.param_spec_of(p, self._spmd_param_specs)
+                if spec is not None:
+                    # constrain=False: the param arrays are jit ARGUMENTS
+                    # whose committed sharding already tells GSPMD the
+                    # placement, and replacing p._data here would make
+                    # jit_target's mutated-baseline comparison flag every
+                    # sharded param as mutated (returned + swapped per
+                    # call). Only the propagation env needs the spec.
+                    sc.seed(p, spec, constrain=False)
+            sc.seed_tree((args_t, kwargs_t), self._spmd_in_specs)
+            out = fn(*args_t, **kwargs_t)
+        self.spmd_stats = dict(sc.stats)
+        return out
+
+    def _spmd_fingerprint(self, params=()):
+        """Persistent-cache key component: a program compiled under one
+        mesh/spec configuration must never be served for another —
+        including the PARAM placements (shard_params stamps /
+        param_specs), which change the compiled executable's input
+        shardings without touching mesh or in_specs."""
+        if self._spmd_mesh is None:
+            return []
+        from ..distributed import spmd as spmd_mod
+        mesh = self._spmd_mesh
+        return [list(mesh.axis_names),
+                [int(mesh.shape[a]) for a in mesh.axis_names],
+                repr(self._spmd_in_specs),
+                [repr(spmd_mod.param_spec_of(p, self._spmd_param_specs))
+                 for p in params]]
 
     # ------------------------------------------------ persistent cache
     def _pcc_key(self, sig, params):
@@ -332,7 +389,11 @@ class StaticFunction:
             repr(treedef),
             [[i, sot_mod._const_repr(v, 2)] for i, v in statics],
             [list(map(list, shapes))],
-            pcc.aval_sig([p._data for p in params]))
+            # spmd fingerprint only when a mesh is set: appending the
+            # empty list for plain functions would re-key (and so
+            # invalidate) every previously persisted cache entry
+            *([self._spmd_fingerprint(params)]
+              if self._spmd_mesh is not None else []))
 
     def _pcc_load(self, sig, params):
         """Look the signature up in the persistent cache; a hit returns a
@@ -590,16 +651,25 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=False):
+              backend=None, full_graph=False, mesh=None, in_specs=None,
+              param_specs=None):
+    """Program capture; with ``mesh=`` the capture auto-shards — see
+    distributed.spmd (``in_specs``: PartitionSpec pytree for the Tensor
+    arguments; ``param_specs``: optional ``fn(param) -> spec``,
+    defaulting to each param's spmd.shard_params placement)."""
     def decorate(fn):
         if hasattr(fn, "forward") and callable(getattr(fn, "forward")):
             # Layer instance: wrap its forward
             layer = fn
             layer.forward = StaticFunction(layer.forward, input_spec,
-                                           build_strategy, backend, full_graph)
+                                           build_strategy, backend,
+                                           full_graph, mesh=mesh,
+                                           in_specs=in_specs,
+                                           param_specs=param_specs)
             return layer
         return StaticFunction(fn, input_spec, build_strategy, backend,
-                              full_graph)
+                              full_graph, mesh=mesh, in_specs=in_specs,
+                              param_specs=param_specs)
     if function is not None:
         return decorate(function)
     return decorate

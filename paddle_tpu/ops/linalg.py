@@ -547,19 +547,29 @@ def svd_lowrank(x, q=6, niter=2, M=None, name=None):
     """Randomized low-rank SVD (reference svd_lowrank; Halko et al.
     subspace iteration, like pca_lowrank without centering)."""
     xt = _t(x)
-    inputs = [xt] + ([_t(M)] if M is not None else [])
+    # The random projection is drawn OUTSIDE the lowering and passed as
+    # an input: a next_key() call inside f would execute at trace time
+    # and bake ONE key into the compiled entry the eager jit cache then
+    # serves forever — freezing the sketch and decoupling results from
+    # the global seed (the order-sensitivity this op's test once had).
+    k = min(q, min(int(xt.shape[-2]), int(xt.shape[-1])))
+    from ..core.generator import next_key
+    omega = jax.random.normal(
+        next_key(), tuple(int(d) for d in xt.shape[:-2])
+        + (int(xt.shape[-1]), k), xt._data.dtype)
+    inputs = [xt, _t(omega)] + ([_t(M)] if M is not None else [])
 
-    def f(a, *m):
+    def f(a, om, *m):
         if m:
             a = a - m[0]
-        k = min(q, min(a.shape[-2:]))
-        from ..core.generator import next_key
-        omega = jax.random.normal(next_key(), a.shape[:-2]
-                                  + (a.shape[-1], k), a.dtype)
-        y = a @ omega
+        # re-orthogonalize between power iterations (Halko alg. 4.4) —
+        # without it the sketch's condition number grows as
+        # (σ1/σk)^(2·niter+1) and the small singular values drown in
+        # fp32 roundoff
+        Q, _ = jnp.linalg.qr(a @ om)
         for _ in range(niter):
-            y = a @ (jnp.swapaxes(a, -2, -1) @ y)
-        Q, _ = jnp.linalg.qr(y)
+            Z, _ = jnp.linalg.qr(jnp.swapaxes(a, -2, -1) @ Q)
+            Q, _ = jnp.linalg.qr(a @ Z)
         B = jnp.swapaxes(Q, -2, -1) @ a
         u, s, vh = jnp.linalg.svd(B, full_matrices=False)
         return Q @ u, s, jnp.swapaxes(vh, -2, -1)

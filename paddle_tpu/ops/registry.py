@@ -29,6 +29,13 @@ class OpDef:
     #: register(..., cost_fn=...) site; None = no model (the perf layer
     #: falls back to a category-generic estimate)
     cost_fn: Optional[Callable] = None
+    #: sharding-propagation rule ``spmd_rule(input_specs, input_shapes,
+    #: attrs, output_shapes) -> distributed.spmd.rules.SpmdResult`` —
+    #: maps input PartitionSpecs to output specs (+ resolved input
+    #: constraints); attached by spmd.attach_spmd_rules() (per-op-class
+    #: rules) or a register(..., spmd_rule=...) site; None = no rule
+    #: (the propagator falls back per category, else replicate-and-warn)
+    spmd_rule: Optional[Callable] = None
 
 
 OPS: Dict[str, OpDef] = {}
@@ -52,7 +59,8 @@ SHADOWED: list = []
 
 
 def register(name: str, category: str = "misc", differentiable: bool = True,
-             inplace_variant: Optional[str] = None, tags=(), cost_fn=None):
+             inplace_variant: Optional[str] = None, tags=(), cost_fn=None,
+             spmd_rule=None):
     """Decorator registering a user-facing op function."""
 
     def deco(fn):
@@ -60,7 +68,7 @@ def register(name: str, category: str = "misc", differentiable: bool = True,
                           differentiable=differentiable,
                           inplace_variant=inplace_variant,
                           doc=(fn.__doc__ or ""), tags=tuple(tags),
-                          cost_fn=cost_fn)
+                          cost_fn=cost_fn, spmd_rule=spmd_rule)
         return fn
 
     return deco

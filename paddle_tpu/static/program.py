@@ -26,13 +26,21 @@ _state = threading.local()
 
 
 class _OpRecord:
-    __slots__ = ("name", "fn", "in_ids", "out_ids")
+    __slots__ = ("name", "fn", "in_ids", "out_ids", "attrs", "in_shapes",
+                 "out_shapes")
 
-    def __init__(self, name, fn, in_ids, out_ids):
+    def __init__(self, name, fn, in_ids, out_ids, attrs=None,
+                 in_shapes=(), out_shapes=()):
         self.name = name
         self.fn = fn
         self.in_ids = in_ids
         self.out_ids = out_ids
+        # semantic attrs + shapes at record time: the spmd propagation
+        # pass (distributed.spmd.propagate) reads the op list as an IR
+        # and needs axis/transpose attrs and dim counts per value
+        self.attrs = dict(attrs or {})
+        self.in_shapes = tuple(in_shapes)
+        self.out_shapes = tuple(out_shapes)
 
     def __repr__(self):
         ins = ", ".join(f"v{i}" for i in self.in_ids)
@@ -67,7 +75,7 @@ class Program:
         self._jit_cache: Dict[tuple, "jax._src.stages.Wrapped"] = {}
 
     # -- construction -----------------------------------------------------
-    def _record(self, op_name, fn, tensor_inputs, out_tensors):
+    def _record(self, op_name, fn, tensor_inputs, out_tensors, attrs=None):
         in_ids = [id(t) for t in tensor_inputs]
         out_ids = [id(t) for t in out_tensors]
         for t in tensor_inputs:
@@ -77,7 +85,10 @@ class Program:
                 self._captured[id(t)] = t
         self._produced.update(out_ids)
         self._keepalive.extend(out_tensors)
-        self._block.ops.append(_OpRecord(op_name, fn, in_ids, out_ids))
+        self._block.ops.append(_OpRecord(
+            op_name, fn, in_ids, out_ids, attrs,
+            [tuple(t.shape) for t in tensor_inputs],
+            [tuple(t.shape) for t in out_tensors]))
 
     def global_block(self):
         return self._block

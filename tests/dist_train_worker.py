@@ -14,6 +14,15 @@ hybrid_parallel_pp_alexnet.py).
 
 Usage: dist_train_worker.py <strategy> <outdir>
   strategy: single | dp | dp_sharding | dp_mp | dp_pp | dp_sep
+          | auto_tp | auto_fsdp
+
+The auto_* strategies train the SAME plain GPT through the SPMD
+sharding-propagation subsystem (distributed.spmd): one mesh declaration
+(data×tp / data×fsdp) + regex param rules, per-op spmd_rules annotate
+the whole jitted step, GSPMD picks the collectives — no fleet parallel
+layers. Their losses must match the single-process baseline exactly
+like the hand-built paths do, and the worker asserts ZERO
+replicate-fallback ops.
 """
 import json
 import os
@@ -42,7 +51,9 @@ rank = jax.process_index()
 ndev = jax.device_count()
 
 strategy = fleet_pkg.DistributedStrategy()
-if STRATEGY == "dp_sharding":
+if STRATEGY in ("auto_tp", "auto_fsdp"):
+    pass  # no fleet wrappers: the spmd subsystem owns the mesh
+elif STRATEGY == "dp_sharding":
     strategy.hybrid_configs = {"dp_degree": ndev // 2,
                                "sharding_degree": 2}
 elif STRATEGY == "dp_mp":
@@ -60,7 +71,75 @@ GLOBAL_BATCH, SEQ, STEPS = 8, 16, 6
 rng = np.random.RandomState(0)  # identical stream on every rank
 losses = []
 
-if STRATEGY == "dp_pp":
+if STRATEGY in ("auto_tp", "auto_fsdp"):
+    # SPMD auto-sharding: plain GPT + one mesh declaration + regex
+    # param-placement rules; the Engine traces ONE step under the
+    # propagation scope and XLA partitions it. Batches enter the jit
+    # uncommitted (identical on every process) — the seeded
+    # with_sharding_constraint inside the program distributes them, so
+    # the same worker runs single- and multi-process unchanged.
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu import nn, ops
+    from paddle_tpu.distributed import mesh as mesh_mod, spmd
+    from paddle_tpu.distributed.auto_parallel.engine import Engine
+    from paddle_tpu.nn import functional as F
+
+    axis = "tp" if STRATEGY == "auto_tp" else "fsdp"
+    mesh = mesh_mod.build_mesh({"data": ndev // 2, axis: 2})
+    mesh_mod.set_mesh(mesh)
+    cfg = GPTConfig(vocab_size=128, hidden_size=32, num_layers=2,
+                    num_heads=2, max_seq_len=16,
+                    use_flash_attention=False)
+    model = GPTForCausalLM(cfg)
+    if STRATEGY == "auto_tp":
+        rules = [
+            (r".*qkv_proj\.weight", P(None, "tp")),
+            (r".*qkv_proj\.bias", P("tp")),
+            (r".*fc1\.weight", P(None, "tp")),
+            (r".*fc1\.bias", P("tp")),
+            (r".*(out_proj|fc2)\.weight", P("tp", None)),
+            (r".*wte\.weight", P("tp", None)),
+        ]
+    else:
+        rules = [(r".*\.weight", P("fsdp")), (r".*\.bias", P("fsdp"))]
+    placed = spmd.shard_params(model, mesh, rules)
+    assert placed, "no parameter matched a placement rule"
+
+    class _LM(nn.Layer):
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        def forward(self, x):
+            return self.inner(x)  # logits
+
+    def _loss(logits, y):
+        v = logits.shape[-1]
+        return F.cross_entropy(
+            ops.reshape(logits[:, :-1, :], [-1, v]),
+            ops.reshape(y[:, 1:], [-1]))
+
+    engine = Engine(_LM(model), loss=_loss,
+                    optimizer=paddle.optimizer.AdamW(
+                        learning_rate=1e-2,
+                        parameters=model.parameters()),
+                    mesh=mesh, in_specs=(P("data"), P("data")))
+    engine.prepare()
+    fixed = rng.randint(0, cfg.vocab_size,
+                        (GLOBAL_BATCH, SEQ)).astype(np.int64)
+    pa = [p._data for p in engine._params]
+    opt_state = engine._init_opt_state(pa)
+    for step in range(STEPS):
+        lr = jnp.asarray(1e-2, jnp.float32)
+        loss, pa, opt_state = engine._train_step(pa, opt_state, lr,
+                                                 fixed, fixed)
+        losses.append(float(np.asarray(loss)))
+    assert engine.spmd_stats is not None
+    assert not engine.spmd_stats["fallback"], \
+        f"replicate-fallback ops: {engine.spmd_stats['fallback']}"
+elif STRATEGY == "dp_pp":
     # pipeline path: a 4-block MLP stack over pp=2 stages trained with
     # fleet's train_batch (scan + ppermute SPMD pipeline, cross-process)
     from paddle_tpu import nn

@@ -157,6 +157,10 @@ epoch = int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0"))
 outdir = sys.argv[1]
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # CPU cross-process collectives need an explicit transport here
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 jax.distributed.initialize(
     coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
     num_processes=world, process_id=rank)
@@ -184,6 +188,10 @@ epoch = int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0"))
 outdir = sys.argv[1]
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:  # CPU cross-process collectives need an explicit transport here
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass
 jax.distributed.initialize(
     coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
     num_processes=world, process_id=rank)
