@@ -221,6 +221,7 @@ class Engine:
             log_freq=10, verbose=0):
         if self._train_step is None:
             self.prepare()
+        from ...observability import fleet as _fleet
         from ...optimizer.lr import LRScheduler
 
         loader = self.dataloader(train_data, batch_size, shuffle=True)
@@ -233,6 +234,12 @@ class Engine:
             for step_i, batch in enumerate(loader):
                 if steps_per_epoch and step_i >= steps_per_epoch:
                     break
+                # fleet beacon: per-step wall time + windowed cross-rank
+                # skew gather — the straggler detector's feed. Resolved
+                # per step (like the fleet trainers) so reset_beacon()
+                # takes effect mid-fit.
+                bcn = _fleet.beacon()
+                bcn.step_begin()
                 xs, ys = batch[0], batch[-1]
                 x = self._shard_batch(xs.numpy() if isinstance(xs, Tensor)
                                       else xs)
@@ -245,6 +252,7 @@ class Engine:
                 if sched is not None:
                     sched.step()
                 losses.append(float(loss))  # tpulint: disable=TPU103 — fit's per-step loss-history read; the driver loop is the documented host boundary (the compiled step itself stays async)
+                bcn.step_end()
                 if verbose and step_i % log_freq == 0:
                     print(f"[engine] epoch {epoch} step {step_i} "
                           f"loss {losses[-1]:.4f}")

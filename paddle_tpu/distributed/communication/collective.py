@@ -15,6 +15,7 @@ same value.
 from __future__ import annotations
 
 import functools
+import math
 from typing import List, Optional
 
 import jax
@@ -37,6 +38,7 @@ from ...core import dispatch
 from ...core.tensor import Tensor, as_tensor
 from ...fault import inject as _inject
 from ...fault.retry import RetryPolicy, retry as _retry
+from ...observability import flight as _flight
 from ...observability import metrics as _metrics
 from ...observability import trace as _trace
 from .. import mesh as mesh_mod
@@ -67,14 +69,37 @@ _m_coll_latency = _metrics.histogram(
     "completion only when the caller synchronizes).", labelnames=("op",))
 
 
-def _coll_begin():
-    if _metrics.enabled() or _trace.active():
-        return _time.perf_counter()
-    return None
+def _coll_begin(name: str, payload=None, group: Optional[Group] = None,
+                **extra):
+    """Open one collective record: a (t0, flight_entry) token.
+
+    The flight recorder stamps a per-group monotonic sequence number and
+    an in-flight ring entry HERE, before the device op — a rank that
+    blocks inside the collective leaves the entry unfinished, which is
+    exactly the evidence the cross-rank hang diff reads. Metric/trace
+    timestamps additionally require their own gates, as before."""
+    t0 = (_time.perf_counter()
+          if _metrics.enabled() or _trace.active() else None)
+    rec = None
+    if _flight.enabled():
+        gid = int(getattr(group, "id", 0) or 0) if group is not None else 0
+        # bytes from shape × itemsize: reading .nbytes off a live jax
+        # Array costs µs per call, which would dominate the recorder
+        shape = getattr(payload, "shape", ())
+        dt = getattr(payload, "dtype", None)
+        nbytes = 0
+        if dt is not None:
+            nbytes = int(math.prod(shape)) * int(
+                getattr(dt, "itemsize", 0) or 0)
+        rec = _flight.RECORDER.begin(gid, name, shape, dt, nbytes,
+                                     **extra)
+    return (t0, rec, name)
 
 
-def _coll_end(name: str, payload, t0):
+def _coll_end(tok, payload=None):
+    t0, rec, name = tok
     LAST_COLLECTIVE["op"] = name     # one dict write; no clock read
+    _flight.RECORDER.end(rec)
     if t0 is None:
         return
     # timestamp (for hang-age reporting) only when telemetry is already
@@ -88,6 +113,32 @@ def _coll_end(name: str, payload, t0):
         _m_coll_latency.observe(t1 - t0, op=name)
     _trace.add_complete(f"collective:{name}", "collective", t0, t1,
                         {"bytes": nbytes})
+
+
+def _coll_abort(tok, exc):
+    """Close the in-flight flight entry when the collective RAISES
+    (shape error, device OOM, transport timeout): this rank is no
+    longer inside the transport, so leaving ``t1=None`` would poison
+    every later hang diff with a stale 'blocked at seq N' verdict.
+    The exception type stays on the entry for the post-mortem."""
+    _, rec, _name = tok
+    if rec is not None and rec.get("t1") is None:
+        rec["raised"] = type(exc).__name__
+        _flight.RECORDER.end(rec)
+
+
+def _desync_bypass(tok) -> bool:
+    """``collective.desync`` fault guard: when armed (with an optional
+    ``op=`` filter), this rank SKIPS the device collective — its peers
+    enter it and block on the missing participant, which is precisely
+    the desync failure mode the flight recorder + watchdog diff must
+    name. The bypassed entry completes immediately and is marked, so a
+    post-mortem reader can see the divergence locally too."""
+    if _inject.fire("collective.desync", op=tok[2]) is None:
+        return False
+    if tok[1] is not None:
+        tok[1]["bypassed"] = True
+    return True
 
 
 class ReduceOp:
@@ -161,12 +212,19 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place sum (or max/min/prod/avg) across the group's axes."""
     g = _group(group)
     t = _t(tensor)
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(t._data, g.mesh)
-    fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec, op)
-    out = fn(arr)
-    t._swap_payload(out)
-    _coll_end("all_reduce", arr, t0)
+    tok = _coll_begin("all_reduce", t._data, g)
+    if _desync_bypass(tok):  # tpulint: disable=TPU105 — taint FP: tok is a host (t0, flight_entry, name) tuple; the branch reads the fault-injection registry, never tensor data
+        _coll_end(tok, t._data)
+        return t
+    try:
+        arr, spec = _ensure_on_mesh(t._data, g.mesh)
+        fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec, op)
+        out = fn(arr)
+        t._swap_payload(out)
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     return t
 
 
@@ -202,11 +260,20 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     all_gather.py)."""
     g = _group(group)
     t = _t(tensor)
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(t._data, g.mesh)
-    fn = _build_all_gather(_mesh_key(g.mesh), g.axes, spec)
-    stacked = fn(arr)                      # (nranks, *global_shape_local)
-    _coll_end("all_gather", arr, t0)
+    tok = _coll_begin("all_gather", t._data, g)
+    if _desync_bypass(tok):  # tpulint: disable=TPU105 — taint FP: tok is a host (t0, flight_entry, name) tuple; the branch reads the fault-injection registry, never tensor data
+        _coll_end(tok, t._data)
+        stacked = jnp.broadcast_to(
+            t._data[None], (g.nranks,) + tuple(t._data.shape))
+    else:
+        try:
+            arr, spec = _ensure_on_mesh(t._data, g.mesh)
+            fn = _build_all_gather(_mesh_key(g.mesh), g.axes, spec)
+            stacked = fn(arr)              # (nranks, *global_shape_local)
+            _coll_end(tok, arr)
+        except BaseException as e:
+            _coll_abort(tok, e)
+            raise
     n = stacked.shape[0]
     if tensor_list is None:
         tensor_list = []
@@ -216,20 +283,140 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     return tensor_list
 
 
+# ------------------------------------------------- cross-process exchange
+# One device per PROCESS: the sharding under which
+# jax.make_array_from_process_local_data lets each process contribute its
+# own row, and a replicated-output jit is a true all-gather over the
+# coordination transport (gloo on CPU, ICI/DCN on TPU pods). This is the
+# substrate of the fleet telemetry plane (observability.fleet): per-rank
+# payloads really ARE distinct across processes there, unlike the
+# single-controller in-process case where every "rank" holds the same
+# object.
+_PROC_MESH = {"mesh": None, "world": 0}
+
+
+def _process_mesh():
+    world = jax.process_count()
+    if _PROC_MESH["mesh"] is None or _PROC_MESH["world"] != world:
+        devs = []
+        for i in range(world):
+            cand = [d for d in jax.devices() if d.process_index == i]
+            if not cand:
+                raise RuntimeError(
+                    f"no addressable-or-known device for process {i}")
+            devs.append(cand[0])
+        from jax.sharding import Mesh
+        _PROC_MESH["mesh"] = Mesh(np.array(devs), ("fleet",))
+        _PROC_MESH["world"] = world
+    return _PROC_MESH["mesh"]
+
+
+@functools.lru_cache(maxsize=64)
+def _gather_rows_fn(mesh_key, shape, dtype):
+    mesh = _MESHES[mesh_key]
+    return jax.jit(lambda a: a,
+                   out_shardings=NamedSharding(mesh, P()))
+
+
+def gather_rows(row: "np.ndarray") -> "np.ndarray":
+    """All-gather one fixed-shape numeric row per PROCESS: rank r's
+    ``row`` (shape ``S``) lands in result[r] (shape ``(world, *S)``) on
+    every rank. Single process: the identity stack. The compiled gather
+    is cached per (world, shape, dtype) — a beacon calling this every N
+    steps pays one compile ever. Flight-recorded like every other
+    primitive: the blocking host read happens inside the token, so a
+    rank stuck here (a peer died mid-window) leaves the pending ring
+    entry the watchdog's cross-rank diff needs — the telemetry plane's
+    own collective must not be the one hang it cannot diagnose."""
+    row = np.asarray(row)
+    world = jax.process_count()
+    if world == 1:
+        return row[None]
+    tok = _coll_begin("gather_rows", row, None)
+    try:
+        mesh = _process_mesh()
+        sharded = NamedSharding(mesh, P("fleet"))
+        x = jax.make_array_from_process_local_data(
+            sharded, jnp.asarray(row)[None], (world,) + row.shape)
+        fn = _gather_rows_fn(_mesh_key(mesh), (world,) + row.shape,
+                             str(row.dtype))
+        out = np.asarray(fn(x))  # tpulint: disable=TPU104 — object-gather boundary: the gathered payload matrix is consumed on the host by contract
+    finally:
+        _coll_end(tok, row)
+    return out
+
+
+#: pickled payloads are padded to a power-of-two bucket (floor 256) so
+#: repeated object gathers reuse a handful of compiled programs
+_OBJ_BUCKET_MIN = 256
+
+
+def _gather_payloads(payload: bytes) -> List[bytes]:
+    """Cross-process all-gather of one variable-length bytes payload per
+    process. Two fixed-shape rounds: lengths first (so every process pads
+    to the same bucket), then the padded payload matrix."""
+    lengths = gather_rows(np.asarray([len(payload)], np.int32))
+    maxlen = int(lengths.max())
+    bucket = _OBJ_BUCKET_MIN
+    while bucket < maxlen:
+        bucket *= 2
+    row = np.zeros(bucket, np.uint8)
+    row[:len(payload)] = np.frombuffer(payload, np.uint8)
+    rows = gather_rows(row)
+    return [bytes(rows[r, :int(lengths[r, 0])])
+            for r in range(rows.shape[0])]
+
+
 def all_gather_object(object_list, obj, group=None):
-    """Host-side object gather. Single-controller: every 'rank' holds the
-    same object, so this replicates (reference all_gather_object is a
-    pickle-over-NCCL convenience). Guarded by the ``collective.timeout``
-    fault point and retried with backoff — the host object channel is the
-    part of a collective that an unhealthy peer can actually stall."""
+    """Host-side object gather (reference all_gather_object is a
+    pickle-over-NCCL convenience). Across real processes each rank's
+    ``obj`` is DISTINCT: the payload is pickled, padded, and exchanged
+    through the tensor collectives (gloo/ICI transport, see
+    ``_gather_payloads``). Single-controller in-process, every 'rank'
+    holds the same object, so it replicates. Guarded by the
+    ``collective.timeout`` fault point and retried with backoff — the
+    host object channel is the part of a collective that an unhealthy
+    peer can actually stall."""
+    import pickle
+
     g = _group(group)
 
-    def attempt():
+    world = jax.process_count()
+    if world > 1:
+        # the cross-process exchange spans EVERY process; a proper
+        # subgroup would hang waiting for non-members, so refuse it
+        # loudly instead (full-world groups are the fleet-telemetry
+        # use; per-axis subgroup object gathers have no cross-process
+        # implementation here yet)
+        # span check by PROCESS, not device rank: on multi-device
+        # processes (a TPU host owns several chips) the full-world
+        # group's nranks is the chip count, not the process count
+        procs = {d.process_index
+                 for d in np.asarray(g.mesh.devices).ravel()}
+        if procs != set(range(world)):
+            raise NotImplementedError(
+                f"cross-process all_gather_object only supports groups "
+                f"spanning every process ({world}); got a group whose "
+                f"devices live on processes {sorted(procs)}")
+        # NO retry here: re-running a real collective on one rank while
+        # its peers completed (or sit inside) theirs would shift the
+        # transport's collective matching — the exact desync failure
+        # the flight recorder exists to name. The retry policy covers
+        # the host-only replicate path, where attempts are idempotent.
         _inject.check("collective.timeout", exc=TimeoutError)
-        return [obj] * g.nranks
+        tok = _coll_begin("all_gather_object", None, g)
+        try:
+            payloads = _gather_payloads(pickle.dumps(obj))
+        finally:
+            _coll_end(tok)
+        gathered = [pickle.loads(p) for p in payloads]  # tpulint: disable=TPU104 — object collective deserialization: host unpickle is the documented contract
+    else:
+        def attempt():
+            _inject.check("collective.timeout", exc=TimeoutError)
+            return [obj] * g.nranks
 
-    gathered = _retry(attempt, policy=_OBJ_COLL_POLICY,
-                      site="all_gather_object")
+        gathered = _retry(attempt, policy=_OBJ_COLL_POLICY,
+                          site="all_gather_object")
     del object_list[:]
     object_list.extend(gathered)
     return object_list
@@ -275,11 +462,15 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
         raise ValueError(
             f"reduce_scatter dim 0 ({src._data.shape[0]}) must divide the "
             f"group size ({g.nranks})")
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(src._data, g.mesh)
-    fn = _build_reduce_scatter(_mesh_key(g.mesh), g.axes, spec, op)
-    out = fn(arr)
-    _coll_end("reduce_scatter", arr, t0)
+    tok = _coll_begin("reduce_scatter", src._data, g)
+    try:
+        arr, spec = _ensure_on_mesh(src._data, g.mesh)
+        fn = _build_reduce_scatter(_mesh_key(g.mesh), g.axes, spec, op)
+        out = fn(arr)
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     if tensor is not None:
         _t(tensor)._swap_payload(out)
         return tensor
@@ -305,11 +496,18 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     src_local = g.get_group_rank(src)
     if src_local < 0:
         src_local = src
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(t._data, g.mesh)
-    fn = _build_broadcast(_mesh_key(g.mesh), g.axes, spec, src_local)
-    t._swap_payload(fn(arr))
-    _coll_end("broadcast", arr, t0)
+    tok = _coll_begin("broadcast", t._data, g)
+    if _desync_bypass(tok):  # tpulint: disable=TPU105 — taint FP: tok is a host (t0, flight_entry, name) tuple; the branch reads the fault-injection registry, never tensor data
+        _coll_end(tok, t._data)
+        return t
+    try:
+        arr, spec = _ensure_on_mesh(t._data, g.mesh)
+        fn = _build_broadcast(_mesh_key(g.mesh), g.axes, spec, src_local)
+        t._swap_payload(fn(arr))
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     return t
 
 
@@ -377,15 +575,19 @@ def scatter(tensor, tensor_or_tensor_list=None, src=0, group=None,
         from ...ops import manipulation
         source = manipulation.concat([_t(s) for s in source], axis=0)
     source = _t(source) if source is not None else _t(tensor)
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(source._data, g.mesh)
-    src_local = g.get_group_rank(src)
-    if src_local < 0:
-        src_local = src
-    fn = _build_scatter(_mesh_key(g.mesh), g.axes, spec, src_local)
-    out = fn(arr)
-    _t(tensor)._swap_payload(out)
-    _coll_end("scatter", arr, t0)
+    tok = _coll_begin("scatter", source._data, g)
+    try:
+        arr, spec = _ensure_on_mesh(source._data, g.mesh)
+        src_local = g.get_group_rank(src)
+        if src_local < 0:
+            src_local = src
+        fn = _build_scatter(_mesh_key(g.mesh), g.axes, spec, src_local)
+        out = fn(arr)
+        _t(tensor)._swap_payload(out)
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     return tensor
 
 
@@ -408,11 +610,15 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
     g = _group(group)
     from ...ops import manipulation
     stacked = manipulation.stack([_t(x) for x in in_tensor_list], axis=0)
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(stacked._data, g.mesh)
-    fn = _build_all_to_all(_mesh_key(g.mesh), g.axes, spec)
-    out = fn(arr)
-    _coll_end("all_to_all", arr, t0)
+    tok = _coll_begin("all_to_all", stacked._data, g)
+    try:
+        arr, spec = _ensure_on_mesh(stacked._data, g.mesh)
+        fn = _build_all_to_all(_mesh_key(g.mesh), g.axes, spec)
+        out = fn(arr)
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     if out_tensor_list is None:
         out_tensor_list = []
     del out_tensor_list[:]
@@ -441,14 +647,18 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
             raise ValueError(
                 f"{label}={list(sizes)} must have one entry per rank ({n}) "
                 f"and sum to dim 0 ({t._data.shape[0]})")
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(t._data, g.mesh)
-    reshaped = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
-    fn = _build_all_to_all(_mesh_key(g.mesh), g.axes,
-                           P(*([None] + list(spec))))
-    out = fn(reshaped)
-    out = out.reshape((-1,) + out.shape[2:])
-    _coll_end("all_to_all_single", arr, t0)
+    tok = _coll_begin("all_to_all_single", t._data, g)
+    try:
+        arr, spec = _ensure_on_mesh(t._data, g.mesh)
+        reshaped = arr.reshape((n, arr.shape[0] // n) + arr.shape[1:])
+        fn = _build_all_to_all(_mesh_key(g.mesh), g.axes,
+                               P(*([None] + list(spec))))
+        out = fn(reshaped)
+        out = out.reshape((-1,) + out.shape[2:])
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     if out_tensor is not None:
         _t(out_tensor)._swap_payload(out)
         return out_tensor
@@ -457,14 +667,22 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 def barrier(group=None):
     g = _group(group)
-    t0 = _coll_begin()
     # token reduction built directly (not via all_reduce) so the barrier
     # records ONE metric sample instead of also inflating all_reduce's
-    tok = jnp.zeros(())
-    arr, spec = _ensure_on_mesh(tok, g.mesh)
-    fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec, ReduceOp.SUM)
-    jax.block_until_ready(fn(arr))
-    _coll_end("barrier", arr, t0)
+    z = jnp.zeros(())
+    tok = _coll_begin("barrier", z, g)
+    if _desync_bypass(tok):  # tpulint: disable=TPU105 — taint FP: tok is a host (t0, flight_entry, name) tuple; the branch reads the fault-injection registry, never tensor data
+        _coll_end(tok, z)
+        return
+    try:
+        arr, spec = _ensure_on_mesh(z, g.mesh)
+        fn = _build_all_reduce(_mesh_key(g.mesh), g.axes, spec,
+                               ReduceOp.SUM)
+        jax.block_until_ready(fn(arr))
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
 
 
 # --------------------------------------------------------------------- p2p
@@ -522,13 +740,17 @@ def batch_isend_irecv(p2p_op_list):
     perm = tuple((int(getattr(op, "src_rank", i)), int(op.peer))
                  for i, op in enumerate(sends))
     t = sends[0].tensor
-    t0 = _coll_begin()
-    arr, spec = _ensure_on_mesh(t._data, g.mesh)
-    fn = _build_ppermute(_mesh_key(g.mesh), g.axes, spec, perm)
-    out = fn(arr)
-    for op in recvs:
-        op.tensor._swap_payload(out)
-    _coll_end("batch_isend_irecv", arr, t0)
+    tok = _coll_begin("batch_isend_irecv", t._data, g)
+    try:
+        arr, spec = _ensure_on_mesh(t._data, g.mesh)
+        fn = _build_ppermute(_mesh_key(g.mesh), g.axes, spec, perm)
+        out = fn(arr)
+        for op in recvs:
+            op.tensor._swap_payload(out)
+        _coll_end(tok, arr)
+    except BaseException as e:
+        _coll_abort(tok, e)
+        raise
     return []
 
 

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ....core import dispatch
 from ....nn.clip import ClipGradByGlobalNorm
+from ....observability import fleet as _fleet
 from .dygraph_sharding_optimizer import DygraphShardingOptimizer
 
 
@@ -91,6 +92,11 @@ class HybridParallelOptimizer:
 
     @dispatch.no_grad()
     def step(self):
+        # fleet beacon boundary: one tick per optimizer step — inter-tick
+        # wall time is the trainer's step time, feeding the cross-rank
+        # straggler detector (observability.fleet). beacon() is looked
+        # up per step on purpose: tests swap the singleton.
+        _fleet.beacon().tick()
         if self._gm_k <= 1:
             self._inner_opt.step()
             return

@@ -150,7 +150,63 @@ class Watchdog:
                       + "\n")
         except Exception as e:
             out.write(f"[watchdog] observability dump failed: {e}\n")
+        try:
+            self._dump_flight_and_diff(out)
+        except Exception as e:
+            out.write(f"[watchdog] flight-recorder dump failed: {e}\n")
         out.write("[watchdog] ---- end diagnostics ----\n")
+
+    def _dump_flight_and_diff(self, out, wait_s: Optional[float] = None):
+        """Collective flight-recorder post-mortem: persist THIS rank's
+        ring (the collectives are the thing that is stuck, so the
+        exchange is out-of-band — through the shared
+        ``PADDLE_TPU_FLIGHT_RECORD`` path), print the local tail, then
+        wait briefly for the peer ranks' watchdogs to write theirs and
+        diff the sequence tails: the verdict names exactly which rank
+        stalled before, or raced past, which collective (the reference
+        comm_task_manager's stuck-rank report)."""
+        import os
+
+        from ..observability import flight
+
+        tail = flight.RECORDER.tail(20)
+        out.write(f"[watchdog] collective flight tail "
+                  f"({len(tail)} records):\n")
+        for e in tail:
+            state = ("IN FLIGHT" if e["t1"] is None else
+                     f"done {e['t1'] - e['t0']:.6f}s")
+            out.write(f"[watchdog]   seq={e['seq']} g={e['group']} "
+                      f"{e['op']}{e['shape']}/{e['dtype']} "
+                      f"{e['bytes']}B {state}"
+                      + (" BYPASSED" if e.get("bypassed") else "")
+                      + "\n")
+        base = os.environ.get(flight.RECORD_ENV)
+        if not base:
+            return
+        path = flight.dump(reason=f"watchdog hang #{self.hang_count}")
+        out.write(f"[watchdog] flight record persisted: {path}\n")
+        world = flight.rank_world()[1]    # env-based; backend may be wedged
+        if world <= 1:
+            return
+        # peers' watchdogs fire within one timeout+poll of ours; wait a
+        # bounded slice of that for their files before diffing what we
+        # have (an incomplete set still yields a best-effort verdict)
+        wait_s = (wait_s if wait_s is not None
+                  else min(self.timeout + 2 * self.poll_interval, 30.0))
+        deadline = time.monotonic() + wait_s
+        dumps = flight.load_dumps(base, world=world)
+        while len(dumps) < world and time.monotonic() < deadline:
+            time.sleep(min(self.poll_interval, 0.5))
+            dumps = flight.load_dumps(base, world=world)
+        verdict = flight.diff_ranks(dumps)
+        out.write(f"[watchdog] cross-rank flight diff "
+                  f"({len(dumps)}/{world} rank dumps): "
+                  f"status={verdict['status']}"
+                  + (f" rank={verdict['rank']}"
+                     if verdict.get("rank") is not None else "")
+                  + (f" seq={verdict['seq']}"
+                     if verdict.get("seq") is not None else "")
+                  + f"\n[watchdog] {verdict['detail']}\n")
 
     def stop(self):
         self._stop.set()

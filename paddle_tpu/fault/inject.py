@@ -41,6 +41,20 @@ guard). The registered points:
                                     ``tick`` — exercises the fail-in-flight
                                     + degrade + keep-serving path; params:
                                     ``tick``
+``fleet.slow_step``                 the fleet beacon sleeps ``seconds``
+                                    inside each observed training step —
+                                    the deterministic slow-rank drill for
+                                    straggler detection (arm on ONE rank);
+                                    params: ``seconds``
+``collective.desync``               a shape-preserving tensor collective
+                                    (``all_reduce`` / ``all_gather`` /
+                                    ``broadcast`` / ``barrier``) is BYPASSED
+                                    on this rank (peers block on the missing
+                                    participant) — the deterministic desync
+                                    drill for the flight-recorder diff;
+                                    params: optional ``op`` filter. Other
+                                    primitives change output shape under a
+                                    bypass and are not wired.
 ==================================  =========================================
 """
 from __future__ import annotations
@@ -73,6 +87,8 @@ POINTS = frozenset({
     "serving.tick_stall",
     "serving.admission_oom",
     "serving.crash_at_tick",
+    "fleet.slow_step",
+    "collective.desync",
 })
 
 _lock = threading.Lock()

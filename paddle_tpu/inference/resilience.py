@@ -25,6 +25,7 @@ once; collection is gated by ``FLAGS_enable_metrics`` as everywhere else.
 """
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from dataclasses import dataclass, field
@@ -160,6 +161,10 @@ _ADMITTING = frozenset({ReplicaState.STARTING, ReplicaState.WARMING,
                         ReplicaState.READY, ReplicaState.DEGRADED})
 
 
+#: default replica-name ordinals (stable within one process)
+_REPLICA_COUNTER = itertools.count(0)
+
+
 class ReplicaLifecycle:
     """Validated replica state machine + probes.
 
@@ -168,12 +173,35 @@ class ReplicaLifecycle:
     silently resurrects from ``STOPPED`` is a routing bug.
     """
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic):
+    def __init__(self, clock: Callable[[], float] = time.monotonic,
+                 name: Optional[str] = None):
         self._clock = clock
         self._lock = threading.Lock()
         self.state = ReplicaState.STARTING
+        #: stable per-replica metric label — several engines in one
+        #: process (multi-replica serving) must not clobber each
+        #: other's probe gauges
+        self.name = name if name is not None else \
+            f"replica{next(_REPLICA_COUNTER)}"
         self.history: List[Tuple[float, str, str]] = []  # (t, state, why)
+        self._export_state()
+
+    def _export_state(self, prev: Optional[str] = None):
+        """Metrics on every transition: state ordinal + the probe
+        results (what /readyz and /livez would answer right now) + a
+        labeled transition counter, so a router/dashboard can follow a
+        replica without polling health() — and so ``fleet.snapshot()``
+        carries it per rank. The probe gauges are labeled per replica;
+        the (pre-existing) state ordinal gauge stays unlabeled,
+        last-writer-wins, for dashboard back-compat."""
         M_REPLICA_STATE.set(ReplicaState.ORDER.index(self.state))
+        M_REPLICA_READY.set(1.0 if self.state == ReplicaState.READY
+                            else 0.0, replica=self.name)
+        M_REPLICA_LIVE.set(0.0 if self.state == ReplicaState.STOPPED
+                           else 1.0, replica=self.name)
+        if prev is not None:
+            M_REPLICA_TRANSITIONS.inc(from_state=prev,
+                                      to_state=self.state)
 
     def to(self, state: str, reason: str = "") -> str:
         with self._lock:
@@ -183,9 +211,10 @@ class ReplicaLifecycle:
                 raise RuntimeError(
                     f"invalid replica transition {self.state} -> {state}"
                     + (f" ({reason})" if reason else ""))
+            prev = self.state
             self.state = state
             self.history.append((self._clock(), state, reason))
-            M_REPLICA_STATE.set(ReplicaState.ORDER.index(state))
+            self._export_state(prev)
             return state
 
     # ------------------------------------------------------------- probes
@@ -205,11 +234,11 @@ class ReplicaLifecycle:
         the watchdog path must never raise from its poll thread."""
         with self._lock:
             if ReplicaState.DEGRADED in _ALLOWED_TRANSITIONS[self.state]:
+                prev = self.state
                 self.state = ReplicaState.DEGRADED
                 self.history.append(
                     (self._clock(), ReplicaState.DEGRADED, reason))
-                M_REPLICA_STATE.set(
-                    ReplicaState.ORDER.index(ReplicaState.DEGRADED))
+                self._export_state(prev)
 
 
 # --------------------------------------------------------------------------
@@ -261,3 +290,16 @@ M_REPLICA_STATE = _metrics.gauge(
     "paddle_tpu_serving_replica_state",
     "Replica lifecycle state ordinal: 0=STARTING 1=WARMING 2=READY "
     "3=DEGRADED 4=DRAINING 5=STOPPED.")
+M_REPLICA_READY = _metrics.gauge(
+    "paddle_tpu_serving_replica_ready",
+    "Readiness probe as a metric (1 = route new traffic here), updated "
+    "on every lifecycle transition, per replica.",
+    labelnames=("replica",))
+M_REPLICA_LIVE = _metrics.gauge(
+    "paddle_tpu_serving_replica_live",
+    "Liveness probe as a metric (0 = STOPPED), updated on every "
+    "lifecycle transition, per replica.", labelnames=("replica",))
+M_REPLICA_TRANSITIONS = _metrics.counter(
+    "paddle_tpu_serving_replica_transitions_total",
+    "Replica lifecycle transitions, by (from_state, to_state).",
+    labelnames=("from_state", "to_state"))

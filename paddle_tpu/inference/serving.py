@@ -406,6 +406,11 @@ class PagedEngine:
         from ..observability.perf import memory as _perf_memory
         _perf_memory.register_object("kv_cache", self,
                                      lambda e: (e.kc, e.vc))
+        # fleet telemetry: this replica's health() rides every
+        # fleet.snapshot(), so a multi-replica router polls one endpoint
+        # per rank (weakly held — a dropped engine unregisters itself)
+        from ..observability import fleet as _fleet
+        _fleet.register_replica(self)
 
     # ---------------------------------------------------------------- API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,

@@ -23,12 +23,16 @@ from __future__ import annotations
 import os
 
 from . import metrics, trace
+from . import flight  # noqa: F401  (registers the flight-record exit dump)
 from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
                       enabled, render_prometheus)
 
-__all__ = ["metrics", "trace", "REGISTRY", "MetricsRegistry", "Counter",
-           "Gauge", "Histogram", "enabled", "render_prometheus",
+__all__ = ["metrics", "trace", "flight", "REGISTRY", "MetricsRegistry",
+           "Counter", "Gauge", "Histogram", "enabled", "render_prometheus",
            "device_live_bytes", "snapshot", "to_prometheus"]
+
+# .fleet (cross-rank plane) stays a plain submodule — it pulls in the
+# distributed collective layer, which must not load at package import.
 
 snapshot = REGISTRY.snapshot
 to_prometheus = REGISTRY.to_prometheus

@@ -3,11 +3,16 @@
     python -m paddle_tpu.observability                  # live registry, prom
     python -m paddle_tpu.observability --format json
     python -m paddle_tpu.observability --input /tmp/metrics.json
+    python -m paddle_tpu.observability --merge /tmp/metrics.json
 
 Without ``--input`` the snapshot is of THIS process's registry (mostly the
 callback gauges, e.g. device memory, unless run embedded); with ``--input``
 it renders a snapshot written by ``PADDLE_TPU_METRICS_DUMP=/path`` from an
-instrumented run. Exit status 0 unless the input file is unreadable.
+instrumented run. ``--merge BASE`` folds BASE plus every per-process
+sibling (``BASE.rankN`` from distributed ranks, ``BASE.pidN`` from
+dataloader workers) into one aggregate whose series carry a leading
+``rank`` label — the multi-process dump files stop being orphans. Exit
+status 0 unless the input file(s) are unreadable.
 """
 from __future__ import annotations
 
@@ -24,9 +29,22 @@ def main(argv=None) -> int:
                     help="output format (default: Prometheus text)")
     ap.add_argument("--input", help="render a saved JSON snapshot file "
                     "instead of this process's registry")
+    ap.add_argument("--merge", metavar="BASE",
+                    help="fold BASE + BASE.rankN/.pidN snapshot files "
+                    "into one rank-labeled aggregate and render it")
+    ap.add_argument("--output", help="write the rendered output to a "
+                    "file instead of stdout")
     args = ap.parse_args(argv)
 
-    if args.input:
+    if args.merge:
+        from .fleet import merge_snapshot_files
+        try:
+            snap = merge_snapshot_files(args.merge)
+        except (OSError, ValueError) as e:
+            print(f"cannot merge snapshots at {args.merge!r}: {e}",
+                  file=sys.stderr)
+            return 1
+    elif args.input:
         try:
             with open(args.input) as f:
                 snap = json.load(f)
@@ -45,10 +63,15 @@ def main(argv=None) -> int:
         snap = REGISTRY.snapshot()
 
     if args.format == "json":
-        print(json.dumps(snap, indent=1, sort_keys=True))
+        text = json.dumps(snap, indent=1, sort_keys=True) + "\n"
     else:
         from .metrics import render_prometheus
-        sys.stdout.write(render_prometheus(snap))
+        text = render_prometheus(snap)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
     return 0
 
 

@@ -80,16 +80,36 @@ _CAT_TID_BASE = {"user": 0, "dispatch": 100, "compile": 200,
                  "collective": 300, "autotune": 400}
 
 
+def _trace_rank() -> Optional[int]:
+    """This process's trainer rank — read from the launcher env, not
+    the jax backend. None when not launched distributed (rank 0 of a
+    real launch still reports 0, so its trace filename stays globbable
+    alongside its peers')."""
+    from ..observability.flight import env_rank
+    return env_rank()
+
+
 def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
     """on_trace_ready callback writing ONE merged chrome trace: user
     RecordEvent ranges + every span the observability tracer collected
     while recording (dispatch ops, to_static/SOT compiles, collectives,
     autotune probes). The jax device trace (perfetto) lands in the same
-    dir."""
+    dir.
+
+    Distributed runs: the default filename carries the trainer rank
+    (``worker_r1_host_ops.json``) and, when ``fleet.clock_sync`` has run
+    in this process, a ``clock_sync`` metadata event embeds the rank's
+    perf_counter offset vs rank 0 — ``tools/fleet_trace.py`` reads it to
+    merge every rank's file onto one aligned timeline."""
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
+        rank = _trace_rank()
+        # distributed launches (rank 0 included) get worker_rN so ONE
+        # worker_r*_host_ops.json glob collects the whole fleet
+        default_name = "worker" if rank is None else f"worker_r{rank}"
+        rank = rank or 0
         fname = os.path.join(
-            dir_name, f"{worker_name or 'worker'}_host_ops.json")
+            dir_name, f"{worker_name or default_name}_host_ops.json")
         events = []
         for name, t0, t1 in prof._events:
             events.append({"name": name, "cat": "user", "ph": "X",
@@ -106,7 +126,27 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
             events.append(ev)
         events.sort(key=lambda e: (e["ts"], e["tid"]))
         meta = [{"name": "process_name", "ph": "M", "pid": 0,
-                 "args": {"name": "paddle_tpu host"}}]
+                 "args": {"name": "paddle_tpu host"
+                          + (f" (rank {rank})" if rank else "")}}]
+        try:
+            from ..observability import fleet as _fleet
+            cs = _fleet.clock_state()
+        except Exception:
+            cs = None
+        if cs is not None:
+            # self-describing alignment: the merger needs no side file
+            meta.append({"name": "clock_sync", "ph": "M", "pid": 0,
+                         "args": {
+                             "rank": rank, "world": cs.get("world"),
+                             "offset_vs_rank0_s":
+                                 cs["offsets"].get(rank, 0.0),
+                             "skew_bound_s": cs.get("skew_bound_s"),
+                             "synced_at_perf_counter":
+                                 cs.get("synced_at_perf_counter")}})
+        else:
+            meta.append({"name": "clock_sync", "ph": "M", "pid": 0,
+                         "args": {"rank": rank,
+                                  "offset_vs_rank0_s": None}})
         if prof._spans_dropped:
             # truncation marker: the buffer overflowed, the timeline is
             # incomplete — tooling must not read it as full coverage

@@ -194,7 +194,7 @@ def Print(input, first_n=-1, message=None, summarize=20,
     tensor through."""
     t = as_tensor(input)
     head = message or ""
-    vals = np.asarray(t.numpy()).reshape(-1)[:summarize]
+    vals = np.asarray(t.numpy()).reshape(-1)[:summarize]  # tpulint: disable=TPU101 — Print IS the host boundary: materializing values to render them is the op's contract
     print(f"{head} {t.name if print_tensor_name else ''} "
           f"shape={list(t.shape) if print_tensor_shape else ''} "
           f"values={vals}")
@@ -225,31 +225,59 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
     return _acc(input, label, k=k)
 
 
+def _auc_in_graph(pred, lab, num_thresholds: int):
+    """Batch ROC AUC as device ops (the thresholded-bin math of
+    metric.Auc.update/accumulate, shapes static in num_thresholds) —
+    stays async, traceable under to_static."""
+    import jax.numpy as jnp
+    if pred.ndim == 2 and pred.shape[1] == 2:      # (N, 2) proba layout
+        pred = pred[:, 1]
+    pred = pred.reshape(-1).astype(jnp.float32)
+    # label TRUTHINESS, not value: the accumulator's labels.astype(bool)
+    # counts each sample once whatever positive encoding it uses
+    posf = (lab.reshape(-1) != 0).astype(jnp.float32)
+    bins = jnp.clip((pred * num_thresholds).astype(jnp.int32), 0,
+                    num_thresholds)
+    stat_pos = jnp.zeros(num_thresholds + 1,
+                         jnp.float32).at[bins].add(posf)
+    stat_neg = jnp.zeros(num_thresholds + 1,
+                         jnp.float32).at[bins].add(1.0 - posf)
+    tot_pos = stat_pos.sum()
+    tot_neg = stat_neg.sum()
+    # integrate TPR over FPR from the highest threshold down, anchored
+    # at the (0, 0) origin (same curve metric.Auc.accumulate walks)
+    pos = jnp.concatenate([jnp.zeros(1), jnp.cumsum(stat_pos[::-1])])
+    neg = jnp.concatenate([jnp.zeros(1), jnp.cumsum(stat_neg[::-1])])
+    tpr = pos / jnp.maximum(tot_pos, 1.0)
+    fpr = neg / jnp.maximum(tot_neg, 1.0)
+    area = jnp.sum((fpr[1:] - fpr[:-1]) * (tpr[1:] + tpr[:-1]) * 0.5)
+    # degenerate batches (single-class) score 0.0, as the accumulator does
+    return jnp.where((tot_pos > 0) & (tot_neg > 0), area, 0.0)
+
+
 def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
         slide_steps=1, ins_tag_weight=None):
     """Batch AUC (reference static.auc returns (auc, batch_auc, state);
-    here the stateless batch value twice + empty state tuple)."""
-    from ..metric import Auc
-    m = Auc(num_thresholds=num_thresholds)
-    pred = np.asarray(as_tensor(input).numpy())
-    lab = np.asarray(as_tensor(label).numpy())
-    m.update(pred, lab)
-    val = as_tensor(np.float32(m.accumulate()))
+    here the stateless batch value twice + empty state tuple). Computed
+    in-graph — no host materialization of predictions/labels."""
+    val = Tensor(_auc_in_graph(as_tensor(input)._data,
+                               as_tensor(label)._data, num_thresholds))
     return val, val, ()
 
 
 def ctr_metric_bundle(input, label, ins_tag_weight=None):
     """CTR metric bundle (reference ctr_metric_bundle): (auc, sqrerr,
-    abserr, prob, q, pos, total)."""
-    pred = np.asarray(as_tensor(input).numpy()).reshape(-1)
-    lab = np.asarray(as_tensor(label).numpy()).reshape(-1)
+    abserr, prob, q, pos, total) — all reductions in-graph."""
+    import jax.numpy as jnp
+    pred = as_tensor(input)._data.reshape(-1).astype(jnp.float32)
+    lab = as_tensor(label)._data.reshape(-1).astype(jnp.float32)
     a, _, _ = auc(input, label)
-    sqrerr = as_tensor(np.float32(((pred - lab) ** 2).sum()))
-    abserr = as_tensor(np.float32(np.abs(pred - lab).sum()))
-    prob = as_tensor(np.float32(pred.sum()))
-    q = as_tensor(np.float32(pred.sum()))
-    pos = as_tensor(np.float32(lab.sum()))
-    total = as_tensor(np.float32(lab.size))
+    sqrerr = Tensor(jnp.sum((pred - lab) ** 2))
+    abserr = Tensor(jnp.sum(jnp.abs(pred - lab)))
+    prob = Tensor(jnp.sum(pred))
+    q = Tensor(jnp.sum(pred))
+    pos = Tensor(jnp.sum(lab))
+    total = Tensor(jnp.asarray(float(pred.shape[0]), jnp.float32))
     return a, sqrerr, abserr, prob, q, pos, total
 
 

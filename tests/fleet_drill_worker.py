@@ -1,0 +1,128 @@
+"""Fleet-observability drill worker — the real 4-process proof.
+
+Runs under ``python -m paddle_tpu.distributed.launch`` like
+dist_train_worker.py. Two deterministic fault drills in one job
+(reference analogue: the comm task manager's stuck-rank report,
+paddle/phi/core/distributed/comm_task_manager.cc):
+
+Phase 1 — straggler: every rank runs the same small jitted step under
+the fleet beacon (window from ``PADDLE_TPU_BEACON_WINDOW``, the harness
+sets 2); ``DRILL_TARGET_RANK`` arms the ``fleet.slow_step`` fault point,
+so that rank sleeps inside every step. The beacon's cross-rank gather
+must name the target rank as the straggler within 2 windows — each rank
+writes its verdict (plus a cross-rank ``fleet.snapshot()`` and the
+``clock_sync`` offsets) to ``drill.r<rank>.json`` for the harness.
+
+Phase 2 — collective desync: after a sync barrier, the target rank arms
+``collective.desync`` and every rank issues one more barrier inside a 3s
+watchdog. The target BYPASSES it — its flight entry completes instantly
+while the peers block *inside* theirs (the barrier synchronizes, so the
+pending ring entry is real evidence) — and then parks without issuing
+another collective (issuing one would shift the transport's collective
+matching and produce undefined cross-rank behavior; a desynced rank
+going quiet is also the realistic failure). Every rank's watchdog fires,
+persists its flight-recorder ring to ``PADDLE_TPU_FLIGHT_RECORD``
+(rank-suffixed), diffs the tails out-of-band through the filesystem, and
+prints the verdict naming the desynced rank + sequence number — then
+aborts. The harness asserts the job died, the per-rank flight files
+exist, and the printed diff names the right rank.
+
+Usage: fleet_drill_worker.py <outdir>
+"""
+import json
+import os
+import signal
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+OUTDIR = sys.argv[1]
+TARGET = int(os.environ.get("DRILL_TARGET_RANK", "2"))
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.communication import collective as C  # noqa: E402
+from paddle_tpu.distributed.watchdog import Watchdog  # noqa: E402
+from paddle_tpu.fault import inject  # noqa: E402
+from paddle_tpu.observability import fleet, flight  # noqa: E402
+
+dist.init_parallel_env()
+rank = jax.process_index()
+world = jax.process_count()
+assert world == 4, f"drill expects 4 processes, got {world}"
+
+# SIGTERM (the launcher tearing the group down after the first abort)
+# must still leave this rank's flight record behind — production
+# behavior for any drain path, and it keeps the drill deterministic.
+signal.signal(signal.SIGTERM,
+              lambda *_: (flight.dump(reason="sigterm"), os._exit(1)))
+
+# cross-process clock handshake first: offsets ride the snapshot and
+# every later chrome-trace export
+clock = fleet.clock_sync(rounds=3)
+
+# ---------------------------------------------------------------- phase 1
+if rank == TARGET:
+    inject.arm("fleet.slow_step", times=10 ** 6, seconds=0.06)
+
+import jax.numpy as jnp  # noqa: E402
+
+w = jnp.asarray(np.random.RandomState(0).randn(64, 64), jnp.float32)
+step = jax.jit(lambda x: jnp.tanh(x @ w))
+bcn = fleet.beacon()
+x = jnp.ones((8, 64), jnp.float32)
+for _ in range(3 * bcn.window):
+    bcn.step_begin()
+    jax.block_until_ready(step(x))
+    bcn.step_end()
+inject.disarm_all()
+
+report = bcn.last_report
+assert report is not None, "beacon never flushed"
+
+# cross-rank aggregation: every rank receives every rank's local
+# snapshot (REAL per-rank payloads — distinct pids prove the object
+# gather is not the in-process replicate path)
+snap = fleet.snapshot(trace_tail=20)
+
+with open(os.path.join(OUTDIR, f"drill.r{rank}.json"), "w") as f:
+    json.dump({
+        "rank": rank,
+        "slowest_rank": report["slowest_rank"],
+        "slowest_score": report["slowest_score"],
+        "dominant_bucket": report["dominant_bucket"],
+        "first_flagged_window": bcn.first_flagged_window,
+        "windows": bcn.windows,
+        "snapshot_world": snap["world"],
+        "snapshot_ranks": [r["rank"] for r in snap["ranks"]],
+        "snapshot_pids": [r["pid"] for r in snap["ranks"]],
+        "clock_world": clock["world"],
+        "clock_offsets": {str(k): v
+                          for k, v in clock["offsets"].items()},
+    }, f)
+print(f"[drill] rank {rank} phase 1 done: straggler="
+      f"{report['slowest_rank']} score={report['slowest_score']:.2f} "
+      f"window={bcn.first_flagged_window}", flush=True)
+
+# ---------------------------------------------------------------- phase 2
+import time  # noqa: E402
+
+C.barrier()          # phase-1 result files are complete on every rank
+
+wd = Watchdog(timeout=3.0, poll_interval=0.5, abort_on_hang=True).start()
+if rank == TARGET:
+    inject.arm("collective.desync", times=1, op="barrier")
+
+wd.begin_work()
+C.barrier()          # target bypasses (flight entry done in µs);
+#                      peers block INSIDE (entry left pending)
+time.sleep(3600)     # only the target gets here — it parks, desynced,
+#                      until its watchdog names it and aborts
+# unreachable: every rank hangs above until its watchdog aborts the
+# process — reaching this line means the drill failed to produce a hang
+wd.end_work()
+print(f"[drill] rank {rank} ERROR: desync did not hang", flush=True)
+sys.exit(7)

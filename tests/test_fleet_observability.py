@@ -1,0 +1,631 @@
+"""Fleet-observability tests: collective flight recorder (+ cross-rank
+diff verdicts), clock-offset handshake, straggler beacon + skew stats,
+cross-rank snapshot aggregation, metrics-dump merging, fleet trace
+merging, and the serving lifecycle metric exports.
+
+The real 4-process drills (straggler flagged, desync named by
+rank+sequence, flight files per rank) live in
+tests/test_multiproc_train.py::test_fleet_observability_drill; this file
+covers the in-process contracts those drills ride on.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fault import inject
+from paddle_tpu.observability import REGISTRY, fleet, flight, trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fleet_hygiene():
+    paddle.set_flags({"FLAGS_enable_metrics": False,
+                      "FLAGS_flight_recorder": True,
+                      "FLAGS_fleet_beacon": True})
+    REGISTRY.reset()
+    trace.deactivate()
+    trace.clear()
+    flight.RECORDER.clear()
+    fleet.reset_beacon()
+    inject.disarm_all()
+    yield
+    paddle.set_flags({"FLAGS_enable_metrics": False,
+                      "FLAGS_flight_recorder": True,
+                      "FLAGS_fleet_beacon": True})
+    REGISTRY.reset()
+    trace.deactivate()
+    trace.clear()
+    flight.RECORDER.clear()
+    fleet.reset_beacon()
+    inject.disarm_all()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_seq_monotonic_per_group(self):
+        r = flight.FlightRecorder()
+        a = r.begin(0, "all_reduce", (4,), "float32", 16)
+        b = r.begin(0, "barrier", (), "float32", 4)
+        c = r.begin(7, "all_gather", (2,), "float32", 8)
+        assert (a["seq"], b["seq"]) == (0, 1)
+        assert c["seq"] == 0          # independent per-group sequence
+        assert b["t1"] is None
+        r.end(b)
+        assert b["t1"] is not None
+
+    def test_ring_bounded(self):
+        r = flight.FlightRecorder(capacity=8)
+        for i in range(20):
+            r.end(r.begin(0, "op", (1,), "f", 1))
+        tail = r.tail()
+        assert len(tail) == 8
+        assert [e["seq"] for e in tail] == list(range(12, 20))
+
+    def test_collectives_stamp_the_ring(self):
+        from paddle_tpu.distributed.communication import collective as C
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        C.all_reduce(t)
+        C.barrier()
+        tail = flight.RECORDER.tail()
+        assert [e["op"] for e in tail] == ["all_reduce", "barrier"]
+        assert [e["seq"] for e in tail] == [0, 1]
+        assert tail[0]["shape"] == [4] and tail[0]["bytes"] == 16
+        assert tail[0]["dtype"] == "float32"
+        assert all(e["t1"] is not None for e in tail)
+
+    def test_flag_disables_recording(self):
+        from paddle_tpu.distributed.communication import collective as C
+        paddle.set_flags({"FLAGS_flight_recorder": False})
+        C.all_reduce(paddle.to_tensor(np.ones(2, np.float32)))
+        assert flight.RECORDER.tail() == []
+
+    def test_desync_bypass_marks_entry_and_skips_device_op(self):
+        from paddle_tpu.distributed.communication import collective as C
+        t = paddle.to_tensor(np.asarray([3.0], np.float32))
+        with inject.armed("collective.desync", op="all_reduce"):
+            C.all_reduce(t)
+        e = flight.RECORDER.tail(1)[0]
+        assert e["op"] == "all_reduce" and e.get("bypassed") is True
+        # armed op filter: a barrier passes through untouched
+        with inject.armed("collective.desync", op="all_reduce"):
+            C.barrier()
+        assert flight.RECORDER.tail(1)[0].get("bypassed") is None
+
+    def test_raised_collective_closes_entry(self):
+        # a collective that RAISES must not leave a pending (t1=None)
+        # entry — that would poison every later hang diff with a stale
+        # 'blocked at seq N' verdict for this rank
+        from paddle_tpu.distributed.communication import collective as C
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        with pytest.raises(ValueError):
+            C.all_reduce(t, op="not-a-reduce-op")
+        e = flight.RECORDER.tail(1)[0]
+        assert e["op"] == "all_reduce"
+        assert e["t1"] is not None
+        assert e["raised"] == "ValueError"
+
+    def test_dump_and_load_roundtrip(self, tmp_path):
+        base = str(tmp_path / "flight.json")
+        flight.RECORDER.end(
+            flight.RECORDER.begin(0, "all_reduce", (4,), "float32", 16))
+        path = flight.dump(path=flight.record_path(base, rank=0),
+                           reason="test")
+        assert path.endswith(".r0") and os.path.exists(path)
+        dumps = flight.load_dumps(base, world=1)
+        assert dumps[0]["reason"] == "test"
+        assert dumps[0]["entries"][0]["op"] == "all_reduce"
+
+    def test_dump_without_env_is_noop(self):
+        os.environ.pop(flight.RECORD_ENV, None)
+        assert flight.dump() is None
+
+
+def _entry(seq, op="barrier", shape=(), dtype="float32", t1=1.0,
+           group=0):
+    return {"seq": seq, "group": group, "op": op, "shape": list(shape),
+            "dtype": dtype, "bytes": 4, "t0": 0.5, "t1": t1}
+
+
+def _dump(entries, rank=0, world=4):
+    return {"rank": rank, "world": world, "entries": entries}
+
+
+class TestDiffRanks:
+    def test_agreeing_tails_are_ok(self):
+        dumps = {r: _dump([_entry(0), _entry(1)]) for r in range(4)}
+        assert flight.diff_ranks(dumps)["status"] == "ok"
+
+    def test_stall_names_the_rank_that_never_issued(self):
+        # ranks 0,1,3 blocked inside seq 1; rank 2 never issued it
+        dumps = {r: _dump([_entry(0), _entry(1, t1=None)])
+                 for r in (0, 1, 3)}
+        dumps[2] = _dump([_entry(0)])
+        v = flight.diff_ranks(dumps)
+        assert v["status"] == "stall" and v["rank"] == 2 \
+            and v["seq"] == 1
+        assert "rank 2" in v["detail"]
+
+    def test_desync_names_the_rank_that_raced_ahead(self):
+        # rank 2 completed seq 1 (bypass) while peers are blocked in it
+        dumps = {r: _dump([_entry(0), _entry(1, t1=None)])
+                 for r in (0, 1, 3)}
+        dumps[2] = _dump([_entry(0), _entry(1)])
+        v = flight.diff_ranks(dumps)
+        assert v["status"] == "desync" and v["rank"] == 2 \
+            and v["seq"] == 1
+
+    def test_desync_rank_blocked_further_ahead(self):
+        # rank 2 bypassed seq 1 and is now blocked inside seq 2: the
+        # verdict must still name rank 2, not call its peers absent
+        dumps = {r: _dump([_entry(0), _entry(1, t1=None)])
+                 for r in (0, 1, 3)}
+        dumps[2] = _dump([_entry(0), _entry(1),
+                          _entry(2, op="all_reduce", t1=None)])
+        v = flight.diff_ranks(dumps)
+        assert v["status"] == "desync" and v["rank"] == 2 \
+            and v["seq"] == 1
+
+    def test_content_mismatch_named_by_rank_and_seq(self):
+        dumps = {r: _dump([_entry(0, op="all_reduce", shape=(8,))])
+                 for r in (0, 1, 3)}
+        dumps[2] = _dump([_entry(0, op="all_gather", shape=(4,))])
+        v = flight.diff_ranks(dumps)
+        assert v["status"] == "desync" and v["rank"] == 2 \
+            and v["seq"] == 0
+        assert "all_gather" in v["detail"]
+
+    def test_all_blocked_is_transport_stall(self):
+        dumps = {r: _dump([_entry(0), _entry(1, t1=None)])
+                 for r in range(4)}
+        v = flight.diff_ranks(dumps)
+        assert v["status"] == "stall" and v["rank"] is None
+
+    def test_empty(self):
+        assert flight.diff_ranks({})["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# clock sync
+# ---------------------------------------------------------------------------
+class TestClockSync:
+    def test_single_process_offsets(self):
+        st = fleet.clock_sync(rounds=3)
+        assert st["world"] == 1 and st["offsets"] == {0: 0.0}
+        assert st["skew_bound_s"] == 0.0
+        assert fleet.clock_state() is st
+
+    def test_offset_gauge_exported(self):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        fleet.clock_sync(rounds=2)
+        g = REGISTRY.get("paddle_tpu_fleet_clock_offset_seconds")
+        assert g is not None and g.value(rank="0") == 0.0
+
+
+# ---------------------------------------------------------------------------
+# straggler beacon
+# ---------------------------------------------------------------------------
+class TestSkewStats:
+    def test_names_slowest_rank_and_bucket(self):
+        m = [[0, 4, 0.010, 0.011, 0.6, 0.2, 0.1, 0.1],
+             [1, 4, 0.010, 0.012, 0.6, 0.2, 0.1, 0.1],
+             [2, 4, 0.031, 0.033, 0.1, 0.7, 0.1, 0.1],
+             [3, 4, 0.010, 0.011, 0.6, 0.2, 0.1, 0.1]]
+        s = fleet.skew_stats(m, threshold=0.2)
+        assert s["slowest_rank"] == 2 and s["is_straggler"]
+        assert s["dominant_bucket"] == "collective"
+        assert s["median_step_s"] == pytest.approx(0.010)
+        assert s["slowest_score"] == pytest.approx(2.1)
+        assert s["scores"][0] == pytest.approx(0.0)
+
+    def test_balanced_fleet_is_not_flagged(self):
+        m = [[r, 4, 0.010 + r * 1e-4, 0.011, 0.5, 0.2, 0.2, 0.1]
+             for r in range(4)]
+        s = fleet.skew_stats(m, threshold=0.2)
+        assert not s["is_straggler"]
+        assert s["skew"] < 0.05
+
+    def test_accepts_ndarray(self):
+        m = np.asarray([[0, 2, 0.01, 0.01, 1, 0, 0, 0]])
+        assert fleet.skew_stats(m)["slowest_rank"] == 0
+
+
+class TestBeacon:
+    def test_windows_flush_and_report(self):
+        b = fleet.FleetBeacon(window=3)
+        for _ in range(7):
+            b.step_begin()
+            b.step_end()
+        assert b.windows == 2
+        r = b.last_report
+        assert r["slowest_rank"] == 0 and r["window"] == 2
+        assert len(r["per_rank"]) == 1
+        assert r["per_rank"][0][1] == 3.0      # steps per window
+
+    def test_probe_attribution_covers_collectives(self):
+        from paddle_tpu.distributed.communication import collective as C
+        b = fleet.FleetBeacon(window=2)
+        t = paddle.to_tensor(np.ones(4, np.float32))
+        for _ in range(2):
+            b.step_begin()
+            C.all_reduce(t)
+            b.step_end()
+        row = b.last_report["per_rank"][0]
+        fracs = row[4:8]
+        assert sum(fracs) == pytest.approx(1.0, abs=1e-6)
+        assert fracs[1] > 0.0                  # collective share seen
+        assert not trace.active()              # probe trace released
+
+    def test_tick_style(self):
+        b = fleet.FleetBeacon(window=2)
+        for _ in range(5):
+            b.tick()
+            time.sleep(0.001)
+        assert b.windows == 2
+        assert b.last_report["median_step_s"] > 0
+
+    def test_disabled_flag_short_circuits(self):
+        paddle.set_flags({"FLAGS_fleet_beacon": False})
+        b = fleet.FleetBeacon(window=2)
+        for _ in range(6):
+            b.step_begin()
+            b.step_end()
+        assert b.windows == 0 and b.last_report is None
+
+    def test_slow_step_drill_inflates_step_time(self):
+        b = fleet.FleetBeacon(window=2)
+        with inject.armed("fleet.slow_step", times=100, seconds=0.02):
+            for _ in range(2):
+                b.step_begin()
+                b.step_end()
+        assert b.last_report["median_step_s"] > 0.015
+
+    def test_metrics_exported_per_window(self):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        b = fleet.FleetBeacon(window=2)
+        for _ in range(2):
+            b.step_begin()
+            b.step_end()
+        assert REGISTRY.get(
+            "paddle_tpu_fleet_beacon_windows_total").total() == 1
+        assert REGISTRY.get(
+            "paddle_tpu_fleet_straggler_score").value(rank="0") == 0.0
+        assert REGISTRY.get(
+            "paddle_tpu_fleet_slowest_rank").value() == 0.0
+
+    def test_respects_external_trace_session(self):
+        # a profiler owns the buffer: the beacon must read without
+        # draining and must not deactivate the session
+        trace.clear()
+        trace.activate()
+        b = fleet.FleetBeacon(window=2)
+        for _ in range(2):
+            b.step_begin()
+            b.step_end()
+        assert trace.active()
+        trace.deactivate()
+
+    def test_engine_fit_feeds_the_beacon(self):
+        from paddle_tpu import nn, optimizer
+        from paddle_tpu.distributed.auto_parallel.engine import Engine
+
+        b = fleet.reset_beacon(window=2)
+        model = nn.Linear(4, 4)
+        eng = Engine(model, loss=lambda o, y: paddle.ops.mean((o - y) ** 2),
+                     optimizer=optimizer.AdamW(
+                         learning_rate=1e-2,
+                         parameters=model.parameters()))
+        xs = np.random.randn(32, 4).astype(np.float32)
+        data = [(xs[i], xs[i]) for i in range(32)]
+        eng.fit(data, epochs=1, batch_size=8)
+        assert b.windows >= 2
+        assert b.last_report["slowest_rank"] == 0
+
+
+# ---------------------------------------------------------------------------
+# cross-rank snapshot + replica registry
+# ---------------------------------------------------------------------------
+class TestSnapshot:
+    def test_single_process_snapshot_shape(self):
+        snap = fleet.snapshot(trace_tail=10)
+        assert snap["world"] == 1 and snap["rank"] == 0
+        local = snap["ranks"][0]
+        for key in ("metrics", "spans", "flight", "beacon", "replicas",
+                    "clock", "pid", "host"):
+            assert key in local
+        json.dumps(snap, default=str)          # JSON-able end to end
+
+    def test_registered_replica_health_rides_snapshot(self):
+        class FakeReplica:
+            def health(self):
+                return {"state": "READY", "ready": True}
+
+        rep = FakeReplica()
+        fleet.register_replica(rep)
+        try:
+            snap = fleet.snapshot(trace_tail=0)
+            assert {"state": "READY", "ready": True} \
+                in snap["ranks"][0]["replicas"]
+        finally:
+            fleet._replicas.discard(rep)
+
+    def test_dump_writes_rank0_file(self, tmp_path):
+        path = fleet.dump(str(tmp_path / "fleet.json"))
+        with open(path) as f:
+            snap = json.load(f)
+        assert snap["format"] == "paddle_tpu.fleet_snapshot/1"
+
+    def test_paged_engine_registers_itself(self):
+        pytest.importorskip("paddle_tpu.inference.serving")
+        from paddle_tpu.inference import serving as sv
+        if not hasattr(sv, "PagedEngine"):
+            pytest.skip("no PagedEngine")
+        # registration is exercised end-to-end in test_serving*; here
+        # just assert the hook exists on the registry side
+        assert callable(fleet.register_replica)
+
+
+# ---------------------------------------------------------------------------
+# metrics-dump merge (tools/metrics_dump.py --merge)
+# ---------------------------------------------------------------------------
+def _snap(value, labeled=False):
+    if labeled:
+        return {"m_total": {"kind": "counter", "help": "h",
+                            "labelnames": ["op"],
+                            "series": [{"labels": ["x"],
+                                        "value": value}]}}
+    return {"m_total": {"kind": "counter", "help": "h",
+                        "labelnames": [],
+                        "series": [{"labels": [], "value": value}]}}
+
+
+class TestMergeSnapshots:
+    def test_rank_label_prepended(self):
+        merged = fleet.merge_snapshots({"0": _snap(1, labeled=True),
+                                        "1": _snap(2, labeled=True)})
+        m = merged["m_total"]
+        assert m["labelnames"] == ["rank", "op"]
+        assert {tuple(s["labels"]) for s in m["series"]} == \
+            {("0", "x"), ("1", "x")}
+
+    def test_rank_collision_uses_proc_label(self):
+        # a metric that already carries a "rank" label (the fleet
+        # gauges) must not render a duplicate label name after merging
+        snap = {"s": {"kind": "gauge", "help": "",
+                      "labelnames": ["rank"],
+                      "series": [{"labels": ["1"], "value": 0.5}]}}
+        merged = fleet.merge_snapshots({"0": snap})
+        assert merged["s"]["labelnames"] == ["proc", "rank"]
+        from paddle_tpu.observability.metrics import render_prometheus
+        assert 's{proc="0",rank="1"} 0.5' in render_prometheus(merged)
+
+    def test_merge_files_and_suffix_labels(self, tmp_path):
+        base = str(tmp_path / "metrics.json")
+        json.dump(_snap(1), open(base, "w"))
+        json.dump(_snap(2), open(base + ".rank1", "w"))
+        json.dump(_snap(3), open(base + ".pid777", "w"))
+        merged = fleet.merge_snapshot_files(base)
+        labels = sorted(s["labels"][0]
+                        for s in merged["m_total"]["series"])
+        assert labels == ["0", "1", "pid777"]
+        from paddle_tpu.observability.metrics import render_prometheus
+        text = render_prometheus(merged)
+        assert 'm_total{rank="1"} 2' in text
+
+    def test_unreadable_sibling_skipped(self, tmp_path, capsys):
+        base = str(tmp_path / "metrics.json")
+        json.dump(_snap(1), open(base, "w"))
+        open(base + ".rank1", "w").write("{truncated")
+        merged = fleet.merge_snapshot_files(base)
+        assert len(merged["m_total"]["series"]) == 1
+
+    def test_no_files_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            fleet.merge_snapshot_files(str(tmp_path / "absent.json"))
+
+    def test_cli_merge_mode(self, tmp_path):
+        from paddle_tpu.observability.__main__ import main
+        base = str(tmp_path / "metrics.json")
+        json.dump(_snap(1), open(base, "w"))
+        json.dump(_snap(2), open(base + ".rank1", "w"))
+        out = str(tmp_path / "merged.prom")
+        assert main(["--merge", base, "--output", out]) == 0
+        text = open(out).read()
+        assert 'm_total{rank="0"} 1' in text
+        assert 'm_total{rank="1"} 2' in text
+        assert main(["--merge", str(tmp_path / "nope.json")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet trace merging (tools/fleet_trace.py)
+# ---------------------------------------------------------------------------
+def _rank_trace(tmp_path, rank, offset, t0_s):
+    evs = [{"name": "clock_sync", "ph": "M", "pid": 0,
+            "args": {"rank": rank, "offset_vs_rank0_s": offset}},
+           {"name": "process_name", "ph": "M", "pid": 0,
+            "args": {"name": "paddle_tpu host"}},
+           {"name": "train_step", "cat": "step", "ph": "X", "pid": 0,
+            "tid": 0, "ts": int(t0_s * 1e6), "dur": 2000}]
+    p = str(tmp_path / f"worker_r{rank}_host_ops.json")
+    json.dump({"traceEvents": evs}, open(p, "w"))
+    return p
+
+
+class TestFleetTrace:
+    def test_merge_aligns_and_lanes(self, tmp_path):
+        sys.path.insert(0, REPO)
+        from tools.fleet_trace import main, merge_traces
+        # rank 1's clock reads 2.5s ahead: same true instant
+        p0 = _rank_trace(tmp_path, 0, 0.0, 50.0)
+        p1 = _rank_trace(tmp_path, 1, 2.5, 52.5)
+        out = str(tmp_path / "fleet.json")
+        assert main([p0, p1, "--out", out]) == 0
+        merged = json.load(open(out))
+        assert "traceEvents" in merged
+        steps = [e for e in merged["traceEvents"]
+                 if e.get("name") == "train_step"]
+        assert sorted(e["pid"] for e in steps) == [0, 1]
+        assert steps[0]["ts"] == steps[1]["ts"] == 50_000_000
+        names = {e["pid"]: e["args"]["name"]
+                 for e in merged["traceEvents"]
+                 if e.get("name") == "process_name"}
+        assert names == {0: "rank 0", 1: "rank 1"}
+        # a valid chrome trace: every non-meta event carries ph/ts
+        for e in merged["traceEvents"]:
+            assert "ph" in e
+            if e["ph"] != "M":
+                assert isinstance(e["ts"], int)
+        assert merge_traces([p0, p1])["metadata"][
+            "unaligned_ranks"] == []
+
+    def test_offsets_file_overrides(self, tmp_path):
+        from tools.fleet_trace import main
+        p0 = _rank_trace(tmp_path, 0, 0.0, 50.0)
+        p1 = _rank_trace(tmp_path, 1, 0.0, 53.0)
+        offs = str(tmp_path / "offsets.json")
+        json.dump({"0": 0.0, "1": 3.0}, open(offs, "w"))
+        out = str(tmp_path / "fleet.json")
+        assert main([p0, p1, "--out", out, "--offsets", offs]) == 0
+        merged = json.load(open(out))
+        steps = [e for e in merged["traceEvents"]
+                 if e.get("name") == "train_step"]
+        assert steps[0]["ts"] == steps[1]["ts"]
+
+    def test_missing_file_fails_cleanly(self, tmp_path):
+        from tools.fleet_trace import main
+        assert main([str(tmp_path / "nope.json"),
+                     "--out", str(tmp_path / "o.json")]) == 1
+
+    def test_profiler_export_embeds_clock_metadata(self, tmp_path):
+        # drive the export handler directly (a full profiler session
+        # would spin up the jax device tracer for ~8s of tier-1 budget;
+        # the contract under test is the metadata embedding)
+        from paddle_tpu import profiler
+
+        class _FakeProf:
+            _events = [("rng", 1.0, 1.001)]
+            _spans = [("op", "dispatch", 1.0, 1.002, 0, None)]
+            _spans_dropped = 0
+            trace_path = None
+
+        fleet.clock_sync(rounds=2)
+        prof = _FakeProf()
+        profiler.export_chrome_tracing(str(tmp_path))(prof)
+        blob = json.load(open(prof.trace_path))
+        cs = [e for e in blob["traceEvents"]
+              if e.get("name") == "clock_sync"]
+        assert cs and cs[0]["args"]["rank"] == 0
+        assert cs[0]["args"]["offset_vs_rank0_s"] == 0.0
+        assert os.path.basename(prof.trace_path) == \
+            "worker_host_ops.json"
+
+
+# ---------------------------------------------------------------------------
+# watchdog flight integration
+# ---------------------------------------------------------------------------
+class TestWatchdogFlight:
+    def test_dump_diagnostics_persists_flight_record(self, tmp_path,
+                                                     monkeypatch):
+        import io
+
+        from paddle_tpu.distributed.watchdog import Watchdog
+
+        base = str(tmp_path / "flight.json")
+        monkeypatch.setenv(flight.RECORD_ENV, base)
+        from paddle_tpu.distributed.communication import collective as C
+        C.all_reduce(paddle.to_tensor(np.ones(4, np.float32)))
+        wd = Watchdog(timeout=60.0)
+        buf = io.StringIO()
+        wd.dump_diagnostics(file=buf)
+        out = buf.getvalue()
+        assert "collective flight tail" in out
+        assert "seq=0" in out and "all_reduce" in out
+        assert os.path.exists(flight.record_path(base))
+        dumps = flight.load_dumps(base, world=1)
+        assert dumps[0]["entries"][0]["op"] == "all_reduce"
+
+    def test_dump_diagnostics_without_env(self):
+        import io
+
+        from paddle_tpu.distributed.watchdog import Watchdog
+
+        os.environ.pop(flight.RECORD_ENV, None)
+        buf = io.StringIO()
+        Watchdog(timeout=60.0).dump_diagnostics(file=buf)
+        assert "flight tail" in buf.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# serving lifecycle metric exports
+# ---------------------------------------------------------------------------
+class TestReplicaLifecycleMetrics:
+    def test_transitions_and_probes_exported(self):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        from paddle_tpu.inference.resilience import (ReplicaLifecycle,
+                                                     ReplicaState)
+
+        lc = ReplicaLifecycle(name="r0")
+        lc.to(ReplicaState.WARMING, "warmup")
+        lc.to(ReplicaState.READY, "serving")
+        tr = REGISTRY.get("paddle_tpu_serving_replica_transitions_total")
+        ready = REGISTRY.get("paddle_tpu_serving_replica_ready")
+        live = REGISTRY.get("paddle_tpu_serving_replica_live")
+        assert tr.value(from_state="STARTING", to_state="WARMING") == 1
+        assert tr.value(from_state="WARMING", to_state="READY") == 1
+        assert ready.value(replica="r0") == 1.0
+        assert live.value(replica="r0") == 1.0
+        lc.degrade("stall")
+        assert tr.value(from_state="READY", to_state="DEGRADED") == 1
+        assert ready.value(replica="r0") == 0.0
+        lc.to(ReplicaState.DRAINING)
+        lc.to(ReplicaState.STOPPED)
+        assert live.value(replica="r0") == 0.0
+
+    def test_two_replicas_do_not_clobber_probes(self):
+        """A second engine's lifecycle (STARTING) must not pull a READY
+        replica's probe gauge out of rotation — the gauges are labeled
+        per replica."""
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        from paddle_tpu.inference.resilience import (ReplicaLifecycle,
+                                                     ReplicaState)
+
+        a = ReplicaLifecycle(name="a")
+        a.to(ReplicaState.READY, "serving")
+        ready = REGISTRY.get("paddle_tpu_serving_replica_ready")
+        assert ready.value(replica="a") == 1.0
+        b = ReplicaLifecycle(name="b")       # STARTING
+        assert ready.value(replica="a") == 1.0
+        assert ready.value(replica="b") == 0.0
+        b.to(ReplicaState.STOPPED)
+        assert REGISTRY.get(
+            "paddle_tpu_serving_replica_live").value(replica="a") == 1.0
+
+
+# ---------------------------------------------------------------------------
+# stable metric names (README "Fleet observability" table)
+# ---------------------------------------------------------------------------
+class TestStableNames:
+    def test_fleet_instruments_registered(self):
+        for name in (
+                "paddle_tpu_fleet_straggler_score",
+                "paddle_tpu_fleet_slowest_rank",
+                "paddle_tpu_fleet_step_skew",
+                "paddle_tpu_fleet_beacon_windows_total",
+                "paddle_tpu_fleet_straggler_warnings_total",
+                "paddle_tpu_fleet_beacon_gather_seconds",
+                "paddle_tpu_fleet_clock_offset_seconds",
+                "paddle_tpu_serving_replica_ready",
+                "paddle_tpu_serving_replica_live",
+                "paddle_tpu_serving_replica_transitions_total"):
+            assert REGISTRY.get(name) is not None, name
+
+    def test_fault_points_registered(self):
+        assert "fleet.slow_step" in inject.POINTS
+        assert "collective.desync" in inject.POINTS

@@ -35,6 +35,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_train_worker.py")
+DRILL_WORKER = os.path.join(REPO, "tests", "fleet_drill_worker.py")
 
 
 def _clean_env():
@@ -155,6 +156,62 @@ def test_auto_spmd_multiproc_matches_baseline(baseline, strategy,
         losses, baseline, rtol=2e-4, atol=2e-4,
         err_msg=f"{strategy} (4 processes) diverged from the "
                 f"single-process baseline")
+
+
+def test_fleet_observability_drill(tmp_path):
+    """The fleet-observability acceptance drill, in the REAL 4-process
+    harness (tests/fleet_drill_worker.py): an injected slow rank is
+    flagged by the beacon (correct rank, within 2 windows) on EVERY
+    rank, cross-rank ``fleet.snapshot`` gathers genuinely distinct
+    per-rank payloads, ``clock_sync`` hands every rank the offset
+    table — then an injected collective desync hangs the job, every
+    rank's watchdog persists its flight-recorder ring, and the
+    out-of-band diff names the desynced rank + sequence number before
+    aborting."""
+    import re
+
+    port = _free_port_pair()
+    env = _clean_env()
+    flight_base = os.path.join(str(tmp_path), "flight.json")
+    env["PADDLE_TPU_FLIGHT_RECORD"] = flight_base
+    env["PADDLE_TPU_BEACON_WINDOW"] = "2"
+    env["DRILL_TARGET_RANK"] = "2"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4",
+         "--master", f"127.0.0.1:{port}", DRILL_WORKER, str(tmp_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+
+    # phase 2 hung the job on purpose; the watchdogs must have killed it
+    assert proc.returncode != 0, f"drill did not abort:\n{out}"
+
+    # phase 1: every rank flagged rank 2 within 2 beacon windows, with
+    # the dominant bucket of an un-instrumented host sleep (idle), and
+    # the cross-rank snapshot really gathered 4 distinct processes
+    for r in range(4):
+        path = os.path.join(str(tmp_path), f"drill.r{r}.json")
+        assert os.path.exists(path), f"rank {r} phase-1 missing:\n{out}"
+        with open(path) as f:
+            res = json.load(f)
+        assert res["slowest_rank"] == 2, res
+        assert res["slowest_score"] > 0.2, res
+        assert res["first_flagged_window"] is not None \
+            and res["first_flagged_window"] <= 2, res
+        assert res["dominant_bucket"] == "idle", res
+        assert sorted(res["snapshot_ranks"]) == [0, 1, 2, 3], res
+        assert len(set(res["snapshot_pids"])) == 4, res
+        assert res["clock_world"] == 4, res
+        assert sorted(res["clock_offsets"]) == ["0", "1", "2", "3"], res
+    assert "[fleet] straggler: rank 2" in out, out
+
+    # phase 2: a flight record per rank, and the watchdog diff named
+    # the desynced rank + its sequence number
+    for r in range(4):
+        assert os.path.exists(f"{flight_base}.r{r}"), \
+            f"rank {r} flight record missing:\n{out}"
+    assert re.search(r"status=desync rank=2 seq=\d+", out), out
+    assert "rank 2 moved past seq" in out, out
 
 
 @pytest.mark.slow  # ~60 s each: a virtual-mesh run PLUS a 4-process

@@ -113,6 +113,27 @@ class TestStaticTail:
             paddle.to_tensor(np.array([1.0, 0.0], np.float32)))
         assert len(bundle) == 7
 
+    def test_static_auc_matches_host_accumulator(self):
+        """The in-graph AUC (round 14 rewrite) must match metric.Auc's
+        thresholded-bin math, including non-{0,1} positive encodings
+        (the accumulator counts label TRUTHINESS, one per sample)."""
+        from paddle_tpu.metric import Auc
+
+        rng = np.random.RandomState(7)
+        pred = rng.rand(300).astype(np.float32)
+        for lab in ((rng.rand(300) > 0.4).astype(np.float32),
+                    2.0 * (rng.rand(300) > 0.6).astype(np.float32)):
+            m = Auc(num_thresholds=4095)
+            m.update(pred, lab)
+            a, _, _ = static.auc(paddle.to_tensor(pred),
+                                 paddle.to_tensor(lab))
+            np.testing.assert_allclose(float(a.numpy()),
+                                       m.accumulate(), atol=1e-5)
+        # degenerate single-class batch scores 0.0, like the accumulator
+        a, _, _ = static.auc(paddle.to_tensor(pred),
+                             paddle.to_tensor(np.ones(300, np.float32)))
+        assert float(a.numpy()) == 0.0
+
 
 class TestDistributedTail:
     def test_full_all_parity(self):

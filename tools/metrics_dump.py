@@ -5,10 +5,14 @@ Thin wrapper over ``python -m paddle_tpu.observability``:
     python tools/metrics_dump.py                       # live registry
     python tools/metrics_dump.py --format json
     python tools/metrics_dump.py --input /tmp/metrics.json
+    python tools/metrics_dump.py --merge /tmp/metrics.json
 
 Pair with ``FLAGS_enable_metrics=1 PADDLE_TPU_METRICS_DUMP=/tmp/metrics.json``
 on any training/serving run to capture a snapshot at exit, then render it
-here offline.
+here offline. Multi-process runs write one file per process
+(``.rankN`` for distributed ranks, ``.pidN`` for worker children);
+``--merge`` folds the whole set into one aggregate with a leading
+``rank`` label per series — see README "Fleet observability".
 """
 import sys
 
