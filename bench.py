@@ -177,6 +177,13 @@ def _env_bool(name, default):
     return v.strip().lower() not in ("0", "false", "no", "off", "")
 
 
+def _fusion_on() -> bool:
+    """Ladder rungs record the graph-fusion flag state in extra, so a
+    BENCH_*.json trajectory always says which regime it measured."""
+    from paddle_tpu.core import flags
+    return bool(flags.get_flag("enable_fusion"))
+
+
 def _bench_gpt(small):
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
@@ -224,6 +231,7 @@ def _bench_gpt(small):
                   "device": str(getattr(jax.devices()[0], "device_kind",
                                         jax.default_backend())),
                   "attribution": attribution,
+                  "fusion": _fusion_on(),
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -270,6 +278,7 @@ def _bench_resnet50(small):
                   "batch": batch, "mfu": round(util, 4),
                   "a100_ref_util": round(a100_util, 4),
                   "attribution": attribution,
+                  "fusion": _fusion_on(),
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -327,6 +336,7 @@ def _bench_bert(small):
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
                   "params": n_params, "attribution": attribution,
+                  "fusion": _fusion_on(),
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -376,6 +386,7 @@ def _bench_llama(small):
         "vs_baseline": round(mfu / 0.40, 4),
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
                   "params": n_params, "attribution": attribution,
+                  "fusion": _fusion_on(),
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -435,6 +446,7 @@ def _bench_llama14(small):
         "extra": {"step_time_s": round(dt, 4), "mfu": round(mfu, 4),
                   "params": n_params, "moment_dtype": moment_dtype,
                   "attribution": attribution,
+                  "fusion": _fusion_on(),
                   "loss_first": round(loss0, 3),
                   "loss_last": round(loss_end, 3)},
     }
@@ -820,6 +832,193 @@ def _bench_spmd_auto(small):
     }
 
 
+def _bench_fusion(small):
+    """Graph-fusion rung (BENCH_MODEL=fusion; paddle_tpu/compile/fusion/).
+
+    The SAME GPT transformer block — rms_norm → q/k projections →
+    rotary embedding (attention prologue), layernorm → FFN → gelu →
+    down-projection (MLP), residual add → rms_norm — measured fused vs
+    unfused in the two regimes it actually runs in:
+
+    * ``train``: the full fwd+bwd step through
+      ``to_static(full_graph=True)`` + ``jax.value_and_grad`` — with
+      ``FLAGS_enable_fusion`` on, the pass rewrites the traced program
+      (rope_proj x2 + norm_linear + residual_norm) before the single
+      XLA compile. Loss parity between the two programs gates the leg.
+    * ``eager``: the block's forward dispatched op-by-op (the
+      decode/serving regime the reference's fused_ops.yaml hot set
+      targets) — the unfused chain is 10 dispatches / 10 program
+      boundaries; the fused-op spelling is 4. Output parity gates it.
+
+    value = geomean of the two fused-vs-unfused step-time ratios;
+    vs_baseline is the same, zeroed if either parity gate fails (a
+    fast-but-wrong rewrite scores 0, not a speedup). The acceptance
+    bar in tools/perf_baseline.json is >= 1.10x.
+
+    Timing: both programs are compiled/warmed up front, then measured
+    in INTERLEAVED chunks (u,f,u,f,…) with min-of-chunk-means per leg —
+    drift inside a ladder run (allocator state, co-tenant load, turbo)
+    hits both programs equally instead of biasing whichever leg ran
+    second, and the min is the contention-free estimate a ratio wants.
+    """
+    import paddle_tpu as paddle
+    import paddle_tpu.ops as ops
+    from paddle_tpu import nn
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models import llama
+    from paddle_tpu.nn import functional as F
+
+    if small:
+        B, S, H, FF, heads, iters = 4, 128, 256, 1024, 4, 10
+    else:
+        B, S, H, FF, heads, iters = 8, 512, 1024, 4096, 16, 20
+    hd = H // heads
+    paddle.seed(0)
+    q_proj, k_proj = nn.Linear(H, H), nn.Linear(H, H)
+    ln2 = nn.LayerNorm(H)
+    fc1, fc2 = nn.Linear(H, FF), nn.Linear(FF, H)
+    layers = (q_proj, k_proj, ln2, fc1, fc2)
+    params = [p for m in layers for p in m.parameters()]
+    rng = np.random.RandomState(0)
+    # distinct inputs per timed iter (replay-caching backends fake the
+    # timing on repeat-identical executions; see _run_train_bench)
+    xs = [(rng.randn(B, S, H) * 0.5).astype(np.float32)
+          for _ in range(3)]
+
+    def block(xt):
+        # attention prologue: the input norm feeds BOTH projections
+        # (multi-consumer → stays), each projection+reshape+rope chain
+        # fuses to ONE fused_rope_proj
+        hn = F.rms_norm(xt)
+        q = llama.rotary_embedding(
+            ops.reshape(q_proj(hn), [B, S, heads, hd]))
+        k = llama.rotary_embedding(
+            ops.reshape(k_proj(hn), [B, S, heads, hd]))
+        # MLP: layernorm → linear → gelu fuses to fused_norm_linear
+        h = fc2(F.gelu(fc1(ln2(xt))))
+        # residual add + rms_norm fuses to fused_residual_norm (the sum
+        # is re-emitted, so the residual stream stays a real value)
+        s = xt + h
+        y = F.rms_norm(s)
+        return y + ops.reshape(q, [B, S, H]) + ops.reshape(k, [B, S, H])
+
+    def build_train(fused):
+        paddle.set_flags({"FLAGS_enable_fusion": fused})
+        sf = paddle.jit.to_static(block, full_graph=True)
+
+        def f(pa, xa):
+            originals = [p._data for p in params]
+            for p, a in zip(params, pa):
+                p._data = a
+            try:
+                out = sf(Tensor(xa))._data
+                return (out * out).mean()
+            finally:
+                for p, o in zip(params, originals):
+                    p._data = o
+
+        g = jax.jit(jax.value_and_grad(f))
+        pa = [p._data for p in params]
+        loss, grads = g(pa, xs[0])          # compile + warm (flag is
+        jax.block_until_ready(grads)        # read at THIS trace)
+        return (g, pa, float(loss),
+                (sf.fusion_stats or {}).get("rewritten", {}))
+
+    def train_chunk(g, pa):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            loss, grads = g(pa, xs[i % len(xs)])
+        jax.block_until_ready(grads)
+        return (time.perf_counter() - t0) / iters
+
+    def eager_unfused(xa):
+        xt = Tensor(xa)
+        hn = F.rms_norm(xt)
+        q = llama.rotary_embedding(
+            ops.reshape(F.linear(hn, q_proj.weight, q_proj.bias),
+                        [B, S, heads, hd]))
+        k = llama.rotary_embedding(
+            ops.reshape(F.linear(hn, k_proj.weight, k_proj.bias),
+                        [B, S, heads, hd]))
+        h = fc2(F.gelu(fc1(ln2(xt))))
+        s = xt + h
+        y = F.rms_norm(s)
+        return y + ops.reshape(q, [B, S, H]) + ops.reshape(k, [B, S, H])
+
+    def eager_fused(xa):
+        xt = Tensor(xa)
+        hn = F.rms_norm(xt)
+        q = F.fused_rope_proj(hn, q_proj.weight, q_proj.bias,
+                              num_heads=heads)
+        k = F.fused_rope_proj(hn, k_proj.weight, k_proj.bias,
+                              num_heads=heads)
+        h = fc2(F.fused_norm_linear(
+            xt, fc1.weight, fc1.bias, ln2.weight, ln2.bias,
+            activation="gelu", norm_type="layer_norm"))
+        y, _s = F.fused_residual_norm(xt, h, norm_type="rms_norm",
+                                      epsilon=1e-6)
+        return y + ops.reshape(q, [B, S, H]) + ops.reshape(k, [B, S, H])
+
+    def eager_chunk(fn):
+        t0 = time.perf_counter()
+        for i in range(iters):
+            out = fn(xs[i % len(xs)])
+        out.numpy()                          # value read drains the queue
+        return (time.perf_counter() - t0) / iters
+
+    prior_fusion = _fusion_on()          # BENCH_FUSION=1 ladder opt-in
+    try:
+        g_u, pa_u, loss_u, _ = build_train(False)
+        g_f, pa_f, loss_f, patterns = build_train(True)
+    finally:
+        paddle.set_flags({"FLAGS_enable_fusion": prior_fusion})
+    # both programs are compiled now (the fused trace already happened;
+    # the flag no longer matters) — interleave the measurement
+    chunks = 4
+    t_u, t_f = [], []
+    for _ in range(chunks):
+        t_u.append(train_chunk(g_u, pa_u))
+        t_f.append(train_chunk(g_f, pa_f))
+    dt_u, dt_f = min(t_u), min(t_f)
+
+    e_out_u = eager_unfused(xs[0]).numpy()   # warm + parity reference
+    e_out_f = eager_fused(xs[0]).numpy()
+    e_u, e_f = [], []
+    for _ in range(chunks):
+        e_u.append(eager_chunk(eager_unfused))
+        e_f.append(eager_chunk(eager_fused))
+    e_dt_u, e_dt_f = min(e_u), min(e_f)
+
+    train_ratio = dt_u / max(dt_f, 1e-12)
+    eager_ratio = e_dt_u / max(e_dt_f, 1e-12)
+    loss_parity = abs(loss_u - loss_f) <= 1e-3 * max(abs(loss_u), 1.0)
+    scale = max(float(np.abs(e_out_u).max()), 1e-6)
+    eager_parity = float(np.abs(e_out_u - e_out_f).max()) <= 1e-3 * scale
+    value = float(np.sqrt(train_ratio * eager_ratio))
+    return {
+        "metric": "fusion_fused_vs_unfused_step_ratio",
+        "value": round(value, 4),
+        "unit": "x_unfused",
+        # parity is the gate: a fast-but-wrong rewrite scores 0
+        "vs_baseline": round(value, 4)
+        if (loss_parity and eager_parity and patterns) else 0.0,
+        "extra": {
+            "block": f"B{B} S{S} H{H} FF{FF} heads{heads}",
+            "patterns": patterns,
+            "train_unfused_step_s": round(dt_u, 5),
+            "train_fused_step_s": round(dt_f, 5),
+            "train_ratio": round(train_ratio, 4),
+            "train_loss_unfused": round(loss_u, 6),
+            "train_loss_fused": round(loss_f, 6),
+            "loss_parity": bool(loss_parity),
+            "eager_unfused_step_s": round(e_dt_u, 5),
+            "eager_fused_step_s": round(e_dt_f, 5),
+            "eager_ratio": round(eager_ratio, 4),
+            "eager_parity": bool(eager_parity),
+        },
+    }
+
+
 def _bench_fleet_observability(small):
     """Fleet-observability overhead rung (BENCH_MODEL=fleet_observability;
     paddle_tpu/observability/fleet.py + flight.py). The SAME step loop —
@@ -1096,7 +1295,14 @@ def main():
                "serving_resilience": _bench_serving_resilience,
                "compile_cache": _bench_compile_cache,
                "spmd_auto": _bench_spmd_auto,
+               "fusion": _bench_fusion,
                "fleet_observability": _bench_fleet_observability}
+    if _env_bool("BENCH_FUSION", False):
+        # opt the LADDER rungs into the fusion pass (they record the
+        # flag state in extra either way); the fusion rung itself
+        # measures both states regardless
+        import paddle_tpu as _p
+        _p.set_flags({"FLAGS_enable_fusion": True})
     which = os.environ.get("BENCH_MODEL", "all")
     if which != "all":
         print(json.dumps(benches[which](small)))
@@ -1156,6 +1362,18 @@ def main():
               "value": 0.0, "unit": "error", "vs_baseline": 0.0,
               "extra": {"error": repr(e)[:300]}}
     print(json.dumps(sa))
+    sys.stdout.flush()
+
+    # fusion rung rides along in every default run: fused-vs-unfused
+    # step time on the GPT block, parity-gated (own metric class — not
+    # in the train geomean; the bar is >= 1.10x, see perf_baseline)
+    try:
+        fu = benches["fusion"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        fu = {"metric": "fusion_fused_vs_unfused_step_ratio",
+              "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(fu))
     sys.stdout.flush()
 
     # fleet-observability overhead rung rides along in every default
@@ -1222,6 +1440,14 @@ def main():
                           "fleet_tp_step_s"),
                       "attribution": sa.get("extra", {}).get(
                           "attribution")},
+                  "fusion": {
+                      "value": fu["value"], "unit": fu["unit"],
+                      "vs_baseline": fu["vs_baseline"],
+                      "patterns": fu.get("extra", {}).get("patterns"),
+                      "train_ratio": fu.get("extra", {}).get(
+                          "train_ratio"),
+                      "eager_ratio": fu.get("extra", {}).get(
+                          "eager_ratio")},
                   "fleet_observability": {
                       "value": fo["value"], "unit": fo["unit"],
                       "overhead_pct": fo.get("extra", {}).get(

@@ -1,0 +1,625 @@
+"""Graph-fusion pass over op-list IRs.
+
+The optimization layer the round-12 perf stack measures against
+(reference: CINN fusion/codegen + the 71-entry ``fused_ops.yaml`` hot
+set). One pattern-matching core rewrites matched subgraphs onto the
+first-class fused ops of :mod:`paddle_tpu.nn.functional.fused`, and
+three thin adapters wire it into every compile path:
+
+* ``fuse_steps``           — the core: match + external-use-checked
+  rewrite over any op list whose records carry
+  ``name/fn/in_ids/out_ids/attrs/in_shapes/out_shapes``
+  (``static.Program``'s ``_OpRecord`` natively qualifies).
+* ``fuse_program_ops``     — ``static.Program`` / ``Executor.run``.
+* ``trace_rewrite``        — ``to_static`` / ``Engine``: captures the
+  dispatched op stream during the trace, then re-emits the fused
+  subgraphs THROUGH the dispatcher (so spmd propagation, cost
+  accounting, and metrics all see the fused ops) and swaps the new
+  values into the function's outputs; the superseded unfused ops die
+  in XLA DCE.
+* ``fuse_sot_nodes``       — SOT segment flush: the pending segment
+  graph is rewritten before its ``seg_fn`` compiles.
+
+Patterns (the inventory README documents):
+
+=================  ======================================================
+``norm_linear``    layer_norm/rms_norm → linear[→ gelu/silu]   (one GEMM
+                   with norm prologue + bias/act epilogue)
+``linear_act``     linear → gelu/silu                (norm-less variant)
+``residual_norm``  add(x, y) → layer_norm/rms_norm   (sum stays a REAL
+                   output, so external residual-stream uses are legal)
+``bias_act``       add(x, bias-vector) → gelu/silu/relu
+``rope_proj``      linear → reshape(B,S,H,D) → rotary_embedding
+=================  ======================================================
+
+Rejection rule: an *interior* value (consumed by the fused op and not
+re-emitted as one of its outputs) that is externally visible — fetched,
+returned, or read by any step outside the chain — rejects the match
+(counted in ``paddle_tpu_fusion_rejected_total{pattern=}``).
+
+Everything is gated by ``FLAGS_enable_fusion`` (default off: the seed
+behavior is bit-exact) and fingerprinted into the persistent-compile
+cache keys so fused and unfused programs can never cross-hit.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ...core import flags
+from ...observability import metrics as _metrics
+
+__all__ = ["enabled", "fingerprint", "fuse_steps", "fuse_program_ops",
+           "trace_rewrite", "fuse_sot_nodes", "FusedStep", "PATTERNS",
+           "FUSION_VERSION"]
+
+#: bump when the pattern set or a fused rewrite's semantics change —
+#: folded into every compile-cache key so stale fused programs die
+FUSION_VERSION = 1
+
+PATTERNS = ("norm_linear", "linear_act", "residual_norm", "bias_act",
+            "rope_proj")
+
+_NORM_OPS = ("layer_norm", "rms_norm")
+_ACT_OPS = ("gelu", "silu", "relu")
+
+_m_matched = _metrics.counter(
+    "paddle_tpu_fusion_matched_total",
+    "Fusion-pattern candidates that matched structurally (rewritten + "
+    "rejected).", labelnames=("pattern",))
+_m_rewritten = _metrics.counter(
+    "paddle_tpu_fusion_rewritten_total",
+    "Fusion-pattern candidates rewritten onto fused ops.",
+    labelnames=("pattern",))
+_m_rejected = _metrics.counter(
+    "paddle_tpu_fusion_rejected_total",
+    "Fusion-pattern candidates rejected (interior value externally "
+    "visible, multi-consumer interior, or producer-order hazard).",
+    labelnames=("pattern",))
+
+
+def enabled() -> bool:
+    return bool(flags.get_flag("enable_fusion"))
+
+
+def fingerprint() -> str:
+    """Cache-key component describing the rewrite the pass would apply
+    (folded into pcc keys + jit statics so fused/unfused programs and
+    different pattern vintages never share a compiled entry)."""
+    return f"fusion/v{FUSION_VERSION}[{','.join(PATTERNS)}]"
+
+
+@dataclass
+class FusedStep:
+    """One rewritten subgraph, replayable like an ``_OpRecord``."""
+
+    name: str
+    fn: Callable
+    in_ids: tuple
+    out_ids: tuple
+    attrs: dict = field(default_factory=dict)
+    in_shapes: tuple = ()
+    out_shapes: tuple = ()
+    pattern: str = ""
+    #: AMP state active when the anchor op was recorded (trace_rewrite
+    #: replays under it so fused GEMMs keep the bf16 discipline the
+    #: unfused chain had; None = replay under the ambient state)
+    amp: Optional[tuple] = None
+
+
+def _act_name(step) -> Optional[str]:
+    """Map a matched activation step to the fused epilogue vocabulary."""
+    if step.name == "gelu":
+        return "gelu_tanh" if (step.attrs or {}).get("approximate") \
+            else "gelu"
+    if step.name in ("silu", "relu"):
+        return step.name
+    return None
+
+
+class _Graph:
+    """Def/use index over the step list."""
+
+    def __init__(self, steps, external_ids):
+        self.steps = list(steps)
+        self.external = set(external_ids)
+        self.producer: Dict = {}
+        self.uses: Dict = {}
+        for i, st in enumerate(self.steps):
+            for o in st.out_ids:
+                self.producer[o] = i
+            for v in st.in_ids:
+                self.uses.setdefault(v, []).append(i)
+
+    def sole_consumer(self, vid) -> Optional[int]:
+        u = self.uses.get(vid, [])
+        return u[0] if len(u) == 1 else None
+
+    def interior_ok(self, vid, consumer_idx) -> bool:
+        """vid may be swallowed: exactly one consumer and not external."""
+        return (self.sole_consumer(vid) == consumer_idx
+                and vid not in self.external)
+
+    def inputs_available(self, in_ids, first_idx) -> bool:
+        """Every fused-step input must exist before the fused step's
+        position (graph inputs always do; produced values must come
+        from earlier steps)."""
+        return all(self.producer.get(v, -1) < first_idx for v in in_ids)
+
+
+# --------------------------------------------------------------------------
+# Pattern matchers: (graph, i) -> (match | None, rejected: bool)
+# match = (pattern, consumed_indices, FusedStep)
+# --------------------------------------------------------------------------
+def _lazy_fused():
+    from ...nn.functional import fused as FF
+    return FF
+
+
+def _match_norm_linear(g: _Graph, i: int):
+    st = g.steps[i]
+    if st.name not in _NORM_OPS:
+        return None, False
+    attrs = st.attrs or {}
+    if attrs.get("norm_ndim") != 1 or "epsilon" not in attrs:
+        return None, False          # pre-attr record or multi-dim norm
+    y = st.out_ids[0]
+    consumers = g.uses.get(y, [])
+    lin_idx = next((j for j in consumers if g.steps[j].name == "linear"
+                    and g.steps[j].in_ids
+                    and g.steps[j].in_ids[0] == y), None)
+    if lin_idx is None:
+        return None, False
+    # structural candidate exists from here on
+    if not g.interior_ok(y, lin_idx):
+        return "rejected", True
+    lin = g.steps[lin_idx]
+    has_bias = len(lin.in_ids) == 3
+    consumed = [i, lin_idx]
+    act = ""
+    out_step = lin
+    lin_out = lin.out_ids[0]
+    act_idx = g.sole_consumer(lin_out)
+    if (act_idx is not None and g.steps[act_idx].name in _ACT_OPS
+            and lin_out not in g.external):
+        a = _act_name(g.steps[act_idx])
+        if a is not None:
+            act = a
+            consumed.append(act_idx)
+            out_step = g.steps[act_idx]
+    has_w = bool(attrs.get("has_w", len(st.in_ids) >= 2))
+    has_b = bool(attrs.get("has_b", len(st.in_ids) >= 3))
+    nw = st.in_ids[1] if has_w else None
+    nb = st.in_ids[1 + has_w] if has_b else None
+    in_ids = [st.in_ids[0], lin.in_ids[1]]
+    in_shapes = [st.in_shapes[0], lin.in_shapes[1]]
+    if has_bias:
+        in_ids.append(lin.in_ids[2])
+        in_shapes.append(lin.in_shapes[2])
+    if nw is not None:
+        in_ids.append(nw)
+        in_shapes.append(st.in_shapes[1])
+    if nb is not None:
+        in_ids.append(nb)
+        in_shapes.append(st.in_shapes[1 + has_w])
+    if not g.inputs_available(in_ids, i):
+        return "rejected", True
+    FF = _lazy_fused()
+    fused = FusedStep(
+        name="fused_norm_linear",
+        fn=FF.norm_linear_lowering(st.name, float(attrs["epsilon"]),
+                                   act, has_bias, has_w, has_b),
+        in_ids=tuple(in_ids), out_ids=tuple(out_step.out_ids),
+        attrs={"norm_type": st.name, "epsilon": float(attrs["epsilon"]),
+               "activation": act},
+        in_shapes=tuple(in_shapes), out_shapes=tuple(out_step.out_shapes),
+        pattern="norm_linear")
+    return ("norm_linear", consumed, fused), False
+
+
+def _match_linear_act(g: _Graph, i: int):
+    st = g.steps[i]
+    if st.name != "linear" or not st.out_ids:
+        return None, False
+    lin_out = st.out_ids[0]
+    act_idx = g.sole_consumer(lin_out)
+    consumers = g.uses.get(lin_out, [])
+    has_act_consumer = any(g.steps[j].name in _ACT_OPS
+                           and _act_name(g.steps[j]) is not None
+                           for j in consumers)
+    if not has_act_consumer:
+        return None, False
+    if act_idx is None or lin_out in g.external:
+        return "rejected", True
+    act = _act_name(g.steps[act_idx])
+    if act is None:
+        return None, False
+    has_bias = len(st.in_ids) == 3
+    if not g.inputs_available(st.in_ids, i):
+        return "rejected", True
+    FF = _lazy_fused()
+    fused = FusedStep(
+        name="fused_norm_linear",
+        fn=FF.norm_linear_lowering("", 0.0, act, has_bias, False,
+                                   False),
+        in_ids=tuple(st.in_ids), out_ids=tuple(g.steps[act_idx].out_ids),
+        attrs={"norm_type": "", "activation": act},
+        in_shapes=tuple(st.in_shapes),
+        out_shapes=tuple(g.steps[act_idx].out_shapes),
+        pattern="linear_act")
+    return ("linear_act", [i, act_idx], fused), False
+
+
+def _match_residual_norm(g: _Graph, i: int):
+    st = g.steps[i]
+    if st.name != "add" or len(st.in_ids) != 2 or not st.out_ids:
+        return None, False
+    if (len(st.in_shapes) != 2 or st.in_shapes[0] != st.in_shapes[1]
+            or len(st.in_shapes[0]) < 2
+            or st.in_shapes[0] != st.out_shapes[0]):
+        return None, False           # not a same-shape residual add
+    s_out = st.out_ids[0]
+    norm_idx = next(
+        (j for j in g.uses.get(s_out, [])
+         if g.steps[j].name in _NORM_OPS
+         and (g.steps[j].attrs or {}).get("norm_ndim") == 1
+         and "epsilon" in (g.steps[j].attrs or {})
+         and g.steps[j].in_ids and g.steps[j].in_ids[0] == s_out), None)
+    if norm_idx is None:
+        return None, False
+    norm = g.steps[norm_idx]
+    attrs = norm.attrs or {}
+    has_w = bool(attrs.get("has_w", len(norm.in_ids) >= 2))
+    has_b = bool(attrs.get("has_b", len(norm.in_ids) >= 3))
+    in_ids = list(st.in_ids) + list(norm.in_ids[1:])
+    in_shapes = list(st.in_shapes) + list(norm.in_shapes[1:])
+    if not g.inputs_available(in_ids, i):
+        return "rejected", True
+    # the sum is RE-EMITTED as the fused op's second output, so other
+    # consumers / external visibility of it are legal — only the norm
+    # output is interior-free by construction (it IS an output too)
+    FF = _lazy_fused()
+    fused = FusedStep(
+        name="fused_residual_norm",
+        fn=FF.residual_norm_lowering(norm.name,
+                                     float(attrs["epsilon"]), has_w,
+                                     has_b),
+        in_ids=tuple(in_ids),
+        out_ids=(norm.out_ids[0], s_out),
+        attrs={"norm_type": norm.name,
+               "epsilon": float(attrs["epsilon"])},
+        in_shapes=tuple(in_shapes),
+        out_shapes=(norm.out_shapes[0], st.out_shapes[0]),
+        pattern="residual_norm")
+    return ("residual_norm", [i, norm_idx], fused), False
+
+
+def _match_bias_act(g: _Graph, i: int):
+    st = g.steps[i]
+    if st.name != "add" or len(st.in_ids) != 2 or not st.out_ids:
+        return None, False
+    shapes = list(st.in_shapes) if len(st.in_shapes) == 2 else None
+    if shapes is None:
+        return None, False
+    out_shape = st.out_shapes[0] if st.out_shapes else ()
+    bias_side = None
+    for side in (1, 0):
+        other = 1 - side
+        if (len(shapes[side]) == 1 and len(shapes[other]) >= 2
+                and len(out_shape) >= 1
+                and int(shapes[side][0]) == int(out_shape[-1])):
+            bias_side = side
+            break
+    if bias_side is None:
+        return None, False
+    add_out = st.out_ids[0]
+    consumers = g.uses.get(add_out, [])
+    if not any(g.steps[j].name in _ACT_OPS
+               and _act_name(g.steps[j]) is not None
+               for j in consumers):
+        return None, False
+    act_idx = g.sole_consumer(add_out)
+    if act_idx is None or add_out in g.external:
+        return "rejected", True
+    act = _act_name(g.steps[act_idx])
+    if act is None:
+        return None, False
+    x_side = 1 - bias_side
+    in_ids = (st.in_ids[x_side], st.in_ids[bias_side])
+    if not g.inputs_available(in_ids, i):
+        return "rejected", True
+    FF = _lazy_fused()
+    fused = FusedStep(
+        name="fused_bias_act",
+        fn=FF.bias_act_lowering(act),
+        in_ids=in_ids, out_ids=tuple(g.steps[act_idx].out_ids),
+        attrs={"activation": act},
+        in_shapes=(st.in_shapes[x_side], st.in_shapes[bias_side]),
+        out_shapes=tuple(g.steps[act_idx].out_shapes),
+        pattern="bias_act")
+    return ("bias_act", [i, act_idx], fused), False
+
+
+def _match_rope_proj(g: _Graph, i: int):
+    st = g.steps[i]
+    if st.name != "linear" or not st.out_ids:
+        return None, False
+    if len(st.in_shapes) < 2 or len(st.in_shapes[0]) != 3:
+        return None, False
+    lin_out = st.out_ids[0]
+    rs_idx = g.sole_consumer(lin_out)
+    if rs_idx is None or g.steps[rs_idx].name != "reshape":
+        return None, False
+    rs = g.steps[rs_idx]
+    if not rs.out_shapes or len(rs.out_shapes[0]) != 4:
+        return None, False
+    b, s, h, d = (int(v) for v in rs.out_shapes[0])
+    if (b, s) != tuple(int(v) for v in st.in_shapes[0][:2]) \
+            or h * d != int(st.out_shapes[0][-1]):
+        return None, False
+    rope_idx = g.sole_consumer(rs.out_ids[0])
+    if rope_idx is None \
+            or g.steps[rope_idx].name != "rotary_embedding":
+        return None, False
+    rope = g.steps[rope_idx]
+    attrs = rope.attrs or {}
+    if "theta" not in attrs or "pos_offset" not in attrs:
+        return None, False           # traced offset: stays unfused
+    # candidate exists: interior values are the projection + reshape
+    if lin_out in g.external or rs.out_ids[0] in g.external:
+        return "rejected", True
+    has_bias = len(st.in_ids) == 3
+    if not g.inputs_available(st.in_ids, i):
+        return "rejected", True
+    FF = _lazy_fused()
+    fused = FusedStep(
+        name="fused_rope_proj",
+        fn=FF.rope_proj_lowering(h, float(attrs["theta"]),
+                                 int(attrs["pos_offset"]), has_bias),
+        in_ids=tuple(st.in_ids), out_ids=tuple(rope.out_ids),
+        attrs={"num_heads": h, "theta": float(attrs["theta"]),
+               "pos_offset": int(attrs["pos_offset"])},
+        in_shapes=tuple(st.in_shapes),
+        out_shapes=tuple(rope.out_shapes),
+        pattern="rope_proj")
+    return ("rope_proj", [i, rs_idx, rope_idx], fused), False
+
+
+#: attempt order at each step index: most-specific first
+_MATCHERS = (_match_rope_proj, _match_norm_linear, _match_residual_norm,
+             _match_bias_act, _match_linear_act)
+
+
+# --------------------------------------------------------------------------
+# The pass
+# --------------------------------------------------------------------------
+def fuse_steps(steps: Sequence, external_ids) -> Tuple[list, dict]:
+    """Rewrite matched subgraphs; returns ``(plan, stats)``.
+
+    ``plan`` preserves program order: unmatched records pass through
+    untouched (same objects), each matched chain is replaced by ONE
+    :class:`FusedStep` at the chain head's position. ``external_ids``
+    are value ids visible outside the op list (fetches / returns);
+    interior values reaching them reject the match.
+    """
+    g = _Graph(steps, external_ids)
+    stats = {"ops_before": len(g.steps), "matched": {}, "rewritten": {},
+             "rejected": {}, "patterns": {}}
+    consumed = set()
+    replacement: Dict[int, FusedStep] = {}
+    metered = _metrics.enabled()
+    for i in range(len(g.steps)):
+        if i in consumed:
+            continue
+        for matcher in _MATCHERS:
+            res, rejected = matcher(g, i)
+            if rejected:
+                pattern = matcher.__name__.replace("_match_", "")
+                stats["matched"][pattern] = \
+                    stats["matched"].get(pattern, 0) + 1
+                stats["rejected"][pattern] = \
+                    stats["rejected"].get(pattern, 0) + 1
+                if metered:
+                    _m_matched.inc(pattern=pattern)
+                    _m_rejected.inc(pattern=pattern)
+                continue
+            if res is None:
+                continue
+            pattern, idxs, fused = res
+            if any(j in consumed for j in idxs):
+                continue
+            stats["matched"][pattern] = \
+                stats["matched"].get(pattern, 0) + 1
+            stats["rewritten"][pattern] = \
+                stats["rewritten"].get(pattern, 0) + 1
+            if metered:
+                _m_matched.inc(pattern=pattern)
+                _m_rewritten.inc(pattern=pattern)
+            consumed.update(idxs)
+            fused.amp = getattr(g.steps[i], "amp", None)
+            replacement[i] = fused
+            break
+    plan: List = []
+    for i, st in enumerate(g.steps):
+        if i in replacement:
+            plan.append(replacement[i])
+        elif i not in consumed:
+            plan.append(st)
+    stats["ops_after"] = len(plan)
+    stats["patterns"] = dict(stats["rewritten"])
+    return plan, stats
+
+
+def fuse_program_ops(ops_list, fetch_ids) -> Tuple[list, dict]:
+    """``static.Program`` adapter: ``_OpRecord`` list in, replayable
+    plan out (fetched value ids are the external set)."""
+    return fuse_steps(ops_list, set(fetch_ids))
+
+
+# --------------------------------------------------------------------------
+# to_static / Engine adapter: capture the traced op stream, re-emit
+# fused subgraphs through the dispatcher, swap outputs
+# --------------------------------------------------------------------------
+class trace_rewrite:
+    """Record ops dispatched inside the ``with`` body, then ``apply``
+    the fusion pass to the captured stream.
+
+    ``apply(out_tree)`` re-executes the fused steps — and every step
+    downstream of a rewrite — through ``dispatch.call`` (so spmd
+    trace scopes, cost accounting, and op metrics observe the fused
+    program), then swaps the recomputed payloads into the output
+    tensors. The superseded unfused values become dead code that XLA
+    eliminates. Ops whose values are untouched by any rewrite keep
+    their original payloads (zero re-trace cost).
+
+    Caveat: the rewritten region is dispatched twice at TRACE time
+    (original chain, then the fused replay), so trace-time-only
+    telemetry (``FLAGS_perf_op_cost`` accumulators, per-op host-latency
+    histograms) over-counts it by one trace. Compiled steady state
+    never re-dispatches, and runtime attribution reads the compiled
+    program's XLA cost analysis — both see exactly the fused program.
+    """
+
+    def __init__(self):
+        self.steps: List[FusedStep] = []
+        self._tensors: Dict[int, object] = {}
+        self.stats: Optional[dict] = None
+
+    def _hook(self, op_name, f, tensor_inputs, out_tensors, attrs=None):
+        from ...core import dispatch
+        for t in list(tensor_inputs) + list(out_tensors):
+            self._tensors[id(t)] = t     # id stability + replay source
+        s = dispatch._tls()
+        amp = None
+        if s.amp_level in ("O1", "O2"):
+            amp = (s.amp_level, s.amp_dtype, set(s.amp_custom_white),
+                   set(s.amp_custom_black))
+        self.steps.append(FusedStep(
+            name=op_name, fn=f,
+            in_ids=tuple(id(t) for t in tensor_inputs),
+            out_ids=tuple(id(t) for t in out_tensors),
+            attrs=dict(attrs or {}),
+            in_shapes=tuple(tuple(t.shape) for t in tensor_inputs),
+            out_shapes=tuple(tuple(t.shape) for t in out_tensors),
+            amp=amp))
+
+    def __enter__(self):
+        from ...core import dispatch
+        dispatch.register_recorder_hook(self._hook)
+        return self
+
+    def __exit__(self, *exc):
+        from ...core import dispatch
+        dispatch.unregister_recorder_hook(self._hook)
+        return False
+
+    def apply(self, out_tree):
+        import jax
+
+        from ...core import dispatch
+        from ...core.tensor import Tensor
+
+        leaves, _ = jax.tree_util.tree_flatten(
+            out_tree, is_leaf=lambda x: isinstance(x, Tensor))
+        out_tensors = [l for l in leaves if isinstance(l, Tensor)]
+        external = {id(t) for t in out_tensors}
+        plan, stats = fuse_steps(self.steps, external)
+        self.stats = stats
+        if not stats["rewritten"]:
+            return out_tree
+        new_vals: Dict = {}          # vid -> recomputed Tensor
+
+        def _inputs(st):
+            ins = []
+            for vid in st.in_ids:
+                t = new_vals.get(vid)
+                ins.append(t if t is not None else self._tensors[vid])
+            return ins
+
+        for st in plan:
+            is_fused = bool(getattr(st, "pattern", ""))
+            dirty = any(v in new_vals for v in st.in_ids)
+            if not is_fused and not dirty:
+                continue             # untouched: keep the original value
+            amp = getattr(st, "amp", None)
+            prev = dispatch.set_amp_state(*amp) if amp else None
+            try:
+                # attrs ride the replay so the spmd rules key on them
+                # (transpose perm, reduce axis, …) — but ONLY as
+                # dispatch metadata: a recorded step's fn is the
+                # already attr-BOUND lowering the recorder hook saw
+                # (dispatch closes attrs over it), so the replay fn
+                # must swallow the kwargs dispatch would re-bind
+                fn = st.fn
+                if st.attrs:
+                    fn = (lambda *xs, __f=st.fn, **_a: __f(*xs))
+                outs = dispatch.call(st.name, fn, _inputs(st),
+                                     attrs=st.attrs or None)
+            finally:
+                if prev is not None:
+                    dispatch.restore_amp_state(prev)
+            outs = outs if isinstance(outs, list) else [outs]
+            for oid, t in zip(st.out_ids, outs):
+                # keys are trace-time python object ids (ints) captured by
+                # the recorder hook — never tensor values/hashes
+                new_vals[oid] = t  # tpulint: disable=TPU203 id()-keyed replay env
+        for t in out_tensors:
+            repl = new_vals.get(id(t))
+            if repl is not None:
+                t._data = repl._data
+        return out_tree
+
+
+def rewrite_traced(call):
+    """Convenience for the trace-time entry points: run ``call()``
+    under a capture, apply the pass, return ``(out, stats)`` —
+    a no-op passthrough when the flag is off."""
+    if not enabled():
+        return call(), None
+    tr = trace_rewrite()
+    with tr:
+        out = call()
+    out = tr.apply(out)
+    return out, tr.stats
+
+
+# --------------------------------------------------------------------------
+# SOT adapter: rewrite the pending segment's node graph pre-compile
+# --------------------------------------------------------------------------
+class _SotStep:
+    """Node-graph view of one SOT segment op (value ids are the
+    ``("n", node, out)`` / ``("x", ext)`` refs the segment uses)."""
+
+    __slots__ = ("name", "fn", "in_ids", "out_ids", "attrs",
+                 "in_shapes", "out_shapes", "pattern")
+
+    def __init__(self, name, fn, in_ids, out_ids, attrs, in_shapes,
+                 out_shapes):
+        self.name = name
+        self.fn = fn
+        self.in_ids = in_ids
+        self.out_ids = out_ids
+        self.attrs = attrs
+        self.in_shapes = in_shapes
+        self.out_shapes = out_shapes
+        self.pattern = ""
+
+
+def fuse_sot_nodes(nodes, out_refs):
+    """Rewrite a SOT segment's node list; returns ``(plan, stats)``
+    with plan steps executable over an env keyed by the original
+    ``("n", node, out)`` slots — or ``(None, None)`` when nothing
+    matched (the caller keeps its unfused ``seg_fn``)."""
+    steps = []
+    for nid, node in enumerate(nodes):
+        op, f, in_refs, n_out, _ak, attrs, io_shapes = node
+        in_shapes, out_shapes = io_shapes
+        out_ids = tuple(("n", nid, k) for k in range(n_out))
+        steps.append(_SotStep(
+            op, f, tuple(tuple(r) for r in in_refs), out_ids,
+            dict(attrs or {}), tuple(in_shapes), tuple(out_shapes)))
+    external = {("n", nid, k) for nid, k in out_refs}
+    plan, stats = fuse_steps(steps, external)
+    if not stats["rewritten"]:
+        return None, stats
+    return plan, stats

@@ -159,6 +159,9 @@ AMP_WHITE_OPS = {
     # chunked head+loss fusion: the matmul dominates, internal lse math
     # accumulates in f32 regardless of the input dtype
     "fused_linear_cross_entropy",
+    # GEMM-bearing fused ops (compile/fusion): the norm prologue /
+    # rope epilogue compute in f32 internally regardless of input dtype
+    "fused_norm_linear", "fused_rope_proj",
 }
 AMP_BLACK_OPS = {
     "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",

@@ -135,6 +135,14 @@ define_flag("compile_cache_size_mb", 512,
 define_flag("compile_cache_manifest", "",
             "Shape-signature manifest (JSONL) recording path for AOT "
             "warmup; empty = off.")
+# Graph fusion pass (paddle_tpu/compile/fusion/) — registered here so
+# set_flags works before the fusion package is first imported. Default
+# OFF: with the flag clear, every compile path is bit-exact with the
+# unfused seed behavior (tests/test_fusion.py pins this).
+define_flag("enable_fusion", False,
+            "Rewrite matched subgraphs (norm->linear->act, residual+norm, "
+            "bias+act, rope+projection) onto fused ops in the compile "
+            "paths (to_static/SOT/Engine/static.Program).")
 # Performance attribution (paddle_tpu/observability/perf/) — registered
 # here so the dispatch hot-path mirror can read them at import time.
 define_flag("perf_capture", False,

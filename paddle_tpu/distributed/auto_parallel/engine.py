@@ -46,6 +46,8 @@ class Engine:
         #: propagation stats of the traced step (filled at prepare-time
         #: trace; the acceptance bar is fallback == {})
         self.spmd_stats = None
+        #: fusion-pass stats of the traced step (FLAGS_enable_fusion)
+        self.fusion_stats = None
         self._params = [p for p in model.parameters()
                         if not p.stop_gradient]
         self._train_step = None
@@ -156,9 +158,11 @@ class Engine:
         """One forward+loss inside the traced step — under SPMD auto
         mode it runs in a propagation scope so every op's spmd_rule
         annotates its outputs (see distributed.spmd)."""
+        from ...compile import fusion as _fusion
         if not self._spmd_auto:
-            out = model(Tensor(x))
-            return loss_fn(out, Tensor(y))._data
+            loss_t, self.fusion_stats = _fusion.rewrite_traced(
+                lambda: loss_fn(model(Tensor(x)), Tensor(y)))
+            return loss_t._data
         from .. import spmd as spmd_mod
         sc = spmd_mod.trace_scope(self._mesh)
         with sc:
@@ -172,8 +176,12 @@ class Engine:
                 sc.seed(xt, in_specs[0])
             if in_specs[1] is not None:
                 sc.seed(yt, in_specs[1])
-            out = model(xt)
-            loss = loss_fn(out, yt)._data
+            # fusion inside the propagation scope: the fused re-emits
+            # dispatch through the scope's hook, so their spmd_rules
+            # annotate the fused program
+            loss_t, self.fusion_stats = _fusion.rewrite_traced(
+                lambda: loss_fn(model(xt), yt))
+            loss = loss_t._data
         self.spmd_stats = dict(sc.stats)
         return loss
 

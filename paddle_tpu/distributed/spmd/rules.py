@@ -557,6 +557,68 @@ def pad_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
                       + [(None,) * len(s) for s in out_shapes[1:]])
 
 
+def fused_residual_norm_rule(in_specs, in_shapes, attrs,
+                             out_shapes) -> SpmdResult:
+    """(x, residual[, w][, b]) -> (normed, summed): both outputs carry
+    the meet of x and residual; norm params stay replicated."""
+    if len(in_specs) < 2:
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    cand = meet(in_specs[0], in_specs[1]) \
+        if len(in_shapes[0]) == len(in_shapes[1]) else in_specs[0]
+    x_shape = in_shapes[0]
+    outs = [cand if tuple(s) == tuple(x_shape)
+            else _carry(cand, x_shape, s) for s in out_shapes]
+    resolved = [None, None] + [normalize(None, len(s))
+                               for s in in_shapes[2:]]
+    return SpmdResult(out_specs=outs, in_specs=resolved)
+
+
+def fused_norm_linear_rule(in_specs, in_shapes, attrs,
+                           out_shapes) -> SpmdResult:
+    """(x(…, K), W(K, N)[, bias][, norm params]) -> (…, N): batch dims
+    ride x, the feature dim rides W's output axis (a TP column split
+    propagates); the contracting dim stays internal."""
+    if len(in_specs) < 2 or not out_shapes:
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    x_spec, w_spec = in_specs[0], in_specs[1]
+    out_shape = out_shapes[0]
+    out = list(x_spec[:len(out_shape) - 1]) \
+        + [None] * (len(out_shape) - len(x_spec))
+    out = out[:len(out_shape) - 1]
+    out.append(w_spec[-1] if len(w_spec) >= 2 else None)
+    out = dedupe(tuple(out))
+    resolved: List[Optional[tuple]] = [None, None]
+    for spec, shape in zip(in_specs[2:], in_shapes[2:]):
+        # 1-D bias rides the output feature axis; norm params replicate
+        if len(shape) == 1 and int(shape[0]) == int(out_shape[-1]):
+            resolved.append(dedupe((out[-1],)))
+        else:
+            resolved.append(normalize(None, len(shape)))
+    return SpmdResult(out_specs=[out if tuple(s) == tuple(out_shape)
+                                 else (None,) * len(s)
+                                 for s in out_shapes],
+                      in_specs=resolved)
+
+
+def fused_rope_proj_rule(in_specs, in_shapes, attrs,
+                         out_shapes) -> SpmdResult:
+    """(x(B, S, K), W(K, H*D)[, bias]) -> (B, S, H, D): batch/seq ride
+    x; a feature-split W shards the heads axis (head_dim is the minor
+    factor of the reshape, so the axis lands on dim 2)."""
+    if len(in_specs) < 2 or not out_shapes or len(out_shapes[0]) != 4:
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    x_spec, w_spec = in_specs[0], in_specs[1]
+    out = (x_spec[0] if len(x_spec) > 0 else None,
+           x_spec[1] if len(x_spec) > 1 else None,
+           w_spec[-1] if len(w_spec) >= 2 else None, None)
+    out = dedupe(out)
+    return SpmdResult(out_specs=[out]
+                      + [(None,) * len(s) for s in out_shapes[1:]])
+
+
 def unconstrained_rule(in_specs, in_shapes, attrs,
                        out_shapes) -> SpmdResult:
     """A real (counted) rule that imposes nothing — for ops whose
@@ -669,6 +731,13 @@ def _fill_rules():
     SPMD_RULES["pad"] = pad_rule
     for name in ("flip", "roll", "rot90"):
         SPMD_RULES[name] = pad_rule  # shape-preserving permute class
+    # fused ops (compile/fusion rewrite targets): first-class rules so
+    # round-13 propagation sees through the rewrite — a fused program
+    # must report zero spmd fallbacks (ISSUE 10 acceptance)
+    SPMD_RULES["fused_bias_act"] = elementwise_rule
+    SPMD_RULES["fused_residual_norm"] = fused_residual_norm_rule
+    SPMD_RULES["fused_norm_linear"] = fused_norm_linear_rule
+    SPMD_RULES["fused_rope_proj"] = fused_rope_proj_rule
     for name in ("zeros", "ones", "full", "arange", "linspace", "empty",
                  "eye", "zeros_like", "ones_like", "full_like",
                  "empty_like", "rand", "randn", "randint", "uniform",
@@ -699,6 +768,9 @@ CATEGORY_RULES: Dict[str, Callable] = {
     # the named table already pins the shape-changing exceptions
     # (reshape_, transpose_, squeeze_, …) to their real classes
     "inplace": elementwise_rule,
+    # fused ops carry NAMED rules (table above); the category fallback
+    # only covers future fused registrations that miss the audit gate
+    "fusion": elementwise_rule,
 }
 
 

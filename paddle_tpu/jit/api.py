@@ -124,6 +124,9 @@ class StaticFunction:
         self._spmd_param_specs = param_specs
         #: propagation stats of the most recent traced signature
         self.spmd_stats: Optional[dict] = None
+        #: fusion-pass stats of the most recent traced signature
+        #: (compile.fusion.rewrite_traced; None = fusion off / no trace)
+        self.fusion_stats: Optional[dict] = None
         #: per-signature AOT runners — deserialized persistent-cache hits
         #: and locally AOT-compiled programs (persistent cache path)
         self._aot_sigs: dict = {}
@@ -212,6 +215,15 @@ class StaticFunction:
                   for l in leaves if _is_traced_leaf(l)]  # tpulint: disable=TPU105 — filters on leaf TYPE (isinstance), never a tensor value
         statics = tuple((i, l) for i, l in enumerate(leaves)
                         if not _is_traced_leaf(l))  # tpulint: disable=TPU105 — same type-level partition
+        # graph fusion: the pass fingerprint rides the statics tuple, so
+        # (a) jax.jit retraces when FLAGS_enable_fusion flips and (b) the
+        # persistent-cache key (built over statics) separates fused from
+        # unfused programs. Slot -1 is unreachable by the leaf rebuild in
+        # jit_target (it iterates range(num_leaves)).
+        from ..compile import fusion as _fusion
+        if _fusion.enabled():
+            statics = statics + ((-1, ("__fusion__",
+                                       _fusion.fingerprint())),)
 
 
         # The live param binding: jit_target reads this at trace time, so a
@@ -313,7 +325,9 @@ class StaticFunction:
                         out = outer._spmd_traced_call(fn, args_t,
                                                       kwargs_t, params)
                     else:
-                        out = fn(*args_t, **kwargs_t)
+                        from ..compile import fusion as _fusion
+                        out, outer.fusion_stats = _fusion.rewrite_traced(
+                            lambda: fn(*args_t, **kwargs_t))
                     # Thread in-place updates (BatchNorm running stats
                     # via set_value) out of the trace so the caller can
                     # write them back. String keys: the mutated dict
@@ -349,7 +363,12 @@ class StaticFunction:
                     # call). Only the propagation env needs the spec.
                     sc.seed(p, spec, constrain=False)
             sc.seed_tree((args_t, kwargs_t), self._spmd_in_specs)
-            out = fn(*args_t, **kwargs_t)
+            # fusion runs INSIDE the propagation scope: the re-emitted
+            # fused ops dispatch through the scope's recorder hook, so
+            # their spmd_rules annotate the fused program's tracers
+            from ..compile import fusion as _fusion
+            out, self.fusion_stats = _fusion.rewrite_traced(
+                lambda: fn(*args_t, **kwargs_t))
         self.spmd_stats = dict(sc.stats)
         return out
 

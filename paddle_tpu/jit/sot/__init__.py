@@ -280,7 +280,8 @@ class Segment:
         return lazies
 
     def add_with_structure(self, op_name: str, f: Callable,
-                           arrays: Sequence, attr_key: str = ""):
+                           arrays: Sequence, attr_key: str = "",
+                           attrs=None):
         in_refs = tuple(self._ref_of(a) for a in arrays)
         avals = [a.aval if isinstance(a, LazyArray) and a._value is None
                  else _aval_of(a) for a in arrays]
@@ -288,7 +289,12 @@ class Segment:
         multi = isinstance(out, (tuple, list))
         out_avals = list(out) if multi else [out]
         node_id = len(self.nodes)
-        self.nodes.append((op_name, f, in_refs, len(out_avals), attr_key))
+        # semantic attrs + shapes ride the node so the graph-fusion pass
+        # (compile/fusion.fuse_sot_nodes) can pattern-match the segment
+        io_shapes = (tuple(tuple(a.shape) for a in avals),
+                     tuple(tuple(a.shape) for a in out_avals))
+        self.nodes.append((op_name, f, in_refs, len(out_avals), attr_key,
+                           dict(attrs or {}), io_shapes))
         lazies = [LazyArray(self, node_id, i, av)
                   for i, av in enumerate(out_avals)]
         self._lazy.extend(weakref.ref(l) for l in lazies)
@@ -298,7 +304,7 @@ class Segment:
     def fingerprint(self, out_refs) -> tuple:
         return (
             tuple((op, attr_key, in_refs, n_out)
-                  for op, _f, in_refs, n_out, attr_key in self.nodes),
+                  for op, _f, in_refs, n_out, attr_key, *_ in self.nodes),
             tuple((tuple(_aval_of(a).shape), str(_aval_of(a).dtype))
                   for a in self.ext_arrays),
             tuple(out_refs),
@@ -317,6 +323,11 @@ class Segment:
                 if l is not None and l._value is None]
         out_refs = sorted({(l.node_id, l.out_idx) for l in live})
         key = (self.owner.site_idx, self.fingerprint(out_refs))
+        from ...compile import fusion as _fusion
+        if _fusion.enabled():
+            # fused and unfused compiles of one segment must never share
+            # a cache entry (in-memory or persistent)
+            key = key + (_fusion.fingerprint(),)
         jitted = self.owner.cache.get(key)
         if jitted is not None:
             # LRU touch: FIFO eviction would throw out the steady-state
@@ -330,15 +341,43 @@ class Segment:
                 _m_segment_cache.inc(event="miss")
             nodes = self.nodes
 
-            def seg_fn(ext):
-                env: List[List[Any]] = []
-                for _op, f, in_refs, _n, _ak in nodes:
-                    ins = [env[r[1]][r[2]] if r[0] == "n" else ext[r[1]]
-                           for r in in_refs]
-                    o = f(*ins)
-                    env.append(list(o) if isinstance(o, (tuple, list))
-                               else [o])
-                return [env[i][j] for i, j in out_refs]
+            # pattern matching only on a cache MISS: a hit replays the
+            # already-fused compile, and the rewritten/matched counters
+            # stay per-compile (not per-execution)
+            fuse_plan = None
+            if _fusion.enabled():
+                fuse_plan, fstats = _fusion.fuse_sot_nodes(self.nodes,
+                                                           out_refs)
+                if fstats and fstats.get("rewritten"):
+                    self.owner.stats["fusion_rewritten"] = (
+                        self.owner.stats.get("fusion_rewritten", 0)
+                        + sum(fstats["rewritten"].values()))
+
+            if fuse_plan is not None:
+                def seg_fn(ext, _plan=fuse_plan):
+                    # fused replay: env keyed by the ORIGINAL ("n",
+                    # node, out) slots, so out_refs stay valid; values
+                    # interior to a fused chain are simply never written
+                    env: dict = {}
+                    for st in _plan:
+                        ins = [env[r] if r[0] == "n" else ext[r[1]]
+                               for r in st.in_ids]
+                        o = st.fn(*ins)
+                        outs = (list(o) if isinstance(o, (tuple, list))
+                                else [o])
+                        for oid, v in zip(st.out_ids, outs):
+                            env[oid] = v
+                    return [env[("n", i, j)] for i, j in out_refs]
+            else:
+                def seg_fn(ext):
+                    env: List[List[Any]] = []
+                    for _op, f, in_refs, _n, _ak, *_ in nodes:
+                        ins = [env[r[1]][r[2]] if r[0] == "n"
+                               else ext[r[1]] for r in in_refs]
+                        o = f(*ins)
+                        env.append(list(o) if isinstance(o, (tuple, list))
+                                   else [o])
+                    return [env[i][j] for i, j in out_refs]
 
             # persistent compilation cache: a segment already compiled by
             # another process (same ops/shapes/toolchain) deserializes
@@ -692,7 +731,7 @@ def record_or_none(op_name: str, f: Callable, arrays: Sequence,
     attr_key += "#" + fn_fingerprint(f)
     try:
         return seg.add_with_structure(op_name, f, arrays,
-                                      attr_key=attr_key)
+                                      attr_key=attr_key, attrs=attrs)
     except Exception:
         # data-dependent output shape (nonzero, unique, …): break here —
         # flush the prefix and let the op run on concrete values

@@ -85,6 +85,29 @@ def llama2_70b(**kw) -> LlamaConfig:
     return LlamaConfig(**kw)
 
 
+def rope_rotate(a, theta, pos_offset):
+    """The rope rotation on a [B, S, H, D] array — THE one copy of the
+    (even, odd)-pair math: `rotary_embedding`'s lowering, the fused
+    `rope_proj` composite (the rewrite's numerics reference), and the
+    rope autotune probes all call this."""
+    b, s, h, d = a.shape
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32)
+                             / half))
+    off = jnp.asarray(pos_offset, jnp.float32)
+    if off.ndim == 0:
+        off = off[None]                        # (1,) broadcast over B
+    positions = (off[:, None]
+                 + jnp.arange(s, dtype=jnp.float32)[None, :])
+    pos = positions[:, :, None] * freqs[None, None, :]
+    cos = jnp.cos(pos)[:, :, None, :]          # (B|1, S, 1, half)
+    sin = jnp.sin(pos)[:, :, None, :]
+    x1, x2 = a[..., :half], a[..., half:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+        axis=-1).astype(a.dtype)
+
+
 def rotary_embedding(x, theta: float = 10000.0, pos_offset=0):
     """Apply RoPE to [B, S, H, D] (reference fused_rope op). Pairs are the
     (even, odd) channel convention. ``pos_offset`` may be a python int, a
@@ -92,24 +115,20 @@ def rotary_embedding(x, theta: float = 10000.0, pos_offset=0):
     or a per-batch ``(B,)`` vector (continuous-batching serving: every
     sequence in the batch sits at a different length)."""
     def f(a):
-        b, s, h, d = a.shape
-        half = d // 2
-        freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32)
-                                 / half))
-        off = jnp.asarray(pos_offset, jnp.float32)
-        if off.ndim == 0:
-            off = off[None]                        # (1,) broadcast over B
-        positions = (off[:, None]
-                     + jnp.arange(s, dtype=jnp.float32)[None, :])
-        pos = positions[:, :, None] * freqs[None, None, :]
-        cos = jnp.cos(pos)[:, :, None, :]          # (B|1, S, 1, half)
-        sin = jnp.sin(pos)[:, :, None, :]
-        x1, x2 = a[..., :half], a[..., half:]
-        return jnp.concatenate(
-            [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-            axis=-1).astype(a.dtype)
+        return rope_rotate(a, theta, pos_offset)
+    # static (python-int) offsets ride the IR record as semantic attrs
+    # so compile/fusion can fold rope into the projection; traced /
+    # per-batch offsets keep the op opaque (and unfusable), as before
+    attrs = None
+    if isinstance(pos_offset, int):
+        attrs = {"theta": float(theta), "pos_offset": int(pos_offset)}
+
+        def f(a, theta=float(theta), pos_offset=int(pos_offset),
+              __f=f):
+            return __f(a)
     return dispatch.call("rotary_embedding", f,
-                         [x if isinstance(x, Tensor) else Tensor(x)])
+                         [x if isinstance(x, Tensor) else Tensor(x)],
+                         attrs=attrs)
 
 
 def _linears(cfg: LlamaConfig):

@@ -43,8 +43,13 @@ log_sigmoid = _unary("log_sigmoid", jax.nn.log_sigmoid,
 def gelu(x, approximate=False, name=None):
     """Gaussian error linear unit, exact or tanh approximation (reference
     gelu)."""
-    return dispatch.call("gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
-                         [_t(x)])
+    # the approximate flag rides the IR record as a semantic attr —
+    # compile/fusion folds it into the fused epilogue it rewrites to
+    return dispatch.call(
+        "gelu",
+        lambda a, approximate=approximate: jax.nn.gelu(
+            a, approximate=approximate),
+        [_t(x)], attrs={"approximate": bool(approximate)})
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):

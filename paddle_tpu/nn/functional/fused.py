@@ -1,0 +1,564 @@
+"""First-class fused ops — the rewrite targets of the graph-fusion pass.
+
+Capability parity with the reference's ``fused_ops.yaml`` hot set
+(reference: paddle/phi/kernels/fusion/ — fused_bias_act,
+fused_layernorm/fused_rms_norm [residual in-pass], fused_rope). Each op
+here is ONE registered OpDef (category ``fusion``) with two
+implementations:
+
+* **xla** — the fused jnp composite: numerically identical to the
+  unfused op chain it replaces (XLA fuses the expression either way),
+  portable to every backend. This is the reference implementation the
+  Pallas path's backward recomputes through, and the "unfused" leg of
+  the autotune comparison.
+* **pallas** — the hand-tiled TPU kernel (:mod:`...ops.pallas.fused_ops`)
+  that collapses the chain's HBM round-trips into one pass.
+
+Implementation choice is a per-shape-class measured decision through
+the round-5 autotuner (``ops/pallas/autotune.py``): the candidate grid
+is ``["xla", ("pallas", tile…)…]`` so one cached winner encodes both
+the implementation and its tile sizes. Off-TPU (or with
+``FLAGS_use_autotune=0``) the composite is the default; tests force the
+kernel path by flipping ``fused_ops.INTERPRET``.
+
+Gradients: the Pallas forwards carry a ``jax.custom_vjp`` whose
+backward is ``jax.vjp`` of the composite (FA2-style recompute) — so
+eager, to_static, and fused-pass gradients agree with the unfused chain
+to float tolerance by construction.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch, flags
+from ...core.tensor import Tensor, as_tensor
+from ...ops.registry import register
+
+__all__ = ["fused_bias_act", "fused_residual_norm", "fused_norm_linear",
+           "fused_rope_proj", "FUSED_OPS", "ACTIVATIONS"]
+
+#: the closed fused-op vocabulary (tools/fusion_audit.py pivots on this)
+FUSED_OPS = ("fused_bias_act", "fused_residual_norm",
+             "fused_norm_linear", "fused_rope_proj")
+
+ACTIVATIONS = ("gelu", "gelu_tanh", "silu", "relu")
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+def _act(y, activation: str):
+    """Single activation vocabulary shared with the Pallas kernels —
+    one implementation, so composite and kernel can never disagree on
+    what an activation name means."""
+    from ...ops.pallas.fused_ops import _act_apply
+    return _act_apply(y, activation)
+
+
+def _norm32(a32, w32, b32, norm_type: str, eps: float):
+    """fp32 row-norm matching nn.functional.norm exactly (bit-for-bit
+    numerics parity with the unfused chain is the rewrite contract)."""
+    if norm_type == "rms_norm":
+        ms = jnp.mean(a32 * a32, axis=-1, keepdims=True)
+        y = a32 / jnp.sqrt(ms + eps)
+    else:
+        mean = jnp.mean(a32, axis=-1, keepdims=True)
+        var = jnp.var(a32, axis=-1, keepdims=True)
+        y = (a32 - mean) / jnp.sqrt(var + eps)
+    if w32 is not None:
+        y = y * w32
+    if b32 is not None:
+        y = y + b32
+    return y
+
+
+# --------------------------------------------------------------------------
+# Implementation selection (round-5 autotuner reuse)
+# --------------------------------------------------------------------------
+def _pallas_forced() -> bool:
+    """CPU tests flip fused_ops.INTERPRET to exercise the kernel path."""
+    from ...ops.pallas import fused_ops as FK
+    return FK.INTERPRET
+
+
+def _choose_impl(kind: str, key_attrs: dict, tile_candidates,
+                 make_run, default_tile):
+    """Measured winner for this shape class: ``"xla"`` or
+    ``("pallas", *tile)``. ``make_run(cand)`` returns a nullary jitted
+    probe executor; measurement happens once per (key, chip) and
+    persists via the autotune cache."""
+    from ...ops.pallas import autotune as at
+
+    if not flags.get_flag("use_pallas_kernels"):
+        return "xla"
+    if _pallas_forced():
+        return ("pallas",) + tuple(default_tile)
+    if not at.is_tpu_backend():
+        return "xla"
+    if not at.should_autotune():
+        # real TPU, autotune off: hand-tuned default tiles
+        return ("pallas",) + tuple(default_tile)
+    key = at.make_key(f"fused_{kind}", **key_attrs)
+    cached = at.get_cache().get(key)
+    if cached is not None:
+        return tuple(cached) if isinstance(cached, list) else cached
+    candidates = ["xla"] + [("pallas",) + tuple(t)
+                            for t in tile_candidates]
+    jitted = {}
+
+    def run(cand, i):
+        c_key = repr(cand)
+        fn = jitted.get(c_key)
+        if fn is None:
+            fn = jitted[c_key] = make_run(cand)
+        return fn(i)
+
+    won = at.autotune(key, candidates, run, "xla")
+    return tuple(won) if isinstance(won, list) else won
+
+
+def _with_composite_vjp(pallas_fwd, composite):
+    """Pallas forward + composite-recompute backward (the fused kernels
+    have no hand-written backward; recompute through the numerics
+    reference keeps gradient parity by construction)."""
+
+    @jax.custom_vjp
+    def op(*args):
+        return pallas_fwd(*args)
+
+    def fwd(*args):
+        return pallas_fwd(*args), args
+
+    def bwd(res, g):
+        return jax.vjp(composite, *res)[1](g)
+
+    op.defvjp(fwd, bwd)
+    return op
+
+
+def _probe_arrays(shapes, dtype, nvar=3):
+    """Distinct random probe inputs (replay-caching backends fake
+    repeat-identical executions; see autotune docstring)."""
+    outs = []
+    for i in range(nvar):
+        key = jax.random.key(i)
+        outs.append([jax.random.normal(jax.random.fold_in(key, j),
+                                       s).astype(dtype)
+                     for j, s in enumerate(shapes)])
+    return outs
+
+
+# --------------------------------------------------------------------------
+# Lowering factories — shared by the eager functionals and the fusion
+# pass (the pass binds these as the fused _OpRecord lowerings)
+# --------------------------------------------------------------------------
+def bias_act_lowering(activation: str):
+    def f(x, b, activation=activation):
+        def composite(x, b):
+            # natural jnp promotion — the unfused chain is add(x, b)
+            # (jnp.add) then act, so mixed-dtype inputs must promote
+            # identically, not cast down to x.dtype
+            return _act(x + b, activation)
+
+        impl = _choose_bias_act_impl(x.shape, b.shape, x.dtype,
+                                     activation)
+        if impl == "xla" or b.dtype != x.dtype:
+            # mixed dtypes take the composite: the Pallas path computes
+            # in x.dtype, which would silently change the output dtype
+            return composite(x, b)
+        from ...ops.pallas import fused_ops as FK
+        rows = int(_numel(x.shape[:-1]))
+
+        def pallas_fwd(x, b, _t=impl[1:]):
+            y = FK.fused_bias_act(x.reshape(rows, x.shape[-1]),
+                                  b.astype(x.dtype), act=activation,
+                                  block_rows=_t[0])
+            return y.reshape(x.shape)
+
+        return _with_composite_vjp(pallas_fwd, composite)(x, b)
+    return f
+
+
+def _numel(shape):
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _choose_bias_act_impl(x_shape, b_shape, dtype, activation):
+    from ...ops.pallas import autotune as at
+
+    rows, d = _numel(x_shape[:-1]), int(x_shape[-1])
+    if d % 128 or rows < 8:
+        return "xla"
+
+    def make_run(cand):
+        from ...ops.pallas import fused_ops as FK
+        probes = _probe_arrays([(min(at.seq_bucket(rows), 4096), d),
+                                (d,)], dtype)
+
+        if cand == "xla":
+            fn = jax.jit(lambda x, b: _act(x + b, activation))
+        else:
+            fn = jax.jit(functools.partial(
+                FK.fused_bias_act, act=activation, block_rows=cand[1]))
+        return lambda i, _f=fn, _p=probes: _f(*_p[i % len(_p)])
+
+    from ...ops.pallas import fused_ops as FK
+    return _choose_impl(
+        "bias_act", dict(r=at.seq_bucket(rows), d=d, dt=str(dtype),
+                         act=activation),
+        [(r,) for r in FK.NORM_ROW_CANDIDATES], make_run,
+        (FK.DEFAULT_NORM_ROWS,))
+
+
+def residual_norm_lowering(norm_type: str, epsilon: float, has_w: bool,
+                           has_b: bool):
+    def f(x, res, *wb, norm_type=norm_type, epsilon=epsilon):
+        def composite(x, res, *wb):
+            # s promotes like the unfused add(x, res); the norm returns
+            # s.dtype like the unfused layer_norm/rms_norm lowering
+            s = x + res
+            i = 0
+            w32 = wb[i].astype(jnp.float32) if has_w else None
+            i += has_w
+            b32 = wb[i].astype(jnp.float32) if has_b else None
+            y = _norm32(s.astype(jnp.float32), w32, b32, norm_type,
+                        epsilon)
+            return y.astype(s.dtype), s
+
+        d = int(x.shape[-1])
+        impl = _choose_norm_impl("residual_norm", x.shape, x.dtype,
+                                 norm_type)
+        if impl == "xla" or res.dtype != x.dtype:
+            # mixed dtypes take the composite (Pallas computes in
+            # x.dtype and would change the outputs' dtype)
+            return composite(x, res, *wb)
+        from ...ops.pallas import fused_ops as FK
+        rows = _numel(x.shape[:-1])
+
+        def pallas_fwd(x, res, *wb, _t=impl[1:]):
+            i = 0
+            w = wb[i].astype(x.dtype) if has_w else jnp.ones(
+                (d,), x.dtype)
+            i += has_w
+            b = wb[i].astype(x.dtype) if has_b else jnp.zeros(
+                (d,), x.dtype)
+            y, s = FK.fused_residual_norm(
+                x.reshape(rows, d), res.reshape(rows, d), w, b,
+                kind=norm_type, eps=epsilon, block_rows=_t[0])
+            return y.reshape(x.shape), s.reshape(x.shape)
+
+        return _with_composite_vjp(pallas_fwd, composite)(x, res, *wb)
+    return f
+
+
+def _choose_norm_impl(kind, x_shape, dtype, norm_type):
+    from ...ops.pallas import autotune as at
+    from ...ops.pallas import fused_ops as FK
+
+    rows, d = _numel(x_shape[:-1]), int(x_shape[-1])
+    if not FK.pallas_ok_norm(rows, d):
+        return "xla"
+
+    def make_run(cand):
+        pr = min(at.seq_bucket(rows), 4096)
+        probes = _probe_arrays([(pr, d), (pr, d), (d,), (d,)], dtype)
+        if cand == "xla":
+            def xf(x, r, w, b):
+                s = x + r
+                return _norm32(s.astype(jnp.float32),
+                               w.astype(jnp.float32),
+                               b.astype(jnp.float32), norm_type,
+                               1e-5).astype(x.dtype), s
+            fn = jax.jit(xf)
+        else:
+            fn = jax.jit(functools.partial(
+                FK.fused_residual_norm, kind=norm_type, eps=1e-5,
+                block_rows=cand[1]))
+        return lambda i, _f=fn, _p=probes: _f(*_p[i % len(_p)])
+
+    return _choose_impl(
+        kind, dict(r=at.seq_bucket(rows), d=d, dt=str(dtype),
+                   nt=norm_type),
+        [(r,) for r in FK.NORM_ROW_CANDIDATES], make_run,
+        (FK.DEFAULT_NORM_ROWS,))
+
+
+def norm_linear_lowering(norm_type: str, epsilon: float,
+                         activation: str, has_bias: bool, has_nw: bool,
+                         has_nb: bool):
+    """x(…, K) [+norm params] @ W(K, N) [+bias] [+act] as one op.
+    Input order: (x, weight[, bias][, norm_weight][, norm_bias])."""
+    def f(x, w, *rest, norm_type=norm_type, epsilon=epsilon,
+          activation=activation):
+        i = 0
+        b = rest[i] if has_bias else None
+        i += has_bias
+        nw = rest[i] if has_nw else None
+        i += has_nw
+        nb = rest[i] if has_nb else None
+
+        def composite(x, w, *rest):
+            i = 0
+            b = rest[i] if has_bias else None
+            i += has_bias
+            nw = rest[i] if has_nw else None
+            i += has_nw
+            nb = rest[i] if has_nb else None
+            xn = x
+            if norm_type:
+                xn = _norm32(
+                    x.astype(jnp.float32),
+                    nw.astype(jnp.float32) if nw is not None else None,
+                    nb.astype(jnp.float32) if nb is not None else None,
+                    norm_type, epsilon).astype(x.dtype)
+            y = jnp.matmul(xn, w.astype(xn.dtype))
+            if b is not None:
+                y = y + b.astype(y.dtype)
+            return _act(y, activation)
+
+        k = int(x.shape[-1])
+        n = int(w.shape[-1])
+        impl = _choose_norm_linear_impl(x.shape, k, n, x.dtype,
+                                        norm_type, activation)
+        if impl == "xla":
+            return composite(x, w, *rest)
+        from ...ops.pallas import fused_ops as FK
+        rows = _numel(x.shape[:-1])
+
+        def pallas_fwd(x, w, *rest, _t=impl[1:]):
+            i = 0
+            b = rest[i] if has_bias else None
+            i += has_bias
+            nw = rest[i] if has_nw else None
+            i += has_nw
+            nb = rest[i] if has_nb else None
+            y = FK.fused_matmul(
+                x.reshape(rows, k), w.astype(x.dtype),
+                b.astype(x.dtype) if b is not None else None,
+                nw.astype(x.dtype) if nw is not None else None,
+                nb.astype(x.dtype) if nb is not None else None,
+                norm_kind=norm_type, act=activation, eps=epsilon,
+                block_m=_t[0], block_n=_t[1])
+            return y.reshape(x.shape[:-1] + (n,))
+
+        return _with_composite_vjp(pallas_fwd, composite)(x, w, *rest)
+    return f
+
+
+def _choose_norm_linear_impl(x_shape, k, n, dtype, norm_type,
+                             activation):
+    from ...ops.pallas import autotune as at
+    from ...ops.pallas import fused_ops as FK
+
+    rows = _numel(x_shape[:-1])
+    bm, bn = FK.DEFAULT_BLOCK_M, FK.DEFAULT_BLOCK_N
+    bm = max(8, min(bm, max(rows, 8)))
+    bn = min(bn, n)
+    if not FK.pallas_ok_matmul(rows, k, n, bm, bn):
+        return "xla"
+
+    def make_run(cand):
+        pr = min(at.seq_bucket(rows), 2048)
+        probes = _probe_arrays([(pr, k), (k, n), (n,), (k,), (k,)],
+                               dtype)
+        if cand == "xla":
+            def xf(x, w, b, nw, nb):
+                xn = _norm32(x.astype(jnp.float32),
+                             nw.astype(jnp.float32),
+                             nb.astype(jnp.float32),
+                             norm_type or "layer_norm",
+                             1e-5).astype(x.dtype) if norm_type else x
+                return _act(jnp.matmul(xn, w) + b, activation)
+            fn = jax.jit(xf)
+        else:
+            fn = jax.jit(functools.partial(
+                FK.fused_matmul, norm_kind=norm_type, act=activation,
+                eps=1e-5, block_m=cand[1], block_n=cand[2]))
+        return lambda i, _f=fn, _p=probes: _f(*_p[i % len(_p)])
+
+    tiles = [t for t in FK.MATMUL_TILE_CANDIDATES
+             if FK.pallas_ok_matmul(rows, k, n, min(t[0], max(rows, 8)),
+                                    min(t[1], n))]
+    return _choose_impl(
+        "norm_linear", dict(r=at.seq_bucket(rows), k=k, n=n,
+                            dt=str(dtype), nt=norm_type or "",
+                            act=activation or ""),
+        tiles or [(bm, bn)], make_run, (bm, bn))
+
+
+def rope_proj_lowering(num_heads: int, theta: float, pos_offset: int,
+                       has_bias: bool):
+    """x(B, S, K) @ W(K, H*D) → rope-rotated (B, S, H, D)."""
+    def f(x, w, *rest, num_heads=num_heads, theta=theta,
+          pos_offset=pos_offset):
+        b = rest[0] if has_bias else None
+        n = int(w.shape[-1])
+        head_dim = n // num_heads
+
+        def composite(x, w, *rest):
+            from ...models.llama import rope_rotate
+            b = rest[0] if has_bias else None
+            y = jnp.matmul(x, w.astype(x.dtype))
+            if b is not None:
+                y = y + b.astype(y.dtype)
+            bt, s = int(x.shape[0]), int(x.shape[1])
+            a = y.reshape(bt, s, num_heads, head_dim)
+            return rope_rotate(a, theta, pos_offset)
+
+        impl = _choose_rope_impl(x.shape, n, head_dim, x.dtype, theta)
+        if impl == "xla":
+            return composite(x, w, *rest)
+        from ...ops.pallas import fused_ops as FK
+        bt, s, k = (int(d) for d in x.shape)
+
+        def pallas_fwd(x, w, *rest, _t=impl[1:]):
+            b = rest[0] if has_bias else None
+            y = FK.fused_matmul_rope(
+                x.reshape(bt * s, k), w.astype(x.dtype),
+                b.astype(x.dtype) if b is not None else None,
+                seq=s, head_dim=head_dim, theta=theta,
+                pos_offset=pos_offset, block_m=_t[0], block_n=_t[1])
+            return y.reshape(bt, s, num_heads, head_dim)
+
+        return _with_composite_vjp(pallas_fwd, composite)(x, w, *rest)
+    return f
+
+
+def _choose_rope_impl(x_shape, n, head_dim, dtype, theta):
+    from ...ops.pallas import autotune as at
+    from ...ops.pallas import fused_ops as FK
+
+    if len(x_shape) != 3:
+        return "xla"
+    rows, k = _numel(x_shape[:-1]), int(x_shape[-1])
+    bm, bn = FK.DEFAULT_BLOCK_M, FK.DEFAULT_BLOCK_N
+    bm = max(8, min(bm, max(rows, 8)))
+    bn = min(bn, n)
+    if bn % head_dim:
+        bn = (bn // head_dim or 1) * head_dim
+    if not FK.pallas_ok_matmul_rope(rows, k, n, head_dim, bm, bn):
+        return "xla"
+    # tile grid filtered to rope-legal candidates
+    tiles = [t for t in FK.MATMUL_TILE_CANDIDATES
+             if FK.pallas_ok_matmul_rope(
+                 rows, k, n, head_dim, min(t[0], max(rows, 8)),
+                 min(t[1], n))]
+
+    def make_run(cand):
+        from ...models.llama import rope_rotate
+        s_b = min(at.seq_bucket(int(x_shape[1])), 2048)
+        probes = _probe_arrays([(2, s_b, k), (k, n)], dtype)
+        if cand == "xla":
+            heads = n // head_dim
+
+            def xf(x, w):
+                y = jnp.matmul(x, w)
+                a = y.reshape(x.shape[0], x.shape[1], heads, head_dim)
+                return rope_rotate(a, theta, 0)
+            fn = jax.jit(xf)
+        else:
+            def pf(x, w, _c=cand):
+                return FK.fused_matmul_rope(
+                    x.reshape(-1, k), w, None, seq=x.shape[1],
+                    head_dim=head_dim, theta=theta, pos_offset=0,
+                    block_m=_c[1], block_n=_c[2])
+            fn = jax.jit(pf)
+        return lambda i, _f=fn, _p=probes: _f(*_p[i % len(_p)])
+
+    return _choose_impl(
+        "rope_proj", dict(r=at.seq_bucket(rows), k=k, n=n,
+                          hd=head_dim, dt=str(dtype)),
+        tiles or [(bm, bn)], make_run, (bm, bn))
+
+
+# --------------------------------------------------------------------------
+# Public functionals (registered OpDefs, category "fusion")
+# --------------------------------------------------------------------------
+@register("fused_bias_act", "fusion")
+def fused_bias_act(x, bias, activation="gelu", name=None):
+    """act(x + bias) as ONE op (reference fused_bias_act): bias add and
+    activation share a single VPU pass / XLA fusion instead of two HBM
+    round-trips. ``activation``: gelu | gelu_tanh | silu | relu."""
+    x = _t(x)
+    return dispatch.call("fused_bias_act",
+                         bias_act_lowering(activation), [x, _t(bias)],
+                         attrs=None,
+                         export_attrs={"activation": activation})
+
+
+@register("fused_residual_norm", "fusion")
+def fused_residual_norm(x, residual, weight=None, bias=None,
+                        norm_type="layer_norm", epsilon=1e-5,
+                        name=None):
+    """(normed, summed) = norm(x + residual), x + residual — the
+    residual-add + layernorm/rms_norm pair fused into one pass
+    (reference fused_layernorm's residual input). The sum is a REAL
+    output so the residual stream keeps flowing without recompute."""
+    x = _t(x)
+    inputs = [x, _t(residual)]
+    has_w, has_b = weight is not None, bias is not None
+    if has_w:
+        inputs.append(_t(weight))
+    if has_b:
+        inputs.append(_t(bias))
+    return dispatch.call(
+        "fused_residual_norm",
+        residual_norm_lowering(norm_type, epsilon, has_w, has_b),
+        inputs, multi_output=True,
+        export_attrs={"norm_type": norm_type, "epsilon": epsilon})
+
+
+@register("fused_norm_linear", "fusion")
+def fused_norm_linear(x, weight, bias=None, norm_weight=None,
+                      norm_bias=None, activation="",
+                      norm_type="layer_norm", epsilon=1e-5, name=None):
+    """act(norm(x) @ W + b) as ONE op — the layernorm/rms_norm → linear
+    → bias → activation chain (reference fused_bias_act +
+    fused_layernorm around a GEMM). ``norm_type=''`` skips the norm
+    (plain linear+bias+act); ``activation=''`` skips the epilogue."""
+    x = _t(x)
+    inputs = [x, _t(weight)]
+    has_bias = bias is not None
+    has_nw = norm_weight is not None
+    has_nb = norm_bias is not None
+    if has_bias:
+        inputs.append(_t(bias))
+    if has_nw:
+        inputs.append(_t(norm_weight))
+    if has_nb:
+        inputs.append(_t(norm_bias))
+    return dispatch.call(
+        "fused_norm_linear",
+        norm_linear_lowering(norm_type, epsilon, activation, has_bias,
+                             has_nw, has_nb),
+        inputs,
+        export_attrs={"norm_type": norm_type, "activation": activation,
+                      "epsilon": epsilon})
+
+
+@register("fused_rope_proj", "fusion")
+def fused_rope_proj(x, weight, bias=None, num_heads=1, theta=10000.0,
+                    pos_offset=0, name=None):
+    """rope(reshape(x @ W + b, heads)) as ONE op (reference fused_rope
+    applied to the QKV projection): the projection lands in HBM already
+    split into heads and rotary-rotated. ``pos_offset`` must be a
+    python int (decode-time traced offsets stay on the unfused path)."""
+    x = _t(x)
+    inputs = [x, _t(weight)]
+    has_bias = bias is not None
+    if has_bias:
+        inputs.append(_t(bias))
+    return dispatch.call(
+        "fused_rope_proj",
+        rope_proj_lowering(int(num_heads), float(theta),
+                           int(pos_offset), has_bias),
+        inputs,
+        export_attrs={"num_heads": num_heads, "theta": theta,
+                      "pos_offset": pos_offset})

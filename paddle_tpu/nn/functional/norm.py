@@ -35,7 +35,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     if has_b:
         inputs.append(_t(bias))
 
-    def f(a, *wb):
+    def f(a, *wb, **_attrs):
+        # semantic attrs ride the IR record (dispatch passes them back as
+        # kwargs); the lowering itself closes over the python values
         dt = a.dtype
         a32 = a.astype(jnp.float32)
         mean = jnp.mean(a32, axis=axes, keepdims=True)
@@ -48,7 +50,11 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         if has_b:
             y = y + wb[i].astype(jnp.float32)
         return y.astype(dt)
-    return dispatch.call("layer_norm", f, inputs)
+    # semantic attrs ride the IR record (compile/fusion reads epsilon +
+    # the normalized-dim count + affine layout to build the rewrite)
+    return dispatch.call("layer_norm", f, inputs,
+                         attrs={"epsilon": epsilon, "norm_ndim": ndim,
+                                "has_w": has_w, "has_b": has_b})
 
 
 def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
@@ -66,7 +72,9 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
     if has_b:
         inputs.append(_t(bias))
 
-    def f(a, *wb):
+    def f(a, *wb, **_attrs):
+        # semantic attrs ride the IR record (dispatch passes them back as
+        # kwargs); the lowering itself closes over the python values
         dt = a.dtype
         a32 = a.astype(jnp.float32)
         ms = jnp.mean(a32 * a32, axis=axes, keepdims=True)
@@ -78,7 +86,10 @@ def rms_norm(x, weight=None, bias=None, epsilon=1e-6, begin_norm_axis=-1,
         if has_b:
             y = y + wb[i].astype(jnp.float32)
         return y.astype(dt)
-    return dispatch.call("rms_norm", f, inputs)
+    return dispatch.call("rms_norm", f, inputs,
+                         attrs={"epsilon": epsilon,
+                                "norm_ndim": x.ndim - axis,
+                                "has_w": has_w, "has_b": has_b})
 
 
 def jax_rsqrt(v):

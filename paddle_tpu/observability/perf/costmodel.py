@@ -225,6 +225,41 @@ def cross_entropy_cost(input_shapes, input_dtypes, attrs,
     return OpCost(6.0 * n, read, written, "softmax+nll")
 
 
+def fused_residual_norm_cost(input_shapes, input_dtypes, attrs,
+                             output_shapes) -> OpCost:
+    """residual add (1) + norm (~8) flops/element; traffic = x +
+    residual in, normed + sum out (the fusion's whole point: no
+    intermediate round-trip)."""
+    n = _numel(input_shapes[0]) if input_shapes else 0
+    read, written = _io_bytes(input_shapes, input_dtypes, output_shapes)
+    return OpCost(9.0 * n, read, written, "fused residual+norm")
+
+
+def fused_norm_linear_cost(input_shapes, input_dtypes, attrs,
+                           output_shapes) -> OpCost:
+    """norm prologue (~8/elt of x) + GEMM + bias/act epilogue (~5/elt
+    of out); traffic = x + W (+vectors) in, ONE output out."""
+    mm = matmul_cost(input_shapes[:2] if len(input_shapes) >= 2
+                     else input_shapes, input_dtypes, {}, output_shapes)
+    n_in = _numel(input_shapes[0]) if input_shapes else 0
+    n_out = _numel(output_shapes[0]) if output_shapes else 0
+    read, written = _io_bytes(input_shapes, input_dtypes, output_shapes)
+    return OpCost(mm.flops + 8.0 * n_in + 5.0 * n_out, read, written,
+                  "fused norm+linear+act")
+
+
+def fused_rope_proj_cost(input_shapes, input_dtypes, attrs,
+                         output_shapes) -> OpCost:
+    """GEMM + rotary epilogue (~6 flops/output element, incl. the
+    sin/cos transcendentals)."""
+    mm = matmul_cost(input_shapes[:2] if len(input_shapes) >= 2
+                     else input_shapes, input_dtypes, {}, output_shapes)
+    n_out = _numel(output_shapes[0]) if output_shapes else 0
+    read, written = _io_bytes(input_shapes, input_dtypes, output_shapes)
+    return OpCost(mm.flops + 6.0 * n_out, read, written,
+                  "fused rope projection")
+
+
 def collective_cost(primitive: str, nbytes: float,
                     n_devices: int) -> OpCost:
     """Wire bytes of one collective under the standard ring algorithms
@@ -288,6 +323,12 @@ def _fill_models():
     for name in ("exp", "log", "tanh", "sigmoid", "gelu", "silu", "swish",
                  "erf", "sin", "cos", "pow", "softplus", "log1p"):
         COST_MODELS[name] = ew4
+    # fused ops (compile/fusion rewrite targets) — round-12 attribution
+    # must see through the rewrite (ISSUE 10)
+    COST_MODELS["fused_bias_act"] = elementwise_cost(5.0)
+    COST_MODELS["fused_residual_norm"] = fused_residual_norm_cost
+    COST_MODELS["fused_norm_linear"] = fused_norm_linear_cost
+    COST_MODELS["fused_rope_proj"] = fused_rope_proj_cost
 
 
 _fill_models()
@@ -306,6 +347,9 @@ _CATEGORY_MODELS: Dict[str, Callable] = {
     "creation": elementwise_cost(0.0),
     "indexing": gather_cost,
     "search": reduction_cost,
+    # fused ops carry NAMED models (COST_MODELS above); this fallback
+    # only covers future fused registrations that miss the audit gate
+    "fusion": elementwise_cost(4.0),
 }
 
 

@@ -228,6 +228,9 @@ def _register_all():
         register_module(mod, cat, skip=skip)
     from ..nn.functional import flash_attention as _fa
     register_module(_fa, "attention")
+    # fused ops self-register via @register decorators (category
+    # "fusion" with cost/spmd coverage gated by tools/fusion_audit.py)
+    from ..nn.functional import fused as _fused  # noqa: F401
     from ..nn.functional import vision as _vis
     register_module(_vis, "vision")
     from ..nn.functional import paged_attention as _paged

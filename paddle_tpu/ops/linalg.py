@@ -162,19 +162,34 @@ def cross(x, y, axis=9, name=None):
 
 def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
     """Fixed-bin histogram counts over [min, max] (reference paddle.histogram).
+
+    In-graph: the bin count is static (output shape ``(bins,)``), the
+    range — when defaulted to the data's min/max — is computed as traced
+    values, so the op jits/fuses instead of forcing a host round-trip.
     """
     xt = _t(input)
-    arr = np.asarray(xt._data)
-    lo, hi = (min, max) if (min != 0 or max != 0) else (arr.min(), arr.max())
-    w = np.asarray(weight._data) if weight is not None else None
-    hist, _ = np.histogram(arr, bins=bins, range=(lo, hi), weights=w, density=density)
-    return Tensor(jnp.asarray(hist if density else hist.astype(np.int64)))
+    inputs = [xt]
+    if weight is not None:
+        inputs.append(_t(weight))
+
+    def f(a, *w):
+        rng = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        hist, _ = jnp.histogram(a.astype(jnp.float32), bins=bins, range=rng,
+                                weights=w[0] if w else None, density=density)
+        import jax.dtypes
+        return hist if density else hist.astype(
+            jax.dtypes.canonicalize_dtype(np.int64))
+    return dispatch.call("histogram", f, inputs,
+                         differentiable_mask=[False] * len(inputs))
 
 
 def bincount(x, weights=None, minlength=0, name=None):
     """Count occurrences of each non-negative int, optional weights (reference
     paddle.bincount)."""
     xt = _t(x)
+    # the OUTPUT SHAPE is data-dependent (length = max(x)+1): sizing it is
+    # inherently a host decision — jnp.bincount needs a static `length`
+    # tpulint: disable=TPU103,TPU104 data-dependent output shape, host-by-design
     n = builtins_max(int(np.asarray(xt._data).max(initial=-1)) + 1, minlength)
     if weights is not None:
         return dispatch.call("bincount",
@@ -253,12 +268,31 @@ def lu(x, pivot=True, get_infos=False, name=None):
     return outs
 
 
+def _eig_cdtype():
+    """Canonical complex eigenvalue dtype (complex64 unless x64 is on)."""
+    import jax.dtypes
+    return jax.dtypes.canonicalize_dtype(np.complex128)
+
+
 def eig(x, name=None):
-    """Eigenpairs of a general matrix (host LAPACK path: XLA has no general
-    eig) (reference paddle.linalg.eig)."""
-    arr = np.asarray(_t(x)._data)  # CPU fallback: general eig not on TPU
-    w, v = np.linalg.eig(arr)
-    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+    """Eigenpairs of a general matrix (reference paddle.linalg.eig).
+
+    XLA has no general (non-hermitian) eigendecomposition, but the output
+    shapes are STATIC — values ``(..., n)`` complex, vectors ``(..., n, n)``
+    complex — so the LAPACK call runs as a host callback inside the graph
+    (``jax.pure_callback``) and the op stays traceable under jit/to_static.
+    """
+    xt = _t(x)
+    cdtype = _eig_cdtype()
+
+    def f(a):
+        def host(m):
+            w, v = np.linalg.eig(np.asarray(m))
+            return w.astype(cdtype), v.astype(cdtype)
+        return tuple(jax.pure_callback(
+            host, (jax.ShapeDtypeStruct(a.shape[:-1], cdtype),
+                   jax.ShapeDtypeStruct(a.shape, cdtype)), a))
+    return dispatch.call("eig", f, [xt], differentiable_mask=[False])
 
 
 def eigh(x, UPLO="L", name=None):
@@ -269,10 +303,19 @@ def eigh(x, UPLO="L", name=None):
 
 
 def eigvals(x, name=None):
-    """Eigenvalues of a general matrix (host LAPACK path) (reference
-    paddle.linalg.eigvals)."""
-    arr = np.asarray(_t(x)._data)
-    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+    """Eigenvalues of a general matrix (reference paddle.linalg.eigvals).
+
+    Same in-graph host-callback treatment as :func:`eig` — static output
+    shape ``(..., n)`` complex, LAPACK via ``jax.pure_callback``."""
+    xt = _t(x)
+    cdtype = _eig_cdtype()
+
+    def f(a):
+        def host(m):
+            return np.linalg.eigvals(np.asarray(m)).astype(cdtype)
+        return jax.pure_callback(
+            host, jax.ShapeDtypeStruct(a.shape[:-1], cdtype), a)
+    return dispatch.call("eigvals", f, [xt], differentiable_mask=[False])
 
 
 def eigvalsh(x, UPLO="L", name=None):

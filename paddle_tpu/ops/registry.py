@@ -46,6 +46,7 @@ OPS: Dict[str, OpDef] = {}
 KNOWN_CATEGORIES = frozenset({
     "activation", "attention", "control_flow", "conv", "creation",
     "custom",  # runtime user ops via utils.custom_op.register_custom_op
+    "fusion",  # fused multi-op kernels (compile/fusion rewrite targets)
     "geometric", "indexing", "inplace", "linalg", "loss", "manipulation",
     "math", "misc", "nn_common", "norm", "pooling", "quantization",
     "random", "reduction", "search", "signal", "vision",

@@ -73,6 +73,9 @@ class Program:
         self._keepalive: List[Tensor] = []  # id stability across guards
         self._produced: set = set()  # incremental: capture stays O(n)
         self._jit_cache: Dict[tuple, "jax._src.stages.Wrapped"] = {}
+        #: stats of the most recent fusion-pass application (run() with
+        #: FLAGS_enable_fusion; None = pass never ran on this program)
+        self.fusion_stats: Optional[dict] = None
 
     # -- construction -----------------------------------------------------
     def _record(self, op_name, fn, tensor_inputs, out_tensors, attrs=None):
@@ -123,17 +126,29 @@ class Program:
                 a = a.astype(np.dtype(declared))  # honor the declaration
             arrays.append(a)
         # the signature includes the captured-id set: extending the
-        # program with new weights must invalidate compiled closures
+        # program with new weights must invalidate compiled closures —
+        # and, when graph fusion is on, the pass fingerprint (a fused
+        # and an unfused compile of one program never share an entry)
         sig = (tuple((n, a.shape, str(a.dtype))
                      for n, a in zip(names, arrays)), tuple(fetch_ids),
                tuple(self._captured.keys()))
+        from ..compile import fusion as _fusion
+        fuse = _fusion.enabled()
+        if fuse:
+            sig = sig + (_fusion.fingerprint(),)
         if sig not in self._jit_cache:
             feed_ids = [self.feed_vars[n] for n in names]
             cap_ids = list(self._captured.keys())
+            ops_plan = None
+            if fuse:
+                # fetched ids are the external set: a fetch of a value
+                # interior to a candidate chain rejects that fusion
+                ops_plan, self.fusion_stats = _fusion.fuse_program_ops(
+                    self._block.ops, fetch_ids)
 
-            def compiled(feed_arrays, cap_arrays):
+            def compiled(feed_arrays, cap_arrays, _ops=ops_plan):
                 env = self._replay_by_ids(feed_ids, feed_arrays, cap_ids,
-                                          cap_arrays)
+                                          cap_arrays, ops=_ops)
                 return [env[i] for i in fetch_ids]
 
             self._jit_cache[sig] = jax.jit(compiled)
@@ -141,10 +156,13 @@ class Program:
         outs = self._jit_cache[sig](arrays, cap_arrays)
         return [np.asarray(o) for o in outs]
 
-    def _replay_by_ids(self, feed_ids, feed_arrays, cap_ids, cap_arrays):
+    def _replay_by_ids(self, feed_ids, feed_arrays, cap_ids, cap_arrays,
+                       ops=None):
         env = dict(zip(feed_ids, feed_arrays))
         env.update(zip(cap_ids, cap_arrays))
-        for op in self._block.ops:
+        # ``ops`` overrides the block's op list (the fusion pass hands a
+        # rewritten plan whose FusedSteps replay like _OpRecords)
+        for op in (self._block.ops if ops is None else ops):
             args = [env[i] for i in op.in_ids]
             out = op.fn(*args)
             outs = list(out) if isinstance(out, (tuple, list)) else [out]

@@ -1,0 +1,507 @@
+"""Graph-fusion pass (paddle_tpu/compile/fusion/) — ISSUE 10.
+
+Contracts under test:
+
+* **pattern corpus** — each pattern matches its canonical chain and is
+  REJECTED when an interior value is externally visible (fetched /
+  multi-consumer) or when an input is not available at the fusion site;
+* **parity** — eager-unfused vs fused numerics AND gradients agree per
+  pattern, on the XLA composite and on the Pallas kernel path
+  (``INTERPRET=True`` runs the real kernel bodies on CPU);
+* **cache key separation** — fused and unfused compiles of one program
+  never share a persistent-cache entry (the fusion fingerprint rides
+  the pcc key);
+* **flag off = seed behavior** — with ``FLAGS_enable_fusion=0`` every
+  compile path is bit-exact with eager and the pass never runs;
+* **spmd** — a fused program propagates over a ``(data, tp)`` mesh with
+  ZERO replicate-fallbacks (the fused ops carry named rules);
+* **audit** — ``tools/fusion_audit.py`` is clean (docstring + cost
+  model + spmd rule + kernel/composite pair per fused op).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+import paddle_tpu.ops as ops
+from paddle_tpu import nn, static
+from paddle_tpu.compile import fusion
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.distributed import mesh as mesh_mod
+from paddle_tpu.models import llama
+from paddle_tpu.nn import functional as F
+from paddle_tpu.observability import REGISTRY
+
+RNG = np.random.RandomState(7)
+
+
+def _arr(*shape, scale=1.0):
+    return (RNG.randn(*shape) * scale).astype(np.float32)
+
+
+@pytest.fixture
+def fusion_on():
+    paddle.set_flags({"FLAGS_enable_fusion": True})
+    yield
+    paddle.set_flags({"FLAGS_enable_fusion": False})
+
+
+@pytest.fixture
+def fusion_off():
+    paddle.set_flags({"FLAGS_enable_fusion": False})
+    yield
+
+
+# ==========================================================================
+# pattern corpus over the static.Program op-list IR
+# ==========================================================================
+class TestPatternCorpus:
+    """Build each chain as a static Program and inspect the pass's plan
+    (``fuse_program_ops``) directly: what matched, what got rejected."""
+
+    def _program(self, build):
+        paddle.enable_static()
+        try:
+            main, start = static.Program(), static.Program()
+            with static.program_guard(main, start):
+                fetches = build(main)
+            return main, fetches
+        finally:
+            paddle.disable_static()
+
+    def _run_pass(self, build, fetch_idx):
+        main, fetches = self._program(build)
+        if not isinstance(fetches, (list, tuple)):
+            fetches = [fetches]
+        # Executor.run keys fetches by tensor identity (id())
+        plan, stats = fusion.fuse_program_ops(
+            main._block.ops, [id(fetches[i]) for i in fetch_idx])
+        return plan, stats
+
+    def test_norm_linear_act_matches(self):
+        w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+        b = paddle.to_tensor(_arr(64, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            h = F.layer_norm(x, [32])
+            return F.gelu(F.linear(h, w, b))
+
+        plan, stats = self._run_pass(build, [0])
+        assert stats["rewritten"] == {"norm_linear": 1}
+        assert stats["rejected"] == {}
+        assert [s.name for s in plan] == ["fused_norm_linear"]
+        assert plan[0].attrs["activation"] == "gelu"
+
+    def test_gelu_tanh_rides_the_attr(self):
+        w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            return F.gelu(F.linear(F.rms_norm(x), w), approximate=True)
+
+        plan, stats = self._run_pass(build, [0])
+        assert stats["rewritten"] == {"norm_linear": 1}
+        assert plan[0].attrs["activation"] == "gelu_tanh"
+        assert plan[0].attrs["norm_type"] == "rms_norm"
+
+    def test_interior_fetch_rejects(self):
+        """The norm output is ALSO fetched: swallowing it would change
+        observable behavior, so the candidate must be rejected."""
+        w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            h = F.layer_norm(x, [32])
+            return h, F.gelu(F.linear(h, w))
+
+        plan, stats = self._run_pass(build, [0, 1])
+        # the WIDE candidate (norm swallowed) is rejected; the narrow
+        # linear→act pair is still legal (the fetched norm output is an
+        # INPUT of that chain, not interior) and fuses on its own
+        assert stats["rejected"].get("norm_linear") == 1
+        assert stats["rewritten"] == {"linear_act": 1}
+        assert [s.name for s in plan] == ["layer_norm",
+                                          "fused_norm_linear"]
+        assert plan[1].attrs["norm_type"] == ""   # norm NOT swallowed
+
+    def test_interior_multi_consumer_rejects(self):
+        """The norm output feeds the linear AND a second op that stays
+        in the graph — not swallowable into the wide chain."""
+        w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            h = F.layer_norm(x, [32])
+            y = F.gelu(F.linear(h, w))
+            return y, h * 2.0
+
+        plan, stats = self._run_pass(build, [0, 1])
+        assert stats["rejected"].get("norm_linear") == 1
+        assert stats["rewritten"] == {"linear_act": 1}
+        assert "layer_norm" in [s.name for s in plan]
+
+    def test_residual_norm_matches_with_external_sum(self):
+        """residual_norm re-emits the sum as a REAL output, so an
+        external consumer of the sum is legal — the chain still fuses."""
+        def build(main):
+            x = static.data("x", [4, 8, 32], "float32")
+            y = static.data("y", [4, 8, 32], "float32")
+            s = x + y
+            return F.rms_norm(s), s.mean()
+
+        plan, stats = self._run_pass(build, [0, 1])
+        assert stats["rewritten"] == {"residual_norm": 1}
+        assert plan[0].name == "fused_residual_norm"
+        assert len(plan[0].out_ids) == 2   # (normed, summed)
+
+    def test_bias_act_matches(self):
+        b = paddle.to_tensor(_arr(32, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            return F.silu(x + b)
+
+        plan, stats = self._run_pass(build, [0])
+        assert stats["rewritten"] == {"bias_act": 1}
+        assert plan[0].name == "fused_bias_act"
+
+    def test_linear_act_without_norm_matches(self):
+        w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            return F.relu(F.linear(x, w))
+
+        plan, stats = self._run_pass(build, [0])
+        assert stats["rewritten"] == {"linear_act": 1}
+        assert plan[0].name == "fused_norm_linear"
+        assert plan[0].attrs["norm_type"] == ""
+
+    def test_rope_proj_matches(self):
+        w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+
+        def build(main):
+            x = static.data("x", [2, 8, 32], "float32")
+            h = ops.reshape(F.linear(x, w), [2, 8, 4, 16])
+            return llama.rotary_embedding(h)
+
+        plan, stats = self._run_pass(build, [0])
+        assert stats["rewritten"] == {"rope_proj": 1}
+        assert plan[0].name == "fused_rope_proj"
+        assert plan[0].attrs["num_heads"] == 4
+
+    def test_unrelated_ops_pass_through_untouched(self):
+        def build(main):
+            x = static.data("x", [4, 32], "float32")
+            return ops.tanh(x) * 2.0
+
+        plan, stats = self._run_pass(build, [0])
+        assert stats["rewritten"] == {}
+        assert stats["ops_before"] == stats["ops_after"]
+
+
+# ==========================================================================
+# numerics + gradient parity per pattern (XLA composite leg)
+# ==========================================================================
+class TestParity:
+    def _grad_parity(self, unfused, fused, *arrays, tol=1e-5):
+        def lu(*a):
+            paddle.set_flags({"FLAGS_enable_fusion": False})
+            return unfused(*a)
+
+        def lf(*a):
+            paddle.set_flags({"FLAGS_enable_fusion": True})
+            try:
+                out, _ = fusion.rewrite_traced(lambda: fused(*a))
+                return out._data
+            finally:
+                paddle.set_flags({"FLAGS_enable_fusion": False})
+
+        argnums = tuple(range(len(arrays)))
+        vu, gu = jax.value_and_grad(lambda *a: lu(*a)._data.sum(),
+                                    argnums)(*arrays)
+        vf, gf = jax.value_and_grad(lambda *a: lf(*a).sum(),
+                                    argnums)(*arrays)
+        np.testing.assert_allclose(np.asarray(vu), np.asarray(vf),
+                                   rtol=tol, atol=tol)
+        for a, b in zip(gu, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=tol, atol=tol)
+
+    def test_norm_linear_chain(self):
+        w, b = _arr(32, 64, scale=0.1), _arr(64, scale=0.1)
+
+        def chain(xa, wa, ba):
+            h = F.layer_norm(Tensor(xa), [32])
+            return F.gelu(F.linear(h, Tensor(wa), Tensor(ba)))
+
+        self._grad_parity(chain, chain, _arr(4, 32), w, b)
+
+    def test_residual_norm_chain(self):
+        def chain(xa, ya):
+            s = Tensor(xa) + Tensor(ya)
+            return F.rms_norm(s).mean()
+
+        self._grad_parity(chain, chain, _arr(4, 8, 32), _arr(4, 8, 32))
+
+    def test_bias_silu_chain(self):
+        def chain(xa, ba):
+            return F.silu(Tensor(xa) + Tensor(ba))
+
+        self._grad_parity(chain, chain, _arr(4, 32), _arr(32, scale=0.1))
+
+    def test_rope_proj_chain(self):
+        def chain(xa, wa):
+            h = ops.reshape(F.linear(Tensor(xa), Tensor(wa)),
+                            [2, 8, 4, 16])
+            return llama.rotary_embedding(h)
+
+        self._grad_parity(chain, chain, _arr(2, 8, 32),
+                          _arr(32, 64, scale=0.1))
+
+    def test_to_static_full_block_parity(self, fusion_on):
+        """A GPT-style block through to_static: the fused program's
+        output matches eager-unfused to float tolerance (the composite
+        is the same math, but XLA may round differently across the two
+        program shapes)."""
+        ln, fc1, fc2 = nn.LayerNorm(32), nn.Linear(32, 64), nn.Linear(64, 32)
+
+        def block(x):
+            h = F.gelu(fc1(ln(x)))
+            h = fc2(h)
+            s = x + h
+            return F.rms_norm(s)
+
+        x = paddle.to_tensor(_arr(4, 8, 32))
+        sf = paddle.jit.to_static(block)
+        out = sf(x)
+        assert sf.fusion_stats["rewritten"] == {"norm_linear": 1,
+                                                "residual_norm": 1}
+        paddle.set_flags({"FLAGS_enable_fusion": False})
+        ref = block(x)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+
+# ==========================================================================
+# Pallas kernel leg (INTERPRET=True runs the real kernel bodies on CPU)
+# ==========================================================================
+class TestPallasKernels:
+    @pytest.fixture(autouse=True)
+    def _interp(self):
+        from paddle_tpu.ops.pallas import fused_ops as FK
+        old = FK.INTERPRET
+        FK.INTERPRET = True
+        yield
+        FK.INTERPRET = old
+
+    def test_fused_bias_act_kernel_matches_composite(self):
+        x = paddle.to_tensor(_arr(16, 256))
+        b = paddle.to_tensor(_arr(256, scale=0.1))
+        got = F.fused_bias_act(x, b, activation="gelu")
+        ref = F.gelu(x + b)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_residual_norm_kernel(self):
+        x, r = paddle.to_tensor(_arr(16, 256)), paddle.to_tensor(
+            _arr(16, 256))
+        w = paddle.to_tensor(np.ones(256, np.float32))
+        b = paddle.to_tensor(np.zeros(256, np.float32))
+        y, s = F.fused_residual_norm(x, r, w, b, norm_type="layer_norm")
+        s_ref = x + r
+        y_ref = F.layer_norm(s_ref, [256], weight=w, bias=b)
+        np.testing.assert_allclose(s.numpy(), s_ref.numpy(), atol=1e-6)
+        np.testing.assert_allclose(y.numpy(), y_ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_fused_norm_linear_kernel_and_grads(self):
+        x = _arr(16, 256)
+        w = _arr(256, 128, scale=0.05)
+
+        def fused(xa, wa):
+            return F.fused_norm_linear(
+                Tensor(xa), Tensor(wa), activation="silu",
+                norm_type="rms_norm")._data.sum()
+
+        def ref(xa, wa):
+            h = F.rms_norm(Tensor(xa), epsilon=1e-5)
+            return F.silu(F.linear(h, Tensor(wa)))._data.sum()
+
+        vf, gf = jax.value_and_grad(fused, (0, 1))(x, w)
+        vr, gr = jax.value_and_grad(ref, (0, 1))(x, w)
+        np.testing.assert_allclose(float(vf), float(vr), rtol=1e-4)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_fused_rope_proj_kernel(self):
+        x = paddle.to_tensor(_arr(2, 16, 256))
+        w = paddle.to_tensor(_arr(256, 128, scale=0.05))
+        got = F.fused_rope_proj(x, w, num_heads=8, theta=10000.0,
+                                pos_offset=3)
+        h = ops.reshape(F.linear(x, w), [2, 16, 8, 16])
+        ref = llama.rotary_embedding(h, pos_offset=3)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+# ==========================================================================
+# flag off = seed behavior; cache-key separation; metrics
+# ==========================================================================
+class TestGating:
+    def test_flag_off_is_bit_exact_and_passless(self, fusion_off):
+        ln, fc = nn.LayerNorm(32), nn.Linear(32, 64)
+
+        def f(x):
+            return F.gelu(fc(ln(x)))
+
+        x = paddle.to_tensor(_arr(4, 32))
+        sf = paddle.jit.to_static(f)
+        out = sf(x)
+        assert sf.fusion_stats is None          # the pass never ran
+        np.testing.assert_array_equal(out.numpy(), f(x).numpy())
+
+        # static Program path: flag off leaves the replay plan alone
+        paddle.enable_static()
+        try:
+            main, start = static.Program(), static.Program()
+            with static.program_guard(main, start):
+                xs = static.data("x", [4, 32], "float32")
+                y = F.gelu(F.linear(F.layer_norm(xs, [32]),
+                                    paddle.to_tensor(_arr(32, 64))))
+            exe = static.Executor()
+            exe.run(main, feed={"x": _arr(4, 32)}, fetch_list=[y])
+            assert main.fusion_stats is None
+        finally:
+            paddle.disable_static()
+
+    def test_pcc_keys_never_cross_hit(self, tmp_path):
+        """Compile one function fused and unfused against the same
+        persistent cache: two distinct entries, zero cross-hits — then a
+        re-compile of each variant hits its own entry."""
+        cache_dir = str(tmp_path / "pcc")
+        paddle.set_flags({"FLAGS_enable_metrics": True,
+                          "FLAGS_compile_cache": True,
+                          "FLAGS_compile_cache_dir": cache_dir})
+        REGISTRY.reset()
+        ln, fc = nn.LayerNorm(32), nn.Linear(32, 64)
+
+        def f(x):
+            return F.gelu(fc(ln(x)))
+
+        x = paddle.to_tensor(_arr(4, 32))
+        try:
+            outs = {}
+            for flag in (False, True, False, True):
+                paddle.set_flags({"FLAGS_enable_fusion": flag})
+                sf = paddle.jit.to_static(f, full_graph=True)
+                outs[flag] = sf(x).numpy()
+            misses = REGISTRY.get("paddle_tpu_pcc_misses_total").value(
+                site="to_static")
+            hits = REGISTRY.get("paddle_tpu_pcc_hits_total").value(
+                site="to_static")
+            # first two compiles miss (distinct keys), second pair hits
+            # its OWN entry — a cross-hit would show as misses < 2
+            assert misses == 2, misses
+            assert hits == 2, hits
+            np.testing.assert_array_equal(outs[True], outs[False])
+        finally:
+            paddle.set_flags({"FLAGS_enable_fusion": False,
+                              "FLAGS_enable_metrics": False,
+                              "FLAGS_compile_cache": False,
+                              "FLAGS_compile_cache_dir": ""})
+            REGISTRY.reset()
+
+    def test_metrics_count_matched_rewritten_rejected(self, fusion_on):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        REGISTRY.reset()
+        try:
+            w = paddle.to_tensor(_arr(32, 64, scale=0.1))
+
+            def f(x):
+                h = F.layer_norm(x, [32])
+                return h, F.gelu(F.linear(h, w))   # h escapes: reject
+
+            def g(x):
+                return F.gelu(F.linear(F.layer_norm(x, [32]), w))
+
+            x = paddle.to_tensor(_arr(4, 32))
+            paddle.jit.to_static(f)(x)
+            paddle.jit.to_static(g)(x)
+            m = REGISTRY.get("paddle_tpu_fusion_matched_total")
+            r = REGISTRY.get("paddle_tpu_fusion_rewritten_total")
+            j = REGISTRY.get("paddle_tpu_fusion_rejected_total")
+            assert m.value(pattern="norm_linear") == 2
+            assert r.value(pattern="norm_linear") == 1
+            assert j.value(pattern="norm_linear") == 1
+        finally:
+            paddle.set_flags({"FLAGS_enable_metrics": False})
+            REGISTRY.reset()
+
+    def test_sot_segments_fuse_with_parity(self, fusion_on):
+        ln, fc = nn.LayerNorm(32), nn.Linear(32, 64)
+
+        def f(x):
+            h = F.gelu(fc(ln(x)))
+            if h.shape[0] > 1:       # python branch → SOT segments
+                h = h * 2.0
+            return h
+
+        x = paddle.to_tensor(_arr(4, 32))
+        out = paddle.jit.to_static(f, full_graph=False)(x)
+        paddle.set_flags({"FLAGS_enable_fusion": False})
+        np.testing.assert_array_equal(out.numpy(), f(x).numpy())
+
+
+# ==========================================================================
+# spmd: fused program over a (data, tp) mesh — zero fallbacks
+# ==========================================================================
+@pytest.mark.skipif(jax.device_count() < 8, reason="needs 8 devices")
+def test_fused_program_zero_spmd_fallback(fusion_on):
+    from paddle_tpu.distributed import spmd
+
+    mesh = mesh_mod.build_mesh({"data": 2, "tp": 4})
+    paddle.seed(5)
+    ln = nn.LayerNorm(32)
+    fc1, fc2 = nn.Linear(32, 64), nn.Linear(64, 32)
+    spmd.shard_params(
+        nn.LayerList([ln, fc1, fc2]), mesh,
+        [(r".*1\.weight", P(None, "tp")), (r".*1\.bias", P("tp")),
+         (r".*2\.weight", P("tp", None))])
+
+    @paddle.jit.to_static(mesh=mesh, in_specs=P("data"))
+    def step(x):
+        h = F.gelu(fc1(ln(x)))
+        h = fc2(h)
+        s = x + h
+        return F.rms_norm(s).mean()
+
+    x = paddle.to_tensor(_arr(8, 16, 32))
+    out = step(x)
+    assert step.fusion_stats["rewritten"], step.fusion_stats
+    assert step.spmd_stats["fallback"] == {}, step.spmd_stats
+    # value parity vs the unfused, unsharded eager path
+    paddle.set_flags({"FLAGS_enable_fusion": False})
+    ref = F.rms_norm(x + fc2(F.gelu(fc1(ln(x))))).mean()
+    np.testing.assert_allclose(float(out.numpy()), float(ref.numpy()),
+                               rtol=1e-5)
+
+
+# ==========================================================================
+# audit tool
+# ==========================================================================
+def test_fusion_audit_clean():
+    from tools.fusion_audit import audit
+    rep = audit()
+    assert rep["problems"] == [], rep["problems"]
+    assert {r["op"] for r in rep["ops"]} >= {
+        "fused_bias_act", "fused_residual_norm", "fused_norm_linear",
+        "fused_rope_proj"}
+    # every pattern maps to a registered fused op
+    targets = {p for r in rep["ops"] for p in r["patterns"]}
+    assert targets == set(rep["patterns"])

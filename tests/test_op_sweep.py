@@ -353,8 +353,8 @@ rec("triangular_solve", [np.tril(pd(3)).astype(np.float32), sym(3, 2)],
     attrs={"upper": False}, grad=False)
 rec("eigvalsh", [pd(3)], ref=np.linalg.eigvalsh, rtol=1e-3, grad=False)
 rec("eigh", [pd(3)], grad=False)
-rec("eig", [pd(3)], grad=False, jit=False)
-rec("eigvals", [pd(3)], grad=False, jit=False)
+rec("eig", [pd(3)], grad=False)     # pure_callback: jits since round 15
+rec("eigvals", [pd(3)], grad=False)
 rec("svd", [sym(4, 3)], grad=False)
 rec("qr", [sym(4, 3)], grad=False)
 rec("lu", [pd(3)], grad=False)
@@ -372,7 +372,7 @@ rec("cdist", [sym(3, 4), sym(5, 4)], grad=False)
 rec("cov", [sym(3, 6)], ref=np.cov, rtol=1e-3, grad=False)
 rec("corrcoef", [sym(3, 6)], ref=np.corrcoef, rtol=1e-3, grad=False)
 rec("bincount", [ints(5, 10)], ref=np.bincount, grad=False, jit=False)
-rec("histogram", [sym(10)], grad=False, jit=False)
+rec("histogram", [sym(10)], grad=False)  # in-graph since round 15
 rec("vander", [sym(4)], grad=False)
 rec("einsum", ["ij,jk->ik", sym(3, 4), sym(4, 5)], jit=False, grad=False)
 rec("multi_dot", [[sym(3, 4), sym(4, 5)]], jit=False, grad=False)
@@ -413,6 +413,20 @@ rec("triplet_margin_loss", [sym(4, 3), sym(4, 3), sym(4, 3)],
     grad_idx=[0])
 rec("fused_linear_cross_entropy", [sym(6, 4), sym(4, 8), ints(8, 6)],
     grad_idx=[0, 1], grad_tol=2e-2)
+
+# ------------------------------------------------------- fused (compile/fusion)
+_sig = lambda v: 1.0 / (1.0 + np.exp(-v))
+rec("fused_bias_act", [sym(6, 8), sym(8)], attrs={"activation": "silu"},
+    ref=lambda x, b, **kw: (x + b) * _sig(x + b), grad_tol=2e-2)
+rec("fused_residual_norm", [sym(6, 8), sym(6, 8), pos(8), sym(8)],
+    grad_idx=[0, 1], grad_tol=2e-2)
+rec("fused_norm_linear", [sym(6, 8), sym(8, 5)],
+    attrs={"norm_type": "rms_norm", "epsilon": 1e-5},
+    ref=lambda x, w, **kw: (
+        x / np.sqrt((x * x).mean(-1, keepdims=True) + 1e-5)) @ w,
+    rtol=1e-3, grad_tol=2e-2)
+rec("fused_rope_proj", [sym(2, 4, 8), sym(8, 8)],
+    attrs={"num_heads": 2}, grad_tol=2e-2)
 
 # --------------------------------------------------------------- nn_common
 rec("linear", [sym(3, 4), sym(4, 5)], ref=np.matmul, grad_tol=2e-2)

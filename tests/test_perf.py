@@ -563,20 +563,26 @@ class TestPerfGate:
     def test_frozen_repo_baseline_is_valid(self):
         """tools/perf_baseline.json (checked in) parses and gates the
         run it was frozen from. Rungs added to the baseline AFTER the
-        r05 freeze (fleet_observability, round 14) are absent from the
-        archived run — they may be missing, but nothing may fail."""
+        r05 freeze (fleet_observability round 14, fusion round 15) are
+        absent from the archived run — they may be missing, but nothing
+        may fail."""
         with open(os.path.join(REPO, "tools", "perf_baseline.json")) as f:
             base = json.load(f)
         assert base["format"] == "paddle_tpu.perf_baseline/1"
         assert base["rungs"]
         assert "fleet_observability_overhead_ratio" in base["rungs"]
+        assert "fusion_fused_vs_unfused_step_ratio" in base["rungs"]
+        # the fusion bar is the acceptance criterion itself: >= 1.10x
+        fusion = base["rungs"]["fusion_fused_vs_unfused_step_ratio"]
+        assert fusion["value"] * fusion["min_ratio"] >= 1.10
         with open(os.path.join(REPO, "BENCH_r05.json")) as f:
             cand = perf_gate.parse_bench_output(f.read())
         res = perf_gate.gate(cand, base, allow_missing=True)
         assert res["pass"]
         missing = {c["metric"] for c in res["checks"]
                    if c["status"] == "missing"}
-        assert missing <= {"fleet_observability_overhead_ratio"}
+        assert missing <= {"fleet_observability_overhead_ratio",
+                           "fusion_fused_vs_unfused_step_ratio"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"

@@ -401,14 +401,30 @@ class TestCoverageGate:
         rules)."""
         from tools.spmd_coverage_audit import audit
         rep = audit()
-        assert rep["tiers"]["rule"] >= 240, rep["tiers"]
-        assert rep["rule_classes"] >= 20, rep["rule_classes"]
-        # the high-traffic LLM op set must be tier-'rule' forever
+        assert rep["tiers"]["rule"] >= 252, rep["tiers"]
+        assert rep["rule_classes"] >= 25, rep["rule_classes"]
+        # the high-traffic LLM op set must be tier-'rule' forever —
+        # including the compile/fusion rewrite targets (a fused program
+        # must propagate with zero replicate-fallbacks)
         for op in ("matmul", "linear", "embedding", "layer_norm",
                    "rms_norm", "flash_attention",
                    "scaled_dot_product_attention", "reshape", "split",
                    "softmax", "cross_entropy", "gelu", "getitem",
                    "transpose", "concat", "sum", "mean", "cumsum",
-                   "conv2d", "dropout"):
+                   "conv2d", "dropout", "fused_bias_act",
+                   "fused_residual_norm", "fused_norm_linear",
+                   "fused_rope_proj"):
             _, tier = R.rule_for(op)
             assert tier == "rule", (op, tier)
+
+    def test_fusion_category_is_fully_ruled(self):
+        """Every category-'fusion' op must carry a NAMED spmd rule —
+        registering a fused op without one fails here (and in
+        tools/fusion_audit.py) instead of silently replicating."""
+        from tools.spmd_coverage_audit import audit
+        rep = audit()
+        bad = rep["fusion"]["unruled"]
+        assert not bad, f"fusion ops without a named spmd rule: {bad}"
+        assert set(rep["fusion"]["ops"]) >= {
+            "fused_bias_act", "fused_residual_norm",
+            "fused_norm_linear", "fused_rope_proj"}

@@ -1,0 +1,212 @@
+"""Fused-op coverage audit — the FUSION.md generator with teeth.
+
+Every op registered under category ``fusion`` (the rewrite targets of
+``paddle_tpu/compile/fusion/``) must carry the full first-class-op kit:
+
+* a **docstring** (the registry doc surface),
+* a **named cost model** (``observability.perf.costmodel.COST_MODELS``
+  or a ``register(..., cost_fn=)`` site) so round-12 attribution sees
+  through the rewrite,
+* a **named spmd rule** (``distributed.spmd.rules.SPMD_RULES`` or a
+  ``register(..., spmd_rule=)`` site — tier ``rule``, category fallback
+  does NOT count) so round-13 propagation reports zero fallbacks on
+  fused programs,
+* a **Pallas kernel + XLA composite pair** (``ops/pallas/fused_ops`` +
+  the lowering factory in ``nn/functional/fused.py``) so the autotuner
+  has both legs to measure.
+
+A fused op missing any of these FAILS the audit (exit 1) — and
+``tests/test_fusion.py::test_fusion_audit_clean`` runs it in tier-1, so
+registering a half-wired fused op breaks the build, not production.
+
+Run::
+
+    python tools/fusion_audit.py            # audit + rewrite FUSION.md
+    python tools/fusion_audit.py --check    # audit only (no write)
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+#: fusion pattern -> the fused op it rewrites onto (must stay in sync
+#: with compile.fusion.PATTERNS — the audit asserts the sync)
+PATTERN_TARGETS = {
+    "norm_linear": "fused_norm_linear",
+    "linear_act": "fused_norm_linear",
+    "residual_norm": "fused_residual_norm",
+    "bias_act": "fused_bias_act",
+    "rope_proj": "fused_rope_proj",
+}
+
+#: fused op -> its Pallas kernel entry point (ops/pallas/fused_ops)
+KERNELS = {
+    "fused_bias_act": "fused_bias_act",
+    "fused_residual_norm": "fused_residual_norm",
+    "fused_norm_linear": "fused_matmul",
+    "fused_rope_proj": "fused_matmul_rope",
+}
+
+#: fused op -> lowering factory in nn/functional/fused.py (the XLA
+#: composite lives inside the factory as the numerics reference)
+LOWERINGS = {
+    "fused_bias_act": "bias_act_lowering",
+    "fused_residual_norm": "residual_norm_lowering",
+    "fused_norm_linear": "norm_linear_lowering",
+    "fused_rope_proj": "rope_proj_lowering",
+}
+
+#: fused op -> autotune cache key family (fused.py _choose_impl kinds)
+AUTOTUNE_KINDS = {
+    "fused_bias_act": "fused_bias_act",
+    "fused_residual_norm": "fused_residual_norm",
+    "fused_norm_linear": "fused_norm_linear",
+    "fused_rope_proj": "fused_rope_proj",
+}
+
+
+def audit() -> dict:
+    from paddle_tpu.compile import fusion as fusion_pass
+    from paddle_tpu.distributed.spmd import rules as spmd_rules
+    from paddle_tpu.nn.functional import fused as fused_mod
+    from paddle_tpu.observability.perf import costmodel
+    from paddle_tpu.ops.pallas import fused_ops as FK
+    from paddle_tpu.ops.registry import OPS
+
+    problems = []
+    fused_ops = sorted(n for n, d in OPS.items() if d.category == "fusion")
+    if not fused_ops:
+        problems.append("no ops registered under category 'fusion'")
+    missing_decl = sorted(set(fused_mod.FUSED_OPS) - set(fused_ops))
+    if missing_decl:
+        problems.append(f"FUSED_OPS declared but not registered under "
+                        f"category 'fusion': {missing_decl}")
+
+    pat_set = set(fusion_pass.PATTERNS)
+    if pat_set != set(PATTERN_TARGETS):
+        problems.append(
+            f"pattern inventory drifted: compile.fusion.PATTERNS="
+            f"{sorted(pat_set)} vs audit map "
+            f"{sorted(PATTERN_TARGETS)} — update PATTERN_TARGETS")
+
+    rows = []
+    for name in fused_ops:
+        d = OPS[name]
+        row = {"op": name,
+               "patterns": sorted(p for p, t in PATTERN_TARGETS.items()
+                                  if t == name)}
+        if not (d.doc or "").strip():
+            problems.append(f"{name}: registered without a docstring")
+        row["doc"] = bool((d.doc or "").strip())
+
+        cost = costmodel.COST_MODELS.get(name) or d.cost_fn
+        if cost is None:
+            problems.append(f"{name}: no NAMED cost model "
+                            f"(costmodel.COST_MODELS / cost_fn=) — "
+                            f"attribution would fall back to a generic "
+                            f"category estimate")
+        row["cost_model"] = getattr(cost, "__name__", None) if cost \
+            else None
+
+        rule = spmd_rules.SPMD_RULES.get(name) or d.spmd_rule
+        if rule is None:
+            problems.append(f"{name}: no NAMED spmd rule "
+                            f"(rules.SPMD_RULES / spmd_rule=) — fused "
+                            f"programs would replicate-fallback")
+        row["spmd_rule"] = getattr(rule, "__name__", None) if rule \
+            else None
+
+        kern = KERNELS.get(name)
+        if kern is None or not callable(getattr(FK, kern, None)):
+            problems.append(f"{name}: no Pallas kernel mapped in "
+                            f"ops/pallas/fused_ops (KERNELS table)")
+            kern = None
+        row["kernel"] = kern
+
+        low = LOWERINGS.get(name)
+        if low is None or not callable(getattr(fused_mod, low, None)):
+            problems.append(f"{name}: no lowering factory (XLA "
+                            f"composite) in nn/functional/fused.py")
+            low = None
+        row["lowering"] = low
+        row["autotune_kind"] = AUTOTUNE_KINDS.get(name)
+        rows.append(row)
+
+    return {"ops": rows, "patterns": sorted(pat_set),
+            "version": fusion_pass.FUSION_VERSION, "problems": problems}
+
+
+def render_markdown(rep: dict) -> str:
+    lines = [
+        "# FUSION.md — fused-op coverage",
+        "",
+        "Generated by `python tools/fusion_audit.py`; regenerate after "
+        "adding a pattern or a fused op. The audit FAILS (exit 1) on a "
+        "fused op missing its docstring, named cost model, named spmd "
+        "rule, or kernel/composite pair — "
+        "`tests/test_fusion.py::test_fusion_audit_clean` runs it in "
+        "tier-1.",
+        "",
+        f"- fusion pass version: **v{rep['version']}** "
+        "(`compile.fusion.FUSION_VERSION`, folded into every compile-"
+        "cache key)",
+        "- patterns: " + ", ".join(f"`{p}`" for p in rep["patterns"]),
+        "",
+        "| fused op | rewritten from | Pallas kernel | XLA composite "
+        "(lowering) | cost model | spmd rule | autotune key |",
+        "|---|---|---|---|---|---|---|",
+    ]
+    for r in rep["ops"]:
+        pats = ", ".join(f"`{p}`" for p in r["patterns"]) or "—"
+        lines.append(
+            f"| `{r['op']}` | {pats} "
+            f"| `{r['kernel']}` | `{r['lowering']}` "
+            f"| `{r['cost_model']}` | `{r['spmd_rule']}` "
+            f"| `{r['autotune_kind']}` |")
+    lines += [
+        "",
+        "Selection is a measured per-shape-class decision through the "
+        "round-5 autotuner: the candidate grid is `[\"xla\", "
+        "(\"pallas\", tile…)…]`, so one cached winner encodes both the "
+        "implementation and its tiles. Off-TPU (or with "
+        "`FLAGS_use_autotune=0`) the XLA composite is the default; the "
+        "composite is always the numerics reference the Pallas "
+        "backward recomputes through.",
+        "",
+        "Metrics: `paddle_tpu_fusion_matched_total{pattern=}`, "
+        "`paddle_tpu_fusion_rewritten_total{pattern=}`, "
+        "`paddle_tpu_fusion_rejected_total{pattern=}` (rejected = an "
+        "interior value of the candidate chain is externally visible, "
+        "or an input isn't available at the fusion site).",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="audit only; do not rewrite FUSION.md")
+    ap.add_argument("--out", default=os.path.join(REPO, "FUSION.md"))
+    args = ap.parse_args(argv)
+    rep = audit()
+    if not args.check:
+        with open(args.out, "w") as f:
+            f.write(render_markdown(rep))
+        print(f"wrote {args.out}")
+    print(f"fused ops={len(rep['ops'])} patterns={len(rep['patterns'])} "
+          f"problems={len(rep['problems'])}")
+    if rep["problems"]:
+        for p in rep["problems"]:
+            print(f"ERROR: {p}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
