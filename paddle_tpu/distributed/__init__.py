@@ -22,6 +22,7 @@ from .auto_parallel import (Partial, Placement, ProcessMesh, Replicate, Shard,
 from . import fleet
 from . import sharding
 from . import spmd
+from . import planner
 from . import checkpoint
 from . import auto_tuner
 from . import rpc

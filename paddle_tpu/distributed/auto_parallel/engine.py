@@ -27,7 +27,7 @@ from .. import mesh as mesh_mod
 class Engine:
     def __init__(self, model, loss=None, optimizer=None, metrics=None,
                  strategy=None, mesh=None, in_specs=None,
-                 param_specs=None):
+                 param_specs=None, placement=None):
         self._model = model
         self._loss = loss
         self._optimizer = optimizer
@@ -43,6 +43,18 @@ class Engine:
         self._spmd_auto = mesh is not None
         self._spmd_in_specs = in_specs
         self._spmd_param_specs = param_specs
+        # placement="auto": the auto-parallel planner
+        # (distributed.planner) picks param_specs/in_specs itself on
+        # the first batch — candidate search over the sharding rules,
+        # scored by the round-12 cost model. Explicit in_specs/
+        # param_specs arguments pin their half of the search.
+        if placement not in (None, "auto"):
+            raise ValueError(f"placement={placement!r} (only 'auto')")
+        if placement == "auto" and mesh is None:
+            raise ValueError("placement='auto' requires mesh=")
+        self._placement = placement
+        #: PlanResult of the auto placement (filled at first fit batch)
+        self.placement_plan = None
         #: propagation stats of the traced step (filled at prepare-time
         #: trace; the acceptance bar is fallback == {})
         self.spmd_stats = None
@@ -185,6 +197,29 @@ class Engine:
         self.spmd_stats = dict(sc.stats)
         return loss
 
+    def _ensure_auto_plan(self, x, y):
+        """placement='auto': run the planner on the first batch's
+        shapes — candidate search + cost-model scoring — and adopt the
+        winning (param_specs, in_specs) before the step compiles."""
+        if self._placement != "auto" or self.placement_plan is not None:
+            return
+        from .. import planner as planner_mod
+        model, loss_fn = self._model, self._loss
+
+        def step_loss(xt, yt):
+            return loss_fn(model(xt), yt)
+
+        res = planner_mod.plan(
+            step_loss, self._mesh, in_specs=self._spmd_in_specs,
+            example_inputs=(x, y), model=model)
+        self.placement_plan = res
+        res.apply(model)  # device_put + stamp the winning placement
+        if self._spmd_param_specs is None:
+            self._spmd_param_specs = res.param_specs
+        if self._spmd_in_specs is None:
+            self._spmd_in_specs = res.in_specs
+        return res
+
     def _spec_pair(self):
         """Normalize ``in_specs`` to an (x_spec, y_spec) pair. A bare
         PartitionSpec is ATOMIC (it subclasses tuple, so a plain
@@ -227,6 +262,15 @@ class Engine:
     # ------------------------------------------------------------ running
     def fit(self, train_data, epochs=1, batch_size=32, steps_per_epoch=None,
             log_freq=10, verbose=0):
+        if self._placement == "auto" and self.placement_plan is None:
+            # plan on the first batch's shapes BEFORE the step compiles
+            peek = next(iter(self.dataloader(train_data, batch_size)),
+                        None)
+            if peek is not None:
+                xs, ys = peek[0], peek[-1]
+                self._ensure_auto_plan(
+                    xs.numpy() if isinstance(xs, Tensor) else np.asarray(xs),
+                    ys.numpy() if isinstance(ys, Tensor) else np.asarray(ys))
         if self._train_step is None:
             self.prepare()
         from ...observability import fleet as _fleet

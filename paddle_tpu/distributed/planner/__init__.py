@@ -1,0 +1,44 @@
+"""Auto-parallel placement planner — search over sharding candidates
+scored by the cost model.
+
+Closes the loop between round-13 sharding propagation
+(``distributed.spmd``: ~250 per-op rules, whole-program passes) and
+round-12 cost attribution (``observability.perf``: per-op FLOPs/bytes,
+collective wire-bytes, HBM census): the system takes a model + mesh
+and emits the parameter/input placement itself, instead of a human
+picking ``param_specs`` by hand (GSPMD, arXiv:2105.04663; Alpa,
+OSDI'22; reference: the auto_parallel DistTensor planner).
+
+Quick start::
+
+    mesh = dist.mesh.build_mesh({"data": 2, "tp": 4})
+    engine = Engine(model, loss, opt, mesh=mesh, placement="auto")
+    engine.fit(dataset)          # planner runs on the first batch
+
+    # or explicitly:
+    result = planner.plan(loss_fn, mesh, example_inputs=(x, y),
+                          model=model)
+    print(result.report())       # per-candidate breakdown
+    result.apply(model)          # device_put the winning placement
+    step = to_static(loss_fn, mesh=mesh, in_specs=result.in_specs,
+                     param_specs=result.param_specs)
+
+Pipeline: candidate enumeration (:mod:`.candidates` — name-heuristic
+t5x-style layouts, canonical DP/TP/FSDP/hybrid families, local
+mutations) -> round-13 propagation per candidate -> analytical scoring
+(:mod:`.cost` — roofline compute, ring-collective wire bytes incl. the
+backward-pass gradient transpose, per-device HBM high-water with hard
+over-capacity rejection) -> winner emission (:mod:`.planner`).
+"""
+from __future__ import annotations
+
+from .candidates import (Candidate, SpecLayout,  # noqa: F401
+                         classify_param, enumerate_candidates,
+                         parameter_spec_from_name)
+from .cost import PENALTY_OPS, Score, score_plan  # noqa: F401
+from .planner import PlanResult, plan, trace_program  # noqa: F401
+
+__all__ = ["plan", "PlanResult", "trace_program", "Score",
+           "score_plan", "PENALTY_OPS", "Candidate", "SpecLayout",
+           "classify_param", "enumerate_candidates",
+           "parameter_spec_from_name"]

@@ -1,0 +1,299 @@
+"""Candidate placement enumeration.
+
+The search space of the auto-parallel planner: each candidate is a
+complete assignment of a PartitionSpec to every trainable parameter
+plus a batch (input) spec, expressed symbolically over the mesh's axis
+names. Three generators feed the population, in deterministic order:
+
+1. **Name heuristics** — a t5x-style :class:`SpecLayout` maps parameter
+   *roles* (embedding / column-parallel projection / row-parallel
+   projection / norm-vector) recognized from their names onto canonical
+   specs (SNIPPETS [1] ``SpecLayout``/``parameter_spec_from_name``
+   idiom, re-derived for this framework's naming vocabulary:
+   ``qkv_proj``/``q_proj``/``fc1``/``gate_proj`` are columns,
+   ``out_proj``/``fc2``/``down_proj`` rows, ``wte``/``embedding``
+   tables, everything 1-D replicated or fsdp-sharded).
+2. **Canonical families** over the mesh's factorizations — pure DP
+   (everything replicated, batch over every axis), megatron-TP per
+   model axis, FSDP per axis (every parameter's dim 0 sharded), and
+   TP x FSDP hybrids when the mesh has two non-trivial axes.
+3. **Local mutations** of each seed — flip one parameter group's
+   sharded dim (column <-> row split), move a group's sharding from one
+   mesh axis to another.
+
+Enumeration is pure and deterministic (no RNG, sorted iteration): the
+same (params, mesh) always yields the same candidate list, which the
+planner tests pin.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["SpecLayout", "Candidate", "classify_param",
+           "parameter_spec_from_name", "enumerate_candidates",
+           "mesh_axis_split"]
+
+#: parameter-name fragments -> role (checked in order; first hit wins)
+_ROLE_PATTERNS = (
+    ("position", ("wpe", "pos_emb", "position_emb")),
+    ("embedding", ("wte", "embed", "embedding", "tok_")),
+    ("column", ("qkv_proj", "q_proj", "k_proj", "v_proj", "fc1",
+                "gate_proj", "up_proj", "in_proj", "w1", "dense_h_to")),
+    ("row", ("out_proj", "o_proj", "fc2", "down_proj", "proj_out", "w2",
+             "dense_4h_to")),
+    ("norm", ("norm", "ln_", "_ln", ".ln", "layernorm", "scale_param")),
+)
+
+_FRAGS = {role: frags for role, frags in _ROLE_PATTERNS}
+
+
+def classify_param(name: str, shape: Sequence[int]) -> str:
+    """Role of one parameter: ``embedding`` / ``column`` / ``row`` /
+    ``norm`` / ``bias`` / ``other`` — the granularity mutations operate
+    at."""
+    low = name.lower()
+    if len(shape) <= 1:
+        for role, frags in _ROLE_PATTERNS:
+            if role == "norm" and any(f in low for f in frags):
+                return "norm"
+        return "bias"
+    for role, frags in _ROLE_PATTERNS:
+        if any(f in low for f in frags):
+            return role
+    return "other"
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical specs per parameter role over named mesh axes.
+
+    ``tp_axis``/``fsdp_axis`` may be None (that dimension of
+    parallelism is off); ``data_axes`` is the tuple of axes the batch
+    dim shards over (empty = replicated batch)."""
+
+    data_axes: tuple = ("data",)
+    tp_axis: Optional[str] = None
+    fsdp_axis: Optional[str] = None
+
+    def embedding(self):
+        # (V, H) table: vocab over tp (partial-sum gather on lookup),
+        # fsdp rides the same dim when both are on
+        lead = tuple(a for a in (self.fsdp_axis, self.tp_axis) if a)
+        if not lead:
+            return (None, None)
+        return (lead if len(lead) > 1 else lead[0], None)
+
+    def column(self):
+        # (K, N) up-projection: N over tp, K over fsdp
+        return (self.fsdp_axis, self.tp_axis)
+
+    def row(self):
+        # (K, N) down-projection: K over tp (forward partial), N fsdp
+        return (self.tp_axis, self.fsdp_axis)
+
+    def bias_column(self):
+        return (self.tp_axis,)
+
+    def vector(self):
+        # norm scales / row biases: replicated (tiny, gather-free)
+        return (None,)
+
+    def batch(self):
+        if not self.data_axes:
+            return None
+        return self.data_axes if len(self.data_axes) != 1 \
+            else self.data_axes[0]
+
+    def spec_for(self, name: str, shape: Sequence[int]):
+        role = classify_param(name, shape)
+        if role == "position":
+            # positional tables are max_seq_len x H — tiny by
+            # construction; sharding one buys a gather per lookup and
+            # saves nothing (megatron replicates them too)
+            return (None,) * len(shape)
+        if role == "embedding":
+            return self.embedding()
+        if role == "column":
+            return self.column() if len(shape) == 2 else (None,) * len(shape)
+        if role == "row":
+            return self.row() if len(shape) == 2 else (None,) * len(shape)
+        if role == "bias":
+            # a column-projection's bias rides the tp split
+            low = name.lower()
+            if any(f in low for f in _FRAGS["column"]):
+                return self.bias_column()
+            return (None,) * max(len(shape), 1)
+        if role == "norm":
+            return (None,) * max(len(shape), 1)
+        # unknown 2-D+: leave replicated; a mutation may shard it
+        return (None,) * len(shape)
+
+
+def parameter_spec_from_name(name: str, shape: Sequence[int],
+                             layout: Optional[SpecLayout] = None):
+    """Heuristic spec for one parameter (t5x idiom): role from the
+    name, spec from the layout."""
+    return (layout or SpecLayout()).spec_for(name, shape)
+
+
+@dataclass(frozen=True)
+class Candidate:
+    """One complete placement: name + per-parameter specs + batch spec.
+
+    ``param_specs`` maps parameter NAME -> canonical spec tuple;
+    ``in_spec`` is the batch-dim entry (axis name, tuple of names, or
+    None) applied to input dim 0."""
+
+    name: str
+    origin: str
+    param_specs: Tuple[Tuple[str, tuple], ...]
+    in_spec: object = None
+
+    def spec_of(self, pname: str):
+        for n, s in self.param_specs:
+            if n == pname:
+                return s
+        return None
+
+    def as_dict(self) -> Dict[str, tuple]:
+        return dict(self.param_specs)
+
+
+def mesh_axis_split(mesh) -> Tuple[List[str], List[str]]:
+    """(batch-ish axes, model-ish axes) of a mesh by conventional
+    names; unknown axes with size > 1 count as model axes, size-1 axes
+    are ignored entirely."""
+    batch, model = [], []
+    for a in mesh.axis_names:
+        if int(mesh.shape[a]) <= 1:
+            continue
+        if a in ("data", "dp", "batch", "replica"):
+            batch.append(a)
+        else:
+            model.append(a)
+    return batch, model
+
+
+def _layout_candidate(name, origin, layout: SpecLayout,
+                      params: Sequence[Tuple[str, tuple]]) -> Candidate:
+    specs = tuple((pname, tuple(layout.spec_for(pname, shape)))
+                  for pname, shape in params)
+    return Candidate(name=name, origin=origin, param_specs=specs,
+                     in_spec=layout.batch())
+
+
+def _dedupe_candidates(cands: List[Candidate]) -> List[Candidate]:
+    seen = set()
+    out = []
+    for c in cands:
+        key = (c.param_specs, repr(c.in_spec))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append(c)
+    return out
+
+
+def enumerate_candidates(params: Sequence[Tuple[str, tuple]],
+                         mesh, max_mutations: int = 24
+                         ) -> List[Candidate]:
+    """Deterministic candidate population for (params, mesh).
+
+    ``params``: [(name, shape), ...] of the trainable parameters, in
+    model order. Seeds: canonical families + name-heuristic layouts;
+    then bounded local mutations of each seed."""
+    params = [(str(n), tuple(int(d) for d in s)) for n, s in params]
+    batch_axes, model_axes = mesh_axis_split(mesh)
+    all_axes = batch_axes + model_axes
+    cands: List[Candidate] = []
+
+    # ---- canonical families -------------------------------------------
+    # pure DP: everything replicated, batch over every non-trivial axis
+    # (a trivial mesh keeps the batch replicated too)
+    dp_layout = SpecLayout(data_axes=tuple(all_axes))
+    cands.append(_layout_candidate("dp", "family:dp", dp_layout, params))
+    # megatron-TP over each model axis (batch over the rest; a TP-only
+    # mesh keeps the batch replicated)
+    for ax in model_axes:
+        rest = tuple(a for a in all_axes if a != ax)
+        layout = SpecLayout(data_axes=rest, tp_axis=ax)
+        cands.append(_layout_candidate(
+            f"tp({ax})", f"family:tp:{ax}", layout, params))
+    # FSDP over each axis: every param's dim 0 sharded, batch over all
+    for ax in model_axes + (batch_axes if not model_axes else []):
+        specs = tuple(
+            (pname, ((ax,) + (None,) * (len(shape) - 1))
+             if shape else (None,))
+            for pname, shape in params)
+        cands.append(Candidate(
+            name=f"fsdp({ax})", origin=f"family:fsdp:{ax}",
+            param_specs=specs,
+            in_spec=(tuple(all_axes) if len(all_axes) > 1
+                     else all_axes[0]) if all_axes else None))
+    # TP x FSDP hybrid over ordered model-axis pairs
+    for ax_f in model_axes:
+        for ax_t in model_axes:
+            if ax_f == ax_t:
+                continue
+            rest = tuple(a for a in all_axes if a not in (ax_f, ax_t))
+            layout = SpecLayout(data_axes=rest, tp_axis=ax_t,
+                                fsdp_axis=ax_f)
+            cands.append(_layout_candidate(
+                f"tp({ax_t})xfsdp({ax_f})",
+                f"family:hybrid:{ax_t}:{ax_f}", layout, params))
+
+    # ---- name-heuristic seeds -----------------------------------------
+    # the t5x layout on the first model axis, batch over the rest
+    for ax in model_axes[:1]:
+        rest = tuple(a for a in all_axes if a != ax)
+        layout = SpecLayout(data_axes=rest or (ax,), tp_axis=ax)
+        cands.append(_layout_candidate(
+            f"heuristic({ax})", f"heuristic:{ax}", layout, params))
+
+    seeds = _dedupe_candidates(cands)
+
+    # ---- local mutations ----------------------------------------------
+    mutations: List[Candidate] = []
+    groups = sorted({classify_param(n, s) for n, s in params})
+    for seed in seeds:
+        # (a) flip one group's sharded dim on its 2-D params
+        for g in groups:
+            flipped = []
+            changed = False
+            for (pname, shape), (_, spec) in zip(params,
+                                                 seed.param_specs):
+                if (classify_param(pname, shape) == g and len(spec) == 2
+                        and (spec[0] is not None
+                             or spec[1] is not None)):
+                    flipped.append((pname, (spec[1], spec[0])))
+                    changed = True
+                else:
+                    flipped.append((pname, spec))
+            if changed:
+                mutations.append(Candidate(
+                    name=f"{seed.name}+flip({g})",
+                    origin=f"mutation:flip:{seed.name}:{g}",
+                    param_specs=tuple(flipped), in_spec=seed.in_spec))
+        # (b) move one group's sharding to a different model axis
+        for g in groups:
+            for ax in model_axes:
+                moved = []
+                changed = False
+                for (pname, shape), (_, spec) in zip(params,
+                                                     seed.param_specs):
+                    if classify_param(pname, shape) != g:
+                        moved.append((pname, spec))
+                        continue
+                    new = tuple(ax if (e is not None and e != ax)
+                                else e for e in spec)
+                    if new != spec:
+                        changed = True
+                    moved.append((pname, new))
+                if changed:
+                    mutations.append(Candidate(
+                        name=f"{seed.name}+move({g}->{ax})",
+                        origin=f"mutation:move:{seed.name}:{g}:{ax}",
+                        param_specs=tuple(moved), in_spec=seed.in_spec))
+    out = _dedupe_candidates(seeds + mutations[:max_mutations])
+    return out

@@ -1,0 +1,396 @@
+"""Analytical plan scoring — compute + collective + HBM per candidate.
+
+Walks a propagated :class:`~..spmd.propagate.ShardingPlan` over a
+recorded ``static.Program`` and prices one training step of the
+candidate placement:
+
+* **compute** — every op's ``OpDef.cost_fn`` FLOPs/bytes
+  (``observability.perf.costmodel``), scaled by the op's *per-device
+  shard fraction* (the fraction of the global output each device
+  materializes under the propagated specs), then turned into seconds
+  via the chip's roofline (``chip_peak_flops``/``chip_peak_bw`` +
+  ``roofline_bound``): an op takes max(flops/peak_flops,
+  bytes/peak_bw) at a fixed achievable-efficiency factor. A ~2x for
+  the backward pass is applied to compute (fwd + dgrad + wgrad ≈ 3x
+  forward FLOPs for GEMM-bearing ops; 2x is the conservative
+  program-level blend).
+* **collective** — three explicit sources, so the score sees the
+  collectives GSPMD will insert instead of trusting it invisibly:
+  (1) *reduce-pending outputs* (``OpAnnotation.out_partial`` from the
+  matmul/einsum rules): an all-reduce of the per-device output bytes
+  over the pending axes; (2) *resharding* at rule boundaries (a
+  consumer's resolved input constraint disagreeing with the producer's
+  spec): modeled as an all-to-all of the value's bytes over the axes
+  in motion; (3) *backward-pass constraint injection* — the gradient
+  transpose of every GEMM-bearing op (a column-parallel forward is
+  collective-free but its input gradient is reduce-pending; a
+  row-parallel forward's pending reduce has a collective-free
+  backward) plus the data-parallel gradient all-reduce for every
+  parameter whose spec does not consume the batch axes. All wire-byte
+  formulas are the ring-algorithm ones (``collective_cost``), priced
+  at the chip's ICI bandwidth.
+* **memory** — per-device HBM high-water: parameters + gradients +
+  optimizer state (``opt_state_factor`` extra param copies, 2.0 =
+  Adam) at their sharded sizes, plus every forward activation at its
+  sharded size (training keeps them live for backward), plus the
+  sharded feed batch. A plan over ``capacity_bytes`` is **rejected**,
+  not ranked.
+
+Ops with neither a rule nor a cost model are either listed in
+:data:`PENALTY_OPS` (an explicit, documented surcharge — e.g. the
+monolithic ``moe_layer`` dispatch) or counted into
+``Score.unscored_ops`` — ``tools/planner_audit.py`` fails the build
+when a workload emits an op in neither table.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ...observability.perf import (chip_hbm_bytes, chip_peak_bw,
+                                   chip_peak_flops)
+from ...observability.perf.costmodel import (OpCost, collective_cost,
+                                             cost_of, dtype_bytes)
+from ..spmd import rules as R
+
+__all__ = ["Score", "score_plan", "PENALTY_OPS", "ici_bandwidth",
+           "GEMM_OPS"]
+
+#: fraction of spec-sheet peak a real kernel sustains (constant across
+#: candidates, so it shifts absolute seconds without reordering ranks;
+#: kept at the LLM-ladder's measured ~0.5 MFU so reports read sane)
+ACHIEVABLE = 0.5
+
+#: modeled fwd+bwd compute multiplier over forward-only (dgrad + wgrad
+#: re-run the GEMMs; elementwise backward is ~1x) — program-level blend
+BACKWARD_COMPUTE = 2.0
+
+#: per-chip ICI bandwidth (bytes/s) fallback — v4/v5p-class links; the
+#: planner only needs candidates priced on a COMMON scale
+_ICI_BW = 9e10
+
+#: per-collective launch latency (the alpha of the alpha-beta model),
+#: charged once per collective EVENT per participating hop. This is
+#: what separates "one big all-reduce" from "26 tiny per-param
+#: all-reduces" — wire bytes alone cannot
+_ALPHA_S = 2e-6
+
+#: ops dispatched as opaque host/composite boundaries that carry no spmd
+#: rule by design, with the planner's explicit surcharge: the op is
+#: scored as replicated compute PLUS an all-to-all of its IO bytes over
+#: the largest mesh axis (the worst collective its internal
+#: dispatch/combine could need). tools/planner_audit.py accepts an op
+#: either via a named/category rule or via THIS table — never silently.
+PENALTY_OPS: Dict[str, str] = {
+    "moe_layer": "monolithic MoE dispatch/expert/combine: replicated "
+                 "compute + all-to-all of token bytes over the widest "
+                 "mesh axis",
+    "moe_gate": "gating softmax + top-k: replicated compute (tiny) + "
+                "all-gather of gate logits",
+}
+
+#: GEMM-bearing op classes whose backward transposes the parallelism
+#: (column-parallel fwd -> reduce-pending dX; row-parallel fwd ->
+#: collective-free dX)
+GEMM_OPS = frozenset((
+    "matmul", "mm", "bmm", "addmm", "linear", "fc", "matmul_v2",
+    "einsum", "fused_norm_linear", "fused_rope_proj", "embedding",
+))
+
+
+def ici_bandwidth() -> float:
+    """Inter-chip interconnect bytes/s used to price collective wire
+    bytes (spec-sheet class constant; candidates only need a common
+    scale)."""
+    return _ICI_BW
+
+
+def _axes_product(mesh, axes) -> int:
+    n = 1
+    for a in set(axes):
+        try:
+            n *= int(mesh.shape[a])
+        except Exception:
+            pass
+    return max(n, 1)
+
+
+def shard_fraction(spec, mesh, shape=None) -> float:
+    """Fraction of the global value each device MATERIALIZES under
+    ``spec``. With ``shape``, divisibility-aware: a dim of 4 sharded 8
+    ways pads to per-device size 1 (fraction 1/4, half the devices
+    idle) — exactly what the partitioner does, and what makes
+    over-sharding a small batch score honestly."""
+    if spec is None:
+        return 1.0
+    if shape is None:
+        axes = [a for e in spec for a in R._axes(e)]
+        return 1.0 / _axes_product(mesh, axes)
+    frac = 1.0
+    for d, e in zip(shape, spec):
+        n = _axes_product(mesh, R._axes(e))
+        if n > 1 and int(d) > 0:
+            frac *= math.ceil(int(d) / n) / int(d)
+    return frac
+
+
+def _value_bytes(shape, itemsize: int = 4) -> float:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return float(n) * itemsize
+
+
+@dataclass
+class Score:
+    """Priced placement: per-step seconds + per-device memory."""
+
+    candidate: str = ""
+    compute_s: float = 0.0
+    collective_s: float = 0.0
+    hbm_bytes: float = 0.0
+    rejected: Optional[str] = None       # reason, or None = rankable
+    #: seconds per collective source (partial / reshard / backward /
+    #: grad_sync / penalty)
+    collective_breakdown: Dict[str, float] = field(default_factory=dict)
+    #: bytes per memory class (params / grads / optimizer / activations
+    #: / feeds)
+    memory_breakdown: Dict[str, float] = field(default_factory=dict)
+    fallback_ops: Dict[str, int] = field(default_factory=dict)
+    unscored_ops: Dict[str, int] = field(default_factory=dict)
+    penalty_ops: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def total_s(self) -> float:
+        """Modeled step seconds: compute and collectives serialized
+        (no-overlap conservative model)."""
+        return self.compute_s + self.collective_s
+
+    def to_dict(self) -> dict:
+        return {"candidate": self.candidate,
+                "compute_s": self.compute_s,
+                "collective_s": self.collective_s,
+                "total_s": self.total_s,
+                "hbm_bytes": self.hbm_bytes,
+                "rejected": self.rejected,
+                "collective_breakdown": dict(self.collective_breakdown),
+                "memory_breakdown": dict(self.memory_breakdown),
+                "fallback_ops": dict(self.fallback_ops),
+                "unscored_ops": dict(self.unscored_ops)}
+
+
+def _op_seconds(cost: OpCost, fraction: float, peak_f: float,
+                peak_b: float) -> float:
+    """Roofline time of one op's per-device shard."""
+    f = cost.flops * fraction
+    b = cost.bytes * fraction
+    return max(f / peak_f, b / peak_b) if (f or b) else 0.0
+
+
+def _collective_seconds(primitive: str, nbytes: float, axes,
+                        mesh) -> float:
+    n = _axes_product(mesh, axes)
+    if n <= 1:
+        return 0.0
+    wire = collective_cost(primitive, nbytes, n).bytes_read
+    # alpha-beta: launch latency once per ring hop + wire time
+    return _ALPHA_S * (n - 1) + wire / ici_bandwidth()
+
+
+def score_plan(program, plan, mesh, *,
+               candidate_name: str = "",
+               param_ids: Optional[set] = None,
+               opt_state_factor: float = 2.0,
+               capacity_bytes: Optional[float] = None,
+               hot_flops_frac: float = 0.01) -> Score:
+    """Price one propagated candidate (see module docstring).
+
+    ``param_ids``: value ids of the TRAINABLE captured parameters
+    (grads + optimizer state are charged for these; other captured
+    tensors are constants). ``capacity_bytes``: per-device HBM ceiling
+    (default: the chip's spec capacity)."""
+    peak_f = chip_peak_flops() * ACHIEVABLE
+    peak_b = chip_peak_bw() * ACHIEVABLE
+    capacity = capacity_bytes if capacity_bytes is not None \
+        else chip_hbm_bytes()
+    sc = Score(candidate=candidate_name)
+    coll = sc.collective_breakdown
+    for k in ("partial", "reshard", "backward", "grad_sync", "penalty"):
+        coll[k] = 0.0
+    env = plan.env
+    ops = program.global_block().ops
+    widest = max((int(mesh.shape[a]) for a in mesh.axis_names),
+                 default=1)
+    wide_axes = [a for a in mesh.axis_names
+                 if int(mesh.shape[a]) == widest]
+
+    op_costs: List[Optional[OpCost]] = []
+    total_flops = 0.0
+    for op in ops:
+        c = cost_of(op.name, op.in_shapes or (), (), op.attrs,
+                    op.out_shapes or ())
+        op_costs.append(c)
+        if c is not None:
+            total_flops += c.flops
+
+    activations = 0.0
+    for op, ann, c in zip(ops, plan.annotations, op_costs):
+        out_shapes = op.out_shapes or ()
+        in_shapes = op.in_shapes or ()
+        out_spec0 = ann.out_specs[0] if ann.out_specs else None
+        # per-device work follows the MOST-sharded operand: a
+        # reduction to scalar over a sharded batch still only touches
+        # each device's shard (out frac alone would bill it fully
+        # replicated)
+        frac = shard_fraction(out_spec0, mesh,
+                              out_shapes[0] if out_shapes else None)
+        for i, vid in enumerate(op.in_ids):
+            have = env.get(vid)
+            if have is not None:
+                frac = min(frac, shard_fraction(
+                    have, mesh,
+                    in_shapes[i] if i < len(in_shapes) else None))
+        if op.name in PENALTY_OPS:
+            sc.penalty_ops[op.name] = sc.penalty_ops.get(op.name, 0) + 1
+            io_bytes = sum(_value_bytes(s) for s in in_shapes) \
+                + sum(_value_bytes(s) for s in out_shapes)
+            if c is not None:
+                sc.compute_s += _op_seconds(c, 1.0, peak_f, peak_b) \
+                    * BACKWARD_COMPUTE
+            coll["penalty"] += _collective_seconds(
+                "all_to_all", io_bytes, wide_axes, mesh)
+        elif c is None:
+            sc.unscored_ops[op.name] = \
+                sc.unscored_ops.get(op.name, 0) + 1
+        else:
+            sc.compute_s += _op_seconds(c, frac, peak_f, peak_b) \
+                * BACKWARD_COMPUTE
+
+        if ann.tier == "replicate-warn" and op.name not in PENALTY_OPS:
+            sc.fallback_ops[op.name] = \
+                sc.fallback_ops.get(op.name, 0) + 1
+
+        # (1) reduce-pending outputs -> all-reduce of sharded bytes
+        for shape, spec, pend in zip(
+                out_shapes, ann.out_specs,
+                list(ann.out_partial) + [()] * len(out_shapes)):
+            if pend:
+                nb = _value_bytes(shape) * shard_fraction(spec, mesh,
+                                                          shape)
+                coll["partial"] += _collective_seconds(
+                    "all_reduce", nb, pend, mesh)
+        # (2) resharding at constrained inputs
+        for i, (vid, ispec) in enumerate(zip(
+                op.in_ids, list(ann.in_specs) + [None] * len(op.in_ids))):
+            if ispec is None:
+                continue
+            have = env.get(vid)
+            if have is None or tuple(have) == tuple(ispec):
+                continue
+            # axes in motion, PER DIM: an axis hopping between dims (a
+            # sharding transpose, exactly what the flip mutations
+            # generate) moves data even though the axis-name sets are
+            # equal — a name-set symmetric difference would price it
+            # free
+            moved = set()
+            for eh, ei in zip(have, ispec):
+                if eh != ei:
+                    moved.update(R._axes(eh))
+                    moved.update(R._axes(ei))
+            if not moved:
+                continue
+            shape = in_shapes[i] if i < len(in_shapes) else ()
+            # the exchanged size is the GATHERED value over the moving
+            # axes (ring all-gather wire = (n-1)/n x gathered bytes),
+            # and the backward replays it as the adjoint
+            # reduce-scatter — two collectives per boundary
+            n_m = _axes_product(mesh, moved)
+            nb = _value_bytes(shape) * shard_fraction(have, mesh,
+                                                      shape) * n_m
+            coll["reshard"] += 2 * _collective_seconds(
+                "all_gather", nb, moved, mesh)
+        # (3) backward transpose of GEMM-bearing ops: a forward with NO
+        # pending reduce but a sharded weight output-dim (column split)
+        # has a reduce-pending input gradient of x's size
+        if op.name in GEMM_OPS and len(in_shapes) >= 2:
+            pend_f = ann.out_partial[0] if ann.out_partial else ()
+            out_axes = {a for e in (out_spec0 or ())
+                        for a in R._axes(e)}
+            x_spec = env.get(op.in_ids[0])
+            x_axes = {a for e in (x_spec or ()) for a in R._axes(e)}
+            col_axes = sorted((out_axes - x_axes)
+                              - set(pend_f or ()))
+            if col_axes and not pend_f:
+                nb = _value_bytes(in_shapes[0]) \
+                    * shard_fraction(x_spec, mesh, in_shapes[0])
+                coll["backward"] += _collective_seconds(
+                    "all_reduce", nb, col_axes, mesh)
+
+        for shape, spec in zip(out_shapes, ann.out_specs):
+            activations += _value_bytes(shape) \
+                * shard_fraction(spec, mesh, shape)
+
+    # ---- data-parallel gradient sync ----------------------------------
+    feed_axes = set()
+    for name, vid in program.feed_vars.items():
+        spec = env.get(vid)
+        for e in (spec or ()):
+            feed_axes.update(R._axes(e))
+    params_b = grads_b = 0.0
+    pids = param_ids if param_ids is not None \
+        else set(program._captured.keys())
+    # gradient sync is BUCKETED per distinct axis group (every real DP
+    # implementation fuses grads into flat buffers): one all-reduce of
+    # the group's total bytes, not one launch per parameter
+    sync_groups: Dict[tuple, float] = {}
+    for vid, t in program._captured.items():
+        spec = env.get(vid)
+        nb = _value_bytes(t.shape,
+                          dtype_bytes(getattr(t, "dtype", "float32"))) \
+            * shard_fraction(spec, mesh, t.shape)
+        params_b += nb
+        if vid not in pids:
+            continue
+        grads_b += nb
+        spec_axes = {a for e in (spec or ()) for a in R._axes(e)}
+        sync_axes = tuple(sorted(feed_axes - spec_axes))
+        if sync_axes:
+            sync_groups[sync_axes] = sync_groups.get(sync_axes, 0.0) + nb
+    for sync_axes, nb in sorted(sync_groups.items()):
+        coll["grad_sync"] += _collective_seconds(
+            "all_reduce", nb, sync_axes, mesh)
+
+    feeds_b = 0.0
+    for name, vid in program.feed_vars.items():
+        shape = [d if d > 0 else 1
+                 for d in program._feed_shapes.get(name, ())]
+        feeds_b += _value_bytes(shape) \
+            * shard_fraction(env.get(vid), mesh, shape)
+
+    sc.collective_s = sum(coll.values())
+    mem = sc.memory_breakdown
+    mem["params"] = params_b
+    mem["grads"] = grads_b
+    mem["optimizer"] = grads_b * opt_state_factor
+    mem["activations"] = activations
+    mem["feeds"] = feeds_b
+    sc.hbm_bytes = sum(mem.values())
+
+    if sc.hbm_bytes > capacity:
+        sc.rejected = (f"over HBM capacity: needs "
+                       f"{sc.hbm_bytes / 1e9:.2f} GB/device, chip has "
+                       f"{capacity / 1e9:.2f} GB")
+    else:
+        # replicate-fallbacks on HOT ops blind the score — discard
+        hot = [n for n, cnt in sc.fallback_ops.items()
+               if any(c is not None and c.flops
+                      >= hot_flops_frac * max(total_flops, 1.0)
+                      for o, c in zip(ops, op_costs) if o.name == n)]
+        if hot:
+            sc.rejected = (f"replicate-fallback on hot op(s) "
+                           f"{sorted(hot)} — cost model cannot see "
+                           f"their collectives")
+    return sc

@@ -1,0 +1,313 @@
+"""The planning pipeline: enumerate -> propagate -> score -> emit.
+
+``plan()`` turns (model/function/Program, mesh, batch spec) into a
+ranked set of placements and emits the winner in exactly the shape the
+execution entry points consume::
+
+    result = planner.plan(train_loss, mesh, example_inputs=(x, y),
+                          model=model)
+    step = to_static(train_loss, mesh=mesh,
+                     in_specs=result.in_specs,
+                     param_specs=result.param_specs)
+    # or: result.apply(model); Engine(model, ..., mesh=mesh)
+
+or, one line higher, ``Engine(model, loss, opt, mesh=mesh,
+placement="auto")`` runs the whole pipeline on the first batch.
+
+The pipeline (GSPMD/Alpa-style, analytical not profiled):
+
+1. :mod:`.candidates` enumerates name-heuristic + canonical-family
+   seeds and their local mutations (deterministic);
+2. each candidate is pushed through the round-13 offline propagation
+   pass (``spmd.propagate_program``) so every op's rule resolves the
+   activation shardings the placement implies;
+3. :mod:`.cost` prices each propagated plan — per-op roofline compute
+   from ``OpDef.cost_fn``, ring wire-bytes for the reduce-pending /
+   reshard / backward-transpose / grad-sync collectives, per-device
+   HBM high-water with hard over-capacity rejection;
+4. the cheapest surviving candidate is emitted as ``(param_specs,
+   in_specs)`` + a report naming why each loser lost.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..spmd import rules as R
+from ..spmd.propagate import propagate_program
+from . import cost as cost_mod
+from .candidates import Candidate, enumerate_candidates
+
+__all__ = ["plan", "PlanResult", "trace_program"]
+
+
+def _to_pspec(spec):
+    from jax.sharding import PartitionSpec as P
+    if spec is None:
+        return None
+    if not isinstance(spec, tuple) or isinstance(spec, P):
+        return spec
+    return P(*spec)
+
+
+@dataclass
+class ScoredCandidate:
+    candidate: Candidate
+    score: "cost_mod.Score"
+    fallbacks: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class PlanResult:
+    """Ranked placements + the winner in entry-point shape."""
+
+    mesh: object
+    ranked: List[ScoredCandidate]
+    #: winner's parameter name -> canonical spec tuple
+    param_spec_table: Dict[str, tuple]
+    #: winner's parameter value-id -> spec (for Program-only planning)
+    _param_spec_by_id: Dict[int, tuple]
+    #: batch entry (axis name / tuple / None) for input dim 0
+    batch_entry: object
+    #: feed ranks, to build in_specs matching each input's rank
+    _feed_ranks: Tuple[int, ...]
+
+    @property
+    def winner(self) -> ScoredCandidate:
+        return self.ranked[0]
+
+    @property
+    def rejected(self) -> List[ScoredCandidate]:
+        return [s for s in self.ranked if s.score.rejected]
+
+    # ---- emission -----------------------------------------------------
+    @property
+    def param_specs(self) -> Callable:
+        """``fn(tensor) -> PartitionSpec`` consumable verbatim by
+        ``to_static(param_specs=)`` / ``Engine(param_specs=)``."""
+        by_id = dict(self._param_spec_by_id)
+        table = dict(self.param_spec_table)
+
+        def fn(t):
+            spec = by_id.get(id(t))
+            if spec is None:
+                name = getattr(t, "name", None)
+                spec = table.get(name) if name else None
+            return _to_pspec(spec)
+
+        return fn
+
+    @property
+    def in_specs(self):
+        """Per-input PartitionSpecs (batch dim 0 sharded per the
+        winner), one per traced feed."""
+        from jax.sharding import PartitionSpec as P
+        e = self.batch_entry
+        if isinstance(e, P):
+            specs = tuple(e for _ in self._feed_ranks)
+        else:
+            specs = tuple((P(e) if e is not None else P())
+                          if r >= 1 else P()
+                          for r in self._feed_ranks)
+        if not specs:
+            return None
+        return specs if len(specs) > 1 else specs[0]
+
+    def apply(self, model) -> Dict[str, object]:
+        """Stamp + device_put the winner's placements onto a model's
+        parameters (like ``spmd.shard_params``). Returns
+        {name: spec}."""
+        import jax
+        from jax.sharding import NamedSharding
+
+        placed = {}
+        for name, p in model.named_parameters():
+            spec = self.param_spec_table.get(name)
+            if spec is None or R.is_trivial(spec):
+                continue
+            sharding = NamedSharding(self.mesh, R.to_pspec(spec))
+            p._swap_payload(jax.device_put(p._data, sharding))
+            p._spmd_spec = tuple(spec)
+            placed[name] = spec
+        return placed
+
+    def summary(self) -> dict:
+        return {
+            "winner": self.winner.candidate.name,
+            "winner_total_s": self.winner.score.total_s,
+            "candidates": len(self.ranked),
+            "rejected": len(self.rejected),
+            "table": [s.score.to_dict() for s in self.ranked],
+        }
+
+    def report(self) -> str:
+        from tools.plan_report import render
+        return render(self)
+
+
+def trace_program(fn: Callable, example_inputs: Sequence,
+                  kwargs: Optional[dict] = None):
+    """Record ``fn(*example_inputs)`` as a ``static.Program`` whose
+    Tensor arguments become feeds (``arg0``..) and whose captured
+    tensors are the parameters/constants. The trace runs the function
+    eagerly once (on the example batch) — exactly what the offline
+    propagation pass consumes."""
+    from ... import static
+    from ...core.tensor import Tensor
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        wrapped = []
+        for i, a in enumerate(example_inputs):
+            # feed set == the jit path's TRACED leaves (Tensor/array):
+            # python scalars/lists stay static there, so making them
+            # feeds here would emit one in_spec too many for
+            # to_static(param_specs="auto") to seed
+            if isinstance(a, Tensor):
+                t = a
+            elif isinstance(a, np.ndarray) or (
+                    hasattr(a, "shape") and hasattr(a, "dtype")):
+                import jax.numpy as jnp
+                t = Tensor(jnp.asarray(a))
+            else:
+                wrapped.append(a)
+                continue
+            name = f"arg{i:02d}"  # zero-padded: feed order == sort order
+            prog._keepalive.append(t)
+            prog.feed_vars[name] = id(t)
+            prog._feed_shapes[name] = tuple(int(d) for d in t.shape)
+            prog._feed_dtypes[name] = str(t.dtype)
+            wrapped.append(t)
+        out = fn(*wrapped, **(kwargs or {}))
+    return prog, out
+
+
+def _named_params(program, model=None):
+    """[(name, shape, value_id, tensor)] of the program's trainable
+    captured parameters. With ``model``, names come from
+    ``named_parameters()`` (the vocabulary the heuristics know);
+    otherwise the tensor's own autoname."""
+    by_id = {}
+    if model is not None and hasattr(model, "named_parameters"):
+        for name, p in model.named_parameters():
+            by_id[id(p)] = name
+    out = []
+    for vid, t in program._captured.items():
+        if getattr(t, "stop_gradient", False) and vid not in by_id:
+            continue  # constants captured by the trace, not parameters
+        name = by_id.get(vid) or getattr(t, "name", None) or f"p{vid}"
+        out.append((name, tuple(int(d) for d in t.shape), vid, t))
+    return out
+
+
+def plan(fn_or_program, mesh, in_specs=None, *,
+         example_inputs: Optional[Sequence] = None,
+         kwargs: Optional[dict] = None,
+         model=None,
+         capacity_bytes: Optional[float] = None,
+         opt_state_factor: float = 2.0,
+         max_candidates: Optional[int] = None) -> PlanResult:
+    """Search placements for one training step (see module docstring).
+
+    ``fn_or_program``: a traced ``static.Program`` or a callable (then
+    ``example_inputs`` is required — the callable runs once eagerly to
+    record the program). ``in_specs``: optional explicit batch
+    PartitionSpec(s); when given, every candidate keeps it and only the
+    parameter placements are searched. ``model``: supplies
+    ``named_parameters()`` so the name heuristics see real names.
+    ``capacity_bytes``: per-device HBM ceiling (default chip spec).
+    """
+    from ... import static
+    from ..spmd import attach_spmd_rules
+    from ...observability.perf.costmodel import attach_cost_models
+
+    attach_spmd_rules()
+    attach_cost_models()
+    if hasattr(mesh, "jax_mesh"):
+        mesh = mesh.jax_mesh()
+
+    if isinstance(fn_or_program, static.Program):
+        program = fn_or_program
+    elif callable(fn_or_program):
+        if example_inputs is None:
+            raise ValueError(
+                "planning a callable needs example_inputs= (one "
+                "example batch to trace the program from)")
+        program, _ = trace_program(fn_or_program, example_inputs, kwargs)
+    else:
+        raise TypeError(f"cannot plan a {type(fn_or_program).__name__}")
+    if not program.global_block().ops:
+        raise ValueError("traced program is empty — nothing to place")
+
+    params = _named_params(program, model)
+    cands = enumerate_candidates([(n, s) for n, s, _, _ in params], mesh)
+    if max_candidates:
+        cands = cands[:max_candidates]
+
+    feed_names = sorted(program.feed_vars)
+    feed_ranks = tuple(
+        len(program._feed_shapes.get(n, ())) for n in feed_names)
+    pid_set = {vid for _, _, vid, _ in params}
+
+    fixed_in = None
+    if in_specs is not None:
+        fixed_in = in_specs if isinstance(in_specs, (list, tuple)) \
+            and not _is_pspec(in_specs) else [in_specs] * len(feed_names)
+
+    scored: List[ScoredCandidate] = []
+    for cand in cands:
+        spec_by_id = {vid: cand.spec_of(name)
+                      for name, _, vid, _ in params}
+
+        def param_spec_fn(t, _m=spec_by_id):
+            s = _m.get(id(t))
+            return _to_pspec(s) if s is not None else None
+
+        if fixed_in is not None:
+            feed_specs = {n: fixed_in[i] if i < len(fixed_in) else None
+                          for i, n in enumerate(feed_names)}
+        else:
+            feed_specs = {
+                n: _to_pspec((cand.in_spec,)
+                             + (None,) * (max(r, 1) - 1))
+                if r >= 1 and cand.in_spec is not None else None
+                for n, r in zip(feed_names, feed_ranks)}
+        p = propagate_program(program, mesh, feed_specs,
+                              param_specs=param_spec_fn)
+        s = cost_mod.score_plan(
+            program, p, mesh, candidate_name=cand.name,
+            param_ids=pid_set, opt_state_factor=opt_state_factor,
+            capacity_bytes=capacity_bytes)
+        scored.append(ScoredCandidate(cand, s,
+                                      fallbacks=dict(p.fallback_ops)))
+
+    # rank: survivors by modeled step time, rejected at the tail (by
+    # their would-be time) — deterministic tiebreak on candidate name
+    scored.sort(key=lambda sc: (sc.score.rejected is not None,
+                                sc.score.total_s, sc.candidate.name))
+    if all(sc.score.rejected for sc in scored):
+        reasons = {sc.candidate.name: sc.score.rejected
+                   for sc in scored}
+        raise RuntimeError(
+            f"auto-parallel planner: every candidate was rejected — "
+            f"{reasons}")
+
+    win = scored[0].candidate
+    table = {name: win.spec_of(name) for name, _, _, _ in params}
+    by_id = {vid: win.spec_of(name) for name, _, vid, _ in params}
+    return PlanResult(
+        mesh=mesh, ranked=scored,
+        param_spec_table={k: v for k, v in table.items()
+                          if v is not None},
+        _param_spec_by_id={k: v for k, v in by_id.items()
+                           if v is not None},
+        batch_entry=(fixed_in[0] if fixed_in is not None
+                     else win.in_spec),
+        _feed_ranks=feed_ranks)
+
+
+def _is_pspec(x) -> bool:
+    from jax.sharding import PartitionSpec
+    return isinstance(x, PartitionSpec)

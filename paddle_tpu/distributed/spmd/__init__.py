@@ -35,8 +35,9 @@ from __future__ import annotations
 import re
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .rules import (CATEGORY_RULES, SPMD_RULES, SpmdResult,  # noqa: F401
-                    attach_spmd_rules, dedupe, meet, normalize,
+from .rules import (CATEGORY_RULES, SPMD_RULES, Partial,  # noqa: F401
+                    SpmdResult, attach_spmd_rules, dedupe, meet,
+                    meet_partial, normalize, normalize_partial,
                     rule_class_of, rule_for, to_pspec)
 from .propagate import (OpAnnotation, ShardedProgram,  # noqa: F401
                         ShardingPlan, param_spec_of, propagate_program,
@@ -45,7 +46,8 @@ from .propagate import (OpAnnotation, ShardedProgram,  # noqa: F401
 __all__ = ["shard_program", "ShardedProgram", "ShardingPlan",
            "propagate_program", "trace_scope", "attach_spmd_rules",
            "shard_params", "param_rules_fn", "SPMD_RULES",
-           "CATEGORY_RULES", "rule_for", "coverage"]
+           "CATEGORY_RULES", "rule_for", "coverage", "Partial",
+           "meet_partial"]
 
 
 def param_rules_fn(rules: Sequence[Tuple[str, object]],

@@ -73,6 +73,10 @@ class OpAnnotation:
     tier: str                      # rule | category-fallback | replicate-warn
     in_specs: List[Optional[tuple]]
     out_specs: List[Optional[tuple]]
+    #: per-output reduce-pending mesh axes (rules.Partial surfaced by
+    #: contraction rules; empty tuple = not partial). The planner's
+    #: scorer charges the pending all-reduce; no constraint is inserted.
+    out_partial: List[tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -82,6 +86,8 @@ class ShardingPlan:
     mesh: object
     annotations: List[OpAnnotation] = field(default_factory=list)
     env: Dict[int, tuple] = field(default_factory=dict)
+    #: value id -> reduce-pending axes (only ids currently partial)
+    partial_env: Dict[int, tuple] = field(default_factory=dict)
     fallback_ops: Dict[str, int] = field(default_factory=dict)
     # meet-rule conflicts are counted in the
     # paddle_tpu_spmd_conflicts_total metric (rules.meet), not per plan
@@ -126,6 +132,9 @@ def _apply_rule(op_name, in_specs, in_shapes, attrs, out_shapes):
                                          - len(res.in_specs))
     res.in_specs = [None if s is None else R.normalize(s, len(in_shapes[i]))
                     for i, s in enumerate(ins)]
+    pend = list(res.out_partial) + [()] * (len(out_shapes)
+                                           - len(res.out_partial))
+    res.out_partial = [R.normalize_partial(p) for p in pend]
     return res, tier
 
 
@@ -158,10 +167,14 @@ def propagate_program(program, mesh, in_specs: Dict[str, object],
         if tier == "replicate-warn":
             plan.fallback_ops[op.name] = \
                 plan.fallback_ops.get(op.name, 0) + 1
-        for oid, spec in zip(op.out_ids, res.out_specs):
+        for oid, spec, pend in zip(op.out_ids, res.out_specs,
+                                   res.out_partial):
             env[oid] = spec
+            if pend:
+                plan.partial_env[oid] = pend
         plan.annotations.append(OpAnnotation(
-            op.name, tier, res.in_specs, res.out_specs))
+            op.name, tier, res.in_specs, res.out_specs,
+            res.out_partial))
     return plan
 
 

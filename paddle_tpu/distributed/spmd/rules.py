@@ -28,6 +28,16 @@ Conventions
   two *different* sharded entries replicate that dim (conflict, counted
   in ``paddle_tpu_spmd_conflicts_total``). One axis name may shard only
   one dim of a value; later repeats are dropped (`dedupe`).
+* **Partial (reduce-pending) placement**: a value whose producer
+  contracted a sharded dim (row-parallel matmul, einsum over a sharded
+  contraction) is *partial* over those mesh axes — each shard holds a
+  partial sum and an all-reduce over the axes is pending. Partiality is
+  a per-VALUE property (not per-dim), carried as a sorted tuple of axis
+  names in ``SpmdResult.out_partial`` and merged with `meet_partial`:
+  equal keeps; the intersection survives a disagreement (an axis one
+  side believes already reduced cannot be un-reduced). The planner's
+  cost model charges the pending all-reduce; GSPMD still owns emitting
+  it.
 """
 from __future__ import annotations
 
@@ -36,9 +46,9 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ...observability import metrics as _metrics
 
-__all__ = ["SpmdResult", "normalize", "meet", "dedupe", "to_pspec",
-           "attach_spmd_rules", "rule_for", "SPMD_RULES",
-           "CATEGORY_RULES", "rule_class_of"]
+__all__ = ["SpmdResult", "Partial", "normalize", "meet", "meet_partial",
+           "dedupe", "to_pspec", "attach_spmd_rules", "rule_for",
+           "SPMD_RULES", "CATEGORY_RULES", "rule_class_of"]
 
 _m_conflicts = _metrics.counter(
     "paddle_tpu_spmd_conflicts_total",
@@ -115,6 +125,46 @@ def is_trivial(spec) -> bool:
     return spec is None or all(e is None for e in spec)
 
 
+@dataclass(frozen=True)
+class Partial:
+    """Reduce-pending placement marker: the value is a partial sum over
+    ``axes`` — each shard along those mesh axes holds an addend and an
+    all-reduce is pending. Surfaced by rules whose op contracts a
+    sharded dim (einsum/matmul); the planner's scorer charges the wire
+    bytes, the partitioner emits the actual collective."""
+
+    axes: tuple
+
+    def __iter__(self):
+        return iter(self.axes)
+
+
+def normalize_partial(p) -> tuple:
+    """Partial / axis tuple / axis name / None -> sorted axis tuple."""
+    if p is None:
+        return ()
+    if isinstance(p, Partial):
+        p = p.axes
+    elif hasattr(p, "reduce_type"):
+        # the OTHER Partial — distributed.auto_parallel's DistTensor
+        # Placement. It names a reduce op, not mesh axes; silently
+        # iterating it would produce garbage axis tuples
+        raise TypeError(
+            "got a distributed.Partial Placement; the spmd spec "
+            "algebra wants spmd.rules.Partial(axes) / an axis tuple")
+    if isinstance(p, str):
+        p = (p,)
+    return tuple(sorted(set(p)))
+
+
+def meet_partial(a, b) -> tuple:
+    """Merge two reduce-pending proposals for one value: equal keeps;
+    otherwise only the axes BOTH sides still consider pending survive
+    (an axis one side already reduced over cannot be un-reduced)."""
+    return tuple(sorted(set(normalize_partial(a))
+                        & set(normalize_partial(b))))
+
+
 @dataclass
 class SpmdResult:
     """One rule application: resolved input constraints + output specs.
@@ -123,10 +173,18 @@ class SpmdResult:
     propagator found it"; otherwise the propagator may re-annotate the
     input at the op boundary (the offline ``shard_program`` pass does;
     the online trace scope only annotates outputs).
+
+    ``out_partial[i]`` is the sorted tuple of mesh axes output i is
+    reduce-pending over (empty = fully reduced / not partial). Rules
+    that contract a sharded dim (matmul/einsum) surface the pending
+    all-reduce here so the planner can score it; the propagator does
+    NOT insert a constraint for it — the partitioner owns the
+    collective.
     """
 
     out_specs: List[tuple]
     in_specs: List[Optional[tuple]] = field(default_factory=list)
+    out_partial: List[tuple] = field(default_factory=list)
 
 
 # --------------------------------------------------------------------------
@@ -251,7 +309,10 @@ def matmul_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
     # locate m among a's (last two) dims, n among b's
     def _pick(shape, spec, want, prefer_last):
         if len(shape) == 1:
-            return spec[0] if int(shape[0]) == int(want) else None
+            # a 1-D operand IS the contraction (matvec/vecmat): its
+            # only dim never supplies m or n, even when the extents
+            # coincide
+            return None
         d_last, d_prev = int(shape[-1]), int(shape[-2])
         if prefer_last:  # n: standard layout has it last
             if d_last == int(want):
@@ -266,6 +327,25 @@ def matmul_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
         return None
     m_entry = _pick(a_shape, a_spec, m, prefer_last=False)
     n_entry = _pick(b_shape, b_spec, n, prefer_last=True)
+    # contracted dim: whichever of each operand's trailing dims did NOT
+    # supply m/n is k — a sharded k makes the output reduce-pending
+    # (Partial) over those axes
+    partial = set()
+    for shape, spec, picked, prefer_last in (
+            (a_shape, a_spec, m_entry, False),
+            (b_shape, b_spec, n_entry, True)):
+        if len(shape) == 1:
+            # 1-D operand: its whole extent is contracted
+            partial.update(_axes(spec[0]))
+            continue
+        # the trailing dim not picked as m/n is the contraction
+        if prefer_last:
+            k_entry = spec[-2] if int(shape[-1]) == int(n) \
+                and picked == spec[-1] else spec[-1]
+        else:
+            k_entry = spec[-1] if int(shape[-2]) == int(m) \
+                and picked == spec[-2] else spec[-2]
+        partial.update(_axes(k_entry))
     batch = list((None,) * (len(out_shape) - 2))
     # batch dims: right-aligned merge of the operands' batch prefixes
     for spec, shape in ((a_spec, a_shape), (b_spec, b_shape)):
@@ -286,21 +366,97 @@ def matmul_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
         resolved = [None, None, bias_spec] + [None] * (len(in_specs) - 3)
     else:
         resolved = [None] * len(in_specs)
+    # a contracted sharded axis is reduce-pending even when the output
+    # also uses it for a kept dim (col-split W consuming a
+    # contraction-sharded x: the partitioner reduce-scatters — the
+    # collective is real either way)
+    pend = tuple(sorted(partial))
     return SpmdResult(out_specs=[out if tuple(s) == tuple(out_shape)
                                  else (None,) * len(s)
                                  for s in out_shapes],
-                      in_specs=resolved)
+                      in_specs=resolved,
+                      out_partial=[pend if tuple(s) == tuple(out_shape)
+                                   else () for s in out_shapes])
+
+
+def parse_einsum_equation(equation: str, n_operands: int,
+                          in_shapes=None):
+    """``"nec,nh->ech"`` -> (input terms, output term) as label lists,
+    or None when the equation cannot be resolved statically (ellipsis,
+    operand/term mismatch). Implicit output (no ``->``) follows the
+    einsum convention: labels appearing exactly once, alphabetical."""
+    eq = equation.replace(" ", "")
+    if "." in eq:          # ellipsis: rank-dependent, punt to heuristics
+        return None
+    if "->" in eq:
+        lhs, rhs = eq.split("->", 1)
+    else:
+        lhs, rhs = eq, None
+    terms = lhs.split(",")
+    if len(terms) != n_operands:
+        return None
+    if in_shapes is not None:
+        for t, s in zip(terms, in_shapes):
+            if len(t) != len(s):
+                return None
+    if rhs is None:
+        counts: Dict[str, int] = {}
+        for t in terms:
+            for c in t:
+                counts[c] = counts.get(c, 0) + 1
+        rhs = "".join(sorted(c for c, n in counts.items() if n == 1))
+    return [list(t) for t in terms], list(rhs)
 
 
 def einsum_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
-    """Minimal einsum guidance: batch-style merge when ranks line up,
-    otherwise unconstrained (still a real rule — einsum legality is the
-    partitioner's job)."""
-    if (len(in_specs) == 2 and out_shapes
-            and len(in_shapes[0]) == len(in_shapes[1])
-            == len(out_shapes[0])):
-        return elementwise_rule(in_specs, in_shapes, attrs, out_shapes)
-    return SpmdResult(out_specs=[(None,) * len(s) for s in out_shapes])
+    """General einsum propagation from the ``equation`` attr: each
+    label's placement is the meet of every operand dim carrying it;
+    output dims read the label map; labels contracted away (absent from
+    the output) whose dims were sharded make the output **Partial**
+    over those axes — the MoE dispatch/combine einsums
+    (``nec,nh->ech`` / ``nec,ech->nh``) and megatron-style sharded
+    contractions all resolve without replicating. Inputs are
+    constrained back to the merged label map. Falls back to the old
+    batch-style heuristic when no equation is recorded (pre-round-16
+    traces) or the equation is rank-dynamic (ellipsis)."""
+    eq = (attrs or {}).get("equation")
+    parsed = parse_einsum_equation(eq, len(in_specs), in_shapes) \
+        if isinstance(eq, str) else None
+    if parsed is None:
+        if (len(in_specs) == 2 and out_shapes
+                and len(in_shapes[0]) == len(in_shapes[1])
+                == len(out_shapes[0])):
+            return elementwise_rule(in_specs, in_shapes, attrs,
+                                    out_shapes)
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    terms, out_term = parsed
+    # label -> merged placement entry (meet over every occurrence)
+    label: Dict[str, object] = {}
+    for term, spec in zip(terms, in_specs):
+        for c, e in zip(term, spec):
+            label[c] = meet((label[c],), (e,))[0] if c in label else e
+    out_shape = out_shapes[0] if out_shapes else ()
+    if len(out_term) != len(out_shape):
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    out = dedupe(tuple(label.get(c) for c in out_term))
+    # contracted labels with sharded dims -> reduce-pending axes (kept
+    # even when an output dim reuses the axis: the reduce-scatter is
+    # still a real collective)
+    pend = set()
+    for c, e in label.items():
+        if c not in out_term:
+            pend.update(_axes(e))
+    pend_t = tuple(sorted(pend))
+    resolved = [dedupe(tuple(label.get(c) for c in term))
+                for term in terms]
+    return SpmdResult(
+        out_specs=[out if tuple(s) == tuple(out_shape)
+                   else (None,) * len(s) for s in out_shapes],
+        in_specs=resolved,
+        out_partial=[pend_t if tuple(s) == tuple(out_shape) else ()
+                     for s in out_shapes])
 
 
 def conv_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
@@ -341,13 +497,28 @@ def attention_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
 
 
 def norm_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
-    """layer/rms/batch/group/instance norm: the activation's spec passes
+    """batch/group/instance norm: the activation's spec passes
     through; scale/bias/stats stay replicated."""
     x_spec = in_specs[0] if in_specs else ()
     x_shape = in_shapes[0] if in_shapes else ()
     outs = [x_spec if tuple(s) == tuple(x_shape)
             else _carry(x_spec, x_shape, s) for s in out_shapes]
     resolved = [None] + [normalize(None, len(s)) for s in in_shapes[1:]]
+    return SpmdResult(out_specs=outs, in_specs=resolved)
+
+
+def layer_norm_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
+    """layer/rms norm: statistics reduce over the LAST (feature) dim —
+    a sharding there forces a gather, so the rule constrains the input
+    feature dim replicated and carries only the leading dims' placement
+    through. Scale/bias stay replicated."""
+    x_spec = in_specs[0] if in_specs else ()
+    x_shape = in_shapes[0] if in_shapes else ()
+    pinned = tuple(x_spec[:-1]) + (None,) if x_spec else x_spec
+    outs = [pinned if tuple(s) == tuple(x_shape)
+            else _carry(pinned, x_shape, s) for s in out_shapes]
+    resolved = [pinned if x_spec and x_spec[-1] is not None else None]
+    resolved += [normalize(None, len(s)) for s in in_shapes[1:]]
     return SpmdResult(out_specs=outs, in_specs=resolved)
 
 
@@ -459,7 +630,14 @@ def embedding_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
         out[d] = ids_spec[d]
     if len(out_shape) >= 1 and len(table_spec) >= 2:
         out[-1] = table_spec[-1]
-    return SpmdResult(out_specs=[dedupe(tuple(out))])
+    out = dedupe(tuple(out))
+    # vocab-sharded table: each shard contributes masked rows — the
+    # lookup's output is reduce-pending over the vocab axes
+    used = {ax for e in out for ax in _axes(e)}
+    pend = tuple(sorted(set(_axes(table_spec[0])) - used)) \
+        if len(table_spec) >= 2 else ()
+    return SpmdResult(out_specs=[out],
+                      out_partial=[pend] + [()] * (len(out_shapes) - 1))
 
 
 def gather_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
@@ -648,8 +826,10 @@ def _fill_rules():
                  "flash_attn_unpadded", "ring_flash_attention",
                  "memory_efficient_attention"):
         SPMD_RULES[name] = attention_rule
-    for name in ("layer_norm", "rms_norm", "batch_norm", "group_norm",
-                 "instance_norm", "fused_layer_norm", "fused_rms_norm",
+    for name in ("layer_norm", "rms_norm", "fused_layer_norm",
+                 "fused_rms_norm"):
+        SPMD_RULES[name] = layer_norm_rule
+    for name in ("batch_norm", "group_norm", "instance_norm",
                  "local_response_norm", "spectral_norm", "weight_norm"):
         SPMD_RULES[name] = norm_rule
     for name in ("rotary_embedding", "fused_rotary_position_embedding",

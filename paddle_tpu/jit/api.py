@@ -208,6 +208,9 @@ class StaticFunction:
         self._check_input_spec(args)
         params = self._collect_params(args)
         fn = self._dygraph_fn
+        if self._spmd_mesh is not None \
+                and self._spmd_param_specs == "auto":
+            self._auto_plan(args, kwargs)
 
         leaves, treedef = jax.tree_util.tree_flatten(
             (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
@@ -343,6 +346,27 @@ class StaticFunction:
                         p._data = d
 
         self._jitted = jax.jit(jit_target, static_argnums=(2, 3))
+
+    def _auto_plan(self, args, kwargs):
+        """param_specs="auto": run the auto-parallel planner
+        (distributed.planner) on the first call's arguments — the
+        function runs once eagerly to record its program, candidates
+        are searched and cost-scored, and the winner's placements
+        replace the "auto" marker before the first jit trace."""
+        from ..distributed import planner as planner_mod
+
+        owner = getattr(self._dygraph_fn, "__self__", None)
+        model = owner if hasattr(owner, "named_parameters") else None
+        res = planner_mod.plan(
+            self._dygraph_fn, self._spmd_mesh,
+            in_specs=self._spmd_in_specs,
+            example_inputs=args, kwargs=dict(kwargs),
+            model=model)
+        #: PlanResult of the auto placement (report(), ranked table)
+        self.placement_plan = res
+        self._spmd_param_specs = res.param_specs
+        if self._spmd_in_specs is None:
+            self._spmd_in_specs = res.in_specs
 
     def _spmd_traced_call(self, fn, args_t, kwargs_t, params):
         """Run the traced body under a sharding-propagation scope
@@ -675,7 +699,10 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """Program capture; with ``mesh=`` the capture auto-shards — see
     distributed.spmd (``in_specs``: PartitionSpec pytree for the Tensor
     arguments; ``param_specs``: optional ``fn(param) -> spec``,
-    defaulting to each param's spmd.shard_params placement)."""
+    defaulting to each param's spmd.shard_params placement — or the
+    string ``"auto"`` to let the auto-parallel planner
+    (distributed.planner) search and emit the placement on the first
+    call)."""
     def decorate(fn):
         if hasattr(fn, "forward") and callable(getattr(fn, "forward")):
             # Layer instance: wrap its forward

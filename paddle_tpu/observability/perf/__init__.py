@@ -36,7 +36,8 @@ __all__ = ["costmodel", "device", "memory", "OpCost", "cost_of",
            "attribute", "capture_enabled", "compiled_programs", "measure",
            "record_compiled", "step_attribution", "timed_section",
            "census", "high_water", "update_high_water", "PEAK_FLOPS",
-           "PEAK_HBM_BW", "chip_peak_flops", "chip_peak_bw"]
+           "PEAK_HBM_BW", "HBM_CAPACITY", "chip_peak_flops",
+           "chip_peak_bw", "chip_hbm_bytes"]
 
 #: peak dense bf16 FLOPs/s per chip (public spec sheets) — the roofline's
 #: compute ceiling; bench.py's MFU math delegates here
@@ -94,3 +95,26 @@ def chip_peak_bw(device_obj=None) -> float:
     """Peak HBM bytes/s of the chip (CPU fallback ~100 GB/s DDR so the
     roofline math stays finite on dev hosts)."""
     return _chip_lookup(PEAK_HBM_BW, device_obj, 1228e9, 100e9)
+
+
+#: HBM capacity (bytes) per chip — public spec sheets; the placement
+#: planner's hard memory ceiling (a plan whose per-device high-water
+#: exceeds this is rejected, not ranked)
+HBM_CAPACITY = {
+    "TPU v2": 8e9,
+    "TPU v3": 16e9,
+    "TPU v4": 32e9,
+    "TPU v5 lite": 16e9,
+    "TPU v5e": 16e9,
+    "TPU v5": 95e9,
+    "TPU v5p": 95e9,
+    "TPU v6 lite": 32e9,
+    "TPU v6e": 32e9,
+    "TPU7x": 192e9,
+}
+
+
+def chip_hbm_bytes(device_obj=None) -> float:
+    """HBM capacity in bytes of one chip (CPU fallback 16 GB host RAM
+    budget so planner capacity checks stay meaningful on dev hosts)."""
+    return _chip_lookup(HBM_CAPACITY, device_obj, 32e9, 16e9)

@@ -29,7 +29,8 @@ from typing import Callable, Dict, Optional, Sequence
 import numpy as np
 
 __all__ = ["OpCost", "cost_of", "attach_cost_models", "xla_cost",
-           "collective_cost", "dtype_bytes", "COST_MODELS"]
+           "collective_cost", "einsum_cost", "dtype_bytes",
+           "COST_MODELS"]
 
 
 def dtype_bytes(dtype) -> int:
@@ -260,6 +261,37 @@ def fused_rope_proj_cost(input_shapes, input_dtypes, attrs,
                   "fused rope projection")
 
 
+def einsum_cost(input_shapes, input_dtypes, attrs, output_shapes) -> OpCost:
+    """General einsum from the recorded ``equation`` attr: FLOPs =
+    2 x the product of every distinct label's extent (each output
+    element is a MAC chain over the contracted extents). Without an
+    equation (legacy traces) the contraction structure is unknown —
+    fall back to the matmul formula when shapes allow, else
+    elementwise-over-largest-operand."""
+    eq = (attrs or {}).get("equation")
+    if isinstance(eq, str) and "." not in eq:
+        lhs = eq.replace(" ", "").split("->", 1)[0]
+        terms = lhs.split(",")
+        if len(terms) == len(input_shapes) and all(
+                len(t) == len(s) for t, s in zip(terms, input_shapes)):
+            extent: Dict[str, int] = {}
+            for t, s in zip(terms, input_shapes):
+                for c, d in zip(t, s):
+                    extent[c] = int(d)
+            vol = 1.0
+            for d in extent.values():
+                vol *= d
+            read, written = _io_bytes(input_shapes, input_dtypes,
+                                      output_shapes)
+            return OpCost(2.0 * vol, read, written, f"einsum {eq}")
+    if len(input_shapes) >= 2 and all(len(s) >= 2
+                                      for s in input_shapes[:2]):
+        return matmul_cost(input_shapes, input_dtypes, {}, output_shapes)
+    n = max((_numel(s) for s in input_shapes), default=0)
+    read, written = _io_bytes(input_shapes, input_dtypes, output_shapes)
+    return OpCost(2.0 * n, read, written, "einsum (no equation)")
+
+
 def collective_cost(primitive: str, nbytes: float,
                     n_devices: int) -> OpCost:
     """Wire bytes of one collective under the standard ring algorithms
@@ -323,6 +355,12 @@ def _fill_models():
     for name in ("exp", "log", "tanh", "sigmoid", "gelu", "silu", "swish",
                  "erf", "sin", "cos", "pow", "softplus", "log1p"):
         COST_MODELS[name] = ew4
+    COST_MODELS["einsum"] = einsum_cost
+    # dispatch-level ops with no registry entry (tensor protocol /
+    # model-layer composites) — named here so the planner's scoring
+    # walk prices them (tools/planner_audit.py enforces coverage)
+    COST_MODELS["getitem"] = elementwise_cost(0.0)   # slice: traffic only
+    COST_MODELS["rotary_embedding"] = elementwise_cost(6.0)
     # fused ops (compile/fusion rewrite targets) — round-12 attribution
     # must see through the rewrite (ISSUE 10)
     COST_MODELS["fused_bias_act"] = elementwise_cost(5.0)

@@ -33,7 +33,11 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
 
 def _shape(shape):
     if isinstance(shape, Tensor):
-        return tuple(int(s) for s in np.asarray(shape._data))
+        # Tensor-valued shape -> python ints: creation ops need the
+        # STATIC output shape XLA requires, so the read is the
+        # host/graph boundary by design (same contract as
+        # manipulation._norm_shape)
+        return tuple(int(s) for s in np.asarray(shape._data))  # tpulint: disable=TPU103,TPU104 — static-shape construction from a shape tensor: host by design
     if isinstance(shape, (int, np.integer)):
         return (int(shape),)
     return tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)

@@ -93,12 +93,18 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
 
 @register("einsum", category="linalg")
 def einsum(equation, *operands):
-    """Einstein summation over named subscripts (reference paddle.einsum)."""
+    """Einstein summation over named subscripts (reference paddle.einsum).
+
+    The equation rides the dispatch attrs so recorders (static Program
+    IR, spmd trace scope) see it — the general einsum spmd_rule and
+    cost model both key on it."""
     ts = [_t(o) for o in operands]
     prec = _precision()
-    return dispatch.call("einsum",
-                         lambda *xs: jnp.einsum(equation, *xs,
-                                                precision=prec), ts)
+    return dispatch.call(
+        "einsum",
+        lambda *xs, equation=equation: jnp.einsum(equation, *xs,
+                                                  precision=prec),
+        ts, attrs={"equation": equation})
 
 
 def t(x, name=None):

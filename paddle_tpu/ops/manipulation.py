@@ -36,7 +36,11 @@ def _t(x):
 
 def _norm_shape(shape):
     if isinstance(shape, Tensor):
-        return tuple(int(v) for v in np.asarray(shape._data))
+        # a Tensor-valued target shape must become python ints to build
+        # the STATIC output shape XLA requires — the read IS the
+        # host/graph boundary (reference kernels read the shape tensor
+        # on host the same way); inside a trace, pass a python list
+        return tuple(int(v) for v in np.asarray(shape._data))  # tpulint: disable=TPU103,TPU104 — static-shape construction from a shape tensor: host by design
     return tuple(int(v.item()) if isinstance(v, Tensor) else int(v) for v in shape)
 
 
@@ -275,7 +279,11 @@ def repeat_interleave(x, repeats, axis=None, name=None):
     """Repeat each element ``repeats`` times along ``axis`` (reference
     paddle.repeat_interleave)."""
     if isinstance(repeats, Tensor):
-        reps = np.asarray(repeats._data)
+        # per-element repeat counts: the output length is sum(repeats)
+        # — a data-dependent shape jit cannot capture, so the counts
+        # are read on host (jnp.repeat would need a host-known
+        # total_repeat_length either way)
+        reps = np.asarray(repeats._data)  # tpulint: disable=TPU104 — data-dependent output size: host by design
         return dispatch.call("repeat_interleave",
                              lambda a: jnp.repeat(a, reps, axis=axis), [_t(x)])
     return dispatch.call("repeat_interleave",
@@ -425,7 +433,7 @@ def masked_select(x, mask, name=None):
     """1D tensor of elements where mask is True (host path: dynamic output
     shape) (reference paddle.masked_select)."""
     xt, mt = _t(x), _t(mask)
-    data = np.asarray(xt._data)[np.asarray(mt._data).astype(bool)]
+    data = np.asarray(xt._data)[np.asarray(mt._data).astype(bool)]  # tpulint: disable=TPU104 — mask population count IS the output shape: host by design (see op docstring)
     return Tensor(jnp.asarray(data))
 
 

@@ -563,9 +563,9 @@ class TestPerfGate:
     def test_frozen_repo_baseline_is_valid(self):
         """tools/perf_baseline.json (checked in) parses and gates the
         run it was frozen from. Rungs added to the baseline AFTER the
-        r05 freeze (fleet_observability round 14, fusion round 15) are
-        absent from the archived run — they may be missing, but nothing
-        may fail."""
+        r05 freeze (fleet_observability round 14, fusion round 15,
+        planner_vs_manual round 16) are absent from the archived run —
+        they may be missing, but nothing may fail."""
         with open(os.path.join(REPO, "tools", "perf_baseline.json")) as f:
             base = json.load(f)
         assert base["format"] == "paddle_tpu.perf_baseline/1"
@@ -575,6 +575,9 @@ class TestPerfGate:
         # the fusion bar is the acceptance criterion itself: >= 1.10x
         fusion = base["rungs"]["fusion_fused_vs_unfused_step_ratio"]
         assert fusion["value"] * fusion["min_ratio"] >= 1.10
+        # the planner bar likewise: planner placement >= best manual
+        pv = base["rungs"]["planner_vs_manual_step_ratio"]
+        assert pv["value"] * pv["min_ratio"] >= 1.0
         with open(os.path.join(REPO, "BENCH_r05.json")) as f:
             cand = perf_gate.parse_bench_output(f.read())
         res = perf_gate.gate(cand, base, allow_missing=True)
@@ -582,7 +585,8 @@ class TestPerfGate:
         missing = {c["metric"] for c in res["checks"]
                    if c["status"] == "missing"}
         assert missing <= {"fleet_observability_overhead_ratio",
-                           "fusion_fused_vs_unfused_step_ratio"}
+                           "fusion_fused_vs_unfused_step_ratio",
+                           "planner_vs_manual_step_ratio"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"

@@ -1,0 +1,181 @@
+"""Planner scoring-coverage audit — no silently-unscored ops.
+
+The planner ranks placements by an analytical cost walk; an op the walk
+cannot see (no spmd rule AND no cost model AND no explicit penalty
+entry) silently biases every score. This audit traces the three LLM
+workload programs the planner is pointed at — GPT, llama, and the MoE
+layer — and asserts every emitted op is covered one of two ways:
+
+* a **sharding tier** that isn't replicate-warn (named ``spmd_rule`` or
+  category fallback) AND a cost model (``cost_of`` returns non-None), or
+* an explicit entry in ``distributed.planner.cost.PENALTY_OPS`` — a
+  documented surcharge for by-design opaque ops (the monolithic
+  ``moe_layer``/``moe_gate`` dispatch).
+
+An op in neither bucket FAILS the audit (exit 1) —
+``tests/test_planner.py::test_planner_audit_clean`` runs it in tier-1,
+so a new workload op lands with a rule or a penalty entry, never
+silently.
+
+Run::
+
+    python tools/planner_audit.py            # audit, print table
+    python tools/planner_audit.py --json -   # machine-readable
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _trace_gpt():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import planner
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=128, hidden_size=32, num_layers=1, num_heads=4,
+        max_seq_len=16, use_flash_attention=False))
+    ids = np.zeros((2, 16), dtype=np.int64)
+
+    def loss_fn(x):
+        _, loss = model(x, labels=x)
+        return loss
+
+    prog, _ = planner.trace_program(loss_fn, (ids,))
+    return prog
+
+
+def _trace_llama():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import planner
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_layers=1, num_heads=4, num_kv_heads=4, max_seq_len=16,
+        use_flash_attention=False))
+    ids = np.zeros((2, 16), dtype=np.int64)
+
+    def loss_fn(x):
+        _, loss = model(x, labels=x)
+        return loss
+
+    prog, _ = planner.trace_program(loss_fn, (ids,))
+    return prog
+
+
+def _trace_moe():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import planner
+    from paddle_tpu.distributed.fleet import MoELayer
+
+    paddle.seed(0)
+    layer = MoELayer(d_model=16, num_experts=4, d_hidden=32, top_k=2)
+    x = np.zeros((8, 16), dtype=np.float32)
+
+    def fwd(xt):
+        out = layer(xt)
+        return (out * out).mean() + layer.l_aux
+
+    prog, _ = planner.trace_program(fwd, (x,))
+    return prog
+
+
+WORKLOADS = {
+    "gpt": _trace_gpt,
+    "llama": _trace_llama,
+    "moe": _trace_moe,
+}
+
+
+def audit() -> dict:
+    """Trace each workload, classify every emitted op. Returns
+    {"ok": bool, "workloads": {name: {op: status}}, "uncovered": [...]}
+    where status is 'rule' / 'category-fallback' / 'penalty' /
+    'UNCOVERED'."""
+    from paddle_tpu.distributed.planner.cost import PENALTY_OPS
+    from paddle_tpu.distributed.spmd import attach_spmd_rules, rule_for
+    from paddle_tpu.observability.perf.costmodel import (
+        attach_cost_models, cost_of)
+
+    attach_spmd_rules()
+    attach_cost_models()
+    out = {"ok": True, "workloads": {}, "uncovered": []}
+    for wname, tracer in WORKLOADS.items():
+        prog = tracer()
+        statuses = {}
+        for op in prog.global_block().ops:
+            if op.name in statuses:
+                continue
+            if op.name in PENALTY_OPS:
+                statuses[op.name] = "penalty"
+                continue
+            _, tier = rule_for(op.name)
+            cost = cost_of(op.name, op.in_shapes or (), (), op.attrs,
+                           op.out_shapes or ())
+            if tier != "replicate-warn" and cost is not None:
+                statuses[op.name] = tier
+            else:
+                why = []
+                if tier == "replicate-warn":
+                    why.append("no spmd rule")
+                if cost is None:
+                    why.append("no cost model")
+                statuses[op.name] = "UNCOVERED"
+                out["uncovered"].append(
+                    {"workload": wname, "op": op.name,
+                     "why": ", ".join(why)})
+                out["ok"] = False
+        out["workloads"][wname] = statuses
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--json", metavar="PATH",
+                    help="write machine-readable result ('-' = stdout)")
+    args = ap.parse_args(argv)
+    rep = audit()
+    if args.json:
+        payload = json.dumps(rep, indent=1, sort_keys=True)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w") as f:
+                f.write(payload + "\n")
+    for wname, statuses in rep["workloads"].items():
+        tiers = {}
+        for s in statuses.values():
+            tiers[s] = tiers.get(s, 0) + 1
+        print(f"{wname}: {len(statuses)} distinct ops — " +
+              ", ".join(f"{k}={v}" for k, v in sorted(tiers.items())))
+    if not rep["ok"]:
+        print("\nUNCOVERED ops (add an spmd rule + cost model, or an "
+              "explicit planner.cost.PENALTY_OPS entry):",
+              file=sys.stderr)
+        for u in rep["uncovered"]:
+            print(f"  [{u['workload']}] {u['op']}: {u['why']}",
+                  file=sys.stderr)
+        return 1
+    print("planner scoring coverage: OK (every emitted op is ruled, "
+          "category-covered, or explicitly penalized)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
