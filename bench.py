@@ -1373,6 +1373,378 @@ def _bench_dispatch(small):
     }
 
 
+def _async_gpt_parts(small):
+    """Shared GPT harness of the async-runtime rungs: model + a
+    functional AdamW step buildable donated or undonated (SAME math —
+    donation is pure buffer aliasing, so loss parity is exact)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if small:
+        cfg = GPTConfig(vocab_size=512, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128,
+                        use_flash_attention=False)
+        batch, seq, iters = 4, 128, 6
+    else:
+        cfg = GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                        max_seq_len=1024)
+        batch, seq, iters = _env_int("BENCH_BATCH", 8), 1024, 8
+    paddle.seed(7)
+    model = GPTForCausalLM(cfg)
+    params = [p for p in model.parameters() if not p.stop_gradient]
+    b1, b2, eps, lr = 0.9, 0.95, 1e-8, 2.5e-4
+
+    def loss_fn(pa, ids):
+        originals = [p._data for p in params]
+        for p, a in zip(params, pa):
+            p._data = a
+        try:
+            t = paddle.Tensor(ids)
+            _, loss = model(t, labels=t)
+            return loss._data.astype(jnp.float32)
+        finally:
+            for p, o in zip(params, originals):
+                p._data = o
+
+    def make_step(donate):
+        def step(state, ids):
+            pa, m_st, v_st, t = state
+            loss, grads = jax.value_and_grad(loss_fn)(pa, ids)
+            t = t + 1
+            tf = t.astype(jnp.float32)
+            new_pa, new_m, new_v = [], [], []
+            for w, m, v, g in zip(pa, m_st, v_st, grads):
+                g = g.astype(jnp.float32)
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * g * g
+                m_hat = m / (1 - b1 ** tf)
+                v_hat = v / (1 - b2 ** tf)
+                w = w - lr * m_hat / (jnp.sqrt(v_hat) + eps)
+                new_pa.append(w)
+                new_m.append(m)
+                new_v.append(v)
+            return loss, (new_pa, new_m, new_v, t)
+
+        return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+    def init_state():
+        pa = [jnp.array(p._data, copy=True) for p in params]
+        return (pa, [jnp.zeros_like(a) for a in pa],
+                [jnp.zeros_like(a) for a in pa],
+                jnp.asarray(0, jnp.int32))
+
+    return cfg, model, params, make_step, init_state, batch, seq, iters
+
+
+def _bench_async_overlap(small):
+    """Async-runtime rung (BENCH_MODEL=async_overlap; io/prefetch.py +
+    donated steps + sharding/decomposed.py).
+
+    The SAME GPT AdamW step runs two ways on the same batches:
+
+    * ``off`` — the synchronous baseline: batch transferred inline on
+      the consumer, undonated step, per-step host sync on the loss (the
+      pre-round-17 ``Engine.fit`` shape).
+    * ``on`` — the async runtime: DevicePrefetcher transfers batch k+1
+      while step k computes, the step donates its param/optimizer-state
+      buffers, and the loss is read once at the end.
+
+    Loss parity between the legs gates the score (donation and
+    prefetch change scheduling, never math). extra records the
+    round-12 attribution of both legs — the acceptance bar is
+    idle+host share strictly lower with overlap on — plus the
+    perf.memory high-water census of each leg (donated buffers count 0
+    the moment the step consumes them) and, when >= 2 devices are
+    visible, the decomposed vs serial stage-2 parameter re-gather."""
+    import paddle_tpu as paddle
+    from paddle_tpu.io.prefetch import DevicePrefetcher
+    from paddle_tpu.observability import perf as _perf, trace as _tr
+    from paddle_tpu.observability.perf import memory as _mem
+    from paddle_tpu.observability.perf.device import DEVICE_CAT
+
+    cfg, model, params, make_step, init_state, batch, seq, iters = \
+        _async_gpt_parts(small)
+    rng = np.random.RandomState(0)
+    # the loader hands out device Tensors (DataLoader._to_output);
+    # the pre-round-17 Engine.fit pulled them back to host and re-put
+    # them per step — that round trip is part of the off leg
+    loader_batches = [
+        paddle.Tensor(jnp.asarray(rng.randint(
+            0, cfg.vocab_size, (batch, seq)).astype(np.int64)))
+        for _ in range(iters)]
+    step_off = make_step(False)
+    step_on = make_step(True)
+
+    def place(t):
+        """The Engine's batch placement: Tensor → host → device."""
+        return jnp.asarray(t.numpy())
+
+    def run_off(state, census=False):
+        lf = None
+        for i, t in enumerate(loader_batches):
+            with _tr.span("io.transfer", "io"):
+                x = place(t)               # inline, on the critical path
+            loss, new_state = step_off(state, x)
+            if census and i == 1:
+                # old state still referenced here — the undonated
+                # execution window really holds both copies
+                _mem.update_high_water("async_overlap_off")
+            state = new_state
+            lf = float(loss)               # per-step host sync
+        return lf, state
+
+    def run_on(state, census=False):
+        pf = DevicePrefetcher(iter(loader_batches), depth=2,
+                              place_fn=place)
+        loss = None
+        try:
+            for i, x in enumerate(pf):
+                prev = state
+                loss, state = step_on(prev, x)
+                if census and i == 1:
+                    # prev was just donated: its buffers census as 0 —
+                    # the high-water drop donation buys
+                    _mem.update_high_water("async_overlap_on")
+        finally:
+            pf.close()
+        return float(loss), state
+
+    # warmup (compiles both programs) + parity + census
+    state_off = init_state()
+    state_on = init_state()
+    loss_off, state_off = run_off(state_off, census=True)
+    loss_on, state_on = run_on(state_on, census=True)
+    parity = abs(loss_on - loss_off) <= 1e-3 * max(abs(loss_off), 1.0)
+
+    # interleaved timed chunks, min per leg, alternating order per
+    # round so host drift hits both legs equally
+    best_off = best_on = float("inf")
+    for r in range(5):
+        legs = ("off", "on") if r % 2 == 0 else ("on", "off")
+        for leg in legs:
+            t0 = time.perf_counter()
+            if leg == "off":
+                _, state_off = run_off(state_off)
+                best_off = min(best_off,
+                               (time.perf_counter() - t0) / iters)
+            else:
+                _, state_on = run_on(state_on)
+                best_on = min(best_on,
+                              (time.perf_counter() - t0) / iters)
+
+    # round-12 attribution of one step per leg. The jit call is
+    # bracketed as a device span: on an async-dispatch backend it is a
+    # ~ms enqueue (the block in timed_section covers the real execution
+    # window); on a backend that serializes donated dispatch (CPU) the
+    # call IS the execution — either way the device share lands where
+    # the device actually worked, and the off leg's inline transfer +
+    # per-step sync stay host/idle.
+    attr_off = attr_on = None
+    pf_attr = None
+    try:
+        import itertools
+
+        st = {"s": state_off, "i": 0}
+
+        def off_step():
+            t = loader_batches[st["i"] % iters]
+            st["i"] += 1
+            with _tr.span("io.transfer", "io"):
+                x = place(t)
+            with _tr.span("bench.step", DEVICE_CAT):
+                loss, st["s"] = step_off(st["s"], x)
+            float(loss)                     # the sync the off leg pays
+            return loss
+
+        att = _perf.step_attribution(off_step, iters=2, warmup=1,
+                                     name="async_off")["total"]
+        attr_off = {k: round(att[k], 4) for k in
+                    ("compute_frac", "collective_frac", "host_frac",
+                     "idle_frac")}
+
+        pf_attr = DevicePrefetcher(
+            iter(itertools.cycle(loader_batches)), depth=2,
+            place_fn=place)
+        st2 = {"s": state_on}
+
+        def on_step():
+            x = next(pf_attr)
+            with _tr.span("bench.step", DEVICE_CAT):
+                loss, st2["s"] = step_on(st2["s"], x)
+            return loss
+
+        att = _perf.step_attribution(on_step, iters=2, warmup=1,
+                                     name="async_on")["total"]
+        attr_on = {k: round(att[k], 4) for k in
+                   ("compute_frac", "collective_frac", "host_frac",
+                    "idle_frac")}
+    except Exception:
+        pass
+    finally:
+        if pf_attr is not None:
+            pf_attr.close()
+
+    # decomposed vs serial stage-2 parameter re-gather (the old serial
+    # front) — needs a multi-device sharding mesh
+    gather = None
+    if jax.device_count() >= 2:
+        try:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from paddle_tpu.distributed import mesh as mesh_mod
+            from paddle_tpu.distributed.fleet.meta_optimizers. \
+                dygraph_sharding_optimizer import shard_spec_for
+            from paddle_tpu.distributed.sharding import (gather_grouped,
+                                                         plan_groups)
+            prev_mesh = mesh_mod._global_mesh
+            try:
+                mesh_mod._global_mesh = None
+                deg = jax.device_count()
+                mesh = mesh_mod.build_mesh({"sharding": deg})
+                mesh_mod.set_mesh(mesh)
+                shardable = [
+                    (p, NamedSharding(
+                        mesh, shard_spec_for(p.shape, deg, "sharding")))
+                    for p in params
+                    if shard_spec_for(p.shape, deg, "sharding")]
+                rep = NamedSharding(mesh, P())
+
+                def to_sharded():
+                    for p, sh in shardable:
+                        p._data = jax.device_put(p._data, sh)
+                    jax.block_until_ready([p._data for p, _ in shardable])
+
+                def timed_gather(fn):
+                    best = float("inf")
+                    for _ in range(3):
+                        to_sharded()
+                        t0 = time.perf_counter()
+                        fn()
+                        jax.block_until_ready(
+                            [p._data for p, _ in shardable])
+                        best = min(best, time.perf_counter() - t0)
+                    return best
+
+                def serial():
+                    for p, _sh in shardable:
+                        p._data = jax.device_put(p._data, rep)
+
+                def decomposed():
+                    gather_grouped([(p, rep) for p, _ in shardable],
+                                   site="bench")
+
+                gather = {
+                    "serial_s": round(timed_gather(serial), 5),
+                    "decomposed_s": round(timed_gather(decomposed), 5),
+                    "groups": len(plan_groups(
+                        [p for p, _ in shardable])),
+                    "params": len(shardable)}
+            finally:
+                mesh_mod._global_mesh = prev_mesh
+        except Exception:
+            gather = None
+
+    hbm_off = _mem.high_water("async_overlap_off")
+    hbm_on = _mem.high_water("async_overlap_on")
+    ratio = best_off / max(best_on, 1e-9)
+    overlap_win = None
+    if attr_off and attr_on:
+        overlap_win = bool(
+            attr_on["host_frac"] + attr_on["idle_frac"]
+            < attr_off["host_frac"] + attr_off["idle_frac"])
+    return {
+        "metric": "async_overlap_step_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_sync",
+        # parity is the gate: a fast-but-wrong async pipeline scores 0
+        "vs_baseline": round(ratio, 4) if parity else 0.0,
+        "extra": {"sync_step_s": round(best_off, 4),
+                  "async_step_s": round(best_on, 4),
+                  "loss_sync": round(loss_off, 5),
+                  "loss_async": round(loss_on, 5),
+                  "loss_parity": bool(parity),
+                  "attribution_off": attr_off,
+                  "attribution_on": attr_on,
+                  "idle_host_shrinks": overlap_win,
+                  "hbm_high_water_off": hbm_off.get("total"),
+                  "hbm_high_water_on": hbm_on.get("total"),
+                  "gather_decomposition": gather,
+                  "batch": batch, "seq": seq},
+    }
+
+
+def _bench_async_batch_sweep(small):
+    """steps/sec-vs-batch sweep (BENCH_MODEL=async_batch_sweep): the
+    SAME GPT step donated vs undonated across a batch ladder. Donation
+    halves the params+optimizer-state working set of the step (inputs
+    alias outputs), which is headroom for bigger batches — the sweep
+    records tokens/s AND the alias-aware compiled peak bytes
+    (memory_analysis) at every batch so the headroom is visible even on
+    hosts where nothing OOMs. value = donated/undonated tokens/s at the
+    largest batch, parity-gated."""
+    cfg, model, params, make_step, init_state, _batch, seq, _iters = \
+        _async_gpt_parts(small)
+    batches = (2, 4, 8) if small else (4, 8, _env_int("BENCH_BATCH", 16))
+    iters = 3 if small else 5
+    step_off = make_step(False)
+    step_on = make_step(True)
+    rng = np.random.RandomState(0)
+
+    def peak_bytes(compiled):
+        from paddle_tpu.observability.perf.device import memory_breakdown
+        mb = memory_breakdown(compiled)
+        return mb["peak_bytes"] if mb else None
+
+    def leg(step, state, ids):
+        loss, state = step(state, ids)      # compile + warm
+        first = float(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss, state = step(state, ids)
+        float(loss)
+        dt = (time.perf_counter() - t0) / iters
+        return first, dt, state
+
+    curve = []
+    ratio_at_max = 0.0
+    parity_all = True
+    for b in batches:
+        ids = jnp.asarray(rng.randint(0, cfg.vocab_size,
+                                      (b, seq)).astype(np.int64))
+        first_off, dt_off, _ = leg(step_off, init_state(), ids)
+        first_on, dt_on, _ = leg(step_on, init_state(), ids)
+        parity = abs(first_on - first_off) <= 1e-3 * max(
+            abs(first_off), 1.0)
+        parity_all = parity_all and parity
+        pk_off = peak_bytes(step_off.lower(init_state(), ids).compile())
+        pk_on = peak_bytes(step_on.lower(init_state(), ids).compile())
+        tok_off = b * seq / dt_off
+        tok_on = b * seq / dt_on
+        ratio_at_max = tok_on / max(tok_off, 1e-9)
+        curve.append({"batch": b,
+                      "tokens_per_s_undonated": round(tok_off, 1),
+                      "tokens_per_s_donated": round(tok_on, 1),
+                      "peak_bytes_undonated": pk_off,
+                      "peak_bytes_donated": pk_on,
+                      "loss_parity": bool(parity)})
+    comparable = [c for c in curve
+                  if c["peak_bytes_donated"] and c["peak_bytes_undonated"]]
+    # None (not a vacuous True) when the backend measured nothing — the
+    # acceptance signal must never read as satisfied without evidence
+    donated_smaller = (
+        all(c["peak_bytes_donated"] < c["peak_bytes_undonated"]
+            for c in comparable)
+        if comparable else None)
+    return {
+        "metric": "async_batch_sweep_tokens_ratio",
+        "value": round(ratio_at_max, 4),
+        "unit": "x_undonated",
+        "vs_baseline": round(ratio_at_max, 4) if parity_all else 0.0,
+        "extra": {"sweep": curve, "seq": seq,
+                  "donated_peak_below_undonated": donated_smaller,
+                  "loss_parity": bool(parity_all)},
+    }
+
+
 def _bench_pipeline(small):
     """Wall-clock pipeline-schedule comparison (VERDICT r3 #4): step time
     of FThenB vs 1F1B vs VPP(K=2,4) vs ZBH1 at fixed (m, total blocks)
@@ -1491,7 +1863,9 @@ def main():
                "spmd_auto": _bench_spmd_auto,
                "planner_vs_manual": _bench_planner_vs_manual,
                "fusion": _bench_fusion,
-               "fleet_observability": _bench_fleet_observability}
+               "fleet_observability": _bench_fleet_observability,
+               "async_overlap": _bench_async_overlap,
+               "async_batch_sweep": _bench_async_batch_sweep}
     if _env_bool("BENCH_FUSION", False):
         # opt the LADDER rungs into the fusion pass (they record the
         # flag state in extra either way); the fusion rung itself
@@ -1597,6 +1971,28 @@ def main():
     print(json.dumps(fo))
     sys.stdout.flush()
 
+    # async-runtime rungs ride along in every default run: prefetch +
+    # donation + decomposed gathers vs the synchronous baseline on the
+    # same GPT (parity-gated; bar >= 1.0x, see perf_baseline) and the
+    # donated-vs-undonated steps/sec-vs-batch sweep (own metric class —
+    # not in the train geomean)
+    try:
+        ao = benches["async_overlap"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        ao = {"metric": "async_overlap_step_ratio", "value": 0.0,
+              "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(ao))
+    sys.stdout.flush()
+    try:
+        ab = benches["async_batch_sweep"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        ab = {"metric": "async_batch_sweep_tokens_ratio", "value": 0.0,
+              "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(ab))
+    sys.stdout.flush()
+
     # serving-resilience rung rides along the same way: goodput vs
     # offered load with shed/deadline-miss counts lands in BENCH_*.json
     # every default run (own metric class — not in the train geomean)
@@ -1672,7 +2068,23 @@ def main():
                       "overhead_pct": fo.get("extra", {}).get(
                           "overhead_pct"),
                       "within_budget": fo.get("extra", {}).get(
-                          "within_budget")}},
+                          "within_budget")},
+                  "async_overlap": {
+                      "value": ao["value"], "unit": ao["unit"],
+                      "loss_parity": ao.get("extra", {}).get(
+                          "loss_parity"),
+                      "idle_host_shrinks": ao.get("extra", {}).get(
+                          "idle_host_shrinks"),
+                      "attribution_off": ao.get("extra", {}).get(
+                          "attribution_off"),
+                      "attribution_on": ao.get("extra", {}).get(
+                          "attribution_on")},
+                  "async_batch_sweep": {
+                      "value": ab["value"], "unit": ab["unit"],
+                      "donated_peak_below_undonated": ab.get(
+                          "extra", {}).get(
+                              "donated_peak_below_undonated"),
+                      "sweep": ab.get("extra", {}).get("sweep")}},
     }))
 
 

@@ -15,6 +15,13 @@ mismatch, or any other incompatibility returns ``None`` (a miss → the
 caller recompiles). The CRC layer in :mod:`.cache` already filtered out
 corruption, so failures here mean "not usable on this runtime", which is
 a legitimate miss, not an error.
+
+Donation: a serialized ``exec``-tier executable carries its input→output
+buffer aliasing, so a deserialized donated program donates exactly like
+the locally-compiled one — callers record ``donate`` in the entry meta
+and fold it into the cache key (``to_static._pcc_key``) so donated and
+undonated programs can never cross-hit; the ``stablehlo`` tier drops
+aliasing on export (a hit is correct but pays the undonated memory).
 """
 from __future__ import annotations
 

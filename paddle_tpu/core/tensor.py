@@ -23,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding
 
+from . import donation as _donation
 from . import dtype as dtypes
 from .place import current_place
 
@@ -132,6 +133,7 @@ class Tensor:
 
     # ------------------------------------------------------------- host sync
     def numpy(self) -> np.ndarray:
+        _donation.check(self._data, "Tensor.numpy()")
         return np.asarray(self._data)
 
     def __array__(self, dtype=None, copy=None):
@@ -141,14 +143,17 @@ class Tensor:
             raise ValueError(
                 "cannot expose a device tensor as a zero-copy numpy view; "
                 "call with copy=None/True")
+        _donation.check(self._data, "Tensor.__array__()")
         arr = np.asarray(self._data)
         return arr.astype(dtype) if dtype is not None else arr
 
     def item(self, *args):
+        _donation.check(self._data, "Tensor.item()")
         arr = np.asarray(self._data)
         return arr.item(*args)
 
     def tolist(self):
+        _donation.check(self._data, "Tensor.tolist()")
         return np.asarray(self._data).tolist()
 
     def __float__(self):
@@ -206,6 +211,7 @@ class Tensor:
         return self
 
     def cpu(self):
+        _donation.check(self._data, "Tensor.cpu()")
         return Tensor(jax.device_get(self._data), stop_gradient=self.stop_gradient)
 
     def to_dist(self, sharding):

@@ -27,7 +27,8 @@ from .. import mesh as mesh_mod
 class Engine:
     def __init__(self, model, loss=None, optimizer=None, metrics=None,
                  strategy=None, mesh=None, in_specs=None,
-                 param_specs=None, placement=None):
+                 param_specs=None, placement=None, donate=None,
+                 prefetch=None):
         self._model = model
         self._loss = loss
         self._optimizer = optimizer
@@ -62,6 +63,14 @@ class Engine:
         self.fusion_stats = None
         self._params = [p for p in model.parameters()
                         if not p.stop_gradient]
+        # Async runtime knobs (None = resolve from FLAGS at use time):
+        # donate hands the param/optimizer-state buffers to the compiled
+        # step (HBM high-water drop — see core.donation for the safety
+        # contract); prefetch double-buffers the input pipeline
+        # (io.DevicePrefetcher) so the next batch transfers during the
+        # current step.
+        self._donate_arg = donate
+        self._prefetch_arg = prefetch
         self._train_step = None
         self._eval_step = None
         self.history: List[float] = []
@@ -147,10 +156,19 @@ class Engine:
                 wd_flags)
             return loss, new_p, (t, new_m, new_st)
 
-        # no buffer donation: the arrays stay referenced by the live
-        # Parameters until the end-of-fit writeback; donation would
-        # invalidate them if fit aborts mid-epoch
-        self._train_step = jax.jit(step)
+        # donation (opt-in via Engine(donate=True) / FLAGS_donate_buffers):
+        # params + optimizer state are donated so XLA reuses their HBM
+        # for the updated values — the step's high-water drops by
+        # roughly the donated bytes (perf.memory records it). fit()
+        # writes the latest live arrays back into the Parameters in a
+        # finally block, so a mid-epoch abort leaves the model usable;
+        # stale pre-step references raise core.donation's clear error.
+        from ...core import flags as _flags
+        self._donate = (bool(_flags.get_flag("donate_buffers"))
+                        if self._donate_arg is None
+                        else bool(self._donate_arg))
+        self._train_step = jax.jit(
+            step, donate_argnums=(0, 1) if self._donate else ())
 
         def eval_step(param_arrays, x, y):
             originals = [p._data for p in params]
@@ -273,7 +291,11 @@ class Engine:
                     ys.numpy() if isinstance(ys, Tensor) else np.asarray(ys))
         if self._train_step is None:
             self.prepare()
+        from ...core import donation as _donation
+        from ...core import flags as _flags
+        from ...io.prefetch import DevicePrefetcher
         from ...observability import fleet as _fleet
+        from ...observability.perf import memory as _perf_mem
         from ...optimizer.lr import LRScheduler
 
         loader = self.dataloader(train_data, batch_size, shuffle=True)
@@ -281,42 +303,92 @@ class Engine:
         opt_state = self._init_opt_state(pa)
         sched = getattr(self._opt, "_learning_rate", None)
         sched = sched if isinstance(sched, LRScheduler) else None
-        for epoch in range(epochs):
-            losses = []
-            for step_i, batch in enumerate(loader):
-                if steps_per_epoch and step_i >= steps_per_epoch:
-                    break
-                # fleet beacon: per-step wall time + windowed cross-rank
-                # skew gather — the straggler detector's feed. Resolved
-                # per step (like the fleet trainers) so reset_beacon()
-                # takes effect mid-fit.
-                bcn = _fleet.beacon()
-                bcn.step_begin()
-                xs, ys = batch[0], batch[-1]
-                x = self._shard_batch(xs.numpy() if isinstance(xs, Tensor)
-                                      else xs)
-                y = self._shard_batch(ys.numpy() if isinstance(ys, Tensor)
-                                      else ys, which=1)
-                # lr is a traced INPUT: schedulers tick without retracing
-                lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
-                loss, pa, opt_state = self._train_step(pa, opt_state, lr,
-                                                       x, y)
-                if sched is not None:
-                    sched.step()
-                losses.append(float(loss))  # tpulint: disable=TPU103 — fit's per-step loss-history read; the driver loop is the documented host boundary (the compiled step itself stays async)
-                bcn.step_end()
-                if verbose and step_i % log_freq == 0:
-                    print(f"[engine] epoch {epoch} step {step_i} "
-                          f"loss {losses[-1]:.4f}")
-            self.history.append(float(np.mean(losses)))
-        # write the trained arrays AND accumulator states back into the
-        # eager optimizer, so a later opt.step()/state_dict() continues
-        # from where the Engine left off
-        t, _masters, states = opt_state
-        self._opt._step_count = int(t)  # tpulint: disable=TPU103 — one end-of-fit writeback into the eager optimizer (documented contract), not a per-step sync
-        for p, a, st in zip(self._params, pa, states):
-            p._data = a
-            self._opt._accumulators[id(p)] = st
+        use_prefetch = (bool(_flags.get_flag("prefetch"))
+                        if self._prefetch_arg is None
+                        else bool(self._prefetch_arg))
+
+        def place(batch):
+            """Batch → placed (x, y) device arrays; under prefetch this
+            runs on the producer thread, overlapping the current step."""
+            xs, ys = batch[0], batch[-1]
+            x = self._shard_batch(xs.numpy() if isinstance(xs, Tensor)
+                                  else xs)
+            y = self._shard_batch(ys.numpy() if isinstance(ys, Tensor)
+                                  else ys, which=1)
+            return x, y
+
+        if self._donate:
+            _donation.ensure_live(pa, "Engine.fit(donate=True) entry")
+            _donation.ensure_distinct(
+                ((p.name, a) for p, a in zip(self._params, pa)),
+                "Engine.fit(donate=True)")
+        census_left = 2     # attributed HBM census on the first steps
+        try:
+            for epoch in range(epochs):
+                # loss stays a device scalar: no per-step host sync —
+                # a running device-side sum (O(1) program regardless of
+                # epoch length), materialized only at log intervals and
+                # epoch end
+                loss_sum, loss_n = None, 0
+                it = iter(loader)
+                batches = (DevicePrefetcher(it, place_fn=place)
+                           if use_prefetch else (place(b) for b in it))
+                try:
+                    for step_i, (x, y) in enumerate(batches):
+                        if steps_per_epoch and step_i >= steps_per_epoch:
+                            break
+                        # fleet beacon: per-step wall time + windowed
+                        # cross-rank skew gather — the straggler
+                        # detector's feed. Resolved per step (like the
+                        # fleet trainers) so reset_beacon() takes effect
+                        # mid-fit.
+                        bcn = _fleet.beacon()
+                        bcn.step_begin()
+                        # lr is a traced INPUT: schedulers tick without
+                        # retracing
+                        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+                        prev = (pa, opt_state) if self._donate else None
+                        loss, pa, opt_state = self._train_step(
+                            pa, opt_state, lr, x, y)
+                        if prev is not None:
+                            _donation.mark_donated(
+                                jax.tree_util.tree_leaves(prev),
+                                "the Engine's donated train step")
+                        if sched is not None:
+                            sched.step()
+                        loss_sum = loss if loss_sum is None \
+                            else loss_sum + loss
+                        loss_n += 1
+                        if census_left:
+                            # mid-flight census: with donation the just-
+                            # donated buffers count 0, so the recorded
+                            # high-water shows the drop
+                            _perf_mem.update_high_water(
+                                "engine_step_donated" if self._donate
+                                else "engine_step")
+                            census_left -= 1
+                        bcn.step_end()
+                        if verbose and step_i % log_freq == 0:
+                            print(f"[engine] epoch {epoch} step {step_i} "
+                                  f"loss {float(loss):.4f}")  # tpulint: disable=TPU103 — the log-interval materialization IS the documented host boundary (async-loss contract)
+                finally:
+                    if isinstance(batches, DevicePrefetcher):
+                        batches.close()
+                if loss_n:
+                    # ONE host sync per epoch for the history mean
+                    self.history.append(
+                        float(loss_sum) / loss_n)  # tpulint: disable=TPU103 — end-of-epoch history materialization (documented contract), not a per-step sync
+        finally:
+            # write the trained arrays AND accumulator states back into
+            # the eager optimizer, so a later opt.step()/state_dict()
+            # continues from where the Engine left off. Runs on abort
+            # too: under donation the Parameters' pre-fit payloads are
+            # dead — the latest live arrays must land back.
+            t, _masters, states = opt_state
+            self._opt._step_count = int(t)  # tpulint: disable=TPU103 — one end-of-fit writeback into the eager optimizer (documented contract), not a per-step sync
+            for p, a, st in zip(self._params, pa, states):
+                p._data = a
+                self._opt._accumulators[id(p)] = st
         return self.history
 
     def evaluate(self, eval_data, batch_size=32, verbose=0):

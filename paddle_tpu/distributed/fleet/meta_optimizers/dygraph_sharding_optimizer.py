@@ -128,9 +128,15 @@ class DygraphShardingOptimizer:
 
     def _sharding_sync_parameters(self):
         """Keep params replicated after the sharded update (reference
-        _sharding_sync_parameters:358 broadcasts owned shards). The jitted
-        step may leave a param output sharded like its states; the
-        device_put below is the all-gather."""
+        _sharding_sync_parameters:358 broadcasts owned shards). The
+        all-gathers run DECOMPOSED at parameter-group granularity
+        (sharding/decomposed.py): layer-order byte-budget groups, each
+        one fused program, all dispatched before any result is consumed
+        — gather(k+1) overlaps the install of group k instead of the old
+        one-device_put-per-param serial front."""
+        from ...sharding.decomposed import gather_grouped
+
+        pairs = []
         for p in self._parameter_list:
             arr = p._data
             sh = getattr(arr, "sharding", None)
@@ -144,8 +150,8 @@ class DygraphShardingOptimizer:
                              if isinstance(e, tuple) else e)
                             for e in sh.spec]
                     keep = [k if k else None for k in keep]
-                    p._data = jax.device_put(
-                        arr, NamedSharding(self._mesh, P(*keep)))
+                    pairs.append((p, NamedSharding(self._mesh, P(*keep))))
+        gather_grouped(pairs, site="post_step_sync")
 
     def minimize(self, loss, *args, **kwargs):
         loss.backward()

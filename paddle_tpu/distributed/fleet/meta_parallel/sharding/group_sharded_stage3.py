@@ -27,7 +27,8 @@ class GroupShardedStage3(_MeshInputWrapper):
     def __init__(self, layer, optimizer=None, group=None,
                  sync_buffers=False, device="tpu", segment_size=2 ** 20,
                  pertrain_sync_models=True, offload=False,
-                 sync_comm=False, axis="sharding", **kwargs):
+                 sync_comm=False, axis="sharding", overlap_gathers=True,
+                 **kwargs):
         super().__init__(layer)
         mesh = mesh_mod.get_mesh()
         if axis not in mesh.axis_names:
@@ -47,6 +48,22 @@ class GroupShardedStage3(_MeshInputWrapper):
                 stacklevel=2)
         self._param_shardings = {}
         self._shard_parameters()
+        # async runtime: eager forwards gather at parameter-group
+        # granularity with one-group lookahead — gather(k+1) is in
+        # flight while layer k computes (sharding/decomposed.py).
+        # ``sync_comm=True`` (the reference's blocking-comm escape
+        # hatch) disables the overlap schedule.
+        self._gather_schedule = None
+        if overlap_gathers and not sync_comm and self._degree > 1:
+            from ....sharding.decomposed import Stage3GatherSchedule
+            self._gather_schedule = Stage3GatherSchedule(
+                self._layers, self._param_shardings,
+                NamedSharding(self._mesh, P()))
+
+    def forward(self, *inputs, **kwargs):
+        if self._gather_schedule is not None:
+            self._gather_schedule.begin_step()
+        return super().forward(*inputs, **kwargs)
 
     def _shard_parameters(self):
         for p in self._layers.parameters():
@@ -61,21 +78,32 @@ class GroupShardedStage3(_MeshInputWrapper):
 
     def get_all_parameters(self, convert2cpu=False):
         """Re-gather every param to replicated (reference :get_all_parameters
-        — used before save). Returns the parameter list. Call
-        :meth:`reshard_parameters` afterwards to restore the ZeRO-3
-        placement and keep training sharded."""
+        — used before save), decomposed at parameter-group granularity
+        so the gathers overlap instead of running as a serial front.
+        Returns the parameter list. Call :meth:`reshard_parameters`
+        afterwards to restore the ZeRO-3 placement and keep training
+        sharded."""
+        from ....sharding.decomposed import gather_grouped
+
         rep = NamedSharding(self._mesh, P())
-        for p in self._layers.parameters():
-            if p.name in self._param_shardings:
-                p._data = jax.device_put(p._data, rep)
+        gather_grouped(
+            [(p, rep) for p in self._layers.parameters()
+             if p.name in self._param_shardings],
+            site="stage3_save")
         return list(self._layers.parameters())
 
     def reshard_parameters(self):
         """Re-apply the ZeRO-3 shardings after a gather (e.g. post-save)."""
-        for p in self._layers.parameters():
-            sh = self._param_shardings.get(p.name)
-            if sh is not None:
-                p._data = jax.device_put(p._data, sh)
+        from ....sharding.decomposed import gather_grouped
+
+        if self._gather_schedule is not None:
+            self._gather_schedule._installed.clear()
+            self._gather_schedule._staged.clear()
+        gather_grouped(
+            [(p, self._param_shardings[p.name])
+             for p in self._layers.parameters()
+             if p.name in self._param_shardings],
+            site="stage3_reshard")
 
     def to(self, *args, **kwargs):
         return self

@@ -15,8 +15,11 @@ from ..fleet.meta_optimizers.dygraph_sharding_optimizer import \
 from ..fleet.meta_parallel.sharding import (GroupShardedOptimizerStage2,
                                             GroupShardedStage2,
                                             GroupShardedStage3)
+from .decomposed import (Stage3GatherSchedule, gather_grouped,
+                         plan_groups)
 
-__all__ = ["group_sharded_parallel", "save_group_sharded_model"]
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "gather_grouped", "plan_groups", "Stage3GatherSchedule"]
 
 
 def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,

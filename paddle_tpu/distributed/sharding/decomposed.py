@@ -1,0 +1,225 @@
+"""Per-group decomposed all-gathers for the ZeRO stages.
+
+The stage-1/2 optimizer's post-step parameter re-gather and the stage-3
+save-time gather used to run as one serial front: one ``device_put`` per
+parameter, each its own tiny program launch. This module decomposes the
+work to *parameter-group* granularity — params are bucketed in layer
+order under a byte budget (``FLAGS_sharding_gather_group_mb``), each
+group gathers as ONE fused program, and every group is dispatched before
+any result is consumed. jax dispatch being async, gather(group k+1)
+overlaps the installation/consumption of group k — the latency-hiding
+schedule the reference's multi-stream ``fleet_executor`` runs by hand,
+here delegated to the runtime queue. This mirrors the bucketed grad-sync
+the auto-parallel planner already prices (``planner/cost.py``).
+
+Stage-3 forward overlap rides the same groups:
+:class:`Stage3GatherSchedule` hooks each group's first parameter-owning
+sublayer so that while layer k computes, the all-gather of group k+1 is
+already in flight (one-group lookahead).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ...core import flags
+from ...observability import metrics as _metrics
+from ...observability import trace as _trace
+
+__all__ = ["plan_groups", "gather_grouped", "Stage3GatherSchedule"]
+
+_m_groups = _metrics.counter(
+    "paddle_tpu_sharding_gather_groups_total",
+    "Decomposed all-gather groups issued, by site.",
+    labelnames=("site",))
+
+#: jitted per-group gather programs, keyed by the group's aval+sharding
+#: signature (shapes/dtypes/current+target shardings)
+_gather_cache: Dict[tuple, Callable] = {}
+
+
+def _group_budget_bytes() -> int:
+    return max(1, int(flags.get_flag("sharding_gather_group_mb"))) << 20
+
+
+def plan_groups(params: Sequence, max_bytes: Optional[int] = None
+                ) -> List[List]:
+    """Bucket ``params`` (layer-traversal order) into gather groups under
+    a byte budget. Order is preserved — group i is consumed before group
+    i+1, which is what makes the lookahead overlap well-formed."""
+    if max_bytes is None:
+        max_bytes = _group_budget_bytes()
+    groups: List[List] = []
+    cur: List = []
+    size = 0
+    for p in params:
+        n = int(getattr(p._data, "nbytes", 0) or
+                np.prod(p.shape or [1]) * 4)
+        if cur and size + n > max_bytes:
+            groups.append(cur)
+            cur, size = [], 0
+        cur.append(p)
+        size += n
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+def _gather_program(arrays, shardings):
+    """One jitted identity program re-laying its inputs onto
+    ``shardings`` — the fused per-group all-gather. Cached per aval +
+    current/target-sharding signature."""
+    # NamedSharding is hashable (mesh + spec), so the key distinguishes
+    # meshes properly — a rebuilt mesh with the same axis names must not
+    # serve a program pinned to the old device assignment
+    key = tuple(
+        (tuple(a.shape), str(a.dtype), getattr(a, "sharding", None), s)
+        for a, s in zip(arrays, shardings))
+    prog = _gather_cache.get(key)
+    if prog is None:
+        prog = jax.jit(lambda *xs: xs, out_shardings=tuple(shardings))
+        if len(_gather_cache) >= 256:
+            _gather_cache.pop(next(iter(_gather_cache)))
+        _gather_cache[key] = prog
+    return prog
+
+
+def gather_grouped(pairs: Sequence[Tuple], site: str = "sharding",
+                   max_bytes: Optional[int] = None,
+                   install: bool = True) -> List:
+    """Gather ``pairs`` of (param, target_sharding) at parameter-group
+    granularity: every group's fused gather is DISPATCHED before any
+    payload is installed, so the runtime overlaps gather(k+1) with the
+    consumption of group k (vs the old one-``device_put``-per-param
+    serial front). Returns the gathered arrays in input order; with
+    ``install`` the params' payloads are swapped in place."""
+    if not pairs:
+        return []
+    by_param = {id(p): s for p, s in pairs}
+    groups = plan_groups([p for p, _ in pairs], max_bytes=max_bytes)
+    issued = []
+    for gi, group in enumerate(groups):
+        arrays = [p._data for p in group]
+        shardings = [by_param[id(p)] for p in group]
+        with _trace.span(f"sharding.gather:{site}:g{gi}", "framework",
+                         args={"params": len(group)}):
+            issued.append(_gather_program(arrays, shardings)(*arrays))
+        if _metrics.enabled():
+            _m_groups.inc(site=site)
+    out = []
+    for group, arrs in zip(groups, issued):
+        for p, a in zip(group, arrs):
+            if install:
+                p._swap_payload(a)
+            out.append(a)
+    return out
+
+
+class Stage3GatherSchedule:
+    """One-group-lookahead forward gather for ZeRO-3 eager training.
+
+    Groups are the same layer-order buckets as :func:`gather_grouped`.
+    ``begin_step()`` (called by the stage-3 wrapper's forward) re-shards
+    any previously gathered params (slice-local, no comm) and issues the
+    gathers of groups 0 and 1; the pre-hook of group i's first
+    parameter-owning sublayer issues group i+2 and installs group i's
+    (already in-flight) gathered payloads — compute(k) overlaps
+    gather(k+1). Params stay replicated through backward (autograd needs
+    them) and return to sharded at the next ``begin_step``/
+    ``reshard()``.
+    """
+
+    def __init__(self, layer, param_shardings: Dict, gathered_sharding,
+                 max_bytes: Optional[int] = None):
+        self._sharded = dict(param_shardings)   # name -> sharded layout
+        self._rep = gathered_sharding
+        sharded_params = [p for p in layer.parameters()
+                          if p.name in self._sharded]
+        self._groups = plan_groups(sharded_params, max_bytes=max_bytes)
+        self._group_of: Dict[int, int] = {
+            id(p): gi for gi, g in enumerate(self._groups) for p in g}
+        self._staged: Dict[int, list] = {}
+        self._installed: set = set()
+        self._hooks = []
+        self._install_hooks(layer)
+
+    # ------------------------------------------------------------ wiring
+    def _install_hooks(self, layer):
+        """Hook every parameter-owning sublayer with the FULL set of
+        groups its params belong to — a byte-budget split inside one
+        sublayer must still install all of its groups (a min-index-only
+        hook would leave the tail groups issued but never installed,
+        pinning their replicated copies in the staging dict)."""
+        for sub in layer.sublayers(include_self=True):
+            gis = sorted({self._group_of[id(p)]
+                          for p in sub.parameters(include_sublayers=False)
+                          if id(p) in self._group_of})
+            if gis:
+                self._hooks.append(sub.register_forward_pre_hook(
+                    self._make_hook(tuple(gis))))
+
+    def _make_hook(self, gis: tuple):
+        def hook(layer, inputs):
+            for gi in gis:
+                self._issue(gi + 2)
+            for gi in gis:
+                self._install(gi)
+            return None
+        return hook
+
+    def remove_hooks(self):
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+
+    # ---------------------------------------------------------- schedule
+    def begin_step(self):
+        """Step boundary: restore the sharded (1/N-resident) layouts of
+        the previous step's gathered params, then put groups 0 and 1 in
+        flight before the first layer runs."""
+        self.reshard()
+        self._issue(0)
+        self._issue(1)
+
+    def reshard(self):
+        """Slice-local re-shard of every installed group (frees the
+        replicated copies); also the post-save restore path."""
+        for gi in sorted(self._installed):
+            group = self._groups[gi]
+            gather_grouped(
+                [(p, self._sharded[p.name]) for p in group],
+                site="stage3_reshard")
+        self._installed.clear()
+        self._staged.clear()
+
+    def _issue(self, gi: int):
+        if gi >= len(self._groups) or gi in self._staged \
+                or gi in self._installed:
+            return
+        group = self._groups[gi]
+        arrays = [p._data for p in group]
+        shardings = [self._rep] * len(group)
+        with _trace.span(f"sharding.gather:stage3_fwd:g{gi}", "framework",
+                         args={"params": len(group)}):
+            self._staged[gi] = list(
+                _gather_program(arrays, shardings)(*arrays))
+        if _metrics.enabled():
+            _m_groups.inc(site="stage3_fwd")
+
+    def _install(self, gi: int):
+        if gi in self._installed:
+            return
+        arrs = self._staged.pop(gi, None)
+        if arrs is None:
+            # executed out of lookahead order (shared layers, dynamic
+            # control flow): gather now rather than silently running
+            # the forward on sharded params with per-op implicit gathers
+            self._issue(gi)
+            arrs = self._staged.pop(gi, None)
+            if arrs is None:
+                return
+        for p, a in zip(self._groups[gi], arrs):
+            p._swap_payload(a)
+        self._installed.add(gi)

@@ -70,6 +70,11 @@ class Model:
                         step=self._global_step) is not None:
             loss = loss * float("nan")   # deterministic divergence for tests
         loss.backward()
+        # the loss is MATERIALIZED here, before the skip-step check:
+        # under the async input pipeline (fit wraps its loader in a
+        # DevicePrefetcher) everything else in the step stays in flight,
+        # but graceful degradation needs a concrete value — a lazy/NaN
+        # loss must never reach the optimizer step undetected
         loss_val = float(loss.numpy())
         if update and self._optimizer is not None:
             if math.isfinite(loss_val):
@@ -135,6 +140,9 @@ class Model:
             start_epoch, skip_steps = self._auto_resume(resume,
                                                         cbks.callbacks,
                                                         verbose)
+        from ..core import flags as _flags
+        from ..io.prefetch import DevicePrefetcher
+        use_prefetch = bool(_flags.get_flag("prefetch"))
         self.stop_training = False
         history = []
         cbks.on_train_begin()
@@ -143,23 +151,17 @@ class Model:
             for m in self._metrics:
                 m.reset()
             losses = []
-            for step, batch in enumerate(loader):
-                if epoch == start_epoch and step < skip_steps:
-                    continue   # step-granular resume: already trained
-                cbks.on_train_batch_begin(step)
-                batch = _to_list(batch)
-                xs, ys = batch[:-1], batch[-1:]
-                out = self.train_batch(xs, ys)
-                loss = out[0][0] if isinstance(out, tuple) else out[0]
-                losses.append(loss)
-                if verbose and log_freq and step % log_freq == 0:
-                    msg = f"epoch {epoch} step {step} loss {loss:.4f}"
-                    for m, v in zip(self._metrics,
-                                    out[1] if isinstance(out, tuple)
-                                    else []):
-                        msg += f" {m.name()}={v}"
-                    print(msg)
-                cbks.on_train_batch_end(step, {"loss": loss})
+            # double-buffered device prefetch (io/prefetch.py): the next
+            # batch transfers on a background thread while train_batch
+            # runs; teardown propagates to the loader's worker processes
+            batches = (DevicePrefetcher(iter(loader)) if use_prefetch
+                       else loader)
+            try:
+                self._fit_epoch(batches, epoch, start_epoch, skip_steps,
+                                losses, cbks, verbose, log_freq)
+            finally:
+                if isinstance(batches, DevicePrefetcher):
+                    batches.close()
             if losses:
                 epoch_logs = {"loss": float(np.mean(losses))}
                 history.append(epoch_logs["loss"])
@@ -185,6 +187,28 @@ class Model:
                 break
         cbks.on_train_end({"loss": history[-1] if history else None})
         return history
+
+    def _fit_epoch(self, batches, epoch, start_epoch, skip_steps, losses,
+                   cbks, verbose, log_freq):
+        """One epoch's step loop over ``batches`` (a DevicePrefetcher or
+        the raw loader)."""
+        for step, batch in enumerate(batches):
+            if epoch == start_epoch and step < skip_steps:
+                continue   # step-granular resume: already trained
+            cbks.on_train_batch_begin(step)
+            batch = _to_list(batch)
+            xs, ys = batch[:-1], batch[-1:]
+            out = self.train_batch(xs, ys)
+            loss = out[0][0] if isinstance(out, tuple) else out[0]
+            losses.append(loss)
+            if verbose and log_freq and step % log_freq == 0:
+                msg = f"epoch {epoch} step {step} loss {loss:.4f}"
+                for m, v in zip(self._metrics,
+                                out[1] if isinstance(out, tuple)
+                                else []):
+                    msg += f" {m.name()}={v}"
+                print(msg)
+            cbks.on_train_batch_end(step, {"loss": loss})
 
     def _auto_resume(self, manager, callbacks, verbose):
         """Restore train state from ``manager`` and translate its meta

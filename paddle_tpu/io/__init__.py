@@ -10,6 +10,7 @@ JAX's async dispatch. Multiprocess workers use the same
 from .dataset import (ChainDataset, ComposeDataset, ConcatDataset, Dataset,
                       IterableDataset, Subset, TensorDataset, random_split)
 from .dataloader import DataLoader, get_worker_info
+from .prefetch import DevicePrefetcher
 from .sampler import (BatchSampler, DistributedBatchSampler, RandomSampler,
                       Sampler, SequenceSampler, SubsetRandomSampler,
                       WeightedRandomSampler)

@@ -229,6 +229,11 @@ class _MultiProcessIter:
         self._shutdown = True
         self._finalizer()
 
+    #: public shutdown hook — a wrapping DevicePrefetcher (io/prefetch.py)
+    #: propagates its own teardown here so abandoning a prefetching
+    #: iterator mid-epoch reaps the worker processes immediately
+    close = _teardown
+
     def __del__(self):
         self._teardown()
 

@@ -105,12 +105,19 @@ class StaticFunction:
 
     def __init__(self, fn: Callable, input_spec=None, build_strategy=None,
                  backend=None, full_graph=True, mesh=None, in_specs=None,
-                 param_specs=None):
+                 param_specs=None, donate=False):
         self._dygraph_fn = fn
         self._input_spec = input_spec
         functools.update_wrapper(self, fn)
         self._jitted = None
         self._params = None
+        # Buffer donation (async runtime): with donate=True the param /
+        # buffer arrays are donated to the compiled program — XLA reuses
+        # their HBM for the updated outputs (the bigger-batch headroom).
+        # jit_target then returns EVERY param so the caller can rebind
+        # the Tensors onto live buffers; the old buffers are registered
+        # with core.donation so stale reads raise the framework's error.
+        self._donate = bool(donate)
         # SPMD auto-sharding (distributed.spmd): when a mesh is given,
         # the trace runs under a propagation scope — inputs seed from
         # in_specs, params from their shard_params/_spmd_spec stamps
@@ -234,6 +241,15 @@ class StaticFunction:
         # tracers onto THAT call's params rather than the first call's.
         self._params = params
         self._build_jitted(fn)
+        donated_prev = None
+        if self._donate:
+            from ..core import donation as _donation
+            site = f"to_static({self.__name__!r}, donate=True)"
+            _donation.ensure_live((p._data for p in params),
+                                  f"{site} entry")
+            _donation.ensure_distinct(
+                ((p.name, p._data) for p in params), site)
+            donated_prev = [p._data for p in params]
         sig = (treedef, statics,
                tuple((tuple(a.shape), str(a.dtype)) for a in arrays))
         if sig in self._graph_breaks:  # tpulint: disable=TPU105 — sig holds treedef/statics/SHAPES (dispatch key), no tensor values
@@ -250,6 +266,7 @@ class StaticFunction:
             out, mutated = runner([p._data for p in params], arrays)
             for i, arr in mutated.items():
                 params[int(i)]._swap_payload(arr)  # tpulint: disable=TPU103 — i is the mutated-dict's STRING key (param index), not tensor data
+            self._mark_donated(donated_prev)
             return _wrap(out)
         if is_new_sig:  # tpulint: disable=TPU105 — same shape-only branch
             self._record_new_sig(sig)
@@ -302,7 +319,17 @@ class StaticFunction:
             return self._run_sot(sig, fn, args, kwargs)
         for i, arr in mutated.items():
             params[int(i)]._swap_payload(arr)  # tpulint: disable=TPU103 — same string-key int() as the runner path
+        self._mark_donated(donated_prev)
         return _wrap(out)
+
+    def _mark_donated(self, donated_prev):
+        """Register the buffers a donating call just invalidated so a
+        stale read raises core.donation.DonatedBufferError (the clear
+        framework error), not XLA's opaque deleted-array failure."""
+        if donated_prev is not None:
+            from ..core import donation as _donation
+            _donation.mark_donated(
+                donated_prev, f"to_static({self.__name__!r}, donate=True)")
 
     def _build_jitted(self, fn):
         if self._jitted is not None:
@@ -335,17 +362,23 @@ class StaticFunction:
                     # via set_value) out of the trace so the caller can
                     # write them back. String keys: the mutated dict
                     # crosses jax.export serialization, which only
-                    # accepts string dict keys in pytrees.
+                    # accepts string dict keys in pytrees. Under
+                    # donation EVERY param comes back — the input
+                    # buffers are invalid after the call, so the caller
+                    # must rebind all of them (unchanged params alias
+                    # their donated input buffer: free).
                     mutated = {str(i): p._data
                                for i, (p, d) in enumerate(
                                    zip(params, param_arrays))
-                               if p._data is not d}
+                               if outer._donate or p._data is not d}
                     return _unwrap(out), mutated
                 finally:
                     for p, d in originals:
                         p._data = d
 
-        self._jitted = jax.jit(jit_target, static_argnums=(2, 3))
+        self._jitted = jax.jit(
+            jit_target, static_argnums=(2, 3),
+            donate_argnums=(0,) if self._donate else ())
 
     def _auto_plan(self, args, kwargs):
         """param_specs="auto": run the auto-parallel planner
@@ -436,7 +469,11 @@ class StaticFunction:
             # empty list for plain functions would re-key (and so
             # invalidate) every previously persisted cache entry
             *([self._spmd_fingerprint(params)]
-              if self._spmd_mesh is not None else []))
+              if self._spmd_mesh is not None else []),
+            # donation re-keys the same way: a donated executable's
+            # input-output aliasing is part of the compiled artifact, so
+            # donated and undonated programs must never cross-hit
+            *([["__donate__"]] if self._donate else []))
 
     def _pcc_load(self, sig, params):
         """Look the signature up in the persistent cache; a hit returns a
@@ -451,6 +488,11 @@ class StaticFunction:
             if got is None:
                 return None
             meta, payload = got
+            # the donate fingerprint in the key already separates the
+            # programs; the meta check is belt-and-braces — an executable
+            # whose aliasing disagrees with this wrapper must not run
+            if bool(meta.get("donate", False)) != self._donate:
+                return None
             runner = pcc.aot.load_runner(meta.get("tier", ""), payload)
             if runner is None:
                 return None
@@ -537,6 +579,7 @@ class StaticFunction:
                     self._pcc_key(sig, params), payload,
                     {"site": "to_static", "tier": tier,
                      "label": getattr(self, "__name__", ""),
+                     "donate": self._donate,
                      "compile_seconds": compile_seconds})
         except Exception:
             pass
@@ -695,14 +738,18 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=False, mesh=None, in_specs=None,
-              param_specs=None):
+              param_specs=None, donate=False):
     """Program capture; with ``mesh=`` the capture auto-shards — see
     distributed.spmd (``in_specs``: PartitionSpec pytree for the Tensor
     arguments; ``param_specs``: optional ``fn(param) -> spec``,
     defaulting to each param's spmd.shard_params placement — or the
     string ``"auto"`` to let the auto-parallel planner
     (distributed.planner) search and emit the placement on the first
-    call)."""
+    call). ``donate=True`` donates the param/buffer arrays to the
+    compiled program (XLA reuses their HBM for the updated outputs, the
+    train-step memory win); the wrapper rebinds every Parameter onto the
+    returned buffers, and stale references to pre-call buffers raise
+    ``core.donation.DonatedBufferError``."""
     def decorate(fn):
         if hasattr(fn, "forward") and callable(getattr(fn, "forward")):
             # Layer instance: wrap its forward
@@ -711,11 +758,12 @@ def to_static(function=None, input_spec=None, build_strategy=None,
                                            build_strategy, backend,
                                            full_graph, mesh=mesh,
                                            in_specs=in_specs,
-                                           param_specs=param_specs)
+                                           param_specs=param_specs,
+                                           donate=donate)
             return layer
         return StaticFunction(fn, input_spec, build_strategy, backend,
                               full_graph, mesh=mesh, in_specs=in_specs,
-                              param_specs=param_specs)
+                              param_specs=param_specs, donate=donate)
     if function is not None:
         return decorate(function)
     return decorate

@@ -32,7 +32,8 @@ from .. import trace as _trace
 
 __all__ = ["capture_enabled", "record_compiled", "compiled_programs",
            "clear_compiled", "measure", "timed_section", "attribute",
-           "step_attribution", "STEP_CAT", "DEVICE_CAT"]
+           "step_attribution", "memory_breakdown", "STEP_CAT",
+           "DEVICE_CAT"]
 
 # Hot mirror (same contract as metrics.enabled()).
 _capture = {"on": bool(flags.get_flag("perf_capture"))}
@@ -48,9 +49,11 @@ def capture_enabled() -> bool:
 DEVICE_CAT = "device"
 STEP_CAT = "step"
 #: host-side span categories (everything instrumented that is not device
-#: execution or a collective)
+#: execution or a collective). "io" is the prefetch/transfer lane — when
+#: a DevicePrefetcher hides a transfer under a device span, the overlap
+#: subtraction removes it from the host share (that's the win showing).
 _HOST_CATS = ("dispatch", "compile", "user", "framework", "serving",
-              "autotune")
+              "autotune", "io")
 
 _m_perf_captures = _metrics.counter(
     "paddle_tpu_perf_captures_total",
@@ -77,24 +80,14 @@ def record_compiled(site: str, label: str, compiled) -> Optional[dict]:
         rec = {"site": site, "label": str(label), "n_captures": 1,
                "flops": 0.0, "bytes_accessed": 0.0, "transcendentals": 0.0,
                "argument_bytes": 0, "output_bytes": 0, "temp_bytes": 0,
-               "generated_code_bytes": 0, "peak_bytes": 0}
+               "alias_bytes": 0, "generated_code_bytes": 0,
+               "peak_bytes": 0}
         cost = xla_cost(compiled)
         if cost:
             rec.update(cost)
-        try:
-            mem = compiled.memory_analysis()
-        except Exception:
-            mem = None
-        if mem is not None:
-            rec["argument_bytes"] = int(
-                getattr(mem, "argument_size_in_bytes", 0))
-            rec["output_bytes"] = int(
-                getattr(mem, "output_size_in_bytes", 0))
-            rec["temp_bytes"] = int(getattr(mem, "temp_size_in_bytes", 0))
-            rec["generated_code_bytes"] = int(
-                getattr(mem, "generated_code_size_in_bytes", 0))
-            rec["peak_bytes"] = (rec["argument_bytes"]
-                                 + rec["output_bytes"] + rec["temp_bytes"])
+        mb = memory_breakdown(compiled)
+        if mb is not None:
+            rec.update(mb)
         key = (site, str(label))
         with _prog_lock:
             prev = _programs.get(key)
@@ -107,6 +100,32 @@ def record_compiled(site: str, label: str, compiled) -> Optional[dict]:
         return rec
     except Exception:
         return None
+
+
+def memory_breakdown(compiled) -> Optional[dict]:
+    """Alias-aware memory accounting of one compiled program — the ONE
+    place the peak formula lives (``record_compiled`` and the bench
+    batch sweep both read it). Donated inputs alias outputs, so XLA
+    reuses the argument HBM: ``peak = arg + out + temp − alias``.
+    None when the backend exposes no analysis."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    out = {
+        "argument_bytes": int(getattr(mem, "argument_size_in_bytes", 0)),
+        "output_bytes": int(getattr(mem, "output_size_in_bytes", 0)),
+        "temp_bytes": int(getattr(mem, "temp_size_in_bytes", 0)),
+        "alias_bytes": int(getattr(mem, "alias_size_in_bytes", 0) or 0),
+        "generated_code_bytes": int(
+            getattr(mem, "generated_code_size_in_bytes", 0)),
+    }
+    out["peak_bytes"] = max(
+        0, out["argument_bytes"] + out["output_bytes"]
+        + out["temp_bytes"] - out["alias_bytes"])
+    return out
 
 
 def compiled_programs(site: Optional[str] = None) -> List[dict]:

@@ -91,6 +91,15 @@ def _array_of(x):
 
 
 def _nbytes(a) -> int:
+    # a donated buffer keeps its aval (shape/dtype metadata) but holds
+    # no HBM — counting it would hide exactly the high-water drop the
+    # donated train step exists to produce
+    deleted = getattr(a, "is_deleted", None)
+    try:
+        if deleted is not None and deleted():
+            return 0
+    except Exception:
+        pass
     return int(getattr(a, "nbytes", 0) or 0)
 
 

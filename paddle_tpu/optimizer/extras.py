@@ -156,7 +156,7 @@ class LBFGS(Optimizer):
     def _wolfe(self, closure, x0, d, f0, g0, lr):
         """Backtracking + curvature (strong Wolfe) line search."""
         c1, c2 = 1e-4, 0.9
-        dg0 = float(jnp.vdot(g0, d))
+        dg0 = float(jnp.vdot(g0, d))  # tpulint: disable=TPU103 — Wolfe line search is host-driven BY CONTRACT (torch-style closure API re-runs arbitrary Python per probe); the directional derivative steers the host loop
         t = lr
         for _ in range(20):
             self._set_flat(x0 + t * d)
@@ -164,7 +164,7 @@ class LBFGS(Optimizer):
             if f > f0 + c1 * t * dg0:
                 t *= 0.5
                 continue
-            if abs(float(jnp.vdot(g, d))) > c2 * abs(dg0):
+            if abs(float(jnp.vdot(g, d))) > c2 * abs(dg0):  # tpulint: disable=TPU103 — curvature condition decides the next HOST probe (shorten/lengthen t); inherently sequential, cannot trace
                 t *= 1.5  # curvature not yet satisfied: lengthen
                 continue
             return t, f, g
@@ -182,7 +182,7 @@ class LBFGS(Optimizer):
         x = self._flat([p._data for p in self._params()])
         evals = 1
         for _ in range(self._max_iter):
-            if float(jnp.abs(g).max()) <= self._tol_grad:
+            if float(jnp.abs(g).max()) <= self._tol_grad:  # tpulint: disable=TPU103 — convergence break of the outer HOST iteration (each iter re-evaluates the Python closure); a data-dependent loop bound is host-by-design here
                 break
             d = self._direction(g)
             if self._line_search == "strong_wolfe":
@@ -196,14 +196,14 @@ class LBFGS(Optimizer):
             x_new = x + t * d
             s = x_new - x
             ygrad = g_new - g
-            if float(jnp.vdot(s, ygrad)) > 1e-10:
+            if float(jnp.vdot(s, ygrad)) > 1e-10:  # tpulint: disable=TPU103 — curvature-pair admission gates PYTHON list state (the (s,y) history the two-loop recursion closes over); host decision by design
                 self._s.append(s)
                 self._y.append(ygrad)
                 if len(self._s) > self._history:
                     self._s.pop(0)
                     self._y.pop(0)
-            if float(jnp.abs(s).max()) <= self._tol_change \
-                    or abs(f_new - f) <= self._tol_change:
+            small_step = float(jnp.abs(s).max()) <= self._tol_change  # tpulint: disable=TPU103 — step-size/loss-change convergence break of the host iteration (same contract as the gradient-norm break above)
+            if small_step or abs(f_new - f) <= self._tol_change:
                 x, f, g = x_new, f_new, g_new
                 break
             x, f, g = x_new, f_new, g_new

@@ -77,7 +77,11 @@ def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
 #: chrome-trace tid blocks per span category, so each instrumented layer
 #: renders as its own named row in the viewer
 _CAT_TID_BASE = {"user": 0, "dispatch": 100, "compile": 200,
-                 "collective": 300, "autotune": 400}
+                 "collective": 300, "autotune": 400,
+                 # 500 is the unknown-category fallback lane; io/device
+                 # get full 100-slot lanes so a process with many traced
+                 # threads cannot bleed io spans into the device lane
+                 "io": 600, "device": 700}
 
 
 def _trace_rank() -> Optional[int]:

@@ -564,8 +564,9 @@ class TestPerfGate:
         """tools/perf_baseline.json (checked in) parses and gates the
         run it was frozen from. Rungs added to the baseline AFTER the
         r05 freeze (fleet_observability round 14, fusion round 15,
-        planner_vs_manual round 16) are absent from the archived run —
-        they may be missing, but nothing may fail."""
+        planner_vs_manual round 16, async_overlap + async_batch_sweep
+        round 17) are absent from the archived run — they may be
+        missing, but nothing may fail."""
         with open(os.path.join(REPO, "tools", "perf_baseline.json")) as f:
             base = json.load(f)
         assert base["format"] == "paddle_tpu.perf_baseline/1"
@@ -582,11 +583,18 @@ class TestPerfGate:
             cand = perf_gate.parse_bench_output(f.read())
         res = perf_gate.gate(cand, base, allow_missing=True)
         assert res["pass"]
+        # the async bars: overlap >= the frozen no-regression floor,
+        # batch sweep within the ladder tolerance of parity
+        ao = base["rungs"]["async_overlap_step_ratio"]
+        assert ao["value"] * ao["min_ratio"] >= 0.85
+        assert "async_batch_sweep_tokens_ratio" in base["rungs"]
         missing = {c["metric"] for c in res["checks"]
                    if c["status"] == "missing"}
         assert missing <= {"fleet_observability_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
-                           "planner_vs_manual_step_ratio"}
+                           "planner_vs_manual_step_ratio",
+                           "async_overlap_step_ratio",
+                           "async_batch_sweep_tokens_ratio"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"
