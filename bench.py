@@ -689,6 +689,171 @@ def _bench_serving_resilience(small):
     }
 
 
+def _bench_serving_router(small):
+    """Multi-replica serving-tier rung (BENCH_MODEL=serving_router;
+    paddle_tpu/serving/).
+
+    Three questions, one rung:
+
+    1. **Goodput scaling vs R** — the open-loop Poisson stream through
+       the Router at saturating arrivals for R=1 and R=2 replicas (the
+       replicas share one model, so compiled tick programs are shared).
+       vs_baseline is goodput(R=2)/goodput(R=1): ~linear (≈2) on real
+       chips where each replica owns a device; ≈1 on the CPU smoke host
+       where all replicas share one core's compute — the frozen CPU
+       value is a no-regression floor, the TPU ladder refreezes per
+       PERF.md §7.
+    2. **2x-overload SLO curve at R=2** — deadlines sized from the
+       capacity probe, 0.5x/1x/2x offered load; overload must shed AT
+       THE ROUTER (``shed_at_router``), never inside a replica
+       (replicas run without a high-water mark), with p99 TTFT held.
+    3. **int8-KV / speculative parity + efficiency** — greedy tokens
+       from a ``kv_dtype="int8"`` engine and a ``speculate="ngram"``
+       engine must equal the baseline engine's exactly; records the
+       KV-bytes-per-token shrink (resident-batch multiplier) and the
+       draft acceptance rate.
+    """
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import PagedEngine, ResilienceConfig
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import Router, SchedulerConfig
+    from tools.loadgen import run_load
+
+    paddle.seed(7)
+    if small:
+        cfg = LlamaConfig(vocab_size=97, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          max_seq_len=256, use_flash_attention=False)
+        n_req, new_tokens, max_batch = 16, 6, 4
+        prompt_range = (4, 16)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_layers=16,
+                          num_heads=16, max_seq_len=1024,
+                          use_flash_attention=False)
+        n_req = _env_int("BENCH_REQUESTS", 48)
+        new_tokens = _env_int("BENCH_NEW_TOKENS", 64)
+        max_batch = _env_int("BENCH_BATCH", 8)
+        prompt_range = (32, 160)
+    model = LlamaForCausalLM(cfg)
+    if not small:
+        for p in model.parameters():  # bf16 weights: serving discipline
+            if np.dtype(p._data.dtype) == np.float32:
+                p._swap_payload(p._data.astype(jnp.bfloat16))
+    blocks_needed = (prompt_range[1] + new_tokens + 31) // 32
+
+    def mk_replica(max_queue):
+        # phase-split on (one chunk batch worth of prefill per tick) and
+        # NO replica-side high-water mark: the router owns shedding
+        return PagedEngine(
+            model, max_batch=max_batch, block_size=32,
+            num_blocks=max(64, blocks_needed * max_batch * 2),
+            max_blocks_per_seq=max(blocks_needed + 1, 8),
+            scheduler=SchedulerConfig(prefill_token_budget=32 * max_batch),
+            resilience=ResilienceConfig(max_queue=max_queue,
+                                        queue_high_water=None))
+
+    common = dict(n_requests=n_req, vocab_size=cfg.vocab_size,
+                  prompt_len_range=prompt_range,
+                  max_new_tokens=new_tokens, seed=13)
+    # --- goodput scaling vs R (saturating arrivals, no deadlines) ---
+    goodput_vs_r = {}
+    for r in (1, 2):
+        # deep queues for the capacity probe: it measures drain rate.
+        # 2x the request count here — the scaling ratio is the frozen
+        # headline and short probes are noisy on the CPU smoke host
+        tier = Router([mk_replica(8 * n_req) for _ in range(r)]).warmup()
+        pt = run_load(tier, offered_rps=10_000.0,
+                      **dict(common, n_requests=2 * n_req))
+        tier.drain()
+        goodput_vs_r[r] = pt
+    g1 = goodput_vs_r[1]["goodput_tokens_per_sec"]
+    g2 = goodput_vs_r[2]["goodput_tokens_per_sec"]
+    scaling = (g2 / g1) if g1 > 0 else 0.0
+    cap_rps = max(goodput_vs_r[2]["goodput_requests_per_sec"], 1e-3)
+    ttft_dl = max((goodput_vs_r[2]["p99_ttft_s"] or 0.01) * 8, 1e-3)
+    total_dl = ttft_dl + 4 * new_tokens * (
+        goodput_vs_r[2]["p99_itl_s"] or 0.01)
+
+    # --- 2x-overload SLO curve at R=2, shedding at the router ---
+    curve = []
+    replica_side_shed = 0
+    # the final point is an instantaneous burst of 4x the request count:
+    # arrivals the tier can NEVER absorb must shed at the router (bounded
+    # replica queues bounce them back), not pile into replica queues
+    points = [(0.5, n_req), (1.0, n_req), (2.0, n_req),
+              ("burst", 4 * n_req)]
+    for mult, n in points:
+        # bounded queues for the SLO curve: past-capacity arrivals must
+        # bounce off replica admission and shed at the router
+        tier = Router([mk_replica(max(max_batch, 4))
+                       for _ in range(2)]).warmup()
+        rate = 10_000.0 if mult == "burst" else mult * cap_rps
+        pt = run_load(tier, offered_rps=rate,
+                      ttft_deadline_s=ttft_dl, deadline_s=total_dl,
+                      **dict(common, n_requests=n))
+        tier.drain()
+        pt["load_multiplier"] = mult
+        pt["shed_at_router"] = pt["router"]["shed_at_router"]
+        # replica-internal sheds must stay 0 — overload policy lives at
+        # the router (replicas have no high-water mark; their bounded
+        # queues surface as router retries, not drops)
+        replica_side_shed += pt["shed"] - pt["shed_at_router"]
+        curve.append(pt)
+    at_1x = curve[1]["goodput_tokens_per_sec"]
+    at_2x = curve[2]["goodput_tokens_per_sec"]
+
+    # --- int8-KV + speculative parity against the baseline engine ---
+    rng = np.random.RandomState(5)
+    prompts = [[int(t) for t in rng.randint(1, cfg.vocab_size, size=n)]
+               for n in rng.randint(prompt_range[0], prompt_range[1],
+                                    size=4)]
+
+    def greedy_tokens(**kw):
+        eng = PagedEngine(model, max_batch=max_batch, block_size=32,
+                          num_blocks=max(64, blocks_needed * max_batch * 2),
+                          max_blocks_per_seq=max(blocks_needed + 1, 8),
+                          **kw)
+        rids = [eng.add_request(p, max_new_tokens=new_tokens)
+                for p in prompts]
+        out = eng.run_to_completion()
+        return [out[rid] for rid in rids], eng
+
+    base_toks, base_eng = greedy_tokens()
+    int8_toks, int8_eng = greedy_tokens(kv_dtype="int8")
+    spec_toks, spec_eng = greedy_tokens(speculate="ngram", speculate_k=4)
+
+    return {
+        "metric": "serving_router_goodput_scaling",
+        "value": round(scaling, 4),
+        "unit": "x_R1",
+        # overload retention through the ROUTER's shedding (same shape
+        # as the serving_resilience rung, now tier-level)
+        "vs_baseline": round(at_2x / at_1x, 4) if at_1x > 0 else 0.0,
+        "extra": {
+            "goodput_tokens_per_sec_R1": round(g1, 2),
+            "goodput_tokens_per_sec_R2": round(g2, 2),
+            "capacity_requests_per_sec_R2": round(cap_rps, 3),
+            "ttft_deadline_s": round(ttft_dl, 5),
+            "total_deadline_s": round(total_dl, 5),
+            "goodput_vs_offered_load_R2": curve,
+            "shed_at_router_total": sum(
+                pt["shed_at_router"] for pt in curve),
+            "replica_side_shed_total": replica_side_shed,
+            "int8_kv_parity": int8_toks == base_toks,
+            "int8_kv_bytes_per_token": int8_eng.kv_bytes_per_token,
+            "base_kv_bytes_per_token": base_eng.kv_bytes_per_token,
+            "resident_batch_multiplier": round(
+                base_eng.kv_bytes_per_token
+                / int8_eng.kv_bytes_per_token, 3),
+            "speculative_parity": spec_toks == base_toks,
+            "spec_acceptance_rate": round(
+                spec_eng.spec_accepted / spec_eng.spec_proposed, 4)
+            if spec_eng.spec_proposed else None,
+        },
+    }
+
+
 def _bench_spmd_auto(small):
     """SPMD auto-sharding rung (BENCH_MODEL=spmd_auto;
     paddle_tpu/distributed/spmd/). The SAME weights run one GPT
@@ -1859,6 +2024,7 @@ def main():
                "dispatch": _bench_dispatch, "pipeline": _bench_pipeline,
                "serving": _bench_serving,
                "serving_resilience": _bench_serving_resilience,
+               "serving_router": _bench_serving_router,
                "compile_cache": _bench_compile_cache,
                "spmd_auto": _bench_spmd_auto,
                "planner_vs_manual": _bench_planner_vs_manual,
@@ -2005,6 +2171,18 @@ def main():
     print(json.dumps(sr))
     sys.stdout.flush()
 
+    # serving-router rung: tier-level goodput scaling vs R with the 2x
+    # overload curve + int8/speculative parity riders (own metric class —
+    # not in the train geomean)
+    try:
+        srr = benches["serving_router"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        srr = {"metric": "serving_router_goodput_scaling",
+               "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+               "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(srr))
+    sys.stdout.flush()
+
     errors = [name for name, r in rungs.items() if r["unit"] == "error"]
     ratios = [r["vs_baseline"] for name, r in rungs.items()
               if r["unit"] != "error"]
@@ -2035,6 +2213,21 @@ def main():
                       "overload_retention": sr["vs_baseline"],
                       "curve": sr.get("extra", {}).get(
                           "goodput_vs_offered_load")},
+                  "serving_router": {
+                      "value": srr["value"], "unit": srr["unit"],
+                      "overload_retention": srr["vs_baseline"],
+                      "shed_at_router": srr.get("extra", {}).get(
+                          "shed_at_router_total"),
+                      "replica_side_shed": srr.get("extra", {}).get(
+                          "replica_side_shed_total"),
+                      "int8_kv_parity": srr.get("extra", {}).get(
+                          "int8_kv_parity"),
+                      "speculative_parity": srr.get("extra", {}).get(
+                          "speculative_parity"),
+                      "spec_acceptance_rate": srr.get("extra", {}).get(
+                          "spec_acceptance_rate"),
+                      "resident_batch_multiplier": srr.get(
+                          "extra", {}).get("resident_batch_multiplier")},
                   "spmd_auto": {
                       "value": sa["value"], "unit": sa["unit"],
                       "loss_parity": sa.get("extra", {}).get(

@@ -118,7 +118,7 @@ class StringTensor:
         return self._data
 
     def tolist(self):
-        return self._data.tolist()
+        return self._data.tolist()  # tpulint: disable=TPU102 — strings are host data; tolist() is the container's contract
 
     # ------------------------------------------------------ tensor-like
     def reshape(self, shape: Sequence[int]) -> "StringTensor":
@@ -153,7 +153,7 @@ class StringTensor:
     def __eq__(self, other) -> bool:
         if isinstance(other, StringTensor):
             return (self._data.shape == other._data.shape
-                    and bool((self._data == other._data).all()))
+                    and bool((self._data == other._data).all()))  # tpulint: disable=TPU103 — host-side object-array compare; no device value involved
         return NotImplemented
 
     # value-equality above is a whole-tensor convenience; hashing stays
@@ -162,7 +162,7 @@ class StringTensor:
 
     def __repr__(self) -> str:
         return (f"StringTensor(shape={self.shape}, "
-                f"data={self._data.tolist()!r})")
+                f"data={self._data.tolist()!r})")  # tpulint: disable=TPU102 — repr of a host-side string container
 
     # ---------------------------------------------------------- kernels
     def lower(self, use_utf8_encoding: bool = False) -> "StringTensor":
@@ -176,7 +176,7 @@ class StringTensor:
         if tuple(src._data.shape) != tuple(self._data.shape):
             self._data = src._data.copy()
         else:
-            np.copyto(self._data, src._data)
+            np.copyto(self._data, src._data)  # tpulint: disable=TPU104 — in-place host copy; strings never live on device
         return self
 
 
@@ -256,7 +256,7 @@ def _case_kernel(x: StringTensor, fn) -> StringTensor:
     if x._data.size:
         vec = np.frompyfunc(fn, 1, 1)
         # frompyfunc collapses 0-d input to a bare str — re-box it
-        out._data = np.asarray(vec(x._data), dtype=object).reshape(
+        out._data = np.asarray(vec(x._data), dtype=object).reshape(  # tpulint: disable=TPU104 — string kernels run on host by design
             x._data.shape)
     else:
         out._data = x._data.copy()

@@ -275,6 +275,11 @@ M_ITL = _metrics.histogram(
 M_KV_BLOCKS = _metrics.gauge(
     "paddle_tpu_serving_kv_blocks_in_use",
     "Physical KV-cache blocks currently allocated to requests.")
+M_KV_BYTES_PER_TOKEN = _metrics.gauge(
+    "paddle_tpu_serving_kv_bytes_per_token",
+    "Resident KV bytes one cached token costs across all layers "
+    "(int8 page pools roughly halve this vs bf16 — the resident-batch "
+    "multiplier).")
 M_REQUESTS = _metrics.counter(
     "paddle_tpu_serving_requests",
     "Requests reaching a terminal status, by outcome.",

@@ -10,18 +10,34 @@ free list, and admission/eviction is plain Python between ticks:
 
 * prefill runs per request in block_size chunks (two compiled shapes:
   a full chunk and each remainder), appending K/V pages via
-  ``nn.functional.block_multihead_attention``;
+  ``nn.functional.block_multihead_attention``; under a phase-split
+  scheduler (``paddle_tpu.serving.Scheduler``) the chunks are budgeted
+  per tick and interleaved with decode, so a long prompt stops stalling
+  every in-flight stream's inter-token latency;
 * decode runs ALL active slots in one (B, 1) step; idle slots point at a
   reserved trash block so the compiled program never branches on
-  occupancy;
+  occupancy. With ``speculate=`` the decode step becomes a speculative
+  verify: draft tokens appended to the feed, one (B, k+1) forward, and
+  the accept-prefix rule in-graph — still ONE compiled program, now
+  yielding up to k+1 tokens per request per tick;
 * positions are per-slot (each sequence is at a different length — the
   batch shares one program, not one position): RoPE offsets for Llama,
   learned-position gathers for GPT (architecture adapters `_LlamaArch` /
-  `_GPTArch`).
+  `_GPTArch`);
+* K/V pages are stored in the model's compute dtype, or as an int8 page
+  pool with sidecar per-(position, head) scales (``kv_dtype="int8"`` —
+  the ``nn/quant`` weight-only pattern applied to KV), halving resident
+  KV vs bf16 and roughly doubling the resident batch a chip can hold.
 
-Greedy sampling v1; numerics are locked to the training models by
-token-parity tests against ``LlamaForCausalLM.generate`` and a
-full-recompute GPT greedy loop.
+Sampling is per-request deterministic: every sampled token draws from a
+key folded from (engine seed, request id, token position), so a request
+preempted and re-prefilled resumes the SAME sampled continuation — a
+replica restart or recompute preemption is invisible in the tokens.
+
+Greedy numerics are locked to the training models by token-parity tests
+against ``LlamaForCausalLM.generate`` and a full-recompute GPT greedy
+loop; the int8-KV and speculative paths are parity-gated greedy-token-
+identical against the baseline engine.
 
 Resilience contract (see ``inference/resilience.py`` and README "Serving
 resilience"): the tick loop never raises — overload, deadline expiry,
@@ -31,11 +47,13 @@ memory races and injected faults become per-request terminal statuses
 the bounded queue; the replica walks an explicit lifecycle
 (``STARTING→WARMING→READY→DEGRADED→DRAINING→STOPPED``) with ``drain()``
 and health/readiness probes, and a stalled tick flips it DEGRADED via the
-attached watchdog.
+attached watchdog. ``engine.stream(rid)`` exposes per-request incremental
+tokens under the same nothing-raises contract (the stream ends with the
+terminal status). The multi-replica front door over R engines is
+``paddle_tpu.serving.Router``.
 """
 from __future__ import annotations
 
-import math
 import time
 import weakref
 from dataclasses import dataclass, field
@@ -47,7 +65,8 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from .resilience import (Overloaded, ReplicaLifecycle, ReplicaState,
-                         RequestOutcome, RequestStatus, ResilienceConfig)
+                         RequestOutcome, RequestStatus, ResilienceConfig,
+                         TERMINAL_STATUSES)
 from . import resilience as _res
 
 __all__ = ["BlockManager", "Request", "PagedEngine", "LlamaPagedEngine",
@@ -110,7 +129,7 @@ class _LlamaArch:
         self.cfg = model.cfg
         self.num_kv_heads = model.cfg.num_kv_heads or model.cfg.num_heads
 
-    def forward_chunk(self, tokens, start, attend):
+    def forward_chunk(self, tokens, start, attend, logits_t: int = 1):
         from paddle_tpu import ops
         from ..models.llama import rotary_embedding
 
@@ -133,7 +152,7 @@ class _LlamaArch:
                 ops.reshape(out, [B, T, nh * hd]))
             x = x + blk.mlp(blk.post_attention_layernorm(x))
         x = model.model.norm(x)
-        last = Tensor(x._data[:, -1:, :])
+        last = Tensor(x._data[:, -logits_t:, :])
         if model.lm_head is None:
             return ops.matmul(last, model.model.embed_tokens.weight,
                               transpose_y=True)
@@ -150,7 +169,7 @@ class _GPTArch:
         self.num_kv_heads = model.cfg.num_heads
         self.max_positions = model.cfg.max_seq_len
 
-    def forward_chunk(self, tokens, start, attend):
+    def forward_chunk(self, tokens, start, attend, logits_t: int = 1):
         from paddle_tpu import ops
 
         m = self.model.gpt
@@ -174,7 +193,7 @@ class _GPTArch:
             x = x + blk.attn.out_proj(ops.reshape(out, [B, T, nh * hd]))
             x = x + blk.mlp(blk.ln2(x))
         x = m.ln_f(x)
-        last = Tensor(x._data[:, -1:, :])
+        last = Tensor(x._data[:, -logits_t:, :])
         return ops.matmul(last, m.wte.weight, transpose_y=True)
 
 
@@ -261,53 +280,133 @@ def _tuned_decode_block_size(cfg, nkv, max_batch, max_blocks_per_seq,
                            warmup=2, iters=5))
 
 
-#: model -> {arch name: jitted tick fn} — shared across engines of one
-#: model (entries die with the model; see PagedEngine.__init__)
+#: model -> {(arch name, program kind): jitted tick fn} — shared across
+#: engines of one model (entries die with the model; see
+#: PagedEngine.__init__)
 _PAGED_JIT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
 
 
-def _sample_tokens(logits, temps, top_ps, key):
+def _request_keys(base_key, rids, ngens):
+    """Per-slot sampling keys folded from (engine seed, request id, token
+    position): a request's key stream depends only on its own identity
+    and how many tokens it has sampled, NEVER on which tick/slot/batch
+    it happens to run in — preemption, re-admission and replica restarts
+    reproduce the same sampled continuation under a fixed seed."""
+    return jax.vmap(lambda r, n: jax.random.fold_in(
+        jax.random.fold_in(base_key, r), n))(rids, ngens)
+
+
+def _sample_tokens(logits, temps, top_ps, base_key, rids, ngens,
+                   sampling: bool):
     """Per-slot greedy / temperature / nucleus sampling — the same
-    kernel as ops.top_p_sampling (shared helper), keyed per tick so
-    the program is reusable across calls."""
-    from ..ops.search import nucleus_sample_ids
+    kernel as ops.top_p_sampling (shared helper), keyed per (request,
+    position) so the program is reusable across calls AND deterministic
+    per request (see _request_keys). ``sampling`` is STATIC: the
+    all-greedy tick (the common serving batch) compiles without the
+    sort/cumsum/gumbel kernel at all — a smaller, faster program; the
+    sampled variant traces only once a sampled request enters the
+    batch."""
     greedy = jnp.argmax(logits, axis=-1)
+    if not sampling:
+        return greedy
+    from ..ops.search import nucleus_sample_ids
     safe_t = jnp.maximum(temps, 1e-6)[:, None]
     probs = jax.nn.softmax(logits / safe_t, axis=-1)
-    sampled = nucleus_sample_ids(probs, top_ps, key)[:, 0]
+    keys = _request_keys(base_key, rids, ngens)
+    sampled = jax.vmap(
+        lambda pr, pp, kk: nucleus_sample_ids(
+            pr[None], pp[None, 0], kk)[0, 0])(
+        probs, top_ps[:, None], keys)
     return jnp.where(temps > 0, sampled, greedy)
 
 
+def _bind_params(params, param_arrays):
+    """Swap traced arrays into the model's Parameter objects; returns
+    the originals for the caller's finally-restore."""
+    originals = [p._data for p in params]
+    for p, a in zip(params, param_arrays):
+        p._data = a
+    return originals
+
+
+def _make_attend(kcs, vcs, tb_t, sl_t):
+    """Paged-attention closure over one chunk's cache state. Cache
+    entries are arrays (float pages) or (payload, scales) tuples (int8
+    pages) — the structure picks the kernel path at trace time."""
+    import paddle_tpu.nn.functional as F
+
+    def attend(li, q, k, v):
+        if isinstance(kcs[li], tuple):
+            (kp, ksc), (vp, vsc) = kcs[li], vcs[li]
+            out, nkp, nvp, nks, nvs = F.block_multihead_attention(
+                q, Tensor(kp), Tensor(vp), tb_t, sl_t,
+                new_k=k, new_v=v, causal=True,
+                k_scale=Tensor(ksc), v_scale=Tensor(vsc))
+            kcs[li] = (nkp._data, nks._data)
+            vcs[li] = (nvp._data, nvs._data)
+        else:
+            out, nkc, nvc = F.block_multihead_attention(
+                q, Tensor(kcs[li]), Tensor(vcs[li]), tb_t, sl_t,
+                new_k=k, new_v=v, causal=True)
+            kcs[li] = nkc._data
+            vcs[li] = nvc._data
+        return out
+
+    return attend
+
+
 def _paged_forward(arch, params, param_arrays, kcs, vcs, tokens, seq_lens,
-                   tables, temps, top_ps, key):
+                   tables, temps, top_ps, rids, ngens, base_key,
+                   sampling: bool = False):
     """One chunk for a (B, T) token batch; returns (next-token ids, new
     caches). Traced under jit. A module-level function (arch + params
     pre-bound via functools.partial) so the shared jit cache holds only
     the model's small adapter/parameter objects — NEVER an engine
     instance, whose paged K/V arrays are the largest allocation in the
     process."""
-    import paddle_tpu.nn.functional as F
-
-    originals = [p._data for p in params]
-    for p, a in zip(params, param_arrays):
-        p._data = a
+    originals = _bind_params(params, param_arrays)
     try:
         B, T = tokens.shape
         start = seq_lens - T
-        sl_t = Tensor(seq_lens)
-        tb_t = Tensor(tables)
-
-        def attend(li, q, k, v):
-            out, nkc, nvc = F.block_multihead_attention(
-                q, Tensor(kcs[li]), Tensor(vcs[li]), tb_t, sl_t,
-                new_k=k, new_v=v, causal=True)
-            kcs[li] = nkc._data
-            vcs[li] = nvc._data
-            return out
-
+        attend = _make_attend(kcs, vcs, Tensor(tables), Tensor(seq_lens))
         logits = arch.forward_chunk(tokens, start, attend)
-        nxt = _sample_tokens(logits._data[:, -1, :], temps, top_ps, key)
+        nxt = _sample_tokens(logits._data[:, -1, :], temps, top_ps,
+                             base_key, rids, ngens, sampling)
         return nxt.astype(jnp.int32), kcs, vcs
+    finally:
+        for p, o in zip(params, originals):
+            p._data = o
+
+
+def _paged_verify(arch, params, param_arrays, kcs, vcs, tokens, seq_lens,
+                  tables, temps, top_ps, rids, ngens, base_key,
+                  max_accept, sampling: bool = False):
+    """Speculative verify: one (B, k+1) forward over [last_token, k
+    draft tokens] per slot, greedy accept-prefix in-graph — draft
+    append, target forward, and acceptance are ONE compiled program with
+    a stable shape (``ops.pallas.serving.spec_accept_prefix``). Returns
+    (emit (B, k+1) candidate tokens, n_emit (B,) how many of them are
+    real, new caches). Sampling slots ride the same program with
+    ``max_accept=0``: their position-0 logits sample exactly as a normal
+    decode step would (same per-request key), drafts ignored."""
+    from ..ops.pallas.serving import spec_accept_prefix
+
+    originals = _bind_params(params, param_arrays)
+    try:
+        B, T = tokens.shape
+        start = seq_lens - T
+        attend = _make_attend(kcs, vcs, Tensor(tables), Tensor(seq_lens))
+        logits = arch.forward_chunk(tokens, start, attend, logits_t=T)
+        lg = logits._data                      # (B, T, V)
+        greedy = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+        first = _sample_tokens(lg[:, 0, :], temps, top_ps,
+                               base_key, rids, ngens, sampling)
+        emit = jnp.concatenate(
+            [jnp.where(temps > 0, first, greedy[:, 0])[:, None],
+             greedy[:, 1:]], axis=1)
+        n_emit, _accepted = spec_accept_prefix(
+            tokens[:, 1:], greedy, max_accept)
+        return emit.astype(jnp.int32), n_emit.astype(jnp.int32), kcs, vcs
     finally:
         for p, o in zip(params, originals):
             p._data = o
@@ -320,8 +419,11 @@ class PagedEngine:
                  block_size: Optional[int] = 16,
                  num_blocks: int = 256, max_blocks_per_seq: int = 32,
                  eos_id: Optional[int] = None, seed: int = 0,
-                 kv_dtype=None,
+                 kv_dtype=None, scheduler=None, speculate=None,
+                 speculate_k: int = 4,
                  resilience: Optional[ResilienceConfig] = None):
+        from ..serving.scheduler import Scheduler, SchedulerConfig
+
         self.model = model
         self.arch = _pick_arch(model)
         self.cfg = model.cfg
@@ -340,23 +442,48 @@ class PagedEngine:
         nkv = self.arch.num_kv_heads
         self.num_kv_heads = nkv
 
+        # ---- phase-split scheduler (paddle_tpu.serving.Scheduler) ----
+        if scheduler is None:
+            scheduler = Scheduler()
+        elif isinstance(scheduler, SchedulerConfig):
+            scheduler = Scheduler(scheduler)
+        self.scheduler = scheduler
+        #: slot -> in-progress chunked-prefill state (padded prefix,
+        #: chunk cursor); a slot decodes only once it leaves this map
+        self._prefilling: Dict[int, dict] = {}
+
+        # ---- speculative decoding (paddle_tpu.serving.NgramProposer) --
+        if speculate == "ngram":
+            from ..serving.speculative import NgramProposer
+            speculate = NgramProposer(k=speculate_k)
+        self._spec = speculate
+        self._spec_k = getattr(speculate, "k", speculate_k)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+
         self.bm = BlockManager(num_blocks)
         self._total_usable = num_blocks - 1
         # K/V pages live in the model's compute dtype (the attention math
         # upcasts to f32 inside the kernel) — a bf16 model must not pay
         # 2x KV HBM for fp32 pages; on a 16 GB chip KV capacity IS the
-        # serving ceiling.
-        if kv_dtype is None:
+        # serving ceiling. kv_dtype="int8" swaps in the quantized page
+        # pool (payload int8 + per-(position, head) fp32 scales), halving
+        # resident KV again vs bf16.
+        self._kv_int8 = (kv_dtype == "int8"
+                         or (kv_dtype is not None
+                             and jnp.dtype(kv_dtype) == jnp.int8))
+        if self._kv_int8:
+            kv_dtype = jnp.int8
+        elif kv_dtype is None:
             kv_dtype = next(
                 (p._data.dtype for p in model.parameters()
                  if jnp.issubdtype(p._data.dtype, jnp.floating)),
                 jnp.float32)
         self.kv_dtype = jnp.dtype(kv_dtype)
         self._kv_shape = (num_blocks, block_size, nkv, self.head_dim)
-        self.kc = [jnp.zeros(self._kv_shape, self.kv_dtype)
-                   for _ in range(cfg.num_layers)]
-        self.vc = [jnp.zeros(self._kv_shape, self.kv_dtype)
-                   for _ in range(cfg.num_layers)]
+        self._kv_scale_shape = (num_blocks, block_size, nkv)
+        self.kc = [self._fresh_cache() for _ in range(cfg.num_layers)]
+        self.vc = [self._fresh_cache() for _ in range(cfg.num_layers)]
 
         self.tables = np.zeros((max_batch, max_blocks_per_seq), np.int32)
         self.seq_lens = np.ones((max_batch,), np.int32)  # idle: len 1
@@ -366,26 +493,33 @@ class PagedEngine:
         self.queue: List[Request] = []
         self.rejected: Dict[int, str] = {}
         self._params = [p for p in model.parameters()]
-        # one jit wrapper: jax.jit itself specializes per (B, T) shape.
-        # Engines over the SAME model share it — _paged_forward reads
-        # only the model's Parameter objects (identical across engines)
-        # and takes caches/tables/tokens as arguments, so a second
-        # replica (or the single-stream baseline in bench.py) reuses
-        # compiled programs instead of re-tracing identical ones. The
-        # cache lives in a weak side table, NOT on the model: jitted
-        # callables hold locks and must not ride through deepcopy/pickle
-        # of the model.
+        # one jit wrapper per program kind: jax.jit itself specializes
+        # per (B, T) shape and cache pytree structure. Engines over the
+        # SAME model share them — the forward fns read only the model's
+        # Parameter objects (identical across engines) and take
+        # caches/tables/tokens as arguments, so a second replica (or the
+        # single-stream baseline in bench.py) reuses compiled programs
+        # instead of re-tracing identical ones. The cache lives in a
+        # weak side table, NOT on the model: jitted callables hold locks
+        # and must not ride through deepcopy/pickle of the model.
         import functools
         cache = _PAGED_JIT_CACHE.setdefault(model, {})
         arch_key = type(self.arch).__name__
-        fn = cache.get(arch_key)
+        fn = cache.get((arch_key, "chunk"))
         if fn is None:
-            fn = cache[arch_key] = jax.jit(
+            fn = cache[(arch_key, "chunk")] = jax.jit(
                 functools.partial(_paged_forward, self.arch,
                                   tuple(self._params)),
-                donate_argnums=(1, 2))
+                donate_argnums=(1, 2), static_argnames=("sampling",))
         self._fn = fn
-        self._key = jax.random.key(seed)
+        vfn = cache.get((arch_key, "verify"))
+        if vfn is None:
+            vfn = cache[(arch_key, "verify")] = jax.jit(
+                functools.partial(_paged_verify, self.arch,
+                                  tuple(self._params)),
+                donate_argnums=(1, 2), static_argnames=("sampling",))
+        self._vfn = vfn
+        self._base_key = jax.random.key(seed)
         self._done: List[Request] = []
         self._rid = 0
         # --- resilience state ---
@@ -401,16 +535,36 @@ class PagedEngine:
         # finished results produced while warmup() owned the step loop —
         # re-delivered by the next step()/run_to_completion
         self._spillover: Dict[int, List[int]] = {}
+        #: per-request incremental token buffers (see stream())
+        self._stream_bufs: Dict[int, List[int]] = {}
         # HBM attribution: KV pages report under the "kv_cache" tag (the
         # getter re-reads kc/vc, which donation replaces every tick)
         from ..observability.perf import memory as _perf_memory
         _perf_memory.register_object("kv_cache", self,
                                      lambda e: (e.kc, e.vc))
+        _res.M_KV_BYTES_PER_TOKEN.set(self.kv_bytes_per_token)
         # fleet telemetry: this replica's health() rides every
         # fleet.snapshot(), so a multi-replica router polls one endpoint
         # per rank (weakly held — a dropped engine unregisters itself)
         from ..observability import fleet as _fleet
         _fleet.register_replica(self)
+
+    def _fresh_cache(self):
+        """One layer's K (or V) page pool: a float array, or the int8
+        (payload, scales) pair."""
+        if self._kv_int8:
+            return (jnp.zeros(self._kv_shape, jnp.int8),
+                    jnp.zeros(self._kv_scale_shape, jnp.float32))
+        return jnp.zeros(self._kv_shape, self.kv_dtype)
+
+    @property
+    def kv_bytes_per_token(self) -> int:
+        """Resident KV bytes one cached token costs across all layers
+        (the resident-batch ceiling is HBM / (this * mean seq len))."""
+        per = self.num_kv_heads * self.head_dim * self.kv_dtype.itemsize
+        if self._kv_int8:
+            per += self.num_kv_heads * 4          # sidecar fp32 scale
+        return 2 * self.cfg.num_layers * per      # K and V
 
     # ---------------------------------------------------------------- API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -478,25 +632,33 @@ class PagedEngine:
         return bool(self.queue) or self.num_active > 0
 
     # ----------------------------------------------------------- compute
+    def _chunk_args(self, tokens_np, seq_lens_np, tables_np, temps_np,
+                    top_ps_np, rids_np, ngens_np):
+        return ([p._data for p in self._params], self.kc, self.vc,
+                jnp.asarray(tokens_np), jnp.asarray(seq_lens_np),
+                jnp.asarray(tables_np),
+                jnp.asarray(temps_np, jnp.float32),
+                jnp.asarray(top_ps_np, jnp.float32),
+                jnp.asarray(rids_np, jnp.int32),
+                jnp.asarray(ngens_np, jnp.int32), self._base_key)
+
     def _run_chunk(self, tokens_np, seq_lens_np, tables_np,
-                   temps_np, top_ps_np, phase: str = "decode"):
+                   temps_np, top_ps_np, rids_np, ngens_np,
+                   phase: str = "decode"):
         from ..observability import trace as _otrace
 
-        self._key, sub = jax.random.split(self._key)
         # serving always runs eval-mode (dropout off); restore the
         # caller's training flag afterwards — the engine must not mutate
         # a model a training loop is still using
         was_training = getattr(self.model, "training", False)
         if was_training:
             self.model.eval()
-        t0 = time.perf_counter() if _otrace._active["on"] else 0.0
+        t0 = time.perf_counter()
         try:
             nxt, self.kc, self.vc = self._fn(
-                [p._data for p in self._params], self.kc, self.vc,
-                jnp.asarray(tokens_np), jnp.asarray(seq_lens_np),
-                jnp.asarray(tables_np),
-                jnp.asarray(temps_np, jnp.float32),
-                jnp.asarray(top_ps_np, jnp.float32), sub)
+                *self._chunk_args(tokens_np, seq_lens_np, tables_np,
+                                  temps_np, top_ps_np, rids_np, ngens_np),
+                sampling=bool(np.any(np.asarray(temps_np) > 0)))
             # np.asarray blocks until the program finishes, so this span
             # covers the chunk's actual device execution — the per-tick
             # prefill-vs-decode attribution loadgen/bench report
@@ -504,12 +666,47 @@ class PagedEngine:
         finally:
             if was_training:
                 self.model.train()
-        if t0:
-            _otrace.add_complete(f"serving.{phase}", "device", t0,
-                                 time.perf_counter(),
+        t1 = time.perf_counter()
+        self.scheduler.note_phase(
+            phase, int(len(seq_lens_np)) * int(tokens_np.shape[1]),
+            t1 - t0)
+        if _otrace._active["on"]:
+            _otrace.add_complete(f"serving.{phase}", "device", t0, t1,
                                  {"phase": phase,
                                   "batch": int(len(seq_lens_np))})
         return out
+
+    def _run_verify(self, tokens_np, seq_lens_np, tables_np, temps_np,
+                    top_ps_np, rids_np, ngens_np, max_accept_np):
+        """Speculative verify program: decode-phase compute (the spans
+        and token counters attribute it to decode — it IS the decode
+        step, just yielding up to k+1 tokens)."""
+        from ..observability import trace as _otrace
+
+        was_training = getattr(self.model, "training", False)
+        if was_training:
+            self.model.eval()
+        t0 = time.perf_counter()
+        try:
+            emit, n_emit, self.kc, self.vc = self._vfn(
+                *self._chunk_args(tokens_np, seq_lens_np, tables_np,
+                                  temps_np, top_ps_np, rids_np, ngens_np),
+                jnp.asarray(max_accept_np, jnp.int32),
+                sampling=bool(np.any(np.asarray(temps_np) > 0)))
+            out = np.asarray(emit)  # tpulint: disable=TPU104 — host boundary by design: verified token ids feed python-side scheduling
+            n_out = np.asarray(n_emit)  # tpulint: disable=TPU104 — same verify-result host boundary
+        finally:
+            if was_training:
+                self.model.train()
+        t1 = time.perf_counter()
+        self.scheduler.note_phase(
+            "decode", int(len(seq_lens_np)) * int(tokens_np.shape[1]),
+            t1 - t0)
+        if _otrace._active["on"]:
+            _otrace.add_complete("serving.decode", "device", t0, t1,
+                                 {"phase": "decode", "speculative": True,
+                                  "batch": int(len(seq_lens_np))})
+        return out, n_out
 
     # -------------------------------------------------------- scheduling
     def _blocks_needed(self, length: int) -> int:
@@ -534,7 +731,6 @@ class PagedEngine:
     def _admit(self):
         from ..fault import inject as _inject
 
-        admitted = []
         for slot in range(self.max_batch):
             if not self.queue or self.slots[slot] is not None:
                 continue
@@ -561,63 +757,78 @@ class PagedEngine:
                 break
             req.status = RequestStatus.RUNNING
             _res.M_ADMITTED.inc()
-            admitted.append(slot)
-        if admitted:
-            self._prefill_batch(admitted)
-
-    def _prefill_batch(self, slots: List[int]):
-        """Prefill every same-tick admission TOGETHER: one (max_batch,
-        block_size) chunk program per chunk tick instead of per-request
-        [1, t] loops. Each slot's prefix is LEFT-padded to a multiple of
-        block_size — padded positions sit at negative sequence positions,
-        which the paged-attention kernel drops from the cache write and
-        fully masks from attention, so only two compiled shapes exist in
-        steady state: (max_batch, block_size) and the (max_batch, 1)
-        decode. The final chunk of each slot yields its first sampled
-        token."""
-        bs = self.block_size
-        prefixes = {}
-        chunks_of = {}
-        pad_of = {}
-        for slot in slots:
-            req = self.slots[slot]
+            # stage the chunked prefill; compute happens in
+            # _prefill_step under the scheduler's per-tick budget. The
+            # prefix is LEFT-padded to a multiple of block_size — padded
+            # positions sit at negative sequence positions, which the
+            # paged-attention kernel drops from the cache write and
+            # fully masks, so only two compiled shapes exist in steady
+            # state: (max_batch, block_size) and the (max_batch, 1-or-
+            # k+1) decode/verify.
+            bs = self.block_size
             prefix = np.asarray(req.prompt + req.generated, np.int32)
             n_chunks = -(-len(prefix) // bs)
-            prefixes[slot] = np.concatenate(
-                [np.zeros(n_chunks * bs - len(prefix), np.int32), prefix])
-            chunks_of[slot] = n_chunks
-            pad_of[slot] = n_chunks * bs - len(prefix)
-        nxt_of = {}
-        for j in range(max(chunks_of.values())):
+            pad = n_chunks * bs - len(prefix)
+            self._prefilling[slot] = {
+                "prefix": np.concatenate(
+                    [np.zeros(pad, np.int32), prefix]),
+                "n_chunks": n_chunks, "next": 0, "pad": pad}
+
+    def _prefill_step(self):
+        """Advance pending chunked prefills under the scheduler's
+        per-tick budget: each chunk program carries the NEXT chunk of up
+        to ``quota`` prefilling slots (slots at different chunk indices
+        share one program — per-slot seq_lens position the writes). The
+        final chunk of a slot yields its first sampled token; chunks
+        past the budget defer to later ticks so the decode step below
+        never waits out a long prompt."""
+        bs = self.block_size
+        quota = self.scheduler.chunk_quota(bs)
+        while self._prefilling:
+            slots = sorted(self._prefilling)
+            if quota is not None:
+                slots = slots[:quota]
+                if not slots:
+                    self.scheduler.note_deferred(sum(
+                        st["n_chunks"] - st["next"]
+                        for st in self._prefilling.values()))
+                    return
             tokens = np.zeros((self.max_batch, bs), np.int32)
             seq = np.zeros((self.max_batch,), np.int32)   # 0 = inactive
             temps = np.zeros((self.max_batch,), np.float32)
             top_ps = np.ones((self.max_batch,), np.float32)
-            involved = []
+            rids = np.zeros((self.max_batch,), np.int32)
+            ngens = np.zeros((self.max_batch,), np.int32)
+            finalists = []
             for slot in slots:
-                if j >= chunks_of[slot]:
-                    continue
+                st = self._prefilling[slot]
                 req = self.slots[slot]
-                tokens[slot] = prefixes[slot][j * bs:(j + 1) * bs]
-                seq[slot] = (j + 1) * bs - pad_of[slot]
+                j = st["next"]
+                tokens[slot] = st["prefix"][j * bs:(j + 1) * bs]
+                seq[slot] = (j + 1) * bs - st["pad"]
                 temps[slot] = req.temperature
                 top_ps[slot] = req.top_p
-                involved.append(slot)
+                rids[slot] = req.rid
+                ngens[slot] = len(req.generated)
+                st["next"] = j + 1
+                if st["next"] == st["n_chunks"]:
+                    finalists.append(slot)
             nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps,
-                                  phase="prefill")
-            for slot in involved:
-                if j == chunks_of[slot] - 1:
-                    nxt_of[slot] = int(nxt[slot])
-        now = self._clock()
-        for slot in slots:
-            req = self.slots[slot]
-            self.seq_lens[slot] = len(req.prompt) + len(req.generated)
-            tok = nxt_of[slot]
-            req.generated.append(tok)
-            self.last_token[slot] = tok
-            self._record_token(req, now)
-            self._maybe_finish(slot)
-
+                                  rids, ngens, phase="prefill")
+            if quota is not None:
+                quota -= len(slots)
+            now = self._clock()
+            for slot in finalists:
+                del self._prefilling[slot]
+                req = self.slots[slot]
+                # cached positions == the prefilled prefix; the sampled
+                # token lands in the cache on its decode step
+                self.seq_lens[slot] = len(req.prompt) + len(req.generated)
+                tok = int(nxt[slot])
+                req.generated.append(tok)
+                self.last_token[slot] = tok
+                self._record_token(req, now)
+                self._maybe_finish(slot)
 
     def _evict(self, slot: int):
         """Preempt a running request: release its blocks and requeue it
@@ -633,6 +844,7 @@ class PagedEngine:
         """Return a slot's KV blocks to the free list and reset its lane
         in the batch state (idle lanes point at the trash block)."""
         self.slots[slot] = None
+        self._prefilling.pop(slot, None)
         self.bm.release(self.slot_blocks[slot])
         self.slot_blocks[slot] = []
         self.tables[slot, :] = 0
@@ -656,6 +868,7 @@ class PagedEngine:
             tokens=list(req.generated), submit_t=req.submit_t,
             first_token_t=req.first_token_t, finish_t=req.finish_t,
             token_times=list(req.token_times))
+        self._stream_bufs.pop(req.rid, None)
         if status == RequestStatus.FINISHED:
             self._done.append(req)
 
@@ -668,6 +881,9 @@ class PagedEngine:
         elif req.token_times:
             _res.M_ITL.observe(now - req.token_times[-1])
         req.token_times.append(now)
+        buf = self._stream_bufs.get(req.rid)
+        if buf is not None:
+            buf.append(req.generated[-1])
 
     def _maybe_finish(self, slot: int):
         req = self.slots[slot]
@@ -752,10 +968,11 @@ class PagedEngine:
 
     # ------------------------------------------------------------- ticks
     def step(self) -> Dict[int, List[int]]:
-        """One engine tick: expire deadlines, shed overload, admit +
-        prefill queued requests, then a single batched decode step for
-        every active slot. Returns {rid: generated_tokens} for requests
-        that finished this tick.
+        """One engine tick: expire deadlines, admit queued requests,
+        shed overload, advance chunked prefill under the scheduler's
+        budget, then a single batched decode (or speculative verify)
+        step for every fully-prefilled slot. Returns
+        {rid: generated_tokens} for requests that finished this tick.
 
         Never raises from scheduling, memory pressure, or injected
         faults: an internal tick failure marks the in-flight requests
@@ -780,6 +997,7 @@ class PagedEngine:
         finally:
             if wd is not None:
                 wd.end_work()
+            self.scheduler.end_tick()
             _res.M_TICK_SECONDS.observe(time.perf_counter() - t0)
             _res.M_QUEUE_DEPTH.set(len(self.queue))
             _res.M_KV_BLOCKS.set(self._total_usable - self.bm.available)
@@ -804,13 +1022,43 @@ class PagedEngine:
         # absorb this tick counts against the high-water mark
         self._admit()
         self._shed_overload()
+        # phase split: bounded prefill, then decode — decode runs EVERY
+        # tick there is decodable work, however much prefill is pending
+        self._prefill_step()
         self._decode_active()
 
+    def _decode_lanes(self) -> List[int]:
+        """Slots holding a fully-prefilled request (mid-prefill slots
+        stay out of the decode batch — their lanes run with the seq=0
+        sentinel so the compiled shape never changes)."""
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and i not in self._prefilling]
+
     def _decode_active(self):
-        active = [i for i, s in enumerate(self.slots) if s is not None]
+        active = self._decode_lanes()
         if not active:
             return
+        if self._spec is not None and self._spec_feasible(active):
+            self._decode_speculative(active)
+            return
+        self._decode_plain(active)
+
+    def _spec_feasible(self, active: List[int]) -> bool:
+        """Speculate this tick only when every active slot has table
+        room for the k draft positions — a slot whose sequence is
+        within k of its ``max_blocks_per_seq`` ceiling must NOT feed a
+        (seq+k)-length verify (the block-table lookup would clamp and
+        corrupt another block's pages, and _ensure_blocks would raise
+        out of the tick). Near-capacity ticks fall back to plain
+        decode, which admission guarantees always fits."""
+        cap = self.max_blocks_per_seq * self.block_size
+        return all(self.slots[i].seq_len + self._spec_k <= cap
+                   for i in active)
+
+    def _decode_plain(self, active: List[int]):
         seq = self.seq_lens.copy()
+        for i in self._prefilling:
+            seq[i] = 0               # masked lane: no write, no attend
         skipped = []
         for i in active:
             # the cache holds seq_len-1 positions; the token being fed
@@ -836,11 +1084,15 @@ class PagedEngine:
         tokens = self.last_token[:, None].astype(np.int32)
         temps = np.zeros((self.max_batch,), np.float32)
         top_ps = np.ones((self.max_batch,), np.float32)
+        rids = np.zeros((self.max_batch,), np.int32)
+        ngens = np.zeros((self.max_batch,), np.int32)
         for i in active:
             temps[i] = self.slots[i].temperature
             top_ps[i] = self.slots[i].top_p
+            rids[i] = self.slots[i].rid
+            ngens[i] = len(self.slots[i].generated)
         nxt = self._run_chunk(tokens, seq, self.tables, temps, top_ps,
-                              phase="decode")
+                              rids, ngens, phase="decode")
         now = self._clock()
         for i in active:
             if seq[i] == 0:
@@ -851,6 +1103,100 @@ class PagedEngine:
             self.last_token[i] = int(nxt[i])
             self._record_token(req, now)
             self._maybe_finish(i)
+
+    def _decode_speculative(self, active: List[int]):
+        """Decode via the fused verify program: per active slot, feed
+        [last_token, k n-gram draft tokens] in one (B, k+1) forward and
+        take the accepted prefix + the model's own next token — up to
+        k+1 tokens per slot per tick, greedy output identical to plain
+        decode by construction (acceptance only keeps drafts the target
+        model would have emitted itself)."""
+        from ..serving import speculative as _spec_mod
+
+        k = self._spec_k
+        T = k + 1
+        seq = self.seq_lens.copy()
+        for i in range(self.max_batch):
+            if i not in active:
+                seq[i] = 0           # idle / mid-prefill: masked lane
+        tokens = np.zeros((self.max_batch, T), np.int32)
+        temps = np.zeros((self.max_batch,), np.float32)
+        top_ps = np.ones((self.max_batch,), np.float32)
+        rids = np.zeros((self.max_batch,), np.int32)
+        ngens = np.zeros((self.max_batch,), np.int32)
+        max_accept = np.zeros((self.max_batch,), np.int32)
+        skipped = []
+        max_pos = getattr(self.arch, "max_positions", None)
+        for i in active:
+            req = self.slots[i]
+            # draft positions extend to seq_len-1+k: allocate for the
+            # whole verify up front (stale tail entries are masked by
+            # the rolled-back seq_len and overwritten as the sequence
+            # legitimately reaches them)
+            if not self._ensure_blocks(i, req.seq_len + k):
+                seq[i] = 0
+                skipped.append(i)
+                continue
+            draft: List[int] = []
+            if req.temperature == 0:
+                draft = list(self._spec.propose(
+                    req.prompt + req.generated))[:k]
+            ma = len(draft)
+            if max_pos is not None:
+                # drafts whose positions would clip-gather past the
+                # learned-position table can never be verified honestly
+                ma = max(0, min(ma, max_pos - req.seq_len))
+            row = [int(self.last_token[i])] + draft
+            row += [row[-1]] * (T - len(row))     # pad: always rejected
+            tokens[i] = row
+            seq[i] = req.seq_len + k
+            temps[i] = req.temperature
+            top_ps[i] = req.top_p
+            rids[i] = req.rid
+            ngens[i] = len(req.generated)
+            max_accept[i] = ma
+        if skipped and len(skipped) == len(active):
+            victim = max(skipped, key=self._eviction_key)
+            self._evict(victim)
+            return
+        if not skipped and not max_accept.any():
+            # nothing speculates this tick (sampling-only batch, or the
+            # proposer came up dry everywhere): the plain (B, 1) decode
+            # emits the same tokens for (k+1)x less attention/logit
+            # work — a dry proposer costs one ordinary decode step
+            self._decode_plain(active)
+            return
+        emit, n_emit = self._run_verify(tokens, seq, self.tables, temps,
+                                        top_ps, rids, ngens, max_accept)
+        now = self._clock()
+        proposed = accepted = 0
+        for i in active:
+            if seq[i] == 0:
+                continue
+            req = self.slots[i]
+            ne = int(n_emit[i])
+            proposed += int(max_accept[i])
+            accepted += ne - 1
+            for j in range(ne):
+                tok = int(emit[i, j])
+                req.generated.append(tok)
+                self.last_token[i] = tok
+                self._record_token(req, now)
+                if (len(req.generated) >= req.max_new_tokens
+                        or (self.eos_id is not None
+                            and tok == self.eos_id)):
+                    break            # _maybe_finish releases the slot
+            # valid cached positions: everything up to (not including)
+            # the newest sampled token — identical invariant to decode
+            self.seq_lens[i] = req.seq_len - 1
+            self._maybe_finish(i)
+        if proposed:
+            self.spec_proposed += proposed
+            self.spec_accepted += accepted
+            _spec_mod.M_SPEC_PROPOSED.inc(proposed)
+            _spec_mod.M_SPEC_ACCEPTED.inc(accepted)
+            _spec_mod.M_SPEC_ACCEPT_RATE.set(
+                self.spec_accepted / max(self.spec_proposed, 1))
 
     def _on_tick_failure(self, exc: BaseException):
         """Contain an unexpected tick error: the in-flight requests are
@@ -874,10 +1220,8 @@ class PagedEngine:
         # above, so later admissions re-prefill from their prompts; a
         # stale-buffer engine would otherwise fail every future tick
         # while still admitting.
-        self.kc = [jnp.zeros(self._kv_shape, self.kv_dtype)
-                   for _ in range(self.cfg.num_layers)]
-        self.vc = [jnp.zeros(self._kv_shape, self.kv_dtype)
-                   for _ in range(self.cfg.num_layers)]
+        self.kc = [self._fresh_cache() for _ in range(self.cfg.num_layers)]
+        self.vc = [self._fresh_cache() for _ in range(self.cfg.num_layers)]
         self.lifecycle.degrade(detail)
 
     def _drain_done(self) -> Dict[int, List[int]]:
@@ -944,6 +1288,42 @@ class PagedEngine:
                                      detail=reason)
                 return True
         return False
+
+    # --------------------------------------------------------- streaming
+    def open_stream(self, rid: int) -> List[int]:
+        """Attach (or fetch) the incremental token buffer for ``rid``;
+        every token the request generates from now on is appended.
+        Tokens generated before the stream opened are replayed first, so
+        a late-attaching client still sees the whole completion. The
+        buffer object stays valid after the request ends (the engine
+        drops its own reference at terminal — the stream keeps the
+        list)."""
+        buf = self._stream_bufs.get(rid)
+        if buf is not None:
+            return buf
+        buf = []
+        oc = self.outcomes.get(rid)
+        if oc is not None:               # already terminal: replay only
+            buf.extend(oc.tokens)
+            return buf
+        for req in list(self.queue) + [s for s in self.slots
+                                       if s is not None]:
+            if req.rid == rid:
+                buf.extend(req.generated)
+                self._stream_bufs[rid] = buf
+                return buf
+        return buf                       # unknown rid: empty, terminal
+
+    def stream(self, rid: int):
+        """Incremental token stream for one request: iterate tokens as
+        ticks produce them (the iterator pumps ``step()`` while the
+        request is live); iteration ends at the terminal status, left on
+        ``stream.status``. See ``paddle_tpu.serving.TokenStream``."""
+        from ..serving.stream import TokenStream
+        return TokenStream(
+            rid, self.open_stream(rid), self.step,
+            lambda: self.request_status(rid),
+            lambda s: s is None or s in TERMINAL_STATUSES)
 
     def warmup(self, prompt_len: Optional[int] = None,
                max_new_tokens: int = 2) -> "PagedEngine":
@@ -1051,14 +1431,23 @@ class PagedEngine:
         """Liveness/readiness probe payload (what an HTTP /healthz in
         front of this replica returns)."""
         lc = self.lifecycle
-        return {"state": lc.state, "ready": lc.ready(),
-                "live": lc.live(),
-                "queue_depth": len(self.queue),
-                "active": self.num_active,
-                "kv_blocks_free": self.bm.available,
-                "kv_blocks_total": self._total_usable,
-                "ticks": self._ticks,
-                "tick_failures": self.tick_failures}
+        h = {"state": lc.state, "ready": lc.ready(),
+             "live": lc.live(),
+             "queue_depth": len(self.queue),
+             "active": self.num_active,
+             "prefilling": len(self._prefilling),
+             "kv_blocks_free": self.bm.available,
+             "kv_blocks_total": self._total_usable,
+             "kv_dtype": str(self.kv_dtype),
+             "kv_bytes_per_token": self.kv_bytes_per_token,
+             "ticks": self._ticks,
+             "tick_failures": self.tick_failures,
+             "phase_share": self.scheduler.phase_share()}
+        if self._spec is not None:
+            h["spec_acceptance_rate"] = (
+                self.spec_accepted / self.spec_proposed
+                if self.spec_proposed else None)
+        return h
 
 
 # Backward-compatible names: the generic engine picks the adapter itself.

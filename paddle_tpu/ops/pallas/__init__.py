@@ -3,5 +3,7 @@
 TPU-native replacement for the reference's hand-written fused CUDA kernels
 (reference: paddle/phi/kernels/fusion/gpu/ and third_party/flashattn). Only
 the truly bandwidth/latency-critical ops get kernels here — everything else
-is left to XLA fusion.
+is left to XLA fusion. ``serving`` holds the serving tier's in-graph
+helpers (int8 KV page (de)quant, the speculative-decode accept-prefix
+step) that the paged-attention op and engine verify program compose.
 """

@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — the multi-replica LLM serving tier.
+
+The deployment story joining five shipped subsystems (see README
+"Serving tier"): the continuous-batching :class:`~paddle_tpu.inference.
+PagedEngine` is the data plane; this package adds the control plane that
+turns one replica into an operable tier:
+
+* :mod:`.scheduler` — phase-split tick scheduling (Sarathi-style chunked
+  prefill under a per-tick token budget, decode-priority so long prompts
+  stop stalling decode batches) + the per-phase token/tick-share metrics.
+* :mod:`.speculative` — the n-gram draft proposer behind the engine's
+  ``speculate=`` knob; the fused single-program verify step itself lives
+  in the engine (``ops/pallas/serving.spec_accept_prefix``).
+* :mod:`.stream` — per-request incremental token streams
+  (``engine.stream(rid)`` / ``router.stream(rid)``).
+* :mod:`.router` — the multi-replica front door: admission keyed on the
+  round-11 readiness probes, queue-depth load balancing, ``Overloaded``
+  retry on the next replica, re-routing of requests stranded by a
+  degraded/drained replica, and load shedding AT THE ROUTER (replicas
+  never see traffic the tier cannot absorb).
+"""
+from .router import Router, RouterConfig
+from .scheduler import Scheduler, SchedulerConfig
+from .speculative import NgramProposer
+from .stream import TokenStream
+
+__all__ = ["Router", "RouterConfig", "Scheduler", "SchedulerConfig",
+           "NgramProposer", "TokenStream"]

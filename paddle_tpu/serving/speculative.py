@@ -1,0 +1,83 @@
+"""N-gram speculative decoding: the host-side draft proposer.
+
+Speculative decoding splits a decode step into *draft* (cheap guesses at
+the next k tokens) and *verify* (one target-model forward over all k+1
+positions, accepting the longest prefix the model agrees with). The
+verify is the expensive half and it lives IN-GRAPH in the engine — draft
+tokens appended to the decode feed, one paged-attention forward, and the
+accept-prefix rule as lax ops (``ops.pallas.serving.spec_accept_prefix``),
+so the whole step is ONE compiled program with the stable shape
+``(max_batch, k+1)``.
+
+This module is the draft half. The n-gram proposer (the "prompt lookup
+decoding" trick) needs no draft model: it matches the sequence's own
+trailing n-gram against its earlier history and proposes the tokens that
+followed last time. On natural text and code the continuation repeats
+often enough for 2-4x decode speedups at zero extra weights; when it
+misses, the verify emits exactly the token normal decode would have —
+speculation never changes greedy output, only how many tokens one
+program yields.
+
+Proposers are pluggable: anything with ``propose(context) -> list[int]``
+(at most ``k`` tokens) slots into ``PagedEngine(speculate=...)`` — a
+draft-model proposer rides the same verify program.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+from ..observability import metrics as _metrics
+
+__all__ = ["NgramProposer", "M_SPEC_PROPOSED", "M_SPEC_ACCEPTED",
+           "M_SPEC_ACCEPT_RATE"]
+
+
+M_SPEC_PROPOSED = _metrics.counter(
+    "paddle_tpu_serving_spec_proposed_tokens_total",
+    "Draft tokens proposed into speculative verify steps.")
+M_SPEC_ACCEPTED = _metrics.counter(
+    "paddle_tpu_serving_spec_accepted_tokens_total",
+    "Draft tokens accepted by the target model (each saves one decode "
+    "tick).")
+M_SPEC_ACCEPT_RATE = _metrics.gauge(
+    "paddle_tpu_serving_spec_acceptance_rate",
+    "Cumulative accepted/proposed draft-token ratio of this process's "
+    "speculative engines.")
+
+
+class NgramProposer:
+    """Draft ``k`` tokens by n-gram lookup in the request's own history.
+
+    Tries the longest trailing n-gram first (``max_n`` down to
+    ``min_n``): scan the context right-to-left for the most recent
+    earlier occurrence, and propose the tokens that followed it. Returns
+    at most ``k`` tokens; fewer (or none) when history has no match —
+    the engine pads the verify feed and caps acceptance, so a dry
+    proposer costs one ordinary decode step, nothing more.
+
+    The scan is O(len(context)) per call with early exit on the first
+    (most recent) match — fine for serving-length contexts; a rolling
+    hash index is the upgrade path if profiles ever show it.
+    """
+
+    def __init__(self, k: int = 4, max_n: int = 3, min_n: int = 1):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        if not 1 <= min_n <= max_n:
+            raise ValueError("need 1 <= min_n <= max_n")
+        self.k = k
+        self.max_n = max_n
+        self.min_n = min_n
+
+    def propose(self, context: Sequence[int]) -> List[int]:
+        ctx = list(context)
+        L = len(ctx)
+        for n in range(min(self.max_n, L - 1), self.min_n - 1, -1):
+            tail = ctx[L - n:]
+            # most recent earlier occurrence of the trailing n-gram
+            for j in range(L - n - 1, -1, -1):
+                if ctx[j:j + n] == tail:
+                    cont = ctx[j + n:j + n + self.k]
+                    if cont:
+                        return cont
+        return []

@@ -565,8 +565,8 @@ class TestPerfGate:
         run it was frozen from. Rungs added to the baseline AFTER the
         r05 freeze (fleet_observability round 14, fusion round 15,
         planner_vs_manual round 16, async_overlap + async_batch_sweep
-        round 17) are absent from the archived run — they may be
-        missing, but nothing may fail."""
+        round 17, serving_router round 18) are absent from the archived
+        run — they may be missing, but nothing may fail."""
         with open(os.path.join(REPO, "tools", "perf_baseline.json")) as f:
             base = json.load(f)
         assert base["format"] == "paddle_tpu.perf_baseline/1"
@@ -594,7 +594,8 @@ class TestPerfGate:
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",
                            "async_overlap_step_ratio",
-                           "async_batch_sweep_tokens_ratio"}
+                           "async_batch_sweep_tokens_ratio",
+                           "serving_router_goodput_scaling"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"

@@ -16,11 +16,21 @@ from paddle_tpu.vision import datasets, models, transforms as T
 
 
 class TestZooForward:
+    # one representative per family runs in tier-1; sibling variants of
+    # an already-covered family (same blocks, different width/depth
+    # config) are `slow` — each costs 5-18s of conv compiles and tier-1
+    # must fit its 870s budget. The full matrix still runs without
+    # `-m 'not slow'`.
+    _slow = pytest.mark.slow
     @pytest.mark.parametrize("ctor,size", [
-        ("vgg11", 64), ("mobilenet_v1", 64), ("mobilenet_v2", 64),
-        ("mobilenet_v3_small", 64), ("mobilenet_v3_large", 64),
-        ("alexnet", 96), ("squeezenet1_0", 96), ("squeezenet1_1", 96),
-        ("shufflenet_v2_x0_25", 64), ("shufflenet_v2_swish", 64),
+        ("vgg11", 64), ("mobilenet_v2", 64),
+        pytest.param("mobilenet_v1", 64, marks=_slow),
+        pytest.param("mobilenet_v3_small", 64, marks=_slow),
+        pytest.param("mobilenet_v3_large", 64, marks=_slow),
+        ("alexnet", 96), ("squeezenet1_1", 96),
+        pytest.param("squeezenet1_0", 96, marks=_slow),
+        ("shufflenet_v2_x0_25", 64),
+        pytest.param("shufflenet_v2_swish", 64, marks=_slow),
         ("densenet121", 64),
     ])
     def test_forward_shape(self, ctor, size):

@@ -166,6 +166,8 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
             good_tokens += len(oc.tokens)
 
     finished = by_status.get("FINISHED", 0)
+    # router mode: per-replica routing/goodput breakdown rides the report
+    router = engine.stats() if hasattr(engine, "stats") else None
     return {
         "offered_rps": float(offered_rps),
         "achieved_arrival_rps": round(n_requests / max(wall, 1e-9), 3),
@@ -186,26 +188,58 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
         "p99_itl_s": _percentile(itls, 99),
         "wall_s": round(wall, 3),
         "device_attribution": device,
+        "router": router,
     }
 
 
-def _tiny_engine(max_batch=4, max_queue=32, high_water=None, seed=7):
+_MODEL_CACHE: dict = {}
+
+
+def _tiny_model(seed=7):
+    """One shared CPU-sized Llama per seed: replicas over the same model
+    share compiled tick programs (serving._PAGED_JIT_CACHE), so an
+    R-replica router costs one compile set, not R."""
+    if seed not in _MODEL_CACHE:
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        paddle.seed(seed)
+        cfg = LlamaConfig(vocab_size=97, hidden_size=64,
+                          intermediate_size=128, num_layers=2, num_heads=4,
+                          max_seq_len=256, use_flash_attention=False)
+        _MODEL_CACHE[seed] = LlamaForCausalLM(cfg)
+    return _MODEL_CACHE[seed]
+
+
+def _tiny_engine(max_batch=4, max_queue=32, high_water=None, seed=7,
+                 kv_dtype=None, speculate=None, prefill_budget=None):
     """CPU-sized Llama replica for CLI runs and drills (per-request
     deadlines are passed through run_load, not the engine defaults)."""
-    import paddle_tpu as paddle
     from paddle_tpu.inference import PagedEngine, ResilienceConfig
-    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.serving import SchedulerConfig
 
-    paddle.seed(seed)
-    cfg = LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=128,
-                      num_layers=2, num_heads=4, max_seq_len=256,
-                      use_flash_attention=False)
-    model = LlamaForCausalLM(cfg)
     rcfg = ResilienceConfig(max_queue=max_queue,
                             queue_high_water=high_water)
-    return PagedEngine(model, max_batch=max_batch, block_size=8,
-                       num_blocks=128, max_blocks_per_seq=16,
-                       resilience=rcfg)
+    sched = (SchedulerConfig(prefill_token_budget=prefill_budget)
+             if prefill_budget else None)
+    return PagedEngine(_tiny_model(seed), max_batch=max_batch,
+                       block_size=8, num_blocks=128, max_blocks_per_seq=16,
+                       kv_dtype=kv_dtype, speculate=speculate,
+                       scheduler=sched, resilience=rcfg)
+
+
+def _tiny_tier(replicas, **engine_kw):
+    """R replicas behind a Router. Shedding policy lives AT THE ROUTER:
+    replicas keep their bounded queues (Overloaded bounces the router to
+    the next candidate) but run without an internal high-water mark —
+    overload becomes router-level SHED outcomes, never replica-side
+    drops (the acceptance shape the ISSUE/ROADMAP name)."""
+    from paddle_tpu.serving import Router
+
+    engine_kw.pop("high_water", None)
+    reps = [_tiny_engine(high_water=None, **engine_kw)
+            for _ in range(replicas)]
+    return Router(reps).warmup()
 
 
 def main(argv=None):
@@ -223,18 +257,33 @@ def main(argv=None):
     ap.add_argument("--ttft-deadline-s", type=float, default=None)
     ap.add_argument("--deadline-s", type=float, default=None)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="router mode: front R replicas with the serving "
+                         "router (shed at the router, per-replica "
+                         "goodput breakdown in the report)")
+    ap.add_argument("--kv-dtype", default=None,
+                    help='e.g. "int8" for the quantized KV page pool')
+    ap.add_argument("--speculate", default=None,
+                    help='"ngram" enables speculative decoding')
+    ap.add_argument("--prefill-budget", type=int, default=None,
+                    help="phase-split scheduler: prefill tokens per tick")
     args = ap.parse_args(argv)
 
+    engine_kw = dict(max_batch=args.max_batch, max_queue=args.max_queue,
+                     kv_dtype=args.kv_dtype, speculate=args.speculate,
+                     prefill_budget=args.prefill_budget)
     for rate in [float(r) for r in args.rates.split(",") if r]:
-        eng = _tiny_engine(max_batch=args.max_batch,
-                           max_queue=args.max_queue,
-                           high_water=args.high_water)
-        eng.warmup()
+        if args.replicas > 1:
+            eng = _tiny_tier(args.replicas, **engine_kw)
+        else:
+            eng = _tiny_engine(high_water=args.high_water, **engine_kw)
+            eng.warmup()
         report = run_load(
             eng, offered_rps=rate, n_requests=args.requests,
             max_new_tokens=args.max_new_tokens,
             ttft_deadline_s=args.ttft_deadline_s,
             deadline_s=args.deadline_s, seed=args.seed)
+        report["replicas"] = args.replicas
         eng.drain()
         print(json.dumps(report))
 
