@@ -854,6 +854,105 @@ def _bench_serving_router(small):
     }
 
 
+def _bench_serving_reqtrace(small):
+    """Request-trace overhead rung (BENCH_MODEL=serving_reqtrace;
+    paddle_tpu/observability/reqtrace.py). The SAME steady-state decode
+    tick — a full batch of long-running requests, so every tick records
+    one decode_tick event per slot plus the per-token exemplar/TTFT
+    bookkeeping — timed with ``FLAGS_reqtrace`` fully OFF vs fully ON.
+    value = off/on tick-time ratio (1.0 = free); the acceptance bar is
+    overhead < 2%. Paired per-tick A/B with alternating order (the
+    round-14 fleet_observability estimator: median over ALL signed pair
+    diffs, so host drift cancels inside pairs and slot-position bias
+    across them)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import flags
+    from paddle_tpu.inference import PagedEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.observability import reqtrace
+
+    paddle.seed(7)
+    if small:
+        cfg = LlamaConfig(vocab_size=97, hidden_size=64,
+                          intermediate_size=128, num_layers=2,
+                          num_heads=4, max_seq_len=4096,
+                          use_flash_attention=False)
+        pairs, max_batch = 300, 4
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          intermediate_size=2816, num_layers=16,
+                          num_heads=16, max_seq_len=4096,
+                          use_flash_attention=False)
+        pairs, max_batch = _env_int("BENCH_REQTRACE_PAIRS", 150), 8
+    model = LlamaForCausalLM(cfg)
+    warm = 20
+    ticks_needed = warm + 2 * pairs + 16
+    prompt_len = 8
+    bs = 16
+    bps = -(-(prompt_len + ticks_needed + bs) // bs) + 1
+    eng = PagedEngine(model, max_batch=max_batch, block_size=bs,
+                      num_blocks=max_batch * bps + 2,
+                      max_blocks_per_seq=bps)
+    rng = np.random.RandomState(3)
+    for _ in range(max_batch):
+        eng.add_request(
+            [int(t) for t in rng.randint(1, cfg.vocab_size,
+                                         size=prompt_len)],
+            max_new_tokens=ticks_needed)
+
+    prev = flags.get_flag("reqtrace")
+    t_off, diffs = [], []
+
+    def one_tick():
+        t0 = time.perf_counter()
+        eng.step()
+        return time.perf_counter() - t0
+
+    try:
+        flags.set_flags({"reqtrace": True})
+        for _ in range(warm):          # compiles + steady decode shape
+            eng.step()
+        for i in range(pairs):
+            if i % 2 == 0:
+                flags.set_flags({"reqtrace": False})
+                d_off = one_tick()
+                flags.set_flags({"reqtrace": True})
+                d_on = one_tick()
+            else:
+                flags.set_flags({"reqtrace": True})
+                d_on = one_tick()
+                flags.set_flags({"reqtrace": False})
+                d_off = one_tick()
+            t_off.append(d_off)
+            diffs.append(d_on - d_off)
+        recorded = sum(len(tl["events"]) for tl in
+                       reqtrace.RECORDER.live_timelines())
+    finally:
+        flags.set_flags({"reqtrace": prev})
+        eng.drain()
+        # the measurement's torn half-traced timelines and exemplars
+        # must not pollute the process stores a later rung might inspect
+        reqtrace.RECORDER.clear()
+        reqtrace.EXEMPLARS.clear()
+    off = float(np.median(t_off))
+    on = off + float(np.median(diffs))
+    ratio = off / max(on, 1e-12)
+    overhead_pct = (on / max(off, 1e-12) - 1.0) * 100.0
+    return {
+        "metric": "serving_reqtrace_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_untraced",
+        "vs_baseline": round(ratio, 4),
+        "extra": {"overhead_pct": round(overhead_pct, 3),
+                  "tick_off_us": round(off * 1e6, 1),
+                  "tick_on_us": round(on * 1e6, 1),
+                  "ticks_per_config": pairs,
+                  "batch": max_batch,
+                  "events_recorded": recorded,
+                  "within_budget": bool(overhead_pct < 2.0)},
+    }
+
+
 def _bench_spmd_auto(small):
     """SPMD auto-sharding rung (BENCH_MODEL=spmd_auto;
     paddle_tpu/distributed/spmd/). The SAME weights run one GPT
@@ -2025,6 +2124,7 @@ def main():
                "serving": _bench_serving,
                "serving_resilience": _bench_serving_resilience,
                "serving_router": _bench_serving_router,
+               "serving_reqtrace": _bench_serving_reqtrace,
                "compile_cache": _bench_compile_cache,
                "spmd_auto": _bench_spmd_auto,
                "planner_vs_manual": _bench_planner_vs_manual,
@@ -2183,6 +2283,18 @@ def main():
     print(json.dumps(srr))
     sys.stdout.flush()
 
+    # request-trace overhead rung: the per-request lifecycle recorder
+    # must stay < 2% of a steady-state decode tick with FLAGS_reqtrace
+    # on (own metric class — not in the train geomean)
+    try:
+        rt = benches["serving_reqtrace"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        rt = {"metric": "serving_reqtrace_overhead_ratio",
+              "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(rt))
+    sys.stdout.flush()
+
     errors = [name for name, r in rungs.items() if r["unit"] == "error"]
     ratios = [r["vs_baseline"] for name, r in rungs.items()
               if r["unit"] != "error"]
@@ -2261,6 +2373,12 @@ def main():
                       "overhead_pct": fo.get("extra", {}).get(
                           "overhead_pct"),
                       "within_budget": fo.get("extra", {}).get(
+                          "within_budget")},
+                  "serving_reqtrace": {
+                      "value": rt["value"], "unit": rt["unit"],
+                      "overhead_pct": rt.get("extra", {}).get(
+                          "overhead_pct"),
+                      "within_budget": rt.get("extra", {}).get(
                           "within_budget")},
                   "async_overlap": {
                       "value": ao["value"], "unit": ao["unit"],

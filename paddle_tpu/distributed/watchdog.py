@@ -154,7 +154,37 @@ class Watchdog:
             self._dump_flight_and_diff(out)
         except Exception as e:
             out.write(f"[watchdog] flight-recorder dump failed: {e}\n")
+        try:
+            self._dump_reqtrace(out)
+        except Exception as e:
+            out.write(f"[watchdog] request-trace dump failed: {e}\n")
         out.write("[watchdog] ---- end diagnostics ----\n")
+
+    def _dump_reqtrace(self, out):
+        """Request flight-recorder post-mortem: the serving requests
+        stuck mid-flight when the tick loop wedged, plus a persisted
+        ring (``PADDLE_TPU_REQTRACE``) for out-of-band analysis with
+        ``tools/request_trace.py`` — mirrors the collective flight
+        dump (an ``os.abort`` skips atexit, so the watchdog persists
+        explicitly first)."""
+        from ..observability import reqtrace
+
+        live = reqtrace.RECORDER.live_timelines()
+        if live:
+            out.write(f"[watchdog] {len(live)} request(s) mid-flight "
+                      f"(no terminal event):\n")
+            for tl in live[:10]:
+                evs = tl["events"]
+                last = evs[-1] if evs else None
+                out.write(
+                    f"[watchdog]   {tl['scope']}/rid={tl['rid']} "
+                    f"{len(evs)} events, last="
+                    + (f"{last['event']}@{last['t']:.3f}" if last
+                       else "<none>") + "\n")
+        path = reqtrace.dump(reason=f"watchdog hang #{self.hang_count}")
+        if path:
+            out.write(f"[watchdog] request-trace record persisted: "
+                      f"{path}\n")
 
     def _dump_flight_and_diff(self, out, wait_s: Optional[float] = None):
         """Collective flight-recorder post-mortem: persist THIS rank's

@@ -109,12 +109,21 @@ class ResilienceConfig:
     ``default_ttft_deadline_s`` / ``default_deadline_s``
         Applied to requests submitted without explicit deadlines.
         ``None`` means unbounded.
+    ``slo_target`` / ``slo_fast_window_s`` / ``slo_slow_window_s``
+        The availability objective the deadlines serve and the two
+        sliding windows behind the
+        ``paddle_tpu_serving_slo_{fast,slow}_burn_rate`` gauges (SRE
+        multiwindow pattern; see ``observability/reqtrace.py``). A
+        terminal outcome other than FINISHED burns error budget.
     """
 
     max_queue: int = 256
     queue_high_water: Optional[int] = None
     default_ttft_deadline_s: Optional[float] = None
     default_deadline_s: Optional[float] = None
+    slo_target: float = 0.99
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
 
     def __post_init__(self):
         if self.max_queue < 1:
@@ -124,6 +133,11 @@ class ResilienceConfig:
             raise ValueError(
                 f"queue_high_water must be in [0, max_queue="
                 f"{self.max_queue}]")
+        if not 0.0 < self.slo_target < 1.0:
+            raise ValueError("slo_target must be in (0, 1)")
+        if not 0.0 < self.slo_fast_window_s <= self.slo_slow_window_s:
+            raise ValueError(
+                "need 0 < slo_fast_window_s <= slo_slow_window_s")
 
 
 class ReplicaState:

@@ -64,6 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import reqtrace as _reqtrace
 from .resilience import (Overloaded, ReplicaLifecycle, ReplicaState,
                          RequestOutcome, RequestStatus, ResilienceConfig,
                          TERMINAL_STATUSES)
@@ -526,6 +527,13 @@ class PagedEngine:
         self.resilience = resilience or ResilienceConfig()
         self._clock = time.monotonic      # seam for deterministic tests
         self.lifecycle = ReplicaLifecycle(clock=self._clock)
+        # SLO burn-rate accounting (reqtrace): every terminal outcome
+        # feeds the multiwindow burn gauges for this replica's scope
+        rc = self.resilience
+        self._slo = _reqtrace.SloTracker(
+            self.lifecycle.name, target=rc.slo_target,
+            fast_window_s=rc.slo_fast_window_s,
+            slow_window_s=rc.slo_slow_window_s)
         #: terminal outcome per request (drained by ``drain_outcomes``;
         #: long-running callers should drain it alongside step())
         self.outcomes: Dict[int, RequestOutcome] = {}
@@ -565,6 +573,22 @@ class PagedEngine:
         if self._kv_int8:
             per += self.num_kv_heads * 4          # sidecar fp32 scale
         return 2 * self.cfg.num_layers * per      # K and V
+
+    # ------------------------------------------------- request tracing
+    @property
+    def reqtrace_scope(self) -> str:
+        """Timeline scope this replica records under (the lifecycle's
+        stable per-process replica name)."""
+        return self.lifecycle.name
+
+    def _rt_event(self, rid: int, event: str,
+                  t: Optional[float] = None, **meta):
+        """Stamp one lifecycle event into the request flight recorder
+        (``reqtrace.emit``: enabled-gate first — the disabled path reads
+        NO clock — timestamps from the engine clock seam so FakeClock
+        drills produce deterministic timelines)."""
+        _reqtrace.emit(self.lifecycle.name, self._clock, rid, event, t,
+                       **meta)
 
     # ---------------------------------------------------------------- API
     def add_request(self, prompt_ids, max_new_tokens: int = 32,
@@ -608,6 +632,11 @@ class PagedEngine:
                                else rcfg.default_ttft_deadline_s)
         req.deadline_s = (deadline_s if deadline_s is not None
                           else rcfg.default_deadline_s)
+        self._rt_event(req.rid, "submitted", t=req.submit_t,
+                       prompt_tokens=len(prompt),
+                       max_new_tokens=max_new_tokens,
+                       ttft_deadline_s=req.ttft_deadline_s,
+                       deadline_s=req.deadline_s)
         need_total = self._blocks_needed(len(prompt) + max_new_tokens)
         if (need_total > self.max_blocks_per_seq
                 or need_total > self._total_usable):
@@ -757,6 +786,10 @@ class PagedEngine:
                 break
             req.status = RequestStatus.RUNNING
             _res.M_ADMITTED.inc()
+            self._rt_event(req.rid, "admitted", slot=slot,
+                           prefix_tokens=prefix_len,
+                           tick=self._ticks,
+                           kv_blocks=len(self.slot_blocks[slot]))
             # stage the chunked prefill; compute happens in
             # _prefill_step under the scheduler's per-tick budget. The
             # prefix is LEFT-padded to a multiple of block_size — padded
@@ -792,6 +825,15 @@ class PagedEngine:
                     self.scheduler.note_deferred(sum(
                         st["n_chunks"] - st["next"]
                         for st in self._prefilling.values()))
+                    # the WHY of a slow TTFT: this tick's budget pushed
+                    # these requests' remaining chunks to a later tick
+                    for slot, st in self._prefilling.items():
+                        req = self.slots[slot]
+                        if req is not None:
+                            self._rt_event(
+                                req.rid, "prefill_deferred",
+                                tick=self._ticks,
+                                chunks_left=st["n_chunks"] - st["next"])
                     return
             tokens = np.zeros((self.max_batch, bs), np.int32)
             seq = np.zeros((self.max_batch,), np.int32)   # 0 = inactive
@@ -818,6 +860,15 @@ class PagedEngine:
             if quota is not None:
                 quota -= len(slots)
             now = self._clock()
+            for slot in slots:
+                # finalists' state entries are still live here — the
+                # chunk just computed is the one BEFORE the cursor
+                st = self._prefilling[slot]
+                req = self.slots[slot]
+                self._rt_event(req.rid, "prefill_chunk", t=now,
+                               chunk=st["next"] - 1,
+                               n_chunks=st["n_chunks"], tokens=bs,
+                               tick=self._ticks)
             for slot in finalists:
                 del self._prefilling[slot]
                 req = self.slots[slot]
@@ -830,14 +881,19 @@ class PagedEngine:
                 self._record_token(req, now)
                 self._maybe_finish(slot)
 
-    def _evict(self, slot: int):
+    def _evict(self, slot: int,
+               reason: str = "kv-block pressure (livelock preemption)"):
         """Preempt a running request: release its blocks and requeue it
         for later re-admission (its generated prefix re-prefills then —
         vLLM-style recompute preemption)."""
         req = self.slots[slot]
+        freed = len(self.slot_blocks[slot])
         self._release_slot(slot)
         req.status = RequestStatus.QUEUED
         _res.M_EVICTIONS.inc()
+        self._rt_event(req.rid, "preempted", victim_reason=reason,
+                       tick=self._ticks, kv_blocks_reclaimed=freed,
+                       tokens_so_far=len(req.generated))
         self.queue.append(req)
 
     def _release_slot(self, slot: int):
@@ -858,6 +914,11 @@ class PagedEngine:
         req.status = status
         req.detail = detail
         req.finish_t = self._clock()
+        self._rt_event(req.rid, "terminal", t=req.finish_t,
+                       outcome=status, detail=detail,
+                       tokens=len(req.generated))
+        self._slo.note(req.finish_t,
+                       good=(status == RequestStatus.FINISHED))
         _res.M_REQUESTS.inc(outcome=status)
         if status == RequestStatus.SHED:
             _res.M_SHED.inc()
@@ -873,13 +934,26 @@ class PagedEngine:
             self._done.append(req)
 
     def _record_token(self, req: Request, now: float):
-        """TTFT / inter-token latency bookkeeping for one new token."""
+        """TTFT / inter-token latency bookkeeping for one new token.
+        Exemplar linkage rides here: the worst TTFT/ITL samples keep
+        the request id, so a p99 regression resolves to a timeline."""
+        traced = _reqtrace.enabled()
         if req.first_token_t is None:
             req.first_token_t = now
             if req.submit_t is not None:
-                _res.M_TTFT.observe(now - req.submit_t)
+                ttft = now - req.submit_t
+                _res.M_TTFT.observe(ttft)
+                if traced:
+                    self._rt_event(req.rid, "first_token", t=now,
+                                   ttft_s=ttft)
+                    _reqtrace.EXEMPLARS.note(
+                        "ttft", self.lifecycle.name, req.rid, ttft, now)
         elif req.token_times:
-            _res.M_ITL.observe(now - req.token_times[-1])
+            itl = now - req.token_times[-1]
+            _res.M_ITL.observe(itl)
+            if traced:
+                _reqtrace.EXEMPLARS.note(
+                    "itl", self.lifecycle.name, req.rid, itl, now)
         req.token_times.append(now)
         buf = self._stream_bufs.get(req.rid)
         if buf is not None:
@@ -985,15 +1059,20 @@ class PagedEngine:
             wd.begin_work()
         self._ticks += 1
         t0 = time.perf_counter()
+        span_args = {"tick": self._ticks}
         try:
-            with trace.span("serving.tick", "serving",
-                            args={"tick": self._ticks}):
+            with trace.span("serving.tick", "serving", args=span_args):
                 try:
                     self._tick()
                     if self.lifecycle.state == ReplicaState.STARTING:
                         self.lifecycle.to(ReplicaState.READY, "serving")
                 except Exception as e:
                     self._on_tick_failure(e)
+                finally:
+                    # this tick's phase split rides its span (read at
+                    # span EXIT — end_tick resets the accumulator later)
+                    span_args.update(
+                        self.scheduler.tick_phase_seconds())
         finally:
             if wd is not None:
                 wd.end_work()
@@ -1101,6 +1180,8 @@ class PagedEngine:
             req.generated.append(int(nxt[i]))
             self.seq_lens[i] = int(seq[i])   # cached positions now
             self.last_token[i] = int(nxt[i])
+            self._rt_event(req.rid, "decode_tick", t=now,
+                           tick=self._ticks, new_tokens=1)
             self._record_token(req, now)
             self._maybe_finish(i)
 
@@ -1177,6 +1258,10 @@ class PagedEngine:
             ne = int(n_emit[i])
             proposed += int(max_accept[i])
             accepted += ne - 1
+            self._rt_event(req.rid, "spec_verify", t=now,
+                           tick=self._ticks,
+                           proposed=int(max_accept[i]),
+                           accepted=ne - 1, new_tokens=ne)
             for j in range(ne):
                 tok = int(emit[i, j])
                 req.generated.append(tok)
@@ -1323,7 +1408,8 @@ class PagedEngine:
         return TokenStream(
             rid, self.open_stream(rid), self.step,
             lambda: self.request_status(rid),
-            lambda s: s is None or s in TERMINAL_STATUSES)
+            lambda s: s is None or s in TERMINAL_STATUSES,
+            trace_hook=lambda ev, **meta: self._rt_event(rid, ev, **meta))
 
     def warmup(self, prompt_len: Optional[int] = None,
                max_new_tokens: int = 2) -> "PagedEngine":
@@ -1442,7 +1528,11 @@ class PagedEngine:
              "kv_bytes_per_token": self.kv_bytes_per_token,
              "ticks": self._ticks,
              "tick_failures": self.tick_failures,
-             "phase_share": self.scheduler.phase_share()}
+             "phase_share": self.scheduler.phase_share(),
+             # the probe path doubles as the burn-rate decay poll: an
+             # idle replica's windows age out here, so the gauges fall
+             # back to 0 after an incident instead of pinning high
+             "slo_burn_rate": self._slo.burn_rates(self._clock())}
         if self._spec is not None:
             h["spec_acceptance_rate"] = (
                 self.spec_accepted / self.spec_proposed

@@ -24,10 +24,12 @@ import os
 
 from . import metrics, trace
 from . import flight  # noqa: F401  (registers the flight-record exit dump)
+from . import reqtrace  # noqa: F401  (registers the reqtrace exit dump)
 from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
                       enabled, render_prometheus)
 
-__all__ = ["metrics", "trace", "flight", "REGISTRY", "MetricsRegistry",
+__all__ = ["metrics", "trace", "flight", "reqtrace", "REGISTRY",
+           "MetricsRegistry",
            "Counter", "Gauge", "Histogram", "enabled", "render_prometheus",
            "device_live_bytes", "snapshot", "to_prometheus"]
 

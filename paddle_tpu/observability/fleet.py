@@ -195,10 +195,30 @@ def replica_health() -> List[dict]:
 # --------------------------------------------------------------------------
 # Cross-rank snapshot
 # --------------------------------------------------------------------------
-def local_snapshot(trace_tail: int = 200) -> dict:
+def _truncate_timelines(timelines, max_timelines: int,
+                        max_events: int):
+    """Newest ``max_timelines`` live timelines, each keeping its FIRST
+    event (submitted — the anchor segment math needs) plus the newest
+    ``max_events - 1``; truncation is marked so consumers don't mistake
+    a clipped timeline for a complete one."""
+    out = []
+    for tl in timelines[-max_timelines:]:
+        evs = tl.get("events", [])
+        if len(evs) > max_events:
+            tl = dict(tl)
+            tl["events"] = [evs[0]] + evs[-(max_events - 1):]
+            tl["truncated"] = len(evs) - max_events
+        out.append(tl)
+    return out
+
+
+def local_snapshot(trace_tail: int = 200, reqtrace_tail: int = 20) -> dict:
     """This rank's contribution: metrics snapshot, span tail, flight
-    tail, beacon report, replica health, clock state."""
+    tail, request-timeline tail, beacon report, replica health, clock
+    state."""
     import socket
+
+    from . import reqtrace as _reqtrace
 
     rank, world = _rank_world()
     b = _beacon["b"]
@@ -211,6 +231,17 @@ def local_snapshot(trace_tail: int = 200) -> dict:
                   for name, cat, t0, t1, tid, args
                   in _trace.tail(trace_tail)],
         "flight": _flight.RECORDER.tail(50),
+        # newest terminal request timelines + whatever is mid-flight:
+        # the per-rank evidence the planned one-engine-per-host serving
+        # deployment needs to debug a request after the fact. Live
+        # timelines are capped like the tail AND event-truncated — a
+        # host mid-way through long generations must not ship MBs of
+        # decode_tick events through the cross-rank gather
+        "reqtrace": (_reqtrace.RECORDER.tail(reqtrace_tail)
+                     + _truncate_timelines(
+                         _reqtrace.RECORDER.live_timelines(),
+                         max_timelines=reqtrace_tail,
+                         max_events=100)),
         "beacon": (b.last_report if b is not None else None),
         "replicas": replica_health(),
         "clock": clock_state(),

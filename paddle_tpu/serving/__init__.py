@@ -18,6 +18,13 @@ turns one replica into an operable tier:
   retry on the next replica, re-routing of requests stranded by a
   degraded/drained replica, and load shedding AT THE ROUTER (replicas
   never see traffic the tier cannot absorb).
+
+Every layer stamps the request flight recorder
+(``observability/reqtrace.py``, README "Request tracing"): router
+route/retry/re-route/shed decisions, the engine's admission / chunk
+scheduling / decode ticks / preemptions, and stream delivery marks all
+land on one per-request timeline, so ``tools/request_trace.py`` can
+reconstruct any request's causal story across replicas after the fact.
 """
 from .router import Router, RouterConfig
 from .scheduler import Scheduler, SchedulerConfig

@@ -29,6 +29,7 @@ and a replica identically). Policy, in order:
 """
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -36,9 +37,14 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ..inference.resilience import (Overloaded, RequestOutcome,
                                     RequestStatus, TERMINAL_STATUSES)
 from ..observability import metrics as _metrics
+from ..observability import reqtrace as _reqtrace
 from .stream import TokenStream
 
 __all__ = ["RouterConfig", "Router"]
+
+#: default router-name ordinals (stable within one process, like the
+#: replica counter in inference/resilience.py)
+_ROUTER_COUNTER = itertools.count(0)
 
 
 M_ROUTER_ROUTED = _metrics.counter(
@@ -64,11 +70,17 @@ class RouterConfig:
     """``max_reroutes``: per-request bound on failure re-routes before
     the stranding outcome is surfaced to the client (defaults to the
     replica count). ``reroute_failed`` / ``reroute_drained``: which
-    stranding outcomes are retried."""
+    stranding outcomes are retried. The ``slo_*`` knobs feed the
+    tier-level ``paddle_tpu_serving_slo_{fast,slow}_burn_rate`` gauges
+    (scope = the router's name) — the client-visible SLO lives HERE,
+    where shedding happens, not per replica."""
 
     max_reroutes: Optional[int] = None
     reroute_failed: bool = True
     reroute_drained: bool = True
+    slo_target: float = 0.99
+    slo_fast_window_s: float = 60.0
+    slo_slow_window_s: float = 600.0
 
 
 @dataclass
@@ -104,12 +116,20 @@ class Router:
     """
 
     def __init__(self, replicas, *, config: Optional[RouterConfig] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 name: Optional[str] = None):
         if not replicas:
             raise ValueError("Router needs at least one replica")
         self.replicas = list(replicas)
         self.config = config or RouterConfig()
         self._clock = clock
+        #: stable reqtrace scope / SLO-gauge label for this tier
+        self.name = name if name is not None else \
+            f"router{next(_ROUTER_COUNTER)}"
+        self._slo = _reqtrace.SloTracker(
+            self.name, target=self.config.slo_target,
+            fast_window_s=self.config.slo_fast_window_s,
+            slow_window_s=self.config.slo_slow_window_s)
         self._rid = 0
         self._live: Dict[Tuple[int, int], _RoutedRequest] = {}
         self._by_rid: Dict[int, _RoutedRequest] = {}
@@ -137,6 +157,17 @@ class Router:
         mr = self.config.max_reroutes
         return len(self.replicas) if mr is None else mr
 
+    # ------------------------------------------------- request tracing
+    @property
+    def reqtrace_scope(self) -> str:
+        """Timeline scope tier-level events record under; replica legs
+        are joined through the ``routed`` events (reqtrace.stitch)."""
+        return self.name
+
+    def _rt_event(self, rid: int, event: str,
+                  t: Optional[float] = None, **meta):
+        _reqtrace.emit(self.name, self._clock, rid, event, t, **meta)
+
     # --------------------------------------------------------------- API
     def warmup(self) -> "Router":
         for rep in self.replicas:
@@ -158,7 +189,20 @@ class Router:
             top_p=top_p, ttft_deadline_s=ttft_deadline_s,
             deadline_s=deadline_s, submit_t=self._clock())
         self._by_rid[rr.rid] = rr
+        self._rt_event(rr.rid, "submitted", t=rr.submit_t,
+                       prompt_tokens=len(rr.prompt),
+                       max_new_tokens=max_new_tokens,
+                       ttft_deadline_s=ttft_deadline_s,
+                       deadline_s=deadline_s)
         if not self._try_submit(rr):
+            # the shed CAUSE gets a timestamped event of its own (not
+            # just the terminal outcome), so a shed storm's timelines
+            # say which rotation state refused the tier's traffic
+            ready = sum(1 for rep in self.replicas
+                        if rep.lifecycle.ready())
+            self._rt_event(rr.rid, "shed",
+                           ready_replicas=ready,
+                           replicas=len(self.replicas))
             self.shed_at_router += 1
             M_ROUTER_SHED.inc()
             self._finish(rr, RequestStatus.SHED,
@@ -171,6 +215,7 @@ class Router:
         False when every candidate refused."""
         remaining = rr.max_new_tokens - len(rr.tokens)
         prompt = rr.prompt + rr.tokens
+        bounced = 0
         for i in self._candidates():
             if i in exclude:
                 continue
@@ -183,12 +228,18 @@ class Router:
                     deadline_s=rr.deadline_s)
             except Overloaded:
                 M_ROUTER_RETRIES.inc()
+                bounced += 1
                 continue
             # submit-time terminal (never-fitting geometry): surface it
             # from this replica rather than looping the tier
             rr.replica_idx, rr.replica_rid = i, rrid
             self._live[(i, rrid)] = rr
             self.per_replica[i]["routed"] += 1
+            self._rt_event(rr.rid, "routed",
+                           replica=rep.lifecycle.name,
+                           replica_rid=rrid,
+                           tokens_carried=len(rr.tokens),
+                           overloaded_bounces=bounced)
             M_ROUTER_ROUTED.inc(replica=rep.lifecycle.name)
             if rr.stream_buf is not None:
                 rr._rep_buf = rep.open_stream(rrid)
@@ -267,6 +318,11 @@ class Router:
                 and len(rr.tokens) < rr.max_new_tokens):
             rr.reroutes += 1
             self.per_replica[replica_idx]["rerouted_away"] += 1
+            self._rt_event(
+                rr.rid, "rerouted",
+                from_replica=self.replicas[replica_idx].lifecycle.name,
+                stranding_outcome=oc.status, stranding_detail=oc.detail,
+                tokens_carried=len(rr.tokens), reroutes=rr.reroutes)
             M_ROUTER_REROUTED.inc()
             if self._try_submit(rr, exclude=(replica_idx,)):
                 return
@@ -290,10 +346,14 @@ class Router:
         return False
 
     def _finish(self, rr: _RoutedRequest, status: str, detail: str = ""):
+        finish_t = self._clock()
+        self._rt_event(rr.rid, "terminal", t=finish_t, outcome=status,
+                       detail=detail, tokens=len(rr.tokens))
+        self._slo.note(finish_t, good=(status == RequestStatus.FINISHED))
         self.outcomes[rr.rid] = RequestOutcome(
             rid=rr.rid, status=status, detail=detail,
             tokens=list(rr.tokens), submit_t=rr.submit_t,
-            first_token_t=rr.first_token_t, finish_t=self._clock(),
+            first_token_t=rr.first_token_t, finish_t=finish_t,
             token_times=list(rr.token_times))
         self._by_rid.pop(rr.rid, None)
 
@@ -340,7 +400,8 @@ class Router:
                 buf = list(oc.tokens)
         return TokenStream(
             rid, buf, self.step, lambda: self.request_status(rid),
-            lambda s: s in TERMINAL_STATUSES)
+            lambda s: s in TERMINAL_STATUSES,
+            trace_hook=lambda ev, **meta: self._rt_event(rid, ev, **meta))
 
     def drain(self) -> Dict[int, List[int]]:
         """Drain every replica and settle all remaining outcomes."""
@@ -370,6 +431,8 @@ class Router:
             "queue_depth": sum(h["queue_depth"] for h in reps),
             "active": sum(h["active"] for h in reps),
             "shed_at_router": self.shed_at_router,
+            # probe-path burn-rate decay poll (see PagedEngine.health)
+            "slo_burn_rate": self._slo.burn_rates(self._clock()),
             "per_replica": reps,
         }
 

@@ -119,6 +119,14 @@ class Scheduler:
             self.deferred_chunks += chunks
             M_PREFILL_DEFERRED.inc(chunks)
 
+    def tick_phase_seconds(self) -> dict:
+        """The CURRENT tick's accumulated per-phase device seconds
+        (before ``end_tick`` folds them into the window). The engine
+        stamps this onto its ``serving.tick`` span, so the chrome view
+        ``tools/request_trace.py`` merges shows each tick's
+        prefill/decode split next to the request lanes."""
+        return dict(self._tick_s)
+
     # -------------------------------------------------------- accounting
     def note_phase(self, phase: str, tokens: int, seconds: float):
         """One compiled program ran: ``tokens`` scheduled positions in

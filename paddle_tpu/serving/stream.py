@@ -33,12 +33,19 @@ class TokenStream:
       is_terminal: predicate over status strings.
       max_pumps: backstop on consecutive dry pumps between tokens — a
         wedged producer must fail the stream, not hang the client.
+      trace_hook: optional request-trace seam (the producer binds its
+        reqtrace scope/clock): called as ``hook(event, **meta)`` with
+        ``first_delivery`` when the first token reaches the client and
+        ``stream_closed`` at termination — the delivery half of the
+        request timeline (tokens can sit generated-but-unread when a
+        client attaches late or reads slowly).
     """
 
     def __init__(self, rid: int, buf: List[int], pump: Callable[[], object],
                  status_fn: Callable[[], Optional[str]],
                  is_terminal: Callable[[Optional[str]], bool],
-                 max_pumps: int = 10_000):
+                 max_pumps: int = 10_000,
+                 trace_hook: Optional[Callable[..., None]] = None):
         self.rid = rid
         self.status: Optional[str] = None
         self._buf = buf
@@ -48,6 +55,7 @@ class TokenStream:
         self._max_pumps = max_pumps
         self._read = 0
         self._final_pump_done = False
+        self._trace = trace_hook
 
     def __iter__(self) -> "TokenStream":
         return self
@@ -58,6 +66,9 @@ class TokenStream:
             if self._read < len(self._buf):
                 tok = self._buf[self._read]
                 self._read += 1
+                if self._read == 1 and self._trace is not None:
+                    self._trace("first_delivery",
+                                buffered=len(self._buf))
                 return tok
             status = self._status_fn()
             if status is None or self._is_terminal(status):
@@ -71,9 +82,23 @@ class TokenStream:
                 if self._read < len(self._buf):
                     continue
                 self.status = self._status_fn() or status
+                if self._trace is not None:
+                    self._trace("stream_closed", status=self.status,
+                                delivered=self._read)
+                    self._trace = None      # close exactly once
                 raise StopIteration
             pumps += 1
             if pumps > self._max_pumps:
+                # close the timeline's delivery half BEFORE raising: a
+                # wedged producer is exactly the failure the request
+                # flight recorder exists to diagnose, and an open-ended
+                # stream mark would read as a client that walked away
+                if self._trace is not None:
+                    self._trace("stream_closed", status=status,
+                                delivered=self._read,
+                                error=f"no progress in "
+                                      f"{self._max_pumps} pumps")
+                    self._trace = None
                 raise RuntimeError(
                     f"stream for request {self.rid} made no progress in "
                     f"{self._max_pumps} ticks (status {status})")

@@ -622,7 +622,8 @@ SKIP = {
        for n in ("inv cholesky_inverse matrix_exp vector_norm "
                  "matrix_norm cond svd_lowrank ormqr").split()},
     # op-surface tail without a sweepable contract
-    "histogramdd": "host-side np.histogramdd; covered in test_api_tail",
+    "histogramdd": "multi-output (hist, edges-list) contract; "
+                   "numpy-parity tested in test_api_tail",
     "as_strided": "gather-based strided view; covered in test_api_tail",
     "combinations": "index enumeration; covered in test_api_tail",
     "frexp": "dual-output decomposition; covered in test_api_tail",

@@ -565,8 +565,9 @@ class TestPerfGate:
         run it was frozen from. Rungs added to the baseline AFTER the
         r05 freeze (fleet_observability round 14, fusion round 15,
         planner_vs_manual round 16, async_overlap + async_batch_sweep
-        round 17, serving_router round 18) are absent from the archived
-        run — they may be missing, but nothing may fail."""
+        round 17, serving_router round 18, serving_reqtrace round 19)
+        are absent from the archived run — they may be missing, but
+        nothing may fail."""
         with open(os.path.join(REPO, "tools", "perf_baseline.json")) as f:
             base = json.load(f)
         assert base["format"] == "paddle_tpu.perf_baseline/1"
@@ -590,12 +591,14 @@ class TestPerfGate:
         assert "async_batch_sweep_tokens_ratio" in base["rungs"]
         missing = {c["metric"] for c in res["checks"]
                    if c["status"] == "missing"}
+        assert "serving_reqtrace_overhead_ratio" in base["rungs"]
         assert missing <= {"fleet_observability_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",
                            "async_overlap_step_ratio",
                            "async_batch_sweep_tokens_ratio",
-                           "serving_router_goodput_scaling"}
+                           "serving_router_goodput_scaling",
+                           "serving_reqtrace_overhead_ratio"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"

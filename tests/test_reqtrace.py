@@ -1,0 +1,833 @@
+"""Request-level serving observability (round 19).
+
+Covers paddle_tpu/observability/reqtrace.py + the instrumentation seams
+in inference/serving.py, serving/router.py and serving/stream.py, the
+tools/request_trace.py renderer, loadgen's exemplar/trace-out riders,
+and the persistence/fleet-carry paths:
+
+* recorder semantics (bounded rings, post-terminal stream marks,
+  per-timeline event caps);
+* exact wall-segment decomposition + completeness validation + router
+  stitching;
+* the FLAGS_reqtrace disabled path reads ZERO clocks (round-8 metrics
+  gate discipline, deterministic);
+* SLO multiwindow burn-rate gauges from the ResilienceConfig knobs;
+* TTFT/ITL exemplar linkage (worst-k samples keep their request id);
+* the fault-drill matrix: under serving.tick_stall,
+  serving.crash_at_tick, deadline expiry, preemption and mid-flight
+  re-route, EVERY terminal request's timeline is complete (terminal
+  present, segments sum to total, no unclosed events) — FakeClock
+  seams from round 11;
+* the acceptance scenario: one request chunk-prefilled, preempted AND
+  re-routed across replicas, reconstructed as a causal timeline whose
+  segments sum to its total wall time, merged with the engine's device
+  spans on one clock.
+"""
+import io
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.fault import inject
+from paddle_tpu.inference import PagedEngine, ReplicaState, ResilienceConfig
+from paddle_tpu.inference.resilience import (RequestStatus,
+                                             TERMINAL_STATUSES)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import REGISTRY, reqtrace
+from paddle_tpu.observability import trace as otrace
+from paddle_tpu.serving import Router
+from tools import request_trace as rt_tool
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = LlamaConfig(vocab_size=97, hidden_size=64, intermediate_size=128,
+                      num_layers=2, num_heads=4, max_seq_len=256,
+                      use_flash_attention=False)
+    return LlamaForCausalLM(cfg)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    inject.disarm_all()
+    reqtrace.RECORDER.clear()
+    reqtrace.EXEMPLARS.clear()
+    paddle.set_flags({"FLAGS_reqtrace": True})
+    yield
+    inject.disarm_all()
+    reqtrace.RECORDER.clear()
+    reqtrace.EXEMPLARS.clear()
+    paddle.set_flags({"FLAGS_reqtrace": True,
+                      "FLAGS_enable_metrics": False})
+
+
+def make_engine(model, *, max_batch=2, block_size=4, num_blocks=32,
+                max_blocks_per_seq=16, **res_kw):
+    res = ResilienceConfig(**res_kw) if res_kw else None
+    return PagedEngine(model, max_batch=max_batch, block_size=block_size,
+                       num_blocks=num_blocks,
+                       max_blocks_per_seq=max_blocks_per_seq,
+                       resilience=res)
+
+
+def prompt(seed, n=5):
+    rng = np.random.RandomState(seed)
+    return [int(t) for t in rng.randint(1, 97, size=n)]
+
+
+class FakeClock:
+    """Deterministic clock seam (engine + lifecycle), counting reads."""
+
+    def __init__(self):
+        self.t = 0.0
+        self.reads = 0
+
+    def __call__(self):
+        self.reads += 1
+        return self.t
+
+    def install(self, eng):
+        eng._clock = self
+        eng.lifecycle._clock = self
+        return self
+
+
+def assert_complete(tl):
+    problems = reqtrace.validate(tl)
+    assert problems == [], (tl["scope"], tl["rid"], problems)
+    seg = reqtrace.segments(tl)
+    covered = sum(seg[b] for b in reqtrace.SEGMENT_BUCKETS)
+    assert abs(covered - seg["total"]) <= 1e-6 + 1e-9 * abs(seg["total"])
+    assert seg["complete"]
+    return seg
+
+
+def engine_timelines(eng, rids):
+    out = {}
+    for r in rids:
+        tl = reqtrace.RECORDER.timeline(eng.reqtrace_scope, r)
+        assert tl is not None and tl["events"], f"rid {r}: no timeline"
+        out[r] = tl
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Recorder unit semantics
+# ---------------------------------------------------------------------------
+class TestRecorder:
+    def test_terminal_moves_live_to_ring(self):
+        rec = reqtrace.RequestTraceRecorder(retain=4)
+        rec.event("s", 1, "submitted", 0.0)
+        assert rec.live_timelines() and not rec.tail()
+        rec.event("s", 1, "terminal", 1.0, {"outcome": "FINISHED"})
+        assert not rec.live_timelines()
+        tail = rec.tail()
+        assert len(tail) == 1 and tail[0]["rid"] == 1
+        assert rec.timeline("s", 1)["events"][-1]["event"] == "terminal"
+
+    def test_ring_bounded_evicts_oldest(self):
+        rec = reqtrace.RequestTraceRecorder(retain=3)
+        for rid in range(6):
+            rec.event("s", rid, "submitted", float(rid))
+            rec.event("s", rid, "terminal", rid + 0.5)
+        tail = rec.tail()
+        assert [t["rid"] for t in tail] == [3, 4, 5]
+        assert rec.timeline("s", 0) is None
+        assert rec.evicted == 3
+
+    def test_post_terminal_stream_marks_attach_to_done(self):
+        rec = reqtrace.RequestTraceRecorder()
+        rec.event("s", 7, "submitted", 0.0)
+        rec.event("s", 7, "terminal", 1.0, {"outcome": "FINISHED"})
+        rec.event("s", 7, "stream_closed", 2.0, {"status": "FINISHED"})
+        tl = rec.timeline("s", 7)
+        assert tl["events"][-1]["event"] == "stream_closed"
+        # a NON-stream event after terminal must not reopen a timeline
+        rec.event("s", 7, "decode_tick", 3.0)
+        assert not rec.live_timelines()
+        assert reqtrace.validate(tl) == []
+        # a stream mark for an UNKNOWN/evicted request must not open a
+        # ghost timeline that never closes
+        rec.event("s", 99, "stream_closed", 4.0)
+        assert not rec.live_timelines()
+        assert rec.timeline("s", 99) is None
+
+    def test_delivery_marks_are_singular_per_request(self):
+        """Re-attaching a second stream must not restamp
+        first_delivery/stream_closed with later timestamps."""
+        rec = reqtrace.RequestTraceRecorder()
+        rec.event("s", 1, "submitted", 0.0)
+        rec.event("s", 1, "first_delivery", 0.5)
+        rec.event("s", 1, "first_delivery", 0.7)      # duplicate: drop
+        rec.event("s", 1, "terminal", 1.0, {"outcome": "FINISHED"})
+        rec.event("s", 1, "stream_closed", 1.5)
+        rec.event("s", 1, "stream_closed", 2.0)       # duplicate: drop
+        evs = rec.timeline("s", 1)["events"]
+        assert [e["event"] for e in evs].count("first_delivery") == 1
+        assert [e["event"] for e in evs].count("stream_closed") == 1
+        assert next(e["t"] for e in evs
+                    if e["event"] == "first_delivery") == 0.5
+
+    def test_done_event_budget_stays_honest_under_stream_marks(self):
+        """Post-terminal stream marks count toward the retained-events
+        budget, so eviction (which subtracts FULL timeline lengths)
+        cannot drift the counter negative and unbind the memory cap."""
+        rec = reqtrace.RequestTraceRecorder(retain=2)
+        for rid in range(5):
+            rec.event("s", rid, "submitted", float(rid))
+            rec.event("s", rid, "terminal", rid + 0.25,
+                      {"outcome": "FINISHED"})
+            rec.event("s", rid, "stream_closed", rid + 0.5)
+        assert rec._done_events == sum(len(t["events"])
+                                       for t in rec.tail())
+        assert rec._done_events == 6          # 2 retained x 3 events
+
+    def test_per_timeline_event_cap_counts_drops(self):
+        rec = reqtrace.RequestTraceRecorder(max_events=4)
+        rec.event("s", 1, "submitted", 0.0)
+        for i in range(10):
+            rec.event("s", 1, "decode_tick", float(i + 1))
+        tl = rec.live_timelines()[0]
+        assert len(tl["events"]) == 4 and tl["dropped"] == 7
+        assert "dropped" in " ".join(reqtrace.validate(tl))
+
+
+# ---------------------------------------------------------------------------
+# Segment decomposition + validation + stitching (synthetic timelines)
+# ---------------------------------------------------------------------------
+def _tl(events, scope="s", rid=1):
+    return {"scope": scope, "rid": rid,
+            "events": [{"event": e, "t": t, **({"meta": m} if m else {})}
+                       for e, t, m in events]}
+
+
+class TestSegments:
+    def test_exact_decomposition(self):
+        tl = _tl([("submitted", 0.0, None), ("admitted", 2.0, None),
+                  ("prefill_chunk", 3.0, None), ("first_token", 5.0, None),
+                  ("decode_tick", 6.0, None),
+                  ("preempted", 7.0, None), ("admitted", 9.0, None),
+                  ("decode_tick", 10.0, None),
+                  ("terminal", 11.0, {"outcome": "FINISHED"})])
+        seg = assert_complete(tl)
+        assert seg["queue"] == 2.0
+        assert seg["prefill"] == 3.0 + 1.0   # admitted→first_token + re-prefill
+        assert seg["decode"] == 1.0 + 1.0 + 1.0
+        assert seg["preempted"] == 2.0
+        assert seg["total"] == 11.0
+
+    def test_incomplete_timeline_flagged(self):
+        tl = _tl([("submitted", 0.0, None), ("admitted", 1.0, None)])
+        seg = reqtrace.segments(tl)
+        assert not seg["complete"]
+        assert any("terminal" in p for p in reqtrace.validate(tl))
+
+    def test_validate_catches_bad_start_and_order(self):
+        tl = _tl([("admitted", 0.0, None),
+                  ("terminal", 1.0, {"outcome": "FINISHED"})])
+        assert any("submitted" in p for p in reqtrace.validate(tl))
+        tl2 = _tl([("submitted", 5.0, None), ("admitted", 1.0, None),
+                   ("terminal", 6.0, {"outcome": "FINISHED"})])
+        assert any("non-monotonic" in p for p in reqtrace.validate(tl2))
+
+    def test_stitched_stranding_bills_rerouted(self):
+        router = _tl([("submitted", 0.0, None),
+                      ("routed", 0.5, {"replica": "r0", "replica_rid": 3}),
+                      ("rerouted", 4.0, {"from_replica": "r0"}),
+                      ("routed", 4.0, {"replica": "r1", "replica_rid": 9}),
+                      ("terminal", 10.0, {"outcome": "FINISHED"})],
+                     scope="router")
+        legs = {
+            ("r0", 3): _tl([("submitted", 0.5, None),
+                            ("admitted", 1.0, None),
+                            ("first_token", 2.0, None),
+                            ("terminal", 3.0, {"outcome": "FAILED"})],
+                           "r0", 3),
+            ("r1", 9): _tl([("submitted", 4.0, None),
+                            ("admitted", 5.0, None),
+                            ("first_token", 6.0, None),
+                            ("decode_tick", 9.0, None),
+                            ("terminal", 10.0, {"outcome": "FINISHED"})],
+                           "r1", 9),
+        }
+        st = reqtrace.stitch(router, lookup=lambda s, r: legs.get((s, r)))
+        assert st["stitched"]
+        seg = assert_complete(st)
+        # r0 FAILED@3 → rerouted until the re-route lands at 4.0; the
+        # 4.0→5.0 wait for r1's admission bills to queue again
+        assert seg["rerouted"] == pytest.approx(1.0)
+        assert seg["queue"] == pytest.approx(2.0)
+        assert seg["total"] == pytest.approx(10.0)
+
+    def test_intervals_tile_without_gaps(self):
+        tl = _tl([("submitted", 0.0, None), ("admitted", 1.0, None),
+                  ("first_token", 2.5, None),
+                  ("terminal", 4.0, {"outcome": "FINISHED"})])
+        iv, complete = reqtrace.segment_intervals(tl)
+        assert complete
+        assert iv[0][1] == 0.0 and iv[-1][2] == 4.0
+        for (s1, a1, b1), (s2, a2, b2) in zip(iv, iv[1:]):
+            assert b1 == a2          # no gaps, no overlaps
+
+
+# ---------------------------------------------------------------------------
+# Disabled path: zero clock reads, zero recordings (round-8 proof)
+# ---------------------------------------------------------------------------
+class TestZeroCostWhenOff:
+    def test_module_record_never_reads_clock_when_off(self, monkeypatch):
+        calls = {"n": 0}
+        real = reqtrace._now
+
+        def counting():
+            calls["n"] += 1
+            return real()
+
+        monkeypatch.setattr(reqtrace, "_now", counting)
+        paddle.set_flags({"FLAGS_reqtrace": False})
+        reqtrace.record("s", 1, "submitted")
+        assert calls["n"] == 0
+        assert not reqtrace.RECORDER.live_timelines()
+        paddle.set_flags({"FLAGS_reqtrace": True})
+        reqtrace.record("s", 1, "submitted")
+        assert calls["n"] == 1
+
+    def test_engine_off_records_nothing_and_reads_fewer_clocks(
+            self, model):
+        def run_once():
+            eng = make_engine(model)
+            clock = FakeClock().install(eng)
+            rids = [eng.add_request(prompt(i, 6), max_new_tokens=4)
+                    for i in range(2)]
+            eng.run_to_completion()
+            return eng, clock.reads, rids
+
+        paddle.set_flags({"FLAGS_reqtrace": False})
+        _eng, reads_off, _ = run_once()
+        assert not reqtrace.RECORDER.tail(), \
+            "flag off must record no timelines"
+        reads_off2 = run_once()[1]
+        assert reads_off == reads_off2, "off-path must be deterministic"
+        paddle.set_flags({"FLAGS_reqtrace": True})
+        eng_on, reads_on, rids = run_once()
+        # the instrumentation's own clock reads exist ONLY when on
+        assert reads_on > reads_off
+        engine_timelines(eng_on, rids)
+
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate accounting
+# ---------------------------------------------------------------------------
+class TestSloBurnRate:
+    def test_tracker_multiwindow_math(self):
+        tr = reqtrace.SloTracker("s", target=0.99, fast_window_s=10.0,
+                                 slow_window_s=100.0)
+        for t in range(8):
+            tr.note(float(t), good=True)
+        tr.note(8.0, good=False)
+        tr.note(9.0, good=False)
+        r = tr.burn_rates()
+        # 2 bad of 10 in both windows: 0.2 / 0.01 = 20x budget burn
+        assert r["fast"] == pytest.approx(20.0)
+        assert r["slow"] == pytest.approx(20.0)
+        # 30s later the fast window is empty, slow still sees 2/10
+        r2 = tr.burn_rates(now=40.0)
+        assert r2["fast"] == 0.0
+        assert r2["slow"] == pytest.approx(20.0)
+        # 200s later both windows aged out
+        r3 = tr.burn_rates(now=200.0)
+        assert r3 == {"fast": 0.0, "slow": 0.0}
+
+    def test_tracker_validates_knobs(self):
+        with pytest.raises(ValueError):
+            reqtrace.SloTracker("s", target=1.5)
+        with pytest.raises(ValueError):
+            reqtrace.SloTracker("s", fast_window_s=100.0,
+                                slow_window_s=10.0)
+        with pytest.raises(ValueError):
+            ResilienceConfig(slo_target=0.0)
+        with pytest.raises(ValueError):
+            ResilienceConfig(slo_fast_window_s=0.0)
+
+    def test_engine_burn_gauges_from_deadline_misses(self, model):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        eng = make_engine(model, slo_target=0.9)
+        clock = FakeClock().install(eng)
+        eng._slo = reqtrace.SloTracker(eng.lifecycle.name, target=0.9,
+                                       fast_window_s=60.0,
+                                       slow_window_s=600.0)
+        ok = eng.add_request(prompt(1, 4), max_new_tokens=2)
+        eng.run_to_completion()
+        bad = eng.add_request(prompt(2, 4), max_new_tokens=2,
+                              ttft_deadline_s=0.5)
+        clock.t = 10.0                       # expire it in the queue
+        eng.step()
+        assert eng.outcomes[bad].status == RequestStatus.DEADLINE_MISSED
+        g = REGISTRY.get("paddle_tpu_serving_slo_fast_burn_rate")
+        # 1 bad of 2 outcomes / 0.1 budget = 5x burn
+        assert g.value(scope=eng.lifecycle.name) == pytest.approx(5.0)
+        assert eng.outcomes[ok].status == RequestStatus.FINISHED
+
+    def test_burn_gauges_decay_on_health_poll(self, model):
+        """An idle-after-incident replica must not pin the alert level:
+        the probe path prunes the windows and re-exports the gauges."""
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        eng = make_engine(model)
+        clock = FakeClock().install(eng)
+        eng._slo = reqtrace.SloTracker(eng.lifecycle.name,
+                                       fast_window_s=10.0,
+                                       slow_window_s=20.0)
+        eng._slo.note(1.0, good=False)
+        g = REGISTRY.get("paddle_tpu_serving_slo_fast_burn_rate")
+        assert g.value(scope=eng.lifecycle.name) > 0
+        clock.t = 100.0                      # both windows aged out
+        h = eng.health()
+        assert h["slo_burn_rate"] == {"fast": 0.0, "slow": 0.0}
+        assert g.value(scope=eng.lifecycle.name) == 0.0
+
+    def test_router_burn_gauges_on_shed(self, model):
+        paddle.set_flags({"FLAGS_enable_metrics": True})
+        rep = make_engine(model, max_queue=1)
+        router = Router([rep])           # replica STARTING≠READY: sheds
+        rid = router.add_request(prompt(3, 4))
+        assert router.outcomes[rid].status == RequestStatus.SHED
+        g = REGISTRY.get("paddle_tpu_serving_slo_fast_burn_rate")
+        assert g.value(scope=router.name) == pytest.approx(100.0)
+
+
+# ---------------------------------------------------------------------------
+# Exemplars
+# ---------------------------------------------------------------------------
+class TestExemplars:
+    def test_store_keeps_topk_with_identity(self):
+        st = reqtrace.ExemplarStore(k=3)
+        for rid, v in enumerate([0.1, 0.9, 0.2, 0.8, 0.3, 0.05]):
+            st.note("ttft", "s", rid, v, t=float(rid))
+        worst = st.worst("ttft")
+        assert [w["rid"] for w in worst] == [1, 3, 4]
+        assert worst[0]["value"] == pytest.approx(0.9)
+
+    def test_engine_populates_ttft_exemplars(self, model):
+        eng = make_engine(model)
+        rids = [eng.add_request(prompt(i, 6), max_new_tokens=3)
+                for i in range(3)]
+        eng.run_to_completion()
+        worst = reqtrace.EXEMPLARS.worst("ttft")
+        assert worst, "no TTFT exemplars recorded"
+        assert {w["rid"] for w in worst} <= set(rids)
+        assert all(w["scope"] == eng.reqtrace_scope for w in worst)
+        # the exemplar's timeline is retrievable — the whole point
+        tl = reqtrace.RECORDER.timeline(worst[0]["scope"],
+                                        worst[0]["rid"])
+        assert tl is not None and assert_complete(tl)
+
+
+# ---------------------------------------------------------------------------
+# Fault-drill matrix: every terminal request's timeline is complete
+# ---------------------------------------------------------------------------
+class TestDrillMatrix:
+    def test_clean_run_timelines_complete(self, model):
+        eng = make_engine(model)
+        rids = [eng.add_request(prompt(i, 6), max_new_tokens=4)
+                for i in range(3)]
+        eng.run_to_completion()
+        for rid, tl in engine_timelines(eng, rids).items():
+            seg = assert_complete(tl)
+            events = [e["event"] for e in tl["events"]]
+            assert events[0] == "submitted" and "admitted" in events
+            assert "first_token" in events and "prefill_chunk" in events
+            assert seg["total"] > 0
+
+    def test_tick_stall_timelines_complete(self, model):
+        eng = make_engine(model)
+        rids = [eng.add_request(prompt(i, 5), max_new_tokens=3)
+                for i in range(2)]
+        with inject.armed("serving.tick_stall", times=2, seconds=0.02):
+            eng.run_to_completion()
+        for tl in engine_timelines(eng, rids).values():
+            assert_complete(tl)
+
+    def test_crash_at_tick_failed_timelines_complete(self, model):
+        eng = make_engine(model)
+        r1 = eng.add_request(prompt(11, 5), max_new_tokens=8)
+        eng.step()
+        with inject.armed("serving.crash_at_tick", tick=eng._ticks + 1):
+            eng.step()
+        assert eng.outcomes[r1].status == RequestStatus.FAILED
+        tl = engine_timelines(eng, [r1])[r1]
+        assert_complete(tl)
+        term = tl["events"][-1]
+        assert term["meta"]["outcome"] == RequestStatus.FAILED
+        assert "tick" in term["meta"]["detail"]
+
+    def test_deadline_expiry_queued_and_midflight(self, model):
+        eng = make_engine(model, max_batch=1)
+        clock = FakeClock().install(eng)
+        running = eng.add_request(prompt(20, 4), max_new_tokens=50,
+                                  deadline_s=5.0)
+        queued = eng.add_request(prompt(21, 4), max_new_tokens=4,
+                                 ttft_deadline_s=2.0)
+        eng.step()                           # admits `running` only
+        clock.t = 10.0                       # expires both
+        eng.step()
+        for rid in (running, queued):
+            assert eng.outcomes[rid].status == \
+                RequestStatus.DEADLINE_MISSED
+        tls = engine_timelines(eng, [running, queued])
+        seg_r = assert_complete(tls[running])
+        seg_q = assert_complete(tls[queued])
+        assert seg_r["total"] == pytest.approx(10.0)
+        # the queued request never left the queue: all wall = queue
+        assert seg_q["queue"] == pytest.approx(seg_q["total"])
+
+    def test_preemption_timeline_records_victim_and_completes(
+            self, model):
+        eng = make_engine(model, max_batch=2, num_blocks=5,
+                          max_blocks_per_seq=4)
+        r1 = eng.add_request(prompt(33, 4), max_new_tokens=6)
+        r2 = eng.add_request(prompt(34, 4), max_new_tokens=6,
+                             deadline_s=3600.0)
+        out = eng.run_to_completion(max_ticks=300)
+        assert len(out[r1]) == 6 and len(out[r2]) == 6
+        tls = engine_timelines(eng, [r1, r2])
+        seg1 = assert_complete(tls[r1])
+        assert_complete(tls[r2])
+        ev1 = [e["event"] for e in tls[r1]["events"]]
+        # r1 (most slack) was the livelock victim; after preemption it
+        # re-admits and re-prefills — both visible in the timeline
+        assert "preempted" in ev1
+        pre = next(e for e in tls[r1]["events"]
+                   if e["event"] == "preempted")
+        assert "victim_reason" in pre["meta"]
+        assert ev1.index("preempted") < len(ev1) - 1
+        assert ev1.count("admitted") >= 2
+        assert seg1["preempted"] >= 0.0
+
+    def test_shed_and_overload_timelines_complete(self, model):
+        eng = make_engine(model, max_batch=1, max_queue=8,
+                          queue_high_water=2)
+        rids = [eng.add_request(prompt(40 + i, 4), max_new_tokens=3)
+                for i in range(6)]
+        eng.run_to_completion()
+        shed = [r for r in rids
+                if eng.outcomes[r].status == RequestStatus.SHED]
+        assert shed, "high-water shedding did not trigger"
+        for tl in engine_timelines(eng, rids).values():
+            assert_complete(tl)
+
+    def test_midflight_reroute_stitched_complete(self, model):
+        reps = [make_engine(model) for _ in range(2)]
+        router = Router(reps).warmup()
+        rid = router.add_request(prompt(50, 6), max_new_tokens=10)
+        for _ in range(3):
+            router.step()
+        victim = router._by_rid[rid].replica_idx
+        with inject.armed("serving.crash_at_tick",
+                          tick=reps[victim]._ticks + 1):
+            router.step()
+        out = router.run_to_completion()
+        assert len(out[rid]) == 10
+        tl = reqtrace.RECORDER.timeline(router.name, rid)
+        events = [e["event"] for e in tl["events"]]
+        assert events.count("routed") == 2 and "rerouted" in events
+        st = reqtrace.stitch(tl)
+        seg = assert_complete(st)
+        assert seg["rerouted"] > 0
+        re = next(e for e in tl["events"] if e["event"] == "rerouted")
+        assert re["meta"]["from_replica"] == \
+            reps[victim].lifecycle.name
+        assert re["meta"]["stranding_outcome"] == RequestStatus.FAILED
+
+
+# ---------------------------------------------------------------------------
+# Loadgen riders: every outcome has a timeline; p99 exemplar decomposition
+# ---------------------------------------------------------------------------
+class TestLoadgenIntegration:
+    def test_every_outcome_has_nonempty_timeline_incl_router_shed(
+            self, model):
+        """Satellite bugfix regression: router-level SHED requests must
+        appear in the reqtrace ring with a timestamped cause — a shed
+        storm is diagnosable per request, not just countable."""
+        from tools.loadgen import run_load
+
+        rep = make_engine(model, max_batch=2, max_queue=2)
+        router = Router([rep]).warmup()
+        report = run_load(router, offered_rps=10_000.0, n_requests=16,
+                          max_new_tokens=3, seed=3)
+        assert report["shed"] > 0, "overload did not shed at the router"
+        n_shed_events = 0
+        for rid in range(1, report["submitted"] + 1):
+            tl = reqtrace.RECORDER.timeline(router.name, rid)
+            assert tl is not None and tl["events"], \
+                f"router rid {rid} has no timeline"
+            assert_complete(tl)
+            events = [e["event"] for e in tl["events"]]
+            term = tl["events"][-1] if events[-1] == "terminal" else None
+            if term and term["meta"]["outcome"] == RequestStatus.SHED:
+                assert "shed" in events, "SHED outcome lacks cause event"
+                n_shed_events += 1
+        assert n_shed_events == report["shed"]
+
+    def test_report_carries_p99_exemplar_decomposition(self, model):
+        from tools.loadgen import run_load
+
+        eng = make_engine(model, max_batch=2).warmup()
+        report = run_load(eng, offered_rps=200.0, n_requests=8,
+                          max_new_tokens=3, seed=1)
+        ex = report["p99_ttft_exemplar"]
+        assert ex is not None and ex["complete"]
+        segs = ex["segments_s"]
+        assert set(segs) == set(reqtrace.SEGMENT_BUCKETS)
+        assert sum(segs.values()) == pytest.approx(ex["total_s"],
+                                                   abs=1e-5)
+
+    def test_trace_out_exports_chrome_and_raw(self, model, tmp_path):
+        from tools.loadgen import run_load
+
+        eng = make_engine(model, max_batch=2).warmup()
+        prefix = str(tmp_path / "pt" / "rate_8")
+        run_load(eng, offered_rps=50.0, n_requests=6, max_new_tokens=3,
+                 seed=2, trace_out=prefix, trace_worst_k=3)
+        with open(prefix + ".trace.json") as f:
+            tracef = json.load(f)
+        names = {e["name"] for e in tracef["traceEvents"]}
+        assert {"queue", "prefill", "decode"} & names
+        assert "serving.prefill" in names or "serving.decode" in names
+        with open(prefix + ".reqtrace.json") as f:
+            raw = json.load(f)
+        assert raw["format"] == "paddle_tpu.reqtrace/1"
+        assert 0 < len(raw["timelines"]) <= 3
+
+
+# ---------------------------------------------------------------------------
+# Streams: delivery marks ride the timeline post-terminal
+# ---------------------------------------------------------------------------
+class TestStreamMarks:
+    def test_stream_records_delivery_and_close(self, model):
+        eng = make_engine(model)
+        rid = eng.add_request(prompt(60, 5), max_new_tokens=4)
+        toks = list(eng.stream(rid))
+        assert len(toks) == 4
+        tl = reqtrace.RECORDER.timeline(eng.reqtrace_scope, rid)
+        events = [e["event"] for e in tl["events"]]
+        assert "first_delivery" in events
+        assert events[-1] == "stream_closed"
+        closed = tl["events"][-1]
+        assert closed["meta"]["status"] == RequestStatus.FINISHED
+        assert closed["meta"]["delivered"] == 4
+        # stream marks do not break completeness validation
+        assert_complete(tl)
+
+
+# ---------------------------------------------------------------------------
+# Persistence, fleet carry, watchdog hang path
+# ---------------------------------------------------------------------------
+class TestPersistence:
+    def test_dump_and_load_roundtrip(self, model, tmp_path, monkeypatch):
+        eng = make_engine(model)
+        rid = eng.add_request(prompt(70, 5), max_new_tokens=3)
+        eng.run_to_completion()
+        live = eng.add_request(prompt(71, 5), max_new_tokens=50)
+        eng.step()                      # leave one request mid-flight
+        base = str(tmp_path / "reqtrace.json")
+        monkeypatch.setenv(reqtrace.RECORD_ENV, base)
+        path = reqtrace.dump(reason="test")
+        assert path == base + ".r0" and os.path.exists(path)
+        payload = reqtrace.load_dump(path)
+        assert payload["reason"] == "test"
+        by_key = {(t["scope"], t["rid"]): t
+                  for t in payload["timelines"]}
+        scope = eng.reqtrace_scope
+        assert (scope, rid) in by_key
+        assert by_key[(scope, live)].get("open") is True
+        assert "ttft" in payload["exemplars"]
+        eng.drain()
+
+    def test_watchdog_hang_path_dumps_reqtrace(self, model, tmp_path,
+                                               monkeypatch):
+        from paddle_tpu.distributed.watchdog import Watchdog
+
+        base = str(tmp_path / "hang_reqtrace.json")
+        monkeypatch.setenv(reqtrace.RECORD_ENV, base)
+        eng = make_engine(model)
+        eng.add_request(prompt(80, 5), max_new_tokens=50)
+        eng.step()                            # one request mid-flight
+        wd = Watchdog(timeout=60.0)           # never started: direct dump
+        buf = io.StringIO()
+        wd.dump_diagnostics(file=buf)
+        text = buf.getvalue()
+        assert "request(s) mid-flight" in text
+        assert "request-trace record persisted" in text
+        assert os.path.exists(base + ".r0")
+        eng.drain()
+
+    def test_fleet_snapshot_carries_reqtrace_tail(self, model):
+        from paddle_tpu.observability import fleet
+
+        eng = make_engine(model)
+        eng.add_request(prompt(90, 5), max_new_tokens=2)
+        eng.run_to_completion()
+        snap = fleet.local_snapshot()
+        assert any(tl["scope"] == eng.reqtrace_scope
+                   for tl in snap["reqtrace"])
+
+    def test_fleet_snapshot_truncates_long_live_timelines(self):
+        from paddle_tpu.observability.fleet import _truncate_timelines
+
+        long_tl = {"scope": "s", "rid": 1,
+                   "events": [{"event": "submitted", "t": 0.0}]
+                   + [{"event": "decode_tick", "t": float(i)}
+                      for i in range(1, 500)]}
+        out = _truncate_timelines([long_tl] * 30, max_timelines=5,
+                                  max_events=100)
+        assert len(out) == 5
+        for tl in out:
+            assert len(tl["events"]) == 100
+            assert tl["events"][0]["event"] == "submitted"  # anchor kept
+            assert tl["truncated"] == 400
+
+
+# ---------------------------------------------------------------------------
+# tools/request_trace.py renderer + CLI
+# ---------------------------------------------------------------------------
+class TestRequestTraceTool:
+    def test_waterfall_text(self, model):
+        eng = make_engine(model)
+        rid = eng.add_request(prompt(95, 6), max_new_tokens=3)
+        eng.run_to_completion()
+        tl = reqtrace.RECORDER.timeline(eng.reqtrace_scope, rid)
+        text = rt_tool.waterfall(tl)
+        assert "submitted" in text and "terminal" in text
+        assert "segments:" in text and "WARNING" not in text
+
+    def test_cli_worst_and_chrome_out(self, model, tmp_path,
+                                      monkeypatch, capsys):
+        eng = make_engine(model)
+        for i in range(3):
+            eng.add_request(prompt(100 + i, 5), max_new_tokens=3)
+        eng.run_to_completion()
+        base = str(tmp_path / "rt.json")
+        monkeypatch.setenv(reqtrace.RECORD_ENV, base)
+        dump_path = reqtrace.dump()
+        out = str(tmp_path / "merged.json")
+        rc = rt_tool.main(["--dump", dump_path, "--worst", "2",
+                           "--out", out])
+        assert rc == 0
+        printed = capsys.readouterr().out
+        assert "segments:" in printed
+        with open(out) as f:
+            tracef = json.load(f)
+        lanes = {e.get("tid") for e in tracef["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert lanes
+        rc = rt_tool.main(["--dump", dump_path, "--list"])
+        assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# ACCEPTANCE: one request chunk-prefilled + preempted + re-routed,
+# reconstructed end-to-end and merged with device spans on one clock.
+# ---------------------------------------------------------------------------
+class TestAcceptance:
+    def test_full_lifecycle_reconstruction_with_device_spans(
+            self, model, tmp_path):
+        # tight replicas: block_size 4 so an 8-token prompt chunk-
+        # prefills in 2+ chunks; 5 usable blocks force livelock
+        # preemption once two sequences grow
+        def mk():
+            return make_engine(model, max_batch=2, block_size=4,
+                               num_blocks=6, max_blocks_per_seq=5)
+
+        reps = [mk(), mk()]
+        router = Router(reps).warmup()
+        # keep replica1 out of rotation so contention lands on replica0
+        reps[1].lifecycle.to(ReplicaState.DEGRADED, "test: hold back")
+
+        own_trace = not otrace.active()
+        if own_trace:
+            otrace.clear()
+            otrace.activate()
+        try:
+            victim = router.add_request(prompt(200, 8),
+                                        max_new_tokens=8)
+            fillers = [router.add_request(prompt(201 + i, 4),
+                                          max_new_tokens=8,
+                                          deadline_s=3600.0)
+                       for i in range(2)]
+            vtl = lambda: reqtrace.RECORDER.timeline(router.name, victim)
+
+            # run until the victim (most deadline slack) is preempted
+            # on replica0, then re-admitted (re-prefill visible)
+            def stitched_events():
+                return [e["event"] for e in
+                        reqtrace.stitch(vtl())["events"]]
+
+            for _ in range(200):
+                router.step()
+                ev = stitched_events()
+                if "preempted" in ev and ev.count("admitted") >= 2:
+                    break
+            else:
+                pytest.fail("victim never preempted+readmitted: "
+                            + str(stitched_events()))
+
+            # bring replica1 back, crash replica0 mid-flight → re-route
+            reps[1].recover("test: rejoin")
+            rr = router._by_rid[victim]
+            assert rr.replica_idx == 0
+            with inject.armed("serving.crash_at_tick",
+                              tick=reps[0]._ticks + 1):
+                router.step()
+            out = router.run_to_completion()
+            assert len(out[victim]) == 8
+        finally:
+            if own_trace:
+                otrace.deactivate()
+        spans = otrace.drain() if own_trace else []
+
+        st = reqtrace.stitch(vtl())
+        ev = [e["event"] for e in st["events"]]
+        scopes = {e["scope"] for e in st["events"]}
+        # ALL THREE behaviors on the one request, across both replicas
+        assert ev.count("prefill_chunk") >= 2
+        assert "preempted" in ev and "rerouted" in ev
+        assert {reps[0].lifecycle.name,
+                reps[1].lifecycle.name} <= scopes
+        seg = assert_complete(st)
+        for b in ("queue", "prefill", "decode", "preempted", "rerouted"):
+            assert seg[b] >= 0.0
+        assert seg["preempted"] > 0 and seg["rerouted"] > 0
+        # total == router-level submit→terminal wall time
+        oc_wall = (st["events"][-1]["t"] - st["events"][0]["t"])
+        assert seg["total"] == pytest.approx(oc_wall)
+
+        # merged chrome trace: request lane + device spans, one clock
+        out_path = str(tmp_path / "acceptance_trace.json")
+        rt_tool.export(out_path, [st],
+                       spans=rt_tool.serving_spans(spans))
+        with open(out_path) as f:
+            tracef = json.load(f)
+        evs = tracef["traceEvents"]
+        req_x = [e for e in evs if e.get("ph") == "X"
+                 and e.get("pid") == 1]
+        dev_x = [e for e in evs if e.get("ph") == "X"
+                 and e.get("pid") == 0]
+        assert req_x and dev_x
+        assert any(e["name"].startswith("serving.") for e in dev_x)
+        # one clock: the request lane overlaps the device-span window
+        dev_lo = min(e["ts"] for e in dev_x)
+        dev_hi = max(e["ts"] + e.get("dur", 0) for e in dev_x)
+        req_lo = min(e["ts"] for e in req_x)
+        req_hi = max(e["ts"] + e.get("dur", 0) for e in req_x)
+        assert req_lo < dev_hi and dev_lo < req_hi, \
+            "request lane and device spans do not share a clock"
+
+        # the waterfall renders the whole causal story
+        text = rt_tool.waterfall(st)
+        for needle in ("prefill_chunk", "preempted", "rerouted",
+                       "segments:"):
+            assert needle in text

@@ -50,7 +50,9 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
                                             List[int]]] = None,
              clock: Callable[[], float] = time.monotonic,
              max_wall_s: float = 300.0,
-             attribution: bool = True) -> dict:
+             attribution: bool = True,
+             trace_out: Optional[str] = None,
+             trace_worst_k: int = 4) -> dict:
     """Drive ``engine`` with an open-loop Poisson arrival stream and
     return the latency/goodput/outcome report (JSON-able dict).
 
@@ -65,7 +67,16 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
     attribution: prefill vs decode compute seconds and shares, plus
     device time per tick — the SLO view of *where* the chip's time went,
     not just wall-clock TTFT/ITL. Skipped when a profiler recording
-    already owns the span buffer."""
+    already owns the span buffer.
+
+    With ``FLAGS_reqtrace`` on (the default) the report also carries
+    the p99-TTFT exemplar's wall-segment decomposition
+    (``queue/prefill/decode/preempted/rerouted``, summing to its total)
+    so a bad percentile points at a concrete request; ``trace_out``
+    names a path PREFIX under which the worst-``trace_worst_k``
+    request timelines are exported as a chrome trace merged with the
+    run's device spans (``<prefix>.trace.json``) plus the raw timelines
+    (``<prefix>.reqtrace.json``) — see ``tools/request_trace.py``."""
     from paddle_tpu.inference import Overloaded
     from paddle_tpu.observability import trace as _trace
 
@@ -120,6 +131,7 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
     real_wall = time.monotonic() - real_start
 
     device = None
+    spans = []
     if own_trace:
         spans = _trace.drain()
         ticks = sum(1 for _n, cat, *_ in spans if cat == "serving")
@@ -166,6 +178,61 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
             good_tokens += len(oc.tokens)
 
     finished = by_status.get("FINISHED", 0)
+
+    # ---- request-trace view: p99 exemplar decomposition + worst-k
+    # timeline export (reqtrace is FLAGS-gated; both degrade to None) --
+    p99_exemplar = None
+    scope = getattr(engine, "reqtrace_scope", None)
+    if scope is not None:
+        from paddle_tpu.observability import reqtrace as _rt
+        from tools import request_trace as _rt_tool
+
+        src = _rt_tool.TimelineSource()
+        with_ttft = sorted(
+            ((outcomes[r].ttft, r) for r in rids
+             if outcomes[r].ttft is not None),
+            key=lambda p: p[0])
+        if with_ttft:
+            p99_t, p99_rid = with_ttft[
+                min(int(round(0.99 * (len(with_ttft) - 1))),
+                    len(with_ttft) - 1)]
+            tl = src.resolve(scope, p99_rid)
+            if tl is not None:
+                seg = _rt.segments(tl)
+                p99_exemplar = {
+                    "rid": p99_rid, "ttft_s": round(p99_t, 6),
+                    "outcome": outcomes[p99_rid].status,
+                    "segments_s": {b: round(seg[b], 6)
+                                   for b in _rt.SEGMENT_BUCKETS},
+                    "total_s": round(seg["total"], 6),
+                    "complete": seg["complete"],
+                }
+        if trace_out:
+            import os as _os
+            d = _os.path.dirname(trace_out)
+            if d:
+                _os.makedirs(d, exist_ok=True)
+            # worst-k by TTFT, padded with the longest-wall outcomes
+            # (an all-shed point has no TTFTs but still needs evidence)
+            ranked = [r for _, r in reversed(with_ttft)]
+            if len(ranked) < trace_worst_k:
+                seen = set(ranked)
+                by_wall = sorted(
+                    rids, key=lambda r: -((outcomes[r].finish_t or 0.0)
+                                          - (outcomes[r].submit_t
+                                             or 0.0)))
+                ranked.extend(r for r in by_wall if r not in seen)
+            worst = [tl for tl in
+                     (src.resolve(scope, r)
+                      for r in ranked[:trace_worst_k]) if tl]
+            _rt_tool.export(f"{trace_out}.trace.json", worst,
+                            spans=_rt_tool.serving_spans(spans))
+            with open(f"{trace_out}.reqtrace.json", "w") as f:
+                import json as _json
+                _json.dump({"format": "paddle_tpu.reqtrace/1",
+                            "reason": "loadgen --trace-out",
+                            "timelines": worst}, f)
+
     # router mode: per-replica routing/goodput breakdown rides the report
     router = engine.stats() if hasattr(engine, "stats") else None
     return {
@@ -188,6 +255,7 @@ def run_load(engine, *, offered_rps: float, n_requests: int,
         "p99_itl_s": _percentile(itls, 99),
         "wall_s": round(wall, 3),
         "device_attribution": device,
+        "p99_ttft_exemplar": p99_exemplar,
         "router": router,
     }
 
@@ -267,6 +335,13 @@ def main(argv=None):
                     help='"ngram" enables speculative decoding')
     ap.add_argument("--prefill-budget", type=int, default=None,
                     help="phase-split scheduler: prefill tokens per tick")
+    ap.add_argument("--trace-out", default=None, metavar="DIR",
+                    help="export the worst-k request timelines per "
+                         "curve point (chrome trace merged with device "
+                         "spans + raw timelines) under DIR; the summary "
+                         "line always carries the p99 TTFT exemplar's "
+                         "segment decomposition")
+    ap.add_argument("--trace-worst-k", type=int, default=4)
     args = ap.parse_args(argv)
 
     engine_kw = dict(max_batch=args.max_batch, max_queue=args.max_queue,
@@ -278,11 +353,16 @@ def main(argv=None):
         else:
             eng = _tiny_engine(high_water=args.high_water, **engine_kw)
             eng.warmup()
+        trace_out = None
+        if args.trace_out:
+            import os
+            trace_out = os.path.join(args.trace_out, f"rate_{rate:g}")
         report = run_load(
             eng, offered_rps=rate, n_requests=args.requests,
             max_new_tokens=args.max_new_tokens,
             ttft_deadline_s=args.ttft_deadline_s,
-            deadline_s=args.deadline_s, seed=args.seed)
+            deadline_s=args.deadline_s, seed=args.seed,
+            trace_out=trace_out, trace_worst_k=args.trace_worst_k)
         report["replicas"] = args.replicas
         eng.drain()
         print(json.dumps(report))
