@@ -953,6 +953,110 @@ def _bench_serving_reqtrace(small):
     }
 
 
+def _bench_verifier_overhead(small):
+    """Program-verifier overhead rung (BENCH_MODEL=verifier_overhead;
+    paddle_tpu/static/verifier.py). The verifier runs ONCE per new
+    compile signature, so its budget is a fraction of trace+lower —
+    not of the step. Measures (a) trace+lower wall of the GPT ladder
+    block's recorded program (fresh jax.jit + .lower per rep, verifier
+    off) and (b) the full verifier pass stack over the same recorded
+    op list; value = trace_lower / (trace_lower + verify) (1.0 = free),
+    acceptance bar: verify < 2% of trace+lower."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.core import flags
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.static import verifier
+    import paddle_tpu.ops as pops
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=16, use_flash_attention=False))
+
+    def record_once():
+        """One program capture of the GPT block + loss (pays the
+        recorder — and, in warn mode, the per-op provenance walk)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            ids = static.data("ids", [2, 8], "int64")
+            logits = model(ids)
+            if isinstance(logits, (tuple, list)):
+                logits = logits[0]
+            v = logits.shape[-1]
+            loss = F.cross_entropy(
+                pops.reshape(logits[:, :-1, :], [-1, v]),
+                pops.reshape(ids[:, 1:], [-1])).mean()
+        return prog, [id(loss)]
+
+    prev = flags.get_flag("verify_programs")
+    reps = 5 if small else _env_int("BENCH_VERIFIER_REPS", 10)
+    try:
+        # per-op recording cost of the default-on warn mode: the
+        # dispatch recorder pays mode() + the bounded user_loc stack
+        # walk per op — measured as record-on minus record-off
+        t_rec = {}
+        for mode_ in ("off", "warn"):
+            flags.set_flags({"verify_programs": mode_})
+            samples = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                prog, fetch_ids = record_once()
+                samples.append(time.perf_counter() - t0)
+            t_rec[mode_] = float(np.median(samples))
+
+        flags.set_flags({"verify_programs": "off"})
+        prog, fetch_ids = record_once()     # loc-free timing substrate
+        names = sorted(prog.feed_vars)
+        feed_ids = [prog.feed_vars[n] for n in names]
+        cap_ids = list(prog._captured.keys())
+        cap_arrays = [t._data for t in prog._captured.values()]
+        feeds = [jnp.zeros(tuple(abs(s) for s in prog._feed_shapes[n]),
+                           dtype=np.dtype(prog._feed_dtypes[n]))
+                 for n in names]
+
+        t_tl = []
+        for _ in range(reps):
+            def replay(feed_arrays, caps):
+                env = prog._replay_by_ids(feed_ids, feed_arrays,
+                                          cap_ids, caps)
+                return [env[i] for i in fetch_ids]
+
+            t0 = time.perf_counter()
+            jax.jit(replay).lower(feeds, cap_arrays)
+            t_tl.append(time.perf_counter() - t0)
+
+        t_v = []
+        report = None
+        for _ in range(reps * 4):
+            t0 = time.perf_counter()
+            report = verifier.check(prog, fetch_ids=fetch_ids)
+            t_v.append(time.perf_counter() - t0)
+        assert report is not None and not report.findings, \
+            "ladder program must verify clean"
+    finally:
+        flags.set_flags({"verify_programs": prev})
+    trace_lower = float(np.median(t_tl))
+    verify = float(np.median(t_v))
+    record = max(0.0, t_rec["warn"] - t_rec["off"])
+    overhead = verify + record
+    ratio = trace_lower / max(trace_lower + overhead, 1e-12)
+    overhead_pct = overhead / max(trace_lower, 1e-12) * 100.0
+    return {
+        "metric": "verifier_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_unverified_compile",
+        "vs_baseline": round(ratio, 4),
+        "extra": {"overhead_pct": round(overhead_pct, 3),
+                  "trace_lower_ms": round(trace_lower * 1e3, 2),
+                  "verify_ms": round(verify * 1e3, 3),
+                  "record_overhead_ms": round(record * 1e3, 3),
+                  "ops": len(prog.global_block().ops),
+                  "within_budget": bool(overhead_pct < 2.0)},
+    }
+
+
 def _bench_spmd_auto(small):
     """SPMD auto-sharding rung (BENCH_MODEL=spmd_auto;
     paddle_tpu/distributed/spmd/). The SAME weights run one GPT
@@ -2125,6 +2229,7 @@ def main():
                "serving_resilience": _bench_serving_resilience,
                "serving_router": _bench_serving_router,
                "serving_reqtrace": _bench_serving_reqtrace,
+               "verifier_overhead": _bench_verifier_overhead,
                "compile_cache": _bench_compile_cache,
                "spmd_auto": _bench_spmd_auto,
                "planner_vs_manual": _bench_planner_vs_manual,
@@ -2295,6 +2400,18 @@ def main():
     print(json.dumps(rt))
     sys.stdout.flush()
 
+    # program-verifier overhead rung: the per-compile contract /
+    # collective / sharding / donation passes must stay < 2% of
+    # trace+lower (own metric class — not in the train geomean)
+    try:
+        vo = benches["verifier_overhead"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        vo = {"metric": "verifier_overhead_ratio",
+              "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(vo))
+    sys.stdout.flush()
+
     errors = [name for name, r in rungs.items() if r["unit"] == "error"]
     ratios = [r["vs_baseline"] for name, r in rungs.items()
               if r["unit"] != "error"]
@@ -2379,6 +2496,12 @@ def main():
                       "overhead_pct": rt.get("extra", {}).get(
                           "overhead_pct"),
                       "within_budget": rt.get("extra", {}).get(
+                          "within_budget")},
+                  "verifier_overhead": {
+                      "value": vo["value"], "unit": vo["unit"],
+                      "overhead_pct": vo.get("extra", {}).get(
+                          "overhead_pct"),
+                      "within_budget": vo.get("extra", {}).get(
                           "within_budget")},
                   "async_overlap": {
                       "value": ao["value"], "unit": ao["unit"],

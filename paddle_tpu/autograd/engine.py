@@ -267,7 +267,7 @@ def run_backward(tensors: Sequence[Tensor], grad_tensors=None,
 
 
 def _leaf_accumulate(t: Tensor, g, input_grads, watched_leaves, accumulate_into_leaves):
-    if _is_float0(g):
+    if _is_float0(g):  # tpulint: disable=TPU105 — taint FP: _is_float0 checks g's DTYPE (jax's zero-tangent sentinel), static metadata — no device read
         return
     for hook in t._backward_hooks:
         res = hook(g if isinstance(g, Tensor) else Tensor(g))

@@ -105,6 +105,10 @@ class FusedStep:
     #: replays under it so fused GEMMs keep the bf16 discipline the
     #: unfused chain had; None = replay under the ambient state)
     amp: Optional[tuple] = None
+    #: source provenance of the anchor record ("file.py:123") — carried
+    #: through the rewrite so program-verifier findings on a fused op
+    #: still name the user line that produced the chain
+    loc: str = ""
 
 
 def _act_name(step) -> Optional[str]:
@@ -437,6 +441,7 @@ def fuse_steps(steps: Sequence, external_ids) -> Tuple[list, dict]:
                 _m_rewritten.inc(pattern=pattern)
             consumed.update(idxs)
             fused.amp = getattr(g.steps[i], "amp", None)
+            fused.loc = getattr(g.steps[i], "loc", "") or ""
             replacement[i] = fused
             break
     plan: List = []

@@ -370,7 +370,7 @@ def _check_nan_inf(op_name, outs):
             continue  # SOT LazyArray / tracer: checked when materialized
         d = np.dtype(o.dtype)
         if np.issubdtype(d, np.floating) or d == dtypes.bfloat16:
-            bad = bool(jnp.any(~jnp.isfinite(o)))
+            bad = bool(jnp.any(~jnp.isfinite(o)))  # tpulint: disable=TPU103 — FLAGS_check_nan_inf debugging sweep: the per-op host sync IS the feature (default off)
             if bad:
                 level = flags.get_flag("check_nan_inf_level")
                 msg = f"NaN or Inf found in output of op '{op_name}'"

@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Iterable, Optional
 
 __all__ = ["DonatedBufferError", "mark_donated", "active", "check",
-           "ensure_distinct", "ensure_live"]
+           "ensure_distinct", "ensure_live", "watch_reads"]
 
 #: hot mirror: False until the first donating call in this process, so
 #: the Tensor host-read paths pay one dict lookup and nothing else
@@ -38,6 +38,31 @@ class DonatedBufferError(RuntimeError):
 
 def active() -> bool:
     return _state["on"]
+
+
+#: host-read observation seam: the program verifier (static.verifier)
+#: installs a callback here while it traces a donating step, so it can
+#: flag donated-then-host-read hazards STATICALLY — before the runtime
+#: path below ever sees a stale buffer. One dict lookup when unused.
+_watch = {"cb": None}
+
+
+class watch_reads:
+    """Context manager observing every Tensor host-read that flows
+    through :func:`check` (numpy/item/tolist/__array__/cpu). The
+    callback receives ``(array, site)``; it must never raise."""
+
+    def __init__(self, cb):
+        self._cb = cb
+
+    def __enter__(self):
+        self._prev = _watch["cb"]
+        _watch["cb"] = self._cb
+        return self
+
+    def __exit__(self, *exc):
+        _watch["cb"] = self._prev
+        return False
 
 
 def mark_donated(arrays: Iterable, context: str):
@@ -60,8 +85,11 @@ def _is_deleted(arr) -> bool:
 
 def check(arr, site: str = "this read"):
     """Raise :class:`DonatedBufferError` if ``arr`` is a deleted device
-    buffer and any donation has happened; no-op (one dict lookup)
+    buffer and any donation has happened; no-op (two dict lookups)
     otherwise."""
+    w = _watch["cb"]
+    if w is not None:
+        w(arr, site)
     if not _state["on"]:
         return
     if _is_deleted(arr):

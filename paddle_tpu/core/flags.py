@@ -143,6 +143,15 @@ define_flag("enable_fusion", False,
             "Rewrite matched subgraphs (norm->linear->act, residual+norm, "
             "bias+act, rope+projection) onto fused ops in the compile "
             "paths (to_static/SOT/Engine/static.Program).")
+# Program verifier (paddle_tpu/static/verifier.py) — static contract /
+# collective-desync / sharding / donation-hazard checks over the op-list
+# IR, run once per new compile signature in every compile path.
+define_flag("verify_programs", "warn",
+            "Pre-compile program verification mode: 'warn' (default) "
+            "reports findings as ProgramVerifierWarning, 'strict' "
+            "raises ProgramVerifierError naming the op + source line "
+            "before XLA sees the program, 'off' disables.",
+            type=str)
 # Performance attribution (paddle_tpu/observability/perf/) — registered
 # here so the dispatch hot-path mirror can read them at import time.
 define_flag("perf_capture", False,

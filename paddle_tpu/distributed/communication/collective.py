@@ -208,8 +208,63 @@ def _mesh_key(mesh):
     return key
 
 
+# ------------------------------------------------ branch-trace seam
+# Inside a static.nn cond/while_loop/switch_case branch under capture,
+# ops do not execute — a control-flow BranchTrace evaluates them
+# abstractly. Collectives do not normally ride dispatch.call, so this
+# seam records them into the active branch trace (name + group/axes
+# identity + payload shape) and returns an abstract result. That trace
+# is what the program verifier's static desync pass (static.verifier,
+# TPU4xx) compares across arms — the compile-time complement of
+# flight.diff_ranks.
+def _bt_group_attrs(group, **extra) -> dict:
+    if group is None:
+        # normalize: an explicit default group and group=None are the
+        # SAME collective — compare equal in the verifier's content
+        # check (resolution may fail in a pure trace: keep None then)
+        try:
+            group = get_default_group()
+        except Exception:
+            group = None
+    gid = int(getattr(group, "id", 0) or 0) if group is not None else 0
+    axes = (tuple(getattr(group, "axes", ()) or ())
+            if group is not None else None)
+    return {"group": gid, "axes": axes, **extra}
+
+
+def _branch_traced(name, tensor, group, n_out=1, out_shape=None,
+                   **extra):
+    """Record one collective abstractly; returns n_out abstract
+    tensor(s) shaped like the input (or ``out_shape``)."""
+    attrs = _bt_group_attrs(group, **extra)
+    if tensor is None:
+        return dispatch.call(name, lambda **_kw: jnp.zeros(()), [],
+                             attrs=attrs)
+    t = _t(tensor)
+    if out_shape is not None:
+        shape = tuple(out_shape)
+        return dispatch.call(
+            name, lambda x, **_kw: jnp.zeros(shape, dtype=x.dtype),
+            [t], attrs=attrs)
+    if n_out == 1:
+        return dispatch.call(name, lambda x, **_kw: x, [t], attrs=attrs)
+    return dispatch.call(
+        name, lambda x, **_kw: tuple(x for _ in range(n_out)), [t],
+        attrs=attrs, multi_output=True)
+
+
+def _bt_nranks(group) -> int:
+    try:
+        return max(1, int(_group(group).nranks))
+    except Exception:
+        return 1                     # no process group in a pure trace
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """In-place sum (or max/min/prod/avg) across the group's axes."""
+    if dispatch.in_branch_trace():
+        return _branch_traced("all_reduce", tensor, group,
+                              reduce=str(op))
     g = _group(group)
     t = _t(tensor)
     tok = _coll_begin("all_reduce", t._data, g)
@@ -258,6 +313,15 @@ def _build_all_gather(mesh_key, axes, spec):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Gather each rank's tensor; fills ``tensor_list`` (reference
     all_gather.py)."""
+    if dispatch.in_branch_trace():
+        n = _bt_nranks(group)
+        outs = _branch_traced("all_gather", tensor, group, n_out=n)
+        outs = list(outs) if isinstance(outs, (list, tuple)) else [outs]
+        if tensor_list is None:
+            tensor_list = []
+        del tensor_list[:]
+        tensor_list.extend(outs)
+        return tensor_list
     g = _group(group)
     t = _t(tensor)
     tok = _coll_begin("all_gather", t._data, g)
@@ -452,6 +516,20 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
                    sync_op=True):
     """Each rank gets its reduced chunk of the concatenated input
     (reference reduce_scatter.py)."""
+    if dispatch.in_branch_trace():
+        src = tensor_or_tensor_list
+        if isinstance(src, (list, tuple)):
+            # list form: each entry is one rank's chunk — the result is
+            # chunk-shaped, so the first entry is the exact shape proxy
+            return _branch_traced("reduce_scatter", src[0], group,
+                                  reduce=str(op))
+        srct = _t(src)
+        shape = tuple(srct._data.shape)
+        n = _bt_nranks(group)
+        if shape and shape[0] % n == 0:
+            shape = (shape[0] // n,) + shape[1:]   # real op contract
+        return _branch_traced("reduce_scatter", srct, group,
+                              out_shape=shape, reduce=str(op))
     g = _group(group)
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
@@ -491,6 +569,8 @@ def _build_broadcast(mesh_key, axes, spec, src):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    if dispatch.in_branch_trace():
+        return _branch_traced("broadcast", tensor, group, src=int(src))
     g = _group(group)
     t = _t(tensor)
     src_local = g.get_group_rank(src)
@@ -666,6 +746,9 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def barrier(group=None):
+    if dispatch.in_branch_trace():
+        _branch_traced("barrier", None, group)
+        return
     g = _group(group)
     # token reduction built directly (not via all_reduce) so the barrier
     # records ONE metric sample instead of also inflating all_reduce's

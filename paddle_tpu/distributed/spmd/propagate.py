@@ -36,7 +36,8 @@ from ...observability import metrics as _metrics
 from . import rules as R
 
 __all__ = ["ShardingPlan", "propagate_program", "shard_program",
-           "ShardedProgram", "trace_scope", "param_spec_of"]
+           "ShardedProgram", "trace_scope", "param_spec_of",
+           "apply_rule"]
 
 _m_fallback = _metrics.counter(
     "paddle_tpu_spmd_fallback_total",
@@ -107,9 +108,11 @@ class ShardingPlan:
                                     "replicate-warn")}}
 
 
-def _apply_rule(op_name, in_specs, in_shapes, attrs, out_shapes):
+def apply_rule(op_name, in_specs, in_shapes, attrs, out_shapes):
     """Run the op's rule; returns (result, tier). Fallback and rule
-    exceptions both produce replicated outputs."""
+    exceptions both produce replicated outputs. Public: the program
+    verifier (static.verifier) replays propagation over arbitrary
+    record lists through this exact engine."""
     rule, tier = R.rule_for(op_name)
     if rule is None:
         _warn_fallback(op_name)
@@ -162,7 +165,7 @@ def propagate_program(program, mesh, in_specs: Dict[str, object],
         out_shapes = op.out_shapes or tuple(() for _ in op.out_ids)
         ins = [env.get(i, (None,) * len(s))
                for i, s in zip(op.in_ids, in_shapes)]
-        res, tier = _apply_rule(op.name, ins, in_shapes, op.attrs,
+        res, tier = apply_rule(op.name, ins, in_shapes, op.attrs,
                                 out_shapes)
         if tier == "replicate-warn":
             plan.fallback_ops[op.name] = \
@@ -296,6 +299,17 @@ def shard_program(program, mesh, in_specs: Dict[str, object],
     from ...ops import registry  # ensure registry import side effects
     R.attach_spmd_rules()
     plan = propagate_program(program, mesh, in_specs, param_specs)
+    from ...static import verifier as _verifier
+    if _verifier.mode() != "off":
+        # pre-flight (FLAGS_verify_programs): divisibility violations,
+        # hot-path fallbacks and unreduced Partials are reported (or, in
+        # strict mode, raised) before the SPMD program compiles. The
+        # plan just computed is handed in so the sharding pass reuses
+        # this propagation instead of re-running every rule.
+        _verifier.enforce(_verifier.check(
+            program, mesh=mesh, in_specs=in_specs,
+            param_specs=param_specs, label="spmd.shard_program",
+            plan=plan))
     return ShardedProgram(program, mesh, plan, in_specs)
 
 
@@ -385,7 +399,7 @@ class trace_scope:
         out_shapes = [tuple(t.shape) for t in out_tensors]
         ins = [self.env.get(id(t), (None,) * len(s))
                for t, s in zip(tensor_inputs, in_shapes)]
-        res, tier = _apply_rule(op_name, ins, in_shapes, attrs,
+        res, tier = apply_rule(op_name, ins, in_shapes, attrs,
                                 out_shapes)
         st = self.stats
         st["ops"] += 1

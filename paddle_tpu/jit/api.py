@@ -299,6 +299,17 @@ class StaticFunction:
             reason = f"{type(e).__name__}: {str(e).splitlines()[0]}"
             if _metrics.enabled():
                 _m_graph_break.inc(reason=type(e).__name__)
+            # donation-hazard verdict (static.verifier): the break may
+            # BE a host read of a donated param mid-step — the stale
+            # read the runtime registry would only catch when the SOT
+            # fallback executes it. strict raises here, before any
+            # segment of the donated program compiles or runs.
+            vsc = getattr(self, "_verifier_scope", None)
+            if vsc is not None:
+                vrep = vsc.donation_report()
+                if vrep is not None:
+                    from ..static import verifier as _verifier
+                    _verifier.enforce(vrep)
             if self._full_graph:
                 raise
             if len(self._graph_breaks) >= self._graph_breaks_max:
@@ -348,6 +359,14 @@ class StaticFunction:
                 for p, d in zip(params, param_arrays):
                     originals.append((p, p._data))
                     p._data = d
+                vsc = getattr(outer, "_verifier_scope", None)
+                if vsc is not None:
+                    # params now hold the trace's argument tracers: a
+                    # host read of one of THESE payloads during the
+                    # trace is a donated-then-read hazard (TPU601);
+                    # begin_trace also resets the record stream so a
+                    # jax retrace of this target starts clean
+                    vsc.begin_trace(params)
                 try:
                     args_t = _wrap(a)
                     kwargs_t = _wrap(k)
@@ -371,6 +390,12 @@ class StaticFunction:
                                for i, (p, d) in enumerate(
                                    zip(params, param_arrays))
                                if outer._donate or p._data is not d}
+                    if vsc is not None:
+                        # verify the recorded op stream HERE — the
+                        # trace is complete but nothing has lowered or
+                        # compiled yet, so strict mode raises before
+                        # XLA ever sees the program
+                        vsc.finish()
                     return _unwrap(out), mutated
                 finally:
                     for p, d in originals:
@@ -534,23 +559,48 @@ class StaticFunction:
         the plain jit path; with it on, AOT lower+compile so the
         executable can be serialized and published for other processes.
         With FLAGS_perf_capture on, the AOT route is taken either way so
-        the compiled program's cost/memory analysis can be captured."""
-        from ..observability import perf as _perf
+        the compiled program's cost/memory analysis can be captured.
 
-        param_arrays = [p._data for p in params]
-        try:
-            from .. import compile as pcc
-            use_pcc = pcc.enabled()
-        except Exception:
-            use_pcc = False
-        if not use_pcc:
-            if _perf.capture_enabled():
-                runner, _c, _s = self._aot_compile(
-                    sig, param_arrays, arrays, treedef, statics)
-                return runner(param_arrays, arrays)
-            return self._jitted(param_arrays, arrays, treedef, statics)
-        runner = self._pcc_store(sig, params, arrays, treedef, statics)
-        return runner(param_arrays, arrays)
+        The program verifier rides the first-compile trace: a
+        static.verifier.trace_scope records the dispatched op stream
+        (and, under donation, host reads of donated params) and the
+        contract/collective passes run before any result is returned —
+        FLAGS_verify_programs=strict raises the framework's error
+        naming the op + source line before XLA sees the program."""
+        from ..observability import perf as _perf
+        from ..static import verifier as _verifier
+
+        self._verifier_scope = None
+        if _verifier.mode() != "off":
+            self._verifier_scope = _verifier.trace_scope(
+                label=f"to_static({getattr(self, '__name__', '<fn>')!r})",
+                donate=self._donate)
+
+        def _inner():
+            param_arrays = [p._data for p in params]
+            try:
+                from .. import compile as pcc
+                use_pcc = pcc.enabled()
+            except Exception:
+                use_pcc = False
+            if not use_pcc:
+                if _perf.capture_enabled():
+                    runner, _c, _s = self._aot_compile(
+                        sig, param_arrays, arrays, treedef, statics)
+                    return runner(param_arrays, arrays)
+                return self._jitted(param_arrays, arrays, treedef,
+                                    statics)
+            runner = self._pcc_store(sig, params, arrays, treedef,
+                                     statics)
+            return runner(param_arrays, arrays)
+
+        if self._verifier_scope is None:
+            return _inner()
+        # the scope only registers/unregisters the recorder hook here;
+        # jit_target itself calls begin_trace/finish so the verdict
+        # lands at end-of-trace, BEFORE lowering + XLA compile
+        with self._verifier_scope:
+            return _inner()
 
     def _pcc_store(self, sig, params, arrays, treedef, statics):
         """AOT-compile one signature, publish it, return its runner.

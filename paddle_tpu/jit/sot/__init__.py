@@ -341,6 +341,25 @@ class Segment:
                 _m_segment_cache.inc(event="miss")
             nodes = self.nodes
 
+            # program verification on a cache MISS (FLAGS_verify_programs)
+            # — the segment node graph is an op-list IR like any other;
+            # strict raises before the segment ever compiles
+            from ...static import verifier as _verifier
+            if _verifier.mode() != "off":
+                recs = [
+                    _verifier.Record(
+                        name=op, fn=f,
+                        in_ids=tuple(tuple(r) for r in in_refs),
+                        out_ids=tuple(("n", nid, k)
+                                      for k in range(n_out)),
+                        attrs=attrs, in_shapes=io_shapes[0],
+                        out_shapes=io_shapes[1])
+                    for nid, (op, f, in_refs, n_out, _ak, attrs,
+                              io_shapes) in enumerate(self.nodes)]
+                _verifier.enforce(_verifier.check(
+                    recs,
+                    label=f"sot segment (site {self.owner.site_idx})"))
+
             # pattern matching only on a cache MISS: a hit replays the
             # already-fused compile, and the rewritten/matched counters
             # stay per-compile (not per-execution)

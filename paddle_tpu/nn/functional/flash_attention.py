@@ -166,6 +166,11 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
+    """Fused attention over [B, S, H, D] q/k/v (reference
+    nn.functional.scaled_dot_product_attention): softmax(q·kᵀ/√d)·v
+    with optional additive/boolean mask, causal masking and dropout.
+    Dispatches the Pallas flash kernel when the shape class qualifies,
+    else the XLA composite."""
     q, k, v = _t(query), _t(key), _t(value)
     inputs = [q, k, v]
     has_mask = attn_mask is not None
@@ -194,6 +199,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     return dispatch.call("scaled_dot_product_attention", f, inputs,
                          differentiable_mask=[True, True, True]
                          + [False] * has_mask)
+
+
+# registry entry for the dispatched name: the op already carried a
+# named spmd rule + cost model, but no OpDef — the program verifier's
+# contract pass (TPU700) surfaced the gap
+from ...ops.registry import register as _register  # noqa: E402
+
+_register("scaled_dot_product_attention",
+          category="attention")(scaled_dot_product_attention)
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,

@@ -33,7 +33,7 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
     # also keeps the coef on device); min(1, max/total) folds the branch
     if error_if_nonfinite:
         import numpy as np
-        if not np.isfinite(float(total.numpy())):
+        if not np.isfinite(float(total.numpy())):  # tpulint: disable=TPU101 — error_if_nonfinite contract (torch parity) requires the host check before scaling
             raise RuntimeError(
                 f"the total norm of gradients is non-finite; disable with "
                 f"error_if_nonfinite=False")

@@ -42,6 +42,10 @@ def _norm_index(idx):
 
 
 def _getitem(self, idx):
+    """Tensor indexing protocol (``t[idx]``): ints/slices/ellipsis/
+    tensor indices lower to jax advanced indexing as ONE ``getitem``
+    op; boolean masks take the data-dependent host path (reference
+    masked_select semantics)."""
     if isinstance(idx, Tensor) and idx.dtype == np.dtype(bool):
         # boolean mask -> dynamic shape -> host path (parity with reference
         # masked_select semantics)
@@ -49,6 +53,14 @@ def _getitem(self, idx):
             jnp.asarray(np.asarray(self._data)[np.asarray(idx._data).astype(bool)]))
     nidx = _norm_index(idx)
     return dispatch.call("getitem", lambda a: a[nidx], [self])
+
+
+# registry entry for the dispatched name: the tensor-protocol indexing
+# pseudo-op already carried a named spmd rule; the program verifier's
+# TPU700 contract pass surfaced the missing OpDef
+from .registry import register as _register_op  # noqa: E402
+
+_register_op("getitem", category="indexing")(_getitem)
 
 
 def _setitem(self, idx, value):
@@ -243,6 +255,23 @@ def _register_all():
     register_module(_sig, "signal")
     from .. import quantization as _quant
     register_module(_quant, "quantization")
+
+    # rotary_embedding dispatches from models/llama.py (imported on
+    # demand, so it cannot self-register at paddle_tpu import time);
+    # the OpDef lives here as a lazy forwarder — the program verifier's
+    # TPU700 contract pass surfaced the missing entry
+    from .registry import register as _reg
+
+    def rotary_embedding(x, theta=10000.0, pos_offset=0):
+        """Apply RoPE to [B, S, H, D] activations (reference fused_rope
+        op): (even, odd) channel pairs rotated by position-dependent
+        angles at base ``theta``; ``pos_offset`` may be a python int
+        (recorded as a semantic attr, fusable into the projection), a
+        traced scalar, or a per-batch vector."""
+        from ..models.llama import rotary_embedding as _impl
+        return _impl(x, theta=theta, pos_offset=pos_offset)
+
+    _reg("rotary_embedding", category="attention")(rotary_embedding)
 
 
 _register_all()

@@ -124,6 +124,11 @@ class BranchTrace:
         self.reads: List[Tensor] = []
         self._read_ids = set()
         self._produced = set()  # id(payload) of outputs of THIS trace
+        #: op sequence the branch traces, in dispatch order — the
+        #: program verifier (static.verifier) reads this off the
+        #: enclosing construct's lowering to compare collective
+        #: sequences across arms (static desync analysis)
+        self.ops: List[dict] = []
 
     def run_op(self, op_name: str, fn: Callable,
                tensor_inputs: Sequence[Tensor], attrs: dict):
@@ -132,6 +137,14 @@ class BranchTrace:
                     and id(t) not in self._read_ids):
                 self._read_ids.add(id(t))
                 self.reads.append(t)
+        self.ops.append({
+            "name": op_name, "attrs": dict(attrs or {}),
+            "shape": (tuple(tensor_inputs[0].shape)
+                      if tensor_inputs else ()),
+            # a nested construct dispatched inside this branch carries
+            # its own arms on the lowering — keep the link so the
+            # verifier can recurse
+            "branches": getattr(fn, "_verifier_branches", None)})
         f = (lambda *xs: fn(*xs, **attrs)) if attrs else fn
         avals = [_payload_aval(t._data) for t in tensor_inputs]
         # suspend this trace while shape-evaluating: a NESTED control-flow
@@ -175,7 +188,7 @@ def _tensor_leaves(out, where: str):
 
 def _trace_branch(fn: Callable, args=()):
     """Abstractly run ``fn(*args)`` under a BranchTrace. Returns
-    (leaves, treedef, avals, reads)."""
+    (leaves, treedef, avals, reads, ops)."""
     bt = BranchTrace()
     for a in args:
         # arguments are placeholders this trace owns, never "reads"
@@ -198,7 +211,7 @@ def _trace_branch(fn: Callable, args=()):
             bt._read_ids.add(id(l))
             bt.reads.append(l)
     avals = [_payload_aval(l._data) for l in leaves]
-    return leaves, treedef, avals, bt.reads
+    return leaves, treedef, avals, bt.reads, bt.ops
 
 
 def _dedup_tensors(*groups) -> List[Tensor]:
@@ -314,12 +327,16 @@ def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
         raise ValueError(
             "cond under capture requires both true_fn and false_fn "
             "(a one-sided cond has no graph form)")
-    _t_leaves, t_def, t_avals, t_reads = _trace_branch(true_fn)
-    _f_leaves, f_def, f_avals, f_reads = _trace_branch(false_fn)
+    _t_leaves, t_def, t_avals, t_reads, t_ops = _trace_branch(true_fn)
+    _f_leaves, f_def, f_avals, f_reads, f_ops = _trace_branch(false_fn)
     _check_same_structure([(t_def, t_avals), (f_def, f_avals)], "cond")
     ext = _dedup_tensors(t_reads, f_reads)
     lowering = _make_select_lowering([true_fn, false_fn], ext, t_avals,
                                      n_branches=2)
+    # branch op sequences for the program verifier's collective-desync
+    # pass: arms whose collective sequences differ are a static hang
+    lowering._verifier_branches = {"construct": "conditional_block",
+                                   "branches": [t_ops, f_ops]}
     outs = dispatch.call(
         "conditional_block", lowering, [pred] + ext, multi_output=True,
         differentiable_mask=[False] + [True] * len(ext),
@@ -415,7 +432,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
                 f"loop_vars ({out_def} vs {carry_def})")
         return out_leaves
 
-    if not _captured(*leaves):
+    if not _captured(*leaves):  # tpulint: disable=TPU105 — _captured() probes capture machinery + payload TYPES and returns a python bool; no tensor value is read
         vars_ = tuple(jax.tree_util.tree_unflatten(carry_def, leaves))
         while True:
             keep = cond(*vars_)
@@ -423,7 +440,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
             if len(keep_leaves) != 1:
                 raise ValueError("while_loop: cond must return one "
                                  "scalar boolean tensor")
-            if not bool(keep_leaves[0]):
+            if not bool(keep_leaves[0]):  # tpulint: disable=TPU103 — eager-mode while_loop reads its predicate on host BY DESIGN (reference dygraph semantics); under capture the construct lowers to lax.while_loop instead
                 break
             out_leaves = _body_flat(body(*vars_), "while_loop body")
             vars_ = tuple(jax.tree_util.tree_unflatten(carry_def,
@@ -435,7 +452,7 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
     n_carry = len(leaves)
 
     ph_c = [Tensor(_AbstractPayload(av)) for av in carry_avals]
-    c_leaves, _c_def, c_avals, c_reads = _trace_branch(
+    c_leaves, _c_def, c_avals, c_reads, c_ops = _trace_branch(
         lambda *ps: cond(*jax.tree_util.tree_unflatten(carry_def,
                                                        list(ps))), ph_c)
     if len(c_leaves) != 1 or int(np.prod(c_avals[0].shape)) != 1:
@@ -447,8 +464,9 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
                                                             list(ps))))
 
     ph_b = [Tensor(_AbstractPayload(av)) for av in carry_avals]
-    _b_leaves, b_def, b_avals, b_reads = _trace_branch(_norm_body, ph_b)
-    if b_def != carry_def:
+    _b_leaves, b_def, b_avals, b_reads, b_ops = _trace_branch(
+        _norm_body, ph_b)
+    if b_def != carry_def:  # tpulint: disable=TPU105 — taint FP: b_def/carry_def are pytree treedefs (host structure metadata from tree_flatten), not tensor values
         raise ValueError(
             f"while_loop: body returned a different structure than "
             f"loop_vars ({b_def} vs {carry_def})")
@@ -490,6 +508,10 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None):
 
         return lax.while_loop(cond_f, body_f, carry0)
 
+    # cond + body traces for the verifier: a collective under a
+    # data-dependent trip count is the classic per-rank desync
+    lowering._verifier_branches = {"construct": "while_loop",
+                                   "branches": [c_ops, b_ops]}
     outs = dispatch.call(
         "while_loop", lowering, leaves + ext, multi_output=True,
         differentiable_mask=[False] * (n_carry + len(ext)),
@@ -567,13 +589,16 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     else:
         default_pos = len(fns) - 1  # largest key's callable
     traced = [_trace_branch(f) for f in fns]
-    _check_same_structure([(td, av) for _l, td, av, _r in traced],
+    _check_same_structure([(td, av) for _l, td, av, _r, _o in traced],
                           "switch_case")
     out_def, out_avals = traced[0][1], traced[0][2]
-    ext = _dedup_tensors(*[r for _l, _td, _av, r in traced])
+    ext = _dedup_tensors(*[r for _l, _td, _av, r, _o in traced])
     lowering = _make_select_lowering(
         fns, ext, out_avals, n_branches=len(fns),
         keys=[k for k, _ in items], default_pos=default_pos)
+    lowering._verifier_branches = {
+        "construct": "switch_case",
+        "branches": [o for _l, _td, _av, _r, o in traced]}
     outs = dispatch.call(
         "switch_case", lowering, [idx_t] + ext, multi_output=True,
         differentiable_mask=[False] + [True] * len(ext),

@@ -28,8 +28,11 @@ def _axis(axis):
     if axis is None:
         return None
     if isinstance(axis, Tensor):
-        arr = np.asarray(axis._data)
-        return tuple(int(v) for v in np.atleast_1d(arr))
+        # XLA reduction axes are compile-time constants: a Tensor-valued
+        # axis MUST be read to host ints here, by design (the reference
+        # accepts axis as a Variable the same way)
+        arr = np.asarray(axis._data)  # tpulint: disable=TPU104 — host-by-design: axis becomes a static attr
+        return tuple(int(v) for v in np.atleast_1d(arr))  # tpulint: disable=TPU103,TPU104 — same static-axis extraction
     if isinstance(axis, (list, tuple)):
         return tuple(int(a.item()) if isinstance(a, Tensor) else int(a) for a in axis)
     return int(axis)

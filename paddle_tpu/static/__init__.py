@@ -37,6 +37,7 @@ class InputSpec:
 from .program import (CompiledProgram, Executor, Program,  # noqa: E402
                       data, default_main_program,
                       default_startup_program, program_guard)
+from . import verifier  # noqa: E402,F401  (program verifier — ISSUE 15)
 from . import nn  # noqa: E402,F401
 from .nn import ExponentialMovingAverage, py_func  # noqa: E402,F401
 from .tail import *  # noqa: E402,F401,F403
@@ -44,5 +45,5 @@ from . import tail as _tail  # noqa: E402
 
 __all__ = ["InputSpec", "Program", "program_guard", "data", "Executor",
            "CompiledProgram", "default_main_program",
-           "default_startup_program", "nn", "ExponentialMovingAverage",
-           "py_func"] + _tail.__all__
+           "default_startup_program", "nn", "verifier",
+           "ExponentialMovingAverage", "py_func"] + _tail.__all__

@@ -27,20 +27,26 @@ _state = threading.local()
 
 class _OpRecord:
     __slots__ = ("name", "fn", "in_ids", "out_ids", "attrs", "in_shapes",
-                 "out_shapes")
+                 "out_shapes", "in_dtypes", "out_dtypes", "loc")
 
     def __init__(self, name, fn, in_ids, out_ids, attrs=None,
-                 in_shapes=(), out_shapes=()):
+                 in_shapes=(), out_shapes=(), in_dtypes=(),
+                 out_dtypes=(), loc=""):
         self.name = name
         self.fn = fn
         self.in_ids = in_ids
         self.out_ids = out_ids
         # semantic attrs + shapes at record time: the spmd propagation
         # pass (distributed.spmd.propagate) reads the op list as an IR
-        # and needs axis/transpose attrs and dim counts per value
+        # and needs axis/transpose attrs and dim counts per value;
+        # dtypes + the recording source line feed the program verifier
+        # (static.verifier) — contract checks and finding provenance
         self.attrs = dict(attrs or {})
         self.in_shapes = tuple(in_shapes)
         self.out_shapes = tuple(out_shapes)
+        self.in_dtypes = tuple(in_dtypes)
+        self.out_dtypes = tuple(out_dtypes)
+        self.loc = loc
 
     def __repr__(self):
         ins = ", ".join(f"v{i}" for i in self.in_ids)
@@ -88,10 +94,18 @@ class Program:
                 self._captured[id(t)] = t
         self._produced.update(out_ids)
         self._keepalive.extend(out_tensors)
+        from . import verifier as _verifier
+        # source provenance only when the verifier can consume it:
+        # FLAGS_verify_programs=off restores the pre-verifier record
+        # cost (no per-op stack walk)
+        loc = _verifier.user_loc() if _verifier.mode() != "off" else ""
         self._block.ops.append(_OpRecord(
             op_name, fn, in_ids, out_ids, attrs,
             [tuple(t.shape) for t in tensor_inputs],
-            [tuple(t.shape) for t in out_tensors]))
+            [tuple(t.shape) for t in out_tensors],
+            [str(t.dtype) for t in tensor_inputs],
+            [str(t.dtype) for t in out_tensors],
+            loc))
 
     def global_block(self):
         return self._block
@@ -137,6 +151,14 @@ class Program:
         if fuse:
             sig = sig + (_fusion.fingerprint(),)
         if sig not in self._jit_cache:
+            from . import verifier as _verifier
+            if _verifier.mode() != "off":
+                # pre-compile verification (FLAGS_verify_programs):
+                # strict raises the framework's error naming the op +
+                # source line before jax.jit ever sees the program
+                _verifier.enforce(_verifier.check(
+                    self, fetch_ids=list(fetch_ids),
+                    label="static.Program"))
             feed_ids = [self.feed_vars[n] for n in names]
             cap_ids = list(self._captured.keys())
             ops_plan = None
@@ -154,7 +176,7 @@ class Program:
             self._jit_cache[sig] = jax.jit(compiled)
         cap_arrays = [t._data for t in self._captured.values()]
         outs = self._jit_cache[sig](arrays, cap_arrays)
-        return [np.asarray(o) for o in outs]
+        return [np.asarray(o) for o in outs]  # tpulint: disable=TPU104 — Program.run returns numpy by contract (reference Executor.run): the fetch IS the host boundary
 
     def _replay_by_ids(self, feed_ids, feed_arrays, cap_ids, cap_arrays,
                        ops=None):

@@ -1,0 +1,851 @@
+"""Whole-program verifier over the recorded op-list IR.
+
+Every diagnostic layer before this one is *runtime*: the collective
+flight recorder names a desynced rank after the fleet hangs, the
+donation registry raises when the stale read executes, and shape/dtype
+mistakes surface as raw XLA errors deep inside ``to_static``. This
+module is the static complement — the same class of pre-execution
+verification GSPMD-style partitioners and MPI deadlock checkers (MUST)
+run over their IRs — applied to the op-list IR every compile path in
+this framework already records (``static/program.py`` ``_OpRecord``,
+the ``to_static`` trace stream, SOT segment nodes, fusion plans).
+
+Four pass families, each with its own code block (``CODES``):
+
+* **TPU7xx — contract**: per-op validation against registry metadata.
+  Unknown ops, broadcast-illegal elementwise shapes, silent float
+  downcasts (the exact bug class the round-15 fusion review fixed by
+  hand), dead/unfetchable ops, in-place-target aliasing that makes a
+  replay read a stale pre-mutation value.
+* **TPU4xx — collective safety**: static desync detection. Control-flow
+  ops (``static.nn`` cond / while_loop / switch_case) carry their
+  branch traces; arms whose collective sequences differ in membership,
+  order, or group/shape content are flagged — the static complement of
+  ``flight.diff_ranks`` — and collectives under a data-dependent loop
+  trip count are warned about.
+* **TPU5xx — sharding/mesh**: given a mesh + specs, the round-13
+  propagation pass runs offline and pre-flights mesh-divisibility
+  violations, replicate-fallback ops on the hot path, and ``Partial``
+  (reduce-pending) values consumed without a reduction
+  (``ShardingPlan.partial_env``).
+* **TPU6xx — donation hazards**: parameters marked for donation that
+  the traced step itself host-reads — the read the round-17 runtime
+  registry would only catch once the stale buffer is touched.
+
+Wired into all three compile paths behind ``FLAGS_verify_programs``
+(default ``warn``; ``strict`` raises :class:`ProgramVerifierError`
+naming the op and its source line before XLA ever sees the program;
+``off`` disables). ``verifier.check(program, mesh=...)`` is the offline
+entry; ``python -m tools.tpulint --programs`` runs it over the
+framework-traced ladder programs.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import warnings
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["CODES", "Finding", "Report", "ProgramVerifierError",
+           "ProgramVerifierWarning", "check", "check_records",
+           "audit_step", "trace_scope", "mode", "enforce",
+           "COLLECTIVE_OPS"]
+
+#: every code the verifier can emit (severity: error = strict raises,
+#: warn = reported but never fatal)
+CODES = {
+    # TPU4xx — collective safety (static desync analysis)
+    "TPU401": ("warn", "collective under a data-dependent while_loop "
+                       "(per-rank trip counts can diverge)"),
+    "TPU402": ("error", "branch arms trace mismatched collective "
+                        "sequences (static desync)"),
+    "TPU403": ("error", "collective group/axes/shape differs between "
+                        "branch arms at the same position"),
+    "TPU404": ("error", "collective ordering diverges between branch "
+                        "arms"),
+    # TPU5xx — sharding/mesh pre-flight
+    "TPU501": ("error", "sharded dimension not divisible by its mesh "
+                        "axes"),
+    "TPU502": ("warn", "op with no sharding rule on the hot path "
+                       "(replicate fallback)"),
+    "TPU503": ("warn", "Partial (reduce-pending) value consumed "
+                       "without a reduction"),
+    # TPU6xx — donation hazards
+    "TPU601": ("error", "donated parameter host-read inside the traced "
+                        "step (stale after donation)"),
+    # TPU7xx — program contract
+    "TPU700": ("warn", "op not present in the registry"),
+    "TPU701": ("error", "operand shapes are not broadcast-compatible "
+                        "for this op"),
+    "TPU702": ("warn", "silent float downcast (f32 operand, narrower "
+                       "output) outside the AMP white-list"),
+    "TPU703": ("warn", "dead op: no output is consumed or fetched"),
+    "TPU704": ("warn", "in-place target read after mutation (replay "
+                       "sees the stale pre-mutation value)"),
+    "TPU705": ("error", "fetched value is produced by no op and is "
+                        "neither a feed nor a captured parameter"),
+}
+
+#: op names the collective pass treats as fleet-wide synchronization
+#: points (the ``distributed.communication`` surface; recorded into
+#: branch traces by the collective layer's branch-trace seam)
+COLLECTIVE_OPS = frozenset({
+    "all_reduce", "all_gather", "all_gather_object", "reduce_scatter",
+    "broadcast", "reduce", "scatter", "alltoall", "alltoall_single",
+    "barrier", "send", "recv", "isend", "irecv",
+})
+
+#: control-flow ops whose branch arms must agree on collectives
+_ARM_OPS = ("conditional_block", "switch_case")
+_LOOP_OPS = ("while_loop",)
+
+#: ops exempt from the downcast check: the cast IS the semantics
+_CAST_OPS = frozenset({"cast", "astype", "to", "type_as", "amp_cast"})
+
+#: binary elementwise ops whose output is the numpy broadcast of the
+#: inputs — the contract the fusion pass and synthetic IRs must honor
+_ELEMENTWISE_BINARY = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum",
+    "pow", "floor_divide", "remainder", "fmax", "fmin",
+})
+
+
+class ProgramVerifierError(RuntimeError):
+    """FLAGS_verify_programs=strict: the program failed verification.
+    The message names every finding with its op index and source line —
+    raised before XLA ever sees the program."""
+
+
+class ProgramVerifierWarning(UserWarning):
+    """Default (warn) mode: findings are reported through this
+    category so callers can filter or escalate them."""
+
+
+@dataclass
+class Finding:
+    code: str
+    op_index: int            # -1 = program-level
+    op_name: str
+    message: str
+    loc: str = ""            # "file.py:123" provenance of the op
+
+    @property
+    def severity(self) -> str:
+        return CODES.get(self.code, ("error", ""))[0]
+
+    def render(self) -> str:
+        where = f"op#{self.op_index} {self.op_name}" \
+            if self.op_index >= 0 else "program"
+        at = f" ({self.loc})" if self.loc else ""
+        return f"{self.code} {where}{at}: {self.message}"
+
+
+@dataclass
+class Report:
+    label: str = "program"
+    findings: List[Finding] = field(default_factory=list)
+    #: per-pass stats (ops walked, passes run) for tooling
+    stats: Dict[str, object] = field(default_factory=dict)
+
+    def add(self, code, op_index, op_name, message, loc=""):
+        self.findings.append(Finding(code, op_index, op_name, message,
+                                     loc))
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    @property
+    def warnings(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "warn"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def codes(self) -> List[str]:
+        return sorted({f.code for f in self.findings})
+
+    def render(self) -> str:
+        head = f"verifier: {len(self.findings)} finding(s) in " \
+               f"{self.label}"
+        return "\n".join([head] + [f"  {f.render()}"
+                                   for f in self.findings])
+
+
+def mode() -> str:
+    """Current FLAGS_verify_programs mode: off | warn | strict."""
+    from ..core import flags
+    v = str(flags.get_flag("verify_programs") or "off").lower()
+    if v in ("", "0", "false", "off", "none"):
+        return "off"
+    if v in ("strict", "raise", "error"):
+        return "strict"
+    return "warn"
+
+
+def enforce(report: Report, mode_: Optional[str] = None):
+    """Apply the flag policy to a report: strict raises
+    :class:`ProgramVerifierError` when any error-severity finding
+    exists; otherwise findings surface as one
+    :class:`ProgramVerifierWarning`."""
+    m = mode_ if mode_ is not None else mode()
+    if m == "off" or report.ok:
+        return report
+    if m == "strict" and report.errors:
+        raise ProgramVerifierError(report.render())
+    warnings.warn(report.render(), ProgramVerifierWarning, stacklevel=3)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Source provenance: first frame outside the framework's capture
+# machinery — the line the finding should point the user at.
+# ---------------------------------------------------------------------------
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SKIP_PARTS = (os.path.join("paddle_tpu", "core"),
+               os.path.join("paddle_tpu", "static"),
+               os.path.join("paddle_tpu", "jit"),
+               os.path.join("paddle_tpu", "compile"),
+               os.path.join("paddle_tpu", "distributed", "spmd"),
+               os.path.join("paddle_tpu", "ops"),
+               os.path.join("paddle_tpu", "nn", "functional"))
+
+
+def user_loc(max_depth: int = 30) -> str:
+    """Walk up the stack past dispatch/capture frames to the first
+    user-owned line (best effort; "" when everything is framework)."""
+    try:
+        f = sys._getframe(2)
+    except ValueError:                       # pragma: no cover
+        return ""
+    first_fw = ""
+    for _ in range(max_depth):
+        if f is None:
+            break
+        fn = f.f_code.co_filename
+        if not any(p in fn for p in _SKIP_PARTS):
+            return f"{os.path.basename(fn)}:{f.f_lineno}"
+        if not first_fw and _PKG_DIR in fn:
+            first_fw = f"{os.path.basename(fn)}:{f.f_lineno}"
+        f = f.f_back
+    return first_fw
+
+
+# ---------------------------------------------------------------------------
+# Record normalization: every compile path's steps qualify
+# ---------------------------------------------------------------------------
+class Record:
+    """Uniform view of one IR step (``_OpRecord`` / ``FusedStep`` /
+    verifier trace entries / hand-built fixture records)."""
+
+    __slots__ = ("name", "fn", "in_ids", "out_ids", "attrs", "in_shapes",
+                 "out_shapes", "in_dtypes", "out_dtypes", "loc")
+
+    def __init__(self, name, in_ids=(), out_ids=(), attrs=None,
+                 in_shapes=(), out_shapes=(), in_dtypes=(),
+                 out_dtypes=(), loc="", fn=None):
+        self.name = name
+        self.fn = fn
+        self.in_ids = tuple(in_ids)
+        self.out_ids = tuple(out_ids)
+        self.attrs = dict(attrs or {})
+        self.in_shapes = tuple(tuple(s) for s in in_shapes)
+        self.out_shapes = tuple(tuple(s) for s in out_shapes)
+        self.in_dtypes = tuple(str(d) for d in in_dtypes)
+        self.out_dtypes = tuple(str(d) for d in out_dtypes)
+        self.loc = loc
+
+    @classmethod
+    def of(cls, step) -> "Record":
+        if isinstance(step, cls):
+            return step
+        return cls(
+            name=step.name, fn=getattr(step, "fn", None),
+            in_ids=step.in_ids, out_ids=step.out_ids,
+            attrs=getattr(step, "attrs", None) or {},
+            in_shapes=getattr(step, "in_shapes", ()) or (),
+            out_shapes=getattr(step, "out_shapes", ()) or (),
+            in_dtypes=getattr(step, "in_dtypes", ()) or (),
+            out_dtypes=getattr(step, "out_dtypes", ()) or (),
+            loc=getattr(step, "loc", "") or "")
+
+
+def _records_of(program_or_steps):
+    """(records, program-or-None) from either entry form."""
+    block = getattr(program_or_steps, "global_block", None)
+    if block is not None:
+        return ([Record.of(op) for op in block().ops], program_or_steps)
+    return ([Record.of(op) for op in program_or_steps], None)
+
+
+# ---------------------------------------------------------------------------
+# Pass 1 — contract (TPU7xx)
+# ---------------------------------------------------------------------------
+def _broadcastable(a, b) -> bool:
+    for x, y in zip(reversed(a), reversed(b)):
+        if x != y and x != 1 and y != 1:
+            return False
+    return True
+
+
+def _float_key(dt: str) -> int:
+    return {"float16": 16, "bfloat16": 16, "float32": 32,
+            "float64": 64}.get(dt, 0)
+
+
+def _contract_pass(records: List[Record], report: Report,
+                   fetch_ids=None, known_ids=()):
+    from ..ops.registry import OPS
+    from ..core.dispatch import AMP_WHITE_OPS
+    inplace_targets = {d.inplace_variant for d in OPS.values()
+                       if d.inplace_variant}
+    try:
+        from ..ops.inplace import INPLACE_OF
+        inplace_targets.update(INPLACE_OF)
+    except Exception:                # pragma: no cover - partial import
+        pass
+    produced: Dict[int, int] = {}
+    consumed_after: Dict[int, int] = {}
+    for i, r in enumerate(records):
+        for v in r.in_ids:
+            consumed_after[v] = i
+    known = set(known_ids)
+    fetch_set = set(fetch_ids) if fetch_ids is not None else set()
+    for i, r in enumerate(records):
+        if r.name not in OPS and r.name not in COLLECTIVE_OPS:
+            report.add("TPU700", i, r.name,
+                       f"op {r.name!r} is not a registered op — the "
+                       f"registry carries no contract (cost/sharding/"
+                       f"doc) for it", r.loc)
+        # broadcast legality on the elementwise contract
+        if (r.name in _ELEMENTWISE_BINARY and len(r.in_shapes) >= 2):
+            a, b = r.in_shapes[0], r.in_shapes[1]
+            if not _broadcastable(a, b):
+                report.add("TPU701", i, r.name,
+                           f"operand shapes {a} and {b} do not "
+                           f"broadcast", r.loc)
+        # silent float downcast (round-15 fusion-review bug class)
+        if (r.out_dtypes and r.in_dtypes and r.name not in _CAST_OPS
+                and r.name.lower() not in AMP_WHITE_OPS):
+            widest = max((_float_key(d) for d in r.in_dtypes),
+                         default=0)
+            for o, od in enumerate(r.out_dtypes):
+                ok = _float_key(od)
+                if widest and ok and ok < widest:
+                    report.add(
+                        "TPU702", i, r.name,
+                        f"output {o} is {od} while a "
+                        f"{max(r.in_dtypes, key=_float_key)} operand "
+                        f"enters — a silent downcast unless this op is "
+                        f"AMP-white-listed", r.loc)
+        # in-place alias: the mutated Tensor's pre-mutation id is read
+        # later — the replay env serves the STALE value (eager saw the
+        # mutated one)
+        if r.name in inplace_targets and r.in_ids:
+            tgt = r.in_ids[0]
+            last = consumed_after.get(tgt, -1)
+            fetched = tgt in fetch_set
+            if last > i or fetched:
+                report.add(
+                    "TPU704", i, r.name,
+                    f"in-place op mutates v{tgt} but its pre-mutation "
+                    f"value is {'fetched' if fetched and last <= i else f'read by op#{last}'}"
+                    f" — a replay serves the stale value", r.loc)
+        for o in r.out_ids:
+            produced[o] = i
+        known.update(r.out_ids)
+    if fetch_ids is not None:
+        for fid in fetch_ids:
+            if fid not in known:
+                report.add("TPU705", -1, "<fetch>",
+                           f"fetched value v{fid} is produced by no op "
+                           f"and is neither a feed nor a captured "
+                           f"parameter")
+        used = set()
+        for r in records:
+            used.update(r.in_ids)
+        for i, r in enumerate(records):
+            if r.name in _ARM_OPS + _LOOP_OPS:
+                continue             # constructs may run for effect
+            if r.out_ids and not any(o in used or o in fetch_set
+                                     for o in r.out_ids):
+                report.add("TPU703", i, r.name,
+                           "no output of this op is consumed or "
+                           "fetched (dead op)", r.loc)
+
+
+# ---------------------------------------------------------------------------
+# Pass 2 — collective safety (TPU4xx)
+# ---------------------------------------------------------------------------
+def _branch_meta(r: Record):
+    fn = r.fn
+    meta = getattr(fn, "_verifier_branches", None) \
+        if fn is not None else None
+    if meta is None:
+        meta = r.attrs.get("_verifier_branches")
+    return meta
+
+
+def _is_collective_entry(entry) -> bool:
+    """A branch-trace entry is a collective only when it came through
+    the collective layer's branch-trace seam, which always stamps the
+    ``group`` attr — name membership alone would confuse the plain
+    TENSOR op ``scatter`` (indexing) with the distributed primitive."""
+    return (entry["name"] in COLLECTIVE_OPS
+            and "group" in (entry.get("attrs") or {}))
+
+
+def _coll_signature(entry) -> tuple:
+    """(name, attrs, shape) identity of one traced collective — the
+    full attr set the seam stamps (group/axes plus reduce op, src, …),
+    i.e. the fields flight.diff_ranks compares across ranks."""
+    attrs = entry.get("attrs") or {}
+    return (entry["name"],
+            tuple(sorted((k, v) for k, v in attrs.items())),
+            tuple(entry.get("shape") or ()))
+
+
+def _branch_collectives(ops, out):
+    """Flatten one branch trace's collective sequence (nested
+    constructs contribute their first arm — nested mismatches are
+    flagged on their own construct)."""
+    for entry in ops:
+        if _is_collective_entry(entry):
+            out.append(_coll_signature(entry))
+        meta = entry.get("branches")
+        if meta:
+            branches = meta.get("branches") or []
+            if meta.get("construct") in _LOOP_OPS:
+                for b in branches:
+                    _branch_collectives(b, out)
+            elif branches:
+                _branch_collectives(branches[0], out)
+    return out
+
+
+def _iter_constructs(ops):
+    """Yield nested construct metas inside a branch trace."""
+    for entry in ops:
+        meta = entry.get("branches")
+        if meta:
+            yield meta
+
+
+def _check_construct(meta, i, name, loc, report: Report):
+    branches = meta.get("branches") or []
+    construct = meta.get("construct", name)
+    if construct in _LOOP_OPS:
+        colls = []
+        for b in branches:
+            _branch_collectives(b, colls)
+        if colls:
+            names = sorted({c[0] for c in colls})
+            report.add(
+                "TPU401", i, name,
+                f"collective(s) {names} execute under a data-dependent "
+                f"loop — ranks whose predicates disagree run different "
+                f"collective counts and desynchronize", loc)
+    else:
+        seqs = [_branch_collectives(b, []) for b in branches]
+        if any(seqs):
+            base = seqs[0]
+            for bi, s in enumerate(seqs[1:], start=1):
+                if [c[0] for c in s] != [c[0] for c in base]:
+                    if sorted(c[0] for c in s) == \
+                            sorted(c[0] for c in base):
+                        report.add(
+                            "TPU404", i, name,
+                            f"branch 0 orders collectives "
+                            f"{[c[0] for c in base]} but branch {bi} "
+                            f"orders {[c[0] for c in s]} — ranks taking "
+                            f"different arms cross-match transports",
+                            loc)
+                    else:
+                        report.add(
+                            "TPU402", i, name,
+                            f"branch 0 traces collectives "
+                            f"{[c[0] for c in base]} but branch {bi} "
+                            f"traces {[c[0] for c in s]} — ranks taking "
+                            f"different arms desynchronize", loc)
+                    continue
+                for k, (ca, cb) in enumerate(zip(base, s)):
+                    if ca != cb:
+                        report.add(
+                            "TPU403", i, name,
+                            f"collective #{k} ({ca[0]}) differs "
+                            f"between branch 0 {ca[1:]} and branch "
+                            f"{bi} {cb[1:]} (group/axes/shape must "
+                            f"match for the transports to pair)", loc)
+    # recurse into nested constructs of every arm
+    for b in branches:
+        for sub in _iter_constructs(b):
+            _check_construct(sub, i, f"{name}/nested", loc, report)
+
+
+def _collective_pass(records: List[Record], report: Report):
+    for i, r in enumerate(records):
+        if r.name not in _ARM_OPS + _LOOP_OPS:
+            continue
+        meta = _branch_meta(r)
+        if meta is None:
+            continue                 # pre-seam record: nothing to read
+        _check_construct(meta, i, r.name, r.loc, report)
+
+
+# ---------------------------------------------------------------------------
+# Pass 3 — sharding / mesh pre-flight (TPU5xx)
+# ---------------------------------------------------------------------------
+#: op names that legitimately consume a Partial value (they ARE the
+#: pending reduction)
+_PARTIAL_RESOLVERS = frozenset({"all_reduce", "reduce_scatter",
+                                "reduce", "mp_allreduce_sum"})
+
+
+def _axes_product(mesh, axes) -> int:
+    n = 1
+    for a in (axes if isinstance(axes, tuple) else (axes,)):
+        if a is None:
+            continue
+        n *= int(mesh.shape[a])
+    return n
+
+
+def _check_divisibility(spec, shape, mesh, where, i, name, loc,
+                        report: Report, seen):
+    if spec is None:
+        return
+    for d, (entry, size) in enumerate(zip(spec, shape)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        factor = _axes_product(mesh, axes)
+        if factor > 1 and int(size) % factor != 0:
+            key = (i, where, d)
+            if key in seen:
+                continue
+            seen.add(key)
+            report.add(
+                "TPU501", i, name,
+                f"{where} dim {d} (size {size}) is sharded over mesh "
+                f"axes {list(axes)} (x{factor}) but {size} % {factor} "
+                f"!= 0 — the constraint will be silently dropped or "
+                f"padded", loc)
+
+
+def _sharding_pass(records, program, mesh, in_specs, param_specs,
+                   fetch_ids, report: Report, plan=None):
+    from ..distributed.spmd import rules as R
+    from ..distributed.spmd import propagate as prop
+    R.attach_spmd_rules()
+    if plan is not None and len(plan.annotations) != len(records):
+        plan = None                  # stale plan: recompute
+    env: Dict[int, tuple] = {}
+    partial_env: Dict[int, tuple] = {}
+    seen_div = set()
+    if program is not None:
+        for fname, vid in program.feed_vars.items():
+            shape = program._feed_shapes.get(fname, ())
+            spec = R.normalize((in_specs or {}).get(fname), len(shape))
+            env[vid] = spec
+            _check_divisibility(spec, [abs(s) for s in shape], mesh,
+                                f"feed {fname!r}", -1, "<feed>", "",
+                                report, seen_div)
+        for vid, t in program._captured.items():
+            spec = R.normalize(
+                prop.param_spec_of(t, param_specs), len(t.shape))
+            env[vid] = spec
+            _check_divisibility(spec, t.shape, mesh,
+                                f"param {getattr(t, 'name', vid)!r}",
+                                -1, "<param>", "", report, seen_div)
+    elif isinstance(in_specs, dict):
+        env.update(in_specs)         # records path: id -> spec seeds
+    # forward propagation mirroring propagate_program, plus the checks
+    hot = set(fetch_ids or ())
+    # hot path = ancestors of fetched values (all ops when no fetches)
+    producers: Dict[int, int] = {}
+    for i, r in enumerate(records):
+        for o in r.out_ids:
+            producers[o] = i
+    on_hot = [fetch_ids is None] * len(records)
+    if fetch_ids is not None:
+        work = list(hot)
+        seen_v = set()
+        while work:
+            v = work.pop()
+            if v in seen_v:
+                continue
+            seen_v.add(v)
+            pi = producers.get(v)
+            if pi is None:
+                continue
+            on_hot[pi] = True
+            work.extend(records[pi].in_ids)
+    fallbacks = {}
+    for i, r in enumerate(records):
+        in_shapes = r.in_shapes or tuple(() for _ in r.in_ids)
+        out_shapes = r.out_shapes or tuple(() for _ in r.out_ids)
+        if plan is not None:
+            # reuse the caller's propagation (shard_program hands its
+            # ShardingPlan in, so the pass never re-runs the rules)
+            res, tier = plan.annotations[i], plan.annotations[i].tier
+        else:
+            ins = [env.get(v, (None,) * len(s))
+                   for v, s in zip(r.in_ids, in_shapes)]
+            res, tier = prop.apply_rule(r.name, ins, in_shapes,
+                                        r.attrs, out_shapes)
+        if tier == "replicate-warn" and on_hot[i]:
+            fallbacks.setdefault(r.name, i)
+        # Partial consumed without reduction
+        for v in r.in_ids:
+            pend = partial_env.get(v)
+            if not pend:
+                continue
+            if r.name in _PARTIAL_RESOLVERS:
+                continue
+            if any(res.out_partial):
+                continue             # still pending, tracked forward
+            report.add(
+                "TPU503", i, r.name,
+                f"v{v} carries a pending reduction over mesh axes "
+                f"{list(pend)} (Partial) but {r.name!r} consumes it "
+                f"without reducing — the partial sums leak into the "
+                f"result unless the partitioner resolves them "
+                f"implicitly", r.loc)
+        for v, spec, shape in zip(r.in_ids, res.in_specs, in_shapes):
+            _check_divisibility(spec, shape, mesh, "input", i, r.name,
+                                r.loc, report, seen_div)
+        for v, spec, pend, shape in zip(
+                r.out_ids, res.out_specs,
+                res.out_partial + [()] * len(r.out_ids), out_shapes):
+            env[v] = spec
+            _check_divisibility(spec, shape, mesh, "output", i, r.name,
+                                r.loc, report, seen_div)
+            if pend:
+                partial_env[v] = pend
+    for name, i in sorted(fallbacks.items(), key=lambda kv: kv[1]):
+        report.add(
+            "TPU502", i, name,
+            f"{name!r} has no sharding rule (named or category) and "
+            f"sits on the hot path — its outputs replicate and every "
+            f"downstream shard is lost", records[i].loc)
+    if fetch_ids is not None:
+        for fid in fetch_ids:
+            pend = partial_env.get(fid)
+            if pend:
+                report.add(
+                    "TPU503", producers.get(fid, -1), "<fetch>",
+                    f"fetched value v{fid} is still Partial over mesh "
+                    f"axes {list(pend)} — the caller receives "
+                    f"unreduced partial sums")
+
+
+# ---------------------------------------------------------------------------
+# Pass 4 — donation hazards (TPU6xx)
+# ---------------------------------------------------------------------------
+def _donation_pass(host_reads, report: Report):
+    for read in host_reads or ():
+        report.add(
+            "TPU601", int(read.get("pos", -1)),
+            str(read.get("param", "<param>")),
+            f"parameter {read.get('param')!r} is marked for donation "
+            f"but the traced step host-reads it via "
+            f"{read.get('site', 'a host read')} — after the donating "
+            f"call that buffer no longer holds data",
+            read.get("loc", ""))
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+def check(program, mesh=None, in_specs=None, param_specs=None,
+          fetch_ids=None, host_reads=(), label=None,
+          contract=True, plan=None) -> Report:
+    """Verify a recorded program (or any op-record list).
+
+    ``program``: a ``static.Program`` or a sequence of records carrying
+    ``name/in_ids/out_ids/attrs/in_shapes/out_shapes`` (optionally
+    dtypes + ``loc``). ``mesh``/``in_specs``/``param_specs`` arm the
+    sharding pass (same arguments as ``spmd.shard_program``).
+    ``fetch_ids`` are the externally visible value ids (enables the
+    dead/unfetchable analysis). ``host_reads`` feeds the donation pass
+    (see :func:`audit_step`). ``plan`` is an optional
+    already-computed ``ShardingPlan`` for this exact record list —
+    callers that propagate anyway (``spmd.shard_program``) hand it in
+    so the sharding pass never re-runs the rules. Returns a
+    :class:`Report`; apply the flag policy with :func:`enforce`.
+    """
+    records, prog = _records_of(program)
+    report = Report(label=label or ("Program" if prog is not None
+                                    else "records"))
+    known = set()
+    if prog is not None:
+        known.update(prog.feed_vars.values())
+        known.update(prog._captured.keys())
+    if isinstance(in_specs, dict) and prog is None:
+        known.update(in_specs.keys())
+    if contract:
+        _contract_pass(records, report, fetch_ids=fetch_ids,
+                       known_ids=known)
+    _collective_pass(records, report)
+    if mesh is not None:
+        _sharding_pass(records, prog, mesh, in_specs, param_specs,
+                       fetch_ids, report, plan=plan)
+    _donation_pass(host_reads, report)
+    report.stats = {"ops": len(records),
+                    "passes": ["contract" if contract else None,
+                               "collective",
+                               "sharding" if mesh is not None else None,
+                               "donation" if host_reads else None]}
+    return report
+
+
+check_records = check
+
+
+def audit_step(fn, args=(), kwargs=None, donate_params=(), mesh=None,
+               in_specs=None, param_specs=None, label=None) -> Report:
+    """Trace ``fn(*args, **kwargs)`` eagerly into a fresh program and
+    verify it — including the donation pass: host reads of any
+    parameter in ``donate_params`` during the step are recorded via the
+    ``core.donation`` watch seam and flagged TPU601.
+
+    This is the offline complement of the ``to_static`` wiring (which
+    watches the real jit trace); the planner uses the same
+    trace-eagerly-once idiom."""
+    from ..core import donation as _donation
+    from ..core.tensor import Tensor
+    from .program import Program, program_guard
+
+    prog = Program()
+    donate_params = list(donate_params)
+    payload_to_param = {id(p._data): p for p in donate_params}
+    host_reads: List[dict] = []
+
+    def _watch(arr, site):
+        p = payload_to_param.get(id(arr))
+        if p is None:
+            return
+        host_reads.append({
+            "param": getattr(p, "name", None) or f"param@{id(p)}",
+            "site": site, "loc": user_loc(),
+            "pos": len(prog.global_block().ops)})
+
+    with program_guard(prog):
+        with _donation.watch_reads(_watch):
+            out = fn(*args, **(kwargs or {}))
+    import jax
+    leaves, _ = jax.tree_util.tree_flatten(
+        out, is_leaf=lambda x: isinstance(x, Tensor))
+    fetch_ids = [id(l) for l in leaves if isinstance(l, Tensor)]
+    return check(prog, mesh=mesh, in_specs=in_specs,
+                 param_specs=param_specs, fetch_ids=fetch_ids or None,
+                 host_reads=host_reads,
+                 label=label or getattr(fn, "__name__", "step"))
+
+
+# ---------------------------------------------------------------------------
+# Online scope: record + verify a to_static / Engine trace
+# ---------------------------------------------------------------------------
+class trace_scope:
+    """Record every dispatched op during a jit trace (the same recorder
+    seam the fusion pass and spmd propagation ride) and verify the
+    stream when the trace completes.
+
+    Used by ``jit/api.py``: enter before the first-call compile, call
+    :meth:`note_donated` from ``jit_target`` once the params are
+    rebound to tracers, and :meth:`finish` after a successful trace.
+    On a graph break, :meth:`donation_report` surfaces any donated
+    host-read recorded before the break."""
+
+    def __init__(self, label="to_static", donate=False):
+        self.label = label
+        self.donate = donate
+        self.records: List[Record] = []
+        self.host_reads: List[dict] = []
+        self._donated_payloads: Dict[int, object] = {}
+        self._watch_token = None
+
+    # -- dispatch recorder hook -------------------------------------------
+    def _hook(self, op_name, f, tensor_inputs, out_tensors, attrs=None):
+        self.records.append(Record(
+            name=op_name, fn=f,
+            in_ids=tuple(id(t) for t in tensor_inputs),
+            out_ids=tuple(id(t) for t in out_tensors),
+            attrs=attrs or {},
+            in_shapes=tuple(tuple(t.shape) for t in tensor_inputs),
+            out_shapes=tuple(tuple(t.shape) for t in out_tensors),
+            in_dtypes=tuple(str(t.dtype) for t in tensor_inputs),
+            out_dtypes=tuple(str(t.dtype) for t in out_tensors),
+            loc=user_loc()))
+
+    # -- donation watch ----------------------------------------------------
+    def begin_trace(self, params=()):
+        """Called at the top of the traced target, after params are
+        rebound onto the trace's argument tracers: resets the record
+        stream (jax may retrace the target) and notes the donated
+        payloads — a host read of one of THESE during the trace is a
+        donated-then-read hazard."""
+        self.records = []
+        self.host_reads = []
+        if self.donate:
+            self._donated_payloads = {
+                id(p._data): (getattr(p, "name", None) or f"param#{i}")
+                for i, p in enumerate(params)}
+
+    note_donated = begin_trace
+
+    def _watch(self, arr, site):
+        name = self._donated_payloads.get(id(arr))
+        if name is None:
+            return
+        self.host_reads.append({
+            "param": name, "site": site, "loc": user_loc(),
+            "pos": len(self.records)})
+
+    def __enter__(self):
+        from ..core import dispatch
+        from ..core import donation as _donation
+        dispatch.register_recorder_hook(self._hook)
+        if self.donate:
+            self._watch_token = _donation.watch_reads(self._watch)
+            self._watch_token.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        from ..core import dispatch
+        dispatch.unregister_recorder_hook(self._hook)
+        if self._watch_token is not None:
+            self._watch_token.__exit__(*exc)
+            self._watch_token = None
+        return False
+
+    # -- verdicts ----------------------------------------------------------
+    def finish(self) -> Report:
+        """Verify the recorded stream (contract + collective passes —
+        sharding constraints on this path are owned by the spmd
+        trace_scope's own propagation) and apply the flag policy.
+        Called at END OF TRACE, before lowering/compile. The record
+        stream (op fns are closure-bearing) is dropped once the report
+        is built so the scope retains nothing after the compile."""
+        report = check(self.records, host_reads=self.host_reads,
+                       label=self.label, fetch_ids=None)
+        self.records = []
+        self.host_reads = []
+        self._donated_payloads = {}
+        return enforce(report)
+
+    def donation_report(self) -> Optional[Report]:
+        """Report covering only the donated host-read hazards (the
+        graph-break path: the trace died mid-stream, so contract
+        analysis over the partial stream would be noise)."""
+        if not self.host_reads:
+            self.records = []
+            return None
+        report = Report(label=self.label)
+        _donation_pass(self.host_reads, report)
+        self.records = []
+        self.host_reads = []
+        self._donated_payloads = {}
+        return report

@@ -1,0 +1,86 @@
+"""Must-NOT-flag corpus: re-binding patterns that used to (or could)
+false-positive the taint engine.
+
+Covers the FP classes fixed alongside the program-verifier round:
+
+* a loop/comprehension target re-bound over an UNTAINTED iterable after
+  the same name held a tensor (the two-pass back-edge union used to
+  leak the stale taint into later augmented assignments / predicates);
+* augmented assignment on such a re-bound counter;
+* walrus assignment re-binding a name to host metadata;
+* try/finally re-binds clearing a tensor-held name;
+* bare truthiness of a container that HOLDS tensors (an emptiness
+  check — ``bool()`` never touches the elements);
+* branching on a cache entry that stores ``jax.jit`` wrappers
+  (callables, not device data).
+
+Every construct here is trace-safe; the analyzer must emit nothing.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def aug_assign_after_loop_rebind(ts):
+    out = []
+    for t in jnp.stack(ts):          # t: tensor loop variable
+        out.append(t)
+    n = 0
+    for t in range(3):               # t re-bound over host ints
+        n += t                       # augmented assign on the re-bind
+    if n > 2:                        # predicate on the host counter
+        return len(out)
+    return 0
+
+
+def comprehension_shadow(ts):
+    rows = jnp.stack(ts)
+    picked = [r for r in rows]       # r: tensor comprehension target
+    small = [r for r in range(4)]    # r shadowed over host ints
+    total = 0
+    total += len(small)
+    if total:
+        return picked
+    return []
+
+
+def walrus_rebind(t, names):
+    total = jnp.sum(t)               # total holds a tensor...
+    if (total := len(names)) > 0:    # ...walrus re-binds it to an int
+        return total
+    while (k := t.ndim):             # static metadata walrus predicate
+        return k
+    return 0
+
+
+def try_finally_rebind(t):
+    acc = jnp.sum(t)                 # tensor-held before the try
+    try:
+        out = acc + 1
+    finally:
+        acc = None                   # finally clears the binding
+    if acc:                          # predicate on the cleared name
+        return None
+    return out
+
+
+def container_emptiness(ps, state_dict):
+    params = [p for p in ps if p is not None]
+    if not params:                   # emptiness check on a tensor list
+        return None
+    st = {}
+    for name in state_dict:
+        st[name] = jnp.asarray(state_dict[name])
+    if st:                           # emptiness check on a tensor dict
+        return st
+    return params
+
+
+def jit_cache_entry(fn, key):
+    cache = {}
+    cache[key] = jax.jit(fn)         # stores a CALLABLE, not data
+    ent = cache.get(key)
+    if ent is None:
+        return None
+    elif ent:                        # truthiness of the wrapper is safe
+        return ent
+    return None

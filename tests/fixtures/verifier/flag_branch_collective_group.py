@@ -1,0 +1,32 @@
+"""Must-flag: the arms run the same collective SEQUENCE but with
+different payload content (shape here; group/axes are compared the
+same way) — the transports pair positionally and then mismatch, the
+exact content-divergence ``flight.diff_ranks`` names at runtime.
+TPU403."""
+import numpy as np
+
+EXPECT = ["TPU403"]
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import static
+    from paddle_tpu.static import verifier
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+
+        def full_then_sum():
+            # collective over the (4, 8) activations
+            return dist.all_reduce(x * 2.0).sum()
+
+        def sum_then_reduce():
+            # collective over the () scalar — same op, different content
+            return dist.all_reduce((x * 3.0).sum())
+
+        out = static.nn.cond(paddle.to_tensor(True), full_then_sum,
+                             sum_then_reduce)
+    return verifier.check(prog, fetch_ids=[id(out)],
+                          label="flag_branch_collective_group")

@@ -1,0 +1,28 @@
+"""Must-flag: cond arms trace DIFFERENT collective sequences — the
+static desync (rank A takes the all-reducing arm, rank B the silent
+one; A blocks inside the transport forever). TPU402."""
+import numpy as np
+
+EXPECT = ["TPU402"]
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import static
+    from paddle_tpu.static import verifier
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+
+        def with_reduce():
+            return dist.all_reduce(x * 2.0)
+
+        def without():
+            return x * 3.0
+
+        out = static.nn.cond(paddle.to_tensor(True), with_reduce,
+                             without)
+    return verifier.check(prog, fetch_ids=[id(out)],
+                          label="flag_branch_collective_mismatch")

@@ -1,0 +1,30 @@
+"""Must-flag: both arms run the same collectives but in OPPOSITE
+order — ranks taking different arms cross-match transports (A's
+all_reduce pairs with B's broadcast). TPU404."""
+import numpy as np
+
+EXPECT = ["TPU404"]
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import static
+    from paddle_tpu.static import verifier
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+
+        def reduce_then_bcast():
+            a = dist.all_reduce(x * 2.0)
+            return dist.broadcast(a, 0)
+
+        def bcast_then_reduce():
+            a = dist.broadcast(x * 3.0, 0)
+            return dist.all_reduce(a)
+
+        out = static.nn.cond(paddle.to_tensor(True), reduce_then_bcast,
+                             bcast_then_reduce)
+    return verifier.check(prog, fetch_ids=[id(out)],
+                          label="flag_branch_collective_order")

@@ -1,0 +1,53 @@
+"""Must-flag: the contract pass (TPU7xx) over a hand-built record
+list — the IR shape every compile path hands the verifier. One
+program exercising every contract code:
+
+* an op name the registry has never seen (TPU700);
+* a broadcast-illegal elementwise add (TPU701) — recorded programs
+  can't produce this (they executed), but fusion rewrites and
+  synthetic IRs can;
+* a silent f32 -> bf16 downcast outside the AMP white-list (TPU702,
+  the round-15 fusion-review bug class);
+* a dead op whose outputs nothing consumes or fetches (TPU703);
+* an in-place op whose target is read again later — the replay env
+  serves the stale pre-mutation value (TPU704);
+* a fetch of a value no op produces (TPU705).
+"""
+EXPECT = ["TPU700", "TPU701", "TPU702", "TPU703", "TPU704", "TPU705"]
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    records = [
+        # v1, v2 feeds; v3 = mystery_op(v1)           -> TPU700
+        R("mystery_op", in_ids=[1], out_ids=[3],
+          in_shapes=[(4, 8)], out_shapes=[(4, 8)],
+          loc="fixture.py:1"),
+        # v4 = add(v3, v2) with non-broadcast shapes  -> TPU701
+        R("add", in_ids=[3, 2], out_ids=[4],
+          in_shapes=[(4, 8), (3, 5)], out_shapes=[(4, 8)],
+          loc="fixture.py:2"),
+        # v5 = multiply(v4, v2): f32 in, bf16 out     -> TPU702
+        R("multiply", in_ids=[4, 2], out_ids=[5],
+          in_shapes=[(4, 8), (4, 8)], out_shapes=[(4, 8)],
+          in_dtypes=["float32", "float32"], out_dtypes=["bfloat16"],
+          loc="fixture.py:3"),
+        # v6 = exp(v5): nothing ever reads v6         -> TPU703
+        R("exp", in_ids=[5], out_ids=[6],
+          in_shapes=[(4, 8)], out_shapes=[(4, 8)],
+          loc="fixture.py:4"),
+        # abs_(v5) mutates v5 in place...             -> TPU704
+        R("abs_", in_ids=[5], out_ids=[7],
+          in_shapes=[(4, 8)], out_shapes=[(4, 8)],
+          loc="fixture.py:5"),
+        # ...but v5's pre-mutation value is read here
+        R("add", in_ids=[5, 7], out_ids=[8],
+          in_shapes=[(4, 8), (4, 8)], out_shapes=[(4, 8)],
+          loc="fixture.py:6"),
+    ]
+    # fetch v8 plus v99, which nothing produces       -> TPU705
+    return verifier.check(records, fetch_ids=[8, 99],
+                          in_specs={1: None, 2: None},
+                          label="flag_contract")

@@ -1,0 +1,26 @@
+"""Must-flag: a train step whose body host-reads a parameter marked
+for donation — after the donating compiled call, that buffer holds
+nothing; the read the round-17 runtime registry would only catch in
+production is flagged statically here. TPU601."""
+import numpy as np
+
+EXPECT = ["TPU601"]
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import verifier
+
+    paddle.seed(11)
+    lin = nn.Linear(8, 8)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+
+    def step(inp):
+        out = lin(inp).sum()
+        _snapshot = lin.weight.numpy()        # stale after donation
+        return out
+
+    return verifier.audit_step(step, (x,),
+                               donate_params=list(lin.parameters()),
+                               label="flag_donated_read")

@@ -1,0 +1,41 @@
+"""Must-flag: the sharding/mesh pre-flight (TPU5xx) over a real
+recorded Program on a (data, tp) mesh —
+
+* the feed's batch dim (6) is sharded over the 4-way tp axis: 6 % 4
+  != 0, the constraint silently drops or pads (TPU501);
+* a matmul whose CONTRACTED dim is sharded emits a Partial
+  (reduce-pending) value that a plain add then consumes without any
+  reduction (TPU503);
+* an op with no sharding rule sits on the hot path and replicates
+  everything downstream (TPU502 — plus TPU700: the unregistered name
+  is exactly why it has no rule).
+"""
+import numpy as np
+
+EXPECT = ["TPU501", "TPU502", "TPU503", "TPU700"]
+
+
+def build():
+    import paddle_tpu as paddle
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu import static
+    from paddle_tpu.core import dispatch
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.static import verifier
+
+    mesh = mesh_mod.build_mesh(dict(data=2, tp=4))
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [6, 8], "float32")       # 6 % 4 != 0
+        w = paddle.to_tensor(np.ones((8, 8), np.float32))
+        y = paddle.matmul(x, w)                       # k sharded below
+        z = y + 1.0                                   # consumes Partial
+        out = dispatch.call("no_rule_op_for_fixture",
+                            lambda a: a * 2.0, [z])   # replicate-warn
+    return verifier.check(
+        prog, mesh=mesh,
+        # dim 0 of x over tp (divisibility violation) AND the matmul's
+        # contracted dim sharded via the param spec (Partial source)
+        in_specs={"x": P("tp", None)},
+        param_specs=lambda t: P("tp", None),
+        fetch_ids=[id(out)], label="flag_sharding")

@@ -1,0 +1,28 @@
+"""Must-flag (warn severity): a collective under a data-dependent
+while_loop — per-rank predicates can disagree on the trip count, so
+ranks run different collective COUNTS. TPU401."""
+import numpy as np
+
+EXPECT = ["TPU401"]
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import static
+    from paddle_tpu.static import verifier
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4], "float32")
+        i0 = paddle.to_tensor(0)
+
+        def keep(i, v):
+            return i < 3
+
+        def body(i, v):
+            return [i + 1, dist.all_reduce(v)]
+
+        _i, out = static.nn.while_loop(keep, body, [i0, x])
+    return verifier.check(prog, fetch_ids=[id(out)],
+                          label="flag_while_collective")

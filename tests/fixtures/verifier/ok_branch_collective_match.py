@@ -1,0 +1,27 @@
+"""Must-NOT-flag: both cond arms trace the SAME collective sequence
+(same op, same group identity, same payload shape) — whichever arm a
+rank takes, the transports pair up."""
+import numpy as np
+
+EXPECT = []
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu import static
+    from paddle_tpu.static import verifier
+
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+
+        def arm_a():
+            return dist.all_reduce(x * 2.0)
+
+        def arm_b():
+            return dist.all_reduce(x * 3.0)
+
+        out = static.nn.cond(paddle.to_tensor(False), arm_a, arm_b)
+    return verifier.check(prog, fetch_ids=[id(out)],
+                          label="ok_branch_collective_match")

@@ -1,0 +1,25 @@
+"""Must-NOT-flag: a well-formed record list — registered ops,
+broadcastable shapes, dtype-preserving math, every value consumed or
+fetched. The contract pass must stay silent (and so must every other
+pass)."""
+EXPECT = []
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    records = [
+        R("matmul", in_ids=[1, 2], out_ids=[3],
+          in_shapes=[(4, 8), (8, 8)], out_shapes=[(4, 8)],
+          in_dtypes=["float32", "float32"], out_dtypes=["float32"]),
+        R("add", in_ids=[3, 2], out_ids=[4],
+          in_shapes=[(4, 8), (8,)], out_shapes=[(4, 8)],
+          in_dtypes=["float32", "float32"], out_dtypes=["float32"]),
+        R("gelu", in_ids=[4], out_ids=[5],
+          in_shapes=[(4, 8)], out_shapes=[(4, 8)],
+          in_dtypes=["float32"], out_dtypes=["float32"]),
+    ]
+    return verifier.check(records, fetch_ids=[5],
+                          in_specs={1: None, 2: None},
+                          label="ok_contract")

@@ -1,0 +1,23 @@
+"""Must-NOT-flag: the same donated step WITHOUT the host read — state
+flows through the step's returns, exactly how a donating caller must
+read it back."""
+import numpy as np
+
+EXPECT = []
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import verifier
+
+    paddle.seed(11)
+    lin = nn.Linear(8, 8)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+
+    def step(inp):
+        return lin(inp).sum()
+
+    return verifier.audit_step(step, (x,),
+                               donate_params=list(lin.parameters()),
+                               label="ok_donated_clean")

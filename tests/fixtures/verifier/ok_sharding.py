@@ -1,0 +1,27 @@
+"""Must-NOT-flag: a cleanly sharded program on the same (data, tp)
+mesh — every sharded dim divides its axes, no Partial leaks (the
+contracted dim stays replicated), every op carries a rule."""
+import numpy as np
+
+EXPECT = []
+
+
+def build():
+    import paddle_tpu as paddle
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu import static
+    from paddle_tpu.distributed import mesh as mesh_mod
+    from paddle_tpu.static import verifier
+
+    mesh = mesh_mod.build_mesh(dict(data=2, tp=4))
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [8, 16], "float32")
+        w = paddle.to_tensor(np.ones((16, 16), np.float32))
+        y = paddle.matmul(x, w)               # k replicated: no Partial
+        z = y + 1.0
+    return verifier.check(
+        prog, mesh=mesh,
+        in_specs={"x": P("data", None)},      # 8 % 2 == 0
+        param_specs=lambda t: P(None, "tp"),  # column-parallel: 16 % 4
+        fetch_ids=[id(z)], label="ok_sharding")

@@ -592,12 +592,16 @@ class TestPerfGate:
         missing = {c["metric"] for c in res["checks"]
                    if c["status"] == "missing"}
         assert "serving_reqtrace_overhead_ratio" in base["rungs"]
+        # the verifier bar encodes the <2% budget: value * min_ratio
+        vo = base["rungs"]["verifier_overhead_ratio"]
+        assert vo["value"] * vo["min_ratio"] >= 0.98
         assert missing <= {"fleet_observability_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",
                            "async_overlap_step_ratio",
                            "async_batch_sweep_tokens_ratio",
                            "serving_router_goodput_scaling",
+                           "verifier_overhead_ratio",
                            "serving_reqtrace_overhead_ratio"}
 
     def test_cli_schema_only(self, tmp_path):

@@ -1,0 +1,397 @@
+"""Program verifier (static/verifier.py) — ISSUE 15.
+
+Contracts under test:
+
+* the fixture corpus: every must-flag program under
+  ``tests/fixtures/verifier/`` produces exactly its EXPECT codes, and
+  every must-not-flag program produces ZERO findings;
+* ``FLAGS_verify_programs=strict`` raises ``ProgramVerifierError``
+  BEFORE compile — on a branch-mismatched-collective program and on a
+  donated-then-host-read program — with the op and source location in
+  the message;
+* the wiring: all three compile paths (``static.Program`` / Executor,
+  ``to_static``, SOT segment flush) run the verifier behind the flag;
+* the framework's own traced ladder programs verify clean
+  (``python -m tools.tpulint --programs``), including the fusion
+  pass's rewritten plans;
+* ``tools.tpulint --diff`` lints only changed files.
+"""
+import importlib.util
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu import jit, nn, static  # noqa: E402
+from paddle_tpu.static import verifier  # noqa: E402
+
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "verifier")
+
+
+def _load_fixture(name):
+    spec = importlib.util.spec_from_file_location(
+        f"verifier_fixture_{name}", os.path.join(FIXTURES, name))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_FIXTURE_FILES = sorted(
+    f for f in os.listdir(FIXTURES)
+    if f.endswith(".py") and f != "__init__.py")
+
+
+@pytest.fixture(autouse=True)
+def _default_flag():
+    prev = paddle.get_flags(["FLAGS_verify_programs"])[
+        "FLAGS_verify_programs"]
+    yield
+    paddle.set_flags({"FLAGS_verify_programs": prev})
+
+
+# ==========================================================================
+# fixture corpus
+# ==========================================================================
+class TestFixtureCorpus:
+    def test_corpus_is_nonempty_and_covers_every_pass(self):
+        expected = set()
+        for f in _FIXTURE_FILES:
+            expected.update(_load_fixture(f).EXPECT)
+        # one must-flag fixture per pass family at minimum
+        assert {"TPU401", "TPU402", "TPU403", "TPU404",      # collective
+                "TPU501", "TPU502", "TPU503",                # sharding
+                "TPU601",                                    # donation
+                "TPU700", "TPU701", "TPU702", "TPU703",
+                "TPU704", "TPU705"} <= expected              # contract
+        assert any(not _load_fixture(f).EXPECT
+                   for f in _FIXTURE_FILES), "no must-not-flag fixtures"
+
+    @pytest.mark.parametrize("name", _FIXTURE_FILES)
+    def test_fixture(self, name):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")   # spmd fallback chatter
+            mod = _load_fixture(name)
+            report = mod.build()
+        assert sorted(set(report.codes())) == sorted(set(mod.EXPECT)), \
+            report.render()
+
+    def test_every_code_documented(self):
+        for f in _FIXTURE_FILES:
+            for code in _load_fixture(f).EXPECT:
+                assert code in verifier.CODES
+
+
+class TestCollectiveDetails:
+    def test_group_axes_mismatch_synthetic(self):
+        """Arms whose collectives differ in GROUP/AXES identity (not
+        just shape) are a TPU403 — checked over a hand-built branch
+        meta, the same structure the control-flow lowerings attach."""
+        meta = {"construct": "conditional_block", "branches": [
+            [{"name": "all_reduce",
+              "attrs": {"group": 1, "axes": ("data",)}, "shape": (4,)}],
+            [{"name": "all_reduce",
+              "attrs": {"group": 2, "axes": ("tp",)}, "shape": (4,)}],
+        ]}
+        rec = verifier.Record(
+            "conditional_block", in_ids=[1], out_ids=[2],
+            in_shapes=[()], out_shapes=[(4,)],
+            attrs={"_verifier_branches": meta})
+        rep = verifier.check([rec], fetch_ids=[2], in_specs={1: None})
+        assert rep.codes() == ["TPU403"]
+
+    def test_tensor_scatter_is_not_a_collective(self):
+        """The plain TENSOR op ``scatter`` (indexing) shares a name
+        with the distributed primitive; only entries stamped by the
+        collective seam (``group`` attr) count — a greedy-decode loop
+        writing its output buffer must not warn TPU401."""
+        import paddle_tpu.ops as ops
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            buf = paddle.to_tensor(np.zeros(4, np.float32))
+            i0 = paddle.to_tensor(0)
+
+            def keep(i, b):
+                return i < 3
+
+            def body(i, b):
+                idx = paddle.to_tensor(np.array([0], np.int64))
+                upd = nn.functional.relu(x[:1])
+                return [i + 1, ops.scatter(b, idx, upd)]
+
+            _i, out = static.nn.while_loop(keep, body, [i0, buf])
+        report = verifier.check(prog, fetch_ids=[id(out)])
+        assert "TPU401" not in report.codes(), report.render()
+
+    def test_reduce_op_mismatch_is_content_divergence(self):
+        """SUM in one arm, MAX in the other: same name/group/shape but
+        genuinely different wire content — TPU403."""
+        meta = {"construct": "conditional_block", "branches": [
+            [{"name": "all_reduce", "shape": (4,),
+              "attrs": {"group": 0, "axes": None, "reduce": "sum"}}],
+            [{"name": "all_reduce", "shape": (4,),
+              "attrs": {"group": 0, "axes": None, "reduce": "max"}}],
+        ]}
+        rec = verifier.Record(
+            "conditional_block", in_ids=[1], out_ids=[2],
+            in_shapes=[()], out_shapes=[(4,)],
+            attrs={"_verifier_branches": meta})
+        rep = verifier.check([rec], fetch_ids=[2], in_specs={1: None})
+        assert rep.codes() == ["TPU403"]
+
+    def test_nested_construct_recursed(self):
+        """A mismatched cond NESTED inside an arm is still found."""
+        inner = {"construct": "conditional_block", "branches": [
+            [{"name": "all_reduce", "attrs": {"group": 0, "axes": None},
+              "shape": (4,)}], [],
+        ]}
+        meta = {"construct": "conditional_block", "branches": [
+            [{"name": "multiply", "attrs": {}, "shape": (4,),
+              "branches": inner}],
+            [{"name": "multiply", "attrs": {}, "shape": (4,),
+              "branches": inner}],
+        ]}
+        rec = verifier.Record(
+            "conditional_block", in_ids=[1], out_ids=[2],
+            in_shapes=[()], out_shapes=[(4,)],
+            attrs={"_verifier_branches": meta})
+        rep = verifier.check([rec], fetch_ids=[2], in_specs={1: None})
+        assert "TPU402" in rep.codes()
+
+
+# ==========================================================================
+# strict mode: raises BEFORE compile, naming op + source line
+# ==========================================================================
+class TestStrictMode:
+    def test_branch_mismatch_message_names_op_and_line(self):
+        mod = _load_fixture("flag_branch_collective_mismatch.py")
+        report = mod.build()
+        with pytest.raises(verifier.ProgramVerifierError) as ei:
+            verifier.enforce(report, "strict")
+        msg = str(ei.value)
+        assert "TPU402" in msg
+        assert "op#" in msg                       # op id
+        assert "conditional_block" in msg         # op name
+        # source provenance: file.py:line of the recording site
+        assert "flag_branch_collective_mismatch.py:" in msg
+
+    def test_warn_mode_warns_instead(self):
+        mod = _load_fixture("flag_branch_collective_mismatch.py")
+        report = mod.build()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            verifier.enforce(report, "warn")
+        assert any(issubclass(x.category,
+                              verifier.ProgramVerifierWarning)
+                   for x in w)
+
+    def test_warn_severity_never_raises_strict(self):
+        # TPU401 is warn-severity: strict reports it but does not raise
+        mod = _load_fixture("flag_while_collective.py")
+        report = mod.build()
+        assert report.codes() == ["TPU401"]
+        with warnings.catch_warnings(record=True):
+            warnings.simplefilter("always")
+            verifier.enforce(report, "strict")    # no raise
+
+    def test_to_static_strict_raises_before_compile(self):
+        """The acceptance drill: a branch-mismatched-collective cond
+        inside a to_static function raises the framework's error at
+        END OF TRACE — before lowering/XLA compile — naming the op and
+        the user source line."""
+        import paddle_tpu.distributed as dist
+        paddle.set_flags({"FLAGS_verify_programs": "strict"})
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+
+        def bad(inp):
+            def t():
+                return dist.all_reduce(inp * 2.0)
+
+            def f():
+                return inp * 3.0
+
+            return static.nn.cond(inp.sum() > 0, t, f)
+
+        fn = jit.to_static(bad)
+        with pytest.raises(verifier.ProgramVerifierError) as ei:
+            fn(x)
+        msg = str(ei.value)
+        assert "TPU402" in msg and "conditional_block" in msg
+        assert "test_program_verifier.py:" in msg
+
+    def test_to_static_donated_host_read_strict(self):
+        """Donated-then-host-read: the read breaks the trace; strict
+        raises the VERIFIER's error (naming param + site) instead of
+        silently falling back to SOT and hitting the stale buffer at
+        runtime."""
+        paddle.set_flags({"FLAGS_verify_programs": "strict"})
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.lin = nn.Linear(8, 8)
+
+            def step(self, inp):
+                out = self.lin(inp).sum()
+                _ = self.lin.weight.numpy()       # stale after donation
+                return out
+
+        m = M()
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        fn = jit.to_static(m.step, full_graph=False, donate=True)
+        with pytest.raises(verifier.ProgramVerifierError) as ei:
+            fn(x)
+        msg = str(ei.value)
+        assert "TPU601" in msg and "Tensor.numpy()" in msg
+        assert "test_program_verifier.py:" in msg
+
+    def test_off_mode_disables_everything(self):
+        paddle.set_flags({"FLAGS_verify_programs": "off"})
+        assert verifier.mode() == "off"
+        mod = _load_fixture("flag_branch_collective_mismatch.py")
+        report = mod.build()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            verifier.enforce(report)              # flag-driven: no-op
+        assert not [x for x in w
+                    if issubclass(x.category,
+                                  verifier.ProgramVerifierWarning)]
+
+
+# ==========================================================================
+# compile-path wiring
+# ==========================================================================
+class TestWiring:
+    def test_program_executor_strict_raises_before_compile(self):
+        import paddle_tpu.distributed as dist
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+
+            def t():
+                return dist.all_reduce(x * 2.0)
+
+            def f():
+                return x * 3.0
+
+            out = static.nn.cond(paddle.to_tensor(True), t, f)
+        paddle.set_flags({"FLAGS_verify_programs": "strict"})
+        exe = static.Executor()
+        with pytest.raises(verifier.ProgramVerifierError):
+            exe.run(prog, feed={"x": np.ones(4, np.float32)},
+                    fetch_list=[out])
+
+    def test_clean_to_static_produces_no_warnings(self):
+        paddle.set_flags({"FLAGS_verify_programs": "warn"})
+        lin = nn.Linear(8, 8)
+        fn = jit.to_static(lambda a: (a @ a.t()).sum())
+        x = paddle.to_tensor(np.ones((4, 8), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            fn(x)
+        assert not [x for x in w
+                    if issubclass(x.category,
+                                  verifier.ProgramVerifierWarning)]
+
+    def test_sot_segments_verified_on_flush(self):
+        """SOT path: the segment node graph rides the same verifier.
+        A clean function flushes without findings; the verification
+        happens only on a segment-cache MISS."""
+        paddle.set_flags({"FLAGS_verify_programs": "warn"})
+
+        def broken(a):
+            h = a * 2.0
+            if float(h.sum()) > 0:        # graph break -> SOT segments
+                h = h + 1.0
+            return h.sum()
+
+        fn = jit.to_static(broken, full_graph=False)
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = fn(x)
+        assert float(out) == pytest.approx(48.0)
+        assert not [x for x in w
+                    if issubclass(x.category,
+                                  verifier.ProgramVerifierWarning)]
+
+    def test_fused_plan_verifies_clean(self):
+        """Fused ops must verify clean: the rewritten plan's FusedSteps
+        replay like _OpRecords and carry the anchor's loc."""
+        from paddle_tpu.compile import fusion
+        lin = nn.Linear(16, 16)
+        norm = nn.LayerNorm(16)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 16], "float32")
+            h = nn.functional.gelu(lin(norm(x)))
+        fetch = [id(h)]
+        plan, stats = fusion.fuse_program_ops(
+            prog.global_block().ops, fetch)
+        assert stats["rewritten"], "fusion matched nothing"
+        fused = [s for s in plan if getattr(s, "pattern", "")]
+        assert fused and fused[0].loc          # provenance carried
+        report = verifier.check(plan, fetch_ids=fetch)
+        assert report.codes() == [], report.render()
+
+    def test_record_loc_provenance(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            y = x * 2.0
+        op = prog.global_block().ops[-1]
+        assert op.loc.startswith("test_program_verifier.py:")
+        assert op.in_dtypes[0] == "float32"
+        assert op.out_dtypes == ("float32",)
+
+
+# ==========================================================================
+# framework programs stay verifier-clean
+# ==========================================================================
+class TestFrameworkClean:
+    def test_ladder_programs_clean(self):
+        from tools.tpulint import program_check
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for label, thunk in program_check.build_programs():
+                report = thunk()
+                assert report.codes() == [], \
+                    f"{label}: {report.render()}"
+
+
+# ==========================================================================
+# tpulint CLI: --programs and --diff
+# ==========================================================================
+class TestCli:
+    def test_diff_mode_no_changes_is_clean(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--diff", "HEAD",
+             "--no-registry", os.path.join(REPO, "paddle_tpu")],
+            cwd=REPO, capture_output=True, text=True)
+        # HEAD vs worktree may or may not have changes; either way the
+        # mode must run and gate only the changed files
+        assert out.returncode in (0, 1), out.stderr
+        assert "tpulint" in out.stdout
+
+    def test_diff_paths_filters_to_changed(self):
+        from tools.tpulint.cli import diff_paths
+        # rev == HEAD~0: identical tree -> subset of working changes
+        paths = diff_paths("HEAD", [os.path.join(REPO, "paddle_tpu")])
+        for p in paths:
+            assert p.endswith(".py") and os.path.isfile(p)
+
+    def test_list_codes_includes_verifier_families(self):
+        out = subprocess.run(
+            [sys.executable, "-m", "tools.tpulint", "--list-codes"],
+            cwd=REPO, capture_output=True, text=True)
+        assert out.returncode == 0
+        for code in ("TPU402", "TPU501", "TPU601", "TPU700"):
+            assert code in out.stdout

@@ -58,10 +58,13 @@ class TestFixtureCorpus:
         got = {k: sorted(v) for k, v in got.items()}
         assert got == expected
 
-    def test_must_not_flag(self):
+    @pytest.mark.parametrize("name", ["ok_host_side.py", "ok_rebinds.py"])
+    def test_must_not_flag(self, name):
         # quiet_scope / branch-trace style internals, static-metadata
-        # branching, plain-numpy host math: all clean
-        findings = _lint_file(os.path.join(FIXTURES, "ok_host_side.py"))
+        # branching, plain-numpy host math — and the re-bind /
+        # container-emptiness / jit-wrapper FP classes (ok_rebinds.py,
+        # fixed this round): all clean
+        findings = _lint_file(os.path.join(FIXTURES, name))
         assert findings == []
 
     def test_every_tpu1xx_and_2xx_code_exercised(self):

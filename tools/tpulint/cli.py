@@ -6,6 +6,13 @@
         --no-registry                            skip the TPU3xx import pass
         --select TPU1xx,TPU203                   restrict emitted codes
         --list-codes                             print the code table
+        --diff REV                               lint only files changed
+                                                 since git rev REV
+        --programs                               trace + verify the
+                                                 framework's ladder
+                                                 programs with the
+                                                 static.verifier passes
+                                                 (TPU4xx/5xx/6xx/7xx)
 
 Exit status: 0 clean (vs baseline if given), 1 new findings, 2 usage error.
 """
@@ -26,6 +33,27 @@ REPO = registry_check.REPO
 def _match_select(code: str, select: List[str]) -> bool:
     return any(code == s or (s.endswith("xx") and code.startswith(s[:4]))
                for s in select)
+
+
+def diff_paths(rev: str, paths: List[str]) -> List[str]:
+    """Python files changed since ``rev`` (``git diff --name-only``),
+    restricted to the requested paths — keeps lint wall time flat as
+    the tree grows (CI lints the diff; the baseline gate still covers
+    the whole tree in tier-1)."""
+    import subprocess
+    out = subprocess.run(
+        ["git", "diff", "--name-only", rev, "--", "*.py"],
+        cwd=REPO, capture_output=True, text=True, check=True).stdout
+    roots = [os.path.abspath(p) for p in paths]
+    changed = []
+    for rel in out.splitlines():
+        p = os.path.join(REPO, rel.strip())
+        if not (rel.strip().endswith(".py") and os.path.isfile(p)):
+            continue
+        if any(os.path.commonpath([p, r]) == r for r in roots
+               if os.path.isdir(r)) or p in roots:
+            changed.append(p)
+    return changed
 
 
 def collect_findings(paths: List[str], with_registry: bool = True,
@@ -59,6 +87,14 @@ def main(argv=None) -> int:
     ap.add_argument("--select", default="",
                     help="comma-separated codes/families, e.g. TPU1xx,TPU203")
     ap.add_argument("--list-codes", action="store_true")
+    ap.add_argument("--diff", metavar="REV", default=None,
+                    help="lint only python files changed since this "
+                         "git revision (within the given paths)")
+    ap.add_argument("--programs", action="store_true",
+                    help="trace the framework's ladder programs and "
+                         "run the static program verifier "
+                         "(static.verifier TPU4xx/5xx/6xx/7xx) over "
+                         "each op-list IR")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
     args = ap.parse_args(argv)
@@ -66,12 +102,41 @@ def main(argv=None) -> int:
     if args.list_codes:
         for code, meaning in sorted(CODES.items()):
             print(f"{code}  {meaning}")
+        try:
+            from paddle_tpu.static.verifier import CODES as VCODES
+            for code, (sev, meaning) in sorted(VCODES.items()):
+                print(f"{code}  [{sev}] {meaning}  (verifier)")
+        except Exception:
+            pass                     # AST-only environment: skip
         return 0
+    if args.programs:
+        from . import program_check
+        return program_check.run(quiet=args.quiet)
     if args.update_baseline and not args.baseline:
         ap.error("--update-baseline requires --baseline")
+    if args.update_baseline and args.diff is not None:
+        # a partial (changed-files-only) run must never REPLACE the
+        # whole-tree baseline: frozen debt in unchanged files would be
+        # dropped and resurface as NEW findings on the next full run
+        ap.error("--update-baseline requires a full-tree run "
+                 "(drop --diff)")
 
     select = [s.strip() for s in args.select.split(",") if s.strip()]
-    findings = collect_findings(args.paths,
+    paths = args.paths
+    if args.diff is not None:
+        import subprocess
+        try:
+            paths = diff_paths(args.diff, paths)
+        except subprocess.CalledProcessError as e:
+            # a typo'd revision is a USAGE error (exit 2), never "new
+            # lint findings" (exit 1) — CI wrappers key on the status
+            ap.error(f"--diff {args.diff!r}: git diff failed — "
+                     f"{(e.stderr or '').strip() or e}")
+        if not paths:
+            print("tpulint: no changed python files under the given "
+                  "paths — clean")
+            return 0
+    findings = collect_findings(paths,
                                 with_registry=not args.no_registry,
                                 select=select)
 

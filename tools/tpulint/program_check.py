@@ -1,0 +1,176 @@
+"""Program-level verification: trace the framework's ladder-style
+programs and run the static verifier over each recorded op-list IR.
+
+``python -m tools.tpulint --programs`` (and the tier-1 gate in
+``tests/test_program_verifier.py``) drives :func:`run`: every program
+the bench ladder and the test suite already trace — a GPT block with
+loss, a tiny llama forward, an SGD train step, in-graph control flow,
+the fusion pass's rewritten plan, and a sharded program over a mesh —
+must verify CLEAN. A finding here is new framework debt: fix the
+program, or suppress it in the verifier call with a justification.
+
+Kept import-light: heavy imports happen inside :func:`build_programs`
+so ``python -m tools.tpulint`` without ``--programs`` stays AST-only.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Tuple
+
+__all__ = ["build_programs", "run"]
+
+
+def _gpt_loss_program(batch=2):
+    """Tiny GPT forward + loss recorded as a static.Program."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.ops as ops
+    from paddle_tpu import static
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.nn import functional as F
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=16, use_flash_attention=False))
+    prog = static.Program()
+    with static.program_guard(prog):
+        ids = static.data("ids", [batch, 8], "int64")
+        logits = model(ids)
+        if isinstance(logits, (tuple, list)):
+            logits = logits[0]
+        v = logits.shape[-1]
+        loss = F.cross_entropy(
+            ops.reshape(logits[:, :-1, :], [-1, v]),
+            ops.reshape(ids[:, 1:], [-1]))
+        loss = loss.mean()
+    return prog, [id(loss)], model
+
+
+def _programs_impl() -> List[Tuple[str, Callable[[], object]]]:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.static import verifier
+
+    def gpt_loss():
+        prog, fetch, _m = _gpt_loss_program()
+        return verifier.check(prog, fetch_ids=fetch, label="gpt_loss")
+
+    def gpt_loss_sharded():
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed import mesh as mesh_mod
+        n = len(jax.devices())
+        # batch == device count: the data axis divides it exactly
+        prog, fetch, _m = _gpt_loss_program(batch=n)
+        mesh = mesh_mod.build_mesh({"data": n})
+        return verifier.check(prog, mesh=mesh,
+                              in_specs={"ids": P("data", None)},
+                              fetch_ids=fetch, label="gpt_loss_sharded")
+
+    def llama_forward():
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        paddle.seed(7)
+        model = LlamaForCausalLM(LlamaConfig(
+            vocab_size=64, hidden_size=32, intermediate_size=64,
+            num_layers=2, num_heads=4, max_seq_len=32,
+            use_flash_attention=False))
+        prog = static.Program()
+        with static.program_guard(prog):
+            ids = static.data("ids", [2, 8], "int64")
+            logits = model(ids)
+            if isinstance(logits, (tuple, list)):
+                logits = logits[0]
+        return verifier.check(prog, fetch_ids=[id(logits)],
+                              label="llama_forward")
+
+    def sgd_train_step():
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+        paddle.seed(7)
+        model = nn.Sequential(nn.Linear(8, 16), nn.GELU(),
+                              nn.Linear(16, 4))
+        sgd = opt.SGD(learning_rate=0.1,
+                      parameters=model.parameters())
+        x = paddle.to_tensor(np.random.rand(4, 8).astype("float32"))
+
+        def step(inp):
+            loss = model(inp).mean()
+            loss.backward()
+            sgd.step()
+            sgd.clear_grad()
+            return loss
+
+        return verifier.audit_step(step, (x,), label="sgd_train_step")
+
+    def control_flow():
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4], "float32")
+            y = static.nn.cond(paddle.to_tensor(True),
+                               lambda: x * 2.0, lambda: x * 3.0)
+
+            def c(i, v):
+                return i < 4
+
+            def b(i, v):
+                return [i + 1, v + y]
+
+            i0 = paddle.to_tensor(0)
+            _i, out = static.nn.while_loop(c, b, [i0, x])
+        return verifier.check(prog, fetch_ids=[id(out)],
+                              label="control_flow")
+
+    def fused_plan():
+        # the fusion pass's rewritten plan must verify clean too: the
+        # FusedSteps replay like _OpRecords and carry loc provenance
+        from paddle_tpu.compile import fusion
+        import paddle_tpu.nn as nn
+        paddle.seed(7)
+        lin = nn.Linear(16, 16)
+        norm = nn.LayerNorm(16)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 16], "float32")
+            h = nn.functional.gelu(lin(norm(x)))
+        fetch = [id(h)]
+        plan, _stats = fusion.fuse_program_ops(
+            prog.global_block().ops, fetch)
+        return verifier.check(plan, fetch_ids=fetch, label="fused_plan")
+
+    return [("gpt_loss", gpt_loss),
+            ("gpt_loss_sharded", gpt_loss_sharded),
+            ("llama_forward", llama_forward),
+            ("sgd_train_step", sgd_train_step),
+            ("control_flow", control_flow),
+            ("fused_plan", fused_plan)]
+
+
+def build_programs():
+    """(label, thunk) pairs; each thunk traces one framework program
+    and returns its verifier Report."""
+    return _programs_impl()
+
+
+def run(quiet: bool = False) -> int:
+    """Trace + verify every program; print findings; exit status 1 when
+    any program is not verifier-clean."""
+    failures = 0
+    for label, thunk in build_programs():
+        try:
+            report = thunk()
+        except Exception as e:      # a program that cannot trace IS debt
+            failures += 1
+            print(f"program {label}: TRACE FAILED — "
+                  f"{type(e).__name__}: {e}")
+            continue
+        if report.findings:
+            failures += 1
+            print(report.render())
+        elif not quiet:
+            print(f"program {label}: clean "
+                  f"({report.stats.get('ops', '?')} ops)")
+    tail = "clean" if not failures else f"{failures} program(s) flagged"
+    print(f"tpulint --programs: {tail}")
+    return 1 if failures else 0

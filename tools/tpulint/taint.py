@@ -31,13 +31,15 @@ SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "name", "place",
 UNTAINTED_CALLS = {"len", "isinstance", "issubclass", "hasattr", "type",
                    "id", "print", "repr", "str", "format", "range",
                    "callable", "getattr", "dir", "vars"}
-#: jax/jnp calls returning static metadata (dtypes, backend names), not
-#: device values — truthiness on these is trace-safe
+#: jax/jnp calls returning static metadata (dtypes, backend names) or
+#: host-side callable wrappers (jit/eval_shape), not device values —
+#: truthiness on these is trace-safe
 METADATA_CALLS = {"issubdtype", "isdtype", "result_type", "can_cast",
                   "promote_types", "iinfo", "finfo", "dtype",
                   "default_backend", "device_count", "local_device_count",
                   "devices", "local_devices", "process_index",
-                  "process_count"}
+                  "process_count", "jit", "eval_shape",
+                  "ShapeDtypeStruct", "tree_structure"}
 
 FIXITS = {
     "TPU101": "keep the computation in-graph (jnp ops / registered ops); "
@@ -228,10 +230,14 @@ class ScopeAnalyzer:
         saved = set(self.tainted)
         for gen in node.generators:
             it = self.expr(gen.iter)
-            if it:
-                for n in ast.walk(gen.target):
-                    if isinstance(n, ast.Name):
-                        self.tainted.add(n.id)
+            # bind the target either way: an UNTAINTED iterable must
+            # CLEAR stale taint on a shadowing target name (the
+            # two-pass back-edge union otherwise leaks a tensor-loop
+            # variable's taint into a later metadata comprehension
+            # reusing the name — augmented-assign/truthiness FPs)
+            for n in ast.walk(gen.target):
+                if isinstance(n, ast.Name):
+                    self._bind(n.id, it)
             for cond in gen.ifs:
                 if self.expr(cond):
                     self.flag(cond, "TPU105",
@@ -292,12 +298,17 @@ class ScopeAnalyzer:
     def _predicate_taint(self, test) -> bool:
         """Taint of an if/while test. Truthiness of a bare ``*args`` name
         is an ARITY check (``if rest:`` for an optional input) — trace-safe
-        even though the tuple's elements are tracers."""
-        if isinstance(test, ast.Name) and test.id in self.vararg_names:
+        even though the tuple's elements are tracers. Likewise the bare
+        truthiness of a name KNOWN to be a python container (bound from a
+        dict/list/set literal or comprehension) is an EMPTINESS check:
+        the container may hold tensors, but ``bool()`` never touches its
+        elements (``if not params:`` / ``if state_dict:``)."""
+        safe_names = self.vararg_names | self.dict_names
+        if isinstance(test, ast.Name) and test.id in safe_names:
             return False
         if (isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not)
                 and isinstance(test.operand, ast.Name)
-                and test.operand.id in self.vararg_names):
+                and test.operand.id in safe_names):
             return False
         return self.expr(test)
 
@@ -318,6 +329,10 @@ class ScopeAnalyzer:
             self._bind(target.id, taint)
             if value is not None and ModuleInfo._is_mutable(value):
                 self.dict_names.add(target.id)
+            elif value is not None:
+                # re-bound to a non-container: the emptiness-check
+                # exemption must not outlive the container binding
+                self.dict_names.discard(target.id)
         elif isinstance(target, (ast.Tuple, ast.List)):
             if (value is not None and isinstance(value, (ast.Tuple, ast.List))
                     and len(value.elts) == len(target.elts)):
@@ -386,10 +401,13 @@ class ScopeAnalyzer:
             self.body(node.orelse)
         elif isinstance(node, ast.For):
             it = self.expr(node.iter)
-            if it:
-                for n in ast.walk(node.target):
-                    if isinstance(n, ast.Name):
-                        self.tainted.add(n.id)
+            # re-binding semantics: a loop over an UNTAINTED iterable
+            # clears stale taint on its target names (e.g. ``for t in
+            # range(3)`` after an earlier tensor loop reused ``t`` — the
+            # back-edge union otherwise flags ``n += t`` / ``if t:``)
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    self._bind(n.id, it)
             self.body(node.body)
             self.body(node.orelse)
         elif isinstance(node, ast.With):
