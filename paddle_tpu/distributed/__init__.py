@@ -23,6 +23,7 @@ from . import fleet
 from . import sharding
 from . import spmd
 from . import planner
+from . import pipeline
 from . import checkpoint
 from . import auto_tuner
 from . import rpc

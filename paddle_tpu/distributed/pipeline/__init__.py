@@ -1,0 +1,33 @@
+"""Pipeline parallelism over the static op-list IR.
+
+The pipeline axis as first-class infrastructure (reference:
+``PipelineLayer`` stage partitioning + 1F1B/zero-bubble schedule
+passes + the fleet executor runtime), built TPU-natively on pieces
+this framework already has:
+
+* :mod:`.partition` — cut a recorded ``static.Program`` into
+  contiguous stages (uniform / cost-balanced / custom split points)
+  and compute the exact cross-stage boundary cuts;
+* :mod:`.schedules` — F-then-B (GPipe), 1F1B, and zero-bubble
+  (ZBH1-style) micro-batch schedule tables, plus the earliest-start
+  event simulation that prices their bubble fractions;
+* :mod:`.runtime` — :class:`~.runtime.PipelinedProgram`: per-stage
+  jitted execution with rematerializing backward, donation-aware
+  double-buffered boundaries, and optional ``(data, pp)`` submesh
+  placement;
+* planner integration lives in :mod:`.planning` (stages as a
+  placement dimension, bubble + P2P priced by the planner's
+  alpha-beta model) and the cross-stage desync verifier pass in
+  ``static.verifier.check_stages`` (TPU8xx).
+"""
+from .partition import (Stage, StagePartition, ValueInfo, op_seconds,
+                        partition_program)
+from .runtime import PipelinedProgram
+from .schedules import (SCHEDULES, ScheduleStep, analytical_bubble,
+                        build_schedule, peak_inflight, simulate)
+
+__all__ = [
+    "Stage", "StagePartition", "ValueInfo", "partition_program",
+    "op_seconds", "PipelinedProgram", "SCHEDULES", "ScheduleStep",
+    "build_schedule", "simulate", "analytical_bubble", "peak_inflight",
+]

@@ -1,0 +1,337 @@
+"""Stage partitioning over the static Program op-list IR.
+
+A pipeline stage is a CONTIGUOUS slice of a recorded program's op list
+(``static/program.py`` ``_OpRecord``) — the op list is already in
+dataflow order, so any contiguous cut is topologically valid. Three
+split strategies feed :func:`partition_program`:
+
+* ``uniform`` — equal op counts per stage (the reference
+  ``PipelineLayer(seg_method="uniform")``);
+* ``cost`` — balance the per-stage *modeled seconds* using the same
+  ``OpDef.cost_fn`` roofline the planner prices placements with
+  (``observability.perf.costmodel.cost_of`` + chip peaks) — the
+  reference's ``seg_method="layer"`` weighted by real op cost;
+* ``custom`` — caller-supplied op-index split points (the reference's
+  manual ``SegmentLayers``).
+
+The partition computes, per boundary, the **cut set**: every value
+produced at or before the boundary and consumed after it, in
+deterministic (producer-index, output-position) order. Stage ``s``
+sends exactly the boundary-``s`` cut to stage ``s+1``; values needed
+further downstream are re-sent by each intermediate stage (adjacent
+ring transfers only, like the fleet runtime's ``ppermute`` ring). Feeds
+and captured parameters are NOT routed: each stage is fed its own
+feeds directly and owns its own parameter slice (a parameter read by
+two stages — tied embeddings — appears in both; the runtime sums its
+gradient contributions).
+
+:meth:`StagePartition.stage_records` renders each stage as a verifier
+record list with explicit ``send``/``recv`` records at the boundaries
+(peer + seq + shape/dtype attrs) — the input of the verifier's TPU8xx
+cross-stage desync pass (``static.verifier.check_stages``).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["ValueInfo", "Stage", "StagePartition", "partition_program",
+           "op_seconds"]
+
+#: one cross-stage (or fetched) value: id + metadata for byte pricing
+#: and send/recv contract checks
+ValueInfo = namedtuple("ValueInfo", ["vid", "shape", "dtype",
+                                     "producer_op"])
+
+
+def _dtype_bytes(dtype: str) -> int:
+    import numpy as np
+    try:
+        return int(np.dtype(dtype).itemsize)
+    except TypeError:
+        return 2 if "bfloat16" in str(dtype) else 4
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def op_seconds(op) -> float:
+    """Modeled roofline seconds of one recorded op (fwd only) — the
+    weight the cost-based splitter balances. Ops without a cost model
+    get a tiny epsilon so they still spread across stages."""
+    from ...observability.perf import chip_peak_bw, chip_peak_flops
+    from ...observability.perf.costmodel import cost_of
+    c = cost_of(op.name, op.in_shapes or (), (), op.attrs,
+                op.out_shapes or ())
+    if c is None or not (c.flops or c.bytes):
+        return 1e-9
+    return max(c.flops / chip_peak_flops(), c.bytes / chip_peak_bw())
+
+
+@dataclass
+class Stage:
+    """One contiguous op slice plus its dataflow boundary sets."""
+
+    index: int
+    op_start: int
+    op_stop: int
+    ops: list
+    #: captured-parameter value ids read by this stage, first-use order
+    param_ids: Tuple[int, ...] = ()
+    #: feed names consumed directly by this stage, first-use order
+    feed_names: Tuple[str, ...] = ()
+    #: values received from stage index-1 (= the previous boundary cut)
+    recv: Tuple[ValueInfo, ...] = ()
+    #: values sent to stage index+1 (= this boundary's cut)
+    send: Tuple[ValueInfo, ...] = ()
+    #: fetched values produced in this stage
+    fetch: Tuple[ValueInfo, ...] = ()
+    #: modeled fwd seconds of this stage's ops
+    seconds: float = 0.0
+
+    @property
+    def num_ops(self) -> int:
+        return len(self.ops)
+
+
+@dataclass
+class StagePartition:
+    """The result of :func:`partition_program`."""
+
+    program: object
+    strategy: str
+    #: op-index cut points, len == num_stages - 1 (stage s is
+    #: ops[boundaries[s-1]:boundaries[s]])
+    boundaries: Tuple[int, ...]
+    stages: List[Stage]
+    fetch_ids: Tuple[int, ...]
+    #: vid -> (shape, dtype) for every routed/fetched value
+    value_meta: Dict[int, tuple] = field(default_factory=dict)
+
+    @property
+    def num_stages(self) -> int:
+        return len(self.stages)
+
+    def boundary_bytes(self, s: int) -> float:
+        """Bytes crossing boundary ``s`` (stage s -> s+1) per
+        microbatch — what the planner prices as P2P wire bytes."""
+        return float(sum(_numel(v.shape) * _dtype_bytes(v.dtype)
+                         for v in self.stages[s].send))
+
+    def total_p2p_bytes(self) -> float:
+        return sum(self.boundary_bytes(s)
+                   for s in range(self.num_stages - 1))
+
+    def stage_seconds(self) -> List[float]:
+        return [st.seconds for st in self.stages]
+
+    def stage_records(self) -> List[list]:
+        """Per-stage verifier ``Record`` lists with explicit
+        ``recv``/``send`` boundary records (peer/seq/shape/dtype) —
+        consumed by ``static.verifier.check_stages`` (TPU8xx)."""
+        from ...static.verifier import Record
+        out = []
+        for st in self.stages:
+            recs = []
+            for k, v in enumerate(st.recv):
+                recs.append(Record(
+                    "recv", in_ids=(), out_ids=(v.vid,),
+                    attrs={"peer": st.index - 1, "seq": k,
+                           "group": "pp"},
+                    out_shapes=(v.shape,), out_dtypes=(v.dtype,),
+                    loc=getattr(self.program.global_block()
+                                .ops[v.producer_op], "loc", "")
+                    if v.producer_op >= 0 else ""))
+            recs.extend(Record.of(op) for op in st.ops)
+            for k, v in enumerate(st.send):
+                recs.append(Record(
+                    "send", in_ids=(v.vid,), out_ids=(),
+                    attrs={"peer": st.index + 1, "seq": k,
+                           "group": "pp"},
+                    in_shapes=(v.shape,), in_dtypes=(v.dtype,),
+                    loc=getattr(self.program.global_block()
+                                .ops[v.producer_op], "loc", "")
+                    if v.producer_op >= 0 else ""))
+            out.append(recs)
+        return out
+
+    def describe(self) -> str:
+        lines = [f"StagePartition({self.strategy}, "
+                 f"S={self.num_stages}, "
+                 f"boundaries={list(self.boundaries)})"]
+        for st in self.stages:
+            cut_b = sum(_numel(v.shape) * _dtype_bytes(v.dtype)
+                        for v in st.send)
+            lines.append(
+                f"  stage {st.index}: ops[{st.op_start}:{st.op_stop}]"
+                f" ({st.num_ops} ops, {st.seconds * 1e6:.1f} us,"
+                f" {len(st.param_ids)} params,"
+                f" send {len(st.send)} vals/{cut_b / 1e3:.1f} kB)")
+        return "\n".join(lines)
+
+
+def _uniform_boundaries(n_ops: int, num_stages: int) -> List[int]:
+    return [round(n_ops * (k + 1) / num_stages)
+            for k in range(num_stages - 1)]
+
+
+def _cost_boundaries(ops, num_stages: int) -> List[int]:
+    """Greedy prefix-sum balance: cut where cumulative modeled seconds
+    crosses k/S of the total — the classic chain-partition heuristic
+    (optimal boundaries differ by at most one op's weight)."""
+    weights = [op_seconds(op) for op in ops]
+    total = sum(weights) or 1.0
+    bounds, acc, k = [], 0.0, 1
+    for i, w in enumerate(weights):
+        acc += w
+        # never let a later stage starve: at most n_ops - (S - k) ops
+        # may sit left of cut k
+        if (acc >= total * k / num_stages
+                and i + 1 <= len(ops) - (num_stages - k)) \
+                or i + 1 == len(ops) - (num_stages - k):
+            bounds.append(i + 1)
+            k += 1
+            if k == num_stages:
+                break
+    return bounds
+
+
+def partition_program(program, num_stages: Optional[int] = None, *,
+                      strategy: str = "cost",
+                      split_points: Optional[Sequence[int]] = None,
+                      fetch_ids: Sequence[int] = ()) -> StagePartition:
+    """Partition ``program`` into pipeline stages (see module doc).
+
+    ``num_stages`` is required unless ``split_points`` (explicit
+    op-index cuts, strictly increasing) is given — then
+    ``num_stages = len(split_points) + 1`` and ``strategy`` is
+    recorded as ``custom``. ``fetch_ids``: externally fetched value
+    ids (the loss) — kept out of the ring and returned by their
+    producing stage."""
+    ops = program.global_block().ops
+    n = len(ops)
+    if split_points is not None:
+        bounds = [int(b) for b in split_points]
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])) \
+                or (bounds and (bounds[0] <= 0 or bounds[-1] >= n)):
+            raise ValueError(
+                f"split_points must be strictly increasing inside "
+                f"(0, {n}); got {bounds}")
+        if num_stages is not None and num_stages != len(bounds) + 1:
+            raise ValueError(
+                f"num_stages={num_stages} disagrees with "
+                f"{len(bounds)} split point(s)")
+        num_stages = len(bounds) + 1
+        strategy = "custom"
+    else:
+        if num_stages is None:
+            raise ValueError("num_stages or split_points is required")
+        num_stages = int(num_stages)
+        if num_stages < 1:
+            raise ValueError(f"num_stages must be >= 1, got "
+                             f"{num_stages}")
+        if num_stages > n:
+            raise ValueError(
+                f"cannot split {n} op(s) into {num_stages} stages — "
+                f"every stage needs at least one op")
+        if strategy == "uniform":
+            bounds = _uniform_boundaries(n, num_stages)
+        elif strategy == "cost":
+            bounds = _cost_boundaries(ops, num_stages)
+        else:
+            raise ValueError(f"unknown strategy {strategy!r} "
+                             f"(uniform | cost | custom)")
+
+    edges = [0] + list(bounds) + [n]
+    feed_ids = set(program.feed_vars.values())
+    feed_name_of = {vid: name
+                    for name, vid in program.feed_vars.items()}
+    cap_ids = set(program._captured.keys())
+
+    # value metadata + producer/consumer stage maps
+    stage_of_op = {}
+    for s in range(num_stages):
+        for i in range(edges[s], edges[s + 1]):
+            stage_of_op[i] = s
+    meta: Dict[int, tuple] = {}
+    producer_op: Dict[int, int] = {}
+    producer_stage: Dict[int, int] = {}
+    last_consumer_stage: Dict[int, int] = {}
+    for i, op in enumerate(ops):
+        for pos, (vid, shape, dtype) in enumerate(zip(
+                op.out_ids, op.out_shapes or (), op.out_dtypes or ())):
+            if vid not in producer_op:
+                producer_op[vid] = i
+                producer_stage[vid] = stage_of_op[i]
+                meta[vid] = (tuple(shape), str(dtype))
+        for pos, vid in enumerate(op.in_ids):
+            last_consumer_stage[vid] = max(
+                last_consumer_stage.get(vid, -1), stage_of_op[i])
+            if vid not in meta and (op.in_shapes or ()):
+                shapes = op.in_shapes
+                dts = op.in_dtypes or ("float32",) * len(op.in_ids)
+                if pos < len(shapes):
+                    meta[vid] = (tuple(shapes[pos]),
+                                 str(dts[pos]) if pos < len(dts)
+                                 else "float32")
+
+    fetch_ids = tuple(fetch_ids)
+    fetch_set = set(fetch_ids)
+
+    # boundary cuts: produced at or before s, consumed after s;
+    # feeds/params are injected per stage, never routed
+    cuts: List[List[ValueInfo]] = []
+    for s in range(num_stages - 1):
+        cut = []
+        for vid, ps in producer_stage.items():
+            if vid in feed_ids or vid in cap_ids:
+                continue
+            if ps <= s and last_consumer_stage.get(vid, -1) > s:
+                cut.append(ValueInfo(vid, meta[vid][0], meta[vid][1],
+                                     producer_op[vid]))
+        cut.sort(key=lambda v: (v.producer_op, v.vid))
+        cuts.append(cut)
+
+    stages: List[Stage] = []
+    for s in range(num_stages):
+        sl = ops[edges[s]:edges[s + 1]]
+        params, feeds, seen_p, seen_f = [], [], set(), set()
+        for op in sl:
+            for vid in op.in_ids:
+                if vid in cap_ids and vid not in seen_p:
+                    seen_p.add(vid)
+                    params.append(vid)
+                elif vid in feed_ids and vid not in seen_f:
+                    seen_f.add(vid)
+                    feeds.append(feed_name_of[vid])
+        fetch = []
+        for vid in fetch_ids:
+            if producer_stage.get(vid) == s:
+                fetch.append(ValueInfo(vid, meta[vid][0],
+                                       meta[vid][1],
+                                       producer_op[vid]))
+            elif vid in (feed_ids | cap_ids) and s == 0:
+                # fetching a feed/param verbatim: stage 0 owns it
+                shape = meta.get(vid, ((), "float32"))
+                fetch.append(ValueInfo(vid, shape[0], shape[1], -1))
+        stages.append(Stage(
+            index=s, op_start=edges[s], op_stop=edges[s + 1], ops=sl,
+            param_ids=tuple(params), feed_names=tuple(feeds),
+            recv=tuple(cuts[s - 1]) if s > 0 else (),
+            send=tuple(cuts[s]) if s < num_stages - 1 else (),
+            fetch=tuple(fetch),
+            seconds=sum(op_seconds(op) for op in sl)))
+
+    missing = fetch_set - set(producer_stage) - feed_ids - cap_ids
+    if missing:
+        raise ValueError(
+            f"fetch ids {sorted(missing)} are produced by no op and "
+            f"are neither feeds nor captured parameters")
+    return StagePartition(program=program, strategy=strategy,
+                          boundaries=tuple(bounds), stages=stages,
+                          fetch_ids=fetch_ids, value_meta=meta)

@@ -1,0 +1,246 @@
+"""Pipeline stages as a placement dimension of the auto-parallel
+planner.
+
+A mesh with a pipeline axis (``candidates.PIPELINE_AXES``: pp / pipe /
+stage / ...) admits PP candidates: the program is cost-partitioned
+into ``mesh.shape[pp_axis]`` stages and one candidate per schedule
+(fthenb / 1f1b / zb) is priced on the SAME alpha-beta scale the
+planner's TP/FSDP scoring uses (``planner.cost``):
+
+* **compute** — the bottleneck stage's per-microbatch fwd+bwd roofline
+  seconds, stretched by the schedule's bubble fraction:
+  ``T = m * tau_max / (1 - bubble)`` (for 1F1B this is exactly
+  ``tau_max * (m + S - 1)``);
+* **collective** — P2P boundary bytes (activation forward + gradient
+  backward per microbatch per boundary) at ``_ALPHA_S`` launch latency
+  + wire bytes over ICI, plus the per-stage data-parallel gradient
+  all-reduce (stages sync concurrently: the max, not the sum);
+* **memory** — per-stage HBM: the stage's parameter slice at
+  ``(2 + opt_state_factor)`` copies plus per-microbatch boundary/
+  activation bytes at the schedule's peak in-flight depth
+  (``schedules.peak_inflight`` — the 1F1B memory win) plus the sharded
+  feed slice. The max stage over ``capacity_bytes`` rejects the
+  candidate — and conversely, hard-HBM rejection of every TP/FSDP
+  candidate is exactly when these PP candidates win.
+
+The result rides the planner's normal ranking as
+``ScoredCandidate``s whose params are unsharded (each stage holds its
+own slice REPLICATED over its submesh); the winning candidate's
+:class:`PipelinePlan` lands on ``PlanResult.pipeline`` for the runtime
+(``PipelinedProgram``) to execute.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["PipelinePlan", "pipeline_axis_of", "pipeline_candidates",
+           "default_microbatches"]
+
+
+@dataclass
+class PipelinePlan:
+    """Everything the runtime needs to execute the winning PP plan."""
+
+    axis: str
+    num_stages: int
+    schedule: str
+    num_microbatches: int
+    strategy: str
+    boundaries: Tuple[int, ...]
+    bubble_fraction: float
+    p2p_bytes: float
+    #: per-stage modeled fwd seconds (full batch, one device)
+    stage_seconds: List[float] = field(default_factory=list)
+    #: per-stage peak in-flight microbatches under the schedule
+    peak_inflight: List[int] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"axis": self.axis, "num_stages": self.num_stages,
+                "schedule": self.schedule,
+                "num_microbatches": self.num_microbatches,
+                "strategy": self.strategy,
+                "boundaries": list(self.boundaries),
+                "bubble_fraction": self.bubble_fraction,
+                "p2p_bytes": self.p2p_bytes}
+
+
+def pipeline_axis_of(mesh) -> Optional[str]:
+    """First pipeline-named mesh axis with size > 1, else None."""
+    from ..planner.candidates import PIPELINE_AXES
+    for a in mesh.axis_names:
+        if a in PIPELINE_AXES and int(mesh.shape[a]) > 1:
+            return a
+    return None
+
+
+def default_microbatches(num_stages: int, batch: int,
+                         dp: int) -> int:
+    """Deepest microbatching that keeps at least one sample per
+    microbatch per data shard, capped at 4 pipeline depths (past
+    ~4S the bubble gain is marginal but the P2P alpha cost is not)."""
+    cap = max(1, batch // max(dp, 1))
+    m = min(4 * num_stages, cap)
+    # prefer an m that divides the per-shard batch so microbatches
+    # stay equal-sized (the runtime splits evenly or replicates)
+    while m > 1 and batch % m:
+        m -= 1
+    return max(m, 1)
+
+
+def pipeline_candidates(program, mesh, *, pp_axis: Optional[str] = None,
+                        fetch_ids: Sequence[int] = (),
+                        param_ids: Optional[set] = None,
+                        opt_state_factor: float = 2.0,
+                        capacity_bytes: Optional[float] = None,
+                        num_microbatches: Optional[int] = None,
+                        schedules: Sequence[str] = ("1f1b", "zb",
+                                                    "fthenb")):
+    """Score one PP candidate per schedule.
+
+    Returns ``[(Candidate, Score, PipelinePlan), ...]`` on the
+    planner's pricing scale — empty when the mesh has no pipeline axis
+    or the program is too small to cut.
+    """
+    from ...observability.perf import chip_hbm_bytes
+    from ..planner import cost as cost_mod
+    from ..planner.candidates import Candidate, mesh_axis_split
+    from .partition import partition_program
+    from .schedules import analytical_bubble, build_schedule, \
+        peak_inflight
+
+    pp_axis = pp_axis or pipeline_axis_of(mesh)
+    if pp_axis is None:
+        return []
+    S = int(mesh.shape[pp_axis])
+    ops = program.global_block().ops
+    if S < 2 or len(ops) < S:
+        return []
+    part = partition_program(program, S, strategy="cost",
+                             fetch_ids=tuple(fetch_ids))
+
+    batch_axes, _model_axes = mesh_axis_split(mesh)
+    dp = 1
+    for a in batch_axes:
+        dp *= int(mesh.shape[a])
+    batch = max((int(shape[0])
+                 for shape in program._feed_shapes.values() if shape),
+                default=1)
+    m = int(num_microbatches) if num_microbatches else \
+        default_microbatches(S, batch, dp)
+
+    capacity = capacity_bytes if capacity_bytes is not None \
+        else chip_hbm_bytes()
+    itemsize = 4.0
+    pid_set = set(param_ids) if param_ids is not None \
+        else set(program._captured.keys())
+
+    def nbytes(t) -> float:
+        n = 1
+        for d in t.shape:
+            n *= int(d)
+        try:
+            import numpy as np
+            return float(n) * np.dtype(str(t.dtype)).itemsize
+        except Exception:
+            return float(n) * itemsize
+
+    # per-stage invariants (schedule-independent)
+    stage_param_b = []
+    stage_act_b = []        # forward activation bytes, full batch
+    for st in part.stages:
+        stage_param_b.append(sum(
+            nbytes(program._captured[pid]) for pid in st.param_ids
+            if pid in pid_set))
+        act = 0.0
+        for op in st.ops:
+            for shape, dt in zip(op.out_shapes or (),
+                                 op.out_dtypes or ()):
+                n = 1
+                for d in shape:
+                    n *= int(d)
+                act += n * cost_mod.dtype_bytes(str(dt))
+        stage_act_b.append(act)
+    feed_b = sum(
+        float(_numel(shape)) * itemsize
+        for shape in program._feed_shapes.values())
+
+    # bottleneck stage per-microbatch fwd+bwd roofline seconds, on the
+    # planner's achievable-peak scale, data-sharded within the stage
+    tau = [sec * (1.0 + cost_mod.BACKWARD_COMPUTE)
+           / cost_mod.ACHIEVABLE / dp / m
+           for sec in part.stage_seconds()]
+    tau_max = max(tau) if tau else 0.0
+
+    # P2P: every boundary moves its cut forward (activation) and
+    # backward (gradient) once per microbatch, data-sharded
+    p2p_bytes = part.total_p2p_bytes()
+    p2p_s = 0.0
+    for s in range(S - 1):
+        b = part.boundary_bytes(s) / dp
+        wire = cost_mod.collective_cost("send", b, 2).bytes_read
+        p2p_s += 2.0 * m * (cost_mod._ALPHA_S
+                            + wire / cost_mod.ici_bandwidth())
+
+    # per-stage dp gradient all-reduce (concurrent across stages)
+    grad_sync_s = max(
+        (cost_mod._collective_seconds("all_reduce", pb / 1.0,
+                                      batch_axes, mesh)
+         for pb in stage_param_b), default=0.0)
+
+    out = []
+    for sched in schedules:
+        table = build_schedule(sched, S, m)
+        peaks = peak_inflight(table)
+        bubble = analytical_bubble(sched, S, m)
+        compute_s = (m * tau_max / max(1.0 - bubble, 1e-9)) \
+            if tau_max else 0.0
+
+        rejected = None
+        mem_max, mem_break = 0.0, {}
+        for s in range(S):
+            params_b = stage_param_b[s] * (2.0 + opt_state_factor)
+            acts_b = stage_act_b[s] / dp / m * peaks[s]
+            feeds_b = feed_b / dp / m
+            total = params_b + acts_b + feeds_b
+            if total > mem_max:
+                mem_max = total
+                mem_break = {"params": stage_param_b[s],
+                             "grads+optimizer": stage_param_b[s]
+                             * (1.0 + opt_state_factor),
+                             "activations": acts_b, "feeds": feeds_b}
+        if capacity and mem_max > capacity:
+            rejected = (f"stage HBM {mem_max / 1e9:.2f} GB over "
+                        f"capacity {capacity / 1e9:.2f} GB")
+
+        name = f"pp{S}[{sched}]x{'dp' + str(dp) if dp > 1 else 'rep'}"
+        cand = Candidate(name=name, origin="pipeline",
+                         param_specs=(),
+                         in_spec=(batch_axes[0] if len(batch_axes) == 1
+                                  else tuple(batch_axes) or None)
+                         if batch_axes else None)
+        score = cost_mod.Score(
+            candidate=name,
+            compute_s=compute_s,
+            collective_s=p2p_s + grad_sync_s,
+            hbm_bytes=mem_max,
+            rejected=rejected,
+            collective_breakdown={"p2p": p2p_s,
+                                  "grad_sync": grad_sync_s},
+            memory_breakdown=mem_break)
+        plan = PipelinePlan(
+            axis=pp_axis, num_stages=S, schedule=sched,
+            num_microbatches=m, strategy=part.strategy,
+            boundaries=part.boundaries, bubble_fraction=bubble,
+            p2p_bytes=p2p_bytes,
+            stage_seconds=part.stage_seconds(),
+            peak_inflight=peaks)
+        out.append((cand, score, plan))
+    return out
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n

@@ -32,7 +32,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["SpecLayout", "Candidate", "classify_param",
            "parameter_spec_from_name", "enumerate_candidates",
-           "mesh_axis_split"]
+           "mesh_axis_split", "PIPELINE_AXES"]
 
 #: parameter-name fragments -> role (checked in order; first hit wins)
 _ROLE_PATTERNS = (
@@ -160,13 +160,22 @@ class Candidate:
         return dict(self.param_specs)
 
 
+#: mesh axis names conventionally meaning "pipeline stages". A pipeline
+#: axis is a PLACEMENT dimension (which stage owns which ops), never a
+#: tensor-sharding axis — tensor-parallel/FSDP candidates must not
+#: shard over it; the planner prices it via
+#: ``distributed.pipeline.planning`` instead.
+PIPELINE_AXES = ("pp", "pipe", "pipeline", "stage", "stages")
+
+
 def mesh_axis_split(mesh) -> Tuple[List[str], List[str]]:
     """(batch-ish axes, model-ish axes) of a mesh by conventional
-    names; unknown axes with size > 1 count as model axes, size-1 axes
-    are ignored entirely."""
+    names; pipeline axes (:data:`PIPELINE_AXES`) belong to neither —
+    they partition the program, not tensors; unknown axes with
+    size > 1 count as model axes, size-1 axes are ignored entirely."""
     batch, model = [], []
     for a in mesh.axis_names:
-        if int(mesh.shape[a]) <= 1:
+        if int(mesh.shape[a]) <= 1 or a in PIPELINE_AXES:
             continue
         if a in ("data", "dp", "batch", "replica"):
             batch.append(a)

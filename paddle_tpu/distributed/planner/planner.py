@@ -73,6 +73,10 @@ class PlanResult:
     batch_entry: object
     #: feed ranks, to build in_specs matching each input's rank
     _feed_ranks: Tuple[int, ...]
+    #: when the winner is a pipeline candidate: the
+    #: ``pipeline.planning.PipelinePlan`` (stage boundaries, schedule,
+    #: microbatch count) the runtime executes; None otherwise
+    pipeline: object = None
 
     @property
     def winner(self) -> ScoredCandidate:
@@ -134,13 +138,16 @@ class PlanResult:
         return placed
 
     def summary(self) -> dict:
-        return {
+        out = {
             "winner": self.winner.candidate.name,
             "winner_total_s": self.winner.score.total_s,
             "candidates": len(self.ranked),
             "rejected": len(self.rejected),
             "table": [s.score.to_dict() for s in self.ranked],
         }
+        if self.pipeline is not None:
+            out["pipeline"] = self.pipeline.to_dict()
+        return out
 
     def report(self) -> str:
         from tools.plan_report import render
@@ -283,6 +290,19 @@ def plan(fn_or_program, mesh, in_specs=None, *,
         scored.append(ScoredCandidate(cand, s,
                                       fallbacks=dict(p.fallback_ops)))
 
+    # pipeline axis on the mesh: the stage partitioner contributes one
+    # candidate per schedule, priced on the same alpha-beta scale —
+    # when hard-HBM rejection rules out every TP/FSDP placement, these
+    # are what survives
+    pipeline_plans: Dict[str, object] = {}
+    from ..pipeline.planning import pipeline_candidates
+    for cand, s, pplan in pipeline_candidates(
+            program, mesh, param_ids=pid_set,
+            opt_state_factor=opt_state_factor,
+            capacity_bytes=capacity_bytes):
+        scored.append(ScoredCandidate(cand, s))
+        pipeline_plans[cand.name] = pplan
+
     # rank: survivors by modeled step time, rejected at the tail (by
     # their would-be time) — deterministic tiebreak on candidate name
     scored.sort(key=lambda sc: (sc.score.rejected is not None,
@@ -305,7 +325,8 @@ def plan(fn_or_program, mesh, in_specs=None, *,
                            if v is not None},
         batch_entry=(fixed_in[0] if fixed_in is not None
                      else win.in_spec),
-        _feed_ranks=feed_ranks)
+        _feed_ranks=feed_ranks,
+        pipeline=pipeline_plans.get(win.name))
 
 
 def _is_pspec(x) -> bool:

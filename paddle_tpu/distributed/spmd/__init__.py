@@ -39,6 +39,7 @@ from .rules import (CATEGORY_RULES, SPMD_RULES, Partial,  # noqa: F401
                     SpmdResult, attach_spmd_rules, dedupe, meet,
                     meet_partial, normalize, normalize_partial,
                     rule_class_of, rule_for, to_pspec)
+from .pipeline import boundary_spec, stage_submeshes  # noqa: F401
 from .propagate import (OpAnnotation, ShardedProgram,  # noqa: F401
                         ShardingPlan, param_spec_of, propagate_program,
                         shard_program, trace_scope)
@@ -47,7 +48,7 @@ __all__ = ["shard_program", "ShardedProgram", "ShardingPlan",
            "propagate_program", "trace_scope", "attach_spmd_rules",
            "shard_params", "param_rules_fn", "SPMD_RULES",
            "CATEGORY_RULES", "rule_for", "coverage", "Partial",
-           "meet_partial"]
+           "meet_partial", "stage_submeshes", "boundary_spec"]
 
 
 def param_rules_fn(rules: Sequence[Tuple[str, object]],

@@ -1,0 +1,68 @@
+"""SPMD placement helpers for the pipeline axis.
+
+A ``(data, pp)`` mesh is split along the pipeline axis into one
+submesh per stage; each stage's program runs SPMD over its own
+submesh (data-parallel within the stage, like the reference's
+DP-inside-PP hybrid topology), and cross-stage activations hop
+between adjacent submeshes with ``jax.device_put``. The boundary
+PartitionSpec keeps the micro-batch dimension sharded over the data
+axis when it divides — the send is then a pure resharding between
+same-shaped layouts, which XLA lowers to neighbour ICI transfers —
+and replicates everything else (scalars, odd remainders).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = ["stage_submeshes", "boundary_spec"]
+
+
+def stage_submeshes(mesh, pp_axis: str = "pp") -> List[object]:
+    """Slice ``mesh`` along ``pp_axis`` into one submesh per stage.
+
+    Returns ``mesh.shape[pp_axis]`` meshes, each spanning the devices
+    of one pipeline stage and keeping every non-pipeline axis (so
+    per-stage data parallelism keeps working). A mesh whose only axis
+    is the pipeline axis yields single-device one-axis submeshes.
+    """
+    import numpy as np
+    from jax.sharding import Mesh
+
+    names = list(mesh.axis_names)
+    if pp_axis not in names:
+        raise ValueError(
+            f"mesh axes {tuple(names)} have no pipeline axis "
+            f"{pp_axis!r}")
+    ax = names.index(pp_axis)
+    devs = np.asarray(mesh.devices)
+    sub_names = tuple(n for i, n in enumerate(names) if i != ax)
+    subs = []
+    for s in range(devs.shape[ax]):
+        sl = np.take(devs, s, axis=ax)
+        if not sub_names:
+            # pipeline-only mesh: one device per stage, keep a real
+            # axis so NamedSharding(P()) stays well-formed
+            sl = sl.reshape(1)
+            subs.append(Mesh(sl, ("stage",)))
+        else:
+            subs.append(Mesh(sl, sub_names))
+    return subs
+
+
+def boundary_spec(shape, submesh, data_axis: str = "data",
+                  ndim: Optional[int] = None):
+    """PartitionSpec for one cross-stage value on a stage submesh.
+
+    Dim 0 is sharded over ``data_axis`` when the axis exists on the
+    submesh and divides it; everything else (and every scalar) is
+    replicated — boundary tensors are activations whose only sharded
+    dimension is the micro-batch one.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    n = len(shape) if ndim is None else ndim
+    if (n >= 1 and data_axis in submesh.axis_names):
+        d = int(submesh.shape[data_axis])
+        if d > 1 and int(shape[0]) % d == 0:
+            return P(data_axis, *([None] * (n - 1)))
+    return P()

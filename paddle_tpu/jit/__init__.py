@@ -5,5 +5,6 @@ capture engine lands in api.py; SOT-style bytecode capture is tracked in
 sot/ (reference python/paddle/jit/sot/).
 """
 from .api import to_static, not_to_static, in_capture_mode, ignore_module
+from .api import donating_jit
 from .api import save, load, TranslatedLayer, ArtifactVersionError
 from .traced_layer import TracedLayer

@@ -828,6 +828,43 @@ def ignore_module(modules):
     return None
 
 
+def donating_jit(fn, donate_argnums=(), context="donating_jit"):
+    """``jax.jit`` with buffer donation plus host-side bookkeeping.
+
+    The pipeline runtime's per-stage backward consumes its saved
+    activations and incoming gradients exactly once — donating them
+    lets XLA reuse the buffers in place (double buffering without a
+    second allocation). After each call the donated argument leaves are
+    registered with ``core.donation`` so a stale host read raises the
+    framework's ``DonatedBufferError`` instead of XLA's opaque
+    deleted-array failure (same contract as ``to_static(donate=True)``).
+    On backends where donation is unimplemented (CPU) the call still
+    works; XLA's "donated buffers were not usable" noise is filtered.
+    """
+    import warnings
+
+    dn = tuple(int(i) for i in donate_argnums)
+    jitted = jax.jit(fn, donate_argnums=dn) if dn else jax.jit(fn)
+
+    @functools.wraps(fn)
+    def call(*args):
+        with warnings.catch_warnings():
+            warnings.filterwarnings(
+                "ignore", message=".*donated buffers were not usable.*")
+            out = jitted(*args)
+        if dn:
+            from ..core import donation as _donation
+            leaves = []
+            for i in dn:
+                if i < len(args):
+                    leaves.extend(jax.tree_util.tree_leaves(args[i]))
+            _donation.mark_donated(leaves, context)
+        return out
+
+    call._jitted = jitted
+    return call
+
+
 def _example_arrays(input_spec):
     """InputSpec / Tensor / ndarray entries -> jax abstract values. A -1
     dim becomes a symbolic dimension so the saved program serves any size

@@ -8,11 +8,18 @@ gathering by parent beam, gather_tree backtrace in finalize :630;
 ``max_step_num``).
 
 TPU-native notes: the per-step math is jnp (one fused XLA program per
-step under the dispatch pipeline); the decode loop itself is host-driven
-exactly like the reference dygraph path. Beam bookkeeping follows the
-reference: finished beams may only extend with ``end_token`` (zero
-log-prob there, -1e9 elsewhere), lengths freeze once finished, and the
-final ids come from ``gather_tree`` over (predicted_ids, parent_ids).
+step under the dispatch pipeline). With ``max_step_num`` given, the
+whole decode loop runs IN-GRAPH as one ``lax.while_loop`` — fixed-size
+output buffers written with ``.at[t].set``, early exit when every beam
+finishes, and a single host sync at the end to trim the buffers to the
+realized length (the reference host loop synced once per step). The
+unbounded ``max_step_num=None`` path stays host-driven like the
+reference dygraph loop: without a step bound there is no fixed output
+shape for XLA, so the per-step finished check MUST read device state.
+Beam bookkeeping follows the reference: finished beams may only extend
+with ``end_token`` (zero log-prob there, -1e9 elsewhere), lengths
+freeze once finished, and the final ids come from ``gather_tree`` over
+(predicted_ids, parent_ids).
 """
 from __future__ import annotations
 
@@ -200,15 +207,78 @@ class BeamSearchDecoder(Decoder):
         return predicted, final_states
 
 
-def dynamic_decode(decoder, inits=None, max_step_num=None,
-                   output_time_major=False, impute_finished=False,
-                   is_test=False, return_length=False, **kwargs):
-    """Loop ``decoder.step`` until every beam finishes (reference
-    decode.py:994)."""
+def _raw(structure):
+    return _map_structure(lambda x: as_tensor(x)._data, structure)
+
+
+def _wrap(structure):
+    return _map_structure(Tensor, structure)
+
+
+def _decode_bounded(decoder, inits, max_step_num, **kwargs):
+    """Bounded decode as ONE in-graph ``lax.while_loop``.
+
+    The reference host loop runs steps for ``t = 0..max_step_num`` with
+    an early break once every beam finishes — and pays one device→host
+    sync PER STEP for that finished check. Here the loop, its early
+    exit, and the output accumulation (fixed ``max_step_num + 1`` row
+    buffers, ``.at[t].set``) are a single XLA program; only the final
+    buffer trim reads the realized step count back to the host.
+    Namedtuple states/outputs ride the loop carry as plain jax pytrees
+    (raw arrays — :class:`Tensor` is not a registered pytree)."""
+    from jax import lax
+
+    inputs, states, finished = decoder.initialize(inits)
+    n_steps = int(max_step_num) + 1     # host loop runs t = 0..max
+
+    def step_fn(t, inputs_r, states_r, finished_r):
+        out, nstates, ninputs, nfin = decoder.step(
+            Tensor(jnp.full((1,), t, jnp.int32)), _wrap(inputs_r),
+            _wrap(states_r), **kwargs)
+        nf = as_tensor(nfin)._data
+        if not decoder.tracks_own_finished:
+            nf = nf | finished_r
+        return _raw(out), _raw(nstates), _raw(ninputs), nf
+
+    carry0 = (jnp.asarray(0, jnp.int32), _raw(inputs), _raw(states),
+              _raw(finished))
+    out_sds, _s, _i, _f = jax.eval_shape(
+        lambda i, s, f: step_fn(0, i, s, f), *carry0[1:])
+    bufs0 = jax.tree_util.tree_map(
+        lambda sd: jnp.zeros((n_steps,) + tuple(sd.shape), sd.dtype),
+        out_sds)
+
+    def cond(carry):
+        t, _inputs, _states, fin, _bufs = carry
+        # the first step always runs (reference loop is do-while); after
+        # that: more steps remain AND some beam is still live
+        return (t == 0) | ((t < n_steps) & ~jnp.all(fin))
+
+    def body(carry):
+        t, inputs_r, states_r, fin, bufs = carry
+        out_r, states_r, inputs_r, fin = step_fn(
+            t, inputs_r, states_r, fin)
+        bufs = jax.tree_util.tree_map(
+            lambda b, o: b.at[t].set(o), bufs, out_r)
+        return t + 1, inputs_r, states_r, fin, bufs
+
+    t_end, _inputs_r, states_r, _fin_r, bufs = lax.while_loop(
+        cond, body, carry0 + (bufs0,))
+    # the ONLY host sync of the bounded path: trim the fixed buffers to
+    # the realized decode length (rows past t_end were never written)
+    steps = int(np.asarray(t_end))  # tpulint: disable=TPU103,TPU104 — one deliberate sync per decode (not per step): the realized length is dynamic and the trimmed host-facing output shape needs it
+    stacked = _map_structure(lambda b: Tensor(b[:steps]), bufs)
+    return stacked, _wrap(states_r)
+
+
+def _decode_host(decoder, inits, max_step_num, impute_finished, **kwargs):
+    """Unbounded decode: the reference dygraph host loop. Without a
+    step bound there is no fixed output shape for an XLA while_loop, so
+    the loop must live on the host and the per-step all-finished check
+    necessarily reads device state."""
     inputs, states, finished = decoder.initialize(inits)
     step_outputs = []
     t = 0
-    seq_lengths = None
     while True:
         output, next_states, next_inputs, next_finished = decoder.step(
             as_tensor(np.array([t], np.int64)), inputs, states, **kwargs)
@@ -224,7 +294,7 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         step_outputs.append(output)
         inputs, states, finished = next_inputs, next_states, nf
         t += 1
-        done = bool(np.asarray(finished._data).all())
+        done = bool(np.asarray(finished._data).all())  # tpulint: disable=TPU103,TPU104 — unbounded loop termination is inherently a host decision; the bounded path (max_step_num given) runs in-graph
         if done or (max_step_num is not None and t > int(max_step_num)):
             break
 
@@ -232,6 +302,22 @@ def dynamic_decode(decoder, inits=None, max_step_num=None,
         Tensor(jnp.stack([as_tensor(getattr(o, f))._data
                           for o in step_outputs]))
         for f in _Output._fields])
+    return stacked, states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Loop ``decoder.step`` until every beam finishes (reference
+    decode.py:994). With ``max_step_num`` the loop runs in-graph as a
+    single ``lax.while_loop`` program (one host sync per decode); the
+    unbounded form keeps the reference host loop."""
+    if max_step_num is not None and not impute_finished:
+        stacked, states = _decode_bounded(decoder, inits,
+                                          int(max_step_num), **kwargs)
+    else:
+        stacked, states = _decode_host(decoder, inits, max_step_num,
+                                       impute_finished, **kwargs)
     seq_lengths = getattr(states, "lengths", None)
     if hasattr(decoder, "finalize"):
         try:

@@ -31,6 +31,12 @@ Four pass families, each with its own code block (``CODES``):
 * **TPU6xx — donation hazards**: parameters marked for donation that
   the traced step itself host-reads — the read the round-17 runtime
   registry would only catch once the stale buffer is touched.
+* **TPU8xx — cross-stage desync**: the pipeline partitioner renders
+  each stage as a record list with explicit ``send``/``recv`` boundary
+  records (``distributed.pipeline.StagePartition.stage_records``);
+  :func:`check_stages` statically matches every stage's sends against
+  the next stage's recvs — count, shape/dtype, and sequence order —
+  the compile-time complement of ``flight.diff_ranks``, per stage.
 
 Wired into all three compile paths behind ``FLAGS_verify_programs``
 (default ``warn``; ``strict`` raises :class:`ProgramVerifierError`
@@ -49,8 +55,8 @@ from typing import Dict, List, Optional, Sequence
 
 __all__ = ["CODES", "Finding", "Report", "ProgramVerifierError",
            "ProgramVerifierWarning", "check", "check_records",
-           "audit_step", "trace_scope", "mode", "enforce",
-           "COLLECTIVE_OPS"]
+           "check_stages", "audit_step", "trace_scope", "mode",
+           "enforce", "COLLECTIVE_OPS"]
 
 #: every code the verifier can emit (severity: error = strict raises,
 #: warn = reported but never fatal)
@@ -85,6 +91,14 @@ CODES = {
                        "sees the stale pre-mutation value)"),
     "TPU705": ("error", "fetched value is produced by no op and is "
                         "neither a feed nor a captured parameter"),
+    # TPU8xx — pipeline cross-stage desync
+    "TPU801": ("error", "adjacent pipeline stages disagree on the "
+                        "number of boundary sends/recvs"),
+    "TPU802": ("error", "pipeline boundary value shape/dtype differs "
+                        "between send and matching recv"),
+    "TPU803": ("error", "pipeline send/recv sequence mismatch (peer "
+                        "or transfer order disagrees between adjacent "
+                        "stages)"),
 }
 
 #: op names the collective pass treats as fleet-wide synchronization
@@ -702,6 +716,81 @@ def check(program, mesh=None, in_specs=None, param_specs=None,
 
 
 check_records = check
+
+
+_SEND_NAMES = ("send", "isend")
+_RECV_NAMES = ("recv", "irecv")
+
+
+def check_stages(stage_records, label: str = "pipeline") -> Report:
+    """Static cross-stage desync analysis (TPU8xx).
+
+    ``stage_records``: one record list per pipeline stage, each with
+    explicit ``send``/``recv`` boundary records carrying ``peer``
+    (adjacent stage index), ``seq`` (transfer position), and the
+    boundary value's shape/dtype (send: ``in_shapes``/``in_dtypes``,
+    recv: ``out_shapes``/``out_dtypes``) — the shape
+    ``distributed.pipeline.StagePartition.stage_records`` emits. Every
+    stage's send sequence must match the next stage's recv sequence in
+    count (TPU801), value shape/dtype (TPU802), and order/peer
+    (TPU803) — a mismatch is the static form of the cross-rank hang
+    ``flight.diff_ranks`` diagnoses at runtime.
+    """
+    stages = [[Record.of(r) for r in recs] for recs in stage_records]
+    S = len(stages)
+    report = Report(label=label)
+    checked = 0
+    for s, recs in enumerate(stages):
+        for i, r in enumerate(recs):
+            peer = r.attrs.get("peer")
+            if r.name in _SEND_NAMES and peer != s + 1:
+                report.add("TPU803", i, r.name,
+                           f"stage {s} sends to peer {peer} — pipeline "
+                           f"boundary transfers must target the "
+                           f"adjacent stage {s + 1}", r.loc)
+            elif r.name in _RECV_NAMES and peer != s - 1:
+                report.add("TPU803", i, r.name,
+                           f"stage {s} receives from peer {peer} — "
+                           f"pipeline boundary transfers must come "
+                           f"from the adjacent stage {s - 1}", r.loc)
+    for s in range(S - 1):
+        sends = [(i, r) for i, r in enumerate(stages[s])
+                 if r.name in _SEND_NAMES
+                 and r.attrs.get("peer") == s + 1]
+        recvs = [(i, r) for i, r in enumerate(stages[s + 1])
+                 if r.name in _RECV_NAMES
+                 and r.attrs.get("peer") == s]
+        if len(sends) != len(recvs):
+            report.add(
+                "TPU801", -1, f"stage{s}->stage{s + 1}",
+                f"stage {s} sends {len(sends)} value(s) but stage "
+                f"{s + 1} receives {len(recvs)} — the pipeline "
+                f"deadlocks at this boundary")
+        for k in range(min(len(sends), len(recvs))):
+            si, snd = sends[k]
+            ri, rcv = recvs[k]
+            s_shape = snd.in_shapes[0] if snd.in_shapes else None
+            s_dt = snd.in_dtypes[0] if snd.in_dtypes else None
+            r_shape = rcv.out_shapes[0] if rcv.out_shapes else None
+            r_dt = rcv.out_dtypes[0] if rcv.out_dtypes else None
+            if s_shape != r_shape or s_dt != r_dt:
+                report.add(
+                    "TPU802", ri, rcv.name,
+                    f"boundary {s}->{s + 1} position {k}: send is "
+                    f"{s_dt}{list(s_shape or ())}, recv expects "
+                    f"{r_dt}{list(r_shape or ())}", rcv.loc or snd.loc)
+            if snd.attrs.get("seq", k) != rcv.attrs.get("seq", k):
+                report.add(
+                    "TPU803", ri, rcv.name,
+                    f"boundary {s}->{s + 1} position {k}: send seq "
+                    f"{snd.attrs.get('seq')} pairs with recv seq "
+                    f"{rcv.attrs.get('seq')} — transfer order "
+                    f"diverges between the stages", rcv.loc or snd.loc)
+            checked += 1
+    report.stats = {"stages": S, "boundary_values": checked,
+                    "ops": sum(len(recs) for recs in stage_records),
+                    "passes": ["stages"]}
+    return report
 
 
 def audit_step(fn, args=(), kwargs=None, donate_params=(), mesh=None,

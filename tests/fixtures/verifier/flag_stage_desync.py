@@ -1,0 +1,44 @@
+"""Must-flag: a pipeline stage partition whose cross-stage send/recv
+contract was tampered after the cut — the static desync family.
+
+Stage 1's recv expects the wrong shape (TPU802) and claims the wrong
+transfer sequence number (TPU803); stage 2 dropped a recv entirely so
+the boundary counts disagree (TPU801). This is exactly the runtime
+failure mode of a hand-edited stage program: the sender ships
+activations the receiver re-interprets — XLA would type-check nothing
+across the processes, the desync surfaces as garbage loss at best.
+TPU801 + TPU802 + TPU803."""
+
+EXPECT = ["TPU801", "TPU802", "TPU803"]
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import static
+    from paddle_tpu.distributed.pipeline import partition_program
+    from paddle_tpu.static import verifier
+
+    paddle.seed(7)
+    blocks = []
+    for _ in range(3):
+        blocks += [nn.Linear(8, 8), nn.GELU()]
+    model = nn.Sequential(*blocks)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        loss = (model(x) ** 2).mean()
+    part = partition_program(prog, 3, strategy="uniform",
+                             fetch_ids=[id(loss)])
+    stages = [list(recs) for recs in part.stage_records()]
+
+    # stage 1: first recv re-declares the boundary value's shape and
+    # transfer order — content desync (TPU802) + order desync (TPU803)
+    for rec in stages[1]:
+        if rec.name == "recv":
+            rec.out_shapes = ((4, 9),)
+            rec.attrs["seq"] = 5
+            break
+    # stage 2: drop its recv — the 1->2 boundary count disagrees
+    stages[2] = [r for r in stages[2] if r.name != "recv"]
+    return verifier.check_stages(stages, label="flag_stage_desync")

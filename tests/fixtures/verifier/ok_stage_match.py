@@ -1,0 +1,28 @@
+"""Must-not-flag: a cost-partitioned pipeline whose stage programs
+carry a consistent cross-stage contract — every boundary value's send
+pairs with a recv of the same shape/dtype, in the same transfer order,
+between adjacent stages. The partitioner emits this by construction;
+the fixture pins that check_stages stays quiet on it."""
+
+EXPECT = []
+
+
+def build():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import static
+    from paddle_tpu.distributed.pipeline import partition_program
+    from paddle_tpu.static import verifier
+
+    paddle.seed(7)
+    blocks = []
+    for _ in range(4):
+        blocks += [nn.Linear(8, 8), nn.GELU()]
+    model = nn.Sequential(*blocks)
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        loss = (model(x) ** 2).mean()
+    part = partition_program(prog, 2, fetch_ids=[id(loss)])
+    return verifier.check_stages(part.stage_records(),
+                                 label="ok_stage_match")

@@ -53,9 +53,14 @@ class TestChunkedFormat:
         fio.save({"a": 1}, str(tmp_path / "y"), protocol=2)
         assert fio.load(str(tmp_path / "y"))["a"] == 1
 
+    @pytest.mark.slow
     def test_over_4gb_state_dict(self, tmp_path):
         """A >4 GB state_dict streams through without any pickle frame
-        near the 4 GB limit (reference io.py:743 chunking contract)."""
+        near the 4 GB limit (reference io.py:743 chunking contract).
+
+        slow: materialising + round-tripping 4.5 GiB costs ~2 min on a
+        1-core CI box; the chunk-boundary contract itself is covered at
+        small sizes by the rest of this class."""
         gib = 1 << 30
         state = {
             "embed": paddle.to_tensor(

@@ -565,9 +565,9 @@ class TestPerfGate:
         run it was frozen from. Rungs added to the baseline AFTER the
         r05 freeze (fleet_observability round 14, fusion round 15,
         planner_vs_manual round 16, async_overlap + async_batch_sweep
-        round 17, serving_router round 18, serving_reqtrace round 19)
-        are absent from the archived run — they may be missing, but
-        nothing may fail."""
+        round 17, serving_router round 18, serving_reqtrace round 19,
+        pipeline_bubble round 21) are absent from the archived run —
+        they may be missing, but nothing may fail."""
         with open(os.path.join(REPO, "tools", "perf_baseline.json")) as f:
             base = json.load(f)
         assert base["format"] == "paddle_tpu.perf_baseline/1"
@@ -595,6 +595,9 @@ class TestPerfGate:
         # the verifier bar encodes the <2% budget: value * min_ratio
         vo = base["rungs"]["verifier_overhead_ratio"]
         assert vo["value"] * vo["min_ratio"] >= 0.98
+        # the pipeline bar is the boolean acceptance gate itself
+        pb = base["rungs"]["pipeline_bubble_measured_vs_analytical"]
+        assert pb["value"] * pb["min_ratio"] >= 1.0
         assert missing <= {"fleet_observability_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",
@@ -602,7 +605,8 @@ class TestPerfGate:
                            "async_batch_sweep_tokens_ratio",
                            "serving_router_goodput_scaling",
                            "verifier_overhead_ratio",
-                           "serving_reqtrace_overhead_ratio"}
+                           "serving_reqtrace_overhead_ratio",
+                           "pipeline_bubble_measured_vs_analytical"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"

@@ -70,7 +70,8 @@ class TestFixtureCorpus:
                 "TPU501", "TPU502", "TPU503",                # sharding
                 "TPU601",                                    # donation
                 "TPU700", "TPU701", "TPU702", "TPU703",
-                "TPU704", "TPU705"} <= expected              # contract
+                "TPU704", "TPU705",                          # contract
+                "TPU801", "TPU802", "TPU803"} <= expected    # stages
         assert any(not _load_fixture(f).EXPECT
                    for f in _FIXTURE_FILES), "no must-not-flag fixtures"
 

@@ -48,7 +48,11 @@ class TestZooForward:
         out, a1, a2 = net(x)
         assert out.shape == [1, 5] and a1.shape == [1, 5] and a2.shape == [1, 5]
 
+    @_slow
     def test_inception_v3(self):
+        # inception family stays represented in tier-1 by googlenet
+        # (which also checks the aux-head contract); v3's larger stem
+        # costs ~12s of conv compiles
         net = models.inception_v3(num_classes=4)
         net.eval()
         x = paddle.to_tensor(np.random.RandomState(0).rand(
@@ -61,7 +65,11 @@ class TestZooForward:
             2, 1, 28, 28).astype(np.float32))
         assert net(x).shape == [2, 10]
 
+    @_slow
     def test_mobilenet_v2_trains(self):
+        # ~35s of depthwise-conv backward compiles; "a zoo CNN trains"
+        # stays in tier-1 via resnet18 (test_models_hapi) and the
+        # mobilenet_v2 forward above still runs
         net = models.mobilenet_v2(scale=0.25, num_classes=2)
         opt = paddle.optimizer.Adam(learning_rate=1e-3,
                                     parameters=net.parameters())

@@ -91,10 +91,10 @@ def main(argv=None) -> int:
                     help="lint only python files changed since this "
                          "git revision (within the given paths)")
     ap.add_argument("--programs", action="store_true",
-                    help="trace the framework's ladder programs and "
-                         "run the static program verifier "
-                         "(static.verifier TPU4xx/5xx/6xx/7xx) over "
-                         "each op-list IR")
+                    help="trace the framework's ladder + serving-tick "
+                         "+ pipeline-stage programs and run the static "
+                         "program verifier (static.verifier "
+                         "TPU4xx/5xx/6xx/7xx/8xx) over each op-list IR")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
     args = ap.parse_args(argv)

@@ -8,6 +8,9 @@ loss, a tiny llama forward, an SGD train step, in-graph control flow,
 the fusion pass's rewritten plan, and a sharded program over a mesh —
 must verify CLEAN. A finding here is new framework debt: fix the
 program, or suppress it in the verifier call with a justification.
+Round 21 adds the serving decode/verify tick programs (the paged
+engine's jitted chunk replayed eagerly over live cache state) and the
+pipeline stage slices + cross-stage send/recv contract (TPU8xx).
 
 Kept import-light: heavy imports happen inside :func:`build_programs`
 so ``python -m tools.tpulint`` without ``--programs`` stays AST-only.
@@ -139,12 +142,91 @@ def _programs_impl() -> List[Tuple[str, Callable[[], object]]]:
             prog.global_block().ops, fetch)
         return verifier.check(plan, fetch_ids=fetch, label="fused_plan")
 
+    def _paged_engine(speculate=False):
+        """Tiny-GPT paged engine advanced one tick so the K/V caches,
+        block tables, and slot state are live decode state."""
+        from paddle_tpu.inference import serving as sv
+        from paddle_tpu.models import GPTConfig, GPTForCausalLM
+        paddle.seed(7)
+        model = GPTForCausalLM(GPTConfig(
+            vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+            max_seq_len=64, use_flash_attention=False))
+        eng = sv.PagedEngine(model, max_batch=2, block_size=8,
+                             num_blocks=32, max_blocks_per_seq=8,
+                             speculate=speculate, speculate_k=2)
+        eng.add_request([3, 5, 7, 9], max_new_tokens=8)
+        eng.step()
+        return sv, eng
+
+    def _chunk_args(eng, tokens, seq):
+        return eng._chunk_args(
+            tokens, seq, eng.tables,
+            np.zeros((eng.max_batch,), np.float32),
+            np.ones((eng.max_batch,), np.float32),
+            np.zeros((eng.max_batch,), np.int32),
+            np.zeros((eng.max_batch,), np.int32))
+
+    def serving_decode_tick():
+        # the engine's decode tick is ONE jitted program
+        # (inference/serving._paged_forward); replay it EAGERLY over
+        # live engine state so the recorder sees the same op stream the
+        # jit traces — a dispatched-but-unregistered op is TPU700 here
+        sv, eng = _paged_engine()
+        seq = eng.seq_lens.copy()
+        if eng.slots[0] is not None:
+            seq[0] = eng.slots[0].seq_len
+        tokens = eng.last_token[:, None].astype(np.int32)
+        return verifier.audit_step(
+            sv._paged_forward,
+            (eng.arch, tuple(eng._params))
+            + tuple(_chunk_args(eng, tokens, seq)),
+            label="serving_decode_tick")
+
+    def serving_verify_tick():
+        # the speculative sibling: one (B, k+1) verify program with the
+        # in-graph accept-prefix — the fused decode path of round 18
+        sv, eng = _paged_engine(speculate=True)
+        k = eng._spec_k
+        seq = eng.seq_lens.copy()
+        if eng.slots[0] is not None:
+            seq[0] = eng.slots[0].seq_len + k
+        tokens = np.zeros((eng.max_batch, k + 1), np.int32)
+        tokens[0, 0] = eng.last_token[0]
+        return verifier.audit_step(
+            sv._paged_verify,
+            (eng.arch, tuple(eng._params))
+            + tuple(_chunk_args(eng, tokens, seq))
+            + (np.full((eng.max_batch,), k, np.int32),),
+            label="serving_verify_tick")
+
+    def pipeline_stages():
+        # every stage slice of a cost-partitioned program must verify
+        # as a standalone op stream AND the cross-stage send/recv
+        # contract must match (TPU801/802/803, verifier.check_stages)
+        from paddle_tpu.distributed.pipeline import partition_program
+        import paddle_tpu.nn as nn
+        paddle.seed(7)
+        blocks = []
+        for _ in range(4):
+            blocks += [nn.Linear(16, 16), nn.GELU()]
+        model = nn.Sequential(*blocks)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 16], "float32")
+            loss = (model(x) ** 2).mean()
+        part = partition_program(prog, 2, fetch_ids=[id(loss)])
+        return verifier.check_stages(part.stage_records(),
+                                     label="pipeline_stages")
+
     return [("gpt_loss", gpt_loss),
             ("gpt_loss_sharded", gpt_loss_sharded),
             ("llama_forward", llama_forward),
             ("sgd_train_step", sgd_train_step),
             ("control_flow", control_flow),
-            ("fused_plan", fused_plan)]
+            ("fused_plan", fused_plan),
+            ("serving_decode_tick", serving_decode_tick),
+            ("serving_verify_tick", serving_verify_tick),
+            ("pipeline_stages", pipeline_stages)]
 
 
 def build_programs():
