@@ -1057,6 +1057,98 @@ def _bench_verifier_overhead(small):
     }
 
 
+def _bench_static_analysis(small):
+    """Static memory-analyzer rung (BENCH_MODEL=static_analysis;
+    paddle_tpu/static/liveness.py). Like the verifier rung, the
+    analyzer runs ONCE per new compile signature, so its budget is a
+    fraction of trace+lower. Measures (a) trace+lower wall of the GPT
+    ladder block's recorded program (fresh jax.jit + .lower per rep)
+    and (b) the full round-22 static stack over the same op list —
+    liveness intervals + peak curve (peak_report), the TPU75x alias
+    pass, and the TPU9xx capacity pass; value =
+    trace_lower / (trace_lower + analysis) (1.0 = free), acceptance
+    bar: analysis < 2% of trace+lower."""
+    import paddle_tpu as paddle
+    from paddle_tpu import static
+    from paddle_tpu.core import flags
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.static import liveness, verifier
+    import paddle_tpu.ops as pops
+
+    paddle.seed(7)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=64, hidden_size=32, num_layers=2, num_heads=4,
+        max_seq_len=16, use_flash_attention=False))
+
+    prev = flags.get_flag("verify_programs")
+    reps = 5 if small else _env_int("BENCH_STATIC_ANALYSIS_REPS", 10)
+    try:
+        flags.set_flags({"verify_programs": "off"})
+        prog = static.Program()
+        with static.program_guard(prog):
+            ids = static.data("ids", [2, 8], "int64")
+            logits = model(ids)
+            if isinstance(logits, (tuple, list)):
+                logits = logits[0]
+            v = logits.shape[-1]
+            loss = F.cross_entropy(
+                pops.reshape(logits[:, :-1, :], [-1, v]),
+                pops.reshape(ids[:, 1:], [-1])).mean()
+        fetch_ids = [id(loss)]
+        names = sorted(prog.feed_vars)
+        feed_ids = [prog.feed_vars[n] for n in names]
+        cap_ids = list(prog._captured.keys())
+        cap_arrays = [t._data for t in prog._captured.values()]
+        feeds = [jnp.zeros(tuple(abs(s) for s in prog._feed_shapes[n]),
+                           dtype=np.dtype(prog._feed_dtypes[n]))
+                 for n in names]
+
+        t_tl = []
+        for _ in range(reps):
+            def replay(feed_arrays, caps):
+                env = prog._replay_by_ids(feed_ids, feed_arrays,
+                                          cap_ids, caps)
+                return [env[i] for i in fetch_ids]
+
+            t0 = time.perf_counter()
+            jax.jit(replay).lower(feeds, cap_arrays)
+            t_tl.append(time.perf_counter() - t0)
+
+        t_a = []
+        rep_out = None
+        peak = None
+        for _ in range(reps * 4):
+            t0 = time.perf_counter()
+            rep_out = verifier.Report(label="bench_static")
+            liveness.alias_pass(prog, rep_out, fetch_ids=fetch_ids)
+            liveness.memory_pass(prog, rep_out, fetch_ids=fetch_ids)
+            peak = liveness.peak_report(prog, fetch_ids=fetch_ids)
+            t_a.append(time.perf_counter() - t0)
+        assert rep_out is not None and not rep_out.findings, \
+            "ladder program must analyze clean"
+        assert peak is not None and peak["peak_bytes"] > 0
+    finally:
+        flags.set_flags({"verify_programs": prev})
+    trace_lower = float(np.median(t_tl))
+    analysis = float(np.median(t_a))
+    ratio = trace_lower / max(trace_lower + analysis, 1e-12)
+    overhead_pct = analysis / max(trace_lower, 1e-12) * 100.0
+    return {
+        "metric": "static_analysis_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_unanalyzed_compile",
+        "vs_baseline": round(ratio, 4),
+        "extra": {"overhead_pct": round(overhead_pct, 3),
+                  "trace_lower_ms": round(trace_lower * 1e3, 2),
+                  "analysis_ms": round(analysis * 1e3, 3),
+                  "static_peak_bytes": peak["peak_bytes"],
+                  "peak_op": peak["peak_op"]["name"],
+                  "ops": len(prog.global_block().ops),
+                  "within_budget": bool(overhead_pct < 2.0)},
+    }
+
+
 def _bench_spmd_auto(small):
     """SPMD auto-sharding rung (BENCH_MODEL=spmd_auto;
     paddle_tpu/distributed/spmd/). The SAME weights run one GPT
@@ -2300,6 +2392,7 @@ def main():
                "serving_router": _bench_serving_router,
                "serving_reqtrace": _bench_serving_reqtrace,
                "verifier_overhead": _bench_verifier_overhead,
+               "static_analysis": _bench_static_analysis,
                "compile_cache": _bench_compile_cache,
                "spmd_auto": _bench_spmd_auto,
                "planner_vs_manual": _bench_planner_vs_manual,

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os as _os
 from typing import List, Optional
 
 import jax
@@ -93,6 +94,16 @@ def _coll_begin(name: str, payload=None, group: Optional[Group] = None,
                 getattr(dt, "itemsize", 0) or 0)
         rec = _flight.RECORDER.begin(gid, name, shape, dt, nbytes,
                                      **extra)
+    if _os.environ.get("PADDLE_TPU_PROGRAM_RECORD"):
+        # static cross-rank seam (tpulint --cross-rank): eager
+        # collectives never ride the dispatch recorder, so the program
+        # dump notes them here — env-gated, zero cost otherwise
+        from ...static import crossrank as _crossrank
+        _crossrank.note_collective(
+            name, getattr(payload, "shape", ()),
+            getattr(payload, "dtype", ""),
+            getattr(group, "id", 0) if group is not None else 0,
+            **extra)
     return (t0, rec, name)
 
 

@@ -91,6 +91,9 @@ class TopKGate(Layer):
         return combine, dispatch.astype(jnp.float32), aux
 
     def forward(self, x: Tensor):
+        """GShard top-k gating: token logits -> (combine weights
+        [N, E, C], dispatch mask [N, E, C], load-balance aux loss) —
+        the registered ``moe_gate`` op."""
         def f(xa, wa):
             logits = xa.reshape(-1, xa.shape[-1]) @ wa
             return self._routing(logits)
@@ -155,6 +158,9 @@ class MoELayer(Layer):
         self.l_aux: Optional[Tensor] = None
 
     def forward(self, x: Tensor) -> Tensor:
+        """Dispatch/expert/combine as ONE ``moe_layer`` op: the GShard
+        einsum pair around the vmapped stacked experts; the aux loss
+        lands on ``self.l_aux``."""
         combine, dispatch_mask, aux = self.gate(x)
         self.l_aux = aux
 
@@ -191,7 +197,12 @@ class MoELayer(Layer):
                 for p, a in zip(tmpl_params, pvals):
                     p._data = a
                 try:
-                    return template(Tensor(xe, stop_gradient=False))._data
+                    # the template's own dispatches are INTERNAL to this
+                    # lowering: without the quiet scope they'd leak into
+                    # an enclosing program_guard as dead nested records
+                    with dispatch.quiet_scope():
+                        return template(
+                            Tensor(xe, stop_gradient=False))._data
                 finally:
                     for p, o in zip(tmpl_params, originals):
                         p._data = o
@@ -204,3 +215,15 @@ class MoELayer(Layer):
 
         return dispatch.call("moe_layer", f,
                              [x, combine, dispatch_mask, *all_params])
+
+
+# the registry is the op surface of record (verifier TPU700): the MoE
+# ops dispatch from the layer forwards, which close over the routing
+# hyperparameters — the forwards ARE the lowerings. The planner prices
+# both through its explicit PENALTY_OPS table, never silently.
+from ...ops import registry as _op_registry  # noqa: E402
+
+_op_registry.register("moe_gate", "nn_common",
+                      tags=("moe",))(TopKGate.forward)
+_op_registry.register("moe_layer", "nn_common",
+                      tags=("moe",))(MoELayer.forward)

@@ -31,10 +31,14 @@ candidate placement:
   at the chip's ICI bandwidth.
 * **memory** — per-device HBM high-water: parameters + gradients +
   optimizer state (``opt_state_factor`` extra param copies, 2.0 =
-  Adam) at their sharded sizes, plus every forward activation at its
-  sharded size (training keeps them live for backward), plus the
-  sharded feed batch. A plan over ``capacity_bytes`` is **rejected**,
-  not ranked.
+  Adam) at their sharded sizes, plus the **liveness-at-peak**
+  activation bytes (``static.liveness``: each op output lives from its
+  def to its last use; GEMM operands are pinned to program end because
+  the backward wgrad re-reads them), plus the sharded feed batch. The
+  old every-activation-resident sum overcharged long elementwise
+  chains by the full chain depth; the interval model prices what a
+  rematerialization-free executor actually holds. A plan over
+  ``capacity_bytes`` is **rejected**, not ranked.
 
 Ops with neither a rule nor a cost model are either listed in
 :data:`PENALTY_OPS` (an explicit, documented surcharge — e.g. the
@@ -162,6 +166,10 @@ class Score:
     fallback_ops: Dict[str, int] = field(default_factory=dict)
     unscored_ops: Dict[str, int] = field(default_factory=dict)
     penalty_ops: Dict[str, int] = field(default_factory=dict)
+    #: op holding the activation high-water (memory_breakdown stays
+    #: float-only; attribution rides here)
+    activation_peak_op: str = ""
+    activation_peak_index: int = -1
 
     @property
     def total_s(self) -> float:
@@ -179,7 +187,9 @@ class Score:
                 "collective_breakdown": dict(self.collective_breakdown),
                 "memory_breakdown": dict(self.memory_breakdown),
                 "fallback_ops": dict(self.fallback_ops),
-                "unscored_ops": dict(self.unscored_ops)}
+                "unscored_ops": dict(self.unscored_ops),
+                "activation_peak_op": self.activation_peak_op,
+                "activation_peak_index": self.activation_peak_index}
 
 
 def _op_seconds(cost: OpCost, fraction: float, peak_f: float,
@@ -236,7 +246,6 @@ def score_plan(program, plan, mesh, *,
         if c is not None:
             total_flops += c.flops
 
-    activations = 0.0
     for op, ann, c in zip(ops, plan.annotations, op_costs):
         out_shapes = op.out_shapes or ()
         in_shapes = op.in_shapes or ()
@@ -329,9 +338,24 @@ def score_plan(program, plan, mesh, *,
                 coll["backward"] += _collective_seconds(
                     "all_reduce", nb, col_axes, mesh)
 
-        for shape, spec in zip(out_shapes, ann.out_specs):
-            activations += _value_bytes(shape) \
-                * shard_fraction(spec, mesh, shape)
+    # ---- activations: liveness-at-peak (static.liveness) --------------
+    # GEMM operands are pinned to program end (the backward wgrad
+    # re-reads them — the "saved for backward" set); everything else
+    # dies at its last use. Entry values (feeds + captured params) are
+    # priced in their own memory classes below, never double-counted
+    # here.
+    from ...static import liveness as _liveness
+    entry_ids = set(program.feed_vars.values()) \
+        | set(program._captured.keys())
+    pinned = set()
+    for op in ops:
+        if op.name in GEMM_OPS:
+            pinned.update(v for v in op.in_ids if v not in entry_ids)
+    activations, peak_i, peak_op = _liveness.activation_peak(
+        ops, exclude_ids=entry_ids, plan=plan, mesh=mesh,
+        pinned_ids=pinned)
+    sc.activation_peak_op = peak_op
+    sc.activation_peak_index = peak_i
 
     # ---- data-parallel gradient sync ----------------------------------
     feed_axes = set()

@@ -41,6 +41,44 @@ def _norm_index(idx):
     return conv(idx)
 
 
+def _static_region(idx, shape):
+    """Per-dim ``(start, stop)`` hull of a static int/slice index
+    expression, or None when any component is data-dependent (tensor /
+    array / mask indices) or unhandled. Dims past the indexed prefix
+    are full extent. Consumed by the verifier's TPU75x alias pass
+    (static.liveness): a provably-disjoint write/read pair is safe, so
+    the hull must never under-approximate — unknown means None."""
+    import builtins                    # `slice` is shadowed by the op
+    items = idx if isinstance(idx, tuple) else (idx,)
+    region = []
+    for k, it in enumerate(items):
+        if k >= len(shape):
+            return None
+        n = int(shape[k])
+        if isinstance(it, bool) or it is None or it is Ellipsis:
+            return None
+        if isinstance(it, (int, np.integer)):
+            s = int(it) + (n if int(it) < 0 else 0)
+            if not 0 <= s < n:
+                return None
+            region.append((s, s + 1))
+        elif isinstance(it, builtins.slice):
+            # NOTE: builtins only in here — `any`/`max`/`slice` are all
+            # shadowed by the star-imported op surface
+            for x in (it.start, it.stop, it.step):
+                if x is not None and not isinstance(x, (int, np.integer)):
+                    return None
+            s, e, st = it.indices(n)
+            if st < 0:                 # hull of a reversed slice
+                s, e = e + 1, s + 1
+            region.append((s, builtins.max(s, e)))
+        else:
+            return None
+    for k in range(len(items), len(shape)):
+        region.append((0, int(shape[k])))
+    return tuple(region)
+
+
 def _getitem(self, idx):
     """Tensor indexing protocol (``t[idx]``): ints/slices/ellipsis/
     tensor indices lower to jax advanced indexing as ONE ``getitem``
@@ -52,7 +90,12 @@ def _getitem(self, idx):
         return search.masked_select(self, idx) if False else Tensor(
             jnp.asarray(np.asarray(self._data)[np.asarray(idx._data).astype(bool)]))
     nidx = _norm_index(idx)
-    return dispatch.call("getitem", lambda a: a[nidx], [self])
+    attrs = {}
+    reg = _static_region(idx, self.shape)
+    if reg is not None:
+        attrs["read_region"] = reg
+    return dispatch.call("getitem", lambda a, **_attrs: a[nidx], [self],
+                         attrs=attrs)
 
 
 # registry entry for the dispatched name: the tensor-protocol indexing
@@ -64,15 +107,31 @@ _register_op("getitem", category="indexing")(_getitem)
 
 
 def _setitem(self, idx, value):
+    """In-place region write ``t[idx] = value`` (``.at[idx].set`` under
+    functional XLA semantics, payload swapped back into ``t``). Records
+    a ``write_region`` attr when the index hull is static so the
+    verifier's TPU75x alias pass can prove disjoint rewrites safe."""
     nidx = _norm_index(idx)
     vt = value if isinstance(value, Tensor) else as_tensor(value)
-    def f(a, v):
+    attrs = {}
+    reg = _static_region(idx, self.shape)
+    if reg is not None:
+        # static write hull: lets the TPU75x alias pass prove a
+        # disjoint region rewrite safe (no attr = data-dependent)
+        attrs["write_region"] = reg
+    def f(a, v, **_attrs):
         return a.at[nidx].set(v.astype(a.dtype))
-    out = dispatch.call("setitem", f, [self, vt])
+    out = dispatch.call("setitem", f, [self, vt], attrs=attrs)
     self._swap_payload(out._data)
     self.grad_node, self.output_index = out.grad_node, out.output_index
     self.stop_gradient = out.stop_gradient if not self.stop_gradient else self.stop_gradient
     return self
+
+
+# registry entry mirrors getitem's: the indexing pseudo-op needs an
+# OpDef for the verifier's TPU700 contract pass (found when the TPU75x
+# alias pass first put recorded setitem programs through the ladder)
+_register_op("setitem", category="indexing")(_setitem)
 
 
 def _astype(self, dtype):

@@ -52,12 +52,16 @@ class SparseCooTensor(Tensor):
                 if getattr(vt, "_accum_node", None) is None:
                     vt._accum_node = AccumulationNode(vt)
                 self._accum_node = vt._accum_node
-        self._coo_indices = jnp.asarray(indices)      # [nnz, ndim]
+        # indices are HOST structure (numpy, [nnz, ndim]): the pattern
+        # never carries gradient and every structure op (merge, sort,
+        # equality) is host work — keeping it off-device removes the
+        # device->host syncs the structure ops used to pay per call
+        self._coo_indices = np.asarray(indices)       # [nnz, ndim]
         self._coo_shape = tuple(int(s) for s in shape)
 
     @property
     def _bcoo(self) -> "jsparse.BCOO":
-        return jsparse.BCOO((self._data, self._coo_indices),
+        return jsparse.BCOO((self._data, jnp.asarray(self._coo_indices)),
                             shape=self._coo_shape)
 
     @property
@@ -237,7 +241,7 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
     vals = (dispatch.call("sparse_cast",
                           lambda v: v.astype(value_dtype), [x])
             if value_dtype is not None else x.values())
-    idx = (np.asarray(x._coo_indices).astype(index_dtype)
+    idx = (x._coo_indices.astype(index_dtype)
            if index_dtype is not None else x._coo_indices)
     return SparseCooTensor(idx, vals, x._coo_shape)
 
@@ -246,22 +250,22 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
 # Binary / structure ops
 # ---------------------------------------------------------------------------
 def _positions(res_idx, idx):
-    """Scatter position of each row of ``idx`` inside ``res_idx``."""
+    """Scatter position of each row of ``idx`` inside ``res_idx``
+    (pure host: both patterns are numpy structure)."""
     lookup = {tuple(r): i for i, r in enumerate(res_idx)}
-    return jnp.asarray([lookup[tuple(r)] for r in np.asarray(idx)])
+    return np.asarray([lookup[tuple(r)] for r in idx])
 
 
 def _merge_patterns(x, y):
-    """Union pattern + per-input scatter positions (host; the pattern is
-    structure, not data)."""
-    merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
-        (jnp.concatenate([jnp.zeros_like(x._data),
-                          jnp.zeros_like(y._data)]),
-         jnp.concatenate([x._coo_indices, y._coo_indices])),
-        shape=x._coo_shape))
-    res_idx = np.asarray(merged.indices)
-    return (res_idx, _positions(res_idx, x._coo_indices),
-            _positions(res_idx, y._coo_indices))
+    """Union pattern + per-input scatter positions — pure host numpy
+    over the stored structure: ``np.unique`` sorts the union
+    row-lexicographically (the same canonical order BCOO dedup uses)
+    and its inverse IS each input row's scatter position. No device
+    round-trip: the pattern is structure, not data."""
+    both = np.concatenate([x._coo_indices, y._coo_indices], axis=0)
+    res_idx, inverse = np.unique(both, axis=0, return_inverse=True)
+    nx = x._coo_indices.shape[0]
+    return res_idx, inverse[:nx], inverse[nx:]
 
 
 def subtract(x, y, name=None):
@@ -319,8 +323,7 @@ def divide(x, y, name=None):
     if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
         # implicit zeros make off-pattern quotients 0/0; only the
         # identical-pattern case has well-defined sparse semantics
-        if (x._coo_indices.shape == y._coo_indices.shape
-                and bool(jnp.all(x._coo_indices == y._coo_indices))):
+        if np.array_equal(x._coo_indices, y._coo_indices):
             vals = dispatch.call("sparse_div_vv", lambda a, b: a / b,
                                  [x, y])
             return SparseCooTensor(x._coo_indices, vals, x._coo_shape)
@@ -367,7 +370,7 @@ def transpose(x, perm, name=None):
     unary.py transpose)."""
     if not isinstance(x, SparseCooTensor):
         raise TypeError("sparse.transpose expects a sparse tensor")
-    idx = np.asarray(x._coo_indices)[:, list(perm)]
+    idx = x._coo_indices[:, list(perm)]
     shape = tuple(np.asarray(x._coo_shape)[list(perm)])
     order = np.lexsort(tuple(idx[:, d] for d in range(idx.shape[1] - 1, -1, -1)))
     vals = dispatch.call("sparse_transpose_gather",
@@ -394,10 +397,10 @@ def coalesce(x, name=None):
     """Merge duplicate coordinates (reference unary.py coalesce)."""
     if not isinstance(x, SparseCooTensor):
         raise TypeError("sparse.coalesce expects a sparse tensor")
-    merged = jsparse.bcoo_sum_duplicates(jsparse.BCOO(
-        (jnp.zeros_like(x._data), x._coo_indices), shape=x._coo_shape))
-    res_idx = np.asarray(merged.indices)
-    pos = _positions(res_idx, x._coo_indices)
+    # duplicate merge is a host structure op: unique rows + inverse
+    # scatter positions (same canonical row-lexicographic order BCOO
+    # dedup produces), no device round-trip
+    res_idx, pos = np.unique(x._coo_indices, axis=0, return_inverse=True)
     n_out = res_idx.shape[0]
 
     def f(v):
@@ -419,7 +422,7 @@ def reshape(x, shape, name=None):
     if not isinstance(x, SparseCooTensor):
         raise TypeError("sparse.reshape expects a sparse tensor")
     old = np.asarray(x._coo_shape)
-    idx = np.asarray(x._coo_indices)
+    idx = x._coo_indices
     flat = np.zeros(idx.shape[0], np.int64)
     for d in range(idx.shape[1]):
         flat = flat * old[d] + idx[:, d]

@@ -1,0 +1,311 @@
+"""Cross-rank static program diff (TPU45x) — compile-time desync
+detection over rank-suffixed program dumps.
+
+``flight.diff_ranks`` names a desynced rank only *after* the fleet
+hangs; this module is the static complement. With
+``PADDLE_TPU_PROGRAM_RECORD=<base>`` set, every compile path that
+records the op-list IR (``static.Program.run`` first-compile,
+``to_static``'s verifier ``trace_scope``) appends its serialized record
+stream to ``<base>.r<rank>`` (flight's rank/world env helpers own the
+suffix scheme). ``python -m tools.tpulint --cross-rank <base>`` then
+diffs the per-rank programs rank-by-rank BEFORE anything has to hang:
+
+* **TPU451** (error) — a program or collective is recorded by some
+  ranks but not others (membership diverges);
+* **TPU452** (error) — the same collective position carries different
+  group/attrs/shape content across ranks;
+* **TPU453** (error) — same collectives, different order;
+* **TPU454** (warn) — the non-collective op streams themselves diverge
+  (a rank-dependent branch in the traced step).
+
+Every finding names the divergent rank and the first divergent sequence
+number, mirroring the flight recorder's runtime verdict format.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from ..observability import flight as _flight
+from .verifier import COLLECTIVE_OPS, Record, Report, _records_of
+
+__all__ = ["RECORD_ENV", "FORMAT", "enabled", "dump_program",
+           "maybe_dump", "note_collective", "reset", "load_dumps",
+           "diff_programs", "run"]
+
+#: env var naming the dump base path — rank-suffixed like the flight
+#: recorder's PADDLE_TPU_FLIGHT_RECORD
+RECORD_ENV = "PADDLE_TPU_PROGRAM_RECORD"
+FORMAT = "paddle_tpu.program_record/1"
+
+#: this process's recorded programs, keyed by base path (a process may
+#: record into an explicit path AND the env-configured one)
+_recorded: Dict[str, List[dict]] = {}
+
+#: straight-line collective stream — eager collectives bypass the
+#: dispatch recorder entirely (they only ride dispatch inside branch
+#: traces), so recorded Programs never contain them; the seam in
+#: ``collective._coll_begin`` notes them here and they dump as the
+#: pseudo-program ``<collective-stream>``
+_coll_stream: List[dict] = []
+
+
+def enabled() -> bool:
+    return bool(os.environ.get(RECORD_ENV))
+
+
+def _json_safe(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return repr(v)
+
+
+def _serialize(records, label: str) -> dict:
+    entries = []
+    for seq, r in enumerate(Record.of(x) for x in records):
+        attrs = {k: _json_safe(v) for k, v in (r.attrs or {}).items()
+                 if not k.startswith("_")}
+        entries.append({
+            "seq": seq,
+            "name": r.name,
+            "attrs": attrs,
+            "in_shapes": [list(s) for s in r.in_shapes],
+            "out_shapes": [list(s) for s in r.out_shapes],
+            "in_dtypes": list(r.in_dtypes),
+            "out_dtypes": list(r.out_dtypes),
+            "loc": r.loc,
+            # same definition as the verifier's branch-trace pass: the
+            # collective seam always stamps the group attr, so a plain
+            # tensor op that shares a name (indexing `scatter`) never
+            # qualifies
+            "collective": (r.name in COLLECTIVE_OPS
+                           and "group" in (r.attrs or {})),
+        })
+    return {"label": label, "ops": entries}
+
+
+def _write_rank_file(base: str) -> str:
+    """Atomically (re)write ``<base>.r<rank>`` with every program —
+    and, for the env-configured base, the straight-line collective
+    stream — recorded so far."""
+    progs = list(_recorded.get(base, ()))
+    if _coll_stream and base == os.environ.get(RECORD_ENV):
+        progs = progs + [{"label": "<collective-stream>",
+                          "ops": list(_coll_stream)}]
+    rank, world = _flight.rank_world()
+    payload = {"format": FORMAT, "rank": rank, "world": world,
+               "pid": os.getpid(), "programs": progs}
+    path = _flight.record_path(base, rank=rank)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+    return path
+
+
+def dump_program(program_or_records, label: str,
+                 base: Optional[str] = None) -> Optional[str]:
+    """Serialize one recorded program and (re)write this rank's dump
+    file ``<base>.r<rank>`` with every program recorded so far. Atomic
+    replace, never raises — this rides compile paths."""
+    try:
+        base = base or os.environ.get(RECORD_ENV)
+        if not base:
+            return None
+        records, _prog = _records_of(program_or_records)
+        _recorded.setdefault(base, []).append(
+            _serialize(records, label))
+        return _write_rank_file(base)
+    except Exception:                 # pragma: no cover - best effort
+        return None
+
+
+def note_collective(name: str, shape, dtype, group_id, **extra) -> None:
+    """Record one straight-line collective launch into the rank's dump
+    (env-gated; the ``collective._coll_begin`` seam calls this on every
+    eager collective). The stream diffs like any other program: a rank
+    running an extra / different / reordered collective is named with
+    its first divergent sequence number BEFORE the fleet can hang on
+    it. Never raises — this rides the collective hot path."""
+    if not enabled():
+        return
+    try:
+        attrs = {"group": int(group_id or 0)}
+        for k, v in extra.items():
+            attrs[k] = _json_safe(v)
+        shape = [int(d) for d in (shape or ())]
+        _coll_stream.append({
+            "seq": len(_coll_stream), "name": name, "attrs": attrs,
+            "in_shapes": [shape], "out_shapes": [shape],
+            "in_dtypes": [str(dtype)], "out_dtypes": [str(dtype)],
+            "loc": "", "collective": True})
+        _write_rank_file(os.environ[RECORD_ENV])
+    except Exception:                 # pragma: no cover - best effort
+        pass
+
+
+def reset() -> None:
+    """Drop everything recorded so far in this process (programs AND
+    the collective stream). For tests/drills that re-point
+    ``PADDLE_TPU_PROGRAM_RECORD`` at a fresh base mid-process."""
+    _recorded.clear()
+    _coll_stream.clear()
+
+
+def maybe_dump(program_or_records, label: str) -> Optional[str]:
+    """Dump iff ``PADDLE_TPU_PROGRAM_RECORD`` is configured — the hook
+    every compile path calls unconditionally."""
+    if not enabled():
+        return None
+    return dump_program(program_or_records, label)
+
+
+def load_dumps(base: str, world: Optional[int] = None) -> Dict[int, dict]:
+    """{rank: payload} for every ``<base>.r<rank>`` present (flight's
+    loader — same suffix scheme, format checked here)."""
+    out = {}
+    for r, payload in _flight.load_dumps(base, world).items():
+        if payload.get("format") == FORMAT:
+            out[r] = payload
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the diff
+# ---------------------------------------------------------------------------
+def _keyed_programs(payload) -> Dict[str, dict]:
+    """label -> program, with repeat compiles of one label suffixed by
+    occurrence (#1, #2, …) so per-signature recompiles line up."""
+    seen: Dict[str, int] = {}
+    out: Dict[str, dict] = {}
+    for prog in payload.get("programs", ()):
+        label = str(prog.get("label", "<program>"))
+        k = seen.get(label, 0)
+        seen[label] = k + 1
+        out[label if k == 0 else f"{label}#{k}"] = prog
+    return out
+
+
+def _coll_sig(e) -> tuple:
+    attrs = e.get("attrs") or {}
+    return (tuple(sorted((k, _json_safe(v)) for k, v in attrs.items())),
+            tuple(tuple(s) for s in e.get("in_shapes", ())),
+            tuple(e.get("in_dtypes", ())))
+
+
+def _diff_one(key: str, ref_rank: int, ref: dict, rank: int, other: dict,
+              report: Report):
+    """Compare one program label between the reference rank and
+    ``rank``; emit at most one finding per code family."""
+    rc = [e for e in ref.get("ops", ()) if e.get("collective")]
+    oc = [e for e in other.get("ops", ()) if e.get("collective")]
+
+    def first_div(a, b, sig):
+        for i in range(min(len(a), len(b))):
+            if sig(a[i]) != sig(b[i]):
+                return i
+        return min(len(a), len(b)) if len(a) != len(b) else None
+
+    name_div = first_div(rc, oc, lambda e: e["name"])
+    if name_div is not None:
+        a_names = sorted(e["name"] for e in rc)
+        b_names = sorted(e["name"] for e in oc)
+        div = oc[name_div] if name_div < len(oc) else (
+            rc[name_div] if name_div < len(rc) else None)
+        seq = div["seq"] if div else name_div
+        op = div["name"] if div else "<missing>"
+        loc = div.get("loc", "") if div else ""
+        if a_names != b_names:
+            report.add(
+                "TPU451", seq, op,
+                f"program {key!r}: rank={rank} seq={seq} — collective "
+                f"sequence membership differs from rank {ref_rank} "
+                f"({len(oc)} vs {len(rc)} collectives; first "
+                f"divergence at collective #{name_div}: rank {rank} "
+                f"runs {op!r})", loc)
+        else:
+            report.add(
+                "TPU453", seq, op,
+                f"program {key!r}: rank={rank} seq={seq} — same "
+                f"collectives as rank {ref_rank} but the order "
+                f"diverges at collective #{name_div} ({op!r})", loc)
+        return
+    content_div = first_div(rc, oc, _coll_sig)
+    if content_div is not None:
+        div = oc[content_div]
+        report.add(
+            "TPU452", div["seq"], div["name"],
+            f"program {key!r}: rank={rank} seq={div['seq']} — "
+            f"collective {div['name']!r} differs from rank {ref_rank} "
+            f"in group/attrs/shape at the same position "
+            f"(#{content_div}): {_coll_sig(div)} vs "
+            f"{_coll_sig(rc[content_div])}", div.get("loc", ""))
+        return
+    ra, oa = ref.get("ops", ()), other.get("ops", ())
+    op_div = first_div(ra, oa, lambda e: (e["name"],
+                                          tuple(tuple(s) for s in
+                                                e.get("out_shapes", ()))))
+    if op_div is not None:
+        div = oa[op_div] if op_div < len(oa) else ra[op_div]
+        report.add(
+            "TPU454", div["seq"], div["name"],
+            f"program {key!r}: rank={rank} seq={div['seq']} — op "
+            f"stream diverges from rank {ref_rank} at op "
+            f"#{op_div} ({len(oa)} vs {len(ra)} ops): rank {rank} "
+            f"records {div['name']!r}", div.get("loc", ""))
+
+
+def diff_programs(dumps: Dict[int, dict]) -> Report:
+    """Rank-by-rank static diff of program dumps; the lowest rank is
+    the reference. Returns a verifier :class:`Report` (TPU45x codes),
+    empty when every rank recorded identical programs."""
+    report = Report(label="cross-rank")
+    if len(dumps) < 2:
+        report.stats = {"ranks": sorted(dumps), "programs": 0}
+        return report
+    ranks = sorted(dumps)
+    ref_rank = ranks[0]
+    keyed = {r: _keyed_programs(dumps[r]) for r in ranks}
+    all_keys: List[str] = []
+    for r in ranks:
+        for k in keyed[r]:
+            if k not in all_keys:
+                all_keys.append(k)
+    for key in all_keys:
+        have = [r for r in ranks if key in keyed[r]]
+        missing = [r for r in ranks if key not in keyed[r]]
+        if missing:
+            minority = have if len(have) < len(missing) else missing
+            report.add(
+                "TPU451", -1, "<program>",
+                f"program {key!r} recorded by ranks {have} but not by "
+                f"ranks {missing} — rank={minority[0]} diverges from "
+                f"the fleet (rank-dependent compile path)")
+            continue
+        ref = keyed[ref_rank][key]
+        for r in ranks[1:]:
+            _diff_one(key, ref_rank, ref, r, keyed[r][key], report)
+    report.stats = {"ranks": ranks, "programs": len(all_keys)}
+    return report
+
+
+def run(base: str, world: Optional[int] = None, quiet: bool = False) -> int:
+    """CLI entry for ``tpulint --cross-rank``: load + diff + print.
+    Returns the number of findings (0 = every rank agrees)."""
+    dumps = load_dumps(base, world)
+    if not dumps:
+        print(f"cross-rank: no program dumps found at {base}.r<rank> "
+              f"(set {RECORD_ENV} on the launch)")
+        return 1
+    report = diff_programs(dumps)
+    if not quiet:
+        n = report.stats.get("programs", 0)
+        if report.ok:
+            print(f"cross-rank: {len(dumps)} rank dump(s), {n} "
+                  f"program(s) — all ranks agree")
+        else:
+            print(report.render())
+    return len(report.findings)

@@ -1,0 +1,658 @@
+"""Static liveness & peak-HBM analysis over the recorded op-list IR.
+
+The round-12 census measures HBM *after* allocation; this module is its
+compile-time complement: per-value live intervals (def -> last use,
+extended through in-place alias chains, donation-shortened, fetch-pinned)
+over the same ``_OpRecord`` stream every compile path already records,
+folded into a peak-HBM curve with per-op attribution — the memory half of
+what Alpa-style planners compute statically before committing a placement.
+
+Three consumers:
+
+* **verifier (TPU9xx)** — :func:`memory_pass` compares the static peak
+  against ``perf.chip_hbm_bytes()`` (or ``FLAGS_verifier_hbm_capacity``)
+  and emits TPU901 (over capacity, error: strict mode raises before XLA
+  ever sees the program) / TPU902 (>= 90%, warn).
+* **verifier (TPU75x)** — :func:`alias_pass` extends the in-place
+  staleness contract (TPU704) to the ``setitem`` / ``scatter_`` /
+  ``index_put_`` / ``.at[].set`` write family with *region* precision:
+  statically disjoint write/read regions are proven safe, overlapping
+  ones are errors, data-dependent index writes are warned about, and
+  writes through views / donated buffers get their own codes.
+* **planner** — :func:`activation_peak` replaces cost.py's
+  "every forward activation resident" estimate with true
+  liveness-at-peak (sharding-aware via the round-13 ``ShardingPlan``).
+
+:func:`measure_peak` is the drift guard: it replays a program eagerly on
+real arrays under the *same* deletion schedule the static model assumes
+and reports the measured high-water (feeding the census phase gauges),
+so a tier-1 test can assert the static size model tracks real buffers.
+
+Sizing contract: a value's bytes are ``numel * dtype_bytes`` of its
+*recorded* shape/dtype, scaled by its shard fraction when a
+``ShardingPlan`` is supplied. In-place chains count BOTH buffers (the
+pre-mutation value until its last reader, the new value through the
+alias target's lifetime) — the conservative model matching eager
+payload-swap semantics, where both arrays coexist until the old one's
+last reference dies.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..core import flags as _flags
+from ..observability.perf.costmodel import dtype_bytes
+from .verifier import Record, _records_of
+
+__all__ = [
+    "Interval", "LivenessResult", "analyze", "peak_report",
+    "render_peak_report", "memory_pass", "alias_pass", "activation_peak",
+    "measure_peak", "stage_peaks", "WRITE_FAMILY", "VIEW_OPS",
+]
+
+_flags.define_flag(
+    "verifier_hbm_capacity", 0,
+    "Override chip HBM bytes for the static memory pass (TPU901/902); "
+    "0 = perf.chip_hbm_bytes() of the attached device.")
+
+#: region-writing ops the alias pass (TPU75x) owns — excluded from the
+#: generic in-place staleness check (TPU704), which has no region
+#: precision and would double-flag provably-disjoint rewrites
+WRITE_FAMILY = frozenset({
+    "setitem", "scatter_", "index_put_", "index_add_", "index_fill_",
+    "masked_fill_", "masked_scatter_",
+})
+
+#: ops whose output is a VIEW of input 0 under reference (torch/paddle)
+#: semantics — on XLA every array is functional, so an in-place write
+#: through one of these silently diverges from the reference: the base
+#: is never updated
+VIEW_OPS = frozenset({
+    "getitem", "slice", "reshape", "view", "transpose", "squeeze",
+    "unsqueeze", "flatten", "expand", "split", "chunk",
+})
+
+#: generic (whole-buffer) in-place ops: registry inplace variants plus
+#: the torch-compat ``INPLACE_OF`` table, minus the region write family
+def _inplace_names() -> set:
+    from ..ops.registry import OPS
+    names = {d.inplace_variant for d in OPS.values() if d.inplace_variant}
+    try:
+        from ..ops.inplace import INPLACE_OF
+        names.update(INPLACE_OF)
+    except Exception:                 # pragma: no cover - partial import
+        pass
+    return names
+
+
+class Interval:
+    """One value's residency: op index of def (-1 = live at entry) to op
+    index of last use (``n_ops`` = pinned through program end)."""
+
+    __slots__ = ("vid", "start", "end", "nbytes", "origin", "label",
+                 "shape", "dtype")
+
+    def __init__(self, vid, start, end, nbytes, origin, label, shape,
+                 dtype):
+        self.vid = vid
+        self.start = start
+        self.end = end
+        self.nbytes = float(nbytes)
+        self.origin = origin          # "feed" | "param" | "op"
+        self.label = label            # feed name / param name / op name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+class LivenessResult:
+    __slots__ = ("intervals", "curve", "peak_bytes", "peak_index",
+                 "n_ops", "entry_bytes", "records")
+
+    def __init__(self, intervals, curve, peak_bytes, peak_index, n_ops,
+                 entry_bytes, records):
+        self.intervals: Dict[int, Interval] = intervals
+        self.curve: List[float] = curve
+        self.peak_bytes = peak_bytes
+        self.peak_index = peak_index
+        self.n_ops = n_ops
+        self.entry_bytes = entry_bytes
+        self.records: List[Record] = records
+
+    def live_at(self, i: int) -> List[Interval]:
+        return [iv for iv in self.intervals.values()
+                if iv.start <= i <= iv.end]
+
+
+def _numel(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _shard_frac(spec, mesh, shape) -> float:
+    if spec is None or mesh is None:
+        return 1.0
+    from ..distributed.planner.cost import shard_fraction
+    try:
+        return shard_fraction(spec, mesh, shape)
+    except Exception:                 # pragma: no cover - malformed spec
+        return 1.0
+
+
+def _value_nbytes(shape, dtype, spec=None, mesh=None) -> float:
+    try:
+        item = dtype_bytes(dtype) if dtype else 4
+    except Exception:
+        item = 4
+    return _numel(shape) * item * _shard_frac(spec, mesh, shape)
+
+
+def analyze(program, fetch_ids=None, plan=None, mesh=None,
+            donated_ids=()) -> LivenessResult:
+    """Live intervals + peak-HBM curve for a ``static.Program`` or any
+    op-record sequence.
+
+    * feeds / captured params are resident for the whole program
+      (caller-held buffers) — unless their id is in ``donated_ids``, in
+      which case donation frees them after their last use (the round-17
+      donation contract).
+    * op outputs live from their def to their last use; fetched values
+      are pinned through program end.
+    * in-place alias chains (generic in-place ops AND the TPU75x write
+      family): the new value's buffer is extended through the alias
+      target's lifetime — eager payload-swap keeps it reachable via the
+      target's Python identity.
+    * sizes are sharding-aware when ``plan`` (a ``ShardingPlan``) and
+      ``mesh`` are given: each value is scaled by its shard fraction
+      from ``plan.env``.
+    """
+    records, prog = _records_of(program)
+    n = len(records)
+    fetch_set = set(fetch_ids or ())
+    donated = set(donated_ids or ())
+    env = getattr(plan, "env", None) or {}
+    pmesh = mesh if mesh is not None else getattr(plan, "mesh", None)
+
+    produced_at: Dict[int, int] = {}
+    last_use: Dict[int, int] = {}
+    meta: Dict[int, tuple] = {}       # vid -> (shape, dtype)
+    entry_order: List[int] = []
+    for i, r in enumerate(records):
+        for k, v in enumerate(r.in_ids):
+            last_use[v] = i
+            if v not in produced_at and v not in meta:
+                entry_order.append(v)
+            if v not in meta:
+                shape = r.in_shapes[k] if k < len(r.in_shapes) else ()
+                dt = r.in_dtypes[k] if k < len(r.in_dtypes) else ""
+                meta[v] = (shape, dt)
+        for k, v in enumerate(r.out_ids):
+            if v not in produced_at:
+                produced_at[v] = i
+            shape = r.out_shapes[k] if k < len(r.out_shapes) else ()
+            dt = r.out_dtypes[k] if k < len(r.out_dtypes) else ""
+            meta[v] = (shape, dt)
+
+    feeds: Dict[int, str] = {}
+    caps: Dict[int, str] = {}
+    if prog is not None:
+        for name, vid in prog.feed_vars.items():
+            feeds[vid] = name
+            if vid not in meta:
+                meta[vid] = (prog._feed_shapes.get(name, ()),
+                             prog._feed_dtypes.get(name, ""))
+        for vid, t in prog._captured.items():
+            caps[vid] = getattr(t, "name", None) or f"param:v{vid}"
+            if vid not in meta:
+                meta[vid] = (tuple(getattr(t, "shape", ())),
+                             str(getattr(t, "dtype", "")))
+
+    intervals: Dict[int, Interval] = {}
+
+    def entry_interval(vid, origin, label):
+        shape, dt = meta.get(vid, ((), ""))
+        end = n
+        if vid in donated:
+            end = last_use.get(vid, -1)
+        intervals[vid] = Interval(
+            vid, -1, end,
+            _value_nbytes(shape, dt, env.get(vid), pmesh),
+            origin, label, shape, dt)
+
+    for vid, name in feeds.items():
+        entry_interval(vid, "feed", name)
+    for vid, name in caps.items():
+        if vid not in intervals:
+            entry_interval(vid, "param", name)
+    for vid in entry_order:
+        # record-list entry values (unproduced inputs) are implicit
+        # parameters
+        if vid not in intervals and vid not in produced_at:
+            entry_interval(vid, "param", f"param:v{vid}")
+
+    for i, r in enumerate(records):
+        for k, vid in enumerate(r.out_ids):
+            if vid in intervals or produced_at.get(vid) != i:
+                continue
+            shape, dt = meta[vid]
+            end = n if vid in fetch_set else last_use.get(vid, i)
+            intervals[vid] = Interval(
+                vid, i, end,
+                _value_nbytes(shape, dt, env.get(vid), pmesh),
+                "op", r.name, shape, dt)
+
+    # alias extension, forward order so def-ordered chains propagate:
+    # the in-place result's buffer stays reachable through the mutated
+    # tensor's identity until THAT value dies
+    alias_names = _inplace_names() | WRITE_FAMILY
+    for i, r in enumerate(records):
+        if r.name not in alias_names or not r.in_ids or not r.out_ids:
+            continue
+        tgt = intervals.get(r.in_ids[0])
+        out = intervals.get(r.out_ids[0])
+        if tgt is not None and out is not None and tgt.end > out.end:
+            out.end = tgt.end
+
+    entry_bytes = sum(iv.nbytes for iv in intervals.values()
+                      if iv.start < 0)
+    if n == 0:
+        return LivenessResult(intervals, [entry_bytes], entry_bytes, 0,
+                              0, entry_bytes, records)
+
+    delta = [0.0] * (n + 1)
+    for iv in intervals.values():
+        s = max(iv.start, 0)
+        e = min(iv.end, n - 1)
+        if e < s:
+            e = s                      # dead value: resident for its op
+        delta[s] += iv.nbytes
+        delta[e + 1] -= iv.nbytes
+    curve: List[float] = []
+    acc = 0.0
+    for i in range(n):
+        acc += delta[i]
+        curve.append(acc)
+    peak_index = max(range(n), key=curve.__getitem__)
+    return LivenessResult(intervals, curve, curve[peak_index],
+                          peak_index, n, entry_bytes, records)
+
+
+# ---------------------------------------------------------------------------
+# peak report (per-op attribution)
+# ---------------------------------------------------------------------------
+def peak_report(program, fetch_ids=None, plan=None, mesh=None,
+                donated_ids=(), top_k=5, capacity_bytes=None) -> dict:
+    """Name the op at the high-water mark and the top-k live values.
+
+    Returns ``{"peak_bytes", "peak_index", "peak_op": {name, loc},
+    "top_values": [...], "capacity_bytes", "utilization", "curve"}`` —
+    the static complement of ``perf.memory.high_water``.
+    """
+    res = analyze(program, fetch_ids=fetch_ids, plan=plan, mesh=mesh,
+                  donated_ids=donated_ids)
+    cap = _capacity(capacity_bytes)
+    if res.n_ops:
+        r = res.records[res.peak_index]
+        peak_op = {"index": res.peak_index, "name": r.name,
+                   "loc": r.loc}
+    else:
+        peak_op = {"index": -1, "name": "<entry>", "loc": ""}
+    live = sorted(res.live_at(res.peak_index) if res.n_ops else
+                  res.intervals.values(),
+                  key=lambda iv: -iv.nbytes)
+    top = [{
+        "vid": iv.vid, "nbytes": iv.nbytes, "origin": iv.origin,
+        "label": iv.label, "shape": iv.shape, "dtype": iv.dtype,
+        "def": iv.start, "last_use": iv.end,
+    } for iv in live[:max(0, int(top_k))]]
+    return {
+        "peak_bytes": res.peak_bytes,
+        "peak_index": res.peak_index,
+        "peak_op": peak_op,
+        "n_ops": res.n_ops,
+        "entry_bytes": res.entry_bytes,
+        "top_values": top,
+        "capacity_bytes": cap,
+        "utilization": (res.peak_bytes / cap) if cap else 0.0,
+        "curve": res.curve,
+    }
+
+
+def render_peak_report(rep: dict) -> str:
+    gib = 1024.0 ** 3
+    lines = [
+        "static peak HBM: %.3f GiB at op#%d %s (%s) — %.1f%% of "
+        "%.1f GiB capacity" % (
+            rep["peak_bytes"] / gib, rep["peak_op"]["index"],
+            rep["peak_op"]["name"], rep["peak_op"]["loc"] or "?",
+            100.0 * rep["utilization"],
+            (rep["capacity_bytes"] or 0) / gib)]
+    for tv in rep["top_values"]:
+        lines.append(
+            "  %10.1f MiB  %-6s %-24s %s %s [op#%d..%s]" % (
+                tv["nbytes"] / 1024.0 ** 2, tv["origin"],
+                str(tv["label"])[:24], tv["shape"], tv["dtype"],
+                tv["def"], tv["last_use"]))
+    return "\n".join(lines)
+
+
+def _capacity(capacity_bytes=None) -> float:
+    if capacity_bytes:
+        return float(capacity_bytes)
+    flag = _flags.get_flag("verifier_hbm_capacity")
+    if flag:
+        return float(flag)
+    try:
+        from ..observability import perf as _perf
+        return float(_perf.chip_hbm_bytes())
+    except Exception:                 # pragma: no cover - no device
+        return 16e9
+
+
+# ---------------------------------------------------------------------------
+# verifier pass: TPU9xx over-capacity
+# ---------------------------------------------------------------------------
+def memory_pass(program, report, *, fetch_ids=None, plan=None,
+                mesh=None, donated_ids=(), capacity_bytes=None):
+    """Emit TPU901 (static peak > chip HBM, error) / TPU902 (>= 90%,
+    warn) into ``report`` — raised in strict mode before XLA compiles."""
+    cap = _capacity(capacity_bytes)
+    if not cap:
+        return None
+    res = analyze(program, fetch_ids=fetch_ids, plan=plan, mesh=mesh,
+                  donated_ids=donated_ids)
+    if res.peak_bytes <= 0.9 * cap:
+        return res
+    gib = 1024.0 ** 3
+    top = sorted(res.live_at(res.peak_index), key=lambda iv: -iv.nbytes)
+    head = ", ".join(
+        "%s %s %.2f GiB" % (iv.label, iv.shape, iv.nbytes / gib)
+        for iv in top[:3])
+    i = res.peak_index
+    r = res.records[i] if res.n_ops else None
+    name = r.name if r is not None else "<entry>"
+    loc = r.loc if r is not None else ""
+    if res.peak_bytes > cap:
+        report.add(
+            "TPU901", i, name,
+            "static peak HBM %.2f GiB exceeds chip capacity %.2f GiB "
+            "at op#%d %s — largest live values: %s" % (
+                res.peak_bytes / gib, cap / gib, i, name, head), loc)
+    else:
+        report.add(
+            "TPU902", i, name,
+            "static peak HBM %.2f GiB is %.0f%% of chip capacity "
+            "%.2f GiB — largest live values: %s" % (
+                res.peak_bytes / gib, 100.0 * res.peak_bytes / cap,
+                cap / gib, head), loc)
+    return res
+
+
+# ---------------------------------------------------------------------------
+# verifier pass: TPU75x setitem/scatter alias checking
+# ---------------------------------------------------------------------------
+def _region_of(attrs, key):
+    reg = (attrs or {}).get(key)
+    if not reg:
+        return None
+    try:
+        return tuple((int(s), int(e)) for s, e in reg)
+    except Exception:
+        return None
+
+
+def _regions_disjoint(wr, rr) -> bool:
+    """True only when the two static regions PROVABLY do not overlap:
+    some dimension's [start, stop) intervals are disjoint. Dims beyond a
+    region's recorded prefix are full-extent (always overlapping)."""
+    if wr is None or rr is None:
+        return False
+    for k in range(min(len(wr), len(rr))):
+        (ws, we), (rs, re) = wr[k], rr[k]
+        if we <= rs or re <= ws:
+            return True
+    return False
+
+
+def alias_pass(program, report, *, fetch_ids=None, donated_ids=()):
+    """Region-precise staleness contract for the write family.
+
+    * TPU751 (error): a later op reads the pre-write value of a mutated
+      tensor and the written region provably overlaps the read — the
+      replay env serves the stale pre-mutation buffer.
+    * TPU752 (error): write through a donated buffer — the payload the
+      write adopts was already handed to XLA.
+    * TPU753 (warn): write through a VIEW whose base is still read —
+      functional XLA arrays never propagate the write to the base
+      (silent divergence from reference in-place view semantics).
+    * TPU754 (warn): data-dependent (tensor) indices make the written
+      region unprovable while the pre-write value is still read.
+    """
+    records, _prog = _records_of(program)
+    fetch_set = set(fetch_ids or ())
+    donated = set(donated_ids or ())
+    producer: Dict[int, Record] = {}
+    producer_idx: Dict[int, int] = {}
+    for i, r in enumerate(records):
+        for v in r.out_ids:
+            if v not in producer:
+                producer[v] = r
+                producer_idx[v] = i
+    for i, r in enumerate(records):
+        if r.name not in WRITE_FAMILY or not r.in_ids:
+            continue
+        tgt = r.in_ids[0]
+        wr = _region_of(r.attrs, "write_region")
+
+        if tgt in donated:
+            report.add(
+                "TPU752", i, r.name,
+                f"write into donated buffer v{tgt} — the buffer was "
+                f"donated to the compiled step and no longer backs "
+                f"this value", r.loc)
+
+        src = producer.get(tgt)
+        if src is not None and src.name in VIEW_OPS and src.in_ids:
+            base = src.in_ids[0]
+            base_read_later = any(
+                base in s.in_ids for s in records[i + 1:]) \
+                or base in fetch_set
+            if base_read_later:
+                report.add(
+                    "TPU753", i, r.name,
+                    f"in-place write through view v{tgt} (a "
+                    f"{src.name!r} of v{base}) — XLA arrays are "
+                    f"functional, the base is NEVER updated; later "
+                    f"reads of v{base} silently diverge from "
+                    f"reference in-place semantics", r.loc)
+
+        # later reads of the PRE-write value
+        flagged = False
+        for j in range(i + 1, len(records)):
+            s = records[j]
+            if tgt not in s.in_ids or flagged:
+                continue
+            rr = None
+            if s.name == "getitem" and s.in_ids[0] == tgt:
+                rr = _region_of(s.attrs, "read_region")
+            if wr is not None and rr is not None \
+                    and _regions_disjoint(wr, rr):
+                continue               # provably disjoint: safe rewrite
+            if wr is not None:
+                report.add(
+                    "TPU751", i, r.name,
+                    f"op#{j} {s.name} reads v{tgt} after this write "
+                    f"overwrote region {wr} — the replay env serves "
+                    f"the stale pre-write value", r.loc)
+            else:
+                report.add(
+                    "TPU754", i, r.name,
+                    f"write region of v{tgt} is data-dependent "
+                    f"(tensor indices) and op#{j} {s.name} reads the "
+                    f"pre-write value — overlap cannot be proven "
+                    f"statically", r.loc)
+            flagged = True
+        if not flagged and tgt in fetch_set:
+            if wr is not None:
+                report.add(
+                    "TPU751", i, r.name,
+                    f"v{tgt} is fetched after this write overwrote "
+                    f"region {wr} — the fetch serves the stale "
+                    f"pre-write value", r.loc)
+            else:
+                report.add(
+                    "TPU754", i, r.name,
+                    f"write region of v{tgt} is data-dependent "
+                    f"(tensor indices) and the pre-write value is "
+                    f"fetched — overlap cannot be proven statically",
+                    r.loc)
+
+
+# ---------------------------------------------------------------------------
+# planner: liveness-at-peak activation pricing
+# ---------------------------------------------------------------------------
+def activation_peak(records, *, exclude_ids=(), plan=None, mesh=None,
+                    fetch_ids=None, pinned_ids=()):
+    """Peak simultaneously-live bytes of OP-PRODUCED values (params and
+    feeds in ``exclude_ids`` are priced separately by the cost model).
+
+    ``pinned_ids``: values held to program end regardless of last use —
+    the cost model pins GEMM operands (saved for the backward wgrad).
+    Returns ``(peak_bytes, peak_index, op_name)``.
+    """
+    recs = [Record.of(r) for r in records]
+    res = analyze(recs, fetch_ids=fetch_ids, plan=plan, mesh=mesh)
+    n = res.n_ops
+    excl = set(exclude_ids or ())
+    pinned = set(pinned_ids or ())
+    if n == 0:
+        return 0.0, 0, ""
+    delta = [0.0] * (n + 1)
+    for iv in res.intervals.values():
+        if iv.start < 0 or iv.vid in excl:
+            continue                   # entry value: priced elsewhere
+        s = iv.start
+        e = n - 1 if iv.vid in pinned else min(iv.end, n - 1)
+        if e < s:
+            e = s
+        delta[s] += iv.nbytes
+        delta[e + 1] -= iv.nbytes
+    acc, best, best_i = 0.0, 0.0, 0
+    for i in range(n):
+        acc += delta[i]
+        if acc > best:
+            best, best_i = acc, i
+    return best, best_i, recs[best_i].name
+
+
+# ---------------------------------------------------------------------------
+# pipeline: stage-aware peaks
+# ---------------------------------------------------------------------------
+def stage_peaks(stage_records, inflight=None, plan=None, mesh=None):
+    """Per-stage static peaks with the schedule's peak-inflight
+    microbatch count multiplying the ACTIVATION share (weights are
+    resident once regardless of how many microbatches are in flight).
+
+    ``stage_records``: the per-stage record lists
+    ``StagePartition.stage_records()`` emits; ``inflight``: per-stage
+    peak in-flight microbatches (int or list), default 1.
+    """
+    out = []
+    for si, recs in enumerate(stage_records):
+        res = analyze(list(recs), plan=plan, mesh=mesh)
+        fl = inflight[si] if isinstance(inflight, (list, tuple)) \
+            else (inflight or 1)
+        activ = max(0.0, res.peak_bytes - res.entry_bytes)
+        out.append({
+            "stage": si,
+            "peak_bytes": res.entry_bytes + float(fl) * activ,
+            "one_shot_peak_bytes": res.peak_bytes,
+            "entry_bytes": res.entry_bytes,
+            "inflight": int(fl),
+            "peak_index": res.peak_index,
+        })
+    return out
+
+
+# ---------------------------------------------------------------------------
+# measured cross-check (census drift guard)
+# ---------------------------------------------------------------------------
+def measure_peak(program, feed=None, fetch_ids=None, phase=None):
+    """Replay ``program`` eagerly on real arrays under the SAME deletion
+    schedule :func:`analyze` assumes (each value freed after its
+    alias-extended last use) and report the measured live-byte
+    high-water. With ``phase`` set, ``perf.memory.update_high_water`` is
+    driven at every step so the census phase gauges record the same
+    peak. The drift between this and ``analyze().peak_bytes`` is the
+    size-model error a tier-1 test bounds.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    records, prog = _records_of(program)
+    if prog is None:
+        raise TypeError("measure_peak needs a static.Program (feeds + "
+                        "captured params carry the entry arrays)")
+    res = analyze(prog, fetch_ids=fetch_ids)
+    if phase is not None:
+        from ..observability.perf import memory as _mem
+
+    env: Dict[int, object] = {}
+    for name in sorted(prog.feed_vars):
+        vid = prog.feed_vars[name]
+        if feed is not None and name in feed:
+            env[vid] = jnp.asarray(feed[name])
+        else:
+            shape = prog._feed_shapes.get(name, ())
+            dt = prog._feed_dtypes.get(name, "float32") or "float32"
+            shape = tuple(abs(int(d)) or 1 for d in shape)
+            env[vid] = jnp.zeros(shape, dtype=np.dtype(dt))
+    for vid, t in prog._captured.items():
+        env[vid] = t._data
+
+    def nbytes(a):
+        return int(getattr(a, "nbytes", 0) or 0)
+
+    free_at: Dict[int, List[int]] = {}
+    for iv in res.intervals.values():
+        if iv.start < 0:
+            continue                   # entry buffers are caller-held
+        free_at.setdefault(min(iv.end, res.n_ops - 1), []).append(iv.vid)
+
+    entry_bytes = sum(nbytes(a) for a in env.values())
+    live = entry_bytes
+    peak, peak_i = live, -1
+    floor = None
+    if phase is not None:
+        floor = _mem.census()["total"]
+    for i, r in enumerate(records):
+        args = [env[v] for v in r.in_ids]
+        out = r.fn(*args) if r.fn is not None else None
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        # measure_peak IS the host-side drift guard: it deliberately
+        # replays eagerly on concrete buffers and reads their sizes —
+        # host sync is the measurement, not an accident
+        for vid, a in zip(r.out_ids, outs):
+            if vid not in env and a is not None:  # tpulint: disable=TPU105 — host replay loop, not a traced program
+                env[vid] = a  # tpulint: disable=TPU203 — keyed on int value-ids, never tensors
+                live += nbytes(a)
+        if live > peak:  # tpulint: disable=TPU105 — live/peak are host ints
+            peak, peak_i = live, i
+        if phase is not None:
+            _mem.update_high_water(phase)
+        for vid in free_at.get(i, ()):
+            a = env.pop(vid, None)
+            if a is not None:
+                live -= nbytes(a)
+    out = {
+        "peak_bytes": float(peak),   # tpulint: disable=TPU103 — sizes are host ints (nbytes), never device values
+        "peak_index": peak_i,
+        "entry_bytes": float(entry_bytes),  # tpulint: disable=TPU103 — host int accumulator
+        "static_peak_bytes": res.peak_bytes,
+        "static_peak_index": res.peak_index,
+    }
+    if phase is not None:
+        out["census_floor"] = floor
+        out["census_high_water"] = _mem.high_water(phase)["total"]
+    return out

@@ -151,11 +151,18 @@ class Program:
         if fuse:
             sig = sig + (_fusion.fingerprint(),)
         if sig not in self._jit_cache:
+            from . import crossrank as _crossrank
+            # rank-suffixed program dump (PADDLE_TPU_PROGRAM_RECORD):
+            # the static substrate tpulint --cross-rank diffs across a
+            # multi-process launch before anything can hang
+            _crossrank.maybe_dump(self, label="static.Program")
             from . import verifier as _verifier
             if _verifier.mode() != "off":
                 # pre-compile verification (FLAGS_verify_programs):
                 # strict raises the framework's error naming the op +
-                # source line before jax.jit ever sees the program
+                # source line before jax.jit ever sees the program —
+                # including the TPU901 static peak-HBM-over-capacity
+                # check (static.liveness)
                 _verifier.enforce(_verifier.check(
                     self, fetch_ids=list(fetch_ids),
                     label="static.Program"))

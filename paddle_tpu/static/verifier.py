@@ -99,6 +99,32 @@ CODES = {
     "TPU803": ("error", "pipeline send/recv sequence mismatch (peer "
                         "or transfer order disagrees between adjacent "
                         "stages)"),
+    # TPU45x — cross-rank program diff (static.crossrank over
+    # rank-suffixed PADDLE_TPU_PROGRAM_RECORD dumps)
+    "TPU451": ("error", "ranks recorded different collective "
+                        "sequences (membership differs — static "
+                        "cross-rank desync)"),
+    "TPU452": ("error", "collective group/attrs/shape differs between "
+                        "ranks at the same sequence position"),
+    "TPU453": ("error", "collective ordering diverges between ranks"),
+    "TPU454": ("warn", "non-collective op streams diverge between "
+                       "ranks (rank-dependent branch in the traced "
+                       "step)"),
+    # TPU75x — setitem/scatter alias checking (static.liveness)
+    "TPU751": ("error", "region write overlaps a later read of the "
+                        "pre-write value (stale replay)"),
+    "TPU752": ("error", "in-place write through a donated buffer"),
+    "TPU753": ("warn", "in-place write through a view: XLA never "
+                       "updates the base (diverges from reference "
+                       "in-place view semantics)"),
+    "TPU754": ("warn", "data-dependent write indices: overlap with a "
+                       "later read of the pre-write value is "
+                       "unprovable"),
+    # TPU9xx — static memory (liveness & peak-HBM, static.liveness)
+    "TPU901": ("error", "static peak HBM exceeds chip capacity "
+                        "(program cannot fit — raised before XLA "
+                        "compiles)"),
+    "TPU902": ("warn", "static peak HBM is >= 90% of chip capacity"),
 }
 
 #: op names the collective pass treats as fleet-wide synchronization
@@ -320,6 +346,11 @@ def _contract_pass(records: List[Record], report: Report,
         inplace_targets.update(INPLACE_OF)
     except Exception:                # pragma: no cover - partial import
         pass
+    # the region write family is owned by the TPU75x alias pass
+    # (static.liveness), which proves disjoint write/read regions safe —
+    # the whole-buffer TPU704 check would double-flag them
+    from .liveness import WRITE_FAMILY as _wf
+    inplace_targets -= _wf
     produced: Dict[int, int] = {}
     consumed_after: Dict[int, int] = {}
     for i, r in enumerate(records):
@@ -380,9 +411,16 @@ def _contract_pass(records: List[Record], report: Report,
         used = set()
         for r in records:
             used.update(r.in_ids)
+        alias_family = inplace_targets | _wf
         for i, r in enumerate(records):
             if r.name in _ARM_OPS + _LOOP_OPS:
                 continue             # constructs may run for effect
+            if (r.name in alias_family and r.in_ids
+                    and (r.in_ids[0] in used
+                         or r.in_ids[0] in fetch_set)):
+                # a mutation IS the op's effect: consumers observe it
+                # through the alias target's id after the payload swap
+                continue
             if r.out_ids and not any(o in used or o in fetch_set
                                      for o in r.out_ids):
                 report.add("TPU703", i, r.name,
@@ -675,7 +713,8 @@ def _donation_pass(host_reads, report: Report):
 # ---------------------------------------------------------------------------
 def check(program, mesh=None, in_specs=None, param_specs=None,
           fetch_ids=None, host_reads=(), label=None,
-          contract=True, plan=None) -> Report:
+          contract=True, plan=None, memory=True, capacity_bytes=None,
+          donated_ids=()) -> Report:
     """Verify a recorded program (or any op-record list).
 
     ``program``: a ``static.Program`` or a sequence of records carrying
@@ -687,7 +726,12 @@ def check(program, mesh=None, in_specs=None, param_specs=None,
     (see :func:`audit_step`). ``plan`` is an optional
     already-computed ``ShardingPlan`` for this exact record list —
     callers that propagate anyway (``spmd.shard_program``) hand it in
-    so the sharding pass never re-runs the rules. Returns a
+    so the sharding pass never re-runs the rules. ``memory`` arms the
+    TPU9xx static liveness/peak-HBM pass (``capacity_bytes`` overrides
+    the chip spec; default ``FLAGS_verifier_hbm_capacity`` falling back
+    to ``perf.chip_hbm_bytes()``); ``donated_ids`` are value ids whose
+    buffers a donating step consumes — they shorten residency in the
+    memory pass and arm the TPU752 write-after-donate check. Returns a
     :class:`Report`; apply the flag policy with :func:`enforce`.
     """
     records, prog = _records_of(program)
@@ -699,19 +743,29 @@ def check(program, mesh=None, in_specs=None, param_specs=None,
         known.update(prog._captured.keys())
     if isinstance(in_specs, dict) and prog is None:
         known.update(in_specs.keys())
+    from . import liveness as _liveness
     if contract:
         _contract_pass(records, report, fetch_ids=fetch_ids,
                        known_ids=known)
+        _liveness.alias_pass(records, report, fetch_ids=fetch_ids,
+                             donated_ids=donated_ids)
     _collective_pass(records, report)
     if mesh is not None:
         _sharding_pass(records, prog, mesh, in_specs, param_specs,
                        fetch_ids, report, plan=plan)
     _donation_pass(host_reads, report)
+    if memory:
+        _liveness.memory_pass(
+            prog if prog is not None else records, report,
+            fetch_ids=fetch_ids, plan=plan, mesh=mesh,
+            donated_ids=donated_ids, capacity_bytes=capacity_bytes)
     report.stats = {"ops": len(records),
                     "passes": ["contract" if contract else None,
+                               "alias" if contract else None,
                                "collective",
                                "sharding" if mesh is not None else None,
-                               "donation" if host_reads else None]}
+                               "donation" if host_reads else None,
+                               "memory" if memory else None]}
     return report
 
 
@@ -882,6 +936,10 @@ class trace_scope:
             self._donated_payloads = {
                 id(p._data): (getattr(p, "name", None) or f"param#{i}")
                 for i, p in enumerate(params)}
+            # tensor-identity view of the same set: record in_ids carry
+            # id(tensor), so the TPU752 write-after-donate and the
+            # donation-shortened liveness intervals key on these
+            self._donated_tids = tuple(id(p) for p in params)
 
     note_donated = begin_trace
 
@@ -918,8 +976,11 @@ class trace_scope:
         Called at END OF TRACE, before lowering/compile. The record
         stream (op fns are closure-bearing) is dropped once the report
         is built so the scope retains nothing after the compile."""
+        from . import crossrank as _crossrank
+        _crossrank.maybe_dump(self.records, label=self.label)
         report = check(self.records, host_reads=self.host_reads,
-                       label=self.label, fetch_ids=None)
+                       label=self.label, fetch_ids=None,
+                       donated_ids=getattr(self, "_donated_tids", ()))
         self.records = []
         self.host_reads = []
         self._donated_payloads = {}

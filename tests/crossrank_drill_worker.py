@@ -1,0 +1,107 @@
+"""Cross-rank program-diff drill worker — the real 4-process proof.
+
+Runs under ``python -m paddle_tpu.distributed.launch`` like the other
+drill workers. Two recording phases in one job, each into its own
+``PADDLE_TPU_PROGRAM_RECORD`` base under <outdir>:
+
+Phase CLEAN (``progs_clean``) — every rank launches the same two eager
+all_reduce collectives (the ``collective._coll_begin`` seam notes them
+into the ``<collective-stream>`` pseudo-program) and records the SAME
+static Program. The harness then asserts ``tpulint --cross-rank``
+reports all ranks agree with exit code 0 — the zero-false-positive half
+of the TPU45x acceptance.
+
+Phase DIVERGENT (``progs_div``) — after re-pointing the record base,
+``DRILL_TARGET_RANK`` (default 2) takes an injected branch while
+tracing the step: its recorded op stream carries an extra ``scale`` op
+(TPU454), and it records an extra ``debug_probe`` program no other rank
+compiles (TPU451). Nothing actually desyncs at runtime — every eager
+collective is still launched identically by all ranks — which is the
+point: the static diff names the divergent rank and first divergent
+sequence number from the dumps alone, BEFORE a real launch-time
+mismatch could hang the fleet.
+
+Usage: crossrank_drill_worker.py <outdir>
+"""
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+OUTDIR = sys.argv[1]
+TARGET = int(os.environ.get("DRILL_TARGET_RANK", "2"))
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+import paddle_tpu.ops as ops  # noqa: E402
+from paddle_tpu import static  # noqa: E402
+from paddle_tpu.distributed.communication import collective as C  # noqa: E402
+from paddle_tpu.static import crossrank  # noqa: E402
+
+dist.init_parallel_env()
+rank = jax.process_index()
+world = jax.process_count()
+assert world == 4, f"drill expects 4 processes, got {world}"
+
+
+def _record_step(divergent: bool):
+    """Trace a tiny step; a divergent rank's branch adds one extra op —
+    the rank-dependent-control-flow bug class TPU454 exists to catch."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        y = ops.add(ops.multiply(x, paddle.to_tensor(2.0)),
+                    paddle.to_tensor(1.0))
+        if divergent:
+            y = ops.scale(y, scale=0.5)
+        z = ops.tanh(y)
+    return prog, [id(z)]
+
+
+# ------------------------------------------------------------- phase CLEAN
+os.environ[crossrank.RECORD_ENV] = os.path.join(OUTDIR, "progs_clean")
+crossrank.reset()
+
+t = paddle.to_tensor(np.ones((4, 4), np.float32))
+dist.all_reduce(t)        # -> <collective-stream> seq 0, every rank
+dist.all_reduce(t)        # -> <collective-stream> seq 1, every rank
+
+prog, fetch = _record_step(divergent=False)
+crossrank.dump_program(prog, "drill_step")
+
+C.barrier()               # every rank's clean dump is on disk
+print(f"[drill] rank {rank} clean phase recorded", flush=True)
+
+# --------------------------------------------------------- phase DIVERGENT
+os.environ[crossrank.RECORD_ENV] = os.path.join(OUTDIR, "progs_div")
+crossrank.reset()
+
+dist.all_reduce(t)        # identical eager collectives — no runtime
+#                           desync is ever injected; the divergence
+#                           below is purely in what gets RECORDED
+
+prog, fetch = _record_step(divergent=(rank == TARGET))
+crossrank.dump_program(prog, "drill_step")
+
+if rank == TARGET:
+    # a program label only this rank ever compiles (TPU451). Dumped
+    # from records rather than a live trace: under multi-process jax,
+    # static.data's mesh device_put runs multihost_utils.assert_equal
+    # — a real broadcast collective — so tracing on ONE rank would
+    # desync the job at runtime, which is exactly the failure mode
+    # this pass exists to catch statically.
+    from paddle_tpu.static import verifier
+    crossrank.dump_program(
+        [verifier.Record("relu", in_ids=[1], out_ids=[2],
+                         in_shapes=[(2, 2)], out_shapes=[(2, 2)],
+                         in_dtypes=["float32"],
+                         out_dtypes=["float32"])],
+        "debug_probe")
+
+C.barrier()               # every rank's divergent dump is on disk
+print(f"[drill] rank {rank} divergent phase recorded", flush=True)
+sys.exit(0)

@@ -1,0 +1,55 @@
+"""Must-flag: every TPU75x alias hazard in one record stream —
+
+* TPU753: an in-place write through a ``getitem`` VIEW whose base is
+  read afterwards (functional XLA arrays never update the base);
+* TPU752: a write into a buffer already donated to the compiled step;
+* TPU751: a statically-overlapping read of the pre-write value;
+* TPU754: a data-dependent (regionless) write whose pre-write value is
+  still read.
+"""
+EXPECT = ["TPU751", "TPU752", "TPU753", "TPU754"]
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    f32 = "float32"
+    records = [
+        # v2 = view of base v1; write through it while v1 is read later
+        R("getitem", in_ids=[1], out_ids=[2],
+          in_shapes=[(8, 8)], out_shapes=[(4, 8)],
+          in_dtypes=[f32], out_dtypes=[f32],
+          attrs={"read_region": ((0, 4), (0, 8))}),
+        R("setitem", in_ids=[2, 10], out_ids=[3],
+          in_shapes=[(4, 8), (2, 8)], out_shapes=[(4, 8)],
+          in_dtypes=[f32, f32], out_dtypes=[f32],
+          attrs={"write_region": ((0, 2), (0, 8))}),        # TPU753
+        R("sum", in_ids=[1], out_ids=[4],
+          in_shapes=[(8, 8)], out_shapes=[()],
+          in_dtypes=[f32], out_dtypes=[f32]),
+        # write into the donated entry v5
+        R("setitem", in_ids=[5, 10], out_ids=[6],
+          in_shapes=[(8, 8), (2, 8)], out_shapes=[(8, 8)],
+          in_dtypes=[f32, f32], out_dtypes=[f32],
+          attrs={"write_region": ((0, 2), (0, 8))}),        # TPU752
+        # overwrite rows [0,2) of v7, then read rows [1,3): overlap
+        R("setitem", in_ids=[7, 10], out_ids=[8],
+          in_shapes=[(8, 8), (2, 8)], out_shapes=[(8, 8)],
+          in_dtypes=[f32, f32], out_dtypes=[f32],
+          attrs={"write_region": ((0, 2), (0, 8))}),        # TPU751
+        R("getitem", in_ids=[7], out_ids=[9],
+          in_shapes=[(8, 8)], out_shapes=[(2, 8)],
+          in_dtypes=[f32], out_dtypes=[f32],
+          attrs={"read_region": ((1, 3), (0, 8))}),
+        # tensor-indexed write: region unprovable, pre-write value read
+        R("setitem", in_ids=[11, 10], out_ids=[12],
+          in_shapes=[(8, 8), (2, 8)], out_shapes=[(8, 8)],
+          in_dtypes=[f32, f32], out_dtypes=[f32]),          # TPU754
+        R("mean", in_ids=[11], out_ids=[13],
+          in_shapes=[(8, 8)], out_shapes=[()],
+          in_dtypes=[f32], out_dtypes=[f32]),
+    ]
+    return verifier.check(records, fetch_ids=[4, 9, 13],
+                          donated_ids=[5],
+                          label="flag_alias_chain")

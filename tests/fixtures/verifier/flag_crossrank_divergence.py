@@ -1,0 +1,51 @@
+"""Must-flag: every TPU45x cross-rank divergence over synthetic
+per-rank program dumps —
+
+* TPU451: rank 1 runs an extra all_reduce (collective membership);
+* TPU452: same position, different group content;
+* TPU453: same collectives, swapped order;
+* TPU454: identical collectives but divergent non-collective op
+  streams (a rank-dependent branch in the traced step).
+"""
+EXPECT = ["TPU451", "TPU452", "TPU453", "TPU454"]
+
+
+def _op(seq, name, group=0, shape=(4, 4), collective=True):
+    return {"seq": seq, "name": name, "attrs": {"group": group},
+            "in_shapes": [list(shape)], "out_shapes": [list(shape)],
+            "in_dtypes": ["float32"], "out_dtypes": ["float32"],
+            "loc": "", "collective": collective}
+
+
+def _prog(label, names, groups=None, extra_op=None):
+    groups = groups or [0] * len(names)
+    ops = [_op(i, n, g) for i, (n, g) in enumerate(zip(names, groups))]
+    if extra_op is not None:
+        ops.append(dict(extra_op, seq=len(ops)))
+    return {"label": label, "ops": ops}
+
+
+def build():
+    from paddle_tpu.static import crossrank
+
+    mm = _op(0, "matmul", collective=False)
+    rl = _op(0, "relu", collective=False)
+    dumps = {
+        0: {"format": crossrank.FORMAT, "rank": 0, "world": 2,
+            "programs": [
+                _prog("membership", ["all_reduce", "all_gather"]),
+                _prog("content", ["all_reduce", "all_gather"]),
+                _prog("order", ["all_reduce", "all_gather"]),
+                _prog("opstream", ["all_reduce"], extra_op=mm),
+            ]},
+        1: {"format": crossrank.FORMAT, "rank": 1, "world": 2,
+            "programs": [
+                _prog("membership",
+                      ["all_reduce", "all_reduce", "all_gather"]),
+                _prog("content", ["all_reduce", "all_gather"],
+                      groups=[0, 3]),
+                _prog("order", ["all_gather", "all_reduce"]),
+                _prog("opstream", ["all_reduce"], extra_op=rl),
+            ]},
+    }
+    return crossrank.diff_programs(dumps)

@@ -1,0 +1,23 @@
+"""Must-flag: a program whose STATIC liveness peak exceeds the HBM
+capacity — TPU901 fires at compile time, before XLA ever sees the
+program (strict mode raises). The matmul holds both 4 MiB operands and
+the 4 MiB output live at once; capacity is 1 MB."""
+EXPECT = ["TPU901"]
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    records = [
+        R("matmul", in_ids=[1, 2], out_ids=[3],
+          in_shapes=[(1024, 1024), (1024, 1024)],
+          out_shapes=[(1024, 1024)],
+          in_dtypes=["float32", "float32"], out_dtypes=["float32"]),
+        R("relu", in_ids=[3], out_ids=[4],
+          in_shapes=[(1024, 1024)], out_shapes=[(1024, 1024)],
+          in_dtypes=["float32"], out_dtypes=["float32"]),
+    ]
+    return verifier.check(records, fetch_ids=[4],
+                          capacity_bytes=1e6,
+                          label="flag_memory_capacity")

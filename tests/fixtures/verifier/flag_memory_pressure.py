@@ -1,0 +1,19 @@
+"""Must-flag: static peak under capacity but >= 90% of it — the TPU902
+pressure warning (the program compiles, but one fragmentation event or
+batch bump OOMs it). Peak here is 12 MiB against a 13 MB cap (~97%)."""
+EXPECT = ["TPU902"]
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    records = [
+        R("matmul", in_ids=[1, 2], out_ids=[3],
+          in_shapes=[(1024, 1024), (1024, 1024)],
+          out_shapes=[(1024, 1024)],
+          in_dtypes=["float32", "float32"], out_dtypes=["float32"]),
+    ]
+    return verifier.check(records, fetch_ids=[3],
+                          capacity_bytes=13e6,
+                          label="flag_memory_pressure")

@@ -1,0 +1,27 @@
+"""Must-NOT-flag: a region write followed by a read of the pre-write
+value whose static regions are PROVABLY disjoint (rows [0,2) written,
+rows [4,6) read) — the precision that separates the TPU75x alias pass
+from the whole-buffer TPU704 check, which would have flagged this."""
+EXPECT = []
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    f32 = "float32"
+    records = [
+        R("setitem", in_ids=[1, 5], out_ids=[2],
+          in_shapes=[(8, 8), (2, 8)], out_shapes=[(8, 8)],
+          in_dtypes=[f32, f32], out_dtypes=[f32],
+          attrs={"write_region": ((0, 2), (0, 8))}),
+        R("getitem", in_ids=[1], out_ids=[3],
+          in_shapes=[(8, 8)], out_shapes=[(2, 8)],
+          in_dtypes=[f32], out_dtypes=[f32],
+          attrs={"read_region": ((4, 6), (0, 8))}),
+        R("relu", in_ids=[3], out_ids=[4],
+          in_shapes=[(2, 8)], out_shapes=[(2, 8)],
+          in_dtypes=[f32], out_dtypes=[f32]),
+    ]
+    return verifier.check(records, fetch_ids=[4],
+                          label="ok_alias_disjoint")

@@ -1,0 +1,24 @@
+"""Must-NOT-flag: four ranks whose program dumps agree op-for-op —
+collective sequence, content, order, and the surrounding op stream.
+The static diff over a clean data-parallel launch stays silent."""
+EXPECT = []
+
+
+def _op(seq, name, collective):
+    return {"seq": seq, "name": name, "attrs": {"group": 0},
+            "in_shapes": [[4, 4]], "out_shapes": [[4, 4]],
+            "in_dtypes": ["float32"], "out_dtypes": ["float32"],
+            "loc": "", "collective": collective}
+
+
+def build():
+    from paddle_tpu.static import crossrank
+
+    ops = [_op(0, "matmul", False), _op(1, "all_reduce", True),
+           _op(2, "relu", False), _op(3, "all_gather", True)]
+    dumps = {
+        r: {"format": crossrank.FORMAT, "rank": r, "world": 4,
+            "programs": [{"label": "step", "ops": ops}]}
+        for r in range(4)
+    }
+    return crossrank.diff_programs(dumps)

@@ -1,0 +1,24 @@
+"""Must-NOT-flag: an elementwise chain whose SUM of activations busts
+the capacity but whose liveness PEAK fits comfortably — the precision
+the interval model buys over the old every-activation-resident
+estimate. Ten 1 MiB intermediates (10.5 MiB summed with the entry)
+against a 6 MB cap; at most two chain buffers are ever live (~3 MiB
+peak with the entry)."""
+EXPECT = []
+
+
+def build():
+    from paddle_tpu.static import verifier
+
+    R = verifier.Record
+    shape, dt = (512, 512), "float32"
+    records = []
+    vid = 1
+    for i in range(10):
+        records.append(R("relu", in_ids=[vid], out_ids=[vid + 1],
+                         in_shapes=[shape], out_shapes=[shape],
+                         in_dtypes=[dt], out_dtypes=[dt]))
+        vid += 1
+    return verifier.check(records, fetch_ids=[vid],
+                          capacity_bytes=6e6,
+                          label="ok_memory_liveness")

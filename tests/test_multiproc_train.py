@@ -36,6 +36,7 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_train_worker.py")
 DRILL_WORKER = os.path.join(REPO, "tests", "fleet_drill_worker.py")
+CROSSRANK_WORKER = os.path.join(REPO, "tests", "crossrank_drill_worker.py")
 
 
 def _clean_env():
@@ -215,6 +216,61 @@ def test_fleet_observability_drill(tmp_path):
             f"rank {r} flight record missing:\n{out}"
     assert re.search(r"status=desync rank=2 seq=\d+", out), out
     assert "rank 2 moved past seq" in out, out
+
+
+def test_crossrank_program_diff_drill(tmp_path):
+    """The TPU45x static cross-rank diff, in the REAL 4-process harness
+    (tests/crossrank_drill_worker.py): one launch records program dumps
+    into two bases — a clean phase where every rank traces the same
+    step and launches the same eager collectives, and a divergent phase
+    where DRILL_TARGET_RANK=2 takes an injected branch (extra op in its
+    traced step, plus a program label only it compiles). The real
+    ``tpulint --cross-rank`` CLI must then (a) name rank 2 and the
+    first divergent sequence number from the dumps alone, exit 1, and
+    (b) report zero findings on the clean base, exit 0."""
+    import re
+
+    port = _free_port_pair()
+    env = _clean_env()
+    env["DRILL_TARGET_RANK"] = "2"
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4",
+         "--master", f"127.0.0.1:{port}", CROSSRANK_WORKER,
+         str(tmp_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"drill job failed:\n{out}"
+
+    clean_base = os.path.join(str(tmp_path), "progs_clean")
+    div_base = os.path.join(str(tmp_path), "progs_div")
+    for r in range(4):
+        assert os.path.exists(f"{clean_base}.r{r}"), \
+            f"rank {r} clean dump missing:\n{out}"
+        assert os.path.exists(f"{div_base}.r{r}"), \
+            f"rank {r} divergent dump missing:\n{out}"
+
+    lint_env = _clean_env()
+    # divergent base: the CLI names the rank and first divergent seq
+    lint = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--cross-rank",
+         div_base],
+        env=lint_env, cwd=REPO, capture_output=True, text=True,
+        timeout=120)
+    assert lint.returncode == 1, lint.stdout + lint.stderr
+    assert "TPU454" in lint.stdout, lint.stdout
+    assert "TPU451" in lint.stdout, lint.stdout
+    assert re.search(r"rank=2 seq=\d+", lint.stdout), lint.stdout
+
+    # clean base: dp-style launch with identical programs + identical
+    # collective streams — zero findings
+    lint = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--cross-rank",
+         clean_base],
+        env=lint_env, cwd=REPO, capture_output=True, text=True,
+        timeout=120)
+    assert lint.returncode == 0, lint.stdout + lint.stderr
+    assert "all ranks agree" in lint.stdout, lint.stdout
 
 
 @pytest.mark.slow  # ~60 s each: a virtual-mesh run PLUS a 4-process

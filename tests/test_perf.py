@@ -595,6 +595,9 @@ class TestPerfGate:
         # the verifier bar encodes the <2% budget: value * min_ratio
         vo = base["rungs"]["verifier_overhead_ratio"]
         assert vo["value"] * vo["min_ratio"] >= 0.98
+        # the static-analyzer bar encodes the same <2% compile budget
+        sa = base["rungs"]["static_analysis_overhead_ratio"]
+        assert sa["value"] * sa["min_ratio"] >= 0.98
         # the pipeline bar is the boolean acceptance gate itself
         pb = base["rungs"]["pipeline_bubble_measured_vs_analytical"]
         assert pb["value"] * pb["min_ratio"] >= 1.0
@@ -605,6 +608,7 @@ class TestPerfGate:
                            "async_batch_sweep_tokens_ratio",
                            "serving_router_goodput_scaling",
                            "verifier_overhead_ratio",
+                           "static_analysis_overhead_ratio",
                            "serving_reqtrace_overhead_ratio",
                            "pipeline_bubble_measured_vs_analytical"}
 

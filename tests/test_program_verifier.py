@@ -67,11 +67,14 @@ class TestFixtureCorpus:
             expected.update(_load_fixture(f).EXPECT)
         # one must-flag fixture per pass family at minimum
         assert {"TPU401", "TPU402", "TPU403", "TPU404",      # collective
+                "TPU451", "TPU452", "TPU453", "TPU454",      # cross-rank
                 "TPU501", "TPU502", "TPU503",                # sharding
                 "TPU601",                                    # donation
                 "TPU700", "TPU701", "TPU702", "TPU703",
                 "TPU704", "TPU705",                          # contract
-                "TPU801", "TPU802", "TPU803"} <= expected    # stages
+                "TPU751", "TPU752", "TPU753", "TPU754",      # alias
+                "TPU801", "TPU802", "TPU803",                # stages
+                "TPU901", "TPU902"} <= expected              # memory
         assert any(not _load_fixture(f).EXPECT
                    for f in _FIXTURE_FILES), "no must-not-flag fixtures"
 

@@ -13,6 +13,11 @@
                                                  programs with the
                                                  static.verifier passes
                                                  (TPU4xx/5xx/6xx/7xx)
+    --cross-rank BASE                        diff the rank-suffixed
+                                                 program dumps
+                                                 BASE.r<rank> that a
+                                                 PADDLE_TPU_PROGRAM_RECORD
+                                                 launch wrote (TPU45x)
 
 Exit status: 0 clean (vs baseline if given), 1 new findings, 2 usage error.
 """
@@ -95,6 +100,14 @@ def main(argv=None) -> int:
                          "+ pipeline-stage programs and run the static "
                          "program verifier (static.verifier "
                          "TPU4xx/5xx/6xx/7xx/8xx) over each op-list IR")
+    ap.add_argument("--cross-rank", metavar="BASE", default=None,
+                    help="statically diff the per-rank program dumps "
+                         "BASE.r<rank> written by a launch with "
+                         "PADDLE_TPU_PROGRAM_RECORD=BASE — mismatched "
+                         "collective sequences / content / order and "
+                         "divergent op streams are flagged with the "
+                         "rank and first divergent seq (TPU45x) before "
+                         "anything has to hang")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="summary line only")
     args = ap.parse_args(argv)
@@ -112,6 +125,10 @@ def main(argv=None) -> int:
     if args.programs:
         from . import program_check
         return program_check.run(quiet=args.quiet)
+    if args.cross_rank:
+        from paddle_tpu.static import crossrank
+        return 1 if crossrank.run(args.cross_rank,
+                                  quiet=args.quiet) else 0
     if args.update_baseline and not args.baseline:
         ap.error("--update-baseline requires --baseline")
     if args.update_baseline and args.diff is not None:

@@ -199,6 +199,28 @@ def _programs_impl() -> List[Tuple[str, Callable[[], object]]]:
             + (np.full((eng.max_batch,), k, np.int32),),
             label="serving_verify_tick")
 
+    def moe_layer():
+        # the GShard MoE block (distributed.fleet.moe): gate + stacked
+        # experts dispatch as the registered moe_gate/moe_layer ops;
+        # BOTH the output and the aux loss are fetched (the training
+        # loop consumes l_aux — unfetched it would read as dead)
+        from paddle_tpu.distributed.fleet.moe import MoELayer
+        paddle.seed(7)
+        layer = MoELayer(d_model=16, num_experts=4, top_k=2,
+                         capacity_factor=2.0)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [8, 16], "float32")
+            y = layer(x)
+            l_aux = layer.l_aux
+        rep = verifier.check(prog, fetch_ids=[id(y), id(l_aux)],
+                             label="moe_layer")
+        # the liveness pass must be able to price it too: the peak
+        # report is part of the op surface contract for ladder programs
+        from paddle_tpu.static import liveness
+        liveness.peak_report(prog, fetch_ids=[id(y), id(l_aux)])
+        return rep
+
     def pipeline_stages():
         # every stage slice of a cost-partitioned program must verify
         # as a standalone op stream AND the cross-stage send/recv
@@ -226,6 +248,7 @@ def _programs_impl() -> List[Tuple[str, Callable[[], object]]]:
             ("fused_plan", fused_plan),
             ("serving_decode_tick", serving_decode_tick),
             ("serving_verify_tick", serving_verify_tick),
+            ("moe_layer", moe_layer),
             ("pipeline_stages", pipeline_stages)]
 
 
