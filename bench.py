@@ -1773,6 +1773,93 @@ def _bench_fleet_observability(small):
     }
 
 
+def _bench_goodput_overhead(small):
+    """Goodput-ledger + sentinel overhead rung (BENCH_MODEL=
+    goodput_overhead; paddle_tpu/observability/goodput.py +
+    sentinel.py). The SAME jitted step timed bare vs with the full
+    per-step job-health plane on the path — ledger step brackets
+    (clock reads + billed-overlap accounting) and the sentinel's
+    median/MAD + EWMA update per step. value = off/on step-time ratio
+    (1.0 = free); the acceptance bar is overhead < 2% of the
+    un-instrumented loop, same discipline as the fleet_observability
+    and serving_reqtrace rungs (paired per-step A/B, alternating
+    order, median over ALL signed pair diffs)."""
+    import io
+
+    from paddle_tpu.core import flags
+    from paddle_tpu.observability import goodput, sentinel
+
+    # step sized to the small end of REAL training steps (~ms-scale),
+    # like the fleet rung: the ledger's absolute cost is µs-level
+    D, B = (768, 256) if small else (2048, 512)
+    iters = 600 if small else 200
+    rng = np.random.RandomState(0)
+    w = jnp.asarray(rng.randn(D, D) * 0.01, jnp.float32)
+    x0 = jnp.asarray(rng.randn(B, D), jnp.float32)
+    step = jax.jit(lambda x: jnp.tanh(x @ w))
+
+    OFF = {"goodput": False, "sentinel": False}
+    ON = {"goodput": True, "sentinel": True}
+
+    def one_step(instrumented, led, snt):
+        t0 = time.perf_counter()
+        if instrumented:
+            led.step_begin()
+        y = step(x0)
+        jax.block_until_ready(y)
+        if instrumented:
+            snt.observe_step(led.step_end(), loss=0.0)
+        return time.perf_counter() - t0
+
+    prev = {k: flags.get_flag(k) for k in ("goodput", "sentinel")}
+    t_off, diffs = [], []
+    try:
+        flags.set_flags(ON)
+        led = goodput.reset_ledger().run_begin()
+        # incidents print nowhere: overhead is what this rung measures,
+        # and a GC-pause spike must not spam the bench log
+        snt = sentinel.reset(stream=io.StringIO())
+        for _ in range(5):                       # warm compiles/caches
+            jax.block_until_ready(step(x0))
+        for i in range(iters):
+            if i % 2 == 0:
+                flags.set_flags(OFF)
+                d_off = one_step(False, led, snt)
+                flags.set_flags(ON)
+                d_on = one_step(True, led, snt)
+            else:
+                flags.set_flags(ON)
+                d_on = one_step(True, led, snt)
+                flags.set_flags(OFF)
+                d_off = one_step(False, led, snt)
+            t_off.append(d_off)
+            diffs.append(d_on - d_off)
+        incidents = len(snt.incidents())
+        ledger_steps = led.snapshot()["steps"]
+    finally:
+        flags.set_flags(prev)
+        goodput.reset_ledger()
+        sentinel.reset()
+    off = float(np.median(t_off))
+    # median over ALL paired diffs (see the fleet rung's rationale)
+    on = off + float(np.median(diffs))
+    ratio = off / max(on, 1e-12)
+    overhead_pct = (on / max(off, 1e-12) - 1.0) * 100.0
+    return {
+        "metric": "goodput_overhead_ratio",
+        "value": round(ratio, 4),
+        "unit": "x_uninstrumented",
+        "vs_baseline": round(ratio, 4),
+        "extra": {"overhead_pct": round(overhead_pct, 3),
+                  "step_off_us": round(off * 1e6, 1),
+                  "step_on_us": round(on * 1e6, 1),
+                  "steps_per_config": iters,
+                  "ledger_steps": ledger_steps,
+                  "sentinel_incidents": incidents,
+                  "within_budget": bool(overhead_pct < 2.0)},
+    }
+
+
 def _bench_dispatch(small):
     """Per-op eager dispatch latency (VERDICT: SURVEY §7 hard part #1).
 
@@ -2398,6 +2485,7 @@ def main():
                "planner_vs_manual": _bench_planner_vs_manual,
                "fusion": _bench_fusion,
                "fleet_observability": _bench_fleet_observability,
+               "goodput_overhead": _bench_goodput_overhead,
                "async_overlap": _bench_async_overlap,
                "async_batch_sweep": _bench_async_batch_sweep}
     if _env_bool("BENCH_FUSION", False):
@@ -2503,6 +2591,18 @@ def main():
               "value": 0.0, "unit": "error", "vs_baseline": 0.0,
               "extra": {"error": repr(e)[:300]}}
     print(json.dumps(fo))
+    sys.stdout.flush()
+
+    # goodput-ledger + sentinel overhead rung rides along in every
+    # default run: the job-health plane must stay < 2% of step time
+    # (own metric class — not in the train geomean)
+    try:
+        go = benches["goodput_overhead"](small)
+    except Exception as e:  # pragma: no cover - rung isolation
+        go = {"metric": "goodput_overhead_ratio",
+              "value": 0.0, "unit": "error", "vs_baseline": 0.0,
+              "extra": {"error": repr(e)[:300]}}
+    print(json.dumps(go))
     sys.stdout.flush()
 
     # async-runtime rungs ride along in every default run: prefetch +
@@ -2666,6 +2766,12 @@ def main():
                       "overhead_pct": fo.get("extra", {}).get(
                           "overhead_pct"),
                       "within_budget": fo.get("extra", {}).get(
+                          "within_budget")},
+                  "goodput_overhead": {
+                      "value": go["value"], "unit": go["unit"],
+                      "overhead_pct": go.get("extra", {}).get(
+                          "overhead_pct"),
+                      "within_budget": go.get("extra", {}).get(
                           "within_budget")},
                   "serving_reqtrace": {
                       "value": rt["value"], "unit": rt["unit"],

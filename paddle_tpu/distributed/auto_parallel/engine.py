@@ -295,6 +295,8 @@ class Engine:
         from ...core import flags as _flags
         from ...io.prefetch import DevicePrefetcher
         from ...observability import fleet as _fleet
+        from ...observability import goodput as _goodput
+        from ...observability import sentinel as _sentinel
         from ...observability.perf import memory as _perf_mem
         from ...optimizer.lr import LRScheduler
 
@@ -306,6 +308,16 @@ class Engine:
         use_prefetch = (bool(_flags.get_flag("prefetch"))
                         if self._prefetch_arg is None
                         else bool(self._prefetch_arg))
+        # goodput ledger + anomaly sentinel: the job health plane. The
+        # jit-cache size tells us which steps hide a trace+compile wall.
+        led = _goodput.ledger().run_begin()
+        snt = _sentinel.get()
+        cache_size = getattr(self._train_step, "_cache_size", None)
+        # async-stretch hygiene: with no scheduler the LR is constant —
+        # transfer it ONCE instead of a host read + H2D per step (the
+        # sentinel's host bucket must not be polluted by our own reads)
+        lr_const = (None if sched is not None
+                    else jnp.asarray(self._opt.get_lr(), jnp.float32))
 
         def place(batch):
             """Batch → placed (x, y) device arrays; under prefetch this
@@ -342,14 +354,24 @@ class Engine:
                         # detector's feed. Resolved per step (like the
                         # fleet trainers) so reset_beacon() takes effect
                         # mid-fit.
+                        led.step_begin()
                         bcn = _fleet.beacon()
                         bcn.step_begin()
                         # lr is a traced INPUT: schedulers tick without
-                        # retracing
-                        lr = jnp.asarray(self._opt.get_lr(), jnp.float32)
+                        # retracing (constant LR: placed once, pre-loop)
+                        lr = (lr_const if lr_const is not None
+                              else jnp.asarray(self._opt.get_lr(),
+                                               jnp.float32))
                         prev = (pa, opt_state) if self._donate else None
+                        n_sigs = cache_size() if cache_size else None
                         loss, pa, opt_state = self._train_step(
                             pa, opt_state, lr, x, y)
+                        if n_sigs is not None and cache_size() > n_sigs:
+                            # jit-cache miss: the (synchronous) trace +
+                            # XLA compile wall heads this step's window
+                            led.bill_since_step_begin("compile")
+                            snt.note_compile(
+                                "initial" if n_sigs == 0 else "retrace")
                         if prev is not None:
                             _donation.mark_donated(
                                 jax.tree_util.tree_leaves(prev),
@@ -368,6 +390,7 @@ class Engine:
                                 else "engine_step")
                             census_left -= 1
                         bcn.step_end()
+                        snt.observe_step(led.step_end())
                         if verbose and step_i % log_freq == 0:
                             print(f"[engine] epoch {epoch} step {step_i} "
                                   f"loss {float(loss):.4f}")  # tpulint: disable=TPU103 — the log-interval materialization IS the documented host boundary (async-loss contract)

@@ -63,7 +63,10 @@ class AsyncSaveHandle:
 
     def wait(self):
         if self._thread is not None:
-            self._thread.join()
+            # the join is checkpoint badput the training loop pays for
+            from ...observability import goodput as _goodput
+            with _goodput.bill("checkpoint"):
+                self._thread.join()
             self._thread = None
         if self._error is not None:
             err, self._error = self._error, None
@@ -197,11 +200,17 @@ def save_state_dict(state_dict: Dict, path: str, process_group=None,
             "(process_allgather of metadata to the coordinator); "
             "single-controller multi-host is not wired yet")
     pid = jax.process_index()
-    meta, chunks = _collect(state_dict, pid)
+    from ...observability import goodput as _goodput
+    with _goodput.bill("checkpoint"):
+        # the host snapshot runs on the calling thread even for async
+        # saves — it is checkpoint badput; the async write phase is not
+        # (it overlaps training; only the wait() join bills)
+        meta, chunks = _collect(state_dict, pid)
     write_metadata = pid == coordinator_rank
 
     if not async_save:
-        _write_files(path, meta, chunks, pid, write_metadata)
+        with _goodput.bill("checkpoint"):
+            _write_files(path, meta, chunks, pid, write_metadata)
         return None
 
     handle = AsyncSaveHandle()

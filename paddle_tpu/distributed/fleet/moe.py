@@ -28,7 +28,37 @@ from ...core import dispatch
 from ...core.tensor import Tensor
 from ...nn.layer.layers import Layer
 from ...nn.parameter import ParamAttr
+from ...observability import metrics as _metrics
 from .. import mesh as mesh_mod
+
+_m_expert_tokens = _metrics.counter(
+    "paddle_tpu_moe_expert_tokens_total",
+    "Tokens routed (within capacity) per expert by eager MoE dispatch.",
+    labelnames=("expert",))
+_m_load_imbalance = _metrics.gauge(
+    "paddle_tpu_moe_load_imbalance",
+    "max/mean tokens-per-expert of the latest eager MoE dispatch "
+    "(1.0 = perfectly balanced).")
+
+
+def _stamp_expert_load(dispatch_mask: Tensor):
+    """Per-expert token counts + load-imbalance gauge from the dispatch
+    mask [N, E, C] — the per-rank expert-load-balance signal the MoE
+    scaling rung is judged on.  Only stamps eager dispatches: inside a
+    traced program the mask is abstract and a host read would either
+    fail or silently bake a constant, so telemetry stays out."""
+    if not _metrics.enabled():
+        return
+    data = dispatch_mask._data
+    if isinstance(data, jax.core.Tracer):
+        return
+    counts = np.asarray(jnp.sum(data, axis=(0, 2)))  # tpulint: disable=TPU104 — telemetry-by-design: eager-only (tracer-guarded), metrics-gated host read
+    for e, c in enumerate(counts):
+        if c > 0:  # tpulint: disable=TPU105 — counts is host numpy here (eager telemetry path)
+            _m_expert_tokens.inc(float(c), expert=e)  # tpulint: disable=TPU103 — same eager telemetry path
+    mean = float(counts.mean())  # tpulint: disable=TPU103 — same eager telemetry path
+    if mean > 0:
+        _m_load_imbalance.set(float(counts.max()) / mean)  # tpulint: disable=TPU103 — same eager telemetry path
 
 
 def _ep_axes(ep_axis: Optional[str], num_experts: int):
@@ -163,6 +193,7 @@ class MoELayer(Layer):
         lands on ``self.l_aux``."""
         combine, dispatch_mask, aux = self.gate(x)
         self.l_aux = aux
+        _stamp_expert_load(dispatch_mask)
 
         template = self.experts[0]
         tmpl_params = list(template.parameters())

@@ -158,7 +158,40 @@ class Watchdog:
             self._dump_reqtrace(out)
         except Exception as e:
             out.write(f"[watchdog] request-trace dump failed: {e}\n")
+        try:
+            self._dump_goodput(out)
+        except Exception as e:
+            out.write(f"[watchdog] goodput dump failed: {e}\n")
         out.write("[watchdog] ---- end diagnostics ----\n")
+
+    def _dump_goodput(self, out):
+        """Job-health post-mortem: the goodput ledger's bucket account
+        and the sentinel's incident tail at hang time, plus a persisted
+        ``PADDLE_TPU_GOODPUT`` record (an ``os.abort`` skips atexit, so
+        the watchdog persists explicitly first)."""
+        import json
+
+        from ..observability import goodput, sentinel
+
+        led = goodput.ledger()
+        if led.running():
+            snap = led.snapshot()
+            out.write("[watchdog] goodput: "
+                      f"wall={snap['wall_s']:.1f}s fraction="
+                      f"{snap['goodput_fraction']:.3f} buckets="
+                      + json.dumps({k: round(v, 3) for k, v
+                                    in snap["buckets"].items()},
+                                   sort_keys=True) + "\n")
+        incidents = sentinel.get().incidents(10)
+        if incidents:
+            out.write(f"[watchdog] sentinel incident tail "
+                      f"({len(incidents)}):\n")
+            for inc in incidents:
+                out.write(f"[watchdog]   {inc['kind']} @ step "
+                          f"{inc['step']}: {inc['detail']}\n")
+        path = goodput.dump(reason=f"watchdog hang #{self.hang_count}")
+        if path:
+            out.write(f"[watchdog] goodput record persisted: {path}\n")
 
     def _dump_reqtrace(self, out):
         """Request flight-recorder post-mortem: the serving requests

@@ -21,6 +21,7 @@ import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..framework import io as _fio
+from ..observability import goodput as _goodput
 from ..observability import metrics as _metrics
 from .retry import RetryPolicy, retry
 
@@ -113,8 +114,9 @@ class CheckpointManager:
         fname = f"{self.prefix}-{int(step):010d}.pdckpt"
         path = os.path.join(self.directory, fname)
         payload = {"state": state, "meta": meta}
-        retry(lambda: _fio.save(payload, path, protocol=self.protocol),
-              policy=self.retry_policy, site="ckpt.save")
+        with _goodput.bill("checkpoint"):
+            retry(lambda: _fio.save(payload, path, protocol=self.protocol),
+                  policy=self.retry_policy, site="ckpt.save")
         entries = [e for e in self.manifest() if e.get("file") != fname]
         entries.append({"file": fname, "step": int(step), "epoch": epoch,
                         "bytes": os.path.getsize(path), "meta": meta})
@@ -151,7 +153,8 @@ class CheckpointManager:
         counts); None when nothing in the directory is loadable."""
         for depth, path in enumerate(self.checkpoints()):
             try:
-                payload = _fio.load(path, verify=verify)
+                with _goodput.bill("checkpoint"):
+                    payload = _fio.load(path, verify=verify)
             except (_fio.CheckpointCorruptError, OSError, EOFError,
                     ValueError, KeyError) as e:
                 warnings.warn(
@@ -211,6 +214,13 @@ def auto_resume(manager: CheckpointManager, network=None, optimizer=None,
     if out is None:
         return None
     state, meta = out
-    restore_train_state(state, network=network, optimizer=optimizer,
-                        scaler=scaler)
+    with _goodput.bill("checkpoint"):
+        restore_train_state(state, network=network, optimizer=optimizer,
+                            scaler=scaler)
+    if meta.get("step") is not None:
+        # the steps between this checkpoint and where the crashed run
+        # had progressed will be recomputed — the ledger bills them as
+        # restart-rewind badput (prior progress from its own account,
+        # or the previous process's PADDLE_TPU_GOODPUT exit dump)
+        _goodput.ledger().note_resume(int(meta["step"]))
     return meta

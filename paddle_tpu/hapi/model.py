@@ -135,6 +135,10 @@ class Model:
         cbks.set_params({"epochs": epochs, "batch_size": batch_size,
                          "verbose": verbose, "save_dir": save_dir,
                          "metrics": [m.name() for m in self._metrics]})
+        # job health plane: wall-clock goodput account (begun BEFORE the
+        # resume path, so auto_resume's rewind lands in this run)
+        from ..observability import goodput as _goodput
+        _goodput.ledger().run_begin()
         start_epoch, skip_steps = 0, 0
         if resume is not None:
             start_epoch, skip_steps = self._auto_resume(resume,
@@ -192,15 +196,22 @@ class Model:
                    cbks, verbose, log_freq):
         """One epoch's step loop over ``batches`` (a DevicePrefetcher or
         the raw loader)."""
+        from ..observability import goodput as _goodput
+        from ..observability import sentinel as _sentinel
+        led = _goodput.ledger()
+        snt = _sentinel.get()
         for step, batch in enumerate(batches):
             if epoch == start_epoch and step < skip_steps:
                 continue   # step-granular resume: already trained
+            led.step_begin()
             cbks.on_train_batch_begin(step)
             batch = _to_list(batch)
             xs, ys = batch[:-1], batch[-1:]
             out = self.train_batch(xs, ys)
             loss = out[0][0] if isinstance(out, tuple) else out[0]
             losses.append(loss)
+            snt.observe_step(led.step_end(step=self._global_step),
+                             loss=loss, step=self._global_step)
             if verbose and log_freq and step % log_freq == 0:
                 msg = f"epoch {epoch} step {step} loss {loss:.4f}"
                 for m, v in zip(self._metrics,

@@ -107,7 +107,7 @@ class Predictor:
         self._outputs = []
         for o in outs:
             h = _Handle()
-            h.copy_from_cpu(np.asarray(
+            h.copy_from_cpu(np.asarray(  # tpulint: disable=TPU104 — host-by-design: the Predictor ABI returns host ndarrays (copy_to_cpu contract)
                 o._data if isinstance(o, Tensor) else o))
             self._outputs.append(h)
         return True

@@ -37,6 +37,7 @@ import weakref
 from typing import Callable, Iterator, Optional
 
 from ..core import flags
+from ..observability import goodput as _goodput
 from ..observability import metrics as _metrics
 from ..observability import trace as _trace
 
@@ -195,6 +196,9 @@ class DevicePrefetcher:
             self.stall_seconds += stalled
             if _metrics.enabled():
                 _m_stall.inc(stalled)
+            # input starvation is badput the data plane owns: bill the
+            # stall window to the goodput ledger's data_stall bucket
+            _goodput.bill_interval("data_stall", t0, t0 + stalled)
         if kind is _DONE:
             self._done = True
             self.close()

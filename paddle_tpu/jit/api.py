@@ -27,7 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..observability import goodput as _goodput
 from ..observability import metrics as _metrics
+from ..observability import sentinel as _sentinel
 from ..observability import trace as _trace
 
 _capture = threading.local()
@@ -258,8 +260,11 @@ class StaticFunction:
         runner = self._aot_sigs.get(sig)
         if runner is None and is_new_sig:  # tpulint: disable=TPU105 — branches on input SHAPES (the dispatch signature), not tensor values
             # persistent compilation cache: an already-seen signature
-            # (this machine or a warmed fleet peer) skips trace+compile
-            runner = self._pcc_load(sig, params)
+            # (this machine or a warmed fleet peer) skips trace+compile.
+            # The goodput ledger bills the load wall as compile — a pcc
+            # hit therefore bills near-zero vs a real compile
+            with _goodput.bill("compile"):
+                runner = self._pcc_load(sig, params)
             self._pcc_record_manifest(arrays)
         if runner is not None:
             self._seen_sigs.add(sig)   # known signature, nothing compiled
@@ -276,13 +281,15 @@ class StaticFunction:
                 # time it as the compile cost (per-subsystem span + metric)
                 kind = "initial" if len(self._seen_sigs) == 1 else "retrace"
                 with _trace.span(f"to_static_compile:{self.__name__}",
-                                 "compile"):
+                                 "compile"), _goodput.bill("compile"):
                     c0 = time.perf_counter()
                     out, mutated = self._dispatch_new_sig(
                         sig, params, arrays, treedef, statics)
+                c1 = time.perf_counter() - c0
+                # retrace bursts are the sentinel's compile-storm signal
+                _sentinel.get().note_compile(kind=kind, seconds=c1)
                 if _metrics.enabled():
-                    _m_compile_time.observe(time.perf_counter() - c0,
-                                            kind=kind)
+                    _m_compile_time.observe(c1, kind=kind)
             else:
                 out, mutated = self._jitted(
                     [p._data for p in params], arrays, treedef, statics)

@@ -418,9 +418,11 @@ class Segment:
                 self.owner.stats["compiled"] += 1
                 # XLA compiles on the first execution — time it as the
                 # segment's compile cost
+                from ...observability import goodput as _goodput
                 with _trace.span(
                         f"sot_segment_compile:site{self.owner.site_idx}",
-                        "compile", {"ops": len(self.nodes)}):
+                        "compile", {"ops": len(self.nodes)}), \
+                        _goodput.bill("compile"):
                     c0 = time.perf_counter()
                     results = jitted(self.ext_arrays)
                 seg_seconds = time.perf_counter() - c0

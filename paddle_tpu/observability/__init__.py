@@ -25,11 +25,13 @@ import os
 from . import metrics, trace
 from . import flight  # noqa: F401  (registers the flight-record exit dump)
 from . import reqtrace  # noqa: F401  (registers the reqtrace exit dump)
+from . import goodput  # noqa: F401  (registers the goodput exit dump)
+from . import sentinel  # noqa: F401  (anomaly sentinel singleton)
 from .metrics import (REGISTRY, Counter, Gauge, Histogram, MetricsRegistry,
                       enabled, render_prometheus)
 
-__all__ = ["metrics", "trace", "flight", "reqtrace", "REGISTRY",
-           "MetricsRegistry",
+__all__ = ["metrics", "trace", "flight", "reqtrace", "goodput", "sentinel",
+           "REGISTRY", "MetricsRegistry",
            "Counter", "Gauge", "Histogram", "enabled", "render_prometheus",
            "device_live_bytes", "snapshot", "to_prometheus"]
 

@@ -218,7 +218,9 @@ def local_snapshot(trace_tail: int = 200, reqtrace_tail: int = 20) -> dict:
     state."""
     import socket
 
+    from . import goodput as _goodput
     from . import reqtrace as _reqtrace
+    from . import sentinel as _sentinel
 
     rank, world = _rank_world()
     b = _beacon["b"]
@@ -245,6 +247,11 @@ def local_snapshot(trace_tail: int = 200, reqtrace_tail: int = 20) -> dict:
         "beacon": (b.last_report if b is not None else None),
         "replicas": replica_health(),
         "clock": clock_state(),
+        # job health plane: the rank's goodput account + incident tail,
+        # so fleet.snapshot() carries the job-level (min-over-ranks)
+        # goodput evidence in one gather
+        "goodput": _goodput.ledger().snapshot(),
+        "sentinel": _sentinel.get().snapshot(),
     }
 
 
@@ -468,6 +475,8 @@ class FleetBeacon:
             tot = _perf_device.attribute(spans, steps=[(t0, t1)])["total"]
             self._attr = (tot["compute_frac"], tot["collective_frac"],
                           tot["host_frac"], tot["idle_frac"])
+            from . import goodput as _goodput
+            _goodput.ledger().note_attribution(*self._attr)
         except Exception:
             pass                      # a beacon must never fail the step
 
@@ -502,6 +511,16 @@ class FleetBeacon:
         stats["window"] = self.windows
         stats["per_rank"] = matrix
         self.last_report = stats
+        try:
+            from . import goodput as _goodput
+            from . import sentinel as _sentinel
+            _goodput.ledger().note_skew(
+                int(self._n), mean, stats["median_step_s"])
+            _sentinel.get().note_straggler(
+                stats.get("slowest_rank"), bool(stats["is_straggler"]),
+                skew=float(stats.get("skew", 0.0)))
+        except Exception:
+            pass                      # telemetry must not kill training
         if _metrics.enabled():
             _m_windows.inc()
             for r, s in stats["scores"].items():

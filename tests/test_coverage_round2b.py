@@ -60,9 +60,14 @@ class TestLlama:
         np.testing.assert_allclose(out.numpy()[:, 0], x.numpy()[:, 0],
                                    atol=1e-6)
 
+    @pytest.mark.slow
     def test_ring_attention_with_tp(self):
         """LLaMA with context_parallel='ring' + mp TP on a sep x mp mesh:
-        loss matches the dense single-config model on the same weights."""
+        loss matches the dense single-config model on the same weights.
+
+        Slow-marked (~15s, 870s tier-1 budget): ring==dense equality
+        stays in tier-1 via test_moe_sep's ring_flash_attention parity
+        and TP via test_fleet_tp's gpt_mp2-matches-serial."""
         from paddle_tpu.distributed import mesh as mesh_mod
         from paddle_tpu.models import LlamaForCausalLM
 

@@ -243,9 +243,14 @@ class TestContainers:
 
 
 class TestHybrid3D:
+    @pytest.mark.slow
     def test_pp_tp_dp_pipeline(self, hybrid3d_mesh):
         """2-stage pipeline of TP-2 GPT blocks over a dp2 x pp2 x mp2 mesh
-        — the composed hybrid story (SURVEY §3.5 call stack)."""
+        — the composed hybrid story (SURVEY §3.5 call stack).
+
+        Slow-marked (~8s, 870s tier-1 budget): the hybrid composition
+        stays in tier-1 via test_compose_rpc's zero2+recompute+tp and
+        test_pipeline_ir's (data, pp) mesh GPT training."""
         import paddle_tpu.distributed.fleet as fleet_pkg
         from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
                                                   PipelineParallel)

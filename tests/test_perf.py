@@ -601,6 +601,9 @@ class TestPerfGate:
         # the pipeline bar is the boolean acceptance gate itself
         pb = base["rungs"]["pipeline_bubble_measured_vs_analytical"]
         assert pb["value"] * pb["min_ratio"] >= 1.0
+        # the goodput-ledger bar encodes the <2% step budget (round 23)
+        go = base["rungs"]["goodput_overhead_ratio"]
+        assert go["value"] * go["min_ratio"] >= 0.95
         assert missing <= {"fleet_observability_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",
@@ -610,7 +613,8 @@ class TestPerfGate:
                            "verifier_overhead_ratio",
                            "static_analysis_overhead_ratio",
                            "serving_reqtrace_overhead_ratio",
-                           "pipeline_bubble_measured_vs_analytical"}
+                           "pipeline_bubble_measured_vs_analytical",
+                           "goodput_overhead_ratio"}
 
     def test_cli_schema_only(self, tmp_path):
         p = tmp_path / "cand.json"

@@ -125,6 +125,10 @@ class TestModelRematFusedFlags:
             outs.append(_train_loss_and_gradsum(GPTForCausalLM(cfg), ids))
         np.testing.assert_allclose(outs[0], outs[1], rtol=1e-4)
 
+    # slow-marked (~10s combined, 870s tier-1 budget): the
+    # recompute+fused_loss invisibility contract stays in tier-1 via
+    # test_gpt above; the llama/bert variants run in the full matrix
+    @pytest.mark.slow
     def test_llama(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         ids = np.random.RandomState(0).randint(0, 128, (2, 16))
@@ -139,6 +143,7 @@ class TestModelRematFusedFlags:
             outs.append(_train_loss_and_gradsum(LlamaForCausalLM(cfg), ids))
         np.testing.assert_allclose(outs[0], outs[1], rtol=1e-4)
 
+    @pytest.mark.slow
     def test_bert(self):
         from paddle_tpu.models.bert import BertConfig, BertForPretraining
         ids = np.random.RandomState(0).randint(0, 128, (2, 16))

@@ -60,6 +60,11 @@ class TestPagedEngineParity:
         out = eng.run_to_completion()
         assert out[rid] == _ref_greedy(model, prompt, 8)
 
+    @pytest.mark.slow
+    # slow-marked (~15s, 870s tier-1 budget): paged-vs-dense parity
+    # stays in tier-1 via the single-request llama case above and the
+    # GPT full-recompute greedy case below; the mixed-length staggered
+    # matrix runs in the full suite
     def test_mixed_lengths_and_staggered_admission(self):
         model = _tiny_model()
         rng = np.random.RandomState(1)

@@ -23,7 +23,13 @@ class TestZooForward:
     # `-m 'not slow'`.
     _slow = pytest.mark.slow
     @pytest.mark.parametrize("ctor,size", [
-        ("vgg11", 64), ("mobilenet_v2", 64),
+        # plain stacked-conv path stays in tier-1 via alexnet; vgg11 is
+        # the same idiom at ~12s of conv compiles
+        pytest.param("vgg11", 64, marks=_slow),
+        # depthwise/pointwise conv path stays in tier-1 via
+        # shufflenet_v2_x0_25; the whole mobilenet family (v1/v2/v3)
+        # runs in the full matrix
+        pytest.param("mobilenet_v2", 64, marks=_slow),
         pytest.param("mobilenet_v1", 64, marks=_slow),
         pytest.param("mobilenet_v3_small", 64, marks=_slow),
         pytest.param("mobilenet_v3_large", 64, marks=_slow),

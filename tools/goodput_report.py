@@ -1,0 +1,157 @@
+"""Render the training goodput ledger + sentinel incident timeline.
+
+The goodput ledger (``paddle_tpu/observability/goodput.py``) partitions a
+run's wall clock into badput buckets; the sentinel ring-buffers typed
+anomaly incidents. This tool renders both as a markdown table + incident
+timeline (or JSON) from any of:
+
+* the **live process** (library use / REPL) — ledger + sentinel
+  singletons;
+* one or more **rank dumps** — ``PADDLE_TPU_GOODPUT=/path`` makes every
+  rank write ``/path.r<rank>`` at exit (the watchdog hang path writes
+  one too); ``--dump /path`` merges the whole set and reports the
+  job-level goodput as the **min over ranks** (a pod is as good as its
+  worst rank);
+* a saved **fleet snapshot** (``fleet.snapshot()`` JSON, which carries a
+  ``goodput`` + ``sentinel`` entry per rank).
+
+CLI::
+
+    python tools/goodput_report.py --dump /tmp/goodput.json
+    python tools/goodput_report.py --dump /tmp/goodput.json --json
+    python tools/goodput_report.py --snapshot /tmp/fleet_snap.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+
+def _goodput():
+    from paddle_tpu.observability import goodput
+    return goodput
+
+
+# ---------------------------------------------------------------------------
+# Collection: rank records from dumps / fleet snapshot / live process
+# ---------------------------------------------------------------------------
+def collect(dump_base: Optional[str] = None,
+            snapshot_path: Optional[str] = None) -> List[dict]:
+    """Uniform per-rank records: ``{"rank", "goodput", "sentinel"}``."""
+    if dump_base is not None:
+        payloads = _goodput().merge_dumps(dump_base)
+        if not payloads:
+            raise SystemExit(f"no goodput dumps match {dump_base}.r*")
+        return [{"rank": p.get("rank", 0), "goodput": p["goodput"],
+                 "sentinel": p.get("sentinel") or {}} for p in payloads]
+    if snapshot_path is not None:
+        with open(snapshot_path) as f:
+            snap = json.load(f)
+        ranks = snap.get("ranks") or [snap]   # fleet.snapshot() or local
+        out = []
+        for r in ranks:
+            if r.get("goodput") is None:
+                continue
+            out.append({"rank": r.get("rank", 0), "goodput": r["goodput"],
+                        "sentinel": r.get("sentinel") or {}})
+        if not out:
+            raise SystemExit(f"{snapshot_path}: no goodput entries")
+        return out
+    from paddle_tpu.observability import sentinel
+    return [{"rank": 0, "goodput": _goodput().ledger().snapshot(),
+             "sentinel": sentinel.get().snapshot()}]
+
+
+def job_report(records: List[dict]) -> dict:
+    """Per-rank accounts + the job-level (min-over-ranks) goodput."""
+    per_rank = []
+    for rec in records:
+        g = rec["goodput"]
+        per_rank.append({
+            "rank": rec["rank"],
+            "wall_s": g.get("wall_s", 0.0),
+            "goodput_fraction": g.get("goodput_fraction", 0.0),
+            "buckets": g.get("buckets", {}),
+            "steps": g.get("steps", 0),
+            "rewind_steps": g.get("rewind_steps", 0),
+            "incidents": (rec.get("sentinel") or {}).get("incidents", []),
+        })
+    worst = min(per_rank, key=lambda r: r["goodput_fraction"],
+                default=None)
+    return {
+        "ranks": per_rank,
+        "job_goodput_fraction": (worst["goodput_fraction"]
+                                 if worst else 0.0),
+        "worst_rank": worst["rank"] if worst else None,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+def render_markdown(report: dict) -> str:
+    gp = _goodput()
+    lines = ["# Goodput report", ""]
+    lines.append(f"Job goodput (min over ranks): "
+                 f"**{report['job_goodput_fraction']:.1%}** "
+                 f"(worst rank: {report['worst_rank']})")
+    lines.append("")
+    header = "| rank | wall (s) | goodput | " + \
+        " | ".join(gp.BUCKETS) + " | steps | rewound |"
+    sep = "|" + "---|" * (len(gp.BUCKETS) + 5)
+    lines += [header, sep]
+    for r in report["ranks"]:
+        b = r["buckets"]
+        cells = [str(r["rank"]), f"{r['wall_s']:.1f}",
+                 f"{r['goodput_fraction']:.1%}"]
+        cells += [f"{b.get(k, 0.0):.2f}" for k in gp.BUCKETS]
+        cells += [str(r["steps"]), str(r["rewind_steps"])]
+        lines.append("| " + " | ".join(cells) + " |")
+    lines.append("")
+    lines.append("## Incident timeline")
+    lines.append("")
+    rows = []
+    for r in report["ranks"]:
+        for inc in r["incidents"]:
+            rows.append((inc.get("step", 0), r["rank"], inc))
+    if not rows:
+        lines.append("(no incidents)")
+    else:
+        lines.append("| step | rank | kind | detail | dominant bucket |")
+        lines.append("|---|---|---|---|---|")
+        for step, rank, inc in sorted(rows, key=lambda x: (x[0], x[1])):
+            dom = (inc.get("diff") or {}).get("dominant_bucket") or "-"
+            lines.append(f"| {step} | {rank} | {inc.get('kind')} | "
+                         f"{inc.get('detail')} | {dom} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Goodput ledger table + sentinel incident timeline")
+    ap.add_argument("--dump", metavar="BASE",
+                    help="PADDLE_TPU_GOODPUT base path; merges BASE.r*")
+    ap.add_argument("--snapshot", metavar="FILE",
+                    help="fleet.snapshot() JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of markdown")
+    ap.add_argument("--out", metavar="FILE",
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    records = collect(dump_base=args.dump, snapshot_path=args.snapshot)
+    report = job_report(records)
+    text = (json.dumps(report, indent=1, default=str) if args.json
+            else render_markdown(report))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
