@@ -39,6 +39,11 @@ from ...core import dispatch
 from ...core.tensor import Tensor, as_tensor
 from ...fault import inject as _inject
 from ...fault.retry import RetryPolicy, retry as _retry
+# arms the collective-timeout abort plane: importing the supervisor
+# registers FLAGS_collective_timeout_s and (only when armed) a monitor
+# thread over the flight ring — the per-collective hot path is untouched,
+# the begin/end token below is already the evidence it reads
+from ...fault import supervisor as _supervisor  # noqa: F401
 from ...observability import flight as _flight
 from ...observability import metrics as _metrics
 from ...observability import trace as _trace

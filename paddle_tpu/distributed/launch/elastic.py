@@ -15,7 +15,6 @@ epoch restart IS the reference's relaunch path).
 from __future__ import annotations
 
 import threading
-import time
 from typing import List, Optional, Tuple
 
 from .kv_server import Heartbeat, KVClient
@@ -121,25 +120,13 @@ class ElasticManager:
         if self.node_rank != 0:
             return self
 
-        def watch():
-            # let every peer's first heartbeat land before judging
-            time.sleep(self.heartbeat.interval * 2)
-            while not self._stop.wait(self.interval):
-                known = self.current_world() or initial_world
-                action, new_world = self.decide(known, self.live_peers())
-                if action == "rescale":
-                    epoch = self.publish(new_world)
-                    print(f"[elastic] membership {known} -> {new_world}; "
-                          f"epoch {epoch}")
-                elif action == "fail":
-                    self.client.put(f"/elastic/{self.job_id}/failed",
-                                    f"below quorum: live={new_world}, "
-                                    f"min={self.min_nodes}")
-                    print(f"[elastic] job below quorum ({new_world}); "
-                          f"marking failed")
-                    return
-
-        self._thread = threading.Thread(target=watch, daemon=True)
+        # the agent's membership loop lives in fault.supervisor — the
+        # same lease-expiry judgement that drives the in-process
+        # coordinated abort; decide() above stays pure for unit tests
+        from ...fault.supervisor import elastic_agent_loop
+        self._thread = threading.Thread(
+            target=elastic_agent_loop,
+            args=(self, initial_world, self._stop), daemon=True)
         self._thread.start()
         return self
 

@@ -36,6 +36,11 @@ def _parse(argv=None):
     p.add_argument("--log_dir", default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic restarts per worker on failure")
+    p.add_argument("--abort_grace", type=float, default=10.0,
+                   help="after one worker dies restart-worthy, wait up "
+                        "to this many seconds for the surviving workers "
+                        "to abort coordinated (collective timeout / "
+                        "lease expiry) before reaping them")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -223,7 +228,44 @@ def launch(argv=None) -> int:
                                   node_rank=node_rank_now)
                     continue
             if any(c is not None and c != 0 for c in codes):
+                from ...fault.supervisor import (describe_exit,
+                                                 restart_worthy)
                 bad = next(c for c in codes if c is not None and c != 0)
+                if not restart_worthy(bad):
+                    # config-type deaths fail identically on every retry
+                    # — don't burn the restart budget, stop the job now
+                    print(f"[launch] worker failed with "
+                          f"{describe_exit(bad)}; not restart-worthy; "
+                          f"stopping job")
+                    if manager is not None:
+                        manager.mark_failed(
+                            f"node {args.node_rank}: worker exit {bad} "
+                            f"({describe_exit(bad)}), not restart-worthy")
+                    for w in workers:
+                        w.terminate()
+                    return bad
+                # coordinated-abort grace: the survivors' own abort
+                # plane (collective timeout, lease expiry) should name
+                # the culprit and exit with a verdict code — give it a
+                # bounded window before reaping them with SIGTERM
+                if args.abort_grace > 0:
+                    deadline = time.monotonic() + args.abort_grace
+                    while (any(w.poll() is None for w in workers)
+                           and time.monotonic() < deadline):
+                        time.sleep(0.2)
+                    codes = [w.poll() for w in workers]
+                # re-select with the full picture: a supervisor VERDICT
+                # code (collective timeout, lease expiry, desync) is the
+                # diagnosis — prefer it over the collateral deaths (gloo
+                # errors, coordination-service aborts) that cascade from
+                # the first exit, whatever rank order they landed in
+                from ...fault.supervisor import EXIT_CODES
+                nz = [c for c in codes if c is not None and c != 0]
+                bad = next((c for c in nz if c in EXIT_CODES),
+                           nz[0] if nz else bad)
+                print(f"[launch] worker death: "
+                      + ", ".join(f"rank {i}: {describe_exit(c)}"
+                                  for i, c in enumerate(codes)))
                 if group_restarts < args.max_restarts:
                     group_restarts += 1
                     if manager is not None:

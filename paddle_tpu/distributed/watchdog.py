@@ -37,6 +37,9 @@ class Watchdog:
         self.unbalanced_end_count = 0
         self.last_op: Optional[str] = None
         self.last_op_t = 0.0
+        #: the newest cross-rank flight-diff verdict (set by
+        #: dump_diagnostics) — decides the abort exit code
+        self.last_verdict: Optional[dict] = None
 
     # ------------------------------------------------------------- progress
     def heartbeat(self):
@@ -94,8 +97,26 @@ class Watchdog:
                         except Exception:
                             pass
                     if self.abort_on_hang:
-                        import os
-                        os.abort()
+                        # verdict-dependent exit code so the elastic
+                        # agent can tell a named desync (one rank raced)
+                        # from a plain hang — both restart-worthy, but
+                        # they chart differently
+                        try:
+                            from ..fault import supervisor as _sup
+                            v = self.last_verdict or {}
+                            code = (_sup.EXIT_DESYNC
+                                    if v.get("status") == "desync"
+                                    else _sup.EXIT_WATCHDOG_HANG)
+                            _sup.force_exit(
+                                code,
+                                reason="watchdog hang: "
+                                + str(v.get("detail",
+                                            "no cross-rank verdict")))
+                        except SystemExit:
+                            raise
+                        except Exception:
+                            import os
+                            os.abort()
                     self.heartbeat()   # one report per stall window
 
         self._thread = threading.Thread(target=run, daemon=True,
@@ -151,7 +172,7 @@ class Watchdog:
         except Exception as e:
             out.write(f"[watchdog] observability dump failed: {e}\n")
         try:
-            self._dump_flight_and_diff(out)
+            self.last_verdict = self._dump_flight_and_diff(out)
         except Exception as e:
             out.write(f"[watchdog] flight-recorder dump failed: {e}\n")
         try:
@@ -227,7 +248,8 @@ class Watchdog:
         wait briefly for the peer ranks' watchdogs to write theirs and
         diff the sequence tails: the verdict names exactly which rank
         stalled before, or raced past, which collective (the reference
-        comm_task_manager's stuck-rank report)."""
+        comm_task_manager's stuck-rank report).  Returns the verdict
+        dict (None when no record path / single-process)."""
         import os
 
         from ..observability import flight
@@ -245,12 +267,12 @@ class Watchdog:
                       + "\n")
         base = os.environ.get(flight.RECORD_ENV)
         if not base:
-            return
+            return None
         path = flight.dump(reason=f"watchdog hang #{self.hang_count}")
         out.write(f"[watchdog] flight record persisted: {path}\n")
         world = flight.rank_world()[1]    # env-based; backend may be wedged
         if world <= 1:
-            return
+            return None
         # peers' watchdogs fire within one timeout+poll of ours; wait a
         # bounded slice of that for their files before diffing what we
         # have (an incomplete set still yields a best-effort verdict)
@@ -270,6 +292,7 @@ class Watchdog:
                   + (f" seq={verdict['seq']}"
                      if verdict.get("seq") is not None else "")
                   + f"\n[watchdog] {verdict['detail']}\n")
+        return verdict
 
     def stop(self):
         self._stop.set()

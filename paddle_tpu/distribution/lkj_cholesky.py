@@ -47,7 +47,7 @@ class LKJCholesky(Distribution):
         conc = as_tensor(concentration)._data.astype(jnp.float32)
         if conc.ndim == 0:
             conc = conc[None]
-        if not bool((conc > 0).all()):
+        if not bool((conc > 0).all()):  # tpulint: disable=TPU103 — constructor-time argument validation: one host read at distribution build, never per-step
             raise ValueError("The arg of `concentration` must be "
                              "positive.")
         self.dim = dim

@@ -12,9 +12,14 @@ Three pieces, built to be provable:
   collectives; exhaustion re-raises the original error.
 - **Deterministic fault injection** — :mod:`.inject` names failure
   points (``io.write_truncate_after_bytes``, ``io.rename_fail``,
-  ``collective.timeout``, ``grads.nan_at_step``) that production code
+  ``collective.timeout``, ``grads.nan_at_step``, ``rank.crash_at_step``,
+  ``rank.hang_at_step``, ``heartbeat.lease_lost``) that production code
   guards at near-zero cost and tests arm to prove every recovery path
   end-to-end.
+- **Fleet supervisor** — :mod:`.supervisor`: the collective-timeout
+  abort plane, lease-based rank-failure detection, cross-rank consensus
+  rewind and sentinel remediation (the half that *acts* on the
+  observability layer's diagnosis).
 
 ``CheckpointManager`` and the train-state helpers resolve lazily because
 they sit above ``framework.io``, which itself guards its writes with
@@ -30,7 +35,7 @@ from .retry import RetryPolicy, retry
 
 __all__ = ["inject", "InjectedFault", "RetryPolicy", "retry",
            "CheckpointManager", "auto_resume", "capture_train_state",
-           "restore_train_state"]
+           "restore_train_state", "supervisor"]
 
 _LAZY = {"CheckpointManager", "auto_resume", "capture_train_state",
          "restore_train_state"}
@@ -42,5 +47,12 @@ def __getattr__(name):
         for n in _LAZY:
             globals()[n] = getattr(mod, n)
         return globals()[name]
+    if name == "supervisor":
+        # lazy for the same reason as the checkpoint pieces: the
+        # supervisor sits above observability + launch, which sit above
+        # framework.io, which imports .inject from below
+        mod = importlib.import_module(".supervisor", __name__)
+        globals()[name] = mod
+        return mod
     raise AttributeError(
         f"module 'paddle_tpu.fault' has no attribute {name!r}")

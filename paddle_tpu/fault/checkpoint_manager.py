@@ -102,6 +102,17 @@ class CheckpointManager:
         ckpts = self.checkpoints()
         return ckpts[0] if ckpts else None
 
+    def steps(self) -> List[int]:
+        """Completed-save steps newest→oldest, by the same directory scan
+        as :meth:`checkpoints` — the per-rank manifest the consensus
+        rewind exchanges across the fleet."""
+        out = []
+        for path in self.checkpoints():
+            m = self._pat.match(os.path.basename(path))
+            if m:
+                out.append(int(m.group(1)))
+        return out
+
     # --------------------------------------------------------------- save
     def save(self, state: Any, step: int, epoch: Optional[int] = None,
              meta: Optional[dict] = None) -> str:
@@ -146,12 +157,26 @@ class CheckpointManager:
                 [e for e in self.manifest() if e.get("file") not in gone])
 
     # ------------------------------------------------------------ restore
-    def restore(self, verify: bool = True
+    def restore(self, verify: bool = True, max_step: Optional[int] = None
                 ) -> Optional[Tuple[Any, Dict[str, Any]]]:
         """``(state, meta)`` from the newest checkpoint that loads clean,
         falling back past corrupt/partial ones (each skip warns and
-        counts); None when nothing in the directory is loadable."""
-        for depth, path in enumerate(self.checkpoints()):
+        counts); None when nothing in the directory is loadable.
+
+        ``max_step`` bounds the candidates to steps <= it — the
+        consensus-rewind path restores the newest step completed on
+        EVERY rank, so a rank that saved further ahead must skip its
+        extra checkpoints (not a fallback: they aren't damaged, they
+        are unilateral)."""
+        cands = self.checkpoints()
+        if max_step is not None:
+            kept = []
+            for path in cands:
+                m = self._pat.match(os.path.basename(path))
+                if m and int(m.group(1)) <= int(max_step):
+                    kept.append(path)
+            cands = kept
+        for depth, path in enumerate(cands):
             try:
                 with _goodput.bill("checkpoint"):
                     payload = _fio.load(path, verify=verify)
@@ -205,12 +230,14 @@ def restore_train_state(state: dict, network=None, optimizer=None,
 
 
 def auto_resume(manager: CheckpointManager, network=None, optimizer=None,
-                scaler=None, verify: bool = True) -> Optional[dict]:
+                scaler=None, verify: bool = True,
+                max_step: Optional[int] = None) -> Optional[dict]:
     """Restore the newest verifiable train state into the given pieces;
     returns its meta (``step``/``epoch``/...) for the training loop to
     fast-forward its counters, or None when there is nothing to resume
-    from."""
-    out = manager.restore(verify=verify)
+    from.  ``max_step`` bounds the restore to the cross-rank consensus
+    step (``fault.supervisor.consensus_resume`` computes and passes it)."""
+    out = manager.restore(verify=verify, max_step=max_step)
     if out is None:
         return None
     state, meta = out

@@ -55,6 +55,21 @@ guard). The registered points:
                                     params: optional ``op`` filter. Other
                                     primitives change output shape under a
                                     bypass and are not wired.
+``rank.crash_at_step``              the supervisor heartbeat kills this
+                                    process with SIGKILL (no atexit, no
+                                    dump — a real machine death) at global
+                                    step ``step``; params: ``step``
+``rank.hang_at_step``               the supervisor heartbeat wedges this
+                                    rank in an uninterruptible sleep at
+                                    global step ``step`` (peers block in the
+                                    next collective) — the deterministic
+                                    hang drill for the collective-timeout
+                                    abort plane; params: ``step``
+``heartbeat.lease_lost``            the supervisor stops publishing this
+                                    rank's heartbeat lease (process stays
+                                    alive — a network partition, not a
+                                    death) so peers observe lease expiry;
+                                    params: optional ``step``
 ==================================  =========================================
 """
 from __future__ import annotations
@@ -89,6 +104,9 @@ POINTS = frozenset({
     "serving.crash_at_tick",
     "fleet.slow_step",
     "collective.desync",
+    "rank.crash_at_step",
+    "rank.hang_at_step",
+    "heartbeat.lease_lost",
 })
 
 _lock = threading.Lock()

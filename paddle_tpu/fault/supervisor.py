@@ -1,0 +1,861 @@
+"""Fleet supervisor — detect, name the culprit, abort coordinated, rewind.
+
+A pod dies by its weakest rank: a SIGKILLed or wedged worker leaves every
+peer blocked *inside* a collective until the cluster scheduler's patience
+runs out, and a restarted job can resume ranks at different checkpoint
+steps (split brain).  The diagnosis half already exists — flight recorder
++ ``diff_ranks``, watchdog, beacon, sentinel, goodput rewind ledger —
+and this module is the half that *acts* on those signals.  Four pieces:
+
+**Collective-timeout abort plane.**  ``FLAGS_collective_timeout_s`` arms
+a monitor thread over the flight recorder's ring: every collective
+already opens an in-flight entry before the device op (``_coll_begin``)
+and stamps it closed on completion, so an entry open past the deadline
+IS the hang evidence — no hot-path change, zero cost when disarmed (the
+flag defaults to 0 and the thread does not exist).  On fire the monitor
+persists this rank's ring, waits briefly for peer dumps, runs
+:func:`flight.diff_ranks` with the full world (a SIGKILLed peer leaves
+no dump, and that absence names it), prints the verdict, and force-exits
+with :data:`EXIT_COLLECTIVE_TIMEOUT` (or :data:`EXIT_DESYNC` when the
+diff proves a rank raced/bypassed).
+
+**Rank-failure detection.**  Lease-based heartbeats: every rank publishes
+a stamp each supervisor tick (:class:`FileLease` on a shared directory,
+or :class:`KVLease` through the launch KV master, whose server-side
+clock defeats cross-host skew).  The :class:`Supervisor` declares a rank
+dead on lease expiry and force-exits the survivors with
+:data:`EXIT_HEARTBEAT_LOST` — a coordinated abort the elastic launcher
+can restart, instead of an indefinite block.  The same loop hosts the
+drillable fault points ``rank.crash_at_step`` / ``rank.hang_at_step`` /
+``heartbeat.lease_lost``.
+
+**Coordinated consensus rewind.**  On restart, ranks exchange their
+:class:`~.checkpoint_manager.CheckpointManager` manifest steps (one
+fixed-shape ``gather_rows``, or the KV server when collectives aren't up
+yet) and resume from the *maximum step completed on every rank* —
+:func:`consensus_step` / :func:`consensus_resume`.  The recomputed steps
+are billed to the goodput ledger's ``rewind`` bucket through the
+existing ``note_resume`` seam.
+
+**Sentinel remediation.**  ``FLAGS_remediation`` gates a registry of
+bounded, audited actions keyed by sentinel incident kind —
+``compile_storm`` → pcc warmup from the manifest,
+``data_stall_regression`` → raise ``FLAGS_prefetch_depth``,
+``nonfinite_loss`` → GradScaler backoff (joining the hapi skip-step
+path) — each rate-limited, counted in
+``paddle_tpu_fault_remediations_total{kind,action}``, with an optional
+per-incident chrome-trace capture (``PADDLE_TPU_INCIDENT_TRACE``).
+
+Exit-code taxonomy (the elastic agent's restart-worthiness contract):
+
+=====  =====================  ==============  =============================
+code   name                   restart-worthy  meaning
+=====  =====================  ==============  =============================
+113    CONFIG                 no              bad flags/arguments — a
+                                              restart would fail identically
+117    COLLECTIVE_TIMEOUT     yes             a collective stayed open past
+                                              ``FLAGS_collective_timeout_s``
+                                              (peer dead or wedged)
+118    HEARTBEAT_LOST         yes             a rank's lease expired (or our
+                                              own did — partitioned)
+119    DESYNC                 yes             the cross-rank flight diff
+                                              proved a rank raced/bypassed a
+                                              collective
+120    WATCHDOG_HANG          yes             the progress watchdog fired
+                                              with no cross-rank desync
+                                              verdict
+=====  =====================  ==============  =============================
+
+Signal deaths (negative ``Popen`` codes) and generic crashes are
+restart-worthy; ``argparse``'s 2 and :data:`EXIT_CONFIG` are not.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import signal
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import flags
+from ..observability import flight as _flight
+from ..observability import metrics as _metrics
+from . import inject as _inject
+
+__all__ = [
+    "EXIT_CONFIG", "EXIT_COLLECTIVE_TIMEOUT", "EXIT_HEARTBEAT_LOST",
+    "EXIT_DESYNC", "EXIT_WATCHDOG_HANG", "EXIT_CODES",
+    "describe_exit", "restart_worthy", "force_exit",
+    "FileLease", "KVLease", "Supervisor", "get", "tick",
+    "elastic_agent_loop",
+    "consensus_step", "consensus_resume",
+    "RemediationEngine", "enable_remediation", "remediation_engine",
+    "register_scaler", "INCIDENT_TRACE_ENV",
+]
+
+# --------------------------------------------------------------- exit codes
+EXIT_CONFIG = 113
+EXIT_COLLECTIVE_TIMEOUT = 117
+EXIT_HEARTBEAT_LOST = 118
+EXIT_DESYNC = 119
+EXIT_WATCHDOG_HANG = 120
+
+#: code -> (name, restart_worthy, description)
+EXIT_CODES: Dict[int, tuple] = {
+    EXIT_CONFIG: (
+        "CONFIG", False,
+        "configuration error — restarting would fail identically"),
+    EXIT_COLLECTIVE_TIMEOUT: (
+        "COLLECTIVE_TIMEOUT", True,
+        "a collective stayed open past FLAGS_collective_timeout_s"),
+    EXIT_HEARTBEAT_LOST: (
+        "HEARTBEAT_LOST", True,
+        "a rank's heartbeat lease expired"),
+    EXIT_DESYNC: (
+        "DESYNC", True,
+        "cross-rank flight diff named a desynced rank"),
+    EXIT_WATCHDOG_HANG: (
+        "WATCHDOG_HANG", True,
+        "progress watchdog fired without a cross-rank desync verdict"),
+}
+
+
+def describe_exit(code: Optional[int]) -> str:
+    """Human-readable name for a worker exit code (signal deaths are the
+    negative codes ``subprocess`` reports)."""
+    if code is None:
+        return "running"
+    if code in EXIT_CODES:
+        name, _, desc = EXIT_CODES[code]
+        return f"{name} ({desc})"
+    if code < 0:
+        try:
+            return f"signal {signal.Signals(-code).name}"
+        except ValueError:
+            return f"signal {-code}"
+    return f"exit {code}"
+
+
+def restart_worthy(code: Optional[int]) -> bool:
+    """Whether the elastic agent should spend a restart on this death.
+
+    Signal deaths (SIGKILL'd by the OOM killer, a preempted VM) and the
+    supervisor's fault codes are transient-by-construction; config errors
+    (:data:`EXIT_CONFIG`, argparse's 2) would fail identically on every
+    retry and must stop the job immediately."""
+    if code is None or code == 0:
+        return False
+    if code in EXIT_CODES:
+        return EXIT_CODES[code][1]
+    if code == 2:               # argparse usage error
+        return False
+    return True                 # signal deaths + generic crashes
+
+
+#: replaceable exit hook so in-process tests can observe force_exit
+#: without dying (the real path MUST be os._exit: atexit handlers may
+#: touch the wedged backend and hang the abort itself)
+_exit = {"fn": os._exit}
+
+
+def force_exit(code: int, reason: str = ""):
+    """Terminal abort: persist the flight ring and the goodput ledger
+    (``os._exit`` skips atexit), flush, and exit with ``code``.  The
+    goodput dump carries ``last_step``, which is how the relaunched
+    process's ``note_resume`` learns how far this one had progressed —
+    the rewind bucket's crash-side half."""
+    try:
+        sys.stderr.write(f"[supervisor] force exit code={code} "
+                         f"({describe_exit(code)}): {reason}\n")
+    except Exception:
+        pass
+    try:
+        _flight.dump(reason=f"force_exit {code}: {reason}")
+    except Exception:
+        pass
+    try:
+        from ..observability import goodput as _goodput
+        _goodput.dump(reason=f"force_exit {code}: {reason}")
+    except Exception:
+        pass
+    try:
+        sys.stdout.flush()
+        sys.stderr.flush()
+    except Exception:
+        pass
+    _exit["fn"](code)
+
+
+# ------------------------------------------- collective-timeout abort plane
+flags.define_flag(
+    "collective_timeout_s", 0.0,
+    "Abort (with exit code 117/119) when a collective's flight-recorder "
+    "entry stays open past this many seconds — a dead or wedged peer. "
+    "0 disarms: no monitor thread exists and the hot path is unchanged.")
+
+_monitor: Dict[str, object] = {"thread": None, "stop": None}
+_monitor_lock = threading.Lock()
+
+
+def _monitor_loop(stop: threading.Event):
+    while True:
+        try:
+            t = float(flags.get_flag("collective_timeout_s") or 0.0)
+        except Exception:
+            return
+        poll = min(max(t / 4.0, 0.05), 0.5) if t > 0 else 0.5
+        if stop.wait(poll):
+            return
+        if t <= 0:
+            continue
+        now = time.perf_counter()
+        overdue = [r for r in _flight.RECORDER.open_entries()
+                   if now - r["t0"] > t]
+        if overdue:
+            rec = min(overdue, key=lambda r: r["t0"])
+            _abort_on_timeout(rec, now - rec["t0"], t)
+            return
+
+
+def _abort_on_timeout(rec: dict, age: float, timeout_s: float):
+    """One overdue collective: print the local evidence, exchange flight
+    dumps out-of-band, name the culprit, exit.  Runs on the monitor
+    thread while the main thread is still blocked inside the op."""
+    err = sys.stderr
+    rank, world = _flight.rank_world()
+    err.write(f"[supervisor] rank {rank}: collective seq={rec['seq']} "
+              f"op={rec['op']} group={rec.get('group', 0)} open for "
+              f"{age:.1f}s > FLAGS_collective_timeout_s={timeout_s:g}\n")
+    code = EXIT_COLLECTIVE_TIMEOUT
+    base = os.environ.get(_flight.RECORD_ENV)
+    if base and world > 1:
+        _flight.dump(reason=f"collective timeout seq={rec['seq']}")
+        # peers' monitors fire within one poll of ours; a dead peer never
+        # writes, so the wait is bounded and its absence is the evidence
+        deadline = time.monotonic() + min(timeout_s + 2.0, 15.0)
+        dumps = _flight.load_dumps(base, world=world)
+        while len(dumps) < world and time.monotonic() < deadline:
+            time.sleep(0.25)
+            dumps = _flight.load_dumps(base, world=world)
+        verdict = _flight.diff_ranks(dumps, world=world)
+        err.write(f"[supervisor] cross-rank flight diff "
+                  f"({len(dumps)}/{world} rank dumps): "
+                  f"status={verdict['status']}"
+                  + (f" rank={verdict['rank']}"
+                     if verdict.get("rank") is not None else "")
+                  + (f" seq={verdict['seq']}"
+                     if verdict.get("seq") is not None else "")
+                  + f"\n[supervisor] {verdict['detail']}\n")
+        if verdict["status"] == "desync":
+            code = EXIT_DESYNC
+    force_exit(code, reason=f"collective seq={rec['seq']} ({rec['op']}) "
+                            f"open > {timeout_s:g}s")
+
+
+def _sync_monitor(value):
+    """Start/stop the monitor thread to track the flag — the disarmed
+    state has NO thread, so the zero-cost claim is structural."""
+    t = float(value or 0.0)
+    with _monitor_lock:
+        if t > 0 and _monitor["thread"] is None:
+            stop = threading.Event()
+            th = threading.Thread(
+                target=_monitor_loop, args=(stop,), daemon=True,
+                name="paddle_tpu_collective_timeout")
+            _monitor["thread"], _monitor["stop"] = th, stop
+            th.start()
+        elif t <= 0 and _monitor["thread"] is not None:
+            _monitor["stop"].set()
+            _monitor["thread"], _monitor["stop"] = None, None
+
+
+flags.on_change("collective_timeout_s", _sync_monitor)
+_sync_monitor(flags.get_flag("collective_timeout_s"))
+
+
+# ------------------------------------------------------ rank-failure leases
+class FileLease:
+    """Per-rank lease stamps in a shared directory (single-host groups or
+    a shared filesystem).  Staleness is judged relative to the freshest
+    stamp — like the KV heartbeat's server clock, this makes a slow
+    *observer* unable to fake everyone else's death: only a rank whose
+    stamp lags its liveliest peer by ``ttl`` is dead."""
+
+    def __init__(self, directory: str, rank: Optional[int] = None,
+                 world: Optional[int] = None, ttl: float = 10.0):
+        r, w = _flight.rank_world()
+        self.directory = str(directory)
+        self.rank = int(rank) if rank is not None else r
+        self.world = int(world) if world is not None else w
+        self.ttl = float(ttl)
+        self.path = os.path.join(self.directory, f"lease.r{self.rank}")
+
+    def publish(self):
+        os.makedirs(self.directory, exist_ok=True)
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(repr(time.time()))
+        os.replace(tmp, self.path)
+
+    def stamps(self) -> Dict[int, float]:
+        out: Dict[int, float] = {}
+        for r in range(self.world):
+            p = os.path.join(self.directory, f"lease.r{r}")
+            try:
+                with open(p) as f:
+                    out[r] = float(f.read().strip())
+            except (OSError, ValueError):
+                continue
+        return out
+
+    def dead_ranks(self) -> List[int]:
+        stamps = self.stamps()
+        if not stamps:
+            return []
+        freshest = max(stamps.values())
+        return sorted(r for r, ts in stamps.items()
+                      if freshest - ts > self.ttl)
+
+
+class KVLease:
+    """Lease through the launch KV master (``launch/kv_server.py``):
+    stamps are server-clocked (``X-KV-Stamp: server``), so cross-host
+    clock skew cannot fake a death.  The multi-host backend."""
+
+    def __init__(self, master: str, rank: Optional[int] = None,
+                 world: Optional[int] = None, job_id: str = "default",
+                 ttl: float = 10.0):
+        from ..distributed.launch.kv_server import Heartbeat
+        r, w = _flight.rank_world()
+        self.rank = int(rank) if rank is not None else r
+        self.world = int(world) if world is not None else w
+        self.ttl = float(ttl)
+        self._hb = Heartbeat(master, self.rank, job_id=job_id, ttl=ttl)
+
+    def publish(self):
+        self._hb.client.put(self._hb.key, b"", server_stamp=True)
+
+    def stamps(self) -> Dict[int, float]:
+        return self._hb.stamps()
+
+    def dead_ranks(self) -> List[int]:
+        return self._hb.dead_nodes()
+
+
+class Supervisor:
+    """In-process rank-failure detector.
+
+    A background loop publishes this rank's lease every ``interval`` and
+    judges peers; the training loop additionally calls :meth:`beat` each
+    step (opportunistic freshness + the drillable fault points).  On
+    lease expiry — a peer's, or our OWN (we are the partitioned side) —
+    the survivors abort coordinated with :data:`EXIT_HEARTBEAT_LOST`
+    instead of blocking in the next collective."""
+
+    def __init__(self, lease, interval: float = 1.0,
+                 on_dead: Optional[Callable[[List[int]], None]] = None,
+                 exit_on_dead: bool = True):
+        self.lease = lease
+        self.interval = float(interval)
+        self.on_dead = on_dead
+        self.exit_on_dead = exit_on_dead
+        self.dead: List[int] = []
+        self._suspended = threading.Event()   # heartbeat.lease_lost drill
+        self._last_pub = 0.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _publish(self):
+        try:
+            self.lease.publish()
+            self._last_pub = time.monotonic()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Supervisor":
+        self._publish()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle_tpu_supervisor")
+        self._thread.start()
+        _default["s"] = self
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval)
+            self._thread = None
+        if _default.get("s") is self:
+            _default["s"] = None
+
+    def _loop(self):
+        # let every peer's first stamp land before judging
+        if self._stop.wait(2 * self.interval):
+            return
+        while not self._stop.wait(self.interval):
+            if not self._suspended.is_set():
+                self._publish()
+            try:
+                dead = self.lease.dead_ranks()
+            except Exception:
+                continue
+            if dead:
+                self._handle_dead(dead)
+                return
+
+    def _handle_dead(self, dead: List[int]):
+        self.dead = list(dead)
+        me = getattr(self.lease, "rank", None)
+        ttl = getattr(self.lease, "ttl", 0.0)
+        msg = (f"rank(s) {dead} lease expired (ttl={ttl:g}s)"
+               + (" — including OWN lease (partitioned)"
+                  if me in dead else ""))
+        if self.on_dead is not None:
+            try:
+                self.on_dead(list(dead))
+            except Exception:
+                pass
+        if self.exit_on_dead:
+            sys.stderr.write(f"[supervisor] rank {me}: {msg} — "
+                             f"aborting coordinated\n")
+            force_exit(EXIT_HEARTBEAT_LOST, reason=msg)
+
+    # ----------------------------------------------------------- step tick
+    def beat(self, step: Optional[int] = None):
+        """Per-step feed from the training loop.  Publishes the lease
+        opportunistically and hosts the fault drills; one module-dict
+        truthiness check when nothing is armed."""
+        if _inject.fire("rank.crash_at_step", step=step) is not None:
+            sys.stderr.write(f"[supervisor] rank.crash_at_step fired at "
+                             f"step {step}: SIGKILL\n")
+            sys.stderr.flush()
+            os.kill(os.getpid(), signal.SIGKILL)
+        if _inject.fire("rank.hang_at_step", step=step) is not None:
+            sys.stderr.write(f"[supervisor] rank.hang_at_step fired at "
+                             f"step {step}: wedging this rank (leases "
+                             f"stay fresh — only the collective-timeout "
+                             f"plane can catch this)\n")
+            sys.stderr.flush()
+            while True:             # a wedged host; SIGTERM still lands
+                time.sleep(3600)
+        if _inject.fire("heartbeat.lease_lost", step=step) is not None:
+            sys.stderr.write(f"[supervisor] heartbeat.lease_lost fired at "
+                             f"step {step}: suspending lease publishing "
+                             f"(process stays alive)\n")
+            sys.stderr.flush()
+            self._suspended.set()
+        # opportunistic publish, RATE-LIMITED to half the loop interval:
+        # it only matters when the background thread is starved (a GIL-
+        # hogging step), and an unconditional per-step file write would
+        # cost hundreds of µs — the disarmed-overhead budget's worth
+        if (not self._suspended.is_set()
+                and time.monotonic() - self._last_pub
+                >= 0.5 * self.interval):
+            self._publish()
+
+
+_default: Dict[str, Optional[Supervisor]] = {"s": None}
+
+
+def get() -> Optional[Supervisor]:
+    """The process's active supervisor (the last one started), if any."""
+    return _default["s"]
+
+
+def tick(step: Optional[int] = None):
+    """Training-loop seam: forward one step tick to the active
+    supervisor.  One dict lookup when none is running."""
+    s = _default["s"]
+    if s is not None:
+        s.beat(step)
+
+
+# --------------------------------------------------------- elastic agent
+def elastic_agent_loop(manager, initial_world: List[int],
+                       stop_event: threading.Event):
+    """The elastic agent's membership loop (node 0) — hoisted out of
+    ``ElasticManager.start`` so the supervisor IS the agent: the same
+    lease-expiry judgement drives both the in-process coordinated abort
+    and the launcher-level rescale/fail decision.  ``decide()`` stays a
+    pure function on the manager for unit tests."""
+    # let every peer's first heartbeat land before judging
+    time.sleep(manager.heartbeat.interval * 2)
+    while not stop_event.wait(manager.interval):
+        known = manager.current_world() or initial_world
+        action, new_world = manager.decide(known, manager.live_peers())
+        if action == "rescale":
+            epoch = manager.publish(new_world)
+            print(f"[elastic] membership {known} -> {new_world}; "
+                  f"epoch {epoch}")
+        elif action == "fail":
+            manager.mark_failed(f"below quorum: live={new_world}, "
+                                f"min={manager.min_nodes}")
+            print(f"[elastic] job below quorum ({new_world}); "
+                  f"marking failed")
+            return
+
+
+# --------------------------------------------------- consensus rewind
+#: manifest steps exchanged per rank (newest first, -1 padded) — fixed
+#: shape so the gather is one cached compiled program
+CONSENSUS_K = 8
+
+
+def consensus_step(local_steps: List[int], rank: Optional[int] = None,
+                   world: Optional[int] = None, k: int = CONSENSUS_K,
+                   kv: Optional[str] = None, job_id: str = "default",
+                   epoch: Optional[int] = None,
+                   timeout: float = 30.0) -> Optional[int]:
+    """The *maximum step completed on every rank* — the split-brain-free
+    resume point.
+
+    Each rank contributes its newest ``k`` manifest steps; the consensus
+    is the largest step present in EVERY rank's set (None when the sets
+    share nothing — resume from scratch rather than diverge).  Transport
+    is one fixed-shape :func:`gather_rows` when the collectives are up;
+    pass ``kv="host:port"`` to exchange through the launch KV master
+    instead (restart paths where no jax world exists yet)."""
+    r, w = _flight.rank_world()
+    rank = int(rank) if rank is not None else r
+    world = int(world) if world is not None else w
+    mine = sorted(set(int(s) for s in local_steps), reverse=True)[:k]
+    if world <= 1:
+        return mine[0] if mine else None
+    if kv is not None:
+        sets = _consensus_kv(kv, rank, world, mine, job_id, epoch, timeout)
+    else:
+        from ..distributed.communication.collective import gather_rows
+        row = np.full(k + 1, -1.0, np.float32)
+        row[0] = float(rank)
+        row[1:1 + len(mine)] = mine
+        mat = gather_rows(row)
+        sets = [set(int(v) for v in mat[i, 1:] if v >= 0)
+                for i in range(mat.shape[0])]
+    common = set.intersection(*sets) if sets else set()
+    return max(common) if common else None
+
+
+def _consensus_kv(master: str, rank: int, world: int, mine: List[int],
+                  job_id: str, epoch: Optional[int],
+                  timeout: float) -> List[set]:
+    """KV-transport manifest exchange: publish under
+    ``/consensus/<job>/e<epoch>/<rank>``, poll until every rank arrived.
+    The epoch scopes the keys so a second restart never reads the first
+    restart's stale manifests."""
+    from ..distributed.launch.kv_server import KVClient
+    if epoch is None:
+        epoch = int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0") or 0)
+    client = KVClient(master)
+    prefix = f"/consensus/{job_id}/e{epoch}"
+    payload = json.dumps(mine)
+    deadline = time.monotonic() + timeout
+    while not client.put(f"{prefix}/{rank}", payload):
+        if time.monotonic() > deadline:
+            raise ConnectionError(
+                f"consensus: cannot reach KV master {master}")
+        time.sleep(0.3)
+    want = {f"{prefix}/{r}" for r in range(world)}
+    while time.monotonic() < deadline:
+        have = client.get_prefix(prefix)
+        if want <= set(have):
+            out = []
+            for r in range(world):
+                try:
+                    out.append(set(int(s)
+                               for s in json.loads(have[f"{prefix}/{r}"])))
+                except (ValueError, KeyError):
+                    out.append(set())
+            return out
+        time.sleep(0.3)
+    missing = sorted(int(k.rsplit("/", 1)[1])
+                     for k in (want - set(client.get_prefix(prefix))))
+    raise TimeoutError(
+        f"consensus: ranks {missing} never published manifests "
+        f"within {timeout}s")
+
+
+def consensus_resume(manager, network=None, optimizer=None, scaler=None,
+                     verify: bool = True, kv: Optional[str] = None,
+                     job_id: str = "default",
+                     timeout: float = 30.0) -> Optional[dict]:
+    """:func:`~.checkpoint_manager.auto_resume` bounded by the cross-rank
+    consensus step.  Single-process worlds degrade to plain auto_resume;
+    the rewind (consensus step → the crashed run's last step) is billed
+    by ``note_resume`` exactly as before — same seam, tighter bound."""
+    from .checkpoint_manager import auto_resume
+    rank, world = _flight.rank_world()
+    max_step = None
+    if world > 1:
+        max_step = consensus_step(manager.steps(), kv=kv, job_id=job_id,
+                                  timeout=timeout)
+        local = manager.steps()
+        newest = local[0] if local else None
+        sys.stderr.write(f"[supervisor] rank {rank}: consensus resume "
+                         f"step={max_step} (local newest {newest})\n")
+    return auto_resume(manager, network=network, optimizer=optimizer,
+                       scaler=scaler, verify=verify, max_step=max_step)
+
+
+# ------------------------------------------------- sentinel remediation
+flags.define_flag(
+    "remediation", False,
+    "Sentinel-driven bounded remediation: compile_storm -> pcc warmup, "
+    "data_stall_regression -> raise prefetch depth, nonfinite_loss -> "
+    "GradScaler backoff. Off: incidents are observed, never acted on.")
+
+#: env var naming a directory for per-incident chrome-trace captures
+INCIDENT_TRACE_ENV = "PADDLE_TPU_INCIDENT_TRACE"
+
+M_REMEDIATIONS = _metrics.counter(
+    "paddle_tpu_fault_remediations_total",
+    "Remediation actions taken by the supervisor, by incident kind and "
+    "action (skipped/rate-limited attempts are not counted).",
+    labelnames=("kind", "action"))
+
+_scaler_ref: Dict[str, object] = {"s": None}
+
+
+def register_scaler(scaler):
+    """Hand the remediation engine the run's GradScaler (the hapi fit
+    path registers the one its ModelCheckpoint callback carries)."""
+    _scaler_ref["s"] = scaler
+
+
+class RemediationEngine:
+    """Bounded, audited incident→action dispatch.
+
+    Sentinel incidents arrive on the sentinel's own lock, so ``submit``
+    only enqueues; a daemon worker runs the action.  Every action is
+    rate-limited per kind (``min_interval_s``), capped per kind
+    (``max_per_kind``), counted in the remediation metric, appended to
+    the ``audit`` list, and — when ``PADDLE_TPU_INCIDENT_TRACE`` names a
+    directory and no profiler session is active — captured as a
+    per-incident chrome trace."""
+
+    #: incident kind -> action name (the registry)
+    ACTIONS = {
+        "compile_storm": "pcc_warmup",
+        "data_stall_regression": "raise_prefetch_depth",
+        "nonfinite_loss": "scaler_backoff",
+    }
+
+    def __init__(self, min_interval_s: float = 30.0,
+                 max_per_kind: int = 8):
+        self.min_interval_s = float(min_interval_s)
+        self.max_per_kind = int(max_per_kind)
+        self.audit: List[dict] = []
+        self._q: "queue.Queue[dict]" = queue.Queue()
+        self._last: Dict[str, float] = {}
+        self._count: Dict[str, int] = {}
+        self._trace_n = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RemediationEngine":
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name="paddle_tpu_remediation")
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def submit(self, incident: dict):
+        """Sentinel observer — called under the sentinel's lock, so this
+        must only enqueue."""
+        if not flags.get_flag("remediation"):
+            return
+        if incident.get("kind") in self.ACTIONS:
+            self._q.put(dict(incident))
+
+    def drain(self, timeout: float = 2.0):
+        """Block until the queue is empty and in-flight work finished
+        (tests)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._q.unfinished_tasks == 0:
+                return
+            time.sleep(0.02)
+
+    # ------------------------------------------------------------ worker
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                inc = self._q.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            try:
+                self._handle(inc)
+            except Exception:
+                pass
+            finally:
+                self._q.task_done()
+
+    def _handle(self, inc: dict):
+        kind = inc["kind"]
+        action = self.ACTIONS[kind]
+        now = time.monotonic()
+        last = self._last.get(kind)
+        entry = {"kind": kind, "action": action,
+                 "step": inc.get("step"), "detail": None, "ok": False,
+                 "t": time.time()}
+        if last is not None and now - last < self.min_interval_s:
+            entry["detail"] = (f"rate-limited (last {action} "
+                              f"{now - last:.1f}s ago < "
+                              f"{self.min_interval_s:g}s)")
+            self.audit.append(entry)
+            return
+        if self._count.get(kind, 0) >= self.max_per_kind:
+            entry["detail"] = (f"suppressed: {kind} already remediated "
+                              f"{self.max_per_kind} times this run")
+            self.audit.append(entry)
+            return
+        self._last[kind] = now
+        self._count[kind] = self._count.get(kind, 0) + 1
+        from ..observability import trace as _trace
+        capture = (not _trace.active()
+                   and bool(os.environ.get(INCIDENT_TRACE_ENV)))
+        if capture:
+            _trace.activate()
+        t0 = time.perf_counter()
+        try:
+            ok, detail = self._run(kind)
+        except Exception as e:
+            ok, detail = False, f"{action} raised {type(e).__name__}: {e}"
+        t1 = time.perf_counter()
+        _trace.add_complete(f"remediation:{action}", "fault", t0, t1,
+                            {"kind": kind, "step": inc.get("step")})
+        if capture:
+            events = _trace.drain()
+            _trace.deactivate()
+            self._persist_trace(kind, action, events)
+        entry["ok"], entry["detail"] = ok, detail
+        self.audit.append(entry)
+        M_REMEDIATIONS.inc(kind=kind, action=action)
+        try:
+            sys.stderr.write(
+                f"[supervisor] remediation {action} for {kind} @ step "
+                f"{inc.get('step')}: {detail}\n")
+        except Exception:
+            pass
+
+    def _run(self, kind: str):
+        if kind == "compile_storm":
+            from ..compile import warmup as _warmup
+            path = _warmup.manifest_path()
+            if not path:
+                return False, "no compile-cache manifest configured"
+            res = _warmup.warm(path)
+            return True, (f"pcc warmup from manifest: "
+                          f"{len(res.get('warmed', []))} warmed, "
+                          f"{len(res.get('skipped', []))} skipped, "
+                          f"{len(res.get('failed', []))} failed")
+        if kind == "data_stall_regression":
+            cur = int(flags.get_flag("prefetch_depth") or 0)
+            if cur >= 8:
+                return False, f"prefetch_depth already {cur} (cap 8)"
+            flags.set_flags({"prefetch_depth": cur + 1})
+            return True, (f"prefetch_depth {cur} -> {cur + 1} "
+                          f"(takes effect at the next prefetcher build)")
+        if kind == "nonfinite_loss":
+            s = _scaler_ref["s"]
+            if s is None:
+                return False, ("no GradScaler registered "
+                               "(hapi skip-step already dropped the "
+                               "poisoned grads)")
+            old = float(getattr(s, "_scale", 0.0) or 0.0)
+            if old <= 1.0:
+                return False, f"loss scale already at floor ({old:g})"
+            new = max(old / 2.0, 1.0)
+            s._scale = new
+            return True, (f"loss-scale backoff {old:g} -> {new:g} "
+                          f"(joins the hapi skip-step path)")
+        return False, f"no action for {kind}"
+
+    def _persist_trace(self, kind: str, action: str, events):
+        base = os.environ.get(INCIDENT_TRACE_ENV)
+        if not base:
+            return
+        try:
+            os.makedirs(base, exist_ok=True)
+            self._trace_n += 1
+            out = [{"name": n, "cat": c, "ph": "X",
+                    "ts": t0 * 1e6, "dur": (t1 - t0) * 1e6,
+                    "pid": os.getpid(), "tid": tid, "args": args or {}}
+                   for (n, c, t0, t1, tid, args) in events]
+            path = os.path.join(
+                base, f"incident-{self._trace_n:03d}-{kind}.trace.json")
+            with open(path, "w") as f:
+                json.dump({"traceEvents": out,
+                           "displayTimeUnit": "ms",
+                           "incident": {"kind": kind, "action": action}},
+                          f)
+        except Exception:
+            pass
+
+
+_engine: Dict[str, Optional[RemediationEngine]] = {"e": None}
+
+
+def remediation_engine() -> Optional[RemediationEngine]:
+    return _engine["e"]
+
+
+def _ensure_engine(min_interval_s: float = 30.0,
+                   max_per_kind: int = 8) -> RemediationEngine:
+    if _engine["e"] is None:
+        from ..observability import sentinel as _sentinel
+        eng = RemediationEngine(min_interval_s=min_interval_s,
+                                max_per_kind=max_per_kind).start()
+        _engine["e"] = eng
+        _sentinel.on_incident(eng.submit)
+    return _engine["e"]
+
+
+def enable_remediation(min_interval_s: float = 30.0,
+                       max_per_kind: int = 8) -> RemediationEngine:
+    """Turn the remediation plane on: starts the worker, registers the
+    sentinel observer, sets ``FLAGS_remediation``.  Idempotent.  The
+    engine is built BEFORE the flag flips: the flag observer runs under
+    the flags registry lock and must not call back into set_flags."""
+    eng = _ensure_engine(min_interval_s=min_interval_s,
+                         max_per_kind=max_per_kind)
+    if not flags.get_flag("remediation"):
+        flags.set_flags({"remediation": True})
+    return eng
+
+
+def disable_remediation():
+    if flags.get_flag("remediation"):
+        flags.set_flags({"remediation": False})
+    eng = _engine["e"]
+    if eng is not None:
+        try:
+            from ..observability import sentinel as _sentinel
+            _sentinel.remove_incident_observer(eng.submit)
+        except Exception:
+            pass
+        eng.stop()
+        _engine["e"] = None
+
+
+def _remediation_flag_changed(v):
+    # runs under the flags registry lock — build the engine, never call
+    # back into set_flags from here
+    if v:
+        _ensure_engine()
+
+
+flags.on_change("remediation", _remediation_flag_changed)
+if flags.get_flag("remediation"):
+    _ensure_engine()

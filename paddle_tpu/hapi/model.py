@@ -196,6 +196,7 @@ class Model:
                    cbks, verbose, log_freq):
         """One epoch's step loop over ``batches`` (a DevicePrefetcher or
         the raw loader)."""
+        from ..fault import supervisor as _fault_sup
         from ..observability import goodput as _goodput
         from ..observability import sentinel as _sentinel
         led = _goodput.ledger()
@@ -203,6 +204,10 @@ class Model:
         for step, batch in enumerate(batches):
             if epoch == start_epoch and step < skip_steps:
                 continue   # step-granular resume: already trained
+            # heartbeat seam: publishes this rank's lease + fires the
+            # rank.crash/hang drills; one dict lookup when no
+            # supervisor is running
+            _fault_sup.tick(self._global_step)
             led.step_begin()
             cbks.on_train_batch_begin(step)
             batch = _to_list(batch)
@@ -223,13 +228,30 @@ class Model:
 
     def _auto_resume(self, manager, callbacks, verbose):
         """Restore train state from ``manager`` and translate its meta
-        into (start_epoch, steps-to-skip in that epoch)."""
+        into (start_epoch, steps-to-skip in that epoch).  Multi-process
+        worlds resume through the supervisor's consensus rewind: ranks
+        exchange checkpoint manifests and every rank restores the newest
+        step completed on ALL of them (a rank that saved further ahead
+        rewinds rather than split-brain the fleet)."""
         from ..fault import auto_resume
+        from ..fault import supervisor as _fault_sup
+        from ..observability import flight as _flight
         scaler = None
         for c in callbacks:
             scaler = getattr(c, "scaler", None) or scaler
-        meta = auto_resume(manager, network=self.network,
-                           optimizer=self._optimizer, scaler=scaler)
+        if scaler is not None:
+            _fault_sup.register_scaler(scaler)
+        try:
+            world = _flight.rank_world()[1]
+        except Exception:
+            world = 1
+        if world > 1:
+            meta = _fault_sup.consensus_resume(
+                manager, network=self.network,
+                optimizer=self._optimizer, scaler=scaler)
+        else:
+            meta = auto_resume(manager, network=self.network,
+                               optimizer=self._optimizer, scaler=scaler)
         if meta is None:
             return 0, 0
         self._global_step = int(meta.get("step", 0))

@@ -25,7 +25,10 @@ fleet-executor, PAPER.md §fleet-executor):
   ``paddle_tpu_fleet_straggler_score{rank=}``, slowest-rank /
   step-skew gauges, and a once-per-window stderr warning naming the
   straggler and its dominant attribution bucket. The ``fleet.slow_step``
-  fault point makes the detector drillable deterministically.
+  fault point makes the detector drillable deterministically. The same
+  windowed gather also folds each rank's live goodput fraction into the
+  row, so ``paddle_tpu_goodput_job_fraction`` (min over ranks) is a live
+  job-level number, not a post-mortem merge.
 
 Un-instrumented host time (a sleeping or swapping rank) shows up in the
 ``idle`` bucket — attribution covers what the spans cover.
@@ -109,6 +112,11 @@ _m_clock_off = _metrics.gauge(
     "paddle_tpu_fleet_clock_offset_seconds",
     "Per-rank perf_counter offset vs rank 0 from the last clock_sync "
     "handshake.", labelnames=("rank",))
+_m_goodput_job = _metrics.gauge(
+    "paddle_tpu_goodput_job_fraction",
+    "Job-level goodput: MINIMUM live goodput fraction over all ranks in "
+    "the last beacon window (the job is only as productive as its worst "
+    "rank).")
 
 
 # --------------------------------------------------------------------------
@@ -304,7 +312,10 @@ def skew_stats(matrix, threshold: float = None) -> dict:
 
     ``matrix`` rows are ``[rank, steps, mean_step_s, max_step_s,
     compute_frac, collective_frac, host_frac, idle_frac]`` (one per
-    rank; ndarray or nested lists). Pure function — unit-testable
+    rank; ndarray or nested lists), optionally extended with a 9th
+    column: the rank's live goodput fraction (−1 when its ledger is
+    cold) — the job-level goodput is the MINIMUM over ranks that
+    reported one. Pure function — unit-testable
     without processes. Plain-Python math on purpose: rows are
     fleet-sized (≤ dozens) and this runs cache-cold inside training
     loops, where numpy's dispatch machinery alone would dominate."""
@@ -320,7 +331,9 @@ def skew_stats(matrix, threshold: float = None) -> dict:
     i = max(range(n), key=lambda k: means[k])
     buckets = rows[i][4:8]
     dominant = BUCKETS[max(range(4), key=lambda k: buckets[k])]
+    fracs = [r[8] for r in rows if len(r) > 8 and r[8] >= 0.0]
     return {
+        "job_goodput_fraction": (min(fracs) if fracs else None),
         "median_step_s": med,
         "scores": {int(rows[r][0]): scores[r] for r in range(n)},
         "slowest_rank": int(rows[i][0]),
@@ -483,8 +496,18 @@ class FleetBeacon:
     def _flush(self):
         rank, world = _rank_world()
         mean = self._sum / max(self._n, 1)
+        # col 8: this rank's live goodput fraction (−1 = ledger cold);
+        # one snapshot per window, amortised against the gather it rides
+        gp = -1.0
+        try:
+            from . import goodput as _goodput
+            led = _goodput.ledger()
+            if led.running():
+                gp = float(led.snapshot()["goodput_fraction"])
+        except Exception:
+            pass
         row = [float(rank), float(self._n), mean, self._max,
-               *self._attr]
+               *self._attr, gp]
         if world > 1:
             from ..distributed.communication import collective as C
             tg0 = time.perf_counter()
@@ -527,6 +550,8 @@ class FleetBeacon:
                 _m_straggler.set(s, rank=r)
             _m_slowest.set(stats["slowest_rank"])
             _m_skew.set(stats["skew"])
+            if stats.get("job_goodput_fraction") is not None:
+                _m_goodput_job.set(stats["job_goodput_fraction"])
         if stats["is_straggler"]:
             if self.first_flagged_window is None:
                 self.first_flagged_window = self.windows

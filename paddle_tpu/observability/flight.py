@@ -99,6 +99,15 @@ class FlightRecorder:
         if rec is not None:
             rec["t1"] = time.perf_counter()
 
+    def open_entries(self) -> List[dict]:
+        """Live in-flight records (t1 still None and not abandoned by an
+        abort path) — the collective-timeout monitor's scan surface. The
+        returned dicts are the LIVE ring entries, not copies: ``t0``/``t1``
+        reads stay coherent because ``end`` only ever stamps ``t1``."""
+        with self._lock:
+            return [r for r in self._ring
+                    if r.get("t1") is None and "raised" not in r]
+
     def tail(self, n: int = 0) -> List[dict]:
         """Newest ``n`` records (all when n<=0) without clearing; dtypes
         are stringified here (JSON-able copies)."""
@@ -200,7 +209,7 @@ def _last_seq(entries: List[dict], group: int) -> int:
     return max(seqs) if seqs else -1
 
 
-def diff_ranks(dumps: Dict[int, dict]) -> dict:
+def diff_ranks(dumps: Dict[int, dict], world: Optional[int] = None) -> dict:
     """Cross-rank diff of flight dumps — the desync/stall verdict.
 
     Returns ``{"status", "rank", "seq", "op", "detail", "per_rank"}``:
@@ -213,9 +222,18 @@ def diff_ranks(dumps: Dict[int, dict]) -> dict:
       in an entry its peers completed;
     * ``ok`` — tails agree over the comparable window.
 
+    With ``world`` given, ranks with NO dump at all are treated as having
+    issued nothing (last seq -1): a SIGKILLed peer leaves no file, and
+    that absence is itself the verdict — the missing rank is named by the
+    stall path instead of being silently excluded from the comparison.
+
     The ring is bounded, so only the overlapping seq window is compared;
     that is exactly the window a hang diagnosis needs (the tail).
     """
+    if world is not None:
+        dumps = dict(dumps)
+        for r in range(world):
+            dumps.setdefault(r, {"entries": []})
     if not dumps:
         return {"status": "ok", "detail": "no dumps to compare",
                 "per_rank": {}}

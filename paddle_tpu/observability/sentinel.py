@@ -36,7 +36,8 @@ from typing import Deque, Dict, List, Optional
 from ..core import flags
 from . import metrics as _metrics
 
-__all__ = ["AnomalySentinel", "get", "reset", "INCIDENT_KINDS"]
+__all__ = ["AnomalySentinel", "get", "reset", "INCIDENT_KINDS",
+           "on_incident", "remove_incident_observer"]
 
 flags.define_flag(
     "sentinel", True,
@@ -53,6 +54,26 @@ INCIDENT_KINDS = ("step_time_spike", "step_time_drift", "compile_storm",
 M_INCIDENTS = _metrics.counter(
     "paddle_tpu_sentinel_incidents_total",
     "Anomaly incidents fired, by kind.", labelnames=("kind",))
+
+#: incident observers (fault.supervisor's remediation engine registers
+#: here).  Called from ``_fire`` UNDER the sentinel's lock — an observer
+#: must only enqueue, never act inline.
+_OBSERVERS: List = []
+
+
+def on_incident(fn):
+    """Register ``fn(incident_dict)`` to be called on every fired
+    incident (after the cooldown filter).  Runs under the sentinel's
+    lock: observers must be non-blocking (enqueue and return)."""
+    if fn not in _OBSERVERS:
+        _OBSERVERS.append(fn)
+
+
+def remove_incident_observer(fn):
+    try:
+        _OBSERVERS.remove(fn)
+    except ValueError:
+        pass
 
 #: MAD multiplier for the spike envelope (1.4826 scales MAD to sigma
 #: under normality; 8 sigma keeps benign jitter quiet)
@@ -243,6 +264,11 @@ class AnomalySentinel:
                   f"{detail}{dom}", file=stream)
         except Exception:
             pass
+        for fn in list(_OBSERVERS):
+            try:
+                fn(dict(incident))
+            except Exception:
+                pass   # an observer bug must never mask the incident
 
     # -- reporting ---------------------------------------------------------
     def incidents(self, n: Optional[int] = None) -> List[dict]:

@@ -207,7 +207,7 @@ def _value_sync(x) -> None:
     import jax
     import jax.numpy as jnp
     try:
-        float(jnp.sum(x))
+        float(jnp.sum(x))  # tpulint: disable=TPU103 — deliberate host sync: _value_sync exists to force device completion for timing
     except TypeError:
         jax.block_until_ready(x)
 

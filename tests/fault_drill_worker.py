@@ -1,0 +1,194 @@
+"""Self-healing-fleet drill worker — the real 4-process fault matrix.
+
+Runs under ``python -m paddle_tpu.distributed.launch`` like
+fleet_drill_worker.py.  One deterministic mode per launch:
+
+``crash``  — every rank trains a closed-form SGD loop (deterministic
+  per-rank gradients, one eager ``all_reduce`` per step, per-step
+  checkpoints into per-rank CheckpointManager dirs; rank 1 stops saving
+  after step 3 to split the manifests).  At elastic epoch 0 the target
+  rank SIGKILLs itself at step 6 (``rank.crash_at_step``); the survivors
+  block in the step-6 all_reduce until the collective-timeout abort
+  plane fires, exchanges flight dumps, names the dead rank (it left no
+  dump — absence is the evidence) and exits
+  ``EXIT_COLLECTIVE_TIMEOUT``.  The launcher group-restarts; at epoch 1
+  every rank resumes from the CROSS-RANK CONSENSUS step (3 — the newest
+  step on every manifest), recomputes, bills the recomputed steps to the
+  goodput ``rewind`` bucket, finishes step 10 and writes its final
+  weights + ledger evidence to ``fault.r<rank>.json``.
+
+``hang``   — the target rank wedges at step 4 (``rank.hang_at_step``)
+  WITHOUT touching its lease (the supervisor thread keeps publishing —
+  a wedged host looks alive to the heartbeat plane on purpose).  Only
+  the collective-timeout plane can catch it: survivors abort 117 with a
+  diff verdict naming the hung rank + the collective seq it never
+  issued.
+
+``lease``  — the target rank stops publishing its lease at step 4
+  (``heartbeat.lease_lost``) but keeps stepping: a partition, not a
+  death, invisible to the collective plane (its collectives still
+  complete).  Only the heartbeat plane fires: every rank (including the
+  partitioned one, which sees its OWN lease expired) exits
+  ``EXIT_HEARTBEAT_LOST``.
+
+Usage: fault_drill_worker.py <mode> <outdir>
+"""
+import json
+import os
+import signal
+import sys
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+MODE = sys.argv[1]
+OUTDIR = sys.argv[2]
+TARGET = int(os.environ.get("DRILL_TARGET_RANK", "3"))
+EPOCH = int(os.environ.get("PADDLE_ELASTIC_EPOCH", "0") or 0)
+
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.communication import collective as C  # noqa: E402
+from paddle_tpu.fault import CheckpointManager, inject  # noqa: E402
+from paddle_tpu.fault import capture_train_state  # noqa: E402
+from paddle_tpu.fault import supervisor as sup  # noqa: E402
+from paddle_tpu.observability import flight, goodput  # noqa: E402
+
+dist.init_parallel_env()
+rank = jax.process_index()
+world = jax.process_count()
+assert world == 4, f"drill expects 4 processes, got {world}"
+
+# the launcher's reap path (SIGTERM after the abort grace) must still
+# leave the flight record behind — and must never orphan this process
+signal.signal(signal.SIGTERM,
+              lambda *_: (flight.dump(reason="sigterm"), os._exit(1)))
+
+D = 4
+LR = 0.1
+STEPS = 10
+
+
+def grad(r: int, s: int) -> np.ndarray:
+    """Deterministic per-rank, per-step gradient — the closed form the
+    harness recomputes to check crash+rewind == uninterrupted."""
+    base = np.arange(1, D + 1, dtype=np.float32)
+    return base * (r + 1) * 0.001 * ((s % 5) + 1)
+
+
+class _Net:
+    """Minimal state_dict carrier so the drill exercises the REAL
+    capture_train_state / consensus_resume path."""
+
+    def __init__(self):
+        self.w = np.zeros(D, np.float32)
+
+    def state_dict(self):
+        return {"w": self.w.copy()}
+
+    def set_state_dict(self, sd):
+        self.w = np.asarray(sd["w"], np.float32).copy()
+
+
+def train_step(net: _Net, s: int) -> float:
+    # gather_rows is the per-rank-different-payload collective (host
+    # all_reduce replicates via device_put, which requires identical
+    # values on every process); it blocks if a peer is gone
+    mat = C.gather_rows(grad(rank, s))
+    net.w = (net.w - LR * mat.mean(axis=0)).astype(np.float32)
+    return float(np.sum(net.w ** 2))
+
+
+# ---------------------------------------------------------------- modes
+if MODE == "crash":
+    ttl = 30.0                           # heartbeat plane stays silent:
+    #                                      the collective plane owns this
+elif MODE == "hang":
+    ttl = 60.0
+else:
+    assert MODE == "lease", MODE
+    ttl = 1.0
+
+lease = sup.FileLease(os.path.join(OUTDIR, "leases"), ttl=ttl)
+svr = sup.Supervisor(lease, interval=0.25).start()
+C.barrier()          # every rank has published before anyone judges
+
+if MODE in ("crash", "hang"):
+    # arm the collective-timeout plane only AFTER the startup barrier:
+    # process launch is staggered by seconds of jax import, so a drill-
+    # tight 2 s deadline would fire on the barrier itself (production
+    # deadlines are minutes and don't care).  The monitor thread tracks
+    # the flag live — no restart needed.
+    from paddle_tpu.core import flags
+    flags.set_flags({"collective_timeout_s": 2.0})
+
+if MODE == "crash":
+    mgr = CheckpointManager(os.path.join(OUTDIR, "ckpt", f"r{rank}"),
+                            keep_n=3)
+    net = _Net()
+    led = goodput.ledger()
+    led.run_begin()
+    if EPOCH == 0 and rank == TARGET:
+        inject.arm("rank.crash_at_step", step=6)
+    start_step = 0
+    walls = []
+    if EPOCH > 0:
+        meta = sup.consensus_resume(mgr, network=net)
+        assert meta is not None, "epoch 1 found nothing to resume from"
+        start_step = int(meta["step"])
+        print(f"[drill] rank {rank} epoch {EPOCH}: resumed step "
+              f"{start_step}", flush=True)
+    for s in range(start_step + 1, STEPS + 1):
+        sup.tick(s)                      # fires the crash on the target
+        t0 = time.perf_counter()
+        led.step_begin()
+        loss = train_step(net, s)
+        led.step_end(step=s)
+        walls.append(time.perf_counter() - t0)
+        if not (rank == 1 and s > 3):    # rank 1 splits the manifests
+            mgr.save(capture_train_state(network=net), step=s)
+    assert EPOCH > 0, "epoch 0 must die before finishing the loop"
+    snap = led.snapshot()
+    rewind_steps = int(snap["rewind_steps"])
+    with open(os.path.join(OUTDIR, f"fault.r{rank}.json"), "w") as f:
+        json.dump({
+            "rank": rank, "epoch": EPOCH,
+            "resume_step": start_step,
+            "final_w": [float(v) for v in net.w],
+            "final_loss": loss,
+            "manifest_steps": mgr.steps(),
+            "rewind_steps": rewind_steps,
+            "rewind_s": snap["buckets"]["rewind"],
+            "measured_recompute_s": sum(walls[:rewind_steps]),
+            "resumes": snap["resumes"],
+        }, f)
+    print(f"[drill] rank {rank} crash-drill complete: final loss "
+          f"{loss:.6f}, rewind {rewind_steps} steps", flush=True)
+    svr.stop()
+    sys.exit(0)
+
+if MODE == "hang":
+    if rank == TARGET:
+        inject.arm("rank.hang_at_step", step=4)
+    net = _Net()
+    for s in range(1, 40):
+        sup.tick(s)                      # target wedges here at step 4;
+        train_step(net, s)               # peers block in this all_reduce
+    print(f"[drill] rank {rank} ERROR: hang drill finished the loop",
+          flush=True)
+    sys.exit(7)
+
+# MODE == "lease"
+if rank == TARGET:
+    inject.arm("heartbeat.lease_lost", step=4)
+net = _Net()
+for s in range(1, 200):
+    sup.tick(s)                          # target goes silent at step 4
+    train_step(net, s)                   # ...but KEEPS stepping: the
+    time.sleep(0.05)                     # collective plane sees nothing
+print(f"[drill] rank {rank} ERROR: lease drill finished the loop",
+      flush=True)
+sys.exit(7)

@@ -1,0 +1,385 @@
+"""In-process units for the self-healing fleet supervisor
+(paddle_tpu/fault/supervisor.py).
+
+The real 4-process drills live in test_multiproc_train.py
+(fault_drill_worker.py); these units pin the pieces those drills
+compose: the exit-code taxonomy the elastic agent keys restarts off,
+lease staleness judgement, cross-rank consensus (both transports),
+the collective-timeout monitor's arm/disarm lifecycle and verdict
+path, bounded sentinel remediation, and the consensus-bounded
+checkpoint restore.
+"""
+import json
+import os
+import threading
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from paddle_tpu.core import flags  # noqa: E402
+from paddle_tpu.fault import CheckpointManager  # noqa: E402
+from paddle_tpu.fault import capture_train_state  # noqa: E402
+from paddle_tpu.fault import supervisor as sup  # noqa: E402
+from paddle_tpu.fault.checkpoint_manager import auto_resume  # noqa: E402
+from paddle_tpu.observability import flight  # noqa: E402
+
+
+class _Net:
+    def __init__(self):
+        self.w = np.zeros(3, np.float32)
+
+    def state_dict(self):
+        return {"w": self.w.copy()}
+
+    def set_state_dict(self, sd):
+        self.w = np.asarray(sd["w"], np.float32).copy()
+
+
+# ------------------------------------------------------------ exit codes
+def test_exit_code_taxonomy():
+    """The elastic agent's restart decision table: supervisor fault
+    codes and signal deaths spend a restart; config errors never do."""
+    for code in (sup.EXIT_COLLECTIVE_TIMEOUT, sup.EXIT_HEARTBEAT_LOST,
+                 sup.EXIT_DESYNC, sup.EXIT_WATCHDOG_HANG):
+        assert sup.restart_worthy(code), code
+    assert sup.restart_worthy(-9)        # SIGKILL (OOM killer, preempt)
+    assert sup.restart_worthy(1)         # generic crash
+    assert not sup.restart_worthy(sup.EXIT_CONFIG)
+    assert not sup.restart_worthy(2)     # argparse usage error
+    assert not sup.restart_worthy(0)
+    assert not sup.restart_worthy(None)
+
+    assert "SIGKILL" in sup.describe_exit(-9)
+    assert "COLLECTIVE_TIMEOUT" in sup.describe_exit(117)
+    assert "HEARTBEAT_LOST" in sup.describe_exit(118)
+    assert "CONFIG" in sup.describe_exit(113)
+    assert sup.describe_exit(None) == "running"
+    # the five codes must be distinct and outside the shell's common set
+    codes = [sup.EXIT_CONFIG, sup.EXIT_COLLECTIVE_TIMEOUT,
+             sup.EXIT_HEARTBEAT_LOST, sup.EXIT_DESYNC,
+             sup.EXIT_WATCHDOG_HANG]
+    assert len(set(codes)) == 5
+    assert all(2 < c < 126 for c in codes)
+
+
+# ----------------------------------------------------------- file lease
+def test_file_lease_staleness_is_freshest_relative(tmp_path):
+    """A rank is dead only when it lags the FRESHEST stamp by ttl — a
+    slow observer cannot fake everyone else's death."""
+    d = str(tmp_path)
+    lease = sup.FileLease(d, rank=0, world=3, ttl=1.0)
+    lease.publish()
+    now = time.time()
+    # rank 1: fresh; rank 2: 5 s behind the freshest stamp -> dead
+    for r, ts in ((1, now), (2, now - 5.0)):
+        with open(os.path.join(d, f"lease.r{r}"), "w") as f:
+            f.write(repr(ts))
+    assert lease.dead_ranks() == [2]
+    # everyone equally old -> nobody dead (the job is just slow)
+    for r in range(3):
+        with open(os.path.join(d, f"lease.r{r}"), "w") as f:
+            f.write(repr(now - 100.0))
+    assert lease.dead_ranks() == []
+
+
+def test_supervisor_detects_dead_rank(tmp_path):
+    """The in-process loop notices an expired peer lease and fires the
+    on_dead callback (exit_on_dead off so the test survives)."""
+    d = str(tmp_path)
+    seen = []
+    lease = sup.FileLease(d, rank=0, world=2, ttl=0.4)
+    s = sup.Supervisor(lease, interval=0.1, on_dead=seen.append,
+                       exit_on_dead=False)
+    # peer published once, then went silent
+    with open(os.path.join(d, "lease.r1"), "w") as f:
+        f.write(repr(time.time()))
+    s.start()
+    try:
+        assert sup.get() is s
+        deadline = time.monotonic() + 5.0
+        while not seen and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert seen and seen[0] == [1], seen
+        assert s.dead == [1]
+    finally:
+        s.stop()
+    assert sup.get() is None
+
+
+def test_supervisor_detects_own_lease_loss(tmp_path, capsys):
+    """The PARTITIONED side: our own stamp is the stale one (peers look
+    fresh), so the abort message says so and the exit code is still the
+    coordinated EXIT_HEARTBEAT_LOST."""
+    d = str(tmp_path)
+    lease = sup.FileLease(d, rank=0, world=2, ttl=0.5)
+    with open(os.path.join(d, "lease.r0"), "w") as f:
+        f.write(repr(time.time() - 60.0))
+    with open(os.path.join(d, "lease.r1"), "w") as f:
+        f.write(repr(time.time()))
+    assert lease.dead_ranks() == [0]
+    codes = []
+    old = sup._exit["fn"]
+    sup._exit["fn"] = codes.append
+    try:
+        s = sup.Supervisor(lease, interval=0.1)
+        s._handle_dead(lease.dead_ranks())
+    finally:
+        sup._exit["fn"] = old
+    assert codes == [sup.EXIT_HEARTBEAT_LOST]
+    err = capsys.readouterr().err
+    assert "including OWN lease (partitioned)" in err
+    assert "aborting coordinated" in err
+
+
+# ------------------------------------------------------------- consensus
+def test_consensus_step_single_world():
+    assert sup.consensus_step([3, 5, 1], rank=0, world=1) == 5
+    assert sup.consensus_step([], rank=0, world=1) is None
+
+
+def test_consensus_step_kv_transport():
+    """Two 'ranks' (threads) exchange split manifests through a live KV
+    master: rank 0 saved {1..5}, rank 1 stalled at {1,2,3} -> the
+    consensus is 3, the newest step present on EVERY rank."""
+    from paddle_tpu.distributed.launch.kv_server import KVServer
+    srv = KVServer(0, host="127.0.0.1").start()
+    try:
+        master = f"127.0.0.1:{srv.port}"
+        results = {}
+
+        def run(rank, steps):
+            results[rank] = sup.consensus_step(
+                steps, rank=rank, world=2, kv=master, epoch=7,
+                timeout=10.0)
+
+        t0 = threading.Thread(target=run, args=(0, [1, 2, 3, 4, 5]))
+        t1 = threading.Thread(target=run, args=(1, [3, 2, 1]))
+        t0.start(); t1.start(); t0.join(10); t1.join(10)
+        assert results == {0: 3, 1: 3}
+
+        # disjoint manifests -> None (resume from scratch, not diverge)
+        def run2(rank, steps):
+            results[rank] = sup.consensus_step(
+                steps, rank=rank, world=2, kv=master, epoch=8,
+                timeout=10.0)
+
+        t0 = threading.Thread(target=run2, args=(0, [4, 5]))
+        t1 = threading.Thread(target=run2, args=(1, [1, 2]))
+        t0.start(); t1.start(); t0.join(10); t1.join(10)
+        assert results == {0: None, 1: None}
+    finally:
+        srv.stop()
+
+
+def test_consensus_kv_times_out_on_missing_rank():
+    from paddle_tpu.distributed.launch.kv_server import KVServer
+    srv = KVServer(0, host="127.0.0.1").start()
+    try:
+        with pytest.raises(TimeoutError, match=r"ranks \[1\] never"):
+            sup.consensus_step([1, 2], rank=0, world=2,
+                               kv=f"127.0.0.1:{srv.port}", epoch=9,
+                               timeout=1.5)
+    finally:
+        srv.stop()
+
+
+def test_checkpoint_restore_bounded_by_consensus(tmp_path):
+    """max_step filters the candidate walk: newer-than-consensus
+    checkpoints are skipped unilaterally (they exist on this rank but
+    not on every rank), not burned as corrupt."""
+    net = _Net()
+    mgr = CheckpointManager(str(tmp_path), keep_n=5)
+    for s in range(1, 5):
+        net.w[:] = float(s)
+        mgr.save(capture_train_state(network=net), step=s)
+    assert mgr.steps() == [4, 3, 2, 1]
+
+    net.w[:] = -1.0
+    meta = auto_resume(mgr, network=net, max_step=2)
+    assert meta is not None and meta["step"] == 2
+    np.testing.assert_allclose(net.w, 2.0)
+    # unbounded resume still takes the newest
+    meta = auto_resume(mgr, network=net)
+    assert meta["step"] == 4
+    np.testing.assert_allclose(net.w, 4.0)
+
+
+def test_consensus_resume_single_process(tmp_path):
+    """world==1 degrades to plain auto_resume (no exchange)."""
+    net = _Net()
+    mgr = CheckpointManager(str(tmp_path), keep_n=3)
+    net.w[:] = 7.0
+    mgr.save(capture_train_state(network=net), step=7)
+    net.w[:] = 0.0
+    meta = sup.consensus_resume(mgr, network=net)
+    assert meta["step"] == 7
+    np.testing.assert_allclose(net.w, 7.0)
+
+
+# ------------------------------------------- collective-timeout monitor
+def test_monitor_thread_tracks_flag():
+    """Disarmed = NO thread (the zero-cost claim is structural); arming
+    the flag starts it, disarming joins it."""
+    assert float(flags.get_flag("collective_timeout_s") or 0.0) == 0.0
+    assert sup._monitor["thread"] is None
+    flags.set_flags({"collective_timeout_s": 5.0})
+    try:
+        th = sup._monitor["thread"]
+        assert th is not None and th.is_alive()
+    finally:
+        flags.set_flags({"collective_timeout_s": 0.0})
+    deadline = time.monotonic() + 3.0
+    while sup._monitor["thread"] is not None \
+            and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert sup._monitor["thread"] is None
+
+
+def test_abort_on_timeout_verdict_and_exit(capsys):
+    """The abort path names the overdue collective and exits 117
+    (world==1 here, so no dump exchange — the multi-process naming is
+    the hang drill's job)."""
+    codes = []
+    old = sup._exit["fn"]
+    sup._exit["fn"] = codes.append
+    try:
+        rec = {"seq": 42, "op": "all_reduce", "group": 0,
+               "shape": (4,), "dtype": "float32", "bytes": 16,
+               "t0": time.perf_counter() - 3.0, "t1": None}
+        sup._abort_on_timeout(rec, age=3.0, timeout_s=2.0)
+    finally:
+        sup._exit["fn"] = old
+    assert codes == [sup.EXIT_COLLECTIVE_TIMEOUT]
+    err = capsys.readouterr().err
+    assert "collective seq=42 op=all_reduce" in err
+    assert "FLAGS_collective_timeout_s=2" in err
+
+
+def test_diff_ranks_names_missing_rank():
+    """world= pads absent dumps with empty rings: a SIGKILLed rank that
+    never wrote a dump is named by its ABSENCE."""
+    ent = {"seq": 3, "op": "all_reduce", "group": 0, "shape": (4,),
+           "dtype": "float32", "bytes": 16, "t0": 0.0, "t1": None}
+    dumps = {0: {"entries": [ent]}}
+    v = flight.diff_ranks(dumps, world=2)
+    assert v["status"] == "stall" and v["rank"] == 1 and v["seq"] == 3
+    assert "rank 1 never issued seq 3" in v["detail"]
+
+
+# ---------------------------------------------------------- remediation
+@pytest.fixture
+def _engine():
+    eng = sup.RemediationEngine(min_interval_s=0.0, max_per_kind=8)
+    eng.start()
+    old_flag = bool(flags.get_flag("remediation"))
+    flags.set_flags({"remediation": True})
+    try:
+        yield eng
+    finally:
+        flags.set_flags({"remediation": old_flag})
+        eng.stop()
+        sup.register_scaler(None)
+
+
+def test_remediation_prefetch_depth(_engine):
+    old = int(flags.get_flag("prefetch_depth") or 0)
+    try:
+        _engine.submit({"kind": "data_stall_regression", "step": 10})
+        _engine.drain()
+        assert int(flags.get_flag("prefetch_depth")) == old + 1
+        entry = _engine.audit[-1]
+        assert entry["ok"] and entry["action"] == "raise_prefetch_depth"
+        assert f"prefetch_depth {old} -> {old + 1}" in entry["detail"]
+    finally:
+        flags.set_flags({"prefetch_depth": old})
+
+
+def test_remediation_scaler_backoff(_engine):
+    class _Scaler:
+        _scale = 8.0
+
+    s = _Scaler()
+    sup.register_scaler(s)
+    _engine.submit({"kind": "nonfinite_loss", "step": 3})
+    _engine.drain()
+    assert s._scale == 4.0
+    assert "loss-scale backoff 8 -> 4" in _engine.audit[-1]["detail"]
+    # at the floor the action reports failure rather than going below 1
+    s._scale = 1.0
+    _engine.submit({"kind": "nonfinite_loss", "step": 4})
+    _engine.drain()
+    assert s._scale == 1.0
+    assert not _engine.audit[-1]["ok"]
+    assert "floor" in _engine.audit[-1]["detail"]
+
+
+def test_remediation_rate_limit_and_cap():
+    eng = sup.RemediationEngine(min_interval_s=3600.0, max_per_kind=8)
+    eng.start()
+    old_flag = bool(flags.get_flag("remediation"))
+    flags.set_flags({"remediation": True})
+
+    class _Scaler:
+        _scale = 16.0
+
+    s = _Scaler()
+    sup.register_scaler(s)
+    try:
+        eng.submit({"kind": "nonfinite_loss", "step": 1})
+        eng.submit({"kind": "nonfinite_loss", "step": 2})
+        eng.drain()
+        assert s._scale == 8.0            # exactly one backoff landed
+        assert len(eng.audit) == 2
+        assert eng.audit[0]["ok"]
+        assert "rate-limited" in eng.audit[1]["detail"]
+        # unknown kinds never enqueue; flag off drops at the gate
+        eng.submit({"kind": "not_a_kind", "step": 3})
+        flags.set_flags({"remediation": False})
+        eng.submit({"kind": "nonfinite_loss", "step": 4})
+        eng.drain()
+        assert len(eng.audit) == 2
+    finally:
+        flags.set_flags({"remediation": old_flag})
+        eng.stop()
+        sup.register_scaler(None)
+
+
+def test_remediation_incident_trace_capture(tmp_path, _engine,
+                                            monkeypatch):
+    monkeypatch.setenv(sup.INCIDENT_TRACE_ENV, str(tmp_path))
+    old = int(flags.get_flag("prefetch_depth") or 0)
+    try:
+        _engine.submit({"kind": "data_stall_regression", "step": 5})
+        _engine.drain()
+    finally:
+        flags.set_flags({"prefetch_depth": old})
+    traces = [f for f in os.listdir(str(tmp_path))
+              if f.endswith(".trace.json")]
+    assert len(traces) == 1, traces
+    with open(os.path.join(str(tmp_path), traces[0])) as f:
+        doc = json.load(f)
+    assert doc["incident"] == {"kind": "data_stall_regression",
+                               "action": "raise_prefetch_depth"}
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert "remediation:raise_prefetch_depth" in names
+
+
+def test_enable_disable_remediation_lifecycle():
+    # earlier flag flips may have built the global engine via the
+    # on_change observer — start from a clean slate
+    sup.disable_remediation()
+    assert sup.remediation_engine() is None
+    eng = sup.enable_remediation(min_interval_s=0.0)
+    try:
+        assert sup.remediation_engine() is eng
+        assert flags.get_flag("remediation")
+        assert sup.enable_remediation() is eng     # idempotent
+    finally:
+        sup.disable_remediation()
+    assert sup.remediation_engine() is None
+    assert not flags.get_flag("remediation")

@@ -37,6 +37,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "dist_train_worker.py")
 DRILL_WORKER = os.path.join(REPO, "tests", "fleet_drill_worker.py")
 CROSSRANK_WORKER = os.path.join(REPO, "tests", "crossrank_drill_worker.py")
+FAULT_WORKER = os.path.join(REPO, "tests", "fault_drill_worker.py")
 
 
 def _clean_env():
@@ -273,10 +274,155 @@ def test_crossrank_program_diff_drill(tmp_path):
     assert "all ranks agree" in lint.stdout, lint.stdout
 
 
+# ---------------------------------------------------------------------------
+# Self-healing fleet: the fault-drill matrix (tests/fault_drill_worker.py)
+# ---------------------------------------------------------------------------
+def _assert_no_drill_orphans(out):
+    """Every drill must end with ALL ranks terminal — a wedged worker
+    surviving its launcher is exactly the failure mode the abort plane
+    exists to prevent."""
+    import glob
+    import time as _time
+
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline:
+        alive = []
+        for p in glob.glob("/proc/[0-9]*/cmdline"):
+            try:
+                with open(p, "rb") as f:
+                    cmd = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            if "fault_drill_worker.py" in cmd:
+                alive.append(p)
+        if not alive:
+            return
+        _time.sleep(0.5)
+    raise AssertionError(f"orphaned drill workers: {alive}\n{out}")
+
+
+def _run_fault_drill(tmp_path, mode, target, extra_env=None,
+                     max_restarts=0):
+    port = _free_port_pair()
+    env = _clean_env()
+    env["PADDLE_TPU_FLIGHT_RECORD"] = os.path.join(str(tmp_path),
+                                                   "flight.json")
+    env["PADDLE_TPU_GOODPUT"] = os.path.join(str(tmp_path), "goodput.json")
+    env["DRILL_TARGET_RANK"] = str(target)
+    env.update(extra_env or {})
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "4", "--master", f"127.0.0.1:{port}",
+         "--max_restarts", str(max_restarts), "--abort_grace", "15",
+         FAULT_WORKER, mode, str(tmp_path)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    _assert_no_drill_orphans(out)
+    return proc.returncode, out
+
+
+def test_fault_crash_consensus_rewind_drill(tmp_path):
+    """The self-healing acceptance drill, one launch end-to-end: rank 3
+    SIGKILLs itself at step 6 → the survivors' collective-timeout plane
+    detects the blocked all_reduce within FLAGS_collective_timeout_s,
+    the cross-rank flight diff names the dead rank (it left no dump),
+    every survivor exits EXIT_COLLECTIVE_TIMEOUT (coordinated abort, not
+    an indefinite block) → the launcher group-restarts → every rank
+    resumes from the CONSENSUS step 3 (rank 1 stopped saving after step
+    3, so 3 is the newest step on every manifest) → the recomputed steps
+    are billed to the goodput ``rewind`` bucket → the final weights on
+    every rank equal the closed-form uninterrupted run."""
+    import re
+
+    rc, out = _run_fault_drill(tmp_path, "crash", target=3,
+                               max_restarts=1)
+    assert rc == 0, f"crash drill did not recover:\n{out}"
+
+    # detection: the abort plane, not the scheduler, caught the death
+    assert "rank.crash_at_step fired at step 6" in out, out
+    assert re.search(
+        r"collective seq=\d+ op=gather_rows .*open for .*"
+        r"FLAGS_collective_timeout_s", out), out
+    # the diff names the SIGKILLed rank from its ABSENT dump
+    assert re.search(r"status=stall rank=3 seq=\d+", out), out
+    assert "rank 3 never issued seq" in out, out
+    # the launcher saw the verdict codes, not a SIGTERM reap
+    assert "COLLECTIVE_TIMEOUT" in out, out
+    assert "signal SIGKILL" in out, out
+    # consensus: all four relaunched ranks agreed on step 3
+    assert out.count("consensus resume step=3") == 4, out
+
+    # closed-form uninterrupted run (must match fault_drill_worker.py)
+    D, LR, STEPS, WORLD = 4, 0.1, 10, 4
+    base = np.arange(1, D + 1, dtype=np.float64)
+    w = np.zeros(D)
+    for s in range(1, STEPS + 1):
+        mean_g = np.mean([base * (r + 1) * 0.001 * ((s % 5) + 1)
+                          for r in range(WORLD)], axis=0)
+        w -= LR * mean_g
+    results = []
+    for r in range(4):
+        with open(os.path.join(str(tmp_path), f"fault.r{r}.json")) as f:
+            results.append(json.load(f))
+    for res in results:
+        assert res["resume_step"] == 3, res
+        np.testing.assert_allclose(
+            res["final_w"], w, rtol=1e-5,
+            err_msg=f"rank {res['rank']} diverged from the "
+                    f"uninterrupted closed form")
+    # goodput rewind: survivors recover crashed_step=5 from their exit
+    # dumps -> 2 recomputed steps billed; the SIGKILLed rank left no
+    # dump, so its account honestly shows no known rewind
+    for res in results:
+        if res["rank"] == 3:
+            assert res["rewind_steps"] == 0, res
+        else:
+            assert res["rewind_steps"] == 2, res
+            assert res["resumes"][0]["crashed_step"] == 5, res
+            # the rewind bucket IS the measured recomputed-step wall
+            assert abs(res["rewind_s"] - res["measured_recompute_s"]) \
+                <= max(0.05, 0.5 * res["measured_recompute_s"]), res
+
+
+def test_fault_hang_drill_names_stalled_rank(tmp_path):
+    """Rank 2 wedges at step 4 with its heartbeat lease kept FRESH (a
+    wedged host looks alive) — only the collective-timeout plane can
+    catch it. The survivors must abort with EXIT_COLLECTIVE_TIMEOUT and
+    the verdict must name the stalled rank + the collective seq it never
+    issued, via flight.diff_ranks over the peer dumps."""
+    import re
+
+    rc, out = _run_fault_drill(tmp_path, "hang", target=2)
+    assert rc == 117, f"expected EXIT_COLLECTIVE_TIMEOUT (117), got " \
+                      f"{rc}:\n{out}"
+    assert "rank.hang_at_step fired at step 4" in out, out
+    assert re.search(r"status=stall rank=2 seq=\d+", out), out
+    assert "rank 2 never issued seq" in out, out
+    assert "COLLECTIVE_TIMEOUT" in out, out
+
+
+def test_fault_lease_loss_drill(tmp_path):
+    """Rank 1 stops publishing its lease at step 4 but KEEPS stepping —
+    a partition, invisible to the collective plane. The survivors must
+    exit EXIT_HEARTBEAT_LOST naming the expired rank, and the launcher
+    must report the distinct heartbeat code — proving the exit-code
+    taxonomy separates the two abort planes.  (The partitioned rank's
+    own-lease self-detection is pinned by an in-process unit in
+    test_fault_supervisor.py — here it races the coordination-service
+    cascade that follows the first survivor exit.)"""
+    rc, out = _run_fault_drill(tmp_path, "lease", target=1)
+    assert rc == 118, f"expected EXIT_HEARTBEAT_LOST (118), got " \
+                      f"{rc}:\n{out}"
+    assert "heartbeat.lease_lost fired at step 4" in out, out
+    assert "rank(s) [1] lease expired" in out, out
+    assert "aborting coordinated" in out, out
+    assert "HEARTBEAT_LOST" in out, out
+
+
 @pytest.mark.slow  # ~60 s each: a virtual-mesh run PLUS a 4-process
 # cluster run. Cross-process coverage for these axes lives in the full
 # (slow-inclusive) run; tier-1 keeps the dp/dp_sharding cluster runs and
-# the auto_tp/auto_fsdp virtual-mesh parity below the 870 s budget.
+# the auto_tp/auto_fsdp virtual-mesh parity below the 1200 s budget.
 @pytest.mark.parametrize("strategy,min_drop", [
     ("dp_mp", 0.5),     # tensor parallel (TP init differs from mp=1)
     ("dp_pp", 0.05),    # SPMD 1F1B pipeline via fleet train_batch

@@ -604,7 +604,12 @@ class TestPerfGate:
         # the goodput-ledger bar encodes the <2% step budget (round 23)
         go = base["rungs"]["goodput_overhead_ratio"]
         assert go["value"] * go["min_ratio"] >= 0.95
+        # the fault-recovery bar: armed abort plane < 2% of disarmed
+        # step time (round 24); MTTR rides ungated in extra
+        fr = base["rungs"]["fault_recovery_overhead_ratio"]
+        assert fr["value"] * fr["min_ratio"] >= 0.95
         assert missing <= {"fleet_observability_overhead_ratio",
+                           "fault_recovery_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",
                            "async_overlap_step_ratio",
