@@ -1,0 +1,30 @@
+"""Mesh-sharded giant-embedding subsystem — the TPU-native translation
+of the reference's industrial parameter server (``distributed/ps/``).
+
+The reference serves trillion-parameter sparse recsys models from a
+host-side PS tier (brpc dense/sparse/SSD tables, ``SelectedRows``
+pulls). On a TPU pod the same capacity problem is solved *on chip*:
+the table row-shards its vocab over the mesh's ``(fsdp, tp)`` axes
+(SNIPPETS [1] pins the ``P(("fsdp", "tp"), None)`` layout), lookups
+dedup their ids before the cross-shard exchange so ONE collective
+moves the deduped rows instead of one gather per id, and the optimizer
+slots stay resident with their table rows — no chip ever materializes
+the full table.
+
+Division of labor with :mod:`paddle_tpu.distributed.ps.embedding`:
+``ShardedEmbedding`` is the on-chip default (table fits the *pod*,
+not one chip); the host-PS ``DistributedEmbedding`` remains the
+overflow tier for tables that exceed even the pod's aggregate HBM
+(host-RAM cold rows). A tier-1 parity test pins the two to identical
+forward/grad numerics on the same table.
+"""
+from .optimizer import RowShardedAdagrad, RowShardedAdam
+from .sharded import (ShardedEmbedding, dedup_stats, exchange_bytes,
+                      naive_gather_bytes, sharded_embedding_bag,
+                      sharded_embedding_lookup)
+
+__all__ = [
+    "ShardedEmbedding", "sharded_embedding_lookup",
+    "sharded_embedding_bag", "dedup_stats", "exchange_bytes",
+    "naive_gather_bytes", "RowShardedAdagrad", "RowShardedAdam",
+]

@@ -1,0 +1,196 @@
+"""Row-sharded optimizer state for giant embedding tables.
+
+The slots (adagrad accumulator, adam moments) are allocated *with the
+table's sharding* — ``device_put`` under the same ``P((fsdp, tp),
+None)`` placement — so optimizer state scales with the pod exactly
+like the table and no chip ever holds a full-table slot. Two update
+paths:
+
+* :meth:`step` — dense: the autograd table grad (already reduced from
+  ``Partial`` by the bucketed grad sync) updates every row. The update
+  math runs shard-local (all operands share the row sharding; GSPMD
+  emits no collective).
+* :meth:`step_rows` — sparse: only the touched rows move. Row grads
+  are merged by id (duplicate ids sum — the scatter-add backward
+  contract), slots are read with ``gather`` and written back with the
+  ``scatter_add`` op, riding the round-17 decomposed-gather seam; the
+  full table is never materialized on one chip, mirroring the host-PS
+  tier's ``push_sparse`` (see ``distributed/ps/embedding.py``).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor, as_tensor
+
+__all__ = ["RowShardedAdagrad", "RowShardedAdam"]
+
+
+def _data(x):
+    return x._data if hasattr(x, "_data") else jnp.asarray(x)
+
+
+def _like_table(value, table_data):
+    """Place a fresh slot array under the table's sharding (no-op on an
+    uncommitted/replicated table)."""
+    sh = getattr(table_data, "sharding", None)
+    if sh is not None and getattr(sh, "mesh", None) is not None:
+        try:
+            return jax.device_put(value, sh)
+        except Exception:        # single-device / incompatible: local
+            return value
+    return value
+
+
+class _RowShardedBase:
+    """Shared slot plumbing: the table Parameter, its sharding, and the
+    write-back that re-pins updated arrays to the table placement."""
+
+    def __init__(self, param, lr: float):
+        self.param = param
+        self.lr = float(lr)
+        self._sharding = getattr(_data(param), "sharding", None)
+
+    def _pin(self, arr):
+        """Keep updated table/slot arrays resident on their shards —
+        eager `.at[].add` may decommit the output placement."""
+        if self._sharding is not None and \
+                getattr(self._sharding, "mesh", None) is not None:
+            try:
+                return jax.device_put(arr, self._sharding)
+            except Exception:
+                return arr
+        return arr
+
+    def slot_nbytes(self) -> int:
+        """Total slot bytes (global, across shards)."""
+        return sum(int(s.size) * s.dtype.itemsize for s in self.slots())
+
+    def slots(self):
+        raise NotImplementedError
+
+    @staticmethod
+    def _merge_rows(row_ids, row_grads):
+        """Sum duplicate-id grads into unique rows (fixed shape: the
+        output keeps the input's row count, extra slots hit id 0 with
+        zero grad — harmless for additive updates)."""
+        ids32 = jnp.ravel(_data(row_ids)).astype(jnp.int32)
+        grads = _data(row_grads)
+        uniq, inv = jnp.unique(ids32, size=ids32.shape[0],
+                               return_inverse=True, fill_value=0)
+        merged = jnp.zeros_like(grads).at[inv.reshape(-1)].add(grads)
+        mask = jnp.zeros((ids32.shape[0],),
+                         grads.dtype).at[inv.reshape(-1)].add(1.0)
+        return uniq, merged, (mask > 0)[:, None]
+
+
+class RowShardedAdagrad(_RowShardedBase):
+    """Per-row adagrad: ``acc += g²; row -= lr·g/(√acc + eps)`` with
+    the accumulator sharded like the table."""
+
+    def __init__(self, param, lr: float = 0.01, eps: float = 1e-10,
+                 initial_accumulator: float = 0.0):
+        super().__init__(param, lr)
+        self.eps = float(eps)
+        td = _data(param)
+        self.acc = _like_table(
+            jnp.full(td.shape, float(initial_accumulator),
+                     dtype=td.dtype), td)
+
+    def slots(self):
+        return (self.acc,)
+
+    def step(self, grad) -> None:
+        g = _data(grad)
+        td = _data(self.param)
+        self.acc = self._pin(self.acc + g * g)
+        self.param._swap_payload(
+            self._pin(td - self.lr * g / (jnp.sqrt(self.acc)
+                                          + self.eps)))
+
+    def step_rows(self, row_ids, row_grads) -> None:
+        """Sparse update: touched rows only. Duplicate ids merge their
+        grads first (the scatter-add backward contract), the slot rows
+        are read with ``gather`` and the deltas written back with the
+        ``scatter_add`` op — the table never densifies."""
+        from ... import ops
+
+        uniq, g, mask = self._merge_rows(row_ids, row_grads)
+        g = g * mask
+        self.acc = self._pin(
+            ops.scatter_add(Tensor(self.acc), Tensor(uniq),
+                            Tensor(g * g))._data)
+        acc_rows = jnp.take(self.acc, uniq, axis=0)
+        delta = -self.lr * g / (jnp.sqrt(acc_rows) + self.eps)
+        self.param._swap_payload(self._pin(
+            ops.scatter_add(self.param, Tensor(uniq),
+                            Tensor(delta))._data))
+
+    def __repr__(self):
+        return f"RowShardedAdagrad(lr={self.lr}, eps={self.eps})"
+
+
+class RowShardedAdam(_RowShardedBase):
+    """Per-row adam with both moment slots sharded like the table."""
+
+    def __init__(self, param, lr: float = 1e-3, beta1: float = 0.9,
+                 beta2: float = 0.999, eps: float = 1e-8):
+        super().__init__(param, lr)
+        self.beta1, self.beta2, self.eps = (float(beta1), float(beta2),
+                                            float(eps))
+        td = _data(param)
+        self.m = _like_table(jnp.zeros(td.shape, dtype=td.dtype), td)
+        self.v = _like_table(jnp.zeros(td.shape, dtype=td.dtype), td)
+        self._t = 0
+
+    def slots(self):
+        return (self.m, self.v)
+
+    def step(self, grad) -> None:
+        g = _data(grad)
+        td = _data(self.param)
+        self._t += 1
+        self.m = self._pin(self.beta1 * self.m + (1 - self.beta1) * g)
+        self.v = self._pin(self.beta2 * self.v
+                           + (1 - self.beta2) * g * g)
+        mhat = self.m / (1 - self.beta1 ** self._t)
+        vhat = self.v / (1 - self.beta2 ** self._t)
+        self.param._swap_payload(
+            self._pin(td - self.lr * mhat / (jnp.sqrt(vhat)
+                                             + self.eps)))
+
+    def step_rows(self, row_ids, row_grads) -> None:
+        """Sparse adam: touched rows update both moment slots in place
+        via ``scatter_add`` deltas (global step count for the bias
+        correction, the industrial sparse-adam convention)."""
+        from ... import ops
+
+        uniq, g, mask = self._merge_rows(row_ids, row_grads)
+        g = g * mask
+        self._t += 1
+        m_rows = jnp.take(self.m, uniq, axis=0)
+        v_rows = jnp.take(self.v, uniq, axis=0)
+        dm = ((self.beta1 - 1.0) * m_rows + (1 - self.beta1) * g) * mask
+        dv = ((self.beta2 - 1.0) * v_rows
+              + (1 - self.beta2) * g * g) * mask
+        self.m = self._pin(
+            ops.scatter_add(Tensor(self.m), Tensor(uniq),
+                            Tensor(dm))._data)
+        self.v = self._pin(
+            ops.scatter_add(Tensor(self.v), Tensor(uniq),
+                            Tensor(dv))._data)
+        m_new = jnp.take(self.m, uniq, axis=0) \
+            / (1 - self.beta1 ** self._t)
+        v_new = jnp.take(self.v, uniq, axis=0) \
+            / (1 - self.beta2 ** self._t)
+        delta = -self.lr * m_new / (jnp.sqrt(v_new) + self.eps) * mask
+        self.param._swap_payload(self._pin(
+            ops.scatter_add(self.param, Tensor(uniq),
+                            Tensor(delta))._data))
+
+    def __repr__(self):
+        return (f"RowShardedAdam(lr={self.lr}, betas=({self.beta1}, "
+                f"{self.beta2}))")

@@ -1,0 +1,322 @@
+"""Row-sharded embedding table + unique-ids dedup lookup.
+
+Layout: the table ``(V, H)`` shards its vocab dim over the mesh's
+``(fsdp, tp)`` axes (``P(("fsdp", "tp"), None)``) — every chip holds
+``V / (fsdp*tp)`` rows and the feature dim stays whole, so a lookup is
+a *row exchange*, never a feature-dim reshard::
+
+    mesh (data=2, fsdp=4):          table (V, H)
+      d0: rows [0,      V/4)   ─┐
+      d1: rows [V/4,   2V/4)    ├─ each shard gathers its resident
+      d2: rows [2V/4,  3V/4)    │  deduped rows; ONE all-reduce of the
+      d3: rows [3V/4,   V)     ─┘  (uniq, H) block completes the lookup
+
+Dedup-before-exchange: a skewed (zipf) batch repeats hot ids, so the
+flat id list is deduped to its unique rows *first* and the cross-shard
+exchange moves ``uniq × H`` row bytes instead of ``B·L × H`` — the
+``paddle_tpu_embedding_unique_ratio`` gauge tracks the shrink and
+``paddle_tpu_embedding_exchange_bytes_total`` accumulates the modeled
+wire bytes. The dedup is fixed-shape (``jnp.unique(size=capacity)``)
+so the lookup stays one compiled program under jit.
+
+The lookup traces as the ``embedding`` / ``embedding_bag`` op, so the
+round-13 spmd rules mark the output reduce-pending (``Partial``) over
+the vocab axes and the planner prices the pending all-reduce; GSPMD
+still owns emitting the collective ("rules annotate, GSPMD picks the
+collectives"). The backward is the gather's transpose — a scatter-add
+of row grads that stays Partial until the bucketed grad sync; the
+sparse optimizer path applies it with the ``scatter_add`` op (see
+``optimizer.py``), never densifying the table on one chip.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core import dispatch
+from ...core.tensor import Tensor, as_tensor
+from ...nn import functional as F
+from ...nn.initializer import Normal
+from ...nn.layer.layers import Layer
+from ...observability import metrics as _metrics
+from .. import mesh as mesh_mod
+
+__all__ = [
+    "ShardedEmbedding", "sharded_embedding_lookup",
+    "sharded_embedding_bag", "dedup_stats", "exchange_bytes",
+    "naive_gather_bytes",
+]
+
+M_UNIQUE_RATIO = _metrics.gauge(
+    "paddle_tpu_embedding_unique_ratio",
+    "unique_ids / total_ids of the last deduped lookup batch — how much "
+    "the dedup shrank the cross-shard row exchange (1.0 = no repeats).")
+M_EXCHANGE_BYTES = _metrics.counter(
+    "paddle_tpu_embedding_exchange_bytes_total",
+    "Modeled per-device wire bytes of the deduped row exchanges (ring "
+    "all-reduce of the (uniq, H) block over the vocab shards).")
+M_DEDUP_OVERFLOW = _metrics.counter(
+    "paddle_tpu_embedding_dedup_overflow_total",
+    "Lookups whose measured unique-id count exceeded dedup_capacity "
+    "(eager mode raises; a jitted lookup would silently drop rows).")
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else as_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# exchange sizing (analytic, mirrors costmodel.collective_cost's ring terms)
+# ---------------------------------------------------------------------------
+def exchange_bytes(n_rows: int, dim: int, n_shards: int,
+                   itemsize: int = 4) -> int:
+    """Per-device wire bytes to complete a deduped lookup: ring
+    all-reduce of the ``(n_rows, dim)`` partial block over ``n_shards``
+    vocab shards (``2·(n−1)/n · B``); 0 on an unsharded table."""
+    if n_shards <= 1:
+        return 0
+    payload = n_rows * dim * itemsize
+    return int(2 * (n_shards - 1) / n_shards * payload)
+
+
+def naive_gather_bytes(n_ids: int, dim: int, n_shards: int,
+                       itemsize: int = 4) -> int:
+    """Wire bytes of the same lookup WITHOUT dedup — every id moves its
+    row through the exchange, repeats and all."""
+    return exchange_bytes(n_ids, dim, n_shards, itemsize)
+
+
+def dedup_stats(ids, vocab_dim: int = 0) -> dict:
+    """Host-side dedup accounting for one id batch: ``n_ids``,
+    ``n_unique``, ``unique_ratio`` (unique/total). Accepts anything
+    array-like; syncs the batch to host, so call it from bench/test
+    code, not the hot path."""
+    flat = jnp.ravel(_t(ids)._data)
+    n = int(flat.size)  # tpulint: disable=TPU103 — observability helper, host sync is its contract
+    n_uniq = int(jnp.unique(flat).size)  # tpulint: disable=TPU103 — same: measured dedup stat for reports
+    return {"n_ids": n, "n_unique": n_uniq,
+            "unique_ratio": (n_uniq / n) if n else 1.0}
+
+
+def _vocab_shards(weight, mesh=None) -> int:
+    """Number of shards the table's vocab dim is split into, from the
+    parameter's stamped spec (``_spmd_spec``) and the live mesh."""
+    spec = getattr(weight, "_spmd_spec", None)
+    if not spec or spec[0] is None:
+        return 1
+    axes = spec[0] if isinstance(spec[0], tuple) else (spec[0],)
+    if mesh is None:
+        # the committed array's own sharding is the authoritative mesh;
+        # the process-global mesh is only a fallback
+        data = getattr(weight, "_data", weight)
+        mesh = getattr(getattr(data, "sharding", None), "mesh", None)
+    if mesh is None:
+        try:
+            mesh = mesh_mod.get_mesh()
+        except Exception:
+            return 1
+    if hasattr(mesh, "jax_mesh"):
+        mesh = mesh.jax_mesh()
+    if mesh is None:
+        return 1
+    shape = dict(getattr(mesh, "shape", {}))
+    n = 1
+    for ax in axes:
+        n *= int(shape.get(ax, 1))
+    return n
+
+
+def _note_lookup(flat_data, capacity: int, dim: int, n_shards: int,
+                 itemsize: int) -> None:
+    """Eager-mode observability for one deduped lookup: unique-ratio
+    gauge, modeled exchange bytes, and a LOUD failure when the batch's
+    real unique count exceeds the fixed dedup capacity (a jitted lookup
+    cannot check — it would silently drop rows)."""
+    if isinstance(flat_data, jax.core.Tracer):
+        return
+    n = int(flat_data.size)  # tpulint: disable=TPU103 — eager-only metrics path, guarded off the traced path above
+    if n == 0:
+        return
+    n_uniq = int(jnp.unique(flat_data).size)  # tpulint: disable=TPU103 — same eager-only metrics path
+    if n_uniq > capacity:
+        M_DEDUP_OVERFLOW.inc()
+        raise ValueError(
+            f"sharded embedding lookup: batch has {n_uniq} unique ids "
+            f"but dedup_capacity={capacity}; a fixed-shape dedup would "
+            f"drop rows. Raise dedup_capacity (or leave it None for "
+            f"the always-safe ids-count default).")
+    M_UNIQUE_RATIO.set(n_uniq / n)
+    M_EXCHANGE_BYTES.inc(
+        exchange_bytes(min(capacity, n), dim, n_shards, itemsize))
+
+
+# ---------------------------------------------------------------------------
+# functional lookups
+# ---------------------------------------------------------------------------
+def sharded_embedding_lookup(ids, weight, *, dedup: bool = True,
+                             dedup_capacity: Optional[int] = None,
+                             padding_idx: Optional[int] = None):
+    """Per-id row lookup ``ids(…) x table(V, H) -> (…, H)`` with
+    unique-ids dedup before the cross-shard exchange.
+
+    The whole dedup → resident-row gather → inverse scatter pipeline is
+    ONE ``embedding`` op: the spmd rule marks the output Partial over a
+    vocab-sharded table's axes and GSPMD emits the single row exchange.
+    ``dedup_capacity`` fixes the dedup's compiled shape (default: the
+    id count — always exact); eager lookups verify the bound and fail
+    loud on overflow.
+    """
+    ids_t, w = _t(ids), _t(weight)
+    if not dedup:
+        return F.embedding(ids_t, w, padding_idx=padding_idx)
+    shape = tuple(int(d) for d in ids_t.shape)
+    n = 1
+    for d in shape:
+        n *= d
+    cap = n if dedup_capacity is None else min(int(dedup_capacity), n)
+    cap = max(cap, 1)
+    itemsize = jnp.dtype(w._data.dtype).itemsize
+    _note_lookup(ids_t._data, cap, int(w.shape[-1]),
+                 _vocab_shards(w), itemsize)
+
+    def f(raw_ids, table):
+        ids32 = jnp.ravel(raw_ids).astype(jnp.int32)
+        uniq, inv = jnp.unique(ids32, size=cap, return_inverse=True,
+                               fill_value=0)
+        rows = jnp.take(table, uniq, axis=0)       # the deduped exchange
+        out = jnp.take(rows, inv.reshape(-1), axis=0)
+        if padding_idx is not None:
+            out = jnp.where((ids32 == padding_idx)[:, None], 0.0, out)
+        return out.reshape(shape + (table.shape[-1],))
+    return dispatch.call("embedding", f, [ids_t, w],
+                         differentiable_mask=[False, True])
+
+
+def sharded_embedding_bag(ids, weight, *, mode: str = "sum",
+                          dedup: bool = True,
+                          dedup_capacity: Optional[int] = None):
+    """Pooled multi-hot lookup ``ids(…, L) x table(V, H) -> (…, H)``
+    (the DLRM feature shape) with the same dedup-before-exchange: one
+    ``embedding_bag`` op whose vocab-sharded output is reduce-pending
+    until GSPMD's single row exchange."""
+    if mode not in ("sum", "mean"):
+        raise ValueError(f"sharded_embedding_bag: mode must be "
+                         f"sum|mean, got {mode!r}")
+    ids_t, w = _t(ids), _t(weight)
+    if not dedup:
+        return F.embedding_bag(ids_t, w, mode=mode)
+    shape = tuple(int(d) for d in ids_t.shape)
+    if len(shape) < 1:
+        raise ValueError("sharded_embedding_bag: ids needs a bag dim")
+    n = 1
+    for d in shape:
+        n *= d
+    cap = n if dedup_capacity is None else min(int(dedup_capacity), n)
+    cap = max(cap, 1)
+    itemsize = jnp.dtype(w._data.dtype).itemsize
+    _note_lookup(ids_t._data, cap, int(w.shape[-1]),
+                 _vocab_shards(w), itemsize)
+
+    def f(raw_ids, table):
+        ids32 = jnp.ravel(raw_ids).astype(jnp.int32)
+        uniq, inv = jnp.unique(ids32, size=cap, return_inverse=True,
+                               fill_value=0)
+        rows = jnp.take(table, uniq, axis=0)       # the deduped exchange
+        per_id = jnp.take(rows, inv.reshape(-1), axis=0)
+        per_id = per_id.reshape(shape + (table.shape[-1],))
+        pooled = jnp.sum(per_id, axis=-2)
+        if mode == "mean":
+            pooled = pooled / float(shape[-1])
+        return pooled
+    return dispatch.call("embedding_bag", f, [ids_t, w],
+                         differentiable_mask=[False, True])
+
+
+# ---------------------------------------------------------------------------
+# the layer
+# ---------------------------------------------------------------------------
+class ShardedEmbedding(Layer):
+    """Embedding whose table row-shards its vocab over ``(fsdp, tp)``.
+
+    The on-chip default for giant tables (see the package docstring for
+    the division of labor vs the host-PS tier). With ``mesh=`` (or via
+    :meth:`shard_` later) the weight is device_put under
+    ``P((fsdp, tp), None)`` — axes missing from the mesh (or of size 1)
+    drop out of the lead tuple, so the same layer runs replicated on a
+    single device and sharded on a pod. ``named_parameters`` exposes
+    the weight under the standard ``weight`` name, so the planner's
+    embedding role heuristics see it like any other table.
+    """
+
+    def __init__(self, num_embeddings: int, embedding_dim: int, *,
+                 mesh=None, axes: Sequence[str] = ("fsdp", "tp"),
+                 dedup: bool = True, dedup_capacity: Optional[int] = None,
+                 padding_idx: Optional[int] = None, weight_attr=None,
+                 name=None):
+        super().__init__()
+        self._num_embeddings = int(num_embeddings)
+        self._embedding_dim = int(embedding_dim)
+        self._axes = tuple(axes)
+        self._dedup = bool(dedup)
+        self._dedup_capacity = dedup_capacity
+        self._padding_idx = (None if padding_idx is None else
+                             padding_idx if padding_idx >= 0
+                             else num_embeddings + padding_idx)
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=Normal(0.0, 1.0))
+        if self._padding_idx is not None:
+            self.weight._swap_payload(
+                self.weight._data.at[self._padding_idx].set(0.0))
+        if mesh is not None:
+            self.shard_(mesh)
+
+    # ------------------------------------------------------------ placement
+    def shard_(self, mesh=None) -> "ShardedEmbedding":
+        """Place the table under ``P(lead, None)`` where ``lead`` is the
+        layer's axes filtered to those present (size > 1) on ``mesh``;
+        stamps ``_spmd_spec`` so trace_scope/planner/liveness all see
+        the row-sharded vocab."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        if mesh is None:
+            mesh = mesh_mod.get_mesh()
+        if hasattr(mesh, "jax_mesh"):
+            mesh = mesh.jax_mesh()
+        shape = dict(mesh.shape)
+        lead = tuple(a for a in self._axes
+                     if int(shape.get(a, 1)) > 1)
+        if not lead:
+            self.weight._spmd_spec = (None, None)
+            return self
+        sharding = NamedSharding(mesh, P(lead, None))
+        self.weight._swap_payload(
+            jax.device_put(self.weight._data, sharding))
+        self.weight._spmd_spec = (lead if len(lead) > 1 else lead[0],
+                                  None)
+        return self
+
+    @property
+    def vocab_shards(self) -> int:
+        """How many ways the vocab dim is currently split."""
+        return _vocab_shards(self.weight)
+
+    # ------------------------------------------------------------- lookups
+    def forward(self, ids):
+        return sharded_embedding_lookup(
+            ids, self.weight, dedup=self._dedup,
+            dedup_capacity=self._dedup_capacity,
+            padding_idx=self._padding_idx)
+
+    def bag(self, ids, mode: str = "sum"):
+        """Pooled lookup over the trailing bag dim (DLRM multi-hot)."""
+        return sharded_embedding_bag(
+            ids, self.weight, mode=mode, dedup=self._dedup,
+            dedup_capacity=self._dedup_capacity)
+
+    def extra_repr(self):
+        return (f"{self._num_embeddings}, {self._embedding_dim}, "
+                f"axes={self._axes}, shards={self.vocab_shards}")

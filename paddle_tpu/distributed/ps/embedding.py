@@ -12,6 +12,17 @@ downstream compute stay on-chip and differentiable*. The backward hook
 pushes per-row gradients back to the PS, where the table's accessor
 (sgd/adam/adagrad/sum) applies the update — so the embedding optimizer
 runs server-side, exactly the reference's division of labor.
+
+Relation to :class:`~paddle_tpu.distributed.embedding.ShardedEmbedding`:
+the two are tiers of one story. ``ShardedEmbedding`` is the on-chip
+default — the table is row-sharded across mesh axes and rows move over
+ICI collectives. ``DistributedEmbedding`` is the *host overflow tier*
+for tables too large even for the whole pod's HBM: rows live in host
+RAM behind the PS and cross the wire per batch. Both dedup ids before
+the exchange and sum-merge duplicate-row grads, so a table can be moved
+between tiers without changing training semantics —
+``tests/test_sharded_embedding.py::TestPsParityBridge`` pins the
+forward/backward parity between them.
 """
 from __future__ import annotations
 

@@ -640,6 +640,55 @@ def embedding_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
                       out_partial=[pend] + [()] * (len(out_shapes) - 1))
 
 
+def embedding_bag_rule(in_specs, in_shapes, attrs,
+                       out_shapes) -> SpmdResult:
+    """ids(…, L) x table(V, H) -> out(…, H): like ``embedding_rule``
+    but the pooled bag dim L disappears. Batch dims keep the ids'
+    placement, the feature dim takes the table's; a vocab-sharded table
+    pools only its resident rows per shard, so the output is
+    reduce-pending over the vocab axes (the sharded-embedding lookup's
+    single deduped exchange IS that pending reduce)."""
+    if len(in_specs) < 2:
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    ids_spec, table_spec = in_specs[0], in_specs[1]
+    out_shape = out_shapes[0]
+    out = list((None,) * len(out_shape))
+    # ids dims minus the pooled last one carry to the output's lead dims
+    for d in range(min(len(in_shapes[0]) - 1, len(out_shape) - 1)):
+        out[d] = ids_spec[d]
+    if len(out_shape) >= 1 and len(table_spec) >= 2:
+        out[-1] = table_spec[-1]
+    out = dedupe(tuple(out))
+    used = {ax for e in out for ax in _axes(e)}
+    pend = tuple(sorted(set(_axes(table_spec[0])) - used)) \
+        if len(table_spec) >= 2 else ()
+    return SpmdResult(out_specs=[out],
+                      out_partial=[pend] + [()] * (len(out_shapes) - 1))
+
+
+def scatter_add_rule(in_specs, in_shapes, attrs,
+                     out_shapes) -> SpmdResult:
+    """dest(V, …) + index(N) + updates(N, …) -> out(V, …): row
+    accumulation keeps the DESTINATION's placement — a vocab-sharded
+    dest accepts only its resident rows per shard (the sharded-embedding
+    backward's table-grad scatter). Trailing dims meet with the updates'
+    so a feature-dim disagreement replicates instead of mis-sharding."""
+    if not in_specs:
+        return SpmdResult(out_specs=[(None,) * len(s)
+                                     for s in out_shapes])
+    dest_spec = tuple(in_specs[0])
+    out = dest_spec
+    if (len(in_specs) >= 3 and len(in_specs[2]) == len(dest_spec)
+            and len(dest_spec) >= 1):
+        upd_spec = tuple(in_specs[2])
+        out = (dest_spec[0],) + meet(dest_spec[1:], upd_spec[1:])
+    out = dedupe(out)
+    outs = [out if tuple(s) == tuple(in_shapes[0])
+            else _carry(out, in_shapes[0], s) for s in out_shapes]
+    return SpmdResult(out_specs=outs)
+
+
 def gather_rule(in_specs, in_shapes, attrs, out_shapes) -> SpmdResult:
     """Value-dependent addressing: output dims that still match the
     source carry through, gathered dims replicate."""
@@ -856,6 +905,8 @@ def _fill_rules():
                  "expand_dims"):
         SPMD_RULES[name] = reshape_rule
     SPMD_RULES["embedding"] = embedding_rule
+    SPMD_RULES["embedding_bag"] = embedding_bag_rule
+    SPMD_RULES["scatter_add"] = scatter_add_rule
     for name in ("gather", "gather_nd", "index_select", "take_along_axis",
                  "index_sample", "take"):
         SPMD_RULES[name] = gather_rule
@@ -864,7 +915,7 @@ def _fill_rules():
     for name in ("cross_entropy", "softmax_with_cross_entropy",
                  "fused_linear_cross_entropy", "nll_loss",
                  "binary_cross_entropy", "binary_cross_entropy_with_logits",
-                 "sigmoid_cross_entropy"):
+                 "bce_with_logits", "sigmoid_cross_entropy"):
         SPMD_RULES[name] = cross_entropy_rule
     for name in ("getitem", "slice", "strided_slice", "index",
                  "masked_select"):

@@ -198,6 +198,20 @@ class _GPTArch:
         return ops.matmul(last, m.wte.weight, transpose_y=True)
 
 
+class _DenseArch:
+    """Adapter for dense-scoring models (DLRM / two-tower recsys): the
+    model provides ``serve_dense(flat_ids) -> (B,) scores in [0, 1]``
+    plus ``serve_dense_width`` (the flat-id row width requests pad to).
+    No KV cache, no positions, no autoregression — each request is ONE
+    forward that emits a single "score token" (the score in basis
+    points), so the whole engine surface (Router placement, outcomes,
+    streams, SLO burn, warmup/drain) works unchanged on top of it."""
+
+    def __init__(self, model):
+        self.model = model
+        self.width = int(model.serve_dense_width)
+
+
 def _pick_arch(model):
     from ..models.gpt import GPTForCausalLM
     from ..models.llama import LlamaForCausalLM
@@ -205,9 +219,12 @@ def _pick_arch(model):
         return _LlamaArch(model)
     if isinstance(model, GPTForCausalLM):
         return _GPTArch(model)
+    if hasattr(model, "serve_dense"):
+        return _DenseArch(model)
     raise TypeError(
         f"PagedEngine supports LlamaForCausalLM / GPTForCausalLM (or "
-        f"subclasses), got {type(model).__name__}")
+        f"subclasses) and dense-scoring models exposing serve_dense(); "
+        f"got {type(model).__name__}")
 
 
 def _tuned_decode_block_size(cfg, nkv, max_batch, max_blocks_per_seq,
@@ -413,8 +430,28 @@ def _paged_verify(arch, params, param_arrays, kcs, vcs, tokens, seq_lens,
             p._data = o
 
 
+def _dense_forward(arch, params, param_arrays, ids):
+    """Dense-path scoring program: one (B, width) padded id batch in,
+    (B,) scores out. Same param-rebinding discipline as _paged_forward
+    so the shared jit cache never captures an engine instance."""
+    originals = _bind_params(params, param_arrays)
+    try:
+        scores = arch.model.serve_dense(Tensor(ids))
+        return scores._data.astype(jnp.float32)
+    finally:
+        for p, o in zip(params, originals):
+            p._data = o
+
+
 class PagedEngine:
-    """Continuous-batching engine for causal LMs (paged KV caches)."""
+    """Continuous-batching engine for causal LMs (paged KV caches).
+
+    Dense-scoring models (anything exposing ``serve_dense`` /
+    ``serve_dense_width``, e.g. :class:`~paddle_tpu.models.DLRM`) run
+    on the same engine through the dense path: no KV pool, one forward
+    per tick over up to ``max_batch`` queued requests, one score token
+    per request — so the Router load-balances recsys replicas exactly
+    like LM replicas."""
 
     def __init__(self, model, *, max_batch: int = 8,
                  block_size: Optional[int] = 16,
@@ -427,8 +464,15 @@ class PagedEngine:
 
         self.model = model
         self.arch = _pick_arch(model)
+        self._dense = isinstance(self.arch, _DenseArch)
         self.cfg = model.cfg
         self.max_batch = max_batch
+        if self._dense:
+            # dense path: "block size" only sizes the synthetic warmup
+            # prompt — use the model's id-row width so warmup compiles
+            # the exact steady-state program
+            block_size = self.arch.width
+            speculate = None
         if block_size is None:
             # measured choice for this chip/model-geometry (falls back to
             # 16 off-TPU); ops/pallas/autotune.py caches winners on disk
@@ -439,8 +483,12 @@ class PagedEngine:
         self.max_blocks_per_seq = max_blocks_per_seq
         self.eos_id = eos_id
         cfg = self.cfg
-        self.head_dim = cfg.hidden_size // cfg.num_heads
-        nkv = self.arch.num_kv_heads
+        if self._dense:
+            self.head_dim = 0
+            nkv = 0
+        else:
+            self.head_dim = cfg.hidden_size // cfg.num_heads
+            nkv = self.arch.num_kv_heads
         self.num_kv_heads = nkv
 
         # ---- phase-split scheduler (paddle_tpu.serving.Scheduler) ----
@@ -483,8 +531,11 @@ class PagedEngine:
         self.kv_dtype = jnp.dtype(kv_dtype)
         self._kv_shape = (num_blocks, block_size, nkv, self.head_dim)
         self._kv_scale_shape = (num_blocks, block_size, nkv)
-        self.kc = [self._fresh_cache() for _ in range(cfg.num_layers)]
-        self.vc = [self._fresh_cache() for _ in range(cfg.num_layers)]
+        if self._dense:
+            self.kc, self.vc = [], []     # no KV state on the dense path
+        else:
+            self.kc = [self._fresh_cache() for _ in range(cfg.num_layers)]
+            self.vc = [self._fresh_cache() for _ in range(cfg.num_layers)]
 
         self.tables = np.zeros((max_batch, max_blocks_per_seq), np.int32)
         self.seq_lens = np.ones((max_batch,), np.int32)  # idle: len 1
@@ -506,20 +557,29 @@ class PagedEngine:
         import functools
         cache = _PAGED_JIT_CACHE.setdefault(model, {})
         arch_key = type(self.arch).__name__
-        fn = cache.get((arch_key, "chunk"))
-        if fn is None:
-            fn = cache[(arch_key, "chunk")] = jax.jit(
-                functools.partial(_paged_forward, self.arch,
-                                  tuple(self._params)),
-                donate_argnums=(1, 2), static_argnames=("sampling",))
-        self._fn = fn
-        vfn = cache.get((arch_key, "verify"))
-        if vfn is None:
-            vfn = cache[(arch_key, "verify")] = jax.jit(
-                functools.partial(_paged_verify, self.arch,
-                                  tuple(self._params)),
-                donate_argnums=(1, 2), static_argnames=("sampling",))
-        self._vfn = vfn
+        if self._dense:
+            dfn = cache.get((arch_key, "dense"))
+            if dfn is None:
+                dfn = cache[(arch_key, "dense")] = jax.jit(
+                    functools.partial(_dense_forward, self.arch,
+                                      tuple(self._params)))
+            self._dense_fn = dfn
+            self._fn = self._vfn = None
+        else:
+            fn = cache.get((arch_key, "chunk"))
+            if fn is None:
+                fn = cache[(arch_key, "chunk")] = jax.jit(
+                    functools.partial(_paged_forward, self.arch,
+                                      tuple(self._params)),
+                    donate_argnums=(1, 2), static_argnames=("sampling",))
+            self._fn = fn
+            vfn = cache.get((arch_key, "verify"))
+            if vfn is None:
+                vfn = cache[(arch_key, "verify")] = jax.jit(
+                    functools.partial(_paged_verify, self.arch,
+                                      tuple(self._params)),
+                    donate_argnums=(1, 2), static_argnames=("sampling",))
+            self._vfn = vfn
         self._base_key = jax.random.key(seed)
         self._done: List[Request] = []
         self._rid = 0
@@ -569,6 +629,8 @@ class PagedEngine:
     def kv_bytes_per_token(self) -> int:
         """Resident KV bytes one cached token costs across all layers
         (the resident-batch ceiling is HBM / (this * mean seq len))."""
+        if self._dense:
+            return 0                     # dense path keeps no KV state
         per = self.num_kv_heads * self.head_dim * self.kv_dtype.itemsize
         if self._kv_int8:
             per += self.num_kv_heads * 4          # sidecar fp32 scale
@@ -604,6 +666,12 @@ class PagedEngine:
             raise ValueError("add_request: top_p must be in (0, 1]")
         if not temperature >= 0.0:   # also rejects NaN
             raise ValueError("add_request: temperature must be >= 0")
+        if self._dense and len(prompt) > self.arch.width:
+            # the id row is padded, never truncated — silently dropping
+            # trailing feature ids would score a different request
+            raise ValueError(
+                f"add_request: dense-path prompt ({len(prompt)} ids) "
+                f"exceeds the model's serve width ({self.arch.width})")
         max_pos = getattr(self.arch, "max_positions", None)
         if max_pos is not None and len(prompt) + max_new_tokens > max_pos:
             # learned-position models: a sequence growing past the table
@@ -1096,6 +1164,13 @@ class PagedEngine:
                 "serving.crash_at_tick",
                 f"injected crash at tick {self._ticks}")
         self._expire_deadlines()
+        if self._dense:
+            # dense path: the tick itself admits (it consumes up to
+            # max_batch from the queue head), so shed only what the
+            # forward could not absorb
+            self._dense_tick()
+            self._shed_overload()
+            return
         # admit BEFORE shedding: a burst hitting an idle replica flows
         # into free decode slots first; only what capacity could not
         # absorb this tick counts against the high-water mark
@@ -1105,6 +1180,43 @@ class PagedEngine:
         # tick there is decodable work, however much prefill is pending
         self._prefill_step()
         self._decode_active()
+
+    def _dense_tick(self):
+        """Score up to ``max_batch`` queued requests in ONE
+        ``serve_dense`` forward. The id matrix is always
+        (max_batch, width) — short batches ride zero rows — so jit
+        compiles exactly one steady-state program. Each request emits a
+        single score token (the [0, 1] score in basis points) and
+        finishes; no engine state survives the tick."""
+        if not self.queue:
+            return
+        batch = self.queue[:self.max_batch]
+        del self.queue[:len(batch)]
+        w = self.arch.width
+        ids = np.zeros((self.max_batch, w), np.int32)
+        for i, req in enumerate(batch):
+            ids[i, :len(req.prompt)] = req.prompt
+        was_training = getattr(self.model, "training", False)
+        if was_training:
+            self.model.eval()
+        t0 = time.perf_counter()
+        try:
+            scores = self._dense_fn([p._data for p in self._params],
+                                    jnp.asarray(ids))
+            out = np.asarray(scores)  # tpulint: disable=TPU104 — host boundary by design: scores become outcome tokens
+        finally:
+            if was_training:
+                self.model.train()
+        self.scheduler.note_phase("decode", len(batch),
+                                  time.perf_counter() - t0)
+        now = self._clock()
+        for i, req in enumerate(batch):
+            bp = int(round(float(out[i]) * 10000.0))  # tpulint: disable=TPU103 — host boundary by design: the score token enters the python-side outcome
+            req.generated.append(bp)
+            self._rt_event(req.rid, "dense_score", t=now, score_bp=bp,
+                           tick=self._ticks)
+            self._record_token(req, now)
+            self._finish_request(req, RequestStatus.FINISHED)
 
     def _decode_lanes(self) -> List[int]:
         """Slots holding a fully-prefilled request (mid-prefill slots

@@ -8,9 +8,11 @@ from .bert import (BertConfig, BertForPretraining,
                    BertForSequenceClassification, BertModel)
 from .llama import (LlamaConfig, LlamaForCausalLM, LlamaModel,
                     llama_7b, llama_tiny, llama2_13b, llama2_70b)
+from .dlrm import DLRM, DLRMConfig, dlrm_tiny
 
 __all__ = [
     "LeNet", "GPTConfig", "GPTModel", "GPTForCausalLM",
+    "DLRM", "DLRMConfig", "dlrm_tiny",
     "BertConfig", "BertModel", "BertForPretraining",
     "BertForSequenceClassification",
     "LlamaConfig", "LlamaModel", "LlamaForCausalLM",

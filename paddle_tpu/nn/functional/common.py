@@ -106,6 +106,41 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
                          differentiable_mask=[False, True])
 
 
+def embedding_bag(x, weight, mode="sum", padding_idx=None, name=None):
+    """Pooled row gather: ids ``(..., L)`` x table ``(V, H)`` ->
+    ``(..., H)``, reduced over the bag dim ``L`` (reference
+    embedding_bag; the DLRM multi-hot lookup shape).
+
+    ``padding_idx`` rows contribute zero to the pool; ``mode="mean"``
+    divides by the count of non-padding ids per bag (a bag of only
+    padding ids pools to zero). The op traces as ``embedding_bag`` so
+    the planner prices it and the spmd rule marks the output
+    reduce-pending over a vocab-sharded table's axes (see
+    ``distributed/spmd/rules.py:embedding_bag_rule``).
+    """
+    if mode not in ("sum", "mean"):
+        raise ValueError(f"embedding_bag: mode must be sum|mean, "
+                         f"got {mode!r}")
+    x, w = _t(x), _t(weight)
+
+    def f(ids, table):
+        ids32 = ids.astype(jnp.int32)
+        rows = jnp.take(table, ids32, axis=0)
+        if padding_idx is not None:
+            keep = (ids32 != padding_idx)[..., None]
+            rows = jnp.where(keep, rows, 0.0)
+            denom = jnp.maximum(
+                jnp.sum(keep, axis=-2).astype(rows.dtype), 1.0)
+        else:
+            denom = jnp.asarray(float(ids32.shape[-1]), rows.dtype)
+        pooled = jnp.sum(rows, axis=-2)
+        if mode == "mean":
+            pooled = pooled / denom
+        return pooled
+    return dispatch.call("embedding_bag", f, [x, w],
+                         differentiable_mask=[False, True])
+
+
 def one_hot(x, num_classes, name=None):
     return dispatch.call(
         "one_hot",
@@ -359,7 +394,8 @@ def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
 
 __all__ = [
     "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
-    "embedding", "one_hot", "pad", "zeropad2d", "interpolate", "upsample",
+    "embedding", "embedding_bag", "one_hot", "pad", "zeropad2d",
+    "interpolate", "upsample",
     "unfold", "fold", "pixel_shuffle", "pixel_unshuffle", "channel_shuffle",
     "cosine_similarity", "bilinear", "label_smooth",
 ]

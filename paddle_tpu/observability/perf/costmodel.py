@@ -219,6 +219,31 @@ def gather_cost(input_shapes, input_dtypes, attrs, output_shapes) -> OpCost:
     return OpCost(0.0, read + out_b, out_b, "gather")
 
 
+def embedding_bag_cost(input_shapes, input_dtypes, attrs,
+                       output_shapes) -> OpCost:
+    """Pooled gather (ids(…, L) x table(V, H) -> (…, H)): every id
+    reads one H-row, the pool adds them (1 flop per gathered element),
+    but only ONE pooled row is written per bag — the traffic asymmetry
+    that makes dedup-before-exchange pay on skewed batches."""
+    ids_n = _numel(input_shapes[0]) if input_shapes else 0
+    table = tuple(input_shapes[1]) if len(input_shapes) > 1 else ()
+    h = int(table[-1]) if table else 1
+    item = dtype_bytes(input_dtypes[1]) if len(input_dtypes) > 1 else 4
+    read = ids_n * 8 + ids_n * h * item      # indices (i64) + rows
+    out_b = sum(_numel(s) * item for s in output_shapes)
+    return OpCost(float(ids_n * h), read, out_b, "embedding_bag")
+
+
+def scatter_add_cost(input_shapes, input_dtypes, attrs,
+                     output_shapes) -> OpCost:
+    """Row accumulate (dest(V, …) += updates at index): dest read +
+    written once, updates and indices read once, one add per updated
+    element (the sharded-embedding backward's table-grad op)."""
+    upd_n = _numel(input_shapes[2]) if len(input_shapes) > 2 else 0
+    read, written = _io_bytes(input_shapes, input_dtypes, output_shapes)
+    return OpCost(float(upd_n), read, written, "scatter_add")
+
+
 def cross_entropy_cost(input_shapes, input_dtypes, attrs,
                        output_shapes) -> OpCost:
     n = _numel(input_shapes[0]) if input_shapes else 0
@@ -337,11 +362,13 @@ def _fill_models():
     COST_MODELS["softmax"] = softmax_cost
     COST_MODELS["log_softmax"] = softmax_cost
     for name in ("cross_entropy", "softmax_with_cross_entropy",
-                 "fused_linear_cross_entropy"):
+                 "fused_linear_cross_entropy", "bce_with_logits"):
         COST_MODELS[name] = cross_entropy_cost
     for name in ("embedding", "gather", "gather_nd", "index_select",
                  "take_along_axis"):
         COST_MODELS[name] = gather_cost
+    COST_MODELS["embedding_bag"] = embedding_bag_cost
+    COST_MODELS["scatter_add"] = scatter_add_cost
     for name in ("sum", "mean", "max", "min", "prod", "reduce_sum",
                  "logsumexp", "cumsum", "argmax", "argmin", "norm"):
         COST_MODELS[name] = reduction_cost

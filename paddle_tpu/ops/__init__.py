@@ -86,9 +86,12 @@ def _getitem(self, idx):
     masked_select semantics)."""
     if isinstance(idx, Tensor) and idx.dtype == np.dtype(bool):
         # boolean mask -> dynamic shape -> host path (parity with reference
-        # masked_select semantics)
-        return search.masked_select(self, idx) if False else Tensor(
-            jnp.asarray(np.asarray(self._data)[np.asarray(idx._data).astype(bool)]))
+        # masked_select semantics): the result length is only known after
+        # reading the mask, so this site is a host boundary by contract,
+        # not an accidental sync
+        mask = np.asarray(idx._data).astype(bool)  # tpulint: disable=TPU104 — data-dependent output shape
+        data = np.asarray(self._data)  # tpulint: disable=TPU104 — same masked_select host boundary
+        return Tensor(jnp.asarray(data[mask]))
     nidx = _norm_index(idx)
     attrs = {}
     reg = _static_region(idx, self.shape)

@@ -21,7 +21,8 @@ __all__ = [
     "transpose", "moveaxis", "swapaxes", "concat", "stack", "unstack", "split",
     "tensor_split", "chunk", "tile", "expand", "expand_as", "broadcast_to",
     "broadcast_tensors", "flip", "rot90", "roll", "repeat_interleave", "gather",
-    "gather_nd", "scatter", "scatter_nd_add", "put_along_axis", "take_along_axis",
+    "gather_nd", "scatter", "scatter_add", "scatter_nd_add", "put_along_axis",
+    "take_along_axis",
     "index_select", "index_sample", "index_add", "index_put", "masked_select",
     "masked_fill", "slice", "strided_slice", "crop", "pad", "unbind", "numel",
     "shard_index", "as_real", "as_complex", "view", "view_as", "unfold",
@@ -324,6 +325,25 @@ def scatter(x, index, updates, overwrite=True, name=None):
         zeroed = a.at[idx].set(jnp.zeros_like(upd))
         return zeroed.at[idx].add(upd)
     return dispatch.call("scatter", f, [_t(x), _t(index), _t(updates)],
+                         differentiable_mask=[True, False, True])
+
+
+@register("scatter_add", category="indexing")
+def scatter_add(x, index, updates, name=None):
+    """Accumulate ``updates`` rows into ``x`` at ``index`` along dim 0
+    (duplicate indices sum — torch.scatter_add over rows; the sharded-
+    embedding backward's table-grad op). Unlike ``scatter``, duplicates
+    never overwrite: out[index[i]] += updates[i].
+
+    The op traces as ``scatter_add`` so the planner prices the
+    row-scatter traffic and the spmd rule keeps the destination's
+    (possibly vocab-sharded) placement — see
+    ``distributed/spmd/rules.py:scatter_add_rule``.
+    """
+    def f(a, idx, upd):
+        idx = idx.astype(jnp.int32).reshape(-1)
+        return a.at[idx].add(upd.astype(a.dtype))
+    return dispatch.call("scatter_add", f, [_t(x), _t(index), _t(updates)],
                          differentiable_mask=[True, False, True])
 
 

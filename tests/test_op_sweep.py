@@ -431,6 +431,8 @@ rec("fused_rope_proj", [sym(2, 4, 8), sym(8, 8)],
 # --------------------------------------------------------------- nn_common
 rec("linear", [sym(3, 4), sym(4, 5)], ref=np.matmul, grad_tol=2e-2)
 rec("embedding", [ints(6, 3), sym(6, 4)], grad_idx=[1])
+rec("embedding_bag", [ints(6, 3, 2), sym(6, 4)], grad_idx=[1],
+    ref=lambda i, w, **kw: w[i].sum(-2))
 rec("dropout", [sym(3, 4)], attrs={"p": 0.0}, ref=lambda x, **kw: x)
 rec("alpha_dropout", [sym(3, 4)], attrs={"p": 0.0},
     ref=lambda x, **kw: x)
@@ -501,6 +503,16 @@ rec("scatter", [sym(4, 3), ints(4, 2), sym(2, 3)], grad_idx=[0, 2],
     jit=False)
 rec("scatter_nd_add", [sym(4, 3), ints(4, 2, 1), sym(2, 3)],
     grad_idx=[0, 2], jit=False)
+
+
+def _scatter_add_ref(x, i, u, **kw):
+    out = np.copy(x)
+    np.add.at(out, i, u)
+    return out
+
+
+rec("scatter_add", [sym(4, 3), ints(4, 5), sym(5, 3)], grad_idx=[0, 2],
+    ref=_scatter_add_ref)
 rec("masked_select", [sym(3, 4), boolean(3, 4)], grad=False, jit=False)
 
 # ------------------------------------------------------------------- search

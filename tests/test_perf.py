@@ -608,7 +608,13 @@ class TestPerfGate:
         # step time (round 24); MTTR rides ungated in extra
         fr = base["rungs"]["fault_recovery_overhead_ratio"]
         assert fr["value"] * fr["min_ratio"] >= 0.95
+        # the giant-embedding bar: sharded DLRM step >= the frozen
+        # no-regression floor vs the replicated baseline (round 25;
+        # parity + pod capacity proof + dedup win gate the score)
+        eb = base["rungs"]["embedding_sharded_vs_replicated_step_ratio"]
+        assert eb["value"] * eb["min_ratio"] >= 0.8
         assert missing <= {"fleet_observability_overhead_ratio",
+                           "embedding_sharded_vs_replicated_step_ratio",
                            "fault_recovery_overhead_ratio",
                            "fusion_fused_vs_unfused_step_ratio",
                            "planner_vs_manual_step_ratio",

@@ -414,7 +414,7 @@ def test_planner_audit_clean():
     from tools.planner_audit import audit
     rep = audit()
     assert rep["ok"], rep["uncovered"]
-    assert set(rep["workloads"]) == {"gpt", "llama", "moe"}
+    assert set(rep["workloads"]) == {"gpt", "llama", "moe", "dlrm"}
     # the MoE workload's opaque ops go through the penalty table, not
     # silence
     assert rep["workloads"]["moe"].get("moe_layer") == "penalty"

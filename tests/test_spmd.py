@@ -112,6 +112,46 @@ class TestRules:
                                [(4, 16), (64, 32)], {}, [(4, 16, 32)])
         assert res.out_specs[0] == ("data", None, "tp")
 
+    def test_embedding_rule_vocab_sharded_emits_partial(self):
+        """Regression (giant-embedding round): a row-sharded vocab dim
+        means every shard gathers masked rows — the output is Partial
+        over the vocab axes until an all-reduce, and the rule must say
+        so (a dropped pending-set silently double-counts the rows)."""
+        res = R.embedding_rule([("data", None), (("fsdp", "tp"), None)],
+                               [(4, 16), (65536, 32)], {},
+                               [(4, 16, 32)])
+        assert res.out_specs[0] == ("data", None, None)
+        assert res.out_partial[0] == ("fsdp", "tp")
+        # unsharded vocab: nothing pends
+        res = R.embedding_rule([("data", None), (None, "tp")],
+                               [(4, 16), (64, 32)], {}, [(4, 16, 32)])
+        assert res.out_partial[0] == ()
+
+    def test_embedding_bag_rule(self):
+        """Pooled lookup: ids' lead dims carry, the pooled dim is gone,
+        the hidden dim takes the table's, and a sharded vocab pends the
+        same all-reduce as plain embedding."""
+        res = R.embedding_bag_rule(
+            [("data", None, None), (("fsdp", "tp"), None)],
+            [(4, 8, 4), (65536, 32)], {}, [(4, 8, 32)])
+        assert res.out_specs[0] == ("data", None, None)
+        assert res.out_partial[0] == ("fsdp", "tp")
+        res = R.embedding_bag_rule(
+            [("data", None, None), (None, "tp")],
+            [(4, 8, 4), (64, 32)], {}, [(4, 8, 32)])
+        assert res.out_specs[0] == ("data", None, "tp")
+        assert res.out_partial[0] == ()
+
+    def test_scatter_add_rule_keeps_dest_placement(self):
+        """The sparse optimizer write-back: the destination table keeps
+        its row sharding (each shard applies its own rows' updates), no
+        pending reduce."""
+        res = R.scatter_add_rule(
+            [(("fsdp", "tp"), None), (None,), (None, None)],
+            [(65536, 32), (128,), (128, 32)], {}, [(65536, 32)])
+        assert res.out_specs[0] == (("fsdp", "tp"), None)
+        assert not any(res.out_partial)    # no pending reduce
+
     def test_attention_rule_constrains_kv(self):
         q = ("data", None, "tp", None)
         res = R.attention_rule([q, q, q],
@@ -401,12 +441,13 @@ class TestCoverageGate:
         rules)."""
         from tools.spmd_coverage_audit import audit
         rep = audit()
-        assert rep["tiers"]["rule"] >= 252, rep["tiers"]
-        assert rep["rule_classes"] >= 25, rep["rule_classes"]
+        assert rep["tiers"]["rule"] >= 257, rep["tiers"]
+        assert rep["rule_classes"] >= 29, rep["rule_classes"]
         # the high-traffic LLM op set must be tier-'rule' forever —
         # including the compile/fusion rewrite targets (a fused program
         # must propagate with zero replicate-fallbacks)
-        for op in ("matmul", "linear", "embedding", "layer_norm",
+        for op in ("matmul", "linear", "embedding", "embedding_bag",
+                   "scatter_add", "bce_with_logits", "layer_norm",
                    "rms_norm", "flash_attention",
                    "scaled_dot_product_attention", "reshape", "split",
                    "softmax", "cross_entropy", "gelu", "getitem",

@@ -2,9 +2,11 @@
 
 The planner ranks placements by an analytical cost walk; an op the walk
 cannot see (no spmd rule AND no cost model AND no explicit penalty
-entry) silently biases every score. This audit traces the three LLM
-workload programs the planner is pointed at — GPT, llama, and the MoE
-layer — and asserts every emitted op is covered one of two ways:
+entry) silently biases every score. This audit traces the workload
+programs the planner is pointed at — GPT, llama, the MoE layer, and
+the DLRM recommender (sharded-embedding path: ``embedding_bag`` /
+``scatter_add``) — and asserts every emitted op is covered one of two
+ways:
 
 * a **sharding tier** that isn't replicate-warn (named ``spmd_rule`` or
   category fallback) AND a cost model (``cost_of`` returns non-None), or
@@ -96,10 +98,32 @@ def _trace_moe():
     return prog
 
 
+def _trace_dlrm():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import planner
+    from paddle_tpu.models import DLRM, dlrm_tiny
+
+    paddle.seed(0)
+    cfg = dlrm_tiny()
+    model = DLRM(cfg)
+    dense = np.zeros((4, cfg.n_dense), dtype=np.float32)
+    ids = np.zeros((4, cfg.n_sparse, cfg.bag_size), dtype=np.int64)
+    labels = np.zeros((4,), dtype=np.float32)
+
+    def loss_fn(d, i, y):
+        return model.loss(d, i, y)
+
+    prog, _ = planner.trace_program(loss_fn, (dense, ids, labels))
+    return prog
+
+
 WORKLOADS = {
     "gpt": _trace_gpt,
     "llama": _trace_llama,
     "moe": _trace_moe,
+    "dlrm": _trace_dlrm,
 }
 
 
